@@ -1,0 +1,132 @@
+"""RNN stack machinery (reference: apex/RNN/RNNBackend.py).
+
+``RNNCell`` holds per-layer weights (gate_multiplier × hidden gates, like
+RNNBackend.py:232-365 incl. the optional output projection);
+``stackedRNN`` runs layers sequentially with each layer a single
+``lax.scan`` over time (the reference's Python loop, :122-195, compiled);
+``bidirectionalRNN`` (:25-86) runs forward/reverse scans and concatenates.
+
+Inputs are seq-major (T, B, F) like the reference.  Hidden state is
+returned functionally instead of stored on the module
+(detach/reset_hidden become no-ops handled by the caller).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .cells import CELLS
+from ..nn.module import Module, ModuleList
+
+__all__ = ["RNNCell", "stackedRNN", "bidirectionalRNN"]
+
+
+class RNNCell(Module):
+    """One recurrent layer's weights + step function."""
+
+    def __init__(self, gate_multiplier: int, input_size: int,
+                 hidden_size: int, cell: str, n_hidden_states: int = 2,
+                 bias: bool = True, output_size: Optional[int] = None):
+        super().__init__()
+        self.gate_multiplier = gate_multiplier
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.cell = cell
+        self.n_hidden_states = n_hidden_states
+        self.bias = bias
+        self.output_size = output_size if output_size is not None else \
+            hidden_size
+
+    def create_params(self, key):
+        ks = jax.random.split(key, 6)
+        gh = self.gate_multiplier * self.hidden_size
+        bound = 1.0 / math.sqrt(self.hidden_size)
+        u = lambda k, shape: jax.random.uniform(
+            k, shape, jnp.float32, -bound, bound)
+        p = {"w_ih": u(ks[0], (gh, self.input_size)),
+             "w_hh": u(ks[1], (gh, self.output_size))}
+        if self.bias:
+            p["b_ih"] = u(ks[2], (gh,))
+            p["b_hh"] = u(ks[3], (gh,))
+        if self.cell == "mLSTM":
+            p["w_mx"] = u(ks[4], (self.output_size, self.input_size))
+            p["w_mh"] = u(ks[5], (self.output_size, self.output_size))
+        return p
+
+    def init_hidden(self, batch: int, dtype=jnp.float32):
+        shape = (batch, self.output_size)
+        return tuple(jnp.zeros(shape, dtype)
+                     for _ in range(self.n_hidden_states))
+
+    def forward(self, params, x, hidden=None):
+        """x: (T, B, F). Returns (out (T, B, H), final_hidden)."""
+        fn = CELLS[self.cell][0]
+        if hidden is None:
+            hidden = self.init_hidden(x.shape[1], x.dtype)
+
+        def step(h, xt):
+            new_h, out = fn(params, h, xt)
+            return new_h, out
+
+        final, outs = lax.scan(step, hidden, x)
+        return outs, final
+
+
+class stackedRNN(Module):
+    """Sequential layer stack with optional inter-layer dropout
+    (reference :122-195)."""
+
+    def __init__(self, inputRNN: RNNCell, num_layers: int = 1,
+                 dropout: float = 0.0):
+        super().__init__()
+        self.num_layers = num_layers
+        self.dropout = dropout
+        cells = [inputRNN]
+        for _ in range(num_layers - 1):
+            cells.append(RNNCell(inputRNN.gate_multiplier,
+                                 inputRNN.output_size, inputRNN.hidden_size,
+                                 inputRNN.cell, inputRNN.n_hidden_states,
+                                 inputRNN.bias, inputRNN.output_size))
+        self.rnns = ModuleList(cells)
+
+    def forward(self, params, x, hidden=None):
+        from ..nn.module import current_context
+        from ..nn import functional as F
+        ctx = current_context()
+        hiddens = []
+        for i, cell in enumerate(self.rnns):
+            h_in = hidden[i] if hidden is not None else None
+            x, h_out = cell(params["rnns"][str(i)], x, h_in)
+            hiddens.append(h_out)
+            if (self.dropout and i < self.num_layers - 1 and ctx is not None
+                    and ctx.train):
+                x = F.dropout(x, self.dropout, ctx.make_rng())
+        return x, hiddens
+
+
+class bidirectionalRNN(Module):
+    """Forward + reversed-scan layer with feature concat (reference :25-86)."""
+
+    def __init__(self, inputRNN: RNNCell, num_layers: int = 1,
+                 dropout: float = 0.0):
+        super().__init__()
+        self.fwd = stackedRNN(inputRNN, num_layers, dropout)
+        bwd_proto = RNNCell(inputRNN.gate_multiplier, inputRNN.input_size,
+                            inputRNN.hidden_size, inputRNN.cell,
+                            inputRNN.n_hidden_states, inputRNN.bias,
+                            inputRNN.output_size)
+        self.bwd = stackedRNN(bwd_proto, num_layers, dropout)
+
+    def forward(self, params, x, hidden=None):
+        fwd_out, fwd_h = self.fwd(params["fwd"], x,
+                                  hidden[0] if hidden else None)
+        rev = jnp.flip(x, axis=0)
+        bwd_out, bwd_h = self.bwd(params["bwd"], rev,
+                                  hidden[1] if hidden else None)
+        out = jnp.concatenate([fwd_out, jnp.flip(bwd_out, axis=0)], axis=-1)
+        return out, (fwd_h, bwd_h)

@@ -1,0 +1,12 @@
+"""apex_tpu.RNN — recurrent stack (reference: apex/RNN).
+
+The reference is a pure-Python unrolled loop over time steps
+(RNNBackend.py:122-195) built on deprecated torch internals.  The TPU-native
+form is ``lax.scan`` over the time axis — one compiled loop body, weights
+resident in VMEM across steps — with the same factory surface
+(apex/RNN/models.py:19-52): LSTM, GRU, ReLU, Tanh, mLSTM.
+"""
+
+from .models import LSTM, GRU, ReLU, Tanh, mLSTM
+from .RNNBackend import RNNCell, stackedRNN, bidirectionalRNN
+from . import cells

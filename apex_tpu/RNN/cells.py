@@ -1,0 +1,80 @@
+"""Cell step functions (reference: apex/RNN/cells.py).
+
+Each cell is a pure function ``cell(params, hidden, x) -> (new_hidden, out)``
+operating on one time step; gate matmuls route through the policy-aware
+F.linear so amp O1 casts them like the reference's RNN interception
+(apex/amp/wrap.py:226-335).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+
+
+def lstm_cell(params, hidden, x):
+    """Standard LSTM; gate order (i, f, g, o) like torch."""
+    h, c = hidden
+    gates = F.linear(x, params["w_ih"], params.get("b_ih")) + \
+        F.linear(h, params["w_hh"], params.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c32 = f * c.astype(g.dtype) + i * g
+    h_new = o * jnp.tanh(c32)
+    return (h_new, c32.astype(c.dtype)), h_new
+
+
+def gru_cell(params, hidden, x):
+    (h,) = hidden
+    gi = F.linear(x, params["w_ih"], params.get("b_ih"))
+    gh = F.linear(h, params["w_hh"], params.get("b_hh"))
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    h_new = (1 - z) * n + z * h
+    return (h_new,), h_new
+
+
+def relu_cell(params, hidden, x):
+    (h,) = hidden
+    h_new = F.relu(F.linear(x, params["w_ih"], params.get("b_ih")) +
+                   F.linear(h, params["w_hh"], params.get("b_hh")))
+    return (h_new,), h_new
+
+
+def tanh_cell(params, hidden, x):
+    (h,) = hidden
+    h_new = jnp.tanh(F.linear(x, params["w_ih"], params.get("b_ih")) +
+                     F.linear(h, params["w_hh"], params.get("b_hh")))
+    return (h_new,), h_new
+
+
+def mlstm_cell(params, hidden, x):
+    """Multiplicative LSTM (Krause et al.; reference cells.py:55-83):
+    m = (W_mx x) * (W_mh h), then LSTM gates driven by (x, m)."""
+    h, c = hidden
+    m = F.linear(x, params["w_mx"]) * F.linear(h, params["w_mh"])
+    gates = F.linear(x, params["w_ih"], params.get("b_ih")) + \
+        F.linear(m, params["w_hh"], params.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c.astype(g.dtype) + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new.astype(c.dtype)), h_new
+
+
+CELLS = {
+    "LSTM": (lstm_cell, 4, 2),      # (fn, gate_multiplier, n_hidden_states)
+    "GRU": (gru_cell, 3, 1),
+    "ReLU": (relu_cell, 1, 1),
+    "Tanh": (tanh_cell, 1, 1),
+    "mLSTM": (mlstm_cell, 4, 2),
+}

@@ -1,0 +1,57 @@
+"""RNN factories (reference: apex/RNN/models.py:19-52)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .cells import CELLS
+from .RNNBackend import RNNCell, bidirectionalRNN, stackedRNN
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]
+
+
+def _toRNNBackend(cell: str, input_size: int, hidden_size: int,
+                  num_layers: int = 1, bias: bool = True,
+                  batch_first: bool = False, dropout: float = 0.0,
+                  bidirectional: bool = False,
+                  output_size: Optional[int] = None):
+    if batch_first:
+        raise NotImplementedError(
+            "batch_first is not supported (reference models.py:10-16); "
+            "inputs are seq-major (T, B, F)")
+    fn, gate_multiplier, n_states = CELLS[cell]
+    proto = RNNCell(gate_multiplier, input_size, hidden_size, cell,
+                    n_states, bias, output_size)
+    if bidirectional:
+        return bidirectionalRNN(proto, num_layers, dropout)
+    return stackedRNN(proto, num_layers, dropout)
+
+
+def LSTM(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    return _toRNNBackend("LSTM", input_size, hidden_size, num_layers, bias,
+                         batch_first, dropout, bidirectional, output_size)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, output_size=None):
+    return _toRNNBackend("GRU", input_size, hidden_size, num_layers, bias,
+                         batch_first, dropout, bidirectional, output_size)
+
+
+def ReLU(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    return _toRNNBackend("ReLU", input_size, hidden_size, num_layers, bias,
+                         batch_first, dropout, bidirectional, output_size)
+
+
+def Tanh(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None):
+    return _toRNNBackend("Tanh", input_size, hidden_size, num_layers, bias,
+                         batch_first, dropout, bidirectional, output_size)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, output_size=None):
+    return _toRNNBackend("mLSTM", input_size, hidden_size, num_layers, bias,
+                         batch_first, dropout, bidirectional, output_size)

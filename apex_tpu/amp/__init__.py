@@ -1,0 +1,45 @@
+"""apex_tpu.amp — automatic mixed precision for JAX on TPU.
+
+Public surface mirrors apex.amp (reference apex/amp/__init__.py):
+
+    model, optimizer = amp.initialize(model, optimizer, opt_level="O2")
+    loss, scaled_grads = amp.scaled_grad(loss_fn, params, opt_state)
+    params, opt_state, info = optimizer.step(params, opt_state, scaled_grads)
+
+plus the eager API-parity path (amp.scale_loss / backward / step) and the
+O1 registries (register_half_function etc.).
+"""
+
+from .frontend import initialize, Properties, opt_levels, O0, O1, O2, O3
+from .handle import scale_loss, scaled_grad, disable_casts
+from .scaler import LossScaler, ScalerState
+from ._process_optimizer import AmpOptimizer, AmpOptState
+from ._initialize import AmpModel, cast_param_tree
+from ._amp_state import master_params, maybe_print
+from .policy import (CastPolicy, NoPolicy, current_policy, set_policy,
+                     use_policy, half_function, float_function,
+                     promote_function)
+from .lists import (register_half_function, register_float_function,
+                    register_promote_function)
+from . import stateful
+from . import lists
+from . import policy
+
+
+def state_dict(bound_or_opt_state) -> dict:
+    """Checkpoint the amp state (loss scalers) — the amp.state_dict the
+    reference lacked in this snapshot (SURVEY.md §5 checkpoint gap)."""
+    from .stateful import BoundOptimizer
+    if isinstance(bound_or_opt_state, BoundOptimizer):
+        opt_state = bound_or_opt_state.opt_state
+    else:
+        opt_state = bound_or_opt_state
+    return {"scalers": [s._asdict() for s in opt_state.scalers]}
+
+
+def load_state_dict(opt_state, sd: dict):
+    import jax.numpy as jnp
+    from .scaler import ScalerState
+    scalers = tuple(ScalerState(**{k: jnp.asarray(v) for k, v in d.items()})
+                    for d in sd["scalers"])
+    return opt_state._replace(scalers=scalers)

@@ -1,0 +1,51 @@
+"""Cross-module amp singleton + rank-aware printing.
+
+Mirrors apex/amp/_amp_state.py:17-52: a module-level state object holding
+the active opt properties and verbosity, and ``maybe_print`` that only
+prints on rank 0 (here: ``jax.process_index() == 0``) unless
+``allow_incoherent_verbosity`` is set.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoherent_verbosity = False
+        self.verbosity = 1
+        self.opt_properties = None
+        self.handle = None
+
+
+_amp_state = AmpState()
+
+
+def master_params(optimizer):
+    """Generator over the fp32 master params of an amp-wrapped optimizer
+    (reference: _amp_state.py:61-70). Accepts the stateful AmpOptimizer."""
+    masters = getattr(optimizer, "masters", None)
+    if masters is None:
+        raise AttributeError(
+            "master_params requires an optimizer returned by amp.initialize")
+    yield from jax.tree_util.tree_leaves(masters)
+
+
+def maybe_print(msg: str, rank0_only: bool = True) -> None:
+    if _amp_state.verbosity > 0:
+        try:
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+        if (not rank0_only) or _amp_state.allow_incoherent_verbosity or rank == 0:
+            print(msg)
+
+
+def warn_or_err(msg: str) -> None:
+    if _amp_state.hard_override:
+        maybe_print("Warning: " + msg)
+    else:
+        raise RuntimeError(msg + "\nIf you're sure you know what you're "
+                           "doing, supply hard_override=True to amp.initialize.")

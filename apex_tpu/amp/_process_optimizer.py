@@ -1,0 +1,140 @@
+"""AmpOptimizer: master weights, unscale, overflow-skip — functionally.
+
+The reference performs in-place surgery on torch optimizers
+(apex/amp/_process_optimizer.py): clones fp16 params to fp32 masters and
+swaps them into param_groups (:13-73), patches ``step`` to copy masters
+back to the model (:286-296), and installs pre/post-backward hooks that the
+``scale_loss`` context drives (:76-239).  Here the same observable behavior
+is a pure wrapper: masters are optimizer *state*, unscale+overflow-check is
+the fused multi_tensor_scale, and a skipped step is a ``lax.cond`` that
+leaves (params, masters, inner state) untouched — the whole thing lives
+inside jit with no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .scaler import LossScaler, ScalerState
+from ..optimizers.base import Optimizer
+
+__all__ = ["AmpOptState", "AmpOptimizer"]
+
+
+class AmpOptState(NamedTuple):
+    inner: Any                     # wrapped optimizer's state
+    masters: Any                   # fp32 master pytree, or None
+    scalers: Tuple[ScalerState, ...]  # one per loss (num_losses)
+
+
+def _to_fp32(tree):
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if jnp.issubdtype(
+            jnp.result_type(p), jnp.floating) else p, tree)
+
+
+def _cast_like(tree, like):
+    return jax.tree_util.tree_map(
+        lambda x, l: x.astype(l.dtype) if jnp.issubdtype(
+            jnp.result_type(l), jnp.floating) else x, tree, like)
+
+
+class AmpOptimizer(Optimizer):
+    """Wraps a base Optimizer with loss scaling and optional fp32 masters."""
+
+    def __init__(self, inner: Optimizer, scaler: LossScaler,
+                 master_weights: bool, num_losses: int = 1):
+        self.inner = inner
+        self.scaler = scaler
+        self.master_weights = bool(master_weights)
+        self.num_losses = int(num_losses)
+        # eager/stateful-mode fields (see amp/stateful.py)
+        self._bound = None
+
+    # -- functional API ----------------------------------------------------
+    def init(self, params: Any) -> AmpOptState:
+        masters = _to_fp32(params) if self.master_weights else None
+        inner_state = self.inner.init(masters if masters is not None else params)
+        scalers = tuple(self.scaler.init_state()
+                        for _ in range(self.num_losses))
+        return AmpOptState(inner=inner_state, masters=masters,
+                           scalers=scalers)
+
+    def loss_scale(self, opt_state: AmpOptState, loss_id: int = 0):
+        return opt_state.scalers[loss_id].loss_scale
+
+    def step(self, params: Any = None, opt_state: AmpOptState = None,
+             scaled_grads: Any = None, loss_id: int = 0,
+             found_inf_extra: Optional[jax.Array] = None
+             ) -> Tuple[Any, AmpOptState, dict]:
+        """Unscale grads, update the scaler, apply-or-skip the inner update.
+
+        ``scaled_grads`` are gradients of ``loss * loss_scale`` w.r.t. the
+        *model* params.  ``found_inf_extra`` lets callers merge additional
+        overflow sources (e.g. a pre-computed grad norm).
+        Returns (new_params, new_opt_state, info).
+
+        Called with no arguments in eager mode (after amp.stateful.bind +
+        scale_loss/backward), it steps the bound state like torch's
+        ``optimizer.step()``.
+        """
+        if params is None:
+            if self._bound is None:
+                raise RuntimeError("step() without arguments requires a "
+                                   "bound optimizer (amp.stateful.bind)")
+            return self._bound.step()
+        sstate = opt_state.scalers[loss_id]
+        grads32, found_inf = self.scaler.unscale(scaled_grads, sstate)
+        if found_inf_extra is not None:
+            found_inf = jnp.maximum(found_inf, found_inf_extra)
+        new_sstate = self.scaler.update(sstate, found_inf)
+        scalers = tuple(new_sstate if i == loss_id else s
+                        for i, s in enumerate(opt_state.scalers))
+
+        if opt_state.masters is not None:
+            def do_update(operand):
+                p, masters, inner = operand
+                new_masters, new_inner = self.inner.update(
+                    grads32, inner, masters)
+                # master -> model copy (the reference's
+                # _master_params_to_model_params, _process_optimizer.py:242-253)
+                new_p = _cast_like(new_masters, p)
+                return new_p, new_masters, new_inner
+        else:
+            def do_update(operand):
+                p, masters, inner = operand
+                new_p, new_inner = self.inner.update(
+                    _cast_like(grads32, p), inner, p)
+                return new_p, masters, new_inner
+
+        def skip_update(operand):
+            return operand
+
+        new_params, new_masters, new_inner = jax.lax.cond(
+            found_inf > 0, skip_update, do_update,
+            (params, opt_state.masters, opt_state.inner))
+
+        info = {"found_inf": found_inf,
+                "loss_scale": new_sstate.loss_scale,
+                "steps_skipped": new_sstate.steps_skipped}
+        return new_params, AmpOptState(inner=new_inner, masters=new_masters,
+                                       scalers=scalers), info
+
+    # -- checkpoint (the amp.state_dict gap called out in SURVEY §5) -------
+    def state_dict(self, opt_state: AmpOptState) -> dict:
+        return {"scalers": [s._asdict() for s in opt_state.scalers]}
+
+    def load_state_dict(self, opt_state: AmpOptState, sd: dict) -> AmpOptState:
+        scalers = tuple(ScalerState(**{k: jnp.asarray(v) for k, v in d.items()})
+                        for d in sd["scalers"])
+        return opt_state._replace(scalers=scalers)
+
+    # -- stateful-mode conveniences (amp/stateful.py fills these in) -------
+    @property
+    def masters(self):
+        if self._bound is None:
+            return None
+        return self._bound.opt_state.masters

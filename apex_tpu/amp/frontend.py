@@ -1,0 +1,275 @@
+"""amp frontend: the opt-level system and ``amp.initialize``.
+
+Faithful to the reference's shape (apex/amp/frontend.py): a ``Properties``
+option struct with per-key validation in ``__setattr__`` (:50-96), O0-O3
+preset objects (:101-190), an ``opt_levels`` registry (:187-190), and an
+``initialize()`` that applies the preset then user overrides (:194-357).
+
+TPU extension: ``half_dtype`` selects bfloat16 (TPU-native; default) or
+float16 (bitwise parity with the reference's semantics, incl. dynamic loss
+scaling).  Under bfloat16 the presets default loss_scale to 1.0 because
+bf16 shares fp32's exponent range and cannot overflow where fp16 does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ._amp_state import _amp_state, maybe_print, warn_or_err
+
+__all__ = ["Properties", "O0", "O1", "O2", "O3", "opt_levels", "initialize"]
+
+_HALF_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+                "fp16": jnp.float16, "bf16": jnp.bfloat16}
+
+
+class Properties:
+    """Options struct with validation; mirrors frontend.py:6-96."""
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_torch_functions": False,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+            "cast_model_outputs": None,
+            "num_losses": 1,
+            "verbosity": 1,
+            "min_loss_scale": None,
+            "max_loss_scale": 2. ** 24,
+            "half_dtype": "bfloat16",
+        }
+
+    def _update_options_dict(self, new_options: dict):
+        for k, v in new_options.items():
+            if k in self.options:
+                setattr(self, k, v)
+            else:
+                raise ValueError(f"Tried to set unexpected option {k}")
+
+    def __getattr__(self, name: str):
+        if "options" in self.__dict__ and name in self.options:
+            return self.options[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any):
+        if "options" not in self.__dict__:
+            super().__setattr__(name, value)
+            return
+        if name not in self.options:
+            super().__setattr__(name, value)
+            return
+        # string forms accepted for argparse interop (frontend.py:74-92)
+        if name == "cast_model_type":
+            if self.opt_level == "O1" and value is not None:
+                if value is not False and value != jnp.float32:
+                    warn_or_err("O1 inserts casts around ops, so the model "
+                                "should not be cast. cast_model_type was "
+                                f"{value}")
+            self.options[name] = _coerce_dtype(value)
+        elif name == "cast_model_outputs":
+            self.options[name] = _coerce_dtype(value)
+        elif name in ("patch_torch_functions", "keep_batchnorm_fp32",
+                      "master_weights"):
+            self.options[name] = _coerce_bool(name, value)
+        elif name == "loss_scale":
+            if value == "dynamic":
+                self.options[name] = "dynamic"
+            elif value is None:
+                self.options[name] = None
+            else:
+                self.options[name] = float(value)
+        elif name == "half_dtype":
+            if isinstance(value, str):
+                if value not in _HALF_DTYPES:
+                    raise ValueError(f"half_dtype must be one of "
+                                     f"{sorted(_HALF_DTYPES)}, got {value}")
+                self.options[name] = "float16" if _HALF_DTYPES[value] == \
+                    jnp.float16 else "bfloat16"
+            else:
+                dt = jnp.dtype(value)
+                if dt not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
+                    raise ValueError(f"half_dtype must be fp16/bf16, got {dt}")
+                self.options[name] = dt.name
+        else:
+            self.options[name] = value
+
+    @property
+    def half_jnp_dtype(self):
+        return _HALF_DTYPES[self.options["half_dtype"]]
+
+    def __repr__(self):
+        return "\n".join(f"{k:24}: {v}" for k, v in self.options.items())
+
+
+def _coerce_dtype(value):
+    if value is None or value is False:
+        return None if value is None else False
+    if isinstance(value, str):
+        table = {"torch.float16": jnp.float16, "torch.float32": jnp.float32,
+                 "float16": jnp.float16, "float32": jnp.float32,
+                 "bfloat16": jnp.bfloat16, "fp16": jnp.float16,
+                 "fp32": jnp.float32, "bf16": jnp.bfloat16, "half": "half"}
+        if value in table:
+            return table[value]
+        raise ValueError(f"Unknown dtype string {value!r}")
+    return jnp.dtype(value).type if value is not None else None
+
+
+def _coerce_bool(name, value):
+    if isinstance(value, str):
+        if value == "True":
+            return True
+        if value == "False":
+            return False
+        raise ValueError(f"{name} must be True/False/None, got {value!r}")
+    return value
+
+
+class OptLevel:
+    brief = ""
+    more = ""
+
+    def __call__(self, properties: Properties) -> Properties:
+        raise NotImplementedError
+
+
+class O3(OptLevel):
+    """Pure half precision — 'speed of light' ceiling (frontend.py:101-116)."""
+    brief = "O3: Pure half precision (the 'speed of light' ceiling)."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = "half"
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2(OptLevel):
+    """Half model + fp32 masters + fp32 batchnorm (frontend.py:118-143)."""
+    brief = "O2: half-precision model with fp32 master weights and batchnorm."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = "half"
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        # bf16 can't overflow where fp16 does; dynamic scaling only for fp16
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1(OptLevel):
+    """Op-classification cast insertion (frontend.py:145-163)."""
+    brief = "O1: insert casts at op boundaries per whitelist/blacklist."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0(OptLevel):
+    """Pure fp32 baseline (frontend.py:165-185)."""
+    brief = "O0: pure fp32 (accuracy baseline)."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+def initialize(model, optimizers=None, enabled: bool = True,
+               opt_level: str = "O1", cast_model_type=None,
+               patch_torch_functions=None, keep_batchnorm_fp32=None,
+               master_weights=None, loss_scale=None,
+               cast_model_outputs=None, num_losses: int = 1,
+               verbosity: int = 1, min_loss_scale=None,
+               max_loss_scale=2. ** 24, half_dtype=None,
+               hard_override: bool = False):
+    """3-line amp enablement — same shape as apex (frontend.py:194-357).
+
+    ``model`` is an apex_tpu.nn.Module (or an (init, apply) pair wrapped in
+    one); ``optimizers`` an apex_tpu Optimizer or list of them.  Returns
+    ``(AmpModel, AmpOptimizer)`` (lists preserved as given).
+    """
+    from ._initialize import _initialize
+
+    _amp_state.hard_override = hard_override
+    _amp_state.verbosity = verbosity
+
+    if not enabled:
+        from ._initialize import AmpModel, AmpOptimizer
+        props = Properties()
+        props.options["half_dtype"] = "bfloat16" if half_dtype is None else half_dtype
+        return _initialize(model, optimizers, props, disabled=True)
+
+    if opt_level not in opt_levels:
+        raise RuntimeError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', "
+            "'O1', 'O2', 'O3'. Note that in `O0`, `O1`, etc., the prefix O "
+            "is the letter O, not the number zero.")
+
+    props = Properties()
+    if half_dtype is not None:
+        props.half_dtype = half_dtype
+    props = opt_levels[opt_level](props)
+    maybe_print(f"Selected optimization level {opt_level}: "
+                f"{opt_levels[opt_level].brief}", True)
+    maybe_print("Defaults for this optimization level are:", True)
+    for k, v in props.options.items():
+        maybe_print(f"{k:24}: {v}", True)
+
+    overrides = dict(cast_model_type=cast_model_type,
+                     patch_torch_functions=patch_torch_functions,
+                     keep_batchnorm_fp32=keep_batchnorm_fp32,
+                     master_weights=master_weights, loss_scale=loss_scale,
+                     cast_model_outputs=cast_model_outputs,
+                     num_losses=num_losses, min_loss_scale=min_loss_scale,
+                     max_loss_scale=max_loss_scale)
+    maybe_print("Processing user overrides (additional kwargs that are not "
+                "None)...", True)
+    for k, v in overrides.items():
+        if v is not None:
+            setattr(props, k, v)
+    # resolve 'half' placeholder to the configured half dtype
+    if props.options["cast_model_type"] == "half":
+        props.options["cast_model_type"] = props.half_jnp_dtype
+    if props.options["cast_model_outputs"] == "half":
+        props.options["cast_model_outputs"] = props.half_jnp_dtype
+    # bf16 never needs dynamic scaling unless the user insists: it shares
+    # fp32's exponent range, so the overflow the scaler guards against
+    # cannot occur.  Applies to any preset that defaulted to "dynamic".
+    if (loss_scale is None and props.options["loss_scale"] == "dynamic"
+            and props.half_jnp_dtype == jnp.bfloat16):
+        props.options["loss_scale"] = 1.0
+    maybe_print("After processing overrides, optimization options are:", True)
+    for k, v in props.options.items():
+        maybe_print(f"{k:24}: {v}", True)
+
+    _amp_state.opt_properties = props
+    return _initialize(model, optimizers, props)

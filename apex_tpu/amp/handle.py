@@ -1,0 +1,117 @@
+"""scale_loss and gradient helpers.
+
+The reference's ``with amp.scale_loss(loss, optimizer)`` (apex/amp/
+handle.py:15-157) scales the loss on entry, and on exit unscales grads,
+checks overflow, and patches ``optimizer.step`` into a one-shot skip.
+JAX has no autograd tape, so apex_tpu offers the same protocol in two
+forms:
+
+1. **Functional (the jit/performance path)** — :func:`scaled_grad` computes
+   grads of ``loss * loss_scale``; ``AmpOptimizer.step`` unscales, updates
+   the scale, and `lax.cond`-skips — all device-resident.
+
+2. **Eager (API-parity path)** — ``with amp.scale_loss(loss_fn, optimizer)
+   as scaled_loss: scaled_loss.backward()`` against a *bound* stateful
+   optimizer (see amp.stateful.bind), matching the reference's call shape
+   for scripts and tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import policy as _policy
+from ._amp_state import _amp_state, maybe_print
+from ._process_optimizer import AmpOptimizer, AmpOptState
+
+__all__ = ["scale_loss", "scaled_grad", "disable_casts"]
+
+disable_casts = _policy.disable_casts
+
+
+def scaled_grad(loss_fn: Callable, params: Any, opt_state: AmpOptState,
+                *args, loss_id: int = 0, has_aux: bool = False, **kwargs):
+    """value_and_grad of ``loss * loss_scale``.
+
+    Returns ``(loss, scaled_grads)`` or ``(loss, aux, scaled_grads)``; pass
+    ``scaled_grads`` straight to ``AmpOptimizer.step`` which unscales them.
+    The *unscaled* loss is returned for logging, like the reference yields
+    the scaled loss only for backward (handle.py:117).
+    """
+    scale = opt_state.scalers[loss_id].loss_scale
+
+    def scaled_fn(p):
+        res = loss_fn(p, *args, **kwargs)
+        if has_aux:
+            loss, aux = res
+            return loss.astype(jnp.float32) * scale, aux
+        return res.astype(jnp.float32) * scale
+
+    if has_aux:
+        (scaled_loss, aux), grads = jax.value_and_grad(
+            scaled_fn, has_aux=True)(params)
+        return scaled_loss / scale, aux, grads
+    scaled_loss, grads = jax.value_and_grad(scaled_fn)(params)
+    return scaled_loss / scale, grads
+
+
+class _ScaledLoss:
+    """What the eager ``scale_loss`` yields: float()-able, backward()-able."""
+
+    def __init__(self, bound, loss_fn: Callable, loss_id: int):
+        self._bound = bound
+        self._loss_fn = loss_fn
+        self._loss_id = loss_id
+        self.value: Optional[jax.Array] = None
+
+    def backward(self) -> None:
+        self._bound._backward(self._loss_fn, self._loss_id)
+
+    def __float__(self) -> float:
+        if self.value is None:
+            self.value = self._bound._eval_scaled_loss(
+                self._loss_fn, self._loss_id)
+        return float(self.value)
+
+    def item(self) -> float:
+        return float(self)
+
+
+@contextlib.contextmanager
+def scale_loss(loss: Any, optimizer: AmpOptimizer, loss_id: int = 0,
+               model=None, delay_unscale: bool = False,
+               delay_overflow_check: bool = False):
+    """Eager-mode context manager with the reference's shape
+    (handle.py:15-157).
+
+    ``loss`` is a callable ``loss_fn(params) -> scalar`` (JAX is tape-free,
+    so the loss must be re-expressible as a function of params); the
+    optimizer must have been bound to params via
+    ``amp.stateful.bind(optimizer, params)`` or be the optimizer half of a
+    bound pair.  On exit, gradients stashed by ``scaled_loss.backward()``
+    are unscaled, the scale is updated, and an overflowed step will be
+    skipped by the next ``optimizer.step()`` — announcing the scale change
+    like the reference (handle.py:142-144).
+    """
+    if isinstance(optimizer, (list, tuple)):
+        raise NotImplementedError(
+            "pass a single optimizer per scale_loss context")
+    bound = optimizer._bound
+    if bound is None:
+        raise RuntimeError(
+            "Eager scale_loss needs a bound optimizer: call "
+            "apex_tpu.amp.stateful.bind(optimizer, params) first, or use "
+            "the functional path (amp.scaled_grad + optimizer.step).")
+    if not callable(loss):
+        raise TypeError(
+            "In apex_tpu, amp.scale_loss takes a callable loss_fn(params) "
+            "(JAX has no autograd tape to replay a computed loss).")
+    sl = _ScaledLoss(bound, loss, loss_id)
+    yield sl
+    bound._post_backward(loss_id,
+                         delay_unscale=delay_unscale,
+                         delay_overflow_check=delay_overflow_check)

@@ -1,0 +1,205 @@
+"""The dtype-cast policy consulted by apex_tpu.nn ops.
+
+The reference implements O1 by monkey-patching ~200 torch entry points with
+cast wrappers (apex/amp/amp.py:68-177, wrap.py:73-216).  JAX functions are
+pure and cannot be patched per-handle, so the policy lives in a context the
+framework's own functional ops consult at trace time.  Under ``jax.jit``
+the casts are traced once and fused by XLA; the reference's casted-weight
+cache (apex/amp/utils.py:87-119) is unnecessary because XLA CSEs repeated
+casts of the same array.
+
+Policies:
+
+- ``NoPolicy``      — O0/O2/O3: ops execute in their inputs' dtypes (for
+  O2/O3 the *parameters* were cast instead, see _initialize.py).
+- ``CastPolicy``    — O1: whitelist ops cast args to the half dtype,
+  blacklist ops to fp32, promote ops to the widest floating dtype of their
+  args; banned ops raise with the reference's actionable message
+  (functional_overrides.py:68-78).
+
+``disable_casts()`` reproduces apex's escape hatch
+(apex/amp/handle.py:162-166).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import lists
+
+__all__ = [
+    "Policy", "NoPolicy", "CastPolicy", "current_policy", "set_policy",
+    "use_policy", "disable_casts", "cast_op_args", "half_function",
+    "float_function", "promote_function",
+]
+
+_FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def _is_float_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        jnp.result_type(x), jnp.floating)
+
+
+def _cast_leaf(x: Any, dtype) -> Any:
+    if _is_float_array(x) and jnp.result_type(x) != dtype:
+        return x.astype(dtype)
+    return x
+
+
+def _cast_tree(tree: Any, dtype) -> Any:
+    return jax.tree_util.tree_map(lambda x: _cast_leaf(x, dtype), tree)
+
+
+def _widest_dtype(args: Sequence[Any]):
+    widest = None
+    order = {jnp.dtype(jnp.float16): 1, jnp.dtype(jnp.bfloat16): 1,
+             jnp.dtype(jnp.float32): 2, jnp.dtype(jnp.float64): 3}
+
+    def visit(x):
+        nonlocal widest
+        if _is_float_array(x):
+            d = jnp.result_type(x)
+            if widest is None or order.get(jnp.dtype(d), 0) > order.get(
+                    jnp.dtype(widest), 0):
+                widest = d
+    jax.tree_util.tree_map(visit, list(args))
+    return widest
+
+
+class Policy:
+    """Base: identity policy (no casting)."""
+
+    enabled = False
+
+    def cast_args(self, op_name: str, args: tuple, kwargs: dict):
+        return args, kwargs
+
+
+class NoPolicy(Policy):
+    pass
+
+
+class CastPolicy(Policy):
+    """O1 whitelist/blacklist/promote casting, driven by amp.lists tables."""
+
+    enabled = True
+
+    def __init__(self, half_dtype=jnp.bfloat16, verbose: bool = False):
+        self.half_dtype = jnp.dtype(half_dtype)
+        self.verbose = verbose
+
+    def _log(self, op_name: str, action: str) -> None:
+        if self.verbose:
+            from ._amp_state import maybe_print
+            maybe_print(f"amp: {action} args of {op_name}")
+
+    def cast_args(self, op_name: str, args: tuple, kwargs: dict):
+        kind = lists.classify(op_name)
+        if kind == "banned":
+            raise NotImplementedError(lists.BANNED_MSG)
+        if kind == "half":
+            self._log(op_name, f"casting to {self.half_dtype.name}")
+            return _cast_tree(args, self.half_dtype), _cast_tree(
+                kwargs, self.half_dtype)
+        if kind == "float":
+            self._log(op_name, "casting to float32")
+            return _cast_tree(args, jnp.float32), _cast_tree(
+                kwargs, jnp.float32)
+        if kind in ("promote", "sequence"):
+            widest = _widest_dtype(list(args) + list(kwargs.values()))
+            if widest is not None:
+                self._log(op_name, f"promoting to {jnp.dtype(widest).name}")
+                return _cast_tree(args, widest), _cast_tree(kwargs, widest)
+        return args, kwargs
+
+
+class _PolicyState(threading.local):
+    def __init__(self):
+        self.stack = [NoPolicy()]
+        self.casts_disabled = 0
+
+
+_STATE = _PolicyState()
+
+
+def current_policy() -> Policy:
+    if _STATE.casts_disabled:
+        return _NO_POLICY
+    return _STATE.stack[-1]
+
+
+_NO_POLICY = NoPolicy()
+
+
+def set_policy(policy: Policy) -> None:
+    """Install ``policy`` as the process-wide default (what amp.initialize
+    does for O1 — mirrors the global effect of apex's monkey-patching)."""
+    _STATE.stack[0] = policy
+
+
+@contextlib.contextmanager
+def use_policy(policy: Policy):
+    _STATE.stack.append(policy)
+    try:
+        yield policy
+    finally:
+        _STATE.stack.pop()
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Temporarily run ops in their incoming dtypes (handle.py:162-166)."""
+    _STATE.casts_disabled += 1
+    try:
+        yield
+    finally:
+        _STATE.casts_disabled -= 1
+
+
+def cast_op_args(op_name: str, args: tuple, kwargs: dict):
+    """Entry point used by apex_tpu.nn.functional at every op dispatch."""
+    return current_policy().cast_args(op_name, args, kwargs)
+
+
+def _wrap_with(cast: Callable, fn: Callable) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if not pol.enabled:
+            return fn(*args, **kwargs)
+        args, kwargs = cast(pol, args, kwargs)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def half_function(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` with float args cast to the policy half dtype
+    (reference: apex/amp/amp.py:30-33)."""
+    return _wrap_with(
+        lambda p, a, k: (_cast_tree(a, p.half_dtype), _cast_tree(k, p.half_dtype)),
+        fn)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` with float args cast to fp32 (amp.py:35-38)."""
+    return _wrap_with(
+        lambda p, a, k: (_cast_tree(a, jnp.float32), _cast_tree(k, jnp.float32)),
+        fn)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` with float args promoted to the widest incoming
+    float dtype (amp.py:40-42)."""
+    def cast(_p, a, k):
+        widest = _widest_dtype(list(a) + list(k.values()))
+        if widest is None:
+            return a, k
+        return _cast_tree(a, widest), _cast_tree(k, widest)
+    return _wrap_with(cast, fn)

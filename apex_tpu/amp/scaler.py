@@ -1,0 +1,105 @@
+"""Loss scaling: static and dynamic, as functional device-resident state.
+
+State machine semantics are copied exactly from the reference
+(apex/amp/scaler.py:39-72,190-210): dynamic scale starts at 2**16, halves on
+overflow (clamped to ``min_loss_scale``), doubles after ``scale_window=2000``
+consecutive clean steps (clamped to ``max_loss_scale``), and an overflowed
+step is skipped.  The differences are deliberate TPU-isms:
+
+- ``found_inf`` is a device fp32 scalar produced by the fused
+  scale+finite-check (multi_tensor_scale), and ``update()`` is pure jnp, so
+  the whole scaler lives inside jit with **zero host syncs per step** — the
+  reference forces one D2H per iteration (scaler.py:192-193).
+- Skipping a step is a ``lax.cond`` in the optimizer wrapper rather than a
+  monkey-patched ``optimizer.step`` (reference handle.py:137-152).
+
+With bfloat16 (the TPU-native half type) overflow is essentially impossible
+(8 exponent bits, like fp32), so O2's default loss scale under bf16 is 1.0
+static; fp16 keeps "dynamic" for behavioral parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor_apply import (multi_tensor_scale, multi_tensor_axpby)
+
+__all__ = ["ScalerState", "LossScaler"]
+
+
+class ScalerState(NamedTuple):
+    loss_scale: jax.Array    # fp32 scalar
+    unskipped: jax.Array     # int32 clean-step counter
+    steps_skipped: jax.Array  # int32 total skipped (observability)
+
+
+class LossScaler:
+    """Configuration + pure transition functions over ScalerState."""
+
+    def __init__(self, loss_scale: Any = "dynamic",
+                 init_scale: float = 2.0 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 2000, min_loss_scale: float = None,
+                 max_loss_scale: float = 2.0 ** 24):
+        if loss_scale == "dynamic":
+            self.dynamic = True
+            self._init_scale = init_scale
+        else:
+            self.dynamic = False
+            self._init_scale = float(loss_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+
+    # -- state ------------------------------------------------------------
+    def init_state(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            unskipped=jnp.zeros((), jnp.int32),
+            steps_skipped=jnp.zeros((), jnp.int32))
+
+    def loss_scale(self, state: ScalerState) -> jax.Array:
+        return state.loss_scale
+
+    # -- ops --------------------------------------------------------------
+    def scale_loss(self, loss: jax.Array, state: ScalerState) -> jax.Array:
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, scaled_grads: Any, state: ScalerState,
+                out_dtype=jnp.float32) -> Tuple[Any, jax.Array]:
+        """grads/scale with fused overflow check; out cast to ``out_dtype``
+        (the master-grad materialization of apex/amp/scaler.py:95-123)."""
+        cast = jax.tree_util.tree_map(
+            lambda g: g.astype(out_dtype), scaled_grads)
+        return multi_tensor_scale(cast, 1.0 / state.loss_scale)
+
+    def unscale_with_stashed(self, scaled_grads: Any, stashed: Any,
+                             state: ScalerState) -> Tuple[Any, jax.Array]:
+        """out = grads/scale + stashed — gradient accumulation across
+        backward passes (apex/amp/scaler.py:149-182, multi_tensor_axpby)."""
+        return multi_tensor_axpby(1.0 / state.loss_scale, 1.0,
+                                  scaled_grads, stashed, arg_to_check=0)
+
+    def update(self, state: ScalerState, found_inf: jax.Array) -> ScalerState:
+        """Pure transition matching apex/amp/scaler.py:190-210."""
+        if not self.dynamic:
+            return state._replace(
+                steps_skipped=state.steps_skipped + found_inf.astype(jnp.int32))
+        overflow = found_inf > 0
+        halved = state.loss_scale / self.scale_factor
+        if self.min_loss_scale is not None:
+            halved = jnp.maximum(halved, self.min_loss_scale)
+        unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        grow = unskipped >= self.scale_window
+        grown = jnp.minimum(state.loss_scale * self.scale_factor,
+                            self.max_loss_scale)
+        new_scale = jnp.where(overflow, halved,
+                              jnp.where(grow, grown, state.loss_scale))
+        unskipped = jnp.where(grow, 0, unskipped)
+        return ScalerState(
+            loss_scale=new_scale,
+            unskipped=unskipped.astype(jnp.int32),
+            steps_skipped=state.steps_skipped + overflow.astype(jnp.int32))

@@ -1,0 +1,121 @@
+"""Eager/stateful driver: torch-shaped training loops over the functional core.
+
+Binds (params, opt_state) to an AmpOptimizer so user code can look like the
+reference's examples (examples/imagenet/main_amp.py:335-351):
+
+    model, optimizer = amp.initialize(model, optimizer, opt_level="O2")
+    params, bn_state = model.init(key)
+    bound = amp.stateful.bind(optimizer, params)
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x)
+        return criterion(out, y)
+
+    with amp.scale_loss(loss_fn, optimizer) as scaled_loss:
+        scaled_loss.backward()
+    optimizer.step()        # == bound.step()
+
+Grad accumulation across multiple backward() calls within one step uses
+``unscale_with_stashed`` (axpby), matching apex/amp/scaler.py:149-182.
+This path is for scripts and parity tests; the jit'd functional path is the
+performance path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ._amp_state import maybe_print
+from ._process_optimizer import AmpOptimizer, AmpOptState
+
+__all__ = ["bind", "BoundOptimizer"]
+
+
+class BoundOptimizer:
+    def __init__(self, optimizer: AmpOptimizer, params: Any):
+        self.optimizer = optimizer
+        self.params = params
+        self.opt_state: AmpOptState = optimizer.init(params)
+        self._grads32: Optional[Any] = None      # unscaled accumulated grads
+        self._found_inf = jnp.zeros((), jnp.float32)
+        self._skip_next = False
+        self._last_scale = None
+
+    # -- driven by amp.scale_loss ------------------------------------------
+    def _eval_scaled_loss(self, loss_fn: Callable, loss_id: int):
+        scale = self.opt_state.scalers[loss_id].loss_scale
+        return loss_fn(self.params) * scale
+
+    def _backward(self, loss_fn: Callable, loss_id: int) -> None:
+        scaler = self.optimizer.scaler
+        sstate = self.opt_state.scalers[loss_id]
+        scale = sstate.loss_scale
+        grads = jax.grad(
+            lambda p: loss_fn(p).astype(jnp.float32) * scale)(self.params)
+        if self._grads32 is None:
+            grads32, found = scaler.unscale(grads, sstate)
+        else:
+            grads32, found = scaler.unscale_with_stashed(
+                jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads),
+                self._grads32, sstate)
+        self._grads32 = grads32
+        self._found_inf = jnp.maximum(self._found_inf, found)
+
+    def _post_backward(self, loss_id: int, delay_unscale: bool = False,
+                       delay_overflow_check: bool = False) -> None:
+        if delay_unscale or delay_overflow_check:
+            return  # grads stay stashed for the next backward in this step
+        scaler = self.optimizer.scaler
+        sstate = self.opt_state.scalers[loss_id]
+        old_scale = float(sstate.loss_scale)
+        new_sstate = scaler.update(sstate, self._found_inf)
+        scalers = tuple(new_sstate if i == loss_id else s
+                        for i, s in enumerate(self.opt_state.scalers))
+        self.opt_state = self.opt_state._replace(scalers=scalers)
+        if bool(self._found_inf > 0):
+            self._skip_next = True
+            maybe_print(
+                f"Gradient overflow.  Skipping step, loss scaler {loss_id} "
+                f"reducing loss scale to {float(new_sstate.loss_scale)}")
+
+    # -- torch-shaped methods ----------------------------------------------
+    def zero_grad(self) -> None:
+        self._grads32 = None
+        self._found_inf = jnp.zeros((), jnp.float32)
+
+    def step(self) -> None:
+        if self._grads32 is None:
+            raise RuntimeError("step() called before backward()")
+        if self._skip_next:
+            self._skip_next = False
+            self.zero_grad()
+            return
+        inner = self.optimizer.inner
+        ost = self.opt_state
+        if ost.masters is not None:
+            new_masters, new_inner = inner.update(
+                self._grads32, ost.inner, ost.masters)
+            self.params = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), new_masters, self.params)
+            self.opt_state = ost._replace(masters=new_masters,
+                                          inner=new_inner)
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), self._grads32, self.params)
+            self.params, new_inner = inner.update(grads, ost.inner, self.params)
+            self.opt_state = ost._replace(inner=new_inner)
+        self.zero_grad()
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.opt_state.scalers[0].loss_scale)
+
+
+def bind(optimizer: AmpOptimizer, params: Any) -> BoundOptimizer:
+    """Attach (params, fresh opt_state) to ``optimizer`` for eager use."""
+    bound = BoundOptimizer(optimizer, params)
+    optimizer._bound = bound
+    return bound

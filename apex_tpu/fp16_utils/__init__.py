@@ -1,0 +1,9 @@
+"""apex_tpu.fp16_utils — manual mixed-precision toolkit
+(reference: apex/fp16_utils/__init__.py:1-16)."""
+
+from .fp16util import (
+    BN_convert_float, FP16Model, clip_grad_norm, convert_network,
+    master_params_to_model_params, model_grads_to_master_grads,
+    network_to_half, prep_param_lists, tofp16)
+from .fp16_optimizer import FP16_Optimizer
+from .loss_scaler import LossScaler, DynamicLossScaler

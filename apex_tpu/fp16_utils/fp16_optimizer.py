@@ -1,0 +1,170 @@
+"""General FP16_Optimizer: the legacy 2-line master-weight wrapper.
+
+Equivalent of apex/fp16_utils/fp16_optimizer.py (643 lines): wraps *any*
+apex_tpu Optimizer, owns loss scaling (``backward``), exposes
+``update_master_grads`` / ``clip_master_grads`` / overflow-skipping
+``step`` with closure support, and checkpoints fp32 masters separately from
+model weights ("option 2", reference :298-359).
+
+This is the stateful/eager flavor for legacy-script parity; it drives the
+same functional pieces the jitted path uses (LossScaler state machine,
+multi_tensor unscale).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .fp16util import (clip_grad_norm, master_params_to_model_params,
+                       model_grads_to_master_grads, prep_param_lists)
+from ..amp.scaler import LossScaler as _FunctionalScaler
+from ..amp._amp_state import maybe_print
+
+__all__ = ["FP16_Optimizer"]
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = True):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = _FunctionalScaler(
+                "dynamic", **(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = _FunctionalScaler(static_loss_scale)
+        self.verbose = verbose
+        self.overflow = False
+        self.first_closure_call_this_step = True
+        self._params = None
+        self._masters = None
+        self._inner_state = None
+        self._scaler_state = self.loss_scaler.init_state()
+        self._master_grads = None
+        self._scaled_grads = None
+
+    # -- binding -----------------------------------------------------------
+    def setup(self, params: Any) -> None:
+        """Attach model params (half or fp32); builds fp32 masters."""
+        self._params, self._masters = prep_param_lists(params)
+        self._inner_state = self.optimizer.init(self._masters)
+
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self._scaler_state.loss_scale)
+
+    # -- the reference's 4-call protocol ----------------------------------
+    def zero_grad(self) -> None:
+        self._master_grads = None
+        self._scaled_grads = None
+
+    def backward(self, loss_fn: Callable, *args,
+                 update_master_grads: bool = True):
+        """Scale the loss, compute grads w.r.t. model params
+        (reference :462-523).  ``loss_fn(params, *args) -> scalar``.
+        Returns the unscaled loss."""
+        if self._params is None:
+            raise RuntimeError("call setup(params) first")
+        scale = self._scaler_state.loss_scale
+
+        def scaled(p):
+            return loss_fn(p, *args).astype(jnp.float32) * scale
+
+        scaled_loss, grads = jax.value_and_grad(scaled)(self._params)
+        if self._scaled_grads is None:
+            self._scaled_grads = grads
+        else:  # accumulate across backward calls (reference :497-510)
+            self._scaled_grads = jax.tree_util.tree_map(
+                jnp.add, self._scaled_grads, grads)
+        if update_master_grads:
+            self.update_master_grads()
+        return scaled_loss / scale
+
+    def update_master_grads(self) -> None:
+        """Unscale accumulated grads into fp32 master grads with fused
+        overflow check (reference :525-579)."""
+        if self._scaled_grads is None:
+            return
+        grads32, found = self.loss_scaler.unscale(
+            self._scaled_grads, self._scaler_state)
+        self.overflow = bool(found > 0)
+        self._master_grads = grads32
+        self._scaled_grads = None
+
+    def clip_master_grads(self, max_norm: float, norm_type: float = 2.0):
+        """Clip master grads by global norm (reference :274-296); returns
+        the pre-clip norm (-1 convention not used here: overflow is already
+        tracked separately)."""
+        if self._master_grads is None:
+            raise RuntimeError("no master grads; call backward first")
+        self._master_grads, total = clip_grad_norm(
+            self._master_grads, max_norm, norm_type)
+        return total
+
+    def step(self, closure: Optional[Callable] = None):
+        """Skip on overflow, else inner step on masters + master->model
+        copy (reference :361-460, incl. closure support)."""
+        if closure is not None:
+            return self._step_with_closure(closure)
+        old_scale = float(self._scaler_state.loss_scale)
+        found = jnp.asarray(1.0 if self.overflow else 0.0, jnp.float32)
+        self._scaler_state = self.loss_scaler.update(self._scaler_state, found)
+        if self.overflow:
+            maybe_print(
+                f"OVERFLOW! Skipping step. Attempted loss scale: "
+                f"{old_scale}, reducing to "
+                f"{float(self._scaler_state.loss_scale)}")
+            self.zero_grad()
+            self.overflow = False
+            return None
+        self._masters, self._inner_state = self.optimizer.update(
+            self._master_grads, self._inner_state, self._masters)
+        self._params = master_params_to_model_params(
+            self._masters, self._params)
+        self.zero_grad()
+        return None
+
+    def _step_with_closure(self, closure: Callable):
+        # re-evaluate until a non-overflowed step applies (reference :423-460)
+        while True:
+            loss = closure()
+            if not self.overflow:
+                break
+            # closure path: scaler already updated inside step recursion
+            found = jnp.ones((), jnp.float32)
+            self._scaler_state = self.loss_scaler.update(
+                self._scaler_state, found)
+            maybe_print("OVERFLOW within closure! Retrying with loss scale "
+                        f"{float(self._scaler_state.loss_scale)}")
+            self.zero_grad()
+            self.overflow = False
+        self.step()
+        return loss
+
+    # -- checkpoint: masters separate from model weights (:298-359) --------
+    def state_dict(self) -> dict:
+        return {"loss_scaler": self._scaler_state._asdict(),
+                "overflow": self.overflow,
+                "first_closure_call_this_step":
+                    self.first_closure_call_this_step,
+                "optimizer_state": self._inner_state,
+                "fp32_from_fp16": self._masters}
+
+    def load_state_dict(self, sd: dict) -> None:
+        from ..amp.scaler import ScalerState
+        self._scaler_state = ScalerState(
+            **{k: jnp.asarray(v) for k, v in sd["loss_scaler"].items()})
+        self.overflow = sd["overflow"]
+        self._inner_state = sd["optimizer_state"]
+        self._masters = sd["fp32_from_fp16"]
+        if self._params is not None:
+            self._params = master_params_to_model_params(
+                self._masters, self._params)

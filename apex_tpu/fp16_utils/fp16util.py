@@ -1,0 +1,151 @@
+"""Manual master-parameter toolkit (reference: apex/fp16_utils/fp16util.py).
+
+Functional equivalents of the reference helpers: master copies are new
+pytrees rather than cloned torch Parameters, and "convert network to half
+keeping BatchNorm fp32" operates on the (module tree, params tree) pair via
+amp.cast_param_tree — same invariant as convert_network
+(fp16util.py:60-70).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "prep_param_lists", "model_grads_to_master_grads",
+    "master_params_to_model_params", "network_to_half", "convert_network",
+    "FP16Model", "tofp16", "BN_convert_float", "clip_grad_norm",
+]
+
+
+def prep_param_lists(model_params: Any, flat_master: bool = False
+                     ) -> Tuple[Any, Any]:
+    """Return (model_params, fp32 master copy).  With ``flat_master`` the
+    master is a single fused fp32 vector (fp16util.py:90-133); params must
+    then share one dtype."""
+    if flat_master:
+        leaves = jax.tree_util.tree_leaves(model_params)
+        dtypes = {jnp.dtype(l.dtype) for l in leaves}
+        if len(dtypes) > 1:
+            raise TypeError("flat_master requires a single param dtype "
+                            f"(got {sorted(map(str, dtypes))})")
+        master = jnp.concatenate(
+            [l.reshape(-1).astype(jnp.float32) for l in leaves])
+        return model_params, master
+    master = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), model_params)
+    return model_params, master
+
+
+def model_grads_to_master_grads(model_grads: Any, master: Any,
+                                flat_master: bool = False) -> Any:
+    """Cast half grads into the master's fp32 structure
+    (fp16util.py:136-155)."""
+    if flat_master:
+        leaves = jax.tree_util.tree_leaves(model_grads)
+        return jnp.concatenate(
+            [l.reshape(-1).astype(jnp.float32) for l in leaves])
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32), model_grads)
+
+
+def master_params_to_model_params(master: Any, model_params: Any,
+                                  flat_master: bool = False) -> Any:
+    """Copy master values back into the model's dtypes/shapes
+    (fp16util.py:158-172)."""
+    if flat_master:
+        leaves, treedef = jax.tree_util.tree_flatten(model_params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(l.size)
+            out.append(master[off:off + n].reshape(l.shape).astype(l.dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), master, model_params)
+
+
+def tofp16(params: Any, half_dtype=jnp.float16) -> Any:
+    """Cast every float leaf to half (fp16util.py:22-27)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(half_dtype)
+        if jnp.issubdtype(jnp.result_type(p), jnp.floating) else p, params)
+
+
+def BN_convert_float(module, params: Any) -> Any:
+    """Restore fp32 for BatchNorm params within a half tree
+    (fp16util.py:30-42)."""
+    from ..amp._initialize import cast_param_tree
+
+    def walk(mod, p):
+        if not isinstance(p, dict):
+            return p
+        out = {}
+        for k, v in p.items():
+            child = mod._children.get(k)
+            if child is not None and getattr(child, "fp32_params", False):
+                out[k] = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32), v)
+            elif child is not None:
+                out[k] = walk(child, v)
+            else:
+                out[k] = v
+        return out
+    return walk(module, params)
+
+
+def network_to_half(module, params: Any, half_dtype=jnp.float16) -> Any:
+    """Half params with fp32 BatchNorm — convert_network parity
+    (fp16util.py:60-84)."""
+    return convert_network(module, params, half_dtype)
+
+
+def convert_network(module, params: Any, dtype=jnp.float16) -> Any:
+    from ..amp._initialize import cast_param_tree
+    return cast_param_tree(module, params, dtype, keep_batchnorm_fp32=True)
+
+
+class FP16Model:
+    """Wrapper running a module in half precision with half-cast inputs
+    (fp16util.py:44-58)."""
+
+    def __init__(self, module, half_dtype=jnp.float16):
+        self.module = module
+        self.half_dtype = half_dtype
+
+    def init(self, key):
+        params, state = self.module.init(key)
+        return convert_network(self.module, params, self.half_dtype), state
+
+    def apply(self, params, *args, **kwargs):
+        from .. import nn
+        args = jax.tree_util.tree_map(
+            lambda x: x.astype(self.half_dtype)
+            if isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+                jnp.result_type(x), jnp.floating) else x, args)
+        return nn.apply(self.module, params, *args, **kwargs)
+
+    __call__ = apply
+
+
+def clip_grad_norm(grads: Any, max_norm: float, norm_type: float = 2.0
+                   ) -> Tuple[Any, jax.Array]:
+    """Clip a gradient tree by global norm; returns (clipped, total_norm)
+    (reference alias fp16util.py:182-187)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == 2.0:
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+    elif norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves]))
+    else:
+        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                    for g in leaves) ** (1.0 / norm_type)
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads)
+    return clipped, total

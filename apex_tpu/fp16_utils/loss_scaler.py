@@ -1,0 +1,67 @@
+"""Legacy LossScaler / DynamicLossScaler (reference:
+apex/fp16_utils/loss_scaler.py).
+
+Stateful classes with the reference's attribute surface for scripts written
+against the legacy API; new code should use apex_tpu.amp.LossScaler's
+functional state.  Overflow detection mirrors the reference's inf/nan probe
+(:84-110), here one fused jnp check instead of a per-tensor sum."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaler:
+    """Static scaler (reference :10-45)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def has_overflow(self, params: Any) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    def scale_gradient(self, grads: Any) -> Any:
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss_fn, params, *args):
+        scaled = lambda p: loss_fn(p, *args) * self.loss_scale
+        return jax.value_and_grad(scaled)(params)
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scaler (reference :46-121): halve on overflow, double every
+    ``scale_window`` clean iterations."""
+
+    def __init__(self, init_scale: float = 2 ** 32, scale_factor: float = 2.,
+                 scale_window: int = 1000):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, grads: Any) -> bool:
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return False
+        bad = jnp.any(jnp.stack(
+            [jnp.any(~jnp.isfinite(g.astype(jnp.float32))) for g in leaves]))
+        return bool(bad)
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

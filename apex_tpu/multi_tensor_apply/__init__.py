@@ -1,0 +1,28 @@
+"""apex_tpu.multi_tensor_apply — multi-tensor kernel dispatch.
+
+API-parity shim for apex.multi_tensor_apply (multi_tensor_apply.py:3-30):
+``multi_tensor_applier(op, tensor_lists, *args)`` calls ``op`` over the
+tensor lists and returns ``(outputs, found_inf)``; the mutated noop-flag
+buffer of the reference becomes a functional return value.
+"""
+
+from .multi_tensor import (multi_tensor_scale, multi_tensor_axpby,
+                           multi_tensor_l2norm, global_grad_norm)
+from .flatten import flatten, unflatten, split_by_dtype, TreeFlattener
+
+
+class MultiTensorApply:
+    """Callable shim mirroring apex's MultiTensorApply. ``chunk_size`` is
+    accepted for signature parity; XLA/Pallas pick their own tiling."""
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, tensor_lists, *args, **kwargs):
+        return op(*tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -1,0 +1,85 @@
+"""Flatten / unflatten: fused flat buffers per dtype group.
+
+TPU-native replacement for the reference's apex_C extension
+(csrc/flatten_unflatten.cpp:5-13) and its `split_half_float_double` dtype
+bucketing (apex/parallel/distributed.py:51-58).  DDP's bucketed allreduce
+and the fused optimizers both operate on these buffers: one contiguous
+array per dtype means one psum / one Pallas kernel launch per group instead
+of per-parameter work — the multi_tensor_apply insight, expressed the XLA
+way (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flatten", "unflatten", "split_by_dtype", "TreeFlattener"]
+
+
+def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate raveled same-dtype tensors into one 1-D buffer."""
+    tensors = list(tensors)
+    if not tensors:
+        return jnp.zeros((0,), jnp.float32)
+    dt = tensors[0].dtype
+    if any(t.dtype != dt for t in tensors):
+        raise TypeError("flatten() requires a same-dtype tensor list; "
+                        "use split_by_dtype first")
+    return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+
+def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
+    """Inverse of flatten: view ``flat`` back as tensors shaped like ``like``."""
+    out, off = [], 0
+    for t in like:
+        n = t.size
+        out.append(jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(t.shape))
+        off += n
+    return out
+
+
+def split_by_dtype(tensors: Sequence[jax.Array]
+                   ) -> Dict[Any, List[Tuple[int, jax.Array]]]:
+    """Group (index, tensor) pairs by dtype, preserving order within a group
+    (the analogue of split_half_float_double, distributed.py:51-58)."""
+    groups: Dict[Any, List[Tuple[int, jax.Array]]] = {}
+    for i, t in enumerate(tensors):
+        groups.setdefault(jnp.dtype(t.dtype), []).append((i, t))
+    return groups
+
+
+class TreeFlattener:
+    """Pack a pytree into one flat fp32-or-native buffer per dtype group and
+    back.  Structure (treedef, shapes, dtype->indices) is computed once at
+    construction, so pack/unpack are pure reshape/concat ops that XLA fuses.
+    """
+
+    def __init__(self, tree: Any):
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.shapes = [l.shape for l in leaves]
+        self.sizes = [int(l.size) for l in leaves]
+        self.dtypes = [jnp.dtype(l.dtype) for l in leaves]
+        self.groups: Dict[Any, List[int]] = {}
+        for i, dt in enumerate(self.dtypes):
+            self.groups.setdefault(dt, []).append(i)
+
+    def pack(self, tree: Any) -> Dict[Any, jax.Array]:
+        leaves = jax.tree_util.tree_leaves(tree)
+        out = {}
+        for dt, idxs in self.groups.items():
+            out[dt] = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        return out
+
+    def unpack(self, buffers: Dict[Any, jax.Array]) -> Any:
+        leaves: List[Any] = [None] * len(self.shapes)
+        for dt, idxs in self.groups.items():
+            off = 0
+            buf = buffers[dt]
+            for i in idxs:
+                n = self.sizes[i]
+                leaves[i] = buf[off:off + n].reshape(self.shapes[i])
+                off += n
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)

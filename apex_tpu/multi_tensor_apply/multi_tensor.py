@@ -1,0 +1,117 @@
+"""Multi-tensor ops: scale / axpby / l2norm (+ fused unscale with overflow
+detection) over lists of arrays or whole pytrees.
+
+These are the TPU-native equivalents of the reference's amp_C CUDA kernels
+(csrc/multi_tensor_scale_kernel.cu, multi_tensor_axpby_kernel.cu,
+multi_tensor_l2norm_kernel.cu, dispatched through the chunked
+multi_tensor_apply harness in csrc/multi_tensor_apply.cuh:40-126).  The CUDA
+harness exists to pack tensor addresses into 4KB kernel-arg structs; XLA has
+no such constraint, so the idiomatic form is a tree_map that XLA fuses into
+a handful of loops — or, on TPU, a single Pallas kernel over a fused flat
+buffer (apex_tpu.ops.pallas_multi_tensor), selected automatically.
+
+Semantics preserved from the reference:
+
+- ``multi_tensor_scale``: out = in * scale, and the returned ``found_inf``
+  flag is 1.0 iff any *input* element is non-finite — the fused
+  unscale+overflow-check (multi_tensor_scale_kernel.cu:64-73).
+- ``multi_tensor_axpby``: out = a*x + b*y with the finite check applied to
+  x, y, or both per ``arg_to_check`` (multi_tensor_axpby_kernel.cu:67-84);
+  used for gradient accumulation across backward passes
+  (apex/amp/scaler.py:167-172).
+- ``multi_tensor_l2norm``: global L2 norm and optional per-tensor norms,
+  accumulated in fp32 (multi_tensor_l2norm_kernel.cu:47-180).
+
+Unlike the reference there is no mutated ``noop_flag`` buffer: the flag is a
+device scalar returned functionally, so under jit no host sync is forced
+(the reference pays one D2H per step at apex/amp/scaler.py:192-193).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _leaves(tree: Any) -> List[jax.Array]:
+    return [x for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _nonfinite_any(leaves: Sequence[jax.Array]) -> jax.Array:
+    """1.0 if any element of any leaf is inf/nan, else 0.0 (fp32 scalar)."""
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    flags = [jnp.any(~jnp.isfinite(x.astype(jnp.float32))) for x in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out.astype(jnp.float32)
+
+
+def multi_tensor_scale(tree: Any, scale, check_finite: bool = True
+                       ) -> Tuple[Any, jax.Array]:
+    """out = tree * scale; found_inf flags non-finite *inputs*.
+
+    Output leaves keep their input dtypes (the reference kernel writes
+    through templated out-types; cross-dtype copy-scaling is done by
+    passing ``out_dtype``-cast trees at the call site).
+    """
+    from ..ops import dispatch
+    if dispatch.use_pallas_for(tree):
+        from ..ops import pallas_multi_tensor as pk
+        return pk.multi_tensor_scale(tree, scale, check_finite)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    found_inf = _nonfinite_any(leaves) if check_finite else jnp.zeros(
+        (), jnp.float32)
+    scale = jnp.asarray(scale, jnp.float32)
+    out = [(x.astype(jnp.float32) * scale).astype(x.dtype) for x in leaves]
+    return jax.tree_util.tree_unflatten(treedef, out), found_inf
+
+
+def multi_tensor_axpby(a, b, x_tree: Any, y_tree: Any,
+                       arg_to_check: int = -1) -> Tuple[Any, jax.Array]:
+    """out = a*x + b*y leafwise; finite check on x (0), y (1) or both (-1)."""
+    from ..ops import dispatch
+    if dispatch.use_pallas_for(x_tree):
+        from ..ops import pallas_multi_tensor as pk
+        return pk.multi_tensor_axpby(a, b, x_tree, y_tree, arg_to_check)
+    xs, treedef = jax.tree_util.tree_flatten(x_tree)
+    ys = jax.tree_util.tree_leaves(y_tree)
+    if arg_to_check == 0:
+        found_inf = _nonfinite_any(xs)
+    elif arg_to_check == 1:
+        found_inf = _nonfinite_any(ys)
+    else:
+        found_inf = jnp.maximum(_nonfinite_any(xs), _nonfinite_any(ys))
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    out = [(a * xv.astype(jnp.float32) + b * yv.astype(jnp.float32)
+            ).astype(xv.dtype) for xv, yv in zip(xs, ys)]
+    return jax.tree_util.tree_unflatten(treedef, out), found_inf
+
+
+def multi_tensor_l2norm(tree: Any, per_tensor: bool = False
+                        ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Global (and optionally per-leaf) L2 norm in fp32."""
+    from ..ops import dispatch
+    if dispatch.use_pallas_for(tree):
+        from ..ops import pallas_multi_tensor as pk
+        return pk.multi_tensor_l2norm(tree, per_tensor)
+    leaves = _leaves(tree)
+    if not leaves:
+        z = jnp.zeros((), jnp.float32)
+        return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    total = jnp.sqrt(sum(sq))
+    if per_tensor:
+        return total, jnp.sqrt(jnp.stack(sq))
+    return total, None
+
+
+def global_grad_norm(tree: Any) -> jax.Array:
+    """fp32 global L2 norm; returns -1.0 when non-finite, matching the
+    overflow convention of apex/optimizers/fp16_optimizer.py:103-128."""
+    norm, _ = multi_tensor_l2norm(tree)
+    return jnp.where(jnp.isfinite(norm), norm, -jnp.ones((), jnp.float32))

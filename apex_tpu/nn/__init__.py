@@ -1,0 +1,8 @@
+"""apex_tpu.nn — minimal policy-aware functional layer library."""
+
+from .module import (Module, ModuleList, Sequential, apply, init,
+                     current_context, ApplyContext)
+from .layers import (Linear, Conv2d, BatchNorm2d, LayerNorm, Embedding,
+                     Dropout, ReLU, GELU, Tanh, Sigmoid, Identity, Flatten,
+                     MaxPool2d, AvgPool2d, AdaptiveAvgPool2d)
+from . import functional

@@ -1,0 +1,303 @@
+"""Policy-aware functional ops (the apex_tpu analogue of torch.nn.functional).
+
+Every op funnels through :func:`op` → ``amp.policy.cast_op_args`` so the O1
+cast policy (whitelist half, blacklist fp32, promote widest — reference
+apex/amp/lists/*) applies at dispatch time.  With no policy installed the
+ops are plain jnp/lax code and XLA fuses them freely.
+
+Convolutions use NCHW layout to match the reference's examples; XLA
+re-layouts internally for the MXU so this costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..amp import policy as _policy
+
+__all__ = [
+    "linear", "matmul", "conv2d", "relu", "gelu", "silu", "sigmoid", "tanh",
+    "softmax", "log_softmax", "layer_norm", "batch_norm_stats",
+    "batch_norm_apply", "dropout", "max_pool2d", "avg_pool2d",
+    "adaptive_avg_pool2d", "embedding", "cross_entropy", "nll_loss",
+    "mse_loss", "l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "cat", "stack", "add", "mul",
+]
+
+
+def op(name: str):
+    """Route a function through the active amp cast policy."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            args, kwargs = _policy.cast_op_args(name, args, kwargs)
+            return fn(*args, **kwargs)
+        wrapper.__amp_op__ = name
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# whitelist (MXU) ops
+# ---------------------------------------------------------------------------
+
+@op("linear")
+def linear(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None
+           ) -> jax.Array:
+    # weight is (out, in) like the reference's nn.Linear
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op("matmul")
+def matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.matmul(a, b)
+
+
+@op("conv2d")
+def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
+           stride: Union[int, Tuple[int, int]] = 1,
+           padding: Union[int, Tuple[int, int], str] = 0,
+           dilation: Union[int, Tuple[int, int]] = 1,
+           groups: int = 1) -> jax.Array:
+    """NCHW conv; weight (O, I/groups, kH, kW) like torch."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    elif isinstance(padding, tuple) and isinstance(padding[0], int):
+        padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=None)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None, None]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pointwise / activations
+# ---------------------------------------------------------------------------
+
+def relu(x: jax.Array) -> jax.Array:
+    return jnp.maximum(x, 0)
+
+
+@op("gelu")
+def gelu(x: jax.Array, approximate: bool = True) -> jax.Array:
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(x)
+
+
+def sigmoid(x: jax.Array) -> jax.Array:
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x: jax.Array) -> jax.Array:
+    return jnp.tanh(x)
+
+
+# ---------------------------------------------------------------------------
+# blacklist (fp32) ops
+# ---------------------------------------------------------------------------
+
+@op("softmax")
+def softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax")
+def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("layer_norm")
+def layer_norm(x: jax.Array, normalized_shape: Sequence[int],
+               weight: Optional[jax.Array] = None,
+               bias: Optional[jax.Array] = None, eps: float = 1e-5
+               ) -> jax.Array:
+    axes = tuple(range(x.ndim - len(tuple(normalized_shape)), x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def batch_norm_stats(x: jax.Array, axes: Tuple[int, ...]
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-channel (count, mean, biased var) in fp32 over ``axes``."""
+    x32 = x.astype(jnp.float32)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean)
+    return jnp.asarray(n, jnp.float32), mean, var
+
+
+def batch_norm_apply(x: jax.Array, mean: jax.Array, var: jax.Array,
+                     weight: Optional[jax.Array], bias: Optional[jax.Array],
+                     eps: float, channel_axis: int = 1) -> jax.Array:
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = inv if weight is None else inv * weight.astype(jnp.float32)
+    shift = -mean.astype(jnp.float32) * scale
+    if bias is not None:
+        shift = shift + bias.astype(jnp.float32)
+    y = x.astype(jnp.float32) * scale.reshape(shape) + shift.reshape(shape)
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# dropout / pooling / embedding
+# ---------------------------------------------------------------------------
+
+def dropout(x: jax.Array, rate: float, rng: jax.Array) -> jax.Array:
+    if rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def _pool2d(x, window, stride, padding, init, reduce_fn):
+    if isinstance(window, int):
+        window = (window, window)
+    if stride is None:
+        stride = window
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(padding, (tuple, list)) and all(
+            isinstance(p, int) for p in padding):
+        ph, pw = padding
+        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    return lax.reduce_window(
+        x, init, reduce_fn, (1, 1) + tuple(window), (1, 1) + tuple(stride),
+        padding)
+
+
+def max_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
+    neg = jnp.array(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return _pool2d(x, kernel_size, stride, padding, neg, lax.max)
+
+
+def avg_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
+    if isinstance(kernel_size, int):
+        denom = kernel_size * kernel_size
+    else:
+        denom = kernel_size[0] * kernel_size[1]
+    s = _pool2d(x, kernel_size, stride, padding, jnp.array(0, x.dtype), lax.add)
+    return s / jnp.asarray(denom, x.dtype)
+
+
+def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]]
+                        ) -> jax.Array:
+    if output_size in (1, (1, 1)):
+        return jnp.mean(x, axis=(2, 3), keepdims=True).astype(x.dtype)
+    raise NotImplementedError("adaptive_avg_pool2d supports output_size=1")
+
+
+def embedding(ids: jax.Array, table: jax.Array) -> jax.Array:
+    return jnp.take(table, ids, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# losses (blacklist: computed in fp32)
+# ---------------------------------------------------------------------------
+
+@op("cross_entropy")
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  reduction: str = "mean") -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return _reduce(nll, reduction)
+
+
+@op("nll_loss")
+def nll_loss(logp: jax.Array, labels: jax.Array, reduction: str = "mean"
+             ) -> jax.Array:
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return _reduce(nll, reduction)
+
+
+@op("mse_loss")
+def mse_loss(x: jax.Array, y: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(jnp.square(x - y), reduction)
+
+
+@op("l1_loss")
+def l1_loss(x: jax.Array, y: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(jnp.abs(x - y), reduction)
+
+
+@op("binary_cross_entropy")
+def binary_cross_entropy(p: jax.Array, y: jax.Array, reduction: str = "mean"
+                         ) -> jax.Array:
+    # Reachable only when no policy is active or casts are disabled: under
+    # an O1 policy this op name is banned (lists.BANNED_FUNCS) and raises.
+    eps = 1e-12
+    loss = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logits: jax.Array, y: jax.Array,
+                                     reduction: str = "mean") -> jax.Array:
+    z = logits.astype(jnp.float32)
+    loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return _reduce(loss, reduction)
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# promote / sequence ops
+# ---------------------------------------------------------------------------
+
+@op("cat")
+def cat(tensors: Sequence[jax.Array], axis: int = 0) -> jax.Array:
+    return jnp.concatenate(list(tensors), axis=axis)
+
+
+@op("stack")
+def stack(tensors: Sequence[jax.Array], axis: int = 0) -> jax.Array:
+    return jnp.stack(list(tensors), axis=axis)
+
+
+@op("add")
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a + b
+
+
+@op("mul")
+def mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a * b

@@ -1,0 +1,256 @@
+"""Layer library: the minimal set the reference's examples/tests exercise
+(Linear/Conv/BatchNorm/LayerNorm/activations/pooling/dropout/embedding),
+policy-aware via apex_tpu.nn.functional.
+
+BatchNorm keeps fp32 parameters and statistics under amp by default — the
+`keep_batchnorm_fp32` invariant the reference enforces via convert_network
+(apex/fp16_utils/fp16util.py:60-70) and the O2 preset
+(apex/amp/frontend.py:133-143); layers whose class sets ``fp32_params=True``
+are skipped by amp's param-casting pass.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .module import Module, current_context
+
+__all__ = [
+    "Linear", "Conv2d", "BatchNorm2d", "LayerNorm", "Embedding", "Dropout",
+    "ReLU", "GELU", "Tanh", "Sigmoid", "Identity", "Flatten", "MaxPool2d",
+    "AvgPool2d", "AdaptiveAvgPool2d",
+]
+
+
+def _kaiming_uniform(key, shape, fan_in, dtype=jnp.float32):
+    bound = math.sqrt(1.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+
+    def create_params(self, key):
+        wk, bk = jax.random.split(key)
+        p = {"weight": _kaiming_uniform(
+            wk, (self.out_features, self.in_features), self.in_features)}
+        if self.use_bias:
+            p["bias"] = _kaiming_uniform(
+                bk, (self.out_features,), self.in_features)
+        return p
+
+    def forward(self, params, x):
+        return F.linear(x, params["weight"], params.get("bias"))
+
+
+class Conv2d(Module):
+    def __init__(self, in_channels: int, out_channels: int,
+                 kernel_size: Union[int, Tuple[int, int]],
+                 stride: Union[int, Tuple[int, int]] = 1,
+                 padding: Union[int, Tuple[int, int]] = 0,
+                 dilation: int = 1, groups: int = 1, bias: bool = True):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.use_bias = bias
+
+    def create_params(self, key):
+        wk, bk = jax.random.split(key)
+        fan_in = (self.in_channels // self.groups) * \
+            self.kernel_size[0] * self.kernel_size[1]
+        p = {"weight": _kaiming_uniform(
+            wk, (self.out_channels, self.in_channels // self.groups,
+                 *self.kernel_size), fan_in)}
+        if self.use_bias:
+            p["bias"] = _kaiming_uniform(bk, (self.out_channels,), fan_in)
+        return p
+
+    def forward(self, params, x):
+        return F.conv2d(x, params["weight"], params.get("bias"),
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups)
+
+
+class BatchNorm2d(Module):
+    """NCHW batch norm with running statistics in apply-context state.
+
+    fp32_params=True marks its affine params (and stats) to stay fp32 under
+    amp O2 (reference: keep_batchnorm_fp32, apex/amp/frontend.py:133-143).
+    """
+
+    fp32_params = True
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True):
+        super().__init__()
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+
+    def create_params(self, key):
+        if not self.affine:
+            return {}
+        return {"weight": jnp.ones((self.num_features,), jnp.float32),
+                "bias": jnp.zeros((self.num_features,), jnp.float32)}
+
+    def create_state(self):
+        if not self.track_running_stats:
+            return None
+        return {"running_mean": jnp.zeros((self.num_features,), jnp.float32),
+                "running_var": jnp.ones((self.num_features,), jnp.float32),
+                "num_batches_tracked": jnp.zeros((), jnp.int64
+                                                 if jax.config.jax_enable_x64
+                                                 else jnp.int32)}
+
+    # hook for SyncBatchNorm: merge (count, mean, var) across devices
+    def _sync_stats(self, count, mean, var):
+        return count, mean, var
+
+    def forward(self, params, x):
+        ctx = current_context()
+        train = ctx.train if ctx is not None else False
+        st = ctx.get_state(self.path) if (ctx is not None and
+                                          self.track_running_stats) else None
+        if train or st is None:
+            count, mean, var = F.batch_norm_stats(x, (0, 2, 3))
+            count, mean, var = self._sync_stats(count, mean, var)
+            if st is not None and ctx.mutable:
+                m = self.momentum
+                # unbiased variance for the running estimate, matching the
+                # reference (apex/parallel/sync_batchnorm.py:123-131)
+                unbiased = var * (count / jnp.maximum(count - 1.0, 1.0))
+                ctx.set_state(self.path, {
+                    "running_mean": (1 - m) * st["running_mean"] + m * mean,
+                    "running_var": (1 - m) * st["running_var"] + m * unbiased,
+                    "num_batches_tracked": st["num_batches_tracked"] + 1,
+                })
+        else:
+            mean, var = st["running_mean"], st["running_var"]
+        w = params.get("weight") if self.affine else None
+        b = params.get("bias") if self.affine else None
+        return F.batch_norm_apply(x, mean, var, w, b, self.eps, channel_axis=1)
+
+
+class LayerNorm(Module):
+    fp32_params = True
+
+    def __init__(self, normalized_shape: Union[int, Sequence[int]],
+                 eps: float = 1e-5, elementwise_affine: bool = True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def create_params(self, key):
+        if not self.elementwise_affine:
+            return {}
+        return {"weight": jnp.ones(self.normalized_shape, jnp.float32),
+                "bias": jnp.zeros(self.normalized_shape, jnp.float32)}
+
+    def forward(self, params, x):
+        return F.layer_norm(x, self.normalized_shape, params.get("weight"),
+                            params.get("bias"), self.eps)
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def create_params(self, key):
+        return {"weight": jax.random.normal(
+            key, (self.num_embeddings, self.embedding_dim), jnp.float32)}
+
+    def forward(self, params, ids):
+        return F.embedding(ids, params["weight"])
+
+
+class Dropout(Module):
+    def __init__(self, rate: float = 0.5):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, params, x):
+        ctx = current_context()
+        if ctx is None or not ctx.train or self.rate == 0.0:
+            return x
+        return F.dropout(x, self.rate, ctx.make_rng())
+
+
+class ReLU(Module):
+    def forward(self, params, x):
+        return F.relu(x)
+
+
+class GELU(Module):
+    def forward(self, params, x):
+        return F.gelu(x)
+
+
+class Tanh(Module):
+    def forward(self, params, x):
+        return F.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, params, x):
+        return F.sigmoid(x)
+
+
+class Identity(Module):
+    def forward(self, params, x):
+        return x
+
+
+class Flatten(Module):
+    def forward(self, params, x):
+        return x.reshape(x.shape[0], -1)
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, params, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, params, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveAvgPool2d(Module):
+    def __init__(self, output_size=1):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, params, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)

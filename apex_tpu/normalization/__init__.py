@@ -1,0 +1,5 @@
+"""apex_tpu.normalization — fused normalization layers
+(reference: apex/normalization/__init__.py)."""
+
+from .fused_layer_norm import (FusedLayerNorm, fused_layer_norm,
+                               fused_layer_norm_affine)

@@ -1,0 +1,148 @@
+"""FusedLayerNorm: layer norm with explicit fused fwd/bwd.
+
+Equivalent of apex.normalization.FusedLayerNorm
+(apex/normalization/fused_layer_norm.py) over csrc/layer_norm_cuda.cpp /
+layer_norm_cuda_kernel.cu.  The contract preserved from the reference:
+
+- input viewed as (n1, n2) = (rows, normalized size) (layer_norm_cuda.cpp:7-27),
+- forward returns output and saves fp32 (mean, invvar) per row for backward
+  even for half inputs (cpp:133,155),
+- backward produces (dx, dgamma, dbeta) via a row-reduction + two-stage
+  gamma/beta reduction (kernel.cu:403-638).
+
+Here forward/backward are a jax.custom_vjp pair; on TPU the row reductions
+dispatch to the Pallas kernels in apex_tpu.ops.pallas_layer_norm, elsewhere
+they are jnp reductions XLA fuses.  The custom VJP exists so the Pallas
+backward kernel can be swapped in without touching autodiff, and so the
+saved activations match the reference's (input, mean, invvar) layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn.module import Module
+
+__all__ = ["FusedLayerNorm", "fused_layer_norm", "fused_layer_norm_affine"]
+
+
+def _norm_axes(x, normalized_shape):
+    return tuple(range(x.ndim - len(normalized_shape), x.ndim))
+
+
+def _fwd_stats(x2: jax.Array, eps: float) -> Tuple[jax.Array, jax.Array]:
+    """Per-row fp32 (mean, invvar) on the (n1, n2) view."""
+    x32 = x2.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=1)
+    var = jnp.mean(jnp.square(x32), axis=1) - jnp.square(mean)
+    invvar = lax.rsqrt(var + eps)
+    return mean, invvar
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm_core(x2, weight, bias, n2: int, eps: float):
+    out, _, _ = _layer_norm_fwd_impl(x2, weight, bias, n2, eps)
+    return out
+
+
+def _layer_norm_fwd_impl(x2, weight, bias, n2, eps):
+    from ..ops import dispatch
+    if dispatch.use_pallas_for(x2):
+        from ..ops import pallas_layer_norm
+        return pallas_layer_norm.forward(x2, weight, bias, eps)
+    mean, invvar = _fwd_stats(x2, eps)
+    xhat = (x2.astype(jnp.float32) - mean[:, None]) * invvar[:, None]
+    y = xhat
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)[None, :]
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)[None, :]
+    return y.astype(x2.dtype), mean, invvar
+
+
+def _layer_norm_fwd(x2, weight, bias, n2, eps):
+    out, mean, invvar = _layer_norm_fwd_impl(x2, weight, bias, n2, eps)
+    return out, (x2, weight, bias, mean, invvar)
+
+
+def _layer_norm_bwd(n2, eps, res, dy):
+    x2, weight, bias, mean, invvar = res
+    from ..ops import dispatch
+    if dispatch.use_pallas_for(x2):
+        from ..ops import pallas_layer_norm
+        return pallas_layer_norm.backward(dy, x2, weight, bias, mean, invvar)
+    dy32 = dy.astype(jnp.float32)
+    x32 = x2.astype(jnp.float32)
+    xhat = (x32 - mean[:, None]) * invvar[:, None]
+    if weight is not None:
+        dy_g = dy32 * weight.astype(jnp.float32)[None, :]
+    else:
+        dy_g = dy32
+    c1 = jnp.mean(dy_g, axis=1, keepdims=True)
+    c2 = jnp.mean(dy_g * xhat, axis=1, keepdims=True)
+    dx = (invvar[:, None] * (dy_g - c1 - xhat * c2)).astype(x2.dtype)
+    dw = db = None
+    if weight is not None:
+        dw = jnp.sum(dy32 * xhat, axis=0).astype(weight.dtype)
+    if bias is not None:
+        db = jnp.sum(dy32, axis=0).astype(bias.dtype)
+    return dx, dw, db
+
+
+_layer_norm_core.defvjp(_layer_norm_fwd, _layer_norm_bwd)
+
+
+def fused_layer_norm(x: jax.Array, normalized_shape: Union[int, Sequence[int]],
+                     weight: Optional[jax.Array] = None,
+                     bias: Optional[jax.Array] = None,
+                     eps: float = 1e-5) -> jax.Array:
+    """Functional fused layer norm (affine when weight/bias given)."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)
+    n2 = 1
+    for s in normalized_shape:
+        n2 *= s
+    n1 = x.size // n2
+    x2 = x.reshape(n1, n2)
+    w = weight.reshape(-1) if weight is not None else None
+    b = bias.reshape(-1) if bias is not None else None
+    out = _layer_norm_core(x2, w, b, n2, eps)
+    return out.reshape(x.shape)
+
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5):
+    return fused_layer_norm(x, normalized_shape, weight, bias, eps)
+
+
+class FusedLayerNorm(Module):
+    """Module parity with apex.normalization.FusedLayerNorm
+    (fused_layer_norm.py:57-165): same constructor, affine & non-affine."""
+
+    fp32_params = True
+
+    def __init__(self, normalized_shape: Union[int, Sequence[int]],
+                 eps: float = 1e-5, elementwise_affine: bool = True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def create_params(self, key):
+        if not self.elementwise_affine:
+            return {}
+        return {"weight": jnp.ones(self.normalized_shape, jnp.float32),
+                "bias": jnp.zeros(self.normalized_shape, jnp.float32)}
+
+    def forward(self, params, x):
+        return fused_layer_norm(x, self.normalized_shape,
+                                params.get("weight"), params.get("bias"),
+                                self.eps)

@@ -1,0 +1,13 @@
+"""apex_tpu.ops — Pallas TPU kernels and their dispatch layer.
+
+Kernel inventory (TPU-native equivalents of the reference csrc/ tree):
+  pallas_multi_tensor — scale / axpby / l2norm over fused flat buffers
+                        (csrc/multi_tensor_*.cu)
+  pallas_adam         — fused Adam step with optional half write-out
+                        (csrc/fused_adam_cuda_kernel.cu)
+  pallas_layer_norm   — fused LayerNorm fwd/bwd row reductions
+                        (csrc/layer_norm_cuda_kernel.cu)
+  pallas_lamb         — LAMB stage1/stage2 (csrc/multi_tensor_lamb_stage_*.cu)
+"""
+
+from . import dispatch

@@ -1,0 +1,62 @@
+"""Kernel dispatch: Pallas on TPU, jnp fallback elsewhere.
+
+The reference gates its CUDA extensions behind lazy imports with Python
+fallbacks (apex/multi_tensor_apply/__init__.py:1-4, README.md:90-95); here
+the gate is the JAX backend plus an env-var kill switch, and the fallback
+is the pure-jnp path which is bitwise-comparable in tests.
+
+Env vars:
+  APEX_TPU_DISABLE_PALLAS=1   force the jnp path everywhere
+  APEX_TPU_FORCE_PALLAS=1     force Pallas (interpret mode off-TPU; slow,
+                              used by kernel parity tests)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+_KERNELS_AVAILABLE = None
+
+
+def kernels_available() -> bool:
+    """True iff the Pallas kernel modules import cleanly (the analogue of
+    the reference's `import amp_C` probe, multi_tensor_apply/__init__.py:1-4)."""
+    global _KERNELS_AVAILABLE
+    if _KERNELS_AVAILABLE is None:
+        try:
+            from . import pallas_multi_tensor  # noqa: F401
+            from . import pallas_adam  # noqa: F401
+            from . import pallas_layer_norm  # noqa: F401
+            _KERNELS_AVAILABLE = True
+        except ImportError:
+            _KERNELS_AVAILABLE = False
+    return _KERNELS_AVAILABLE
+
+
+def backend() -> str:
+    return jax.default_backend()
+
+
+def pallas_enabled() -> bool:
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS") == "1":
+        return False
+    if not kernels_available():
+        return False
+    if os.environ.get("APEX_TPU_FORCE_PALLAS") == "1":
+        return True
+    return backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret=True is needed off-TPU (CPU tests)."""
+    return backend() != "tpu"
+
+
+def use_pallas_for(tree: Any) -> bool:
+    if not pallas_enabled():
+        return False
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves)

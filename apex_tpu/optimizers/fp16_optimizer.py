@@ -1,0 +1,114 @@
+"""FP16_Optimizer (fused flavor): master-weight wrapper for FusedAdam.
+
+Equivalent of apex/optimizers/fp16_optimizer.py (274 lines): keeps fp32
+master weights alongside half model weights, computes the global grad norm
+of the incoming (scaled) half grads — overflow is signalled by a non-finite
+norm, reported as -1 like the reference (:103-128) — skips the step and
+adjusts the loss scale on overflow, and otherwise hands the flat grads to
+FusedAdam with the combined scale (:130-161).  Dynamic-scale bookkeeping
+(:174-190) reuses the amp LossScaler state machine, which implements the
+same halve-on-overflow / double-per-window policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .fused_adam import FusedAdam, AdamState
+from ..amp.scaler import LossScaler, ScalerState
+from ..multi_tensor_apply import global_grad_norm
+
+__all__ = ["FP16_Optimizer", "FP16OptState"]
+
+
+class FP16OptState(NamedTuple):
+    masters: Any          # fp32 master pytree
+    adam: AdamState
+    scaler: ScalerState
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer: FusedAdam,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = True):
+        if not isinstance(init_optimizer, FusedAdam):
+            raise TypeError(
+                "apex_tpu.optimizers.FP16_Optimizer is designed only for "
+                "FusedAdam (like the reference, fp16_optimizer.py:28); use "
+                "apex_tpu.fp16_utils.FP16_Optimizer for other optimizers.")
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            self.loss_scaler = LossScaler("dynamic", **args)
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.verbose = verbose
+
+    # -- functional API ----------------------------------------------------
+    def init(self, params: Any) -> FP16OptState:
+        masters = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)
+        return FP16OptState(masters=masters,
+                            adam=self.optimizer.init(masters),
+                            scaler=self.loss_scaler.init_state())
+
+    def loss_scale(self, state: FP16OptState) -> jax.Array:
+        return state.scaler.loss_scale
+
+    def scale_loss(self, loss: jax.Array, state: FP16OptState) -> jax.Array:
+        return self.loss_scaler.scale_loss(loss, state.scaler)
+
+    def backward(self, loss_fn, params: Any, state: FP16OptState, *args):
+        """value_and_grad of the scaled loss (reference backward,
+        fp16_optimizer.py:163-172). Returns (loss, scaled_grads)."""
+        scale = state.scaler.loss_scale
+
+        def scaled(p):
+            return loss_fn(p, *args).astype(jnp.float32) * scale
+
+        scaled_loss, grads = jax.value_and_grad(scaled)(params)
+        return scaled_loss / scale, grads
+
+    def step(self, params: Any, state: FP16OptState, scaled_grads: Any
+             ) -> Tuple[Any, FP16OptState, dict]:
+        """Grad-norm overflow check, skip-or-apply, master->model copy."""
+        norm = global_grad_norm(scaled_grads)  # -1 on inf/nan (:103-128)
+        found_inf = (norm < 0).astype(jnp.float32)
+        new_sstate = self.loss_scaler.update(state.scaler, found_inf)
+        scale = state.scaler.loss_scale
+
+        def do_update(operand):
+            p, masters, adam = operand
+            new_masters, new_adam = self.optimizer.step(
+                masters, adam, scaled_grads, scale=scale,
+                grad_norm=jnp.maximum(norm, 0.0))
+            new_p = jax.tree_util.tree_map(
+                lambda m_, p_: m_.astype(p_.dtype), new_masters, p)
+            return new_p, new_masters, new_adam
+
+        new_params, new_masters, new_adam = jax.lax.cond(
+            found_inf > 0, lambda op: op, do_update,
+            (params, state.masters, state.adam))
+
+        info = {"found_inf": found_inf, "grad_norm": norm,
+                "loss_scale": new_sstate.loss_scale}
+        return new_params, FP16OptState(masters=new_masters, adam=new_adam,
+                                        scaler=new_sstate), info
+
+    # -- checkpointing ("option 2": masters saved separately from model
+    #    weights, reference fp16_optimizer.py:211-274) --------------------
+    def state_dict(self, state: FP16OptState) -> dict:
+        return {"masters": state.masters, "adam": state.adam._asdict(),
+                "scaler": state.scaler._asdict()}
+
+    def load_state_dict(self, sd: dict) -> FP16OptState:
+        return FP16OptState(
+            masters=sd["masters"],
+            adam=AdamState(**sd["adam"]),
+            scaler=ScalerState(**{k: jnp.asarray(v)
+                                  for k, v in sd["scaler"].items()}))

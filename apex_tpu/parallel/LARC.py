@@ -1,0 +1,68 @@
+"""LARC: layer-wise adaptive rate control, as an optimizer wrapper.
+
+Parity with apex.parallel.LARC (LARC.py:68-97): per-parameter adaptive lr
+
+    adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)
+
+applied by rescaling gradients in place before the wrapped optimizer runs;
+``clip=True`` caps the effective lr at the base lr
+(``min(adaptive_lr/lr, 1)``), ``clip=False`` scales grads by adaptive_lr
+directly.  Weight decay is absorbed into the gradient and zeroed on the
+inner optimizer, exactly like the reference mutates param_groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizers.base import Optimizer, resolve_lr
+
+__all__ = ["LARC"]
+
+
+class LARC(Optimizer):
+    def __init__(self, optimizer: Optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        # absorb the inner weight decay (reference LARC.py:81-95 zeroes the
+        # group's wd after folding it into the grad)
+        self.weight_decay = float(getattr(optimizer, "weight_decay", 0.0))
+        if self.weight_decay:
+            optimizer.weight_decay = 0.0
+
+    def __getattr__(self, name):
+        return getattr(self.optim, name)
+
+    def init(self, params: Any):
+        return self.optim.init(params)
+
+    def update(self, grads: Any, state: Any, params: Any):
+        step = getattr(state, "step", jnp.zeros((), jnp.int32))
+        lr = resolve_lr(self.optim.lr, step)
+        wd = self.weight_decay
+        tc = self.trust_coefficient
+        eps = self.eps
+
+        def rescale(p, g):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = tc * p_norm / (g_norm + wd * p_norm + eps)
+            # parameters with zero norm take the base lr (reference guards
+            # p_norm/g_norm != 0, LARC.py:88)
+            adaptive_lr = jnp.where((p_norm > 0) & (g_norm > 0),
+                                    adaptive_lr, 1.0 if self.clip else 1.0)
+            if self.clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+            new_g = g32 + wd * p32
+            return (new_g * adaptive_lr).astype(g.dtype)
+
+        scaled = jax.tree_util.tree_map(rescale, params, grads)
+        return self.optim.update(scaled, state, params)

@@ -1,0 +1,229 @@
+"""DistributedDataParallel for device meshes.
+
+The reference DDP (apex/parallel/distributed.py:129-512) overlaps NCCL
+allreduce with backward by hooking per-param grad accumulators, assembling
+flat dtype-split buckets in backward arrival order, and draining them on a
+dedicated reduction stream.  On TPU/XLA none of that machinery is needed or
+desirable (SURVEY.md §7 hard parts): collectives are compiler-scheduled, so
+overlap comes from XLA's latency-hiding scheduler.  What *is* preserved is
+every observable option of the reference wrapper:
+
+- ``message_size``        — bucket granularity (elements) for chunked psum,
+                            letting XLA interleave collectives with the
+                            backward's tail (distributed.py:162-171),
+- ``delay_allreduce``     — one fused allreduce after backward (:148-158),
+- ``allreduce_always_fp32`` — upcast half grads before the collective
+                            (:383-396),
+- ``gradient_average``    — divide by world size after (:391-393),
+- ``gradient_predivide_factor`` — pre/post divide split for fp16 range
+                            control (:386-393),
+- ``retain_allreduce_buffers`` — expose the flat reduced buckets.
+
+Usage inside a shard_map/pmap'd step over axis ``data``::
+
+    ddp = DistributedDataParallel(model)          # wrapper parity
+    ...
+    grads = ddp.allreduce_grads(grads)            # inside the mapped fn
+
+or functionally via ``allreduce_grads_tree(grads, axis_name='data')``.
+``DistributedDataParallel.make_step`` builds a whole shard_map'd train step
+over a 1-D mesh for the common data-parallel case.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
+           "flat_dist_call"]
+
+
+def _axis_size(axis_name: str) -> jax.Array:
+    return lax.psum(jnp.ones((), jnp.float32), axis_name)
+
+
+def allreduce_grads_tree(grads: Any, axis_name: str = "data",
+                         message_size: int = 10_000_000,
+                         allreduce_always_fp32: bool = False,
+                         gradient_average: bool = True,
+                         gradient_predivide_factor: float = 1.0,
+                         delay_allreduce: bool = False,
+                         axis_index_groups: Optional[List[List[int]]] = None,
+                         retain_buffers: Optional[list] = None) -> Any:
+    """Bucketed gradient allreduce with the reference's semantics
+    (allreduce_bucket, distributed.py:378-398).  Must run inside a context
+    where ``axis_name`` is a mapped mesh axis."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+
+    # dtype-split buckets, like split_half_float_double (distributed.py:51-58)
+    groups: Dict[Any, List[int]] = {}
+    for i, g in enumerate(leaves):
+        groups.setdefault(jnp.dtype(g.dtype), []).append(i)
+
+    world = _axis_size(axis_name)
+    if axis_index_groups is not None:
+        world = jnp.asarray(float(len(axis_index_groups[0])), jnp.float32)
+
+    new_leaves: List[Any] = [None] * len(leaves)
+    for dt, idxs in groups.items():
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        comm = flat.astype(jnp.float32) if allreduce_always_fp32 else flat
+        if gradient_predivide_factor != 1.0:
+            comm = comm / jnp.asarray(gradient_predivide_factor, comm.dtype)
+
+        n = comm.shape[0]
+        if delay_allreduce or n <= message_size:
+            reduced = lax.psum(comm, axis_name,
+                               axis_index_groups=axis_index_groups)
+        else:
+            # chunked psum: XLA schedules the pieces independently, which
+            # is the compiler-native form of the reference's bucket overlap
+            nchunks = math.ceil(n / message_size)
+            pad = nchunks * message_size - n
+            padded = jnp.pad(comm, (0, pad))
+            chunks = padded.reshape(nchunks, message_size)
+            reduced = lax.psum(chunks, axis_name,
+                               axis_index_groups=axis_index_groups)
+            reduced = reduced.reshape(-1)[:n]
+
+        if gradient_average:
+            post = world / gradient_predivide_factor if \
+                gradient_predivide_factor != 1.0 else world
+            reduced = reduced / post.astype(reduced.dtype)
+        reduced = reduced.astype(dt)
+        if retain_buffers is not None:
+            retain_buffers.append(reduced)
+        off = 0
+        for i in idxs:
+            sz = leaves[i].size
+            new_leaves[i] = reduced[off:off + sz].reshape(leaves[i].shape)
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def flat_dist_call(tree: Any, axis_name: str = "data", op: str = "psum",
+                   axis_index_groups=None) -> Any:
+    """apply_flat_dist_call parity (distributed.py:36-49): one collective
+    per dtype group over the flattened tree."""
+    reducer = {"psum": lax.psum, "pmean": lax.pmean, "pmax": lax.pmax,
+               "pmin": lax.pmin}[op]
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: Dict[Any, List[int]] = {}
+    for i, g in enumerate(leaves):
+        groups.setdefault(jnp.dtype(g.dtype), []).append(i)
+    out: List[Any] = [None] * len(leaves)
+    for dt, idxs in groups.items():
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        red = reducer(flat, axis_name, axis_index_groups=axis_index_groups)
+        off = 0
+        for i in idxs:
+            sz = leaves[i].size
+            out[i] = red[off:off + sz].reshape(leaves[i].shape)
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class DistributedDataParallel:
+    """Model wrapper with the reference's constructor surface
+    (distributed.py:129-171)."""
+
+    def __init__(self, module=None, message_size: int = 10_000_000,
+                 delay_allreduce: bool = False,
+                 shared_param: Optional[bool] = None,
+                 allreduce_trigger_params: Optional[list] = None,
+                 retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 axis_name: str = "data"):
+        if shared_param is not None:
+            raise ValueError("shared_param is deprecated (reference "
+                             "distributed.py:176-180)")
+        self.module = module
+        self.message_size = int(message_size)
+        self.delay_allreduce = delay_allreduce
+        self.allreduce_trigger_params = allreduce_trigger_params
+        self.retain_allreduce_buffers = retain_allreduce_buffers
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.axis_name = axis_name
+        self.allreduce_buffers: list = []
+
+    # -- forward passthrough (wrapper parity) ------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.module(*args, **kwargs)
+
+    def apply(self, *args, **kwargs):
+        return self.module.apply(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.module, name)
+
+    # -- the hot path ------------------------------------------------------
+    def allreduce_grads(self, grads: Any,
+                        axis_index_groups: Optional[List[List[int]]] = None
+                        ) -> Any:
+        retain = [] if self.retain_allreduce_buffers else None
+        out = allreduce_grads_tree(
+            grads, axis_name=self.axis_name, message_size=self.message_size,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_average=self.gradient_average,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            delay_allreduce=self.delay_allreduce,
+            axis_index_groups=axis_index_groups,
+            retain_buffers=retain)
+        if retain is not None:
+            self.allreduce_buffers = retain
+        return out
+
+    # -- whole-step builder for the common 1-D data-parallel mesh ---------
+    def make_step(self, step_fn: Callable, mesh: Optional[Mesh] = None,
+                  donate_state: bool = True) -> Callable:
+        """shard_map ``step_fn(state..., batch) -> (state..., aux)`` over a
+        1-D mesh: replicated state, batch sharded on axis 0.  ``step_fn``
+        runs per-device and should call ``self.allreduce_grads`` on its
+        gradient tree (param broadcast from rank 0 is implicit: replicated
+        inputs to shard_map stay replicated, the analogue of the init-time
+        broadcast at distributed.py:234)."""
+        if mesh is None:
+            mesh = Mesh(jax.devices(), (self.axis_name,))
+        an = self.axis_name
+
+        def wrapped(state, batch):
+            return step_fn(state, batch)
+
+        mapped = jax.shard_map(
+            wrapped, mesh=mesh,
+            in_specs=(P(), P(an)),
+            out_specs=(P(), P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,) if donate_state else ())
+
+
+class Reducer:
+    """Manual allreduce helper, parity with apex.parallel.Reducer
+    (distributed.py:89-126): call ``reduce(tree)`` inside a mapped context
+    to sum (and average) a pytree across the axis."""
+
+    def __init__(self, module_or_tree=None, axis_name: str = "data",
+                 gradient_average: bool = True):
+        self.module = module_or_tree
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+
+    def reduce(self, tree: Any) -> Any:
+        red = flat_dist_call(tree, self.axis_name, "psum")
+        if self.gradient_average:
+            world = _axis_size(self.axis_name)
+            red = jax.tree_util.tree_map(
+                lambda x: x / world.astype(x.dtype), red)
+        return red

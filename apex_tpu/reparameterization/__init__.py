@@ -1,0 +1,83 @@
+"""apex_tpu.reparameterization — weight reparameterization (weight norm).
+
+Reference: apex/reparameterization/{__init__.py,reparameterization.py,
+weight_norm.py} — a forward-pre-hook framework computing w = g * v/||v||.
+NOTE: the reference snapshot is *broken* (weight_norm.py:3 imports a
+``Fused_Weight_Norm`` that fp16_utils no longer exports; SURVEY.md §2.1);
+this implementation supplies the working equivalent: the norm is computed
+functionally at apply time, fused by XLA into the consumer matmul's
+prologue.
+
+``apply_weight_norm(module, name='weight', dim=0)`` wraps a module so its
+params tree stores (name_g, name_v) instead of ``name``;
+``remove_weight_norm`` bakes the current effective weight back in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.module import Module
+
+__all__ = ["WeightNorm", "apply_weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v: jax.Array, dim: int) -> jax.Array:
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def compute_weight(g: jax.Array, v: jax.Array, dim: int,
+                   eps: float = 0.0) -> jax.Array:
+    n = _norm_except_dim(v, dim)
+    return (g.astype(jnp.float32) * v.astype(jnp.float32) / (n + eps)
+            ).astype(v.dtype)
+
+
+class WeightNorm(Module):
+    """Wrapper module: v-direction + g-magnitude parameterization of one
+    of the inner module's params (reference weight_norm.py:39-78)."""
+
+    def __init__(self, inner: Module, name: str = "weight", dim: int = 0):
+        super().__init__()
+        self.inner = inner
+        self.param_name = name
+        self.dim = dim
+
+    def init(self, key):
+        params, state = self.inner.init(key)
+        inner_p = params.pop("inner", None)
+        if inner_p is None:
+            inner_p = params
+        w = inner_p.pop(self.param_name)
+        inner_p[self.param_name + "_v"] = w
+        inner_p[self.param_name + "_g"] = _norm_except_dim(w, self.dim)
+        return {"inner": inner_p}, state
+
+    def forward(self, params, *args, **kwargs):
+        p = dict(params["inner"])
+        g = p.pop(self.param_name + "_g")
+        v = p.pop(self.param_name + "_v")
+        p[self.param_name] = compute_weight(g, v, self.dim)
+        return self.inner(p, *args, **kwargs)
+
+
+def apply_weight_norm(module: Module, name: str = "weight", dim: int = 0
+                      ) -> WeightNorm:
+    """Wrap ``module`` with weight normalization on param ``name``
+    (reference reparameterization.py:56-102)."""
+    return WeightNorm(module, name, dim)
+
+
+def remove_weight_norm(wrapped: WeightNorm, params: dict) -> (Module, dict):
+    """Bake the effective weight back into a plain params tree
+    (reference reparameterization.py:127-137)."""
+    inner_p = dict(params["inner"])
+    g = inner_p.pop(wrapped.param_name + "_g")
+    v = inner_p.pop(wrapped.param_name + "_v")
+    inner_p[wrapped.param_name] = compute_weight(g, v, wrapped.dim)
+    return wrapped.inner, inner_p

@@ -1,0 +1,9 @@
+"""apex_tpu.transformer — attention, transformer blocks, and
+sequence/context parallelism (ring attention over the mesh).
+
+New capability relative to the 2019 reference (which has no attention,
+SURVEY.md §5): long-context support is first-class in apex_tpu.
+"""
+
+from .attention import dot_product_attention, MultiheadAttention
+from .ring_attention import ring_attention, ring_self_attention

@@ -1,0 +1,63 @@
+"""Attention primitives (policy-aware, MXU-shaped).
+
+The reference (2019 Apex) predates attention entirely (SURVEY.md §5:
+long-context is absent there).  apex_tpu treats long-context as
+first-class: this module provides the single-device attention core; the
+sequence-parallel forms (ring attention over a mesh axis) live in
+apex_tpu.transformer.ring_attention.
+
+The inner matmuls route through the amp policy ("dot_product_attention" is
+whitelisted → bf16 on the MXU) while the softmax runs in fp32 (blacklist),
+matching the reference's cast philosophy applied to a new op.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.module import Module, current_context
+from ..nn.layers import Linear, Dropout
+
+__all__ = ["dot_product_attention", "MultiheadAttention"]
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          scale: Optional[float] = None) -> jax.Array:
+    """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = F.matmul(q, jnp.swapaxes(k, -1, -2)).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    return F.matmul(probs.astype(v.dtype), v)
+
+
+class MultiheadAttention(Module):
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 bias: bool = True):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.qkv = Linear(embed_dim, 3 * embed_dim, bias=bias)
+        self.out = Linear(embed_dim, embed_dim, bias=bias)
+        self.drop = Dropout(dropout)
+
+    def forward(self, params, x, mask: Optional[jax.Array] = None):
+        B, T, E = x.shape
+        qkv = self.qkv(params["qkv"], x)
+        qkv = qkv.reshape(B, T, 3, self.num_heads, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        ctx = dot_product_attention(q, k, v, mask)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        ctx = self.drop(params.get("drop", {}), ctx)
+        return self.out(params["out"], ctx)

@@ -1,0 +1,93 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context support is first-class in apex_tpu (the 2019 reference has
+none — SURVEY.md §5).  Sequence is sharded across the ``sp`` mesh axis;
+each device holds a (B, H, T/n, D) slice of q/k/v.  K/V blocks rotate
+around the ring via ``lax.ppermute`` (ICI neighbor exchange) while each
+device accumulates flash-attention-style online-softmax statistics
+(running max m, normalizer l, weighted accumulator acc) — so the full
+T×T score matrix never materializes and memory stays O(T/n · T/n) per
+step.  XLA overlaps the ppermute DMA of step i+1's block with step i's
+matmuls, which is the point of the ring formulation on TPU.
+
+Use inside shard_map/pmap with the sequence axis mapped::
+
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """q, k, v: (B, H, T_local, D) per-device slices; returns the exact
+    attention output for the local queries against the *global* sequence."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+
+    q32 = q.astype(jnp.float32) * scale
+    perm = None  # built lazily: static python list needs concrete axis size
+
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+
+    q_pos = my * Tq + jnp.arange(Tq)
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - i) % n  # whose kv block we hold at step i
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            kv_pos = src * Tk + jnp.arange(Tk)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        # fully-masked rows keep m=-inf; guard the exp
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(scores - safe_m)
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        new_acc = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        # rotate kv to the next ring neighbor over ICI
+        nxt = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, nxt)
+        v_blk = lax.ppermute(v_blk, axis_name, nxt)
+        return k_blk, v_blk, new_m, new_l, new_acc
+
+    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
+                        num_heads: int, axis_name: str = "sp",
+                        causal: bool = False) -> jax.Array:
+    """Convenience fused qkv-projection + ring attention + output proj for
+    (B, T_local, E) sequence-sharded activations."""
+    B, T, E = x.shape
+    hd = E // num_heads
+    qkv = jnp.einsum("bte,fe->btf", x, wqkv)
+    qkv = qkv.reshape(B, T, 3, num_heads, hd)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+    ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+    return jnp.einsum("bte,fe->btf", ctx, wo)

@@ -1,0 +1,42 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+
+Mirrors the strategy SURVEY.md §4 prescribes: multi-device behavior
+(DDP psum, SyncBatchNorm stat merge, mesh dryruns) is validated on a faked
+host-platform mesh — something the reference could not do (it needed 2 real
+GPUs, tests/L1/cross_product_distributed/run.sh).
+"""
+
+import os
+
+# Tests always run on the virtual CPU mesh.  jax may already be imported
+# with a TPU plugin registered (the environment's sitecustomize does this
+# at interpreter startup), so flip the platform via jax.config — effective
+# as long as no backend has been initialized yet — and force 8 host
+# devices before the first jax.devices() call.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", (
+    "tests must run on the CPU mesh; a TPU backend was already initialized "
+    "before conftest ran")
+assert len(jax.devices()) >= 8
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from jax.sharding import Mesh
+    import numpy as np
+    return Mesh(np.array(jax.devices()[:8]), ("data",))

@@ -1,0 +1,207 @@
+"""Cast-policy tests — mirror the reference's tests/L0/run_amp
+(test_basic_casts.py run_layer_test pattern, test_promotion.py, banned
+functions, disabled-amp passthrough)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import apex_tpu
+from apex_tpu import amp, nn
+from apex_tpu.amp import policy as P
+from apex_tpu.nn import functional as F
+
+
+@pytest.fixture(autouse=True)
+def reset_policy():
+    yield
+    P.set_policy(P.NoPolicy())
+
+
+def with_o1(half=jnp.float16):
+    return P.use_policy(P.CastPolicy(half))
+
+
+# -- whitelist: gemms cast to half (test_basic_casts.py:14-40) -------------
+
+def test_linear_casts_to_half():
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((3, 4), jnp.float32)
+    with with_o1():
+        out = F.linear(x, w)
+    assert out.dtype == jnp.float16
+
+
+def test_matmul_casts_to_half():
+    a = jnp.ones((2, 4))
+    b = jnp.ones((4, 2))
+    with with_o1(jnp.bfloat16):
+        out = F.matmul(a, b)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_conv2d_casts_to_half():
+    x = jnp.ones((1, 3, 8, 8))
+    w = jnp.ones((4, 3, 3, 3))
+    with with_o1():
+        out = F.conv2d(x, w, padding=1)
+    assert out.dtype == jnp.float16
+
+
+# -- blacklist: softmax & friends in fp32 ----------------------------------
+
+def test_softmax_casts_to_fp32():
+    x = jnp.ones((2, 4), jnp.float16)
+    with with_o1():
+        out = F.softmax(x)
+    assert out.dtype == jnp.float32
+
+
+def test_loss_fp32():
+    logits = jnp.ones((2, 4), jnp.float16)
+    labels = jnp.zeros((2,), jnp.int32)
+    with with_o1():
+        loss = F.cross_entropy(logits, labels)
+    assert loss.dtype == jnp.float32
+
+
+# -- promote: widest type wins (test_promotion.py) -------------------------
+
+def test_add_promotes_to_widest():
+    a = jnp.ones((2,), jnp.float16)
+    b = jnp.ones((2,), jnp.float32)
+    with with_o1():
+        out = F.add(a, b)
+    assert out.dtype == jnp.float32
+
+
+def test_cat_promotes_sequence():
+    a = jnp.ones((2,), jnp.float16)
+    b = jnp.ones((2,), jnp.float32)
+    with with_o1():
+        out = F.cat([a, b])
+    assert out.dtype == jnp.float32
+
+
+# -- banned ops raise with actionable message ------------------------------
+
+def test_binary_cross_entropy_banned():
+    p = jnp.asarray([0.5, 0.5], jnp.float16)
+    y = jnp.asarray([1.0, 0.0], jnp.float16)
+    with with_o1():
+        with pytest.raises(NotImplementedError,
+                           match="binary_cross_entropy_with_logits"):
+            F.binary_cross_entropy(p, y)
+
+
+def test_banned_op_ok_with_disabled_casts():
+    p = jnp.asarray([0.5, 0.5], jnp.float32)
+    y = jnp.asarray([1.0, 0.0], jnp.float32)
+    with with_o1():
+        with amp.disable_casts():
+            loss = F.binary_cross_entropy(p, y)
+    assert np.isfinite(float(loss))
+
+
+# -- no policy: passthrough (test_basic_casts.py:140-158) ------------------
+
+def test_disabled_passthrough():
+    x = jnp.ones((2, 4), jnp.float16)
+    w = jnp.ones((3, 4), jnp.float16)
+    out = F.linear(x, w)
+    assert out.dtype == jnp.float16
+    x32 = jnp.ones((2, 4), jnp.float32)
+    out = F.linear(x32, w.astype(jnp.float32))
+    assert out.dtype == jnp.float32
+
+
+# -- user registries (apex.amp.amp:30-64) ----------------------------------
+
+def test_register_float_function_moves_category():
+    from apex_tpu.amp import lists
+    assert lists.classify("matmul") == "half"
+    amp.register_float_function("matmul")
+    try:
+        a = jnp.ones((2, 2))
+        with with_o1():
+            out = F.matmul(a, a)
+        assert out.dtype == jnp.float32
+    finally:
+        amp.register_half_function("matmul")
+
+
+def test_half_function_decorator():
+    @amp.half_function
+    def my_op(x):
+        return x * 2
+
+    x = jnp.ones((2,), jnp.float32)
+    assert my_op(x).dtype == jnp.float32  # no policy: passthrough
+    with with_o1():
+        assert my_op(x).dtype == jnp.float16
+
+
+# -- O2 param casting keeps batchnorm fp32 ---------------------------------
+
+class ConvBN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 8, 3, padding=1, bias=False)
+        self.bn = nn.BatchNorm2d(8)
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, p, x):
+        h = self.bn(p["bn"], self.conv(p["conv"], x))
+        h = F.adaptive_avg_pool2d(F.relu(h), 1).reshape(x.shape[0], -1)
+        return self.fc(p["fc"], h)
+
+
+def test_o2_keeps_bn_fp32():
+    model = ConvBN()
+    amodel, aopt = amp.initialize(model, apex_tpu.optimizers.SGD(0.1),
+                                  opt_level="O2", verbosity=0)
+    params, state = amodel.init(jax.random.PRNGKey(0))
+    assert params["conv"]["weight"].dtype == jnp.bfloat16
+    assert params["fc"]["weight"].dtype == jnp.bfloat16
+    assert params["bn"]["weight"].dtype == jnp.float32
+    out, _ = amodel.apply(params, jnp.ones((2, 3, 8, 8)), state=state)
+    # O2 casts outputs back to fp32 (reference _initialize.py:197-208)
+    assert out.dtype == jnp.float32
+
+
+def test_o3_casts_everything():
+    model = ConvBN()
+    amodel = amp.initialize(model, opt_level="O3", verbosity=0,
+                            half_dtype="float16")
+    params, _ = amodel.init(jax.random.PRNGKey(0))
+    assert params["bn"]["weight"].dtype == jnp.float16
+
+
+def test_o0_everything_fp32():
+    model = ConvBN()
+    amodel = amp.initialize(model, opt_level="O0", verbosity=0)
+    params, state = amodel.init(jax.random.PRNGKey(0))
+    assert params["conv"]["weight"].dtype == jnp.float32
+    out, _ = amodel.apply(params, jnp.ones((2, 3, 8, 8)), state=state)
+    assert out.dtype == jnp.float32
+
+
+def test_initialize_twice_raises():
+    model = ConvBN()
+    amodel = amp.initialize(model, opt_level="O1", verbosity=0)
+    with pytest.raises(RuntimeError, match="only once"):
+        amp.initialize(amodel, opt_level="O1", verbosity=0)
+
+
+def test_properties_string_coercion():
+    props = amp.Properties()
+    props.options["opt_level"] = "O2"
+    props.loss_scale = "128.0"
+    assert props.loss_scale == 128.0
+    props.loss_scale = "dynamic"
+    assert props.loss_scale == "dynamic"
+    props.keep_batchnorm_fp32 = "True"
+    assert props.keep_batchnorm_fp32 is True
+    with pytest.raises(ValueError):
+        props.keep_batchnorm_fp32 = "yes"

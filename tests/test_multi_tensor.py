@@ -1,0 +1,111 @@
+"""Multi-tensor op fuzz tests — the harness of the reference's
+tests/L0/run_amp/test_multi_tensor_scale.py:88-121 (sizes x dtypes x
+overflow injection at first/last/middle element)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_scale, multi_tensor_axpby, multi_tensor_l2norm,
+    global_grad_norm, flatten, unflatten, TreeFlattener)
+
+SIZES = [7, 777, 4096, 2048 * 32 + 1]
+DTYPES = [jnp.float32, jnp.float16, jnp.bfloat16]
+
+
+def _mk(sizes, dtype, fill=4.0):
+    return [jnp.full((s,), fill, dtype) for s in sizes]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("scale", [1.0, 4.0, 1 / 3.0])
+def test_scale_values(dtype, scale):
+    xs = _mk(SIZES, dtype)
+    out, flag = multi_tensor_scale(xs, scale)
+    assert float(flag) == 0.0
+    for o, x in zip(out, xs):
+        assert o.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(x, np.float32) * scale,
+            rtol=2e-2 if dtype != jnp.float32 else 1e-6)
+
+
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+@pytest.mark.parametrize("pos", ["first", "mid", "last"])
+@pytest.mark.parametrize("which_tensor", [0, 2])
+def test_scale_overflow_injection(bad, pos, which_tensor):
+    xs = [np.full((s,), 1.0, np.float32) for s in SIZES]
+    idx = {"first": 0, "mid": SIZES[which_tensor] // 2,
+           "last": SIZES[which_tensor] - 1}[pos]
+    xs[which_tensor][idx] = bad
+    xs = [jnp.asarray(x) for x in xs]
+    _, flag = multi_tensor_scale(xs, 1.0)
+    assert float(flag) == 1.0
+
+
+def test_axpby_values_and_argcheck():
+    x = [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0])]
+    y = [jnp.asarray([10.0, 20.0]), jnp.asarray([30.0])]
+    out, flag = multi_tensor_axpby(2.0, 0.5, x, y)
+    np.testing.assert_allclose(np.asarray(out[0]), [7.0, 14.0])
+    assert float(flag) == 0.0
+
+    xb = [jnp.asarray([1.0, jnp.nan]), jnp.asarray([3.0])]
+    _, flag = multi_tensor_axpby(1.0, 1.0, xb, y, arg_to_check=0)
+    assert float(flag) == 1.0
+    _, flag = multi_tensor_axpby(1.0, 1.0, x, xb, arg_to_check=0)
+    assert float(flag) == 0.0  # only x checked
+    _, flag = multi_tensor_axpby(1.0, 1.0, x, xb, arg_to_check=-1)
+    assert float(flag) == 1.0  # both checked
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_l2norm(dtype):
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(s).astype(np.float32) for s in SIZES]
+    ref_per = np.array([np.linalg.norm(x) for x in xs], np.float32)
+    ref_total = np.sqrt((ref_per ** 2).sum())
+    jx = [jnp.asarray(x, dtype) for x in xs]
+    total, per = multi_tensor_l2norm(jx, per_tensor=True)
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(float(total), ref_total, rtol=tol)
+    np.testing.assert_allclose(np.asarray(per), ref_per, rtol=tol)
+
+
+def test_global_grad_norm_overflow_convention():
+    ok = {"a": jnp.asarray([3.0, 4.0])}
+    assert abs(float(global_grad_norm(ok)) - 5.0) < 1e-6
+    bad = {"a": jnp.asarray([3.0, jnp.inf])}
+    assert float(global_grad_norm(bad)) == -1.0
+
+
+def test_flatten_unflatten_roundtrip():
+    xs = [jnp.arange(5, dtype=jnp.float32),
+          jnp.arange(6, dtype=jnp.float32).reshape(2, 3)]
+    flat = flatten(xs)
+    assert flat.shape == (11,)
+    back = unflatten(flat, xs)
+    for a, b in zip(back, xs):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flatten_dtype_mismatch_raises():
+    with pytest.raises(TypeError):
+        flatten([jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float16)])
+
+
+def test_tree_flattener_groups_by_dtype():
+    tree = {"a": jnp.zeros((2, 2), jnp.float32),
+            "b": jnp.zeros((3,), jnp.float16),
+            "c": jnp.ones((4,), jnp.float32)}
+    tf = TreeFlattener(tree)
+    bufs = tf.pack(tree)
+    assert set(bufs) == {jnp.dtype(jnp.float32), jnp.dtype(jnp.float16)}
+    assert bufs[jnp.dtype(jnp.float32)].shape == (8,)
+    back = tf.unpack(bufs)
+    assert jax.tree_util.tree_structure(back) == \
+        jax.tree_util.tree_structure(tree)
+    np.testing.assert_array_equal(np.asarray(back["c"]), np.ones(4))
