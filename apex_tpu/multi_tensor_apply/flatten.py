@@ -16,7 +16,36 @@ from typing import Any, Dict, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flatten", "unflatten", "split_by_dtype", "TreeFlattener"]
+__all__ = ["flatten", "unflatten", "split_by_dtype", "TreeFlattener",
+           "pack_flat", "unpack_flat"]
+
+
+def pack_flat(tree: Any, dtype=None) -> Tuple[jax.Array, list, Any]:
+    """Concatenate tree leaves into one flat buffer (optionally casting).
+    Returns (flat, leaves, treedef); empty trees give a 0-length buffer.
+    The single flatten helper shared by the fused optimizers and the
+    Pallas kernel family."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return jnp.zeros((0,), dtype or jnp.float32), leaves, treedef
+    parts = [l.reshape(-1) if dtype is None else
+             l.reshape(-1).astype(dtype) for l in leaves]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return flat, leaves, treedef
+
+
+def unpack_flat(flat: jax.Array, like_leaves: Sequence[jax.Array], treedef,
+                cast_like: bool = True) -> Any:
+    """Inverse of pack_flat against reference leaves + treedef."""
+    out, off = [], 0
+    for l in like_leaves:
+        n = int(l.size)
+        piece = flat[off:off + n].reshape(l.shape)
+        if cast_like:
+            piece = piece.astype(l.dtype)
+        out.append(piece)
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def flatten(tensors: Sequence[jax.Array]) -> jax.Array:

@@ -133,6 +133,7 @@ def layer_norm(x: jax.Array, normalized_shape: Sequence[int],
     axes = tuple(range(x.ndim - len(tuple(normalized_shape)), x.ndim))
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axes, keepdims=True)
+    # shifted two-pass variance avoids E[x^2]-mean^2 cancellation
     var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
     y = (x32 - mean) * lax.rsqrt(var + eps)
     if weight is not None:
@@ -144,13 +145,19 @@ def layer_norm(x: jax.Array, normalized_shape: Sequence[int],
 
 def batch_norm_stats(x: jax.Array, axes: Tuple[int, ...]
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-channel (count, mean, biased var) in fp32 over ``axes``."""
+    """Per-channel (count, mean, biased var) in fp32 over ``axes``.
+    Shifted two-pass variance (no E[x^2]-mean^2 cancellation) — the local
+    half of the reference's Welford stats (csrc/welford.cu:259-294)."""
     x32 = x.astype(jnp.float32)
     n = 1
     for a in axes:
         n *= x.shape[a]
     mean = jnp.mean(x32, axis=axes)
-    var = jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean)
+    shape = [1] * x.ndim
+    for a in range(x.ndim):
+        if a not in axes:
+            shape[a] = x.shape[a]
+    var = jnp.mean(jnp.square(x32 - mean.reshape(shape)), axis=axes)
     return jnp.asarray(n, jnp.float32), mean, var
 
 

@@ -37,10 +37,12 @@ def _norm_axes(x, normalized_shape):
 
 
 def _fwd_stats(x2: jax.Array, eps: float) -> Tuple[jax.Array, jax.Array]:
-    """Per-row fp32 (mean, invvar) on the (n1, n2) view."""
+    """Per-row fp32 (mean, invvar) on the (n1, n2) view.  Shifted two-pass
+    variance: numerically equivalent to the reference's Welford pass
+    (layer_norm_cuda_kernel.cu:11-50) without E[x^2]-mean^2 cancellation."""
     x32 = x2.astype(jnp.float32)
     mean = jnp.mean(x32, axis=1)
-    var = jnp.mean(jnp.square(x32), axis=1) - jnp.square(mean)
+    var = jnp.mean(jnp.square(x32 - mean[:, None]), axis=1)
     invvar = lax.rsqrt(var + eps)
     return mean, invvar
 

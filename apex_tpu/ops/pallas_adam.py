@@ -1,0 +1,98 @@
+"""Pallas fused Adam kernel.
+
+Equivalent of csrc/fused_adam_cuda_kernel.cu:15-55: one pass over the flat
+(p, m, v, g) buffers computing the scaled-grad Adam update, with the
+optional half-precision parameter write-out (p_copy, :94-115) fused into
+the same pass.  Bias correction is folded into ``step_size`` host-side
+(:83-91), matching the reference.
+
+Inputs are fp32 flat buffers viewed as (rows, 128); p/m/v are updated via
+``input_output_aliases`` so the kernel is in-place on device memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_common import (BLOCK_ROWS, LANES, from_2d, interpret, to_2d)
+
+
+def _adam_kernel(scal_ref, p_ref, m_ref, v_ref, g_ref,
+                 p_out, m_out, v_out, *half_out, beta1, beta2, eps,
+                 eps_inside_sqrt, weight_decay, half_dtype):
+    step_size = scal_ref[0, 0]
+    inv_scale = scal_ref[0, 1]
+    g = g_ref[:].astype(jnp.float32) * inv_scale
+    p = p_ref[:]
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    if eps_inside_sqrt:
+        denom = jnp.sqrt(v + eps)
+    else:
+        denom = jnp.sqrt(v) + eps
+    update = m / denom + weight_decay * p
+    new_p = p - step_size * update
+    p_out[:] = new_p
+    m_out[:] = m
+    v_out[:] = v
+    if half_dtype is not None:
+        # the fp16/bf16 parameter write-out fused into the same pass
+        # (the reference kernel's p_copy, fused_adam_cuda_kernel.cu:94-115)
+        half_out[0][:] = new_p.astype(half_dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("beta1", "beta2", "eps", "eps_inside_sqrt",
+                              "weight_decay", "half_dtype"))
+def _adam_flat(p, m, v, g, step_size, combined_scale, *, beta1, beta2, eps,
+               eps_inside_sqrt, weight_decay, half_dtype):
+    p2, n = to_2d(p)
+    m2, _ = to_2d(m)
+    v2, _ = to_2d(v)
+    g2, _ = to_2d(g)
+    rows = p2.shape[0]
+    grid = rows // BLOCK_ROWS
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    scal = jnp.stack([jnp.asarray(step_size, jnp.float32),
+                      1.0 / jnp.asarray(combined_scale, jnp.float32)]
+                     ).reshape(1, 2)
+    out_specs = [blk(), blk(), blk()]
+    out_shape = [jax.ShapeDtypeStruct((rows, LANES), jnp.float32)] * 3
+    if half_dtype is not None:
+        out_specs.append(blk())
+        out_shape.append(jax.ShapeDtypeStruct((rows, LANES), half_dtype))
+    outs = pl.pallas_call(
+        functools.partial(_adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
+                          eps_inside_sqrt=eps_inside_sqrt,
+                          weight_decay=weight_decay, half_dtype=half_dtype),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), blk()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+        interpret=interpret(),
+    )(scal, p2, m2, v2, g2)
+    new_p2, new_m2, new_v2 = outs[:3]
+    half = from_2d(outs[3], n) if half_dtype is not None else None
+    return from_2d(new_p2, n), from_2d(new_m2, n), from_2d(new_v2, n), half
+
+
+def fused_adam(p, m, v, g, step_size, combined_scale, beta1, beta2, eps,
+               eps_inside_sqrt, weight_decay, half_dtype=None
+               ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                          Optional[jax.Array]]:
+    """Flat-buffer fused Adam step; signature mirrors the jnp reference
+    path in apex_tpu.optimizers.fused_adam._adam_kernel."""
+    return _adam_flat(p, m, v, g, step_size, combined_scale,
+                      beta1=float(beta1), beta2=float(beta2), eps=float(eps),
+                      eps_inside_sqrt=bool(eps_inside_sqrt),
+                      weight_decay=float(weight_decay),
+                      half_dtype=half_dtype)

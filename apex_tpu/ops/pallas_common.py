@@ -1,0 +1,48 @@
+"""Shared plumbing for the Pallas kernel family.
+
+The reference's multi_tensor_apply harness (csrc/multi_tensor_apply.cuh:
+40-126) exists to smuggle tensor addresses into 4KB CUDA kernel-arg
+structs, chunking and relaunching as the struct fills.  TPU has no such
+constraint: the tensor list is concatenated into one flat buffer on device
+(a fusion XLA performs as pure data movement) and each kernel tiles over a
+2-D (rows, 128) view of it — lanes fixed at 128, row blocks sized for VMEM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor_apply.flatten import pack_flat, unpack_flat  # noqa: F401
+# (re-exported: the kernels' flatten plumbing is the shared helper in
+# multi_tensor_apply.flatten — one implementation, three call sites)
+
+LANES = 128
+# rows per grid block: 512 rows x 128 lanes x 4B = 256 KiB per buffer in
+# VMEM — small enough for several operands to co-reside, large enough to
+# amortize grid overhead
+BLOCK_ROWS = 512
+BLOCK_ELEMS = BLOCK_ROWS * LANES
+
+
+def interpret() -> bool:
+    from . import dispatch
+    return dispatch.interpret_mode()
+
+
+def to_2d(flat: jax.Array) -> Tuple[jax.Array, int]:
+    """Pad a 1-D buffer to a (rows, LANES) view, rows a multiple of
+    BLOCK_ROWS so every grid block is full.  Returns (arr2d, orig_len)."""
+    n = flat.shape[0]
+    rows = max(1, -(-n // LANES))
+    rows = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
+    padded = rows * LANES
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(rows, LANES), n
+
+
+def from_2d(arr2d: jax.Array, n: int) -> jax.Array:
+    return arr2d.reshape(-1)[:n]

@@ -1,0 +1,180 @@
+"""Pallas multi-tensor kernels: scale / axpby / l2norm over fused buffers.
+
+TPU-native equivalents of the reference's amp_C kernels, contracts per
+SURVEY.md §2.2:
+
+- scale  (csrc/multi_tensor_scale_kernel.cu:64-73): out = in * scale with
+  the overflow flag raised on any non-finite *input* — the fused
+  unscale+overflow-check of the loss scaler.
+- axpby  (csrc/multi_tensor_axpby_kernel.cu:67-84): out = a*x + b*y with
+  the finite check on x, y, or both.
+- l2norm (csrc/multi_tensor_l2norm_kernel.cu:47-114): fp32 global L2 norm
+  via partial sums and a cleanup reduction.
+
+Each kernel makes one pass over a (rows, 128) view of the fused buffer.
+The flag / norm accumulator is a single (1, 1) SMEM output revisited by
+every grid step — TPU grid iterations execute sequentially, so the
+read-modify-write accumulation replaces the reference's atomically-set
+``noop_gmem`` flag and two-kernel cleanup reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_common import (BLOCK_ROWS, LANES, from_2d, interpret, pack_flat,
+                            to_2d, unpack_flat)
+
+
+def _row_blk():
+    return pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _acc_blk():
+    # single (1,1) accumulator revisited by every grid step
+    return pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _scale_kernel(scale_ref, x_ref, out_ref, flag_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        flag_ref[0, 0] = 0.0
+    x = x_ref[:].astype(jnp.float32)
+    out_ref[:] = x * scale_ref[0, 0]
+    bad = jnp.where(jnp.all(jnp.isfinite(x)), 0.0, 1.0)
+    flag_ref[0, 0] = jnp.maximum(flag_ref[0, 0], bad)
+
+
+@functools.partial(jax.jit, static_argnames=("check_finite",))
+def _scale_flat(flat: jax.Array, scale: jax.Array, check_finite: bool = True
+                ) -> Tuple[jax.Array, jax.Array]:
+    x2, n = to_2d(flat)
+    rows = x2.shape[0]
+    grid = rows // BLOCK_ROWS
+    out2, flag = pl.pallas_call(
+        _scale_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _row_blk(),
+        ],
+        out_specs=[_row_blk(), _acc_blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret(),
+    )(jnp.asarray(scale, jnp.float32).reshape(1, 1), x2)
+    found_inf = flag[0, 0] if check_finite else jnp.zeros((), jnp.float32)
+    return from_2d(out2, n), found_inf
+
+
+def multi_tensor_scale(tree: Any, scale, check_finite: bool = True
+                       ) -> Tuple[Any, jax.Array]:
+    flat, leaves, treedef = pack_flat(tree, jnp.float32)
+    if not leaves:
+        return tree, jnp.zeros((), jnp.float32)
+    out, found_inf = _scale_flat(flat, jnp.asarray(scale, jnp.float32),
+                                 check_finite)
+    return unpack_flat(out, leaves, treedef), found_inf
+
+
+def _axpby_kernel(ab_ref, x_ref, y_ref, out_ref, flag_ref, *, arg_to_check):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        flag_ref[0, 0] = 0.0
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    out_ref[:] = ab_ref[0, 0] * x + ab_ref[0, 1] * y
+    if arg_to_check == 0:
+        finite = jnp.all(jnp.isfinite(x))
+    elif arg_to_check == 1:
+        finite = jnp.all(jnp.isfinite(y))
+    else:
+        finite = jnp.all(jnp.isfinite(x)) & jnp.all(jnp.isfinite(y))
+    flag_ref[0, 0] = jnp.maximum(flag_ref[0, 0],
+                                 jnp.where(finite, 0.0, 1.0))
+
+
+@functools.partial(jax.jit, static_argnames=("arg_to_check",))
+def _axpby_flat(xf, yf, a, b, arg_to_check):
+    x2, n = to_2d(xf)
+    y2, _ = to_2d(yf)
+    rows = x2.shape[0]
+    grid = rows // BLOCK_ROWS
+    out2, flag = pl.pallas_call(
+        functools.partial(_axpby_kernel, arg_to_check=arg_to_check),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _row_blk(),
+            _row_blk(),
+        ],
+        out_specs=[_row_blk(), _acc_blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret(),
+    )(jnp.asarray([a, b], jnp.float32).reshape(1, 2), x2, y2)
+    return from_2d(out2, n), flag[0, 0]
+
+
+def multi_tensor_axpby(a, b, x_tree: Any, y_tree: Any, arg_to_check: int = -1
+                       ) -> Tuple[Any, jax.Array]:
+    xf, leaves, treedef = pack_flat(x_tree, jnp.float32)
+    if not leaves:
+        return x_tree, jnp.zeros((), jnp.float32)
+    yf, _, _ = pack_flat(y_tree, jnp.float32)
+    out, found_inf = _axpby_flat(xf, yf, jnp.asarray(a, jnp.float32),
+                                 jnp.asarray(b, jnp.float32),
+                                 int(arg_to_check))
+    return unpack_flat(out, leaves, treedef), found_inf
+
+
+def _l2norm_kernel(x_ref, acc_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        acc_ref[0, 0] = 0.0
+    x = x_ref[:].astype(jnp.float32)
+    acc_ref[0, 0] += jnp.sum(x * x)
+
+
+@jax.jit
+def _l2norm_flat(flat: jax.Array) -> jax.Array:
+    x2, _ = to_2d(flat)
+    rows = x2.shape[0]
+    grid = rows // BLOCK_ROWS
+    acc = pl.pallas_call(
+        _l2norm_kernel,
+        grid=(grid,),
+        in_specs=[_row_blk()],
+        out_specs=_acc_blk(),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=interpret(),
+    )(x2)
+    return jnp.sqrt(acc[0, 0])
+
+
+def multi_tensor_l2norm(tree: Any, per_tensor: bool = False
+                        ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        z = jnp.zeros((), jnp.float32)
+        return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+    if per_tensor:
+        # per-leaf norms are plain XLA reductions (the reference's
+        # per-tensor output buffer, l2norm_kernel.cu:117-180); the global
+        # norm folds them
+        sq = jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves])
+        return jnp.sqrt(jnp.sum(sq)), jnp.sqrt(sq)
+    flat, _, _ = pack_flat(tree, jnp.float32)
+    return _l2norm_flat(flat), None

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from .base import Optimizer, resolve_lr
 from ..multi_tensor_apply import multi_tensor_l2norm
+from ..multi_tensor_apply.flatten import pack_flat, unpack_flat
 
 __all__ = ["FusedAdam", "AdamState"]
 
@@ -82,21 +83,6 @@ class FusedAdam(Optimizer):
         self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
 
-    # -- flat-buffer plumbing ---------------------------------------------
-    def _pack32(self, tree) -> jax.Array:
-        leaves = jax.tree_util.tree_leaves(tree)
-        return jnp.concatenate(
-            [l.reshape(-1).astype(jnp.float32) for l in leaves])
-
-    def _unpack_like(self, flat: jax.Array, like_tree) -> Any:
-        leaves, treedef = jax.tree_util.tree_flatten(like_tree)
-        out, off = [], 0
-        for l in leaves:
-            n = int(l.size)
-            out.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
-            off += n
-        return jax.tree_util.tree_unflatten(treedef, out)
-
     # -- Optimizer protocol ------------------------------------------------
     def init(self, params: Any) -> AdamState:
         n = sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
@@ -121,8 +107,8 @@ class FusedAdam(Optimizer):
         params in the same pass (the kernel's p_copy, :94-115).
         Returns (new_params, new_state[, half_params]).
         """
-        flat_g = self._pack32(grads)
-        flat_p = self._pack32(params)
+        flat_g, _, _ = pack_flat(grads, jnp.float32)
+        flat_p, p_leaves, p_treedef = pack_flat(params, jnp.float32)
 
         combined_scale = jnp.asarray(scale, jnp.float32)
         if self.max_grad_norm > 0:
@@ -148,7 +134,7 @@ class FusedAdam(Optimizer):
             beta1, beta2, self.eps, self.eps_inside_sqrt, self.weight_decay,
             output_params_dtype)
 
-        new_params = self._unpack_like(new_p, params)
+        new_params = unpack_flat(new_p, p_leaves, p_treedef)
         new_state = AdamState(step=t, m=new_m, v=new_v)
         if output_params_dtype is not None:
             return new_params, new_state, half

@@ -1,0 +1,160 @@
+"""Pallas-kernel vs jnp-path parity — the L1 philosophy of the reference
+(tests/L1/common/compare.py: extension path and Python path must agree)
+applied at the kernel level, via interpret mode on CPU.
+
+Marked slow: interpret mode executes the kernels element-by-element.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import dispatch
+from apex_tpu.ops import pallas_multi_tensor as pk
+from apex_tpu.ops import pallas_adam as pa
+from apex_tpu.ops import pallas_layer_norm as pln
+from apex_tpu.multi_tensor_apply import multi_tensor
+
+
+@pytest.fixture(autouse=True)
+def force_jnp_reference(monkeypatch):
+    # the reference path must not dispatch to pallas while we compare
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
+    yield
+
+
+def test_kernels_available():
+    assert dispatch.kernels_available()
+
+
+def test_pallas_scale_matches_jnp():
+    tree = {"a": jnp.asarray(np.random.RandomState(0).randn(777), jnp.float32),
+            "b": jnp.asarray(np.random.RandomState(1).randn(33, 5),
+                             jnp.float32)}
+    ref, ref_flag = multi_tensor.multi_tensor_scale(tree, 0.25)
+    out, flag = pk.multi_tensor_scale(tree, 0.25)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]),
+                                   rtol=1e-6)
+    assert float(flag) == float(ref_flag) == 0.0
+
+
+def test_pallas_scale_overflow_flag():
+    x = np.ones(300, np.float32)
+    x[123] = np.inf
+    _, flag = pk.multi_tensor_scale([jnp.asarray(x)], 1.0)
+    assert float(flag) == 1.0
+    x[123] = np.nan
+    _, flag = pk.multi_tensor_scale([jnp.asarray(x)], 1.0)
+    assert float(flag) == 1.0
+
+
+def test_pallas_axpby_matches_jnp():
+    rng = np.random.RandomState(2)
+    xt = [jnp.asarray(rng.randn(100), jnp.float32)]
+    yt = [jnp.asarray(rng.randn(100), jnp.float32)]
+    ref, _ = multi_tensor.multi_tensor_axpby(2.0, -0.5, xt, yt)
+    out, flag = pk.multi_tensor_axpby(2.0, -0.5, xt, yt)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               rtol=1e-6)
+    assert float(flag) == 0.0
+    ybad = [jnp.asarray(np.array([np.nan] + [0.0] * 99, np.float32))]
+    _, flag = pk.multi_tensor_axpby(1.0, 1.0, xt, ybad, arg_to_check=0)
+    assert float(flag) == 0.0
+    _, flag = pk.multi_tensor_axpby(1.0, 1.0, xt, ybad, arg_to_check=1)
+    assert float(flag) == 1.0
+
+
+def test_pallas_l2norm_matches_jnp():
+    rng = np.random.RandomState(3)
+    tree = [jnp.asarray(rng.randn(1000), jnp.float32),
+            jnp.asarray(rng.randn(77), jnp.float32)]
+    ref, _ = multi_tensor.multi_tensor_l2norm(tree)
+    out, _ = pk.multi_tensor_l2norm(tree)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+
+
+def test_pallas_adam_matches_jnp():
+    rng = np.random.RandomState(4)
+    n = 700
+    p = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(np.abs(rng.randn(n)) * 0.1, jnp.float32)
+    v = jnp.asarray(np.abs(rng.randn(n)) * 0.01, jnp.float32)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    args = dict(step_size=0.01, combined_scale=2.0, beta1=0.9, beta2=0.999,
+                eps=1e-8, eps_inside_sqrt=False, weight_decay=0.01)
+    # jnp reference (fused_adam._adam_kernel math)
+    gs = g / args["combined_scale"]
+    rm = args["beta1"] * m + 0.1 * gs
+    rv = args["beta2"] * v + 0.001 * gs * gs
+    denom = jnp.sqrt(rv) + args["eps"]
+    rp = p - args["step_size"] * (rm / denom + args["weight_decay"] * p)
+
+    np_, nm, nv, half = pa.fused_adam(p, m, v, g, **args,
+                                      half_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(np_), np.asarray(rp), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nm), np.asarray(rm), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(rv), rtol=1e-5)
+    assert half.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(half, np.float32),
+                               np.asarray(rp), rtol=1e-2)
+
+
+@pytest.mark.parametrize("shape,n2", [((10, 96), 96), ((9, 99), 99),
+                                      ((33, 256), 256)])
+def test_pallas_layer_norm_fwd_bwd_matches_jnp(shape, n2):
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    w = jnp.asarray(rng.randn(n2), jnp.float32)
+    b = jnp.asarray(rng.randn(n2), jnp.float32)
+    eps = 1e-5
+
+    # jnp reference (fused_layer_norm jnp path)
+    x32 = x.astype(jnp.float32)
+    mean_ref = jnp.mean(x32, axis=1)
+    var = jnp.mean(jnp.square(x32), axis=1) - mean_ref ** 2
+    inv_ref = 1.0 / jnp.sqrt(var + eps)
+    y_ref = (x32 - mean_ref[:, None]) * inv_ref[:, None] * w[None] + b[None]
+
+    y, mean, inv = pln.forward(x, w, b, eps)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(inv), np.asarray(inv_ref),
+                               atol=1e-4)
+
+    dy = jnp.asarray(rng.randn(*shape), jnp.float32)
+    xhat = (x32 - mean_ref[:, None]) * inv_ref[:, None]
+    dy_g = dy * w[None]
+    c1 = jnp.mean(dy_g, axis=1, keepdims=True)
+    c2 = jnp.mean(dy_g * xhat, axis=1, keepdims=True)
+    dx_ref = inv_ref[:, None] * (dy_g - c1 - xhat * c2)
+    dw_ref = jnp.sum(dy * xhat, axis=0)
+    db_ref = jnp.sum(dy, axis=0)
+
+    dx, dw, db = pln.backward(dy, x, w, b, mean, inv)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(db_ref), atol=1e-4)
+
+
+def test_layer_norm_large_mean_no_cancellation():
+    # rows with mean >> std: E[x^2]-mean^2 would be catastrophically wrong
+    rng = np.random.RandomState(7)
+    x_np = (5000.0 + 0.01 * rng.randn(8, 256)).astype(np.float32)
+    x = jnp.asarray(x_np)
+    y, mean, inv = pln.forward(x, None, None, 1e-5)
+    true_inv = 1.0 / np.sqrt(x_np.var(axis=1) + 1e-5)
+    np.testing.assert_allclose(np.asarray(inv), true_inv, rtol=0.05)
+    y_np = np.asarray(y)
+    np.testing.assert_allclose(y_np.std(axis=1), 1.0, rtol=0.1)
+
+
+def test_layer_norm_no_affine():
+    x = jnp.asarray(np.random.RandomState(6).randn(4, 64), jnp.float32)
+    y, mean, inv = pln.forward(x, None, None, 1e-5)
+    dy = jnp.ones_like(x)
+    dx, dw, db = pln.backward(dy, x, None, None, mean, inv)
+    assert dw is None and db is None
+    assert dx.shape == x.shape
