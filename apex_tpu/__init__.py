@@ -34,5 +34,6 @@ from . import fp16_utils
 from . import RNN
 from . import reparameterization
 from . import transformer
+from . import models
 
 __version__ = "0.1.0"

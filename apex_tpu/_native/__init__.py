@@ -1,0 +1,163 @@
+"""apex_tpu._native — ctypes bindings for the C++ host runtime.
+
+Loads libapex_tpu_C.so (built by build.sh / `python setup.py build_native`),
+auto-building it on first import when a compiler is available.  Every entry
+point has a numpy fallback, so a Python-only environment keeps working —
+the reference's graceful-degradation invariant (README.md:90-95) applied
+to the host runtime.
+
+API:
+  available() -> bool
+  flatten(list[np.ndarray]) -> np.ndarray           (apex_C.flatten)
+  unflatten(flat, like) -> list[np.ndarray]         (apex_C.unflatten)
+  plan_buckets(sizes, message_size) -> np.ndarray   (DDP bucket planner)
+  preprocess_images(u8_nhwc, mean, std) -> f32 nchw (input pipeline)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libapex_tpu_C.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    src = os.path.join(_HERE, "apex_tpu_C.cpp")
+    try:  # rebuild when the source is newer than the binary
+        return os.path.getmtime(src) > os.path.getmtime(_SO)
+    except OSError:
+        return False
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:  # don't shell out to the compiler on every call
+        return None
+    if _needs_build():
+        try:
+            subprocess.run(["bash", os.path.join(_HERE, "build.sh")],
+                           check=True, capture_output=True, timeout=120)
+        except Exception:
+            _load_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.apex_flatten.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_int64, ctypes.c_void_p]
+    lib.apex_unflatten.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)]
+    lib.apex_plan_buckets.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.apex_plan_buckets.restype = ctypes.c_int
+    lib.apex_preprocess_nhwc_u8_to_nchw_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.apex_native_version.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _try_load() is not None
+
+
+def flatten(tensors: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate same-dtype host arrays into one contiguous 1-D buffer."""
+    tensors = [np.ascontiguousarray(t) for t in tensors]
+    if not tensors:
+        return np.zeros((0,), np.float32)
+    dt = tensors[0].dtype
+    if any(t.dtype != dt for t in tensors):
+        raise TypeError("flatten() requires a same-dtype list")
+    total = sum(t.size for t in tensors)
+    lib = _try_load()
+    if lib is None:
+        return np.concatenate([t.reshape(-1) for t in tensors])
+    out = np.empty((total,), dt)
+    n = len(tensors)
+    srcs = (ctypes.c_void_p * n)(
+        *[t.ctypes.data_as(ctypes.c_void_p) for t in tensors])
+    sizes = (ctypes.c_int64 * n)(*[t.size for t in tensors])
+    lib.apex_flatten(srcs, sizes, n, dt.itemsize,
+                     out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unflatten(flat: np.ndarray, like: Sequence[np.ndarray]
+              ) -> List[np.ndarray]:
+    flat = np.ascontiguousarray(flat)
+    lib = _try_load()
+    outs = [np.empty(t.shape, flat.dtype) for t in like]
+    if lib is None:
+        off = 0
+        for o in outs:
+            o[...] = flat[off:off + o.size].reshape(o.shape)
+            off += o.size
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(
+        *[o.ctypes.data_as(ctypes.c_void_p) for o in outs])
+    sizes = (ctypes.c_int64 * n)(*[o.size for o in outs])
+    lib.apex_unflatten(flat.ctypes.data_as(ctypes.c_void_p), sizes, n,
+                       flat.dtype.itemsize, dsts)
+    return outs
+
+
+def plan_buckets(sizes: Sequence[int], message_size: int) -> np.ndarray:
+    """Greedy in-order bucket ids (DDP bucketing, distributed.py:338-361)."""
+    sizes = np.asarray(list(sizes), np.int64)
+    lib = _try_load()
+    if lib is None:
+        ids = np.zeros(len(sizes), np.int32)
+        bucket = filled = 0
+        for i, s in enumerate(sizes):
+            ids[i] = bucket
+            filled += int(s)
+            if filled >= message_size:
+                bucket += 1
+                filled = 0
+        return ids
+    ids = np.zeros(len(sizes), np.int32)
+    lib.apex_plan_buckets(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(sizes),
+        message_size, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return ids
+
+
+def preprocess_images(images_u8: np.ndarray, mean: Sequence[float],
+                      std: Sequence[float]) -> np.ndarray:
+    """NHWC uint8 -> normalized NCHW float32 on host threads."""
+    images_u8 = np.ascontiguousarray(images_u8)
+    n, h, w, c = images_u8.shape
+    lib = _try_load()
+    if lib is None:
+        f = images_u8.astype(np.float32)
+        f = (f - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+        return np.ascontiguousarray(f.transpose(0, 3, 1, 2))
+    out = np.empty((n, c, h, w), np.float32)
+    mean_c = (ctypes.c_float * c)(*[float(m) for m in mean])
+    std_c = (ctypes.c_float * c)(*[float(s) for s in std])
+    lib.apex_preprocess_nhwc_u8_to_nchw_f32(
+        images_u8.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), n, h, w, c, mean_c, std_c)
+    return out

@@ -1,0 +1,186 @@
+// apex_tpu_C: native host runtime for apex_tpu.
+//
+// TPU-native counterpart of the reference's apex_C extension
+// (csrc/flatten_unflatten.cpp:5-13) plus the host-side pieces that matter
+// on TPU: on TPU the *device* flatten is free (XLA fuses concatenates),
+// but host-side staging — assembling fused fp32 buffers from numpy arrays,
+// planning DDP buckets, and preprocessing input batches — sits on the
+// critical path of the input pipeline and is implemented here in C++ with
+// a small thread pool.
+//
+// Exposed via a plain C ABI and loaded with ctypes (the environment has no
+// pybind11); every entry point has a pure-Python fallback in
+// apex_tpu/_native/__init__.py, mirroring the reference's graceful
+// degradation (README.md:90-95).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal thread pool (shared by flatten and preprocessing).
+// ---------------------------------------------------------------------------
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> task;
+          {
+            std::unique_lock<std::mutex> lock(mu_);
+            cv_.wait(lock, [this] { return stop_ || !tasks_.empty(); });
+            if (stop_ && tasks_.empty()) return;
+            task = std::move(tasks_.front());
+            tasks_.pop();
+          }
+          task();
+          done_.fetch_add(1, std::memory_order_release);
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void Submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      tasks_.push(std::move(f));
+    }
+    submitted_.fetch_add(1, std::memory_order_acq_rel);
+    cv_.notify_one();
+  }
+
+  // Monotonic counters, never reset: Wait() snapshots the submit count at
+  // entry and blocks until that many tasks have completed.  Concurrent
+  // callers sharing the singleton pool may over-wait (for each other's
+  // tasks) but can never under-wait or deadlock — no data race.
+  void Wait() {
+    uint64_t target = submitted_.load(std::memory_order_acquire);
+    while (done_.load(std::memory_order_acquire) < target) {
+      std::this_thread::yield();
+    }
+  }
+
+  static ThreadPool& Get() {
+    static ThreadPool pool(
+        std::max(1u, std::thread::hardware_concurrency()));
+    return pool;
+  }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_;
+  std::atomic<uint64_t> submitted_{0};
+  std::atomic<uint64_t> done_{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+// Concatenate n same-dtype host tensors into one contiguous buffer
+// (apex_C.flatten). srcs[i] points at sizes[i] elements of elem_size bytes.
+void apex_flatten(const void** srcs, const int64_t* sizes, int n,
+                  int64_t elem_size, void* dst) {
+  // compute offsets, then copy chunks in parallel
+  std::vector<int64_t> offsets(n + 1, 0);
+  for (int i = 0; i < n; ++i) offsets[i + 1] = offsets[i] + sizes[i];
+  auto& pool = ThreadPool::Get();
+  char* out = static_cast<char*>(dst);
+  for (int i = 0; i < n; ++i) {
+    const char* src = static_cast<const char*>(srcs[i]);
+    char* d = out + offsets[i] * elem_size;
+    int64_t bytes = sizes[i] * elem_size;
+    pool.Submit([src, d, bytes] { std::memcpy(d, src, bytes); });
+  }
+  pool.Wait();
+}
+
+// Inverse: scatter a flat buffer back into n host tensors
+// (apex_C.unflatten).
+void apex_unflatten(const void* src, const int64_t* sizes, int n,
+                    int64_t elem_size, void** dsts) {
+  std::vector<int64_t> offsets(n + 1, 0);
+  for (int i = 0; i < n; ++i) offsets[i + 1] = offsets[i] + sizes[i];
+  auto& pool = ThreadPool::Get();
+  const char* in = static_cast<const char*>(src);
+  for (int i = 0; i < n; ++i) {
+    const char* s = in + offsets[i] * elem_size;
+    char* dst = static_cast<char*>(dsts[i]);
+    int64_t bytes = sizes[i] * elem_size;
+    pool.Submit([s, dst, bytes] { std::memcpy(dst, s, bytes); });
+  }
+  pool.Wait();
+}
+
+// Greedy in-order bucket assignment: tensors are packed into buckets of at
+// least message_size elements in arrival order — the planning half of the
+// reference DDP's bucketing (distributed.py:338-361), done once on host
+// instead of per-backward on device.  Returns the number of buckets.
+int apex_plan_buckets(const int64_t* sizes, int n, int64_t message_size,
+                      int32_t* bucket_ids) {
+  int bucket = 0;
+  int64_t filled = 0;
+  for (int i = 0; i < n; ++i) {
+    bucket_ids[i] = bucket;
+    filled += sizes[i];
+    if (filled >= message_size) {
+      bucket++;
+      filled = 0;
+    }
+  }
+  return (filled > 0 || n == 0) ? bucket + 1 : bucket;
+}
+
+// Input-pipeline preprocessing: NHWC uint8 images -> NCHW float32,
+// normalized with per-channel mean/std — the host half of the reference
+// example's data_prefetcher (examples/imagenet/main_amp.py:264-300), which
+// on GPU ran on a side CUDA stream; on TPU it runs on host threads
+// overlapped with device compute.
+void apex_preprocess_nhwc_u8_to_nchw_f32(const uint8_t* in, float* out,
+                                         int64_t n, int64_t h, int64_t w,
+                                         int64_t c, const float* mean,
+                                         const float* std) {
+  auto& pool = ThreadPool::Get();
+  std::vector<float> inv_std(c);
+  for (int64_t k = 0; k < c; ++k) inv_std[k] = 1.0f / std[k];
+  const float* inv = inv_std.data();
+  for (int64_t img = 0; img < n; ++img) {
+    const uint8_t* src = in + img * h * w * c;
+    float* dst = out + img * c * h * w;
+    pool.Submit([src, dst, h, w, c, mean, inv] {
+      for (int64_t k = 0; k < c; ++k) {
+        float mk = mean[k], ik = inv[k];
+        float* plane = dst + k * h * w;
+        for (int64_t p = 0; p < h * w; ++p) {
+          plane[p] = (static_cast<float>(src[p * c + k]) - mk) * ik;
+        }
+      }
+    });
+  }
+  pool.Wait();
+}
+
+int apex_native_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Build the apex_tpu native host runtime (plain g++, no external deps).
+set -euo pipefail
+cd "$(dirname "$0")"
+# no -march=native: the .so may outlive the build machine; -O3 + memcpy
+# dominate anyway
+g++ -O3 -fPIC -shared -pthread -std=c++17 \
+    apex_tpu_C.cpp -o libapex_tpu_C.so
+echo "built $(pwd)/libapex_tpu_C.so"

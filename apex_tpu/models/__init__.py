@@ -1,0 +1,6 @@
+"""apex_tpu.models — model zoo for examples and benchmarks."""
+
+from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
+                     resnet50, resnet101, resnet152)
+from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
+                   bert_large)

@@ -1,0 +1,164 @@
+"""BERT encoder for the FusedLayerNorm/FusedAdam/FusedLAMB benchmark
+configs (BASELINE.md configs #4-5: BERT-base fine-tune, BERT-large
+large-batch pretrain).
+
+Built on apex_tpu primitives end-to-end: FusedLayerNorm
+(apex_tpu.normalization), policy-aware matmuls (amp O1/O2 apply), and the
+MultiheadAttention core from apex_tpu.transformer.  Sequence-parallel
+long-context variants swap the attention core for
+transformer.ring_attention over an 'sp' mesh axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..transformer.attention import dot_product_attention
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
+           "bert_large"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_large():
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096)
+
+
+class BertSelfAttention(nn.Module):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.attention_probs_dropout_prob = cfg.attention_probs_dropout_prob
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, p, x, mask=None):
+        B, T, E = x.shape
+        qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.num_heads,
+                                            self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        ctx = dot_product_attention(
+            q, k, v, mask, dropout_rate=self.attention_probs_dropout_prob)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
+
+
+class BertLayer(nn.Module):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attention_ln = FusedLayerNorm(cfg.hidden_size,
+                                           eps=cfg.layer_norm_eps)
+        self.intermediate = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.output = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.output_ln = FusedLayerNorm(cfg.hidden_size,
+                                        eps=cfg.layer_norm_eps)
+        self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, p, x, mask=None):
+        a = self.attention(p["attention"], x, mask)
+        x = self.attention_ln(p["attention_ln"], x + a)
+        h = F.gelu(self.intermediate(p["intermediate"], x))
+        h = self.drop(p.get("drop", {}), self.output(p["output"], h))
+        return self.output_ln(p["output_ln"], x + h)
+
+
+class BertModel(nn.Module):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.embeddings_ln = FusedLayerNorm(cfg.hidden_size,
+                                            eps=cfg.layer_norm_eps)
+        self.layer = nn.ModuleList([BertLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, p, input_ids, token_type_ids=None,
+                attention_mask=None):
+        B, T = input_ids.shape
+        pos = jnp.arange(T)[None, :]
+        emb = self.word_embeddings(p["word_embeddings"], input_ids)
+        emb = emb + self.position_embeddings(p["position_embeddings"], pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(
+                p["token_type_embeddings"], token_type_ids)
+        x = self.embeddings_ln(p["embeddings_ln"], emb)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(self.cfg.num_hidden_layers):
+            x = self.layer[i](p["layer"][str(i)], x, mask)
+        pooled = F.tanh(self.pooler(p["pooler"], x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Module):
+    """MLM + NSP heads, the BERT-large pretrain benchmark target."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.mlm_dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_ln = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, p, input_ids, token_type_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(p["bert"], input_ids, token_type_ids,
+                                attention_mask)
+        h = self.mlm_ln(p["mlm_ln"], F.gelu(self.mlm_dense(p["mlm_dense"],
+                                                           seq)))
+        # decoder tied to word embeddings (standard BERT)
+        table = p["bert"]["word_embeddings"]["weight"]
+        mlm_logits = F.matmul(h, table.T.astype(h.dtype))
+        nsp_logits = self.nsp(p["nsp"], pooled)
+        return mlm_logits, nsp_logits
+
+    def loss(self, p, input_ids, mlm_labels, nsp_labels,
+             token_type_ids=None, attention_mask=None, ignore_index=-100):
+        mlm_logits, nsp_logits = self(p, input_ids, token_type_ids,
+                                      attention_mask)
+        logp = F.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        valid = mlm_labels != ignore_index
+        labels = jnp.where(valid, mlm_labels, 0)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        nsp_loss = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm_loss + nsp_loss

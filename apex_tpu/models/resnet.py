@@ -1,0 +1,131 @@
+"""ResNet family for the imagenet example and benchmarks.
+
+The reference's examples/tests train torchvision ResNet-50
+(examples/imagenet/main_amp.py:150, tests/L1/common/main_amp.py); apex_tpu
+ships its own definition on apex_tpu.nn so amp's param casting, SyncBatchNorm
+conversion, and the policy-aware conv/linear ops all apply.  Structure
+matches torchvision's v1 ResNet (stride-2 in the bottleneck's 3x3, like
+the torchvision the reference era used).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["ResNet", "BasicBlock", "Bottleneck", "resnet18", "resnet34",
+           "resnet50", "resnet101", "resnet152"]
+
+
+def conv3x3(cin, cout, stride=1):
+    return nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False)
+
+
+def conv1x1(cin, cout, stride=1):
+    return nn.Conv2d(cin, cout, 1, stride=stride, bias=False)
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = conv3x3(inplanes, planes, stride)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = conv3x3(planes, planes)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, p, x):
+        identity = x
+        out = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
+        out = self.bn2(p["bn2"], self.conv2(p["conv2"], out))
+        if self.downsample is not None:
+            identity = self.downsample(p["downsample"], x)
+        return F.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = conv1x1(inplanes, planes)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = conv3x3(planes, planes, stride)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = conv1x1(planes, planes * self.expansion)
+        self.bn3 = nn.BatchNorm2d(planes * self.expansion)
+        self.downsample = downsample
+
+    def forward(self, p, x):
+        identity = x
+        out = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
+        out = F.relu(self.bn2(p["bn2"], self.conv2(p["conv2"], out)))
+        out = self.bn3(p["bn3"], self.conv3(p["conv3"], out))
+        if self.downsample is not None:
+            identity = self.downsample(p["downsample"], x)
+        return F.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block: Type, layers: List[int],
+                 num_classes: int = 1000):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential([
+                conv1x1(self.inplanes, planes * block.expansion, stride),
+                nn.BatchNorm2d(planes * block.expansion)])
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return nn.Sequential(layers)
+
+    def forward(self, p, x):
+        x = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
+        x = self.maxpool({}, x)
+        x = self.layer1(p["layer1"], x)
+        x = self.layer2(p["layer2"], x)
+        x = self.layer3(p["layer3"], x)
+        x = self.layer4(p["layer4"], x)
+        x = self.avgpool({}, x)
+        x = x.reshape(x.shape[0], -1)
+        return self.fc(p["fc"], x)
+
+
+def resnet18(num_classes=1000):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes)
+
+
+def resnet34(num_classes=1000):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes)
+
+
+def resnet50(num_classes=1000):
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes)
+
+
+def resnet101(num_classes=1000):
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes)
+
+
+def resnet152(num_classes=1000):
+    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes)

@@ -206,8 +206,9 @@ def _pool2d(x, window, stride, padding, init, reduce_fn):
 
 
 def max_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
-    neg = jnp.array(-jnp.inf, x.dtype) if jnp.issubdtype(
-        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    # literal init values let XLA recognize the max monoid (autodiff rule)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
     return _pool2d(x, kernel_size, stride, padding, neg, lax.max)
 
 
@@ -216,7 +217,7 @@ def avg_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
         denom = kernel_size * kernel_size
     else:
         denom = kernel_size[0] * kernel_size[1]
-    s = _pool2d(x, kernel_size, stride, padding, jnp.array(0, x.dtype), lax.add)
+    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
     return s / jnp.asarray(denom, x.dtype)
 
 

@@ -28,14 +28,21 @@ __all__ = ["dot_product_attention", "MultiheadAttention"]
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
-                          scale: Optional[float] = None) -> jax.Array:
-    """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax."""
+                          scale: Optional[float] = None,
+                          dropout_rate: float = 0.0) -> jax.Array:
+    """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax.
+
+    ``dropout_rate`` applies attention-probability dropout in train mode
+    (rng drawn from the active apply-context, like nn.Dropout)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     scores = F.matmul(q, jnp.swapaxes(k, -1, -2)).astype(jnp.float32) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))
     probs = jax.nn.softmax(scores, axis=-1)
+    ctx = current_context()
+    if dropout_rate > 0.0 and ctx is not None and ctx.train:
+        probs = F.dropout(probs, dropout_rate, ctx.make_rng())
     return F.matmul(probs.astype(v.dtype), v)
 
 

@@ -1,0 +1,97 @@
+"""Benchmark: ResNet-50 amp-O2 training throughput (BASELINE.md config #2).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline is measured against the driver's north-star target of 10k
+images/sec aggregate on v5e-64 => 156.25 images/sec/chip (BASELINE.md).
+Runs the full O2 train step (bf16 fwd/bwd on the MXU, fp32 masters,
+FusedAdam Pallas kernel) on however many chips are visible; on CPU it
+falls back to a tiny config so the harness still produces a line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 10_000.0 / 64.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import amp, optimizers, parallel, models
+    from apex_tpu.nn import functional as F
+
+    on_tpu = jax.default_backend() == "tpu"
+    ndev = len(jax.devices())
+    if on_tpu:
+        batch_per_chip, image, iters, warmup = 128, 224, 20, 3
+        arch = "resnet50"
+    else:  # smoke config for CPU runs of the harness
+        batch_per_chip, image, iters, warmup = 8, 32, 3, 1
+        arch = "resnet18"
+
+    model, optimizer = amp.initialize(
+        getattr(models, arch)(), optimizers.FusedAdam(lr=0.1),
+        opt_level="O2", verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    opt_state = optimizer.init(params)
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    global_batch = batch_per_chip * ndev
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(global_batch, 3, image, image), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, global_batch), jnp.int32)
+
+    def step(state, batch):
+        params, bn_state, opt_state = state
+        xb, yb = batch
+
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn_state, train=True)
+            return F.cross_entropy(out, yb), new_bn
+
+        loss, new_bn, grads = amp.scaled_grad(loss_fn, params, opt_state,
+                                              has_aux=True)
+        grads = ddp.allreduce_grads(grads)
+        params, opt_state, _ = optimizer.step(params, opt_state, grads)
+        return (params, new_bn, opt_state), lax.pmean(loss, "data")
+
+    train = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
+        out_specs=(P(), P()), check_vma=False),
+        donate_argnums=(0,))
+
+    state = (params, bn_state, opt_state)
+    for _ in range(warmup):
+        state, loss = train(state, (x, y))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = train(state, (x, y))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = global_batch * iters / dt
+    ips_per_chip = ips / ndev
+    print(json.dumps({
+        "metric": f"{arch}_amp_o2_ddp_train_throughput",
+        "value": round(ips_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""ImageNet training example — apex_tpu clone of the reference's
+examples/imagenet/main_amp.py: the 3-line amp enablement + DDP wrap, same
+CLI surface (--opt-level, --loss-scale, --keep-batchnorm-fp32, --sync_bn,
+--b, --prof), adapted to JAX: data-parallel over the device mesh via
+shard_map, synthetic ImageNet-shaped data by default (the container has no
+dataset; pass --data for a real numpy-file pipeline).
+
+Run on CPU mesh:
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/imagenet/main_amp.py --arch resnet18 --b 8 --iters 10
+
+Run on TPU: python examples/imagenet/main_amp.py --b 128
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+# allow running straight from a source checkout
+_repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+if os.path.isdir(os.path.join(_repo, "apex_tpu")) and _repo not in sys.path:
+    sys.path.insert(0, _repo)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="apex_tpu ImageNet training")
+    p.add_argument("--data", default=None,
+                   help="optional .npz with images/labels; synthetic if unset")
+    p.add_argument("--arch", "-a", default="resnet50")
+    p.add_argument("-b", "--batch-size", type=int, default=128,
+                   help="per-device batch size")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--iters", type=int, default=100,
+                   help="iterations per epoch (synthetic data)")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--loss-scale", default=None)
+    p.add_argument("--keep-batchnorm-fp32", default=None)
+    p.add_argument("--half-dtype", default=None,
+                   choices=[None, "bfloat16", "float16"])
+    p.add_argument("--sync_bn", action="store_true",
+                   help="convert BatchNorm to SyncBatchNorm")
+    p.add_argument("--fused-adam", action="store_true",
+                   help="use FusedAdam instead of SGD")
+    p.add_argument("--prof", action="store_true",
+                   help="emit a jax profiler trace of 10 hot iterations")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+class AverageMeter:
+    """Same helper as the reference example (main_amp.py:354-390)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = self.avg = 0.0
+
+    def update(self, val, n=1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+
+def main():
+    args = parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import apex_tpu
+    from apex_tpu import amp, nn, optimizers, parallel, models
+    from apex_tpu.nn import functional as F
+
+    ndev = len(jax.devices())
+    print(f"=> {ndev} device(s) on backend {jax.default_backend()}")
+    print(f"=> creating model '{args.arch}'")
+    model = getattr(models, args.arch)()
+    if args.sync_bn:
+        print("using apex_tpu synced BN")
+        model = parallel.convert_syncbn_model(model)
+
+    if args.fused_adam:
+        optimizer = optimizers.FusedAdam(lr=args.lr,
+                                         weight_decay=args.weight_decay)
+    else:
+        optimizer = optimizers.SGD(lr=args.lr, momentum=args.momentum,
+                                   weight_decay=args.weight_decay)
+
+    model, optimizer = amp.initialize(
+        model, optimizer, opt_level=args.opt_level,
+        keep_batchnorm_fp32=args.keep_batchnorm_fp32,
+        loss_scale=args.loss_scale, half_dtype=args.half_dtype)
+    ddp = parallel.DistributedDataParallel(model)
+
+    params, bn_state = model.init(jax.random.PRNGKey(args.seed))
+    opt_state = optimizer.init(params)
+
+    global_batch = args.batch_size * ndev
+    rng = np.random.RandomState(args.seed)
+    if args.data:
+        blob = np.load(args.data)
+        images_all = blob["images"].astype(np.float32)
+        labels_all = blob["labels"].astype(np.int32)
+        n_batches = len(images_all) // global_batch
+        if n_batches == 0:
+            raise SystemExit(
+                f"dataset has {len(images_all)} images < one global batch "
+                f"({global_batch}); lower --batch-size")
+        args.iters = min(args.iters, n_batches)
+
+        def get_batch(i):
+            s = (i % n_batches) * global_batch
+            return (images_all[s:s + global_batch],
+                    labels_all[s:s + global_batch])
+    else:
+        images_all = rng.randn(
+            global_batch, 3, args.image_size, args.image_size
+        ).astype(np.float32)
+        labels_all = rng.randint(0, 1000, global_batch).astype(np.int32)
+
+        def get_batch(i):
+            return images_all, labels_all
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def step(state, batch):
+        params, bn_state, opt_state = state
+        x, y = batch
+
+        def loss_fn(p):
+            out, new_bn = model.apply(p, x, state=bn_state, train=True)
+            return F.cross_entropy(out, y), (new_bn, out)
+
+        loss, (new_bn, out), grads = amp.scaled_grad(
+            loss_fn, params, opt_state, has_aux=True)
+        grads = ddp.allreduce_grads(grads)
+        params, opt_state, info = optimizer.step(params, opt_state, grads)
+        acc = jnp.mean((jnp.argmax(out, -1) == y).astype(jnp.float32))
+        metrics = {"loss": lax.pmean(loss, "data"),
+                   "prec1": lax.pmean(acc, "data") * 100.0,
+                   "loss_scale": info["loss_scale"]}
+        return (params, new_bn, opt_state), metrics
+
+    train_step = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), (P("data"), P("data"))),
+        out_specs=(P(), P()), check_vma=False))
+
+    state = (params, bn_state, opt_state)
+
+    print("=> compiling train step...")
+    t0 = time.time()
+    xb, yb = get_batch(0)
+    state, metrics = train_step(state, (jnp.asarray(xb), jnp.asarray(yb)))
+    jax.block_until_ready(metrics)
+    print(f"=> compiled in {time.time() - t0:.1f}s")
+
+    batch_time = AverageMeter()
+    losses = AverageMeter()
+    top1 = AverageMeter()
+
+    for epoch in range(args.epochs):
+        end = time.time()
+        for i in range(args.iters):
+            if args.prof and epoch == 0 and i == 10:
+                jax.profiler.start_trace("/tmp/apex_tpu_trace")
+            xb, yb = get_batch(i)
+            state, metrics = train_step(
+                state, (jnp.asarray(xb), jnp.asarray(yb)))
+            jax.block_until_ready(metrics)
+            if args.prof and epoch == 0 and i == 20:
+                jax.profiler.stop_trace()
+            batch_time.update(time.time() - end)
+            end = time.time()
+            losses.update(float(metrics["loss"]))
+            top1.update(float(metrics["prec1"]))
+            if i % args.print_freq == 0:
+                ips = global_batch / batch_time.val
+                print(f"Epoch: [{epoch}][{i}/{args.iters}]  "
+                      f"Time {batch_time.val:.3f} ({batch_time.avg:.3f})  "
+                      f"Speed {ips:.1f} img/s  "
+                      f"Loss {losses.val:.4f} ({losses.avg:.4f})  "
+                      f"Prec@1 {top1.val:.2f}  "
+                      f"scale {float(metrics['loss_scale']):.0f}")
+    ips = global_batch / batch_time.avg
+    print(f"=> done. avg {ips:.1f} img/s over {args.iters} iters "
+          f"({ips / ndev:.1f} img/s/device)")
+    return ips
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Native host-runtime (C++ apex_tpu_C) parity tests vs numpy fallbacks."""
+
+import numpy as np
+import pytest
+
+from apex_tpu import _native
+
+# graceful degradation is the contract: without a C++ toolchain the numpy
+# fallbacks serve, and only the parity tests are skipped
+pytestmark = pytest.mark.skipif(
+    not _native.available(),
+    reason="native lib unavailable (no compiler); numpy fallbacks in use")
+
+
+def test_native_builds_and_loads():
+    assert _native.available()
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.RandomState(0)
+    tensors = [rng.randn(17).astype(np.float32),
+               rng.randn(4, 5).astype(np.float32),
+               rng.randn(2, 3, 2).astype(np.float32)]
+    flat = _native.flatten(tensors)
+    ref = np.concatenate([t.reshape(-1) for t in tensors])
+    np.testing.assert_array_equal(flat, ref)
+    back = _native.unflatten(flat, tensors)
+    for a, b in zip(back, tensors):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flatten_dtype_mismatch():
+    with pytest.raises(TypeError):
+        _native.flatten([np.zeros(2, np.float32), np.zeros(2, np.float16)])
+
+
+def test_plan_buckets_greedy():
+    ids = _native.plan_buckets([10, 10, 10, 10, 10], message_size=25)
+    # fills: 10,20,30 -> bucket closes after 3rd; then 10, 20
+    np.testing.assert_array_equal(ids, [0, 0, 0, 1, 1])
+    ids2 = _native.plan_buckets([100], message_size=10)
+    np.testing.assert_array_equal(ids2, [0])
+    assert _native.plan_buckets([], 10).shape == (0,)
+
+
+def test_preprocess_images_matches_numpy():
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (3, 8, 9, 3), dtype=np.uint8)
+    mean = [125.3, 123.0, 113.9]
+    std = [63.0, 62.1, 66.7]
+    out = _native.preprocess_images(imgs, mean, std)
+    ref = (imgs.astype(np.float32) - np.asarray(mean, np.float32)) / \
+        np.asarray(std, np.float32)
+    ref = ref.transpose(0, 3, 1, 2)
+    assert out.shape == (3, 3, 8, 9)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)

@@ -1,0 +1,168 @@
+"""SyncBatchNorm parity — the reference's two_gpu_unit_test.py:80-167
+pattern: stats/output/grads of N-rank SyncBN on a sharded batch must match
+single-process BatchNorm fed the full batch; plus group sync
+(test_groups.py) via axis_index_groups."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import nn
+from apex_tpu.parallel import (SyncBatchNorm, convert_syncbn_model,
+                               create_syncbn_process_group)
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _shard_run(mesh, fn, *args, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))(*args)
+
+
+def test_syncbn_forward_matches_full_batch(mesh):
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(16, 6, 4, 4).astype(np.float32) * 3 + 1.5
+    x = jnp.asarray(x_np)
+
+    ref_bn = nn.BatchNorm2d(6)
+    params, state = ref_bn.init(jax.random.PRNGKey(0))
+    ref_out, ref_state = nn.apply(ref_bn, params, x, state=state, train=True)
+
+    sbn = SyncBatchNorm(6)
+    sparams, sstate = sbn.init(jax.random.PRNGKey(0))
+
+    def fn(xb):
+        out, new_state = nn.apply(sbn, sparams, xb, state=sstate, train=True)
+        return out, new_state
+
+    out, new_state = _shard_run(mesh, fn, x, in_specs=(P("data"),),
+                                out_specs=(P("data"), P()))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-4)
+    # running stats must also match the full-batch reference
+    k = list(ref_state)[0]
+    sk = list(new_state)[0]
+    np.testing.assert_allclose(np.asarray(new_state[sk]["running_mean"]),
+                               np.asarray(ref_state[k]["running_mean"]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_state[sk]["running_var"]),
+                               np.asarray(ref_state[k]["running_var"]),
+                               atol=1e-3)
+
+
+def test_syncbn_backward_matches_full_batch(mesh):
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(16, 4, 3, 3).astype(np.float32)
+    x = jnp.asarray(x_np)
+
+    ref_bn = nn.BatchNorm2d(4)
+    params, state = ref_bn.init(jax.random.PRNGKey(0))
+
+    def ref_loss(p, xin):
+        out, _ = nn.apply(ref_bn, p, xin, state=state, train=True)
+        return jnp.sum(out ** 2)
+
+    ref_grads = jax.grad(ref_loss)(params, x)
+
+    sbn = SyncBatchNorm(4)
+    sparams, sstate = sbn.init(jax.random.PRNGKey(0))
+
+    def fn(xb):
+        def loss(p):
+            out, _ = nn.apply(sbn, p, xb, state=sstate, train=True)
+            # local sum; global loss = psum of locals
+            return jnp.sum(out ** 2)
+        g = jax.grad(loss)(sparams)
+        return jax.tree_util.tree_map(lambda t: lax.psum(t, "data"), g)
+
+    grads = _shard_run(mesh, fn, x, in_specs=(P("data"),), out_specs=P())
+    np.testing.assert_allclose(np.asarray(grads["weight"]),
+                               np.asarray(ref_grads["weight"]), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(grads["bias"]),
+                               np.asarray(ref_grads["bias"]), atol=1e-3)
+
+
+def test_syncbn_group_sync(mesh):
+    """group_size=4: each half of the mesh syncs separately
+    (reference test_groups.py)."""
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(16, 2, 2, 2).astype(np.float32)
+    x_np[8:] += 10.0  # second half-mesh sees shifted data
+    x = jnp.asarray(x_np)
+
+    pg = create_syncbn_process_group(4, world_size=8)
+    sbn = SyncBatchNorm(2, process_group=pg)
+    sparams, sstate = sbn.init(jax.random.PRNGKey(0))
+
+    def fn(xb):
+        out, _ = nn.apply(sbn, sparams, xb, state=sstate, train=True)
+        return out
+
+    out = _shard_run(mesh, fn, x, in_specs=(P("data"),),
+                     out_specs=P("data"))
+    out_np = np.asarray(out)
+    # ranks 0-3 hold rows 0-7 (first group), 4-7 hold rows 8-15: each
+    # group's batch is normalized over that group only -> group mean ~0,
+    # group var ~1 despite the +10 shift in the second half
+    for half in (out_np[:8], out_np[8:]):
+        np.testing.assert_allclose(half.mean(axis=(0, 2, 3)), 0.0,
+                                   atol=1e-4)
+        np.testing.assert_allclose(half.std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+
+    # sanity: a single global group normalizes over all 16 rows, so each
+    # shifted half keeps a large nonzero mean
+    sbn_g = SyncBatchNorm(2)
+    gparams, gstate = sbn_g.init(jax.random.PRNGKey(0))
+
+    def fn_g(xb):
+        out, _ = nn.apply(sbn_g, gparams, xb, state=gstate, train=True)
+        return out
+
+    gout = np.asarray(_shard_run(mesh, fn_g, x, in_specs=(P("data"),),
+                                 out_specs=P("data")))
+    assert np.abs(gout[:8].mean(axis=(0, 2, 3))).max() > 0.5
+
+
+def test_syncbn_fallback_without_mesh():
+    """Outside a mapped axis SyncBatchNorm uses local stats (the
+    world_size==1 branch, reference sync_batchnorm.py:105-117)."""
+    sbn = SyncBatchNorm(3)
+    params, state = sbn.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 3, 2, 2), jnp.float32)
+    out, _ = nn.apply(sbn, params, x, state=state, train=True)
+    out32 = np.asarray(out, np.float32)
+    np.testing.assert_allclose(out32.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+
+
+def test_convert_syncbn_model():
+    from apex_tpu.models import resnet18
+    model = resnet18(num_classes=10)
+    n_bn_before = sum(1 for m in model.modules()
+                      if type(m).__name__ == "BatchNorm2d")
+    model = convert_syncbn_model(model)
+    n_sync = sum(1 for m in model.modules()
+                 if isinstance(m, SyncBatchNorm))
+    n_plain = sum(1 for m in model.modules()
+                  if type(m).__name__ == "BatchNorm2d")
+    assert n_sync == n_bn_before
+    assert n_plain == 0
+    # param schema unchanged: init and forward still work
+    params, state = model.init(jax.random.PRNGKey(0))
+    out, _ = nn.apply(model, params, jnp.ones((2, 3, 32, 32)), state=state)
+    assert out.shape == (2, 10)
+
+
+def test_syncbn_channel_last():
+    sbn = SyncBatchNorm(5, channel_last=True)
+    params, state = sbn.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 4, 4, 5), jnp.float32)
+    out, _ = nn.apply(sbn, params, x, state=state, train=True)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out).mean(axis=(0, 1, 2)), 0.0,
+                               atol=1e-5)
