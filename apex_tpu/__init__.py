@@ -35,5 +35,6 @@ from . import RNN
 from . import reparameterization
 from . import transformer
 from . import models
+from . import utils
 
 __version__ = "0.1.0"

@@ -1,0 +1,122 @@
+"""Pallas LAMB stage-1 / stage-2 kernels.
+
+Equivalent of csrc/multi_tensor_lamb_stage_1.cu:86-108 and
+multi_tensor_lamb_stage_2.cu:38-48,66-70: stage 1 is one pass over the flat
+(g, p, m, v) buffers producing the Adam-style ``update`` tensor with the
+grad pre-scaled by the clipped global norm; stage 2 applies the per-tensor
+trust ratio ``||p|| / ||update||``.
+
+The reference passes per-tensor trust ratios through a separate
+param_norm/update_norm tensor pair indexed by tensor id; here the ratios
+are expanded to a flat per-element buffer (a static-shape ``jnp.repeat``
+XLA folds into the surrounding fusion) so stage 2 stays a single
+elementwise kernel over the fused supervector.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_common import (BLOCK_ROWS, LANES, from_2d, interpret, to_2d)
+
+
+def _stage1_kernel(scal_ref, g_ref, p_ref, m_ref, v_ref,
+                   upd_out, m_out, v_out, *, beta1, beta2, beta3, eps,
+                   weight_decay, adam_w_mode):
+    inv_clip = scal_ref[0, 0]
+    inv_bc1 = scal_ref[0, 1]
+    inv_bc2 = scal_ref[0, 2]
+    g = g_ref[:].astype(jnp.float32) * inv_clip
+    p = p_ref[:]
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p  # classic L2 enters the gradient
+    m = beta1 * m_ref[:] + beta3 * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    upd = (m * inv_bc1) / (jnp.sqrt(v * inv_bc2) + eps)
+    if adam_w_mode and weight_decay:
+        upd = upd + weight_decay * p  # decoupled decay enters the update
+    upd_out[:] = upd
+    m_out[:] = m
+    v_out[:] = v
+
+
+@functools.partial(
+    jax.jit, static_argnames=("beta1", "beta2", "beta3", "eps",
+                              "weight_decay", "adam_w_mode"))
+def _stage1_flat(g, p, m, v, inv_clip, inv_bc1, inv_bc2, *, beta1, beta2,
+                 beta3, eps, weight_decay, adam_w_mode):
+    g2, n = to_2d(g)
+    p2, _ = to_2d(p)
+    m2, _ = to_2d(m)
+    v2, _ = to_2d(v)
+    rows = g2.shape[0]
+    grid = rows // BLOCK_ROWS
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    scal = jnp.stack([jnp.asarray(inv_clip, jnp.float32),
+                      jnp.asarray(inv_bc1, jnp.float32),
+                      jnp.asarray(inv_bc2, jnp.float32)]).reshape(1, 3)
+    upd2, new_m2, new_v2 = pl.pallas_call(
+        functools.partial(_stage1_kernel, beta1=beta1, beta2=beta2,
+                          beta3=beta3, eps=eps, weight_decay=weight_decay,
+                          adam_w_mode=adam_w_mode),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), blk()],
+        out_specs=[blk(), blk(), blk()],
+        out_shape=[jax.ShapeDtypeStruct((rows, LANES), jnp.float32)] * 3,
+        input_output_aliases={3: 1, 4: 2},
+        interpret=interpret(),
+    )(scal, g2, p2, m2, v2)
+    return from_2d(upd2, n), from_2d(new_m2, n), from_2d(new_v2, n)
+
+
+def _stage2_kernel(lr_ref, p_ref, upd_ref, ratio_ref, p_out):
+    lr = lr_ref[0, 0]
+    p_out[:] = p_ref[:] - lr * ratio_ref[:] * upd_ref[:]
+
+
+@jax.jit
+def _stage2_flat(p, upd, ratio, lr):
+    p2, n = to_2d(p)
+    upd2, _ = to_2d(upd)
+    ratio2, _ = to_2d(ratio)
+    rows = p2.shape[0]
+    grid = rows // BLOCK_ROWS
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    lr_s = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    new_p2 = pl.pallas_call(
+        _stage2_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk()],
+        out_specs=blk(),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+        input_output_aliases={1: 0},
+        interpret=interpret(),
+    )(lr_s, p2, upd2, ratio2)
+    return from_2d(new_p2, n)
+
+
+def lamb_stage1(g, p, m, v, inv_clip, inv_bc1, inv_bc2, beta1, beta2, beta3,
+                eps, weight_decay, adam_w_mode
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Flat-buffer LAMB stage 1 -> (update, new_m, new_v)."""
+    return _stage1_flat(g, p, m, v, inv_clip, inv_bc1, inv_bc2,
+                        beta1=float(beta1), beta2=float(beta2),
+                        beta3=float(beta3), eps=float(eps),
+                        weight_decay=float(weight_decay),
+                        adam_w_mode=bool(adam_w_mode))
+
+
+def lamb_stage2(p, upd, ratio, lr) -> jax.Array:
+    """Flat-buffer LAMB stage 2: p -= lr * ratio * update, with ``ratio``
+    the per-element expansion of the per-tensor trust ratios."""
+    return _stage2_flat(p, upd, ratio, lr)

@@ -92,6 +92,11 @@ class FusedLAMB(Optimizer):
 
         wd = self.weight_decay
 
+        from ..ops import dispatch
+        if dispatch.use_pallas_for(params):
+            return self._step_pallas(params, state, grads, t, lr, beta1,
+                                     beta2, beta3, bc1, bc2, clip_factor, wd)
+
         def stage1(p, g, m, v):
             g32 = g.astype(jnp.float32) / clip_factor
             p32 = p.astype(jnp.float32)
@@ -122,4 +127,39 @@ class FusedLAMB(Optimizer):
             return (p.astype(jnp.float32) - lr * ratio * upd).astype(p.dtype)
 
         new_params = jax.tree_util.tree_map(stage2, params, updates)
+        return new_params, LambState(step=t, m=new_m, v=new_v)
+
+    def _step_pallas(self, params, state, grads, t, lr, beta1, beta2, beta3,
+                     bc1, bc2, clip_factor, wd):
+        """Flat-buffer kernel path: one stage-1 launch over the fused
+        supervector, per-tensor trust ratios, one stage-2 launch."""
+        from ..multi_tensor_apply.flatten import pack_flat, unpack_flat
+        from ..ops import pallas_lamb
+
+        g_flat, leaves, treedef = pack_flat(grads, jnp.float32)
+        p_flat, p_leaves, _ = pack_flat(params, jnp.float32)
+        m_flat, _, _ = pack_flat(state.m, jnp.float32)
+        v_flat, _, _ = pack_flat(state.v, jnp.float32)
+
+        upd_flat, new_m_flat, new_v_flat = pallas_lamb.lamb_stage1(
+            g_flat, p_flat, m_flat, v_flat, 1.0 / clip_factor, 1.0 / bc1,
+            1.0 / bc2, beta1, beta2, beta3, self.eps, wd, self.adam_w_mode)
+
+        # per-tensor trust ratios (stage_2.cu:38-48) from
+        # multi_tensor_l2norm's per-tensor output, expanded to per-element
+        # for the apply kernel
+        updates = unpack_flat(upd_flat, leaves, treedef, cast_like=False)
+        _, p_norm = multi_tensor_l2norm(params, per_tensor=True)
+        _, u_norm = multi_tensor_l2norm(updates, per_tensor=True)
+        ratios = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm,
+                           jnp.ones_like(p_norm))
+        sizes = [int(l.size) for l in p_leaves]
+        ratio_flat = jnp.repeat(ratios, jnp.asarray(sizes),
+                                total_repeat_length=p_flat.shape[0])
+
+        new_p_flat = pallas_lamb.lamb_stage2(p_flat, upd_flat, ratio_flat, lr)
+
+        new_params = unpack_flat(new_p_flat, p_leaves, treedef)
+        new_m = unpack_flat(new_m_flat, leaves, treedef, cast_like=False)
+        new_v = unpack_flat(new_v_flat, leaves, treedef, cast_like=False)
         return new_params, LambState(step=t, m=new_m, v=new_v)

@@ -65,8 +65,13 @@ class SyncBatchNorm(BatchNorm2d):
         """Chan-combine local (count, mean, biased var) across the axis.
         Falls back to local stats when no mapped axis is in scope — the
         world_size==1 branch of the reference (sync_batchnorm.py:105-117)."""
+        # named range mirroring the reference's nvtx annotation of this
+        # boundary (sync_batchnorm.py:69 "sync_BN_fw")
+        with jax.named_scope("sync_bn_stats"):
+            return self._sync_stats_inner(count, mean, var)
+
+    def _sync_stats_inner(self, count, mean, var):
         try:
-            zero = jnp.zeros((), jnp.float32)
             total = lax.psum(
                 jnp.ones((), jnp.float32) * count, self.axis_name,
                 axis_index_groups=self.axis_index_groups)

@@ -7,3 +7,4 @@ SURVEY.md §5): long-context support is first-class in apex_tpu.
 
 from .attention import dot_product_attention, MultiheadAttention
 from .ring_attention import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention

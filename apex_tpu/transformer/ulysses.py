@@ -1,0 +1,91 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The complementary long-context strategy to ring attention: instead of
+rotating K/V blocks around the ring (O(n) ppermute steps), two
+``lax.all_to_all`` collectives re-shard the activations from
+sequence-sharded to *head*-sharded and back:
+
+    (B, H, T/n, D)  --all_to_all-->  (B, H/n, T, D)
+         attention over the full sequence on H/n local heads
+    (B, H/n, T, D)  --all_to_all-->  (B, H, T/n, D)
+
+Each device then computes exact attention over the full sequence for its
+slice of heads — no online-softmax bookkeeping, two collectives total.
+On TPU the all_to_all rides ICI; prefer Ulysses when H >= n and the
+sequence is long enough that ring's n ppermute latencies dominate, ring
+when head count is the binding constraint.
+
+Use inside shard_map/pmap with the sequence axis mapped, like
+ring_attention.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import dot_product_attention
+
+__all__ = ["ulysses_attention", "ulysses_self_attention"]
+
+
+def _seq_to_head_sharded(x: jax.Array, axis_name: str) -> jax.Array:
+    """(B, H, T/n, D) -> (B, H/n, T, D): scatter heads, gather sequence."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def _head_to_seq_sharded(x: jax.Array, axis_name: str) -> jax.Array:
+    """(B, H/n, T, D) -> (B, H, T/n, D): scatter sequence, gather heads."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """q, k, v: (B, H, T_local, D) per-device sequence-sharded slices;
+    returns the exact attention output for the local queries against the
+    global sequence, identical (up to fp reassociation) to
+    ``ring_attention`` on the same operands."""
+    n = lax.psum(1, axis_name)
+    H = q.shape[1]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs head count divisible by the sp axis "
+            f"size, got H={H}, n={n}; use ring_attention instead")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    qh = _seq_to_head_sharded(q, axis_name)
+    kh = _seq_to_head_sharded(k, axis_name)
+    vh = _seq_to_head_sharded(v, axis_name)
+
+    T = qh.shape[2]
+    mask = None
+    if causal:
+        pos = jnp.arange(T)
+        mask = pos[:, None] >= pos[None, :]  # (T, T), full sequence local
+    out = dot_product_attention(qh, kh, vh, mask=mask, scale=scale)
+
+    return _head_to_seq_sharded(out, axis_name)
+
+
+def ulysses_self_attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
+                           num_heads: int, axis_name: str = "sp",
+                           causal: bool = False) -> jax.Array:
+    """Fused qkv-projection + ulysses attention + output projection for
+    (B, T_local, E) sequence-sharded activations (the q/k/v projections
+    stay sequence-sharded — pure local matmuls)."""
+    B, T, E = x.shape
+    hd = E // num_heads
+    qkv = jnp.einsum("bte,fe->btf", x, wqkv)
+    qkv = qkv.reshape(B, T, 3, num_heads, hd)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+    ctx = ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+    ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+    return jnp.einsum("bte,fe->btf", ctx, wo)

@@ -1,0 +1,130 @@
+"""Tracing / profiling utilities (NVTX-range parity for TPU).
+
+Two annotation layers, matching what the reference's nvtx ranges gave it:
+
+- **Trace-time** (``jax.named_scope``): names the HLO emitted while the
+  scope is active, so XLA profiles, HLO dumps, and xprof op breakdowns
+  attribute time to framework phases ("syncbn_fwd", "allreduce", ...).
+- **Host-time** (``jax.profiler.TraceAnnotation``): a real wall-clock range
+  on the host timeline for eager sections (data loading, checkpointing).
+
+``range_push/range_pop`` mirror torch.cuda.nvtx.range_push/pop
+(reference sync_batchnorm.py:69,87); ``start_profile/stop_profile`` mirror
+the cudaProfilerStart/Stop window of examples/imagenet/main_amp.py:325-352
+on top of ``jax.profiler.start_trace/stop_trace``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["range_push", "range_pop", "nvtx_range", "annotate",
+           "start_profile", "stop_profile", "profile", "AverageMeter"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def range_push(name: str) -> int:
+    """Open a named range (torch.cuda.nvtx.range_push parity).  Returns the
+    new nesting depth.  Inside a jit trace this opens a named_scope (HLO
+    attribution); outside it opens a host profiler annotation."""
+    scope = jax.named_scope(name)
+    ann = jax.profiler.TraceAnnotation(name)
+    scope.__enter__()
+    ann.__enter__()
+    _stack().append((scope, ann))
+    return len(_stack())
+
+
+def range_pop() -> int:
+    """Close the innermost range (torch.cuda.nvtx.range_pop parity)."""
+    stack = _stack()
+    if not stack:
+        raise RuntimeError("range_pop() without matching range_push()")
+    scope, ann = stack.pop()
+    ann.__exit__(None, None, None)
+    scope.__exit__(None, None, None)
+    return len(stack)
+
+
+@contextlib.contextmanager
+def nvtx_range(name: str):
+    """Context-manager form; exception-safe (prefer over push/pop)."""
+    range_push(name)
+    try:
+        yield
+    finally:
+        range_pop()
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator: run the function under a named range."""
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with nvtx_range(label):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
+
+
+_trace_active = False
+
+
+def start_profile(logdir: str = "/tmp/apex_tpu_profile") -> None:
+    """Begin an xprof trace window (cudaProfilerStart parity,
+    main_amp.py:329)."""
+    global _trace_active
+    if not _trace_active:
+        jax.profiler.start_trace(logdir)
+        _trace_active = True
+
+
+def stop_profile() -> None:
+    """End the trace window (cudaProfilerStop parity, main_amp.py:351)."""
+    global _trace_active
+    if _trace_active:
+        jax.profiler.stop_trace()
+        _trace_active = False
+
+
+@contextlib.contextmanager
+def profile(logdir: str = "/tmp/apex_tpu_profile"):
+    start_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_profile()
+
+
+class AverageMeter:
+    """Running average tracker (reference examples/imagenet/main_amp.py:
+    415-430); used by the examples for loss/throughput reporting."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)

@@ -174,3 +174,51 @@ def test_ring_attention_matches_dense():
             ref = dot_product_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref),
                                    atol=2e-5)
+
+
+def test_ulysses_attention_matches_dense():
+    """All-to-all SP on the mesh == dense attention on the full sequence."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer import ulysses_attention
+    from apex_tpu.transformer.attention import dot_product_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    rng = np.random.RandomState(1)
+    B, H, T, D = 2, 4, 32, 8  # H divisible by sp=4
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+
+    for causal in (False, True):
+        def attn(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="sp", causal=causal)
+
+        uly = jax.jit(jax.shard_map(
+            attn, mesh=mesh, in_specs=(P(None, None, "sp"),) * 3,
+            out_specs=P(None, None, "sp"), check_vma=False))
+        out = uly(q, k, v)
+
+        if causal:
+            pos = np.arange(T)
+            mask = jnp.asarray(pos[:, None] >= pos[None, :])
+            ref = dot_product_attention(q, k, v, mask[None, None])
+        else:
+            ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_ulysses_head_count_check():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer import ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    x = jnp.ones((1, 3, 8, 4), jnp.float32)  # H=3 not divisible by 4
+
+    def attn(q):
+        return ulysses_attention(q, q, q, axis_name="sp")
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(jax.shard_map(
+            attn, mesh=mesh, in_specs=(P(None, None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False))(x)

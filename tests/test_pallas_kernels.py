@@ -158,3 +158,50 @@ def test_layer_norm_no_affine():
     dx, dw, db = pln.backward(dy, x, None, None, mean, inv)
     assert dw is None and db is None
     assert dx.shape == x.shape
+
+
+@pytest.mark.parametrize("adam_w_mode", [True, False])
+def test_pallas_lamb_matches_jnp(monkeypatch, adam_w_mode):
+    from apex_tpu.optimizers import FusedLAMB
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(37, 5), jnp.float32),
+              "b": jnp.asarray(rng.randn(129), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.randn(37, 5), jnp.float32),
+             "b": jnp.asarray(rng.randn(129), jnp.float32)}
+    opt = FusedLAMB(lr=0.01, weight_decay=0.01, adam_w_mode=adam_w_mode)
+    state = opt.init(params)
+
+    ref_p, ref_s = opt.step(params, state, grads)          # jnp path
+    ref_p2, _ = opt.step(ref_p, ref_s, grads)
+
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "0")
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    out_p, out_s = opt.step(params, state, grads)          # pallas path
+    out_p2, _ = opt.step(out_p, out_s, grads)
+
+    for k in params:
+        np.testing.assert_allclose(np.asarray(out_p[k]),
+                                   np.asarray(ref_p[k]), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_s.m[k]),
+                                   np.asarray(ref_s.m[k]), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_p2[k]),
+                                   np.asarray(ref_p2[k]), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_pallas_lamb_grad_clipping(monkeypatch):
+    # grads above max_grad_norm are pre-scaled by norm/max_norm
+    # (multi_tensor_lamb_stage_1.cu: clipped global-norm prescale)
+    from apex_tpu.optimizers import FusedLAMB
+    big = {"w": jnp.full((64,), 100.0, jnp.float32)}
+    params = {"w": jnp.ones((64,), jnp.float32)}
+    opt = FusedLAMB(lr=0.01, weight_decay=0.0, max_grad_norm=1.0)
+    state = opt.init(params)
+    ref_p, _ = opt.step(params, state, big)
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "0")
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    out_p, _ = opt.step(params, state, big)
+    np.testing.assert_allclose(np.asarray(out_p["w"]),
+                               np.asarray(ref_p["w"]), rtol=1e-5)

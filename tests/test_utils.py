@@ -1,0 +1,73 @@
+"""apex_tpu.utils: profiler range shims and AverageMeter."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import (range_push, range_pop, nvtx_range, annotate,
+                            AverageMeter)
+
+
+def test_range_push_pop_balanced():
+    assert range_push("outer") == 1
+    assert range_push("inner") == 2
+    assert range_pop() == 1
+    assert range_pop() == 0
+
+
+def test_range_pop_unbalanced_raises():
+    with pytest.raises(RuntimeError, match="range_pop"):
+        range_pop()
+
+
+def test_nvtx_range_inside_jit_names_hlo():
+    @jax.jit
+    def f(x):
+        with nvtx_range("my_hot_section"):
+            return x * 2.0
+
+    x = jnp.ones((4,))
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0)
+    hlo = f.lower(x).as_text(debug_info=True)
+    assert "my_hot_section" in hlo
+
+
+def test_annotate_decorator():
+    @annotate("scaled_add")
+    def g(a, b):
+        return a + 2 * b
+
+    assert float(g(jnp.ones(()), jnp.ones(()))) == 3.0
+    assert g.__name__ == "g"
+
+
+def test_average_meter():
+    m = AverageMeter()
+    m.update(1.0)
+    m.update(3.0)
+    assert m.avg == 2.0 and m.val == 3.0 and m.count == 2
+    m.update(5.0, n=2)
+    assert m.count == 4 and m.avg == pytest.approx(3.5)
+    m.reset()
+    assert m.count == 0 and m.avg == 0.0
+
+
+def test_syncbn_emits_named_scope():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.parallel import SyncBatchNorm
+    from apex_tpu import nn
+
+    bn = SyncBatchNorm(4)
+    params, state = bn.init(jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def fwd(p, x):
+        out, _ = nn.apply(bn, p, x, state=state, train=True)
+        return out
+
+    x = jnp.ones((4, 4, 2, 2))
+    lowered = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+        check_vma=False)).lower(params, x)
+    assert "sync_bn_stats" in lowered.as_text(debug_info=True)
