@@ -43,7 +43,7 @@ class RNNCell(Module):
             hidden_size
 
     def create_params(self, key):
-        ks = jax.random.split(key, 6)
+        ks = jax.random.split(key, 7)
         gh = self.gate_multiplier * self.hidden_size
         bound = 1.0 / math.sqrt(self.hidden_size)
         u = lambda k, shape: jax.random.uniform(
@@ -56,21 +56,36 @@ class RNNCell(Module):
         if self.cell == "mLSTM":
             p["w_mx"] = u(ks[4], (self.output_size, self.input_size))
             p["w_mh"] = u(ks[5], (self.output_size, self.output_size))
+        if self.output_size != self.hidden_size:
+            # optional output projection (reference RNNBackend.py:318-328:
+            # hidden[0] is projected to output_size and fed back recurrently).
+            # GRU's update gate mixes h elementwise with gate-space tensors,
+            # so a projected recurrent state is ill-defined there.
+            if self.cell == "GRU":
+                raise NotImplementedError(
+                    "output_size projection is not defined for GRU")
+            p["w_ho"] = u(ks[6], (self.output_size, self.hidden_size))
         return p
 
     def init_hidden(self, batch: int, dtype=jnp.float32):
-        shape = (batch, self.output_size)
-        return tuple(jnp.zeros(shape, dtype)
-                     for _ in range(self.n_hidden_states))
+        # hidden[0] (the recurrent output) is output_size; deeper states
+        # (e.g. the LSTM cell state) stay hidden_size
+        shapes = [(batch, self.output_size)] + \
+            [(batch, self.hidden_size)] * (self.n_hidden_states - 1)
+        return tuple(jnp.zeros(s, dtype) for s in shapes)
 
     def forward(self, params, x, hidden=None):
         """x: (T, B, F). Returns (out (T, B, H), final_hidden)."""
+        from ..nn import functional as F
         fn = CELLS[self.cell][0]
         if hidden is None:
             hidden = self.init_hidden(x.shape[1], x.dtype)
 
         def step(h, xt):
             new_h, out = fn(params, h, xt)
+            if "w_ho" in params:
+                out = F.linear(out, params["w_ho"])
+                new_h = (out,) + tuple(new_h[1:])
             return new_h, out
 
         final, outs = lax.scan(step, hidden, x)

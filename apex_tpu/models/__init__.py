@@ -4,3 +4,4 @@ from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
                      resnet50, resnet101, resnet152)
 from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
                    bert_large)
+from .dcgan import Generator, Discriminator, dcgan

@@ -1,0 +1,69 @@
+"""DCGAN generator/discriminator (the reference ships a DCGAN amp example,
+examples/dcgan/main_amp.py; the 64x64 topology is the standard
+Radford et al. 2015 layout).
+
+The generator upsamples z (B, nz, 1, 1) -> (B, nc, 64, 64) through
+strided transposed convs; the discriminator mirrors it downward to one
+logit. Both are amp-friendly: convs ride the MXU whitelist, BatchNorm
+stays fp32 under O2 (keep_batchnorm_fp32), and the final D output is a
+logit so the loss is the fp32 ``binary_cross_entropy_with_logits`` (the
+plain BCE form is banned under amp — apex_tpu.amp.lists.BANNED_FUNCS).
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["Generator", "Discriminator", "dcgan"]
+
+
+class Generator(nn.Module):
+    def __init__(self, nz: int = 100, ngf: int = 64, nc: int = 3):
+        super().__init__()
+        self.nz = nz
+        self.main = nn.Sequential([
+            # (nz, 1, 1) -> (ngf*8, 4, 4)
+            nn.ConvTranspose2d(nz, ngf * 8, 4, 1, 0, bias=False),
+            nn.BatchNorm2d(ngf * 8), nn.ReLU(),
+            # -> (ngf*4, 8, 8)
+            nn.ConvTranspose2d(ngf * 8, ngf * 4, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ngf * 4), nn.ReLU(),
+            # -> (ngf*2, 16, 16)
+            nn.ConvTranspose2d(ngf * 4, ngf * 2, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ngf * 2), nn.ReLU(),
+            # -> (ngf, 32, 32)
+            nn.ConvTranspose2d(ngf * 2, ngf, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ngf), nn.ReLU(),
+            # -> (nc, 64, 64)
+            nn.ConvTranspose2d(ngf, nc, 4, 2, 1, bias=False),
+            nn.Tanh(),
+        ])
+
+    def forward(self, params, z):
+        return self.main(params["main"], z)
+
+
+class Discriminator(nn.Module):
+    def __init__(self, ndf: int = 64, nc: int = 3):
+        super().__init__()
+        self.main = nn.Sequential([
+            # (nc, 64, 64) -> (ndf, 32, 32)
+            nn.Conv2d(nc, ndf, 4, 2, 1, bias=False),
+            nn.LeakyReLU(0.2),
+            nn.Conv2d(ndf, ndf * 2, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ndf * 2), nn.LeakyReLU(0.2),
+            nn.Conv2d(ndf * 2, ndf * 4, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ndf * 4), nn.LeakyReLU(0.2),
+            nn.Conv2d(ndf * 4, ndf * 8, 4, 2, 1, bias=False),
+            nn.BatchNorm2d(ndf * 8), nn.LeakyReLU(0.2),
+            # -> (1, 1, 1) logit
+            nn.Conv2d(ndf * 8, 1, 4, 1, 0, bias=False),
+        ])
+
+    def forward(self, params, x):
+        out = self.main(params["main"], x)
+        return out.reshape(out.shape[0])  # (B,) logits
+
+
+def dcgan(nz: int = 100, ngf: int = 64, ndf: int = 64, nc: int = 3):
+    return Generator(nz, ngf, nc), Discriminator(ndf, nc)

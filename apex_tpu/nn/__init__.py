@@ -2,7 +2,8 @@
 
 from .module import (Module, ModuleList, Sequential, apply, init,
                      current_context, ApplyContext)
-from .layers import (Linear, Conv2d, BatchNorm2d, LayerNorm, Embedding,
-                     Dropout, ReLU, GELU, Tanh, Sigmoid, Identity, Flatten,
-                     MaxPool2d, AvgPool2d, AdaptiveAvgPool2d)
+from .layers import (Linear, Conv2d, ConvTranspose2d, BatchNorm2d, LayerNorm,
+                     Embedding, Dropout, ReLU, LeakyReLU, GELU, Tanh, Sigmoid,
+                     Identity, Flatten, MaxPool2d, AvgPool2d,
+                     AdaptiveAvgPool2d)
 from . import functional

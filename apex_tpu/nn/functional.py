@@ -21,7 +21,8 @@ from jax import lax
 from ..amp import policy as _policy
 
 __all__ = [
-    "linear", "matmul", "conv2d", "relu", "gelu", "silu", "sigmoid", "tanh",
+    "linear", "matmul", "conv2d", "conv_transpose2d", "relu", "leaky_relu",
+    "gelu", "silu", "sigmoid", "tanh",
     "softmax", "log_softmax", "layer_norm", "batch_norm_stats",
     "batch_norm_apply", "dropout", "max_pool2d", "avg_pool2d",
     "adaptive_avg_pool2d", "embedding", "cross_entropy", "nll_loss",
@@ -86,12 +87,49 @@ def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
     return y
 
 
+@op("conv_transpose2d")
+def conv_transpose2d(x: jax.Array, weight: jax.Array,
+                     bias: Optional[jax.Array] = None,
+                     stride: Union[int, Tuple[int, int]] = 1,
+                     padding: Union[int, Tuple[int, int]] = 0,
+                     output_padding: Union[int, Tuple[int, int]] = 0
+                     ) -> jax.Array:
+    """NCHW transposed conv; weight (I, O, kH, kW) like torch.
+
+    Expressed as the gradient-of-conv form ``lax.conv_general_dilated``
+    with lhs dilation — the formulation XLA pattern-matches onto the MXU.
+    """
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(output_padding, int):
+        output_padding = (output_padding, output_padding)
+    kh, kw = weight.shape[2], weight.shape[3]
+    pads = tuple((k - 1 - p, k - 1 - p + op_)
+                 for k, p, op_ in zip((kh, kw), padding, output_padding))
+    # torch stores transposed-conv weights (in, out, kH, kW) spatially
+    # unflipped; the dilated-input conv needs the flipped OIHW kernel
+    w = jnp.flip(weight, axis=(2, 3)).transpose(1, 0, 2, 3)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pads,
+        lhs_dilation=stride,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None, None]
+    return y
+
+
 # ---------------------------------------------------------------------------
 # pointwise / activations
 # ---------------------------------------------------------------------------
 
 def relu(x: jax.Array) -> jax.Array:
     return jnp.maximum(x, 0)
+
+
+def leaky_relu(x: jax.Array, negative_slope: float = 0.01) -> jax.Array:
+    return jnp.where(x >= 0, x, x * negative_slope)
 
 
 @op("gelu")

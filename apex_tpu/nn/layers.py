@@ -21,9 +21,9 @@ from . import functional as F
 from .module import Module, current_context
 
 __all__ = [
-    "Linear", "Conv2d", "BatchNorm2d", "LayerNorm", "Embedding", "Dropout",
-    "ReLU", "GELU", "Tanh", "Sigmoid", "Identity", "Flatten", "MaxPool2d",
-    "AvgPool2d", "AdaptiveAvgPool2d",
+    "Linear", "Conv2d", "ConvTranspose2d", "BatchNorm2d", "LayerNorm",
+    "Embedding", "Dropout", "ReLU", "LeakyReLU", "GELU", "Tanh", "Sigmoid",
+    "Identity", "Flatten", "MaxPool2d", "AvgPool2d", "AdaptiveAvgPool2d",
 ]
 
 
@@ -85,6 +85,51 @@ class Conv2d(Module):
         return F.conv2d(x, params["weight"], params.get("bias"),
                         stride=self.stride, padding=self.padding,
                         dilation=self.dilation, groups=self.groups)
+
+
+class ConvTranspose2d(Module):
+    """NCHW transposed convolution (DCGAN generator upsampling path)."""
+
+    def __init__(self, in_channels: int, out_channels: int,
+                 kernel_size: Union[int, Tuple[int, int]],
+                 stride: Union[int, Tuple[int, int]] = 1,
+                 padding: Union[int, Tuple[int, int]] = 0,
+                 output_padding: Union[int, Tuple[int, int]] = 0,
+                 bias: bool = True):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.use_bias = bias
+
+    def create_params(self, key):
+        wk, bk = jax.random.split(key)
+        fan_in = self.in_channels * self.kernel_size[0] * self.kernel_size[1]
+        p = {"weight": _kaiming_uniform(
+            wk, (self.in_channels, self.out_channels, *self.kernel_size),
+            fan_in)}
+        if self.use_bias:
+            p["bias"] = _kaiming_uniform(bk, (self.out_channels,), fan_in)
+        return p
+
+    def forward(self, params, x):
+        return F.conv_transpose2d(x, params["weight"], params.get("bias"),
+                                  stride=self.stride, padding=self.padding,
+                                  output_padding=self.output_padding)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, params, x):
+        return F.leaky_relu(x, self.negative_slope)
 
 
 class BatchNorm2d(Module):

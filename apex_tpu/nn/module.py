@@ -174,6 +174,15 @@ class Module:
     def __call__(self, params: Dict[str, Any], *args, **kwargs):
         return self.forward(params, *args, **kwargs)
 
+    def apply(self, params: Dict[str, Any], *args,
+              state: Optional[Dict[str, Any]] = None, train: bool = False,
+              rng: Optional[jax.Array] = None, mutable: bool = True,
+              **kwargs):
+        """Functional apply returning ``(out, new_state)`` — see the
+        module-level :func:`apply`."""
+        return apply(self, params, *args, state=state, train=train,
+                     rng=rng, mutable=mutable, **kwargs)
+
     # -- conveniences -----------------------------------------------------
     def sub(self, params: Dict[str, Any], name: str) -> Dict[str, Any]:
         return params.get(name, {})

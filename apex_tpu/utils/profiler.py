@@ -37,8 +37,8 @@ def _stack():
 
 def range_push(name: str) -> int:
     """Open a named range (torch.cuda.nvtx.range_push parity).  Returns the
-    new nesting depth.  Inside a jit trace this opens a named_scope (HLO
-    attribution); outside it opens a host profiler annotation."""
+    new nesting depth.  Opens both a named_scope (HLO attribution when
+    tracing) and a host profiler annotation (timeline range)."""
     scope = jax.named_scope(name)
     ann = jax.profiler.TraceAnnotation(name)
     scope.__enter__()

@@ -56,22 +56,6 @@ def parse_args():
     return p.parse_args()
 
 
-class AverageMeter:
-    """Same helper as the reference example (main_amp.py:354-390)."""
-
-    def __init__(self):
-        self.reset()
-
-    def reset(self):
-        self.val = self.sum = self.count = self.avg = 0.0
-
-    def update(self, val, n=1):
-        self.val = val
-        self.sum += val * n
-        self.count += n
-        self.avg = self.sum / self.count
-
-
 def main():
     args = parse_args()
 
@@ -82,6 +66,7 @@ def main():
 
     import apex_tpu
     from apex_tpu import amp, nn, optimizers, parallel, models
+    from apex_tpu.utils import AverageMeter
     from apex_tpu.nn import functional as F
 
     ndev = len(jax.devices())

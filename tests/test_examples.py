@@ -1,0 +1,48 @@
+"""Example-script smoke tests (the reference treats its examples as the L1
+test drivers — tests/L1/common/main_amp.py is an instrumented clone of
+examples/imagenet).  Each runs as a subprocess on a tiny CPU config."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU in children
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    return subprocess.run([sys.executable, *args], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_simple_distributed_single_process():
+    r = _run(["examples/simple/distributed/distributed_data_parallel.py"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK: params identical" in r.stdout
+
+
+def test_multiproc_launcher_two_processes():
+    r = _run(["-m", "apex_tpu.parallel.multiproc", "--nprocs", "2",
+              "--backend", "cpu", "--port", "29531",
+              "examples/simple/distributed/distributed_data_parallel.py"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "2 processes" in r.stdout
+
+
+def test_dcgan_example_smoke():
+    r = _run(["examples/dcgan/main_amp.py", "-b", "4", "--iters", "2",
+              "--ngf", "8", "--ndf", "8", "--print-freq", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done" in r.stdout
+
+
+def test_imagenet_example_smoke():
+    r = _run(["examples/imagenet/main_amp.py", "--arch", "resnet18",
+              "-b", "2", "--iters", "2", "--image-size", "32",
+              "--print-freq", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -1,0 +1,128 @@
+"""apex_tpu.fp16_utils tests — manual master-weight toolkit + legacy
+FP16_Optimizer wrapper (reference test: tests/L0/run_fp16util/test_fp16util.py
+checks FP16Model leaves BN fp32; tests/L0/run_optimizers cover step/skip).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import fp16_utils, nn, optimizers
+from apex_tpu.fp16_utils import (prep_param_lists, master_params_to_model_params,
+                                 network_to_half, FP16Model, clip_grad_norm,
+                                 FP16_Optimizer, DynamicLossScaler)
+
+
+def _small_params():
+    k = jax.random.PRNGKey(0)
+    return {"w": jax.random.normal(k, (4, 3), jnp.float16),
+            "b": jnp.zeros((4,), jnp.float16)}
+
+
+def test_prep_param_lists_masters_fp32():
+    params = _small_params()
+    model_p, masters = prep_param_lists(params)
+    for leaf in jax.tree_util.tree_leaves(masters):
+        assert leaf.dtype == jnp.float32
+    # master values equal model values
+    for a, b in zip(jax.tree_util.tree_leaves(model_p),
+                    jax.tree_util.tree_leaves(masters)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b))
+
+
+def test_master_to_model_roundtrip():
+    params = _small_params()
+    _, masters = prep_param_lists(params)
+    masters = jax.tree_util.tree_map(lambda m: m + 0.25, masters)
+    new_model = master_params_to_model_params(masters, params)
+    for leaf in jax.tree_util.tree_leaves(new_model):
+        assert leaf.dtype == jnp.float16
+    np.testing.assert_allclose(
+        np.asarray(new_model["b"], np.float32), 0.25 * np.ones(4))
+
+
+def test_fp16model_keeps_batchnorm_fp32():
+    """Reference test_fp16util.py:50-75 — conversion halves everything
+    except BatchNorm params."""
+    model = nn.Sequential([nn.Conv2d(3, 4, 3), nn.BatchNorm2d(4),
+                           nn.ReLU(), nn.Linear(4, 2)])
+    fm = FP16Model(model)
+    params, _ = fm.init(jax.random.PRNGKey(0))
+    conv_leaves = jax.tree_util.tree_leaves(params["0"])
+    bn_leaves = jax.tree_util.tree_leaves(params["1"])
+    lin_leaves = jax.tree_util.tree_leaves(params["3"])
+    assert all(l.dtype == jnp.float16 for l in conv_leaves + lin_leaves)
+    assert all(l.dtype == jnp.float32 for l in bn_leaves)
+
+
+def test_clip_grad_norm_matches_manual():
+    grads = {"a": jnp.ones((3,)) * 3.0, "b": jnp.ones((4,)) * 4.0}
+    total = float(np.sqrt(3 * 9 + 4 * 16))
+    clipped, norm = clip_grad_norm(grads, max_norm=1.0)
+    assert abs(float(norm) - total) < 1e-5
+    new_norm = float(jnp.sqrt(sum(jnp.sum(g ** 2)
+                                  for g in jax.tree_util.tree_leaves(clipped))))
+    assert abs(new_norm - 1.0) < 1e-5
+
+
+def test_dynamic_loss_scaler_state_machine():
+    s = DynamicLossScaler(init_scale=2 ** 8, scale_window=4)
+    assert s.loss_scale == 2 ** 8
+    s.update_scale(overflow=True)
+    assert s.loss_scale == 2 ** 7
+    for _ in range(4):
+        s.update_scale(overflow=False)
+    assert s.loss_scale == 2 ** 8
+    assert s.has_overflow({"g": jnp.array([1.0, jnp.inf])})
+    assert not s.has_overflow({"g": jnp.array([1.0, 2.0])})
+
+
+def test_fp16_optimizer_step_and_overflow_skip():
+    params = _small_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 3), jnp.float16)
+
+    def loss_fn(p, x):
+        return jnp.sum((x @ p["w"].T.astype(x.dtype) + p["b"]) ** 2
+                       ).astype(jnp.float32)
+
+    opt = FP16_Optimizer(optimizers.SGD(lr=0.1),
+                         dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2.0 ** 8},
+                         verbose=False)
+    opt.setup(params)
+    before = np.asarray(opt.params["w"], np.float32)
+
+    loss = opt.backward(loss_fn, x)
+    assert jnp.isfinite(loss)
+    assert not opt.overflow
+    opt.step()
+    after = np.asarray(opt.params["w"], np.float32)
+    assert np.abs(after - before).max() > 0  # params moved
+
+    # overflow: plant an inf through a huge loss scale blowup
+    scale_before = opt.loss_scale
+
+    def inf_loss(p, x):
+        return loss_fn(p, x) * jnp.float32(jnp.inf)
+
+    opt.backward(inf_loss, x)
+    assert opt.overflow
+    at_overflow = np.asarray(opt.params["w"], np.float32)
+    opt.step()
+    skipped = np.asarray(opt.params["w"], np.float32)
+    np.testing.assert_array_equal(at_overflow, skipped)  # step skipped
+    assert opt.loss_scale == scale_before / 2  # scale halved
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    params = _small_params()
+    opt = FP16_Optimizer(optimizers.SGD(lr=0.1), static_loss_scale=128.0,
+                         verbose=False)
+    opt.setup(params)
+    sd = opt.state_dict()
+    opt2 = FP16_Optimizer(optimizers.SGD(lr=0.1), static_loss_scale=1.0,
+                          verbose=False)
+    opt2.setup(params)
+    opt2.load_state_dict(sd)
+    assert opt2.loss_scale == 128.0

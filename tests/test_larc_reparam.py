@@ -1,0 +1,122 @@
+"""LARC trust-ratio math + weight-norm reparameterization tests.
+
+Reference: apex/parallel/LARC.py:68-97 (adaptive lr, clip vs scale mode,
+absorbed weight decay) and apex/reparameterization/weight_norm.py:39-78
+(w = g * v/||v||; the reference snapshot is broken — SURVEY.md §2.1 — so
+these tests pin the *working* semantics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import nn, optimizers
+from apex_tpu.parallel import LARC
+from apex_tpu.reparameterization import (apply_weight_norm,
+                                         remove_weight_norm, compute_weight)
+
+
+def test_larc_clip_mode_matches_manual():
+    lr, tc = 0.5, 0.02
+    p = {"w": jnp.ones((4,)) * 2.0}       # ||p|| = 4
+    g = {"w": jnp.ones((4,)) * 0.1}       # ||g|| = 0.2
+    opt = LARC(optimizers.SGD(lr=lr), trust_coefficient=tc, clip=True)
+    state = opt.init(p)
+    new_p, _ = opt.update(g, state, p)
+
+    p_norm, g_norm = 4.0, 0.2
+    adaptive = tc * p_norm / (g_norm + 1e-8)          # = 0.4
+    eff = min(adaptive / lr, 1.0)                     # clip mode
+    expected = 2.0 - lr * eff * 0.1
+    np.testing.assert_allclose(np.asarray(new_p["w"]),
+                               np.full(4, expected), rtol=1e-5)
+
+
+def test_larc_scale_mode_matches_manual():
+    lr, tc = 0.5, 0.02
+    p = {"w": jnp.ones((4,)) * 2.0}
+    g = {"w": jnp.ones((4,)) * 0.1}
+    opt = LARC(optimizers.SGD(lr=lr), trust_coefficient=tc, clip=False)
+    state = opt.init(p)
+    new_p, _ = opt.update(g, state, p)
+    adaptive = tc * 4.0 / (0.2 + 1e-8)
+    expected = 2.0 - lr * adaptive * 0.1
+    np.testing.assert_allclose(np.asarray(new_p["w"]),
+                               np.full(4, expected), rtol=1e-5)
+
+
+def test_larc_absorbs_weight_decay():
+    """wd moves into the denominator + grad, inner optimizer sees wd=0
+    (reference LARC.py:81-95)."""
+    lr, tc, wd = 0.5, 0.02, 0.01
+    inner = optimizers.SGD(lr=lr, weight_decay=wd)
+    opt = LARC(inner, trust_coefficient=tc, clip=False)
+    assert inner.weight_decay == 0.0
+    p = {"w": jnp.ones((4,)) * 2.0}
+    g = {"w": jnp.ones((4,)) * 0.1}
+    new_p, _ = opt.update(g, opt.init(p), p)
+    adaptive = tc * 4.0 / (0.2 + wd * 4.0 + 1e-8)
+    expected = 2.0 - lr * adaptive * (0.1 + wd * 2.0)
+    np.testing.assert_allclose(np.asarray(new_p["w"]),
+                               np.full(4, expected), rtol=1e-5)
+
+
+def test_larc_zero_grad_guard():
+    p = {"w": jnp.ones((4,))}
+    g = {"w": jnp.zeros((4,))}
+    opt = LARC(optimizers.SGD(lr=0.1))
+    new_p, _ = opt.update(g, opt.init(p), p)
+    np.testing.assert_array_equal(np.asarray(new_p["w"]), np.ones(4))
+
+
+def test_weight_norm_preserves_initial_output():
+    """At init g = ||w||, so the wrapped module computes the same output."""
+    lin = nn.Linear(6, 4)
+    params, _ = lin.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 6))
+    ref, _ = lin.apply(params, x)
+
+    wn = apply_weight_norm(nn.Linear(6, 4), name="weight", dim=0)
+    wp, _ = wn.init(jax.random.PRNGKey(0))
+    out, _ = wn.apply(wp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_param_structure_and_grad():
+    wn = apply_weight_norm(nn.Linear(6, 4), name="weight", dim=0)
+    params, _ = wn.init(jax.random.PRNGKey(0))
+    flat = params
+    names = set(jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map_with_path(lambda p, _: str(p), flat)))
+    assert any("weight_g" in n for n in names)
+    assert any("weight_v" in n for n in names)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 6))
+
+    def loss(p):
+        out, _ = wn.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert all(jnp.all(jnp.isfinite(g))
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_remove_weight_norm_bakes_weight():
+    wn = apply_weight_norm(nn.Linear(6, 4), name="weight", dim=0)
+    params, _ = wn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 6))
+    ref, _ = wn.apply(params, x)
+    plain, plain_params = remove_weight_norm(wn, params)
+    out, _ = plain.apply(plain_params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compute_weight_unit_norm():
+    v = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+    g = jnp.ones((4, 1))
+    w = compute_weight(g, v, dim=0)
+    norms = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2, axis=1))
+    np.testing.assert_allclose(np.asarray(norms), np.ones(4), rtol=1e-5)

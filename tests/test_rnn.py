@@ -1,0 +1,113 @@
+"""apex_tpu.RNN tests — scan-based stacked/bidirectional RNN + cells.
+
+Mirrors the reference's RNN coverage (tests/L0/run_amp/test_rnn.py drives
+cell/layer casts through real layers); here we check shapes, hidden-state
+plumbing, jit/eager agreement, and gradient flow for every factory
+(reference apex/RNN/models.py:19-52).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import RNN
+
+T, B, F, H = 5, 3, 4, 6
+
+
+def _run(model, x, hidden=None):
+    params, _ = model.init(jax.random.PRNGKey(0))
+    (out, _h), _ = model.apply(params, x, hidden)
+    return params, out
+
+
+@pytest.mark.parametrize("factory", [RNN.LSTM, RNN.GRU, RNN.ReLU, RNN.Tanh,
+                                     RNN.mLSTM])
+def test_shapes(factory):
+    model = factory(F, H, num_layers=2)
+    x = jnp.ones((T, B, F))
+    _, out = _run(model, x)
+    assert out.shape == (T, B, H)
+    assert jnp.all(jnp.isfinite(out))
+
+
+def test_bidirectional_concat():
+    model = RNN.LSTM(F, H, bidirectional=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, B, F))
+    _, out = _run(model, x)
+    assert out.shape == (T, B, 2 * H)
+
+
+def test_output_projection():
+    model = RNN.LSTM(F, H, output_size=7)
+    x = jnp.ones((T, B, F))
+    _, out = _run(model, x)
+    assert out.shape == (T, B, 7)
+
+
+def test_output_projection_rejected_for_gru():
+    with pytest.raises(NotImplementedError):
+        m = RNN.GRU(F, H, output_size=7)
+        m.init(jax.random.PRNGKey(0))
+
+
+def test_jit_matches_eager():
+    model = RNN.LSTM(F, H, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(2), (T, B, F))
+    params, _ = model.init(jax.random.PRNGKey(0))
+
+    def fwd(p, x):
+        (out, _h), _ = model.apply(p, x)
+        return out
+
+    eager = fwd(params, x)
+    jitted = jax.jit(fwd)(params, x)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_grad_flows_to_all_layers():
+    model = RNN.LSTM(F, H, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(3), (T, B, F))
+    params, _ = model.init(jax.random.PRNGKey(0))
+
+    def loss(p):
+        (out, _h), _ = model.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert leaves and all(jnp.all(jnp.isfinite(g)) for g in leaves)
+    # every layer's weights receive nonzero gradient
+    assert all(float(jnp.max(jnp.abs(g))) > 0 for g in leaves)
+
+
+def test_hidden_state_carries_information():
+    """Feeding the final hidden state back must differ from a cold start."""
+    model = RNN.LSTM(F, H)
+    x = jax.random.normal(jax.random.PRNGKey(4), (T, B, F))
+    params, _ = model.init(jax.random.PRNGKey(0))
+    (_out, h), _ = model.apply(params, x)
+    (cold, _h1), _ = model.apply(params, x)
+    (warm, _h2), _ = model.apply(params, x, h)
+    assert float(jnp.max(jnp.abs(cold - warm))) > 1e-6
+
+
+def test_relu_cell_matches_manual_recurrence():
+    """Single-layer ReLU RNN equals the hand-written h' = relu(Wx+Uh+b)."""
+    model = RNN.ReLU(F, H)
+    x = jax.random.normal(jax.random.PRNGKey(5), (T, B, F))
+    params, _ = model.init(jax.random.PRNGKey(0))
+    (out, _h), _ = model.apply(params, x)
+
+    cell = params["rnns"]["0"]
+    w_ih, w_hh = np.asarray(cell["w_ih"]), np.asarray(cell["w_hh"])
+    b = np.asarray(cell["b_ih"]) + np.asarray(cell["b_hh"])
+    h = np.zeros((B, H), np.float32)
+    ref = []
+    for t in range(T):
+        h = np.maximum(np.asarray(x[t]) @ w_ih.T + h @ w_hh.T + b, 0.0)
+        ref.append(h)
+    np.testing.assert_allclose(np.asarray(out), np.stack(ref),
+                               rtol=1e-5, atol=1e-5)
