@@ -202,6 +202,13 @@ def batch_norm_stats(x: jax.Array, axes: Tuple[int, ...]
 def batch_norm_apply(x: jax.Array, mean: jax.Array, var: jax.Array,
                      weight: Optional[jax.Array], bias: Optional[jax.Array],
                      eps: float, channel_axis: int = 1) -> jax.Array:
+    from ..ops import dispatch
+    if x.ndim == 4 and channel_axis == 1 and dispatch.use_pallas_for(x):
+        from ..ops.pallas_syncbn import batch_norm_apply_fused
+        C = x.shape[1]
+        w = weight if weight is not None else jnp.ones((C,), jnp.float32)
+        b = bias if bias is not None else jnp.zeros((C,), jnp.float32)
+        return batch_norm_apply_fused(x, mean, var, w, b, float(eps))
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
     inv = lax.rsqrt(var.astype(jnp.float32) + eps)

@@ -109,7 +109,9 @@ class ConvTranspose2d(Module):
 
     def create_params(self, key):
         wk, bk = jax.random.split(key)
-        fan_in = self.in_channels * self.kernel_size[0] * self.kernel_size[1]
+        # torch derives transposed-conv fan_in from weight.size(1)
+        # (= out_channels), not in_channels
+        fan_in = self.out_channels * self.kernel_size[0] * self.kernel_size[1]
         p = {"weight": _kaiming_uniform(
             wk, (self.in_channels, self.out_channels, *self.kernel_size),
             fan_in)}

@@ -8,6 +8,8 @@ Kernel inventory (TPU-native equivalents of the reference csrc/ tree):
   pallas_layer_norm   — fused LayerNorm fwd/bwd row reductions
                         (csrc/layer_norm_cuda_kernel.cu)
   pallas_lamb         — LAMB stage1/stage2 (csrc/multi_tensor_lamb_stage_*.cu)
+  pallas_syncbn       — fused BatchNorm normalize-apply fwd/bwd
+                        (csrc/welford.cu:298-318,325-410)
 """
 
 from . import dispatch

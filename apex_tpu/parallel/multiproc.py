@@ -15,12 +15,16 @@ Two pieces:
   reference's world_size==1 passthrough paths.
 - ``python -m apex_tpu.parallel.multiproc [--nprocs N] script.py args...``
   — the *launcher*: spawns N local processes with the wiring set, streams
-  their output, and exits non-zero if any child fails. With
-  ``--backend cpu`` (default when no TPU is visible) each child runs on
-  host-platform devices, giving a real multi-process collective runtime
-  on one machine — the analogue of the reference's single-node
-  ``torch.distributed.launch --nproc_per_node=2`` test setup
-  (tests/L1/cross_product_distributed/run.sh).
+  their output, and exits non-zero if any child fails (killing the
+  survivors, which would otherwise block in distributed init). With
+  ``--backend cpu`` each child runs on host-platform devices, giving a
+  real multi-process collective runtime on one machine — the analogue of
+  the reference's single-node ``torch.distributed.launch
+  --nproc_per_node=2`` test setup
+  (tests/L1/cross_product_distributed/run.sh).  The default ``auto``
+  inherits the environment's platform; on a host with a single TPU,
+  multiple children would contend for it — pass ``--backend cpu`` there
+  (the launcher warns).
 """
 
 from __future__ import annotations
@@ -75,6 +79,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     coord = f"127.0.0.1:{args.port}"
+    if (args.backend == "auto" and args.nprocs > 1
+            and os.environ.get("PALLAS_AXON_POOL_IPS")):
+        print("[multiproc] warning: a TPU plugin is active and all "
+              f"{args.nprocs} children will contend for it; pass "
+              "--backend cpu for local multi-process runs", file=sys.stderr)
     children = []
     for rank in range(args.nprocs):
         env = dict(os.environ)
@@ -95,15 +104,26 @@ def main(argv=None) -> int:
         children.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
 
-    # wait on children like the reference's final loop; fail fast on error
+    # wait on children like the reference's final loop, but poll so one
+    # crashed rank kills the others instead of deadlocking the group
+    # (a failed rank leaves the survivors blocked in distributed init)
+    import time
     rc = 0
-    for c in children:
-        c.wait()
-        rc = rc or c.returncode
-    if rc:
+    try:
+        while True:
+            codes = [c.poll() for c in children]
+            failed = [code for code in codes if code not in (None, 0)]
+            if failed:
+                rc = failed[0]
+                break
+            if all(code is not None for code in codes):
+                break
+            time.sleep(0.2)
+    finally:
         for c in children:
-            if c.returncode is None:
+            if c.poll() is None:
                 c.kill()
+                c.wait()
     return rc
 
 

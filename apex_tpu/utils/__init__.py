@@ -11,6 +11,10 @@ ranges); this module provides both behind the reference's push/pop shape.
 from .profiler import (range_push, range_pop, nvtx_range, annotate,
                        start_profile, stop_profile, profile,
                        AverageMeter)
+from .checkpoint import (save_checkpoint, restore_checkpoint, latest_step,
+                         available_steps)
 
 __all__ = ["range_push", "range_pop", "nvtx_range", "annotate",
-           "start_profile", "stop_profile", "profile", "AverageMeter"]
+           "start_profile", "stop_profile", "profile", "AverageMeter",
+           "save_checkpoint", "restore_checkpoint", "latest_step",
+           "available_steps"]

@@ -1,0 +1,124 @@
+"""Checkpoint / resume for whole training states.
+
+The reference relies on raw torch ``state_dict`` conventions and ships the
+"option 2" pattern — fp32 masters and loss-scaler state saved alongside
+the half model weights (fp16_utils/fp16_optimizer.py:298-359;
+examples/imagenet/main_amp.py:170-185 epoch/best-prec resume).  SURVEY.md
+§5 flags that the reference's new amp API *lacks* an ``amp.state_dict``;
+apex_tpu closes that gap: ``amp.state_dict`` exists, and this module
+persists any training-state pytree — params, optimizer state (masters
+included, they are ordinary optimizer-state leaves here), BN running
+stats, scaler state, step counters — to one atomic file.
+
+Format: a single ``.npz`` holding every leaf keyed by its pytree keypath
+string.  Restore is template-shaped: you pass the pytree you want filled
+(built the same way as at save time), so no pickled treedefs are needed
+and the format is stable across sessions and jax versions.
+
+    ckpt.save_checkpoint(dir, step, {"params": params, "opt": opt_state,
+                                     "bn": bn_state, "amp": amp_sd})
+    state = ckpt.restore_checkpoint(dir, template)          # latest
+    state = ckpt.restore_checkpoint(dir, template, step=7)  # specific
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
+           "available_steps"]
+
+_FMT = "ckpt_{step:08d}.npz"
+_RE = re.compile(r"ckpt_(\d{8})\.npz$")
+
+
+def _leaf_dict(tree: Any) -> dict:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if key in out:
+            raise ValueError(f"duplicate keypath {key!r}")
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "V" or arr.dtype.name in ("bfloat16",):
+            # npz has no bfloat16/fp8; fp32 holds them exactly, and restore
+            # casts back to the template dtype
+            arr = np.asarray(leaf, np.float32)
+        out[key] = arr
+    return out
+
+
+def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
+                    keep: Optional[int] = None) -> str:
+    """Write ``tree`` for ``step``; atomic (write-temp + rename).  With
+    ``keep``, retain only the newest ``keep`` checkpoints."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    leaves = _leaf_dict(tree)
+    path = os.path.join(ckpt_dir, _FMT.format(step=step))
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **leaves)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    if keep is not None:
+        for s in available_steps(ckpt_dir)[:-keep]:
+            os.unlink(os.path.join(ckpt_dir, _FMT.format(step=s)))
+    return path
+
+
+def available_steps(ckpt_dir: str) -> list:
+    steps = []
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            m = _RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = available_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, template: Any,
+                       step: Optional[int] = None) -> Any:
+    """Return ``template`` with every leaf replaced by the stored value
+    (cast to the template leaf's dtype, shapes must match).  ``step=None``
+    loads the newest checkpoint; raises FileNotFoundError if none."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir!r}")
+    path = os.path.join(ckpt_dir, _FMT.format(step=step))
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with np.load(path) as data:
+        stored = dict(data)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    out = []
+    for kp, leaf in flat:
+        key = jax.tree_util.keystr(kp)
+        if key not in stored:
+            raise KeyError(
+                f"checkpoint {path} has no entry for {key!r} — template "
+                "structure does not match the saved state")
+        arr = stored[key]
+        if hasattr(leaf, "shape") and tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {arr.shape} vs "
+                f"template {leaf.shape}")
+        dtype = getattr(leaf, "dtype", arr.dtype)
+        out.append(jnp.asarray(arr, dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)

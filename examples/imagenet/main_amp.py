@@ -53,6 +53,11 @@ def parse_args():
     p.add_argument("--prof", action="store_true",
                    help="emit a jax profiler trace of 10 hot iterations")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save an epoch checkpoint here (keep last 3)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in "
+                        "--checkpoint-dir")
     return p.parse_args()
 
 
@@ -146,6 +151,21 @@ def main():
 
     state = (params, bn_state, opt_state)
 
+    start_epoch = 0
+    if args.checkpoint_dir and args.resume:
+        from apex_tpu.utils import checkpoint as ckpt
+        last = ckpt.latest_step(args.checkpoint_dir)
+        if last is not None:
+            state = ckpt.restore_checkpoint(args.checkpoint_dir, state,
+                                            step=last)
+            start_epoch = last
+            print(f"=> resumed from epoch {last} "
+                  f"(reference main_amp.py:170-185 resume flow)")
+            if start_epoch >= args.epochs:
+                print(f"=> nothing to do: resumed epoch {start_epoch} >= "
+                      f"--epochs {args.epochs}")
+                return 0.0
+
     print("=> compiling train step...")
     t0 = time.time()
     xb, yb = get_batch(0)
@@ -157,7 +177,7 @@ def main():
     losses = AverageMeter()
     top1 = AverageMeter()
 
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         end = time.time()
         for i in range(args.iters):
             if args.prof and epoch == 0 and i == 10:
@@ -180,6 +200,10 @@ def main():
                       f"Loss {losses.val:.4f} ({losses.avg:.4f})  "
                       f"Prec@1 {top1.val:.2f}  "
                       f"scale {float(metrics['loss_scale']):.0f}")
+        if args.checkpoint_dir:
+            from apex_tpu.utils import checkpoint as ckpt
+            ckpt.save_checkpoint(args.checkpoint_dir, epoch + 1, state,
+                                 keep=3)
     ips = global_batch / batch_time.avg
     print(f"=> done. avg {ips:.1f} img/s over {args.iters} iters "
           f"({ips / ndev:.1f} img/s/device)")

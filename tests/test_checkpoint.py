@@ -1,0 +1,102 @@
+"""Checkpoint/resume tests — the full "option 2" flow of the reference
+(fp32 masters + scaler state persisted with the half model weights,
+fp16_utils/fp16_optimizer.py:298-359) through apex_tpu.utils.checkpoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, nn, optimizers, utils
+from apex_tpu.nn import functional as F
+
+
+def _train_state():
+    model, opt = amp.initialize(
+        nn.Sequential([nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2)]),
+        optimizers.FusedAdam(lr=1e-2), opt_level="O2", verbosity=0,
+        hard_override=True)
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    return model, opt, params, state, opt_state
+
+
+def _step(model, opt, params, state, opt_state, x, y):
+    def loss_fn(p):
+        out, s = model.apply(p, x, state=state, train=True)
+        return F.mse_loss(out, y), s
+
+    loss, state, grads = amp.scaled_grad(loss_fn, params, opt_state,
+                                         has_aux=True)
+    params, opt_state, _ = opt.step(params, opt_state, grads)
+    return params, state, opt_state, loss
+
+
+def test_roundtrip_identity(tmp_path):
+    model, opt, params, state, opt_state = _train_state()
+    tree = {"params": params, "bn": state, "opt": opt_state,
+            "amp": amp.state_dict(opt_state), "step": jnp.asarray(3)}
+    utils.save_checkpoint(str(tmp_path), 3, tree)
+    restored = utils.restore_checkpoint(str(tmp_path), tree)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.dtype == b.dtype
+
+
+def test_resume_continues_identically(tmp_path):
+    """Train 3 steps, checkpoint, train 2 more; restoring and re-running
+    the last 2 steps must land on bitwise-identical params — the L1-style
+    resume guarantee."""
+    model, opt, params, state, opt_state = _train_state()
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 2))
+
+    for _ in range(3):
+        params, state, opt_state, _ = _step(model, opt, params, state,
+                                            opt_state, x, y)
+    utils.save_checkpoint(str(tmp_path), 3,
+                          {"params": params, "bn": state, "opt": opt_state})
+    for _ in range(2):
+        params, state, opt_state, _ = _step(model, opt, params, state,
+                                            opt_state, x, y)
+
+    # resume from the saved checkpoint into freshly-built (different) state
+    m2, o2, p2, s2, os2 = _train_state()
+    r = utils.restore_checkpoint(str(tmp_path),
+                                 {"params": p2, "bn": s2, "opt": os2})
+    p2, s2, os2 = r["params"], r["bn"], r["opt"]
+    for _ in range(2):
+        p2, s2, os2, _ = _step(m2, o2, p2, s2, os2, x, y)
+
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_retention_and_latest(tmp_path):
+    tree = {"w": jnp.zeros((2,))}
+    for s in (1, 2, 3, 4):
+        utils.save_checkpoint(str(tmp_path), s, tree, keep=2)
+    assert utils.available_steps(str(tmp_path)) == [3, 4]
+    assert utils.latest_step(str(tmp_path)) == 4
+
+
+def test_restore_specific_step(tmp_path):
+    for s in (1, 2):
+        utils.save_checkpoint(str(tmp_path), s,
+                              {"w": jnp.full((2,), float(s))})
+    r = utils.restore_checkpoint(str(tmp_path), {"w": jnp.zeros((2,))},
+                                 step=1)
+    np.testing.assert_array_equal(np.asarray(r["w"]), [1.0, 1.0])
+
+
+def test_template_mismatch_raises(tmp_path):
+    utils.save_checkpoint(str(tmp_path), 1, {"w": jnp.zeros((2,))})
+    with pytest.raises(KeyError):
+        utils.restore_checkpoint(str(tmp_path), {"other": jnp.zeros((2,))})
+    with pytest.raises(ValueError):
+        utils.restore_checkpoint(str(tmp_path), {"w": jnp.zeros((3,))})
+    with pytest.raises(FileNotFoundError):
+        utils.restore_checkpoint(str(tmp_path) + "/none",
+                                 {"w": jnp.zeros((2,))})
