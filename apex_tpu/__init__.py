@@ -36,5 +36,6 @@ from . import reparameterization
 from . import transformer
 from . import models
 from . import utils
+from . import data
 
 __version__ = "0.1.0"

@@ -73,6 +73,18 @@ def _try_load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float)]
     lib.apex_native_version.restype = ctypes.c_int
+    lib.apex_loader_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.apex_loader_create.restype = ctypes.c_void_p
+    lib.apex_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.apex_loader_next.restype = ctypes.c_int64
+    lib.apex_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.apex_loader_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 

@@ -19,6 +19,7 @@
 #include <cstring>
 #include <functional>
 #include <mutex>
+#include <numeric>
 #include <queue>
 #include <thread>
 #include <vector>
@@ -96,6 +97,24 @@ class ThreadPool {
 
 }  // namespace
 
+namespace {
+
+// Shared per-image normalize: uint8 HWC plane gather -> fp32 CHW planes.
+// Used by the one-shot preprocess API and the prefetching loader.
+inline void NormalizeImage(const uint8_t* src, float* dst, int64_t h,
+                           int64_t w, int64_t c, const float* mean,
+                           const float* inv_std) {
+  for (int64_t k = 0; k < c; ++k) {
+    float mk = mean[k], ik = inv_std[k];
+    float* plane = dst + k * h * w;
+    for (int64_t p = 0; p < h * w; ++p) {
+      plane[p] = (static_cast<float>(src[p * c + k]) - mk) * ik;
+    }
+  }
+}
+
+}  // namespace
+
 extern "C" {
 
 // Concatenate n same-dtype host tensors into one contiguous buffer
@@ -169,18 +188,191 @@ void apex_preprocess_nhwc_u8_to_nchw_f32(const uint8_t* in, float* out,
     const uint8_t* src = in + img * h * w * c;
     float* dst = out + img * c * h * w;
     pool.Submit([src, dst, h, w, c, mean, inv] {
-      for (int64_t k = 0; k < c; ++k) {
-        float mk = mean[k], ik = inv[k];
-        float* plane = dst + k * h * w;
-        for (int64_t p = 0; p < h * w; ++p) {
-          plane[p] = (static_cast<float>(src[p * c + k]) - mk) * ik;
-        }
-      }
+      NormalizeImage(src, dst, h, w, c, mean, inv);
     });
   }
   pool.Wait();
 }
 
-int apex_native_version() { return 1; }
+int apex_native_version() { return 2; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Prefetching data loader: the native input pipeline.
+//
+// The reference's data_prefetcher (examples/imagenet/main_amp.py:264-300)
+// overlaps H2D copies + normalization with compute on a side CUDA stream.
+// The TPU-native equivalent is host-side: worker threads assemble
+// normalized NCHW fp32 batches into a ring of slots *ahead* of the
+// training loop, so the Python step only wraps a ready pointer and hands
+// it to device_put while the next batches are already being built.
+//
+// Ordered delivery: batch numbers are assigned under the slot mutex, so
+// the outstanding batches always occupy the available slots and the
+// consumer (who demands batch k before k+1) can never deadlock.
+// Shuffling is a per-epoch affine bijection i -> (a*i + c) % n (stateless,
+// workers never coordinate about epoch boundaries).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Slot {
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+  int64_t batch = -1;
+  enum State { kFree, kFilling, kReady, kInUse } state = kFree;
+};
+
+struct Loader {
+  const uint8_t* images;  // (n, h, w, c) borrowed; caller keeps it alive
+  const int32_t* labels;  // (n,)
+  int64_t n, h, w, c, batch;
+  std::vector<float> mean, inv_std;
+  bool shuffle;
+  uint64_t seed;
+  int64_t batches_per_epoch;
+
+  std::vector<Slot> slots;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  int64_t next_fill = 0;
+  int64_t next_deliver = 0;
+  bool stop = false;
+
+  int64_t SampleIndex(int64_t global_batch, int64_t j) const {
+    int64_t epoch = global_batch / batches_per_epoch;
+    int64_t i = (global_batch % batches_per_epoch) * batch + j;
+    if (!shuffle) return i;
+    // affine bijection with a odd and gcd(a, n) == 1
+    uint64_t mix = seed + 0x9e3779b97f4a7c15ull * (epoch + 1);
+    uint64_t a = (mix | 1) % n;
+    if (a == 0) a = 1;
+    while (std::gcd<uint64_t, uint64_t>(a, n) != 1) a += 2;
+    uint64_t cshift = (mix >> 17) % n;
+    return static_cast<int64_t>((a * i + cshift) % n);
+  }
+
+  void Fill(Slot& s, int64_t b) {
+    float* dst_base = s.images.data();
+    for (int64_t j = 0; j < batch; ++j) {
+      int64_t src_idx = SampleIndex(b, j);
+      NormalizeImage(images + src_idx * h * w * c,
+                     dst_base + j * c * h * w, h, w, c, mean.data(),
+                     inv_std.data());
+      s.labels[j] = labels[src_idx];
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Slot* s = nullptr;
+      int64_t b;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_free.wait(lock, [this] {
+          if (stop) return true;
+          for (auto& sl : slots)
+            if (sl.state == Slot::kFree) return true;
+          return false;
+        });
+        if (stop) return;
+        for (auto& sl : slots) {
+          if (sl.state == Slot::kFree) { s = &sl; break; }
+        }
+        b = next_fill++;  // assigned under the lock: see header comment
+        s->state = Slot::kFilling;
+        s->batch = b;
+      }
+      Fill(*s, b);
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        s->state = Slot::kReady;
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* apex_loader_create(const uint8_t* images, const int32_t* labels,
+                         int64_t n, int64_t h, int64_t w, int64_t c,
+                         int64_t batch, int depth, int num_workers,
+                         uint64_t seed, const float* mean,
+                         const float* stddev, int shuffle) {
+  if (n < batch || batch <= 0 || depth <= 0 || num_workers <= 0)
+    return nullptr;
+  auto* L = new Loader();
+  L->images = images;
+  L->labels = labels;
+  L->n = n; L->h = h; L->w = w; L->c = c; L->batch = batch;
+  L->shuffle = shuffle != 0;
+  L->seed = seed;
+  L->batches_per_epoch = n / batch;  // drop-last
+  L->mean.assign(mean, mean + c);
+  L->inv_std.resize(c);
+  for (int64_t k = 0; k < c; ++k) L->inv_std[k] = 1.0f / stddev[k];
+  L->slots.resize(depth);
+  for (auto& s : L->slots) {
+    s.images.resize(batch * c * h * w);
+    s.labels.resize(batch);
+  }
+  for (int i = 0; i < num_workers; ++i)
+    L->workers.emplace_back([L] { L->WorkerLoop(); });
+  return L;
+}
+
+// Blocks until the next in-order batch is ready; returns its index and
+// pointers into the slot (valid until apex_loader_release of that pointer).
+int64_t apex_loader_next(void* loader, const float** out_images,
+                         const int32_t** out_labels) {
+  auto* L = static_cast<Loader*>(loader);
+  std::unique_lock<std::mutex> lock(L->mu);
+  Slot* hit = nullptr;
+  L->cv_ready.wait(lock, [&] {
+    for (auto& s : L->slots) {
+      if (s.state == Slot::kReady && s.batch == L->next_deliver) {
+        hit = &s;
+        return true;
+      }
+    }
+    return false;
+  });
+  hit->state = Slot::kInUse;
+  L->next_deliver++;
+  *out_images = hit->images.data();
+  *out_labels = hit->labels.data();
+  return hit->batch;
+}
+
+// Return a delivered slot (identified by its images pointer) to the pool.
+void apex_loader_release(void* loader, const float* images_ptr) {
+  auto* L = static_cast<Loader*>(loader);
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    for (auto& s : L->slots) {
+      if (s.images.data() == images_ptr && s.state == Slot::kInUse) {
+        s.state = Slot::kFree;
+        break;
+      }
+    }
+  }
+  L->cv_free.notify_one();
+}
+
+void apex_loader_destroy(void* loader) {
+  auto* L = static_cast<Loader*>(loader);
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    L->stop = true;
+  }
+  L->cv_free.notify_all();
+  for (auto& wkr : L->workers) wkr.join();
+  delete L;
+}
 
 }  // extern "C"

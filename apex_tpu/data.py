@@ -1,0 +1,165 @@
+"""apex_tpu.data — the prefetching input pipeline.
+
+TPU-native equivalent of the reference example's ``data_prefetcher``
+(examples/imagenet/main_amp.py:264-300), which overlapped H2D copies and
+normalization with compute on a side CUDA stream.  On TPU the device side
+is XLA's job; the host side — batch assembly, uint8→fp32 NCHW normalize,
+shuffling — is the bottleneck and runs in the C++ runtime
+(apex_tpu/_native/apex_tpu_C.cpp, ``apex_loader_*``): worker threads fill
+a ring of slots ahead of the training loop, delivery is in batch order,
+and the Python step only wraps a ready buffer for ``device_put``.
+
+Falls back to a pure-numpy implementation when the native library is
+unavailable (the reference's Python-only build invariant).
+
+    loader = DataLoader(images_u8_nhwc, labels, batch_size=128,
+                        shuffle=True, prefetch=3, workers=4)
+    for imgs, lbls in loader:           # imgs: (B, C, H, W) fp32
+        ...                             # valid until the next iteration
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import _native
+
+__all__ = ["DataLoader", "IMAGENET_MEAN", "IMAGENET_STD"]
+
+IMAGENET_MEAN = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+IMAGENET_STD = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+
+
+class DataLoader:
+    """Iterate normalized (images, labels) batches with native prefetch.
+
+    ``images``: (N, H, W, C) uint8, ``labels``: (N,) int-like.  Epochs are
+    endless via ``next_batch`` (``__iter__`` yields one epoch, drop-last).
+
+    Delivered batches are owned copies by default.  ``zero_copy=True``
+    returns views straight into the prefetch slot — fastest, but the view
+    is only valid until the next ``next_batch`` call, and JAX's **CPU**
+    backend may alias (not copy) aligned fp32 numpy arrays in
+    ``device_put``, so an async in-flight step can read a recycled slot.
+    Use zero_copy only when each batch is fully consumed (e.g.
+    ``block_until_ready``) before requesting the next.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, shuffle: bool = True,
+                 mean: Sequence[float] = IMAGENET_MEAN,
+                 std: Sequence[float] = IMAGENET_STD,
+                 prefetch: int = 3, workers: int = 4, seed: int = 0,
+                 native: Optional[bool] = None, zero_copy: bool = False):
+        self.zero_copy = zero_copy
+        self.images = np.ascontiguousarray(images, np.uint8)
+        self.labels = np.ascontiguousarray(labels, np.int32)
+        if self.images.ndim != 4:
+            raise ValueError("images must be (N, H, W, C) uint8")
+        if len(self.labels) != len(self.images):
+            raise ValueError("labels/images length mismatch")
+        self.batch_size = int(batch_size)
+        self.n, self.h, self.w, self.c = self.images.shape
+        if self.n < self.batch_size:
+            raise ValueError("dataset smaller than one batch")
+        self.batches_per_epoch = self.n // self.batch_size
+        self.shuffle = shuffle
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        if len(self.mean) != self.c or len(self.std) != self.c:
+            raise ValueError("mean/std length must equal channel count")
+        self.seed = seed
+        self._handle = None
+        self._held: Optional[ctypes.c_void_p] = None
+        use_native = _native.available() if native is None else native
+        if use_native:
+            lib = _native._try_load()
+            if lib is not None:
+                self._lib = lib
+                self._handle = lib.apex_loader_create(
+                    self.images.ctypes.data_as(ctypes.c_void_p),
+                    self.labels.ctypes.data_as(ctypes.c_void_p),
+                    self.n, self.h, self.w, self.c, self.batch_size,
+                    int(prefetch), int(workers), seed,
+                    self.mean.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    self.std.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    1 if shuffle else 0)
+        # python fallback state
+        self._py_batch = 0
+        self._py_rng = np.random.RandomState(seed)
+        self._py_perm = None
+        self._py_epoch = -1
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    # -- native path -------------------------------------------------------
+    def _next_native(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        if self._held is not None:
+            self._lib.apex_loader_release(self._handle, self._held)
+            self._held = None
+        img_p = ctypes.c_void_p()
+        lbl_p = ctypes.c_void_p()
+        b = self._lib.apex_loader_next(self._handle, ctypes.byref(img_p),
+                                       ctypes.byref(lbl_p))
+        self._held = img_p
+        shape = (self.batch_size, self.c, self.h, self.w)
+        imgs = np.ctypeslib.as_array(
+            ctypes.cast(img_p, ctypes.POINTER(ctypes.c_float)),
+            shape=shape)
+        lbls = np.ctypeslib.as_array(
+            ctypes.cast(lbl_p, ctypes.POINTER(ctypes.c_int32)),
+            shape=(self.batch_size,))
+        if not self.zero_copy:
+            imgs, lbls = imgs.copy(), lbls.copy()
+        return imgs, lbls, b
+
+    # -- fallback path -----------------------------------------------------
+    def _next_python(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        b = self._py_batch
+        self._py_batch += 1
+        epoch, i = divmod(b, self.batches_per_epoch)
+        if self.shuffle:
+            if epoch != self._py_epoch:
+                self._py_perm = np.random.RandomState(
+                    self.seed + epoch).permutation(self.n)
+                self._py_epoch = epoch
+            idx = self._py_perm[i * self.batch_size:
+                                (i + 1) * self.batch_size]
+        else:
+            idx = np.arange(i * self.batch_size, (i + 1) * self.batch_size)
+        raw = self.images[idx].astype(np.float32)
+        imgs = np.moveaxis((raw - self.mean) / self.std, -1, 1)
+        return np.ascontiguousarray(imgs), self.labels[idx], b
+
+    # -- iteration ---------------------------------------------------------
+    def next_batch(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(images, labels, batch_index); endless, in batch order."""
+        if self.native:
+            return self._next_native()
+        return self._next_python()
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for _ in range(self.batches_per_epoch):
+            imgs, lbls, _ = self.next_batch()
+            yield imgs, lbls
+
+    def close(self) -> None:
+        if self._handle is not None:
+            if self._held is not None:
+                self._lib.apex_loader_release(self._handle, self._held)
+                self._held = None
+            self._lib.apex_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -204,11 +204,14 @@ def batch_norm_apply(x: jax.Array, mean: jax.Array, var: jax.Array,
                      eps: float, channel_axis: int = 1) -> jax.Array:
     from ..ops import dispatch
     if x.ndim == 4 and channel_axis == 1 and dispatch.use_pallas_for(x):
-        from ..ops.pallas_syncbn import batch_norm_apply_fused
-        C = x.shape[1]
-        w = weight if weight is not None else jnp.ones((C,), jnp.float32)
-        b = bias if bias is not None else jnp.zeros((C,), jnp.float32)
-        return batch_norm_apply_fused(x, mean, var, w, b, float(eps))
+        from ..ops.pallas_syncbn import batch_norm_apply_fused, fits_vmem
+        # planes too large for the kernel's VMEM tiling fall through to
+        # the jnp path below
+        if fits_vmem(x.shape[2] * x.shape[3]):
+            C = x.shape[1]
+            w = weight if weight is not None else jnp.ones((C,), jnp.float32)
+            b = bias if bias is not None else jnp.zeros((C,), jnp.float32)
+            return batch_norm_apply_fused(x, mean, var, w, b, float(eps))
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
     inv = lax.rsqrt(var.astype(jnp.float32) + eps)

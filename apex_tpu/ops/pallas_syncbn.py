@@ -31,12 +31,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_common import LANES, interpret
 
-_VMEM_BUDGET = 4 * 1024 * 1024
+_VMEM_BUDGET = 8 * 1024 * 1024
 
 
-def _block_rows(C: int) -> int:
-    br = _VMEM_BUDGET // (C * 4)
+def _block_rows(C: int, n_row_operands: int) -> int:
+    """Rows per grid block, budgeted across every row-sized operand the
+    kernel keeps resident (x2 for the grid pipeline's double buffering) so
+    ImageNet-scale planes (hw ~ 112*112) still fit VMEM."""
+    br = _VMEM_BUDGET // (C * 4 * n_row_operands * 2)
     return max(8, min(256, (br // 8) * 8))
+
+
+def fits_vmem(hw: int) -> bool:
+    """True if the minimum 8-row block of the 3-operand backward fits the
+    budget; callers fall back to the jnp path for larger planes."""
+    Cpad = -(-hw // LANES) * LANES
+    return Cpad * 4 * 8 * 3 * 2 <= _VMEM_BUDGET
 
 
 def _pad2(x, R, C):
@@ -75,7 +85,7 @@ def _fwd(x4, mean, var, w, b, *, eps):
     hw = H * W
     rows = N * Cch
     Cpad = -(-hw // LANES) * LANES
-    BR = _block_rows(Cpad)
+    BR = _block_rows(Cpad, 2)  # resident row operands: x, y
     R = -(-rows // BR) * BR
     xp = _pad2(x4.reshape(rows, hw), R, Cpad)
     inv = lax.rsqrt(var.astype(jnp.float32) + eps)
@@ -101,7 +111,7 @@ def _bwd(x4, mean, var, w, dy4, *, eps):
     hw = H * W
     rows = N * Cch
     Cpad = -(-hw // LANES) * LANES
-    BR = _block_rows(Cpad)
+    BR = _block_rows(Cpad, 3)  # resident row operands: dy, x, dx
     R = -(-rows // BR) * BR
     xp = _pad2(x4.reshape(rows, hw), R, Cpad)
     dyp = _pad2(dy4.reshape(rows, hw), R, Cpad)

@@ -80,10 +80,12 @@ def main(argv=None) -> int:
 
     coord = f"127.0.0.1:{args.port}"
     if (args.backend == "auto" and args.nprocs > 1
-            and os.environ.get("PALLAS_AXON_POOL_IPS")):
-        print("[multiproc] warning: a TPU plugin is active and all "
-              f"{args.nprocs} children will contend for it; pass "
-              "--backend cpu for local multi-process runs", file=sys.stderr)
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+        print("[multiproc] warning: --backend auto inherits the "
+              "environment's platform; if this host has a single "
+              f"accelerator, all {args.nprocs} children will contend for "
+              "it — pass --backend cpu for local multi-process runs",
+              file=sys.stderr)
     children = []
     for rank in range(args.nprocs):
         env = dict(os.environ)

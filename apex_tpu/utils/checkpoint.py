@@ -59,6 +59,8 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
                     keep: Optional[int] = None) -> str:
     """Write ``tree`` for ``step``; atomic (write-temp + rename).  With
     ``keep``, retain only the newest ``keep`` checkpoints."""
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
     os.makedirs(ckpt_dir, exist_ok=True)
     leaves = _leaf_dict(tree)
     path = os.path.join(ckpt_dir, _FMT.format(step=step))

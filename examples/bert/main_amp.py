@@ -1,0 +1,160 @@
+"""BERT pretraining example — the FusedLayerNorm + FusedAdam / FusedLAMB
+benchmark configs (BASELINE.md #4 BERT-base Adam, #5 BERT-large LAMB
+large-batch).  MLM + NSP on synthetic data, amp O2, data-parallel over the
+device mesh.  The reference has no BERT example of its own — these configs
+are how its kernels were consumed downstream (BASELINE.md); this script is
+the runnable equivalent.
+
+Run on CPU mesh:
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/bert/main_amp.py --config tiny -b 2 --iters 5
+
+Run on TPU: python examples/bert/main_amp.py --config base -b 8
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+if os.path.isdir(os.path.join(_repo, "apex_tpu")) and _repo not in sys.path:
+    sys.path.insert(0, _repo)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="apex_tpu BERT pretraining")
+    p.add_argument("--config", default="base",
+                   choices=["tiny", "base", "large"])
+    p.add_argument("-b", "--batch-size", type=int, default=8,
+                   help="per-device batch size")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--optimizer", default="adam", choices=["adam", "lamb"])
+    p.add_argument("--lr", type=float, default=None,
+                   help="default: 1e-4 adam, 4e-3 lamb (large batch)")
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--loss-scale", default=None)
+    p.add_argument("--half-dtype", default=None,
+                   choices=[None, "bfloat16", "float16"])
+    p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import amp, models, optimizers, parallel
+    from apex_tpu.utils import AverageMeter
+
+    if args.config == "tiny":
+        cfg = models.BertConfig(vocab_size=1024, hidden_size=64,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=128)
+    elif args.config == "base":
+        cfg = models.bert_base()
+    else:
+        cfg = models.bert_large()
+
+    lr = args.lr or (4e-3 if args.optimizer == "lamb" else 1e-4)
+    if args.optimizer == "lamb":
+        optimizer = optimizers.FusedLAMB(lr=lr, weight_decay=0.01,
+                                         max_grad_norm=1.0)
+    else:
+        optimizer = optimizers.FusedAdam(lr=lr, weight_decay=0.01)
+
+    model, optimizer = amp.initialize(
+        models.BertForPretraining(cfg), optimizer,
+        opt_level=args.opt_level, loss_scale=args.loss_scale,
+        half_dtype=args.half_dtype)
+    ddp = parallel.DistributedDataParallel(model)
+
+    params, _ = model.init(jax.random.PRNGKey(args.seed))
+    opt_state = optimizer.init(params)
+
+    ndev = len(jax.devices())
+    global_batch = args.batch_size * ndev
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    rng = np.random.RandomState(args.seed)
+    T = args.seq_len
+
+    def synth_batch():
+        ids = rng.randint(5, cfg.vocab_size, (global_batch, T))
+        mask = rng.rand(global_batch, T) < args.mask_prob
+        labels = np.where(mask, ids, -100)
+        ids = np.where(mask & (rng.rand(global_batch, T) < 0.8), 3, ids)
+        nsp = rng.randint(0, 2, (global_batch,))
+        return (ids.astype(np.int32), labels.astype(np.int32),
+                nsp.astype(np.int32))
+
+    def step(state, batch):
+        params, opt_state = state
+        ids, mlm_labels, nsp_labels = batch
+
+        def loss_fn(p):
+            # through model.apply so the amp cast policy is in scope
+            (mlm_logits, nsp_logits), _ = model.apply(p, ids)
+            logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), -1)
+            valid = mlm_labels != -100
+            lbl = jnp.where(valid, mlm_labels, 0)
+            nll = -jnp.take_along_axis(logp, lbl[..., None], -1)[..., 0]
+            mlm = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+            nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
+            nsp = -jnp.mean(jnp.take_along_axis(
+                nsp_logp, nsp_labels[:, None], -1))
+            return mlm + nsp
+
+        loss, grads = amp.scaled_grad(loss_fn, params, opt_state)
+        grads = ddp.allreduce_grads(grads)
+        params, opt_state, info = optimizer.step(params, opt_state, grads)
+        return (params, opt_state), {"loss": lax.pmean(loss, "data"),
+                                     "loss_scale": info["loss_scale"]}
+
+    train_step = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), (P("data"), P("data"), P("data"))),
+        out_specs=(P(), P()), check_vma=False))
+
+    state = (params, opt_state)
+    print(f"=> BERT-{args.config} {args.optimizer} "
+          f"global batch {global_batch} seq {T}; compiling...")
+    t0 = time.time()
+    batch = tuple(map(jnp.asarray, synth_batch()))
+    state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    print(f"=> compiled in {time.time() - t0:.1f}s")
+
+    batch_time = AverageMeter()
+    losses = AverageMeter()
+    end = time.time()
+    for i in range(args.iters):
+        batch = tuple(map(jnp.asarray, synth_batch()))
+        state, metrics = train_step(state, batch)
+        jax.block_until_ready(metrics)
+        batch_time.update(time.time() - end)
+        end = time.time()
+        losses.update(float(metrics["loss"]))
+        if i % args.print_freq == 0 or i == args.iters - 1:
+            sps = global_batch / batch_time.val
+            print(f"[{i:4d}/{args.iters}]  "
+                  f"Time {batch_time.val:.3f} ({batch_time.avg:.3f})  "
+                  f"Speed {sps:.1f} seq/s  "
+                  f"Loss {losses.val:.4f} ({losses.avg:.4f})  "
+                  f"scale {float(metrics['loss_scale']):.0f}")
+    sps = global_batch / batch_time.avg
+    print(f"=> done. avg {sps:.1f} seq/s ({sps / ndev:.2f} seq/s/device)")
+    return sps
+
+
+if __name__ == "__main__":
+    main()

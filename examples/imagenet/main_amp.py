@@ -102,19 +102,35 @@ def main():
     rng = np.random.RandomState(args.seed)
     if args.data:
         blob = np.load(args.data)
-        images_all = blob["images"].astype(np.float32)
-        labels_all = blob["labels"].astype(np.int32)
-        n_batches = len(images_all) // global_batch
-        if n_batches == 0:
+        if len(blob["images"]) < global_batch:
             raise SystemExit(
-                f"dataset has {len(images_all)} images < one global batch "
-                f"({global_batch}); lower --batch-size")
-        args.iters = min(args.iters, n_batches)
+                f"dataset has {len(blob['images'])} images < one global "
+                f"batch ({global_batch}); lower --batch-size")
+        if (blob["images"].dtype == np.uint8
+                and blob["images"].shape[-1] == 3):
+            # NHWC uint8 -> the native prefetching pipeline (C++ worker
+            # threads normalize + assemble batches ahead of the loop)
+            from apex_tpu.data import DataLoader
+            loader = DataLoader(blob["images"], blob["labels"],
+                                batch_size=global_batch, shuffle=True,
+                                seed=args.seed)
+            print(f"=> native data loader: {loader.native} "
+                  f"({loader.batches_per_epoch} batches/epoch)")
+            args.iters = min(args.iters, loader.batches_per_epoch)
 
-        def get_batch(i):
-            s = (i % n_batches) * global_batch
-            return (images_all[s:s + global_batch],
-                    labels_all[s:s + global_batch])
+            def get_batch(i):
+                imgs, lbls, _ = loader.next_batch()
+                return imgs, lbls
+        else:
+            images_all = blob["images"].astype(np.float32)
+            labels_all = blob["labels"].astype(np.int32)
+            n_batches = len(images_all) // global_batch
+            args.iters = min(args.iters, n_batches)
+
+            def get_batch(i):
+                s = (i % n_batches) * global_batch
+                return (images_all[s:s + global_batch],
+                        labels_all[s:s + global_batch])
     else:
         images_all = rng.randn(
             global_batch, 3, args.image_size, args.image_size

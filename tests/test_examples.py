@@ -46,3 +46,18 @@ def test_imagenet_example_smoke():
               "-b", "2", "--iters", "2", "--image-size", "32",
               "--print-freq", "1"])
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_bert_example_smoke():
+    r = _run(["examples/bert/main_amp.py", "--config", "tiny", "-b", "2",
+              "--seq-len", "32", "--iters", "2", "--print-freq", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done" in r.stdout
+
+
+def test_bert_example_lamb_smoke():
+    r = _run(["examples/bert/main_amp.py", "--config", "tiny", "-b", "2",
+              "--seq-len", "32", "--iters", "2", "--optimizer", "lamb",
+              "--print-freq", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done" in r.stdout
