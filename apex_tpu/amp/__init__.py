@@ -24,6 +24,7 @@ from .lists import (register_half_function, register_float_function,
 from . import stateful
 from . import lists
 from . import policy
+from .legacy import init, AmpHandle, NoOpHandle, OptimWrapper
 
 
 def state_dict(bound_or_opt_state) -> dict:

@@ -1,0 +1,166 @@
+"""Legacy amp API: ``amp.init()`` handles and the deprecated OptimWrapper.
+
+The reference keeps two generations of amp alive: the modern
+``amp.initialize`` path and the original handle-based API —
+``handle = amp.init(enabled=...)``, ``handle.wrap_optimizer(opt)``,
+``with handle.scale_loss(loss, opt): ...`` (reference apex/amp/handle.py:
+169-280 AmpHandle/NoOpHandle, apex/amp/opt.py:9-103 OptimWrapper).  Users
+migrating from the reference may still hold handle-shaped code, so the
+same surface exists here, built on the modern pieces: ``AmpHandle``
+installs the O1 ``CastPolicy`` globally; ``OptimWrapper`` drives a
+``BoundOptimizer`` under the covers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from . import policy as _policy
+
+__all__ = ["init", "AmpHandle", "NoOpHandle", "OptimWrapper"]
+
+
+class AmpHandle:
+    """Handle returned by the legacy ``amp.init(enabled=True)``."""
+
+    def __init__(self, enable_caching: bool = True, verbose: bool = False,
+                 half_dtype=jnp.bfloat16):
+        self._enable_caching = enable_caching  # accepted for API parity;
+        # XLA CSEs repeated casts, so no cache object exists
+        self._verbose = verbose
+        self._all_wrappers = []
+        self._is_active = True
+        _policy.set_policy(_policy.CastPolicy(half_dtype))
+
+    def is_active(self) -> bool:
+        return self._is_active
+
+    def wrap_optimizer(self, optimizer, num_loss: int = 1):
+        """Returns the deprecated OptimWrapper (reference handle.py:222)."""
+        self._all_wrappers.append(optimizer)
+        return OptimWrapper(optimizer, self, num_loss)
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss_fn: Callable, optimizer):
+        """Legacy two-arg scale_loss; ``optimizer`` is an apex_tpu
+        optimizer previously bound via ``amp.stateful.bind`` or an
+        OptimWrapper from ``wrap_optimizer``."""
+        if isinstance(optimizer, OptimWrapper):
+            with optimizer.scale_loss(loss_fn) as scaled:
+                yield scaled
+            return
+        from .handle import scale_loss as _modern
+        with _modern(loss_fn, optimizer) as scaled:
+            yield scaled
+
+    def _deactivate(self) -> None:
+        self._is_active = False
+        _policy.set_policy(_policy.NoPolicy())
+
+
+class NoOpHandle:
+    """Handle returned by ``amp.init(enabled=False)`` — everything passes
+    through untouched (reference handle.py:262-280)."""
+
+    def is_active(self) -> bool:
+        return False
+
+    def wrap_optimizer(self, optimizer, num_loss: int = 1):
+        return OptimWrapper(optimizer, self, num_loss)
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss_fn: Callable, optimizer):
+        yield loss_fn
+
+    def _deactivate(self) -> None:
+        pass
+
+
+def init(enabled: bool = True, enable_caching: bool = True,
+         verbose: bool = False, allow_banned: bool = False,
+         half_dtype=jnp.bfloat16):
+    """The original amp entry point (reference apex/amp/amp.py:68).
+    Prefer ``amp.initialize``; this exists for migration parity."""
+    if not enabled:
+        return NoOpHandle()
+    return AmpHandle(enable_caching, verbose, half_dtype)
+
+
+class OptimWrapper:
+    """Deprecated per-optimizer wrapper with per-loss scalers (reference
+    apex/amp/opt.py:9-103)."""
+
+    def __init__(self, optimizer, amp_handle, num_loss: int = 1):
+        warnings.warn("OptimWrapper is deprecated; use amp.initialize + "
+                      "amp.scaled_grad (or amp.scale_loss)",
+                      DeprecationWarning, stacklevel=2)
+        self._optimizer = optimizer
+        self._amp_handle = amp_handle
+        self._num_loss = num_loss
+        self._loss_idx = 0
+        self._bound = None  # bound in setup()
+
+    # the reference requires params registered before use; here binding
+    # happens through amp.stateful so state lives functionally
+    def setup(self, params: Any) -> None:
+        from . import stateful
+        # the per-loss scalers live in the bound optimizer's state; make
+        # sure it carries num_loss of them (reference opt.py:14-16)
+        if getattr(self._optimizer, "num_losses", 1) < self._num_loss:
+            self._optimizer.num_losses = self._num_loss
+        self._bound = stateful.bind(self._optimizer, params)
+
+    @property
+    def params(self):
+        return self._bound.params if self._bound else None
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss_fn: Callable):
+        if self._bound is None:
+            raise RuntimeError("call OptimWrapper.setup(params) first")
+        if self._loss_idx >= self._num_loss:
+            raise RuntimeError(
+                f"Unable to scale {self._num_loss + 1} losses — "
+                f"OptimWrapper was constructed with num_loss={self._num_loss}"
+                " (reference opt.py raises the same way)")
+        loss_id = self._loss_idx
+
+        class _Scaled:
+            def __init__(self, bound):
+                self._bound = bound
+
+            def backward(self):
+                self._bound._backward(loss_fn, loss_id)
+
+            def __float__(self):
+                return float(self._bound._eval_scaled_loss(loss_fn, loss_id))
+
+        yield _Scaled(self._bound)
+        self._bound._post_backward(loss_id)
+        self._loss_idx += 1
+
+    def step(self, closure=None):
+        if closure is not None:
+            raise NotImplementedError(
+                "OptimWrapper does not support closures (reference "
+                "opt.py:79-81)")
+        self._loss_idx = 0
+        self._bound.step()
+
+    def zero_grad(self) -> None:
+        self._bound.zero_grad()
+
+    @property
+    def loss_scale(self) -> float:
+        return self._bound.loss_scale
+
+    def state_dict(self) -> dict:
+        from . import state_dict as _sd
+        return _sd(self._bound.opt_state)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)

@@ -55,6 +55,11 @@ class DataLoader:
                  prefetch: int = 3, workers: int = 4, seed: int = 0,
                  native: Optional[bool] = None, zero_copy: bool = False):
         self.zero_copy = zero_copy
+        if np.asarray(images).dtype != np.uint8:
+            raise TypeError(
+                f"images must be uint8, got {np.asarray(images).dtype} — "
+                "normalization happens inside the loader; pass the raw "
+                "uint8 pixels")
         self.images = np.ascontiguousarray(images, np.uint8)
         self.labels = np.ascontiguousarray(labels, np.int32)
         if self.images.ndim != 4:
@@ -118,6 +123,11 @@ class DataLoader:
             shape=(self.batch_size,))
         if not self.zero_copy:
             imgs, lbls = imgs.copy(), lbls.copy()
+            # data is owned now: release the slot immediately so workers
+            # refill it during this step's compute (zero_copy defers the
+            # release to the next call because the views still alias it)
+            self._lib.apex_loader_release(self._handle, self._held)
+            self._held = None
         return imgs, lbls, b
 
     # -- fallback path -----------------------------------------------------
@@ -134,9 +144,9 @@ class DataLoader:
                                 (i + 1) * self.batch_size]
         else:
             idx = np.arange(i * self.batch_size, (i + 1) * self.batch_size)
-        raw = self.images[idx].astype(np.float32)
-        imgs = np.moveaxis((raw - self.mean) / self.std, -1, 1)
-        return np.ascontiguousarray(imgs), self.labels[idx], b
+        imgs = _native.preprocess_images(self.images[idx], self.mean,
+                                         self.std)
+        return imgs, self.labels[idx], b
 
     # -- iteration ---------------------------------------------------------
     def next_batch(self) -> Tuple[np.ndarray, np.ndarray, int]:

@@ -22,8 +22,41 @@ import numpy as np
 BASELINE_IMG_PER_SEC_PER_CHIP = 10_000.0 / 64.0
 
 
+def _tpu_responsive(timeout_s: int = 180) -> bool:
+    """Probe device execution in a subprocess: a wedged TPU tunnel hangs
+    on the first op forever, and a hung bench records nothing for the
+    round.  On timeout the bench falls back to the CPU mesh so the driver
+    always gets its JSON line."""
+    probe = ("import jax, jax.numpy as jnp; "
+             "r = jax.jit(lambda a: a @ a)(jnp.ones((128, 128))); "
+             "r.block_until_ready()")
+    import subprocess
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import jax
+
+    # decide the platform BEFORE any backend init in this process: calling
+    # jax.default_backend() would pin the (possibly wedged) TPU plugin and
+    # make the cpu fallback config update a no-op.  Only probe when a TPU
+    # plugin is actually in play — a CPU-only host skips straight through.
+    want_accel = (bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+                  or os.environ.get("JAX_PLATFORMS", "") in ("tpu", "axon"))
+    if want_accel and not _tpu_responsive():
+        print("bench: TPU unresponsive, falling back to CPU mesh",
+              file=sys.stderr)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P

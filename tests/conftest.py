@@ -30,6 +30,16 @@ assert len(jax.devices()) >= 8
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_amp_policy():
+    """O1 amp.initialize installs a process-wide cast policy (the analogue
+    of the reference's global monkey-patching); never let one test's
+    policy leak into the next."""
+    yield
+    from apex_tpu.amp import policy
+    policy.set_policy(policy.NoPolicy())
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

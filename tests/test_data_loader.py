@@ -94,14 +94,47 @@ def test_ordered_delivery_under_stress():
     dl.close()
 
 
-def test_buffer_valid_until_next_call():
+def test_zero_copy_slot_lifetime():
+    """zero_copy=True returns views into the ring: the view must hold the
+    right data at delivery, stay stable until the next call, and get
+    recycled once the ring wraps past it."""
     images, labels = _dataset()
-    dl = DataLoader(images, labels, batch_size=8, shuffle=False)
-    imgs, _, _ = dl.next_batch()
-    snapshot = imgs.copy()
-    np.testing.assert_array_equal(imgs, snapshot)  # stable while held
-    dl.next_batch()
+    zc = DataLoader(images, labels, batch_size=8, shuffle=False,
+                    prefetch=2, workers=2, zero_copy=True)
+    ref = DataLoader(images, labels, batch_size=8, shuffle=False,
+                     native=False)
+    imgs0, lbls0, _ = zc.next_batch()
+    rimgs0, rlbls0, _ = ref.next_batch()
+    np.testing.assert_array_equal(lbls0, rlbls0)
+    np.testing.assert_allclose(imgs0, rimgs0, rtol=1e-6, atol=1e-5)
+    lbl_snapshot = lbls0.copy()
+    # advance past the ring depth: the old view's slot must be recycled
+    # with different (later-batch) labels — proving views really alias
+    # the ring and documenting the hazard the default copy mode avoids
+    for _ in range(4):
+        zc.next_batch()
+    assert not np.array_equal(lbls0, lbl_snapshot)
+    zc.close()
+
+
+def test_copy_mode_batches_are_owned():
+    """Default mode: delivered arrays are unaffected by later calls."""
+    images, labels = _dataset()
+    dl = DataLoader(images, labels, batch_size=8, shuffle=False,
+                    prefetch=2, workers=2)
+    imgs0, lbls0, _ = dl.next_batch()
+    snap_i, snap_l = imgs0.copy(), lbls0.copy()
+    for _ in range(6):
+        dl.next_batch()
+    np.testing.assert_array_equal(imgs0, snap_i)
+    np.testing.assert_array_equal(lbls0, snap_l)
     dl.close()
+
+
+def test_rejects_non_uint8_images():
+    images, labels = _dataset()
+    with pytest.raises(TypeError, match="uint8"):
+        DataLoader(images.astype(np.float32), labels, batch_size=8)
 
 
 def test_validation_errors():

@@ -1,0 +1,126 @@
+"""Legacy amp handle API tests — amp.init() / AmpHandle / NoOpHandle /
+OptimWrapper (reference apex/amp/handle.py:169-280, opt.py:9-103)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, nn, optimizers
+from apex_tpu.nn import functional as F
+
+
+def _setup():
+    model = nn.Sequential([nn.Linear(4, 4)])
+    params, _ = model.init(jax.random.PRNGKey(0))
+    _, opt = amp.initialize(model, optimizers.FusedAdam(lr=1e-2),
+                            opt_level="O2", verbosity=0, hard_override=True)
+    return model, params, opt
+
+
+def _wrap(handle, opt, params, num_loss=1):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        w = handle.wrap_optimizer(opt, num_loss=num_loss)
+    w.setup(params)
+    return w
+
+
+def test_handle_activation_lifecycle():
+    handle = amp.init(enabled=True)
+    assert handle.is_active()
+    handle._deactivate()
+    assert not handle.is_active()
+    assert not amp.init(enabled=False).is_active()
+
+
+def test_optim_wrapper_deprecation_warning():
+    handle = amp.init(enabled=True)
+    model, params, opt = _setup()
+    with pytest.warns(DeprecationWarning):
+        handle.wrap_optimizer(opt)
+    handle._deactivate()
+
+
+def test_optim_wrapper_trains():
+    handle = amp.init(enabled=True)
+    model, params, opt = _setup()
+    w = _wrap(handle, opt, params)
+    x, y = jnp.ones((3, 4)), jnp.zeros((3, 4))
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x)
+        return F.mse_loss(out.astype(jnp.float32), y)
+
+    before = np.asarray(jax.tree_util.tree_leaves(w.params)[0], np.float32)
+    with w.scale_loss(loss_fn) as scaled:
+        assert float(scaled) >= 0  # float()-able like the reference's yield
+        scaled.backward()
+    w.step()
+    after = np.asarray(jax.tree_util.tree_leaves(w.params)[0], np.float32)
+    assert np.abs(after - before).max() > 0
+    handle._deactivate()
+
+
+def test_optim_wrapper_num_loss_exceeded_raises():
+    handle = amp.init(enabled=True)
+    model, params, opt = _setup()
+    w = _wrap(handle, opt, params, num_loss=1)
+    x, y = jnp.ones((3, 4)), jnp.zeros((3, 4))
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x)
+        return F.mse_loss(out.astype(jnp.float32), y)
+
+    with w.scale_loss(loss_fn) as s:
+        s.backward()
+    with pytest.raises(RuntimeError, match="num_loss"):
+        with w.scale_loss(loss_fn) as s:
+            s.backward()
+    handle._deactivate()
+
+
+def test_optim_wrapper_requires_setup():
+    handle = amp.init(enabled=True)
+    model, params, opt = _setup()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        w = handle.wrap_optimizer(opt)
+    with pytest.raises(RuntimeError, match="setup"):
+        with w.scale_loss(lambda p: jnp.zeros(())):
+            pass
+    handle._deactivate()
+
+
+def test_noop_handle_passthrough():
+    noop = amp.init(enabled=False)
+    ran = []
+    with noop.scale_loss(lambda p: ran.append(1), None) as fn:
+        assert callable(fn)
+
+
+def test_optim_wrapper_two_losses():
+    """num_loss=2 must give two independent scalers in the bound state
+    (regression: this used to IndexError on the second scale_loss)."""
+    model, params, _ = _setup()
+    _, opt = amp.initialize(model, optimizers.FusedAdam(lr=1e-2),
+                            opt_level="O2", half_dtype="float16",
+                            loss_scale="dynamic", verbosity=0,
+                            hard_override=True)
+    handle = amp.init(enabled=True)
+    w = _wrap(handle, opt, params, num_loss=2)
+    assert len(w._bound.opt_state.scalers) == 2
+    x, y = jnp.ones((3, 4)), jnp.zeros((3, 4))
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x)
+        return F.mse_loss(out.astype(jnp.float32), y)
+
+    with w.scale_loss(loss_fn) as s:
+        s.backward()
+    with w.scale_loss(loss_fn) as s:
+        s.backward()
+    w.step()
+    handle._deactivate()

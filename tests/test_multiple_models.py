@@ -1,0 +1,165 @@
+"""Multiple models x optimizers x losses with fault injection — the
+apex_tpu analogue of the reference's flagship 762-line
+tests/L0/run_amp/test_multiple_models_optimizers_losses.py: the cross
+product of {opt levels} x {planted inf at iter 0/1} x {loss_id}, asserting
+(a) half-precision runs track an fp32 reference trajectory, (b) an
+overflowed loss skips exactly that optimizer's step and halves exactly
+that scaler, and (c) per-loss scalers evolve independently."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, nn, optimizers
+from apex_tpu.nn import functional as F
+
+
+def _models():
+    return [nn.Sequential([nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3)]),
+            nn.Sequential([nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3)])]
+
+
+X = np.random.RandomState(0).randn(16, 6).astype(np.float32)
+Y = np.random.RandomState(1).randn(16, 3).astype(np.float32)
+
+
+def _run(opt_level, iters=6, inf_iter=None, half_dtype=None, target=0):
+    """Train two models with two optimizers; optionally plant an inf into
+    model[target]'s loss at iteration ``inf_iter``.  Returns (params list,
+    scale list, trajectories)."""
+    models, opts = amp.initialize(
+        _models(), [optimizers.FusedAdam(lr=1e-2) for _ in range(2)],
+        opt_level=opt_level, half_dtype=half_dtype, verbosity=0,
+        hard_override=True)
+    x, y = jnp.asarray(X), jnp.asarray(Y)
+    ps = [m.init(jax.random.PRNGKey(i))[0] for i, m in enumerate(models)]
+    oss = [o.init(p) for o, p in zip(opts, ps)]
+    trajs = [[], []]
+    for it in range(iters):
+        for k in range(2):
+            bad = (inf_iter is not None and it == inf_iter and k == target)
+
+            def loss_fn(p, k=k, bad=bad):
+                out, _ = models[k].apply(p, x)
+                loss = F.mse_loss(out.astype(jnp.float32), y)
+                return loss * jnp.float32(np.inf) if bad else loss
+
+            loss, grads = amp.scaled_grad(loss_fn, ps[k], oss[k])
+            ps[k], oss[k], info = opts[k].step(ps[k], oss[k], grads)
+            trajs[k].append(float(loss) if np.isfinite(float(loss))
+                            else None)
+    scales = [float(o.scalers[0].loss_scale) for o in oss]
+    return ps, scales, trajs
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_half_tracks_fp32_reference(opt_level):
+    ref_ps, _, ref_traj = _run("O0")
+    tst_ps, _, tst_traj = _run(opt_level)
+    # loss trajectories agree to half-precision tolerance
+    for rt, tt in zip(ref_traj, tst_traj):
+        np.testing.assert_allclose(rt, tt, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("inf_iter", [0, 1])
+@pytest.mark.parametrize("target", [0, 1])
+def test_inf_skips_only_target_optimizer(inf_iter, target):
+    """Planted inf must (a) halve only the target's scaler, (b) leave the
+    target's params equal to a run where that iteration never happened,
+    (c) not disturb the other model at all."""
+    init_scale = 2.0 ** 8
+    loss_scale = "dynamic"
+
+    def run(n_iters, inf_at):
+        models, opts = amp.initialize(
+            _models(), [optimizers.FusedAdam(lr=1e-2) for _ in range(2)],
+            opt_level="O2", half_dtype="float16", loss_scale=loss_scale,
+            verbosity=0, hard_override=True)
+        x, y = jnp.asarray(X), jnp.asarray(Y)
+        ps = [m.init(jax.random.PRNGKey(i))[0]
+              for i, m in enumerate(models)]
+        oss = [o.init(p) for o, p in zip(opts, ps)]
+        for it in range(n_iters):
+            for k in range(2):
+                bad = (it == inf_at and k == target)
+
+                def loss_fn(p, k=k, bad=bad):
+                    out, _ = models[k].apply(p, x)
+                    loss = F.mse_loss(out.astype(jnp.float32), y)
+                    return loss * jnp.float32(np.inf) if bad else loss
+
+                _, grads = amp.scaled_grad(loss_fn, ps[k], oss[k])
+                ps[k], oss[k], _ = opts[k].step(ps[k], oss[k], grads)
+        return ps, oss
+
+    ps_inf, oss_inf = run(3, inf_iter)
+    ps_ref, oss_ref = run(3, None)
+
+    # target scaler halved exactly once, the other untouched
+    s_t = float(oss_inf[target].scalers[0].loss_scale)
+    s_o = float(oss_inf[1 - target].scalers[0].loss_scale)
+    s_ref = float(oss_ref[0].scalers[0].loss_scale)
+    assert s_t == s_ref / 2
+    assert s_o == s_ref
+
+    # the non-target model is bit-identical to the clean run
+    for a, b in zip(jax.tree_util.tree_leaves(ps_inf[1 - target]),
+                    jax.tree_util.tree_leaves(ps_ref[1 - target])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the target model differs from clean (it skipped one update) but has
+    # finite params
+    assert all(np.all(np.isfinite(np.asarray(l, np.float32)))
+               for l in jax.tree_util.tree_leaves(ps_inf[target]))
+    diff = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                     b.astype(jnp.float32))))
+               for a, b in zip(jax.tree_util.tree_leaves(ps_inf[target]),
+                               jax.tree_util.tree_leaves(ps_ref[target])))
+    assert diff > 0
+
+
+def test_skipped_step_params_unchanged():
+    """iter-0 inf: params after the skipped step == initial params."""
+    models, opts = amp.initialize(
+        _models()[:1], [optimizers.FusedAdam(lr=1e-2)],
+        opt_level="O2", half_dtype="float16", loss_scale="dynamic",
+        verbosity=0, hard_override=True)
+    model, opt = models[0], opts[0]
+    x, y = jnp.asarray(X), jnp.asarray(Y)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+
+    def inf_loss(p):
+        out, _ = model.apply(p, x)
+        return F.mse_loss(out.astype(jnp.float32), y) * jnp.float32(np.inf)
+
+    _, grads = amp.scaled_grad(inf_loss, params, opt_state)
+    new_params, opt_state, info = opt.step(params, opt_state, grads)
+    assert float(info["found_inf"]) == 1.0
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(new_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_two_losses_one_optimizer_independent_scalers():
+    """num_losses=2: each loss_id owns a scaler; overflow in loss 1 must
+    not touch scaler 0 (reference scale_loss(loss_id=...) semantics)."""
+    model, opt = amp.initialize(
+        _models()[0], optimizers.FusedAdam(lr=1e-2), opt_level="O2",
+        half_dtype="float16", loss_scale="dynamic", num_losses=2,
+        verbosity=0, hard_override=True)
+    x, y = jnp.asarray(X), jnp.asarray(Y)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    assert len(opt_state.scalers) == 2
+    s0 = float(opt_state.scalers[0].loss_scale)
+
+    def inf_loss(p):
+        out, _ = model.apply(p, x)
+        return F.mse_loss(out.astype(jnp.float32), y) * jnp.float32(np.inf)
+
+    _, grads = amp.scaled_grad(inf_loss, params, opt_state, loss_id=1)
+    params, opt_state, _ = opt.step(params, opt_state, grads, loss_id=1)
+    assert float(opt_state.scalers[1].loss_scale) == s0 / 2
+    assert float(opt_state.scalers[0].loss_scale) == s0
