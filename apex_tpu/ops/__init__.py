@@ -10,6 +10,8 @@ Kernel inventory (TPU-native equivalents of the reference csrc/ tree):
   pallas_lamb         — LAMB stage1/stage2 (csrc/multi_tensor_lamb_stage_*.cu)
   pallas_syncbn       — fused BatchNorm normalize-apply fwd/bwd
                         (csrc/welford.cu:298-318,325-410)
+  pallas_flash_attention — fused attention fwd/bwd (no reference
+                        equivalent: the 2019 snapshot predates attention)
 """
 
 from . import dispatch

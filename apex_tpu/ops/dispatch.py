@@ -32,6 +32,7 @@ def kernels_available() -> bool:
             from . import pallas_layer_norm  # noqa: F401
             from . import pallas_lamb  # noqa: F401
             from . import pallas_syncbn  # noqa: F401
+            from . import pallas_flash_attention  # noqa: F401
             _KERNELS_AVAILABLE = True
         except ImportError:
             _KERNELS_AVAILABLE = False

@@ -1,0 +1,265 @@
+"""Pallas fused attention kernels (fwd + bwd) for the MXU.
+
+New capability relative to the reference (2019, pre-attention — SURVEY.md
+§5): apex_tpu treats transformer workloads as first-class.  This kernel
+is the compute core of ``transformer.dot_product_attention`` and, through
+it, ``ulysses_attention``'s per-head local attention.  (Ring attention
+keeps its own jnp online-softmax accumulation: its inner blocks interleave
+with ppermutes and XLA fuses them against the collective.)
+
+Design (memory-efficient attention, Rabe & Staats / FlashAttention
+family): queries are tiled into row blocks; K and V for one (batch, head)
+stay resident in VMEM, so each q-block computes its (BQ, T) score tile in
+one MXU call, softmaxes in fp32, and contracts with V — the full (T, T)
+matrix never exists in HBM.  The forward saves the per-row logsumexp; the
+backward recomputes probabilities from it (no stored probs) in two
+passes: a dQ pass tiled over q rows and a dK/dV pass tiled over k rows,
+each a handful of MXU contractions.
+
+For sequences too long for K/V residency (``fits_vmem`` false) callers
+fall back to the jnp path; at that scale the right tool is ring
+attention's sequence sharding anyway.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_common import LANES, interpret
+
+_VMEM_BUDGET = 10 * 1024 * 1024
+_BQ = 256  # query rows per grid step
+_NEG = -1e30
+
+
+def fits_vmem(T: int, D: int) -> bool:
+    """K, V, (+Q/dO/O tiles) resident per (b, h): keep the resident set
+    comfortably under budget."""
+    Tp = -(-T // _BQ) * _BQ
+    Dp = -(-D // LANES) * LANES
+    resident = (2 * Tp * Dp        # K, V
+                + 2 * _BQ * Tp     # score tile + mask temps
+                + 4 * _BQ * Dp) * 4
+    return resident <= _VMEM_BUDGET
+
+
+def _pad_to(x, T, D):
+    t, d = x.shape[-2:]
+    if t == T and d == D:
+        return x
+    pad = [(0, 0)] * (x.ndim - 2) + [(0, T - t), (0, D - d)]
+    return jnp.pad(x, pad)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                T_real, BQ):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)                  # (T, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (BQ, T)
+    kpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = kpos < T_real
+    if causal:
+        qpos = qi * BQ + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        valid = jnp.logical_and(valid, qpos >= kpos)
+    s = jnp.where(valid, s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "causal"))
+def _fwd(q, k, v, scale, causal):
+    BH, T, D = q.shape
+    Tp = -(-T // _BQ) * _BQ
+    Dp = -(-D // LANES) * LANES
+    qp = _pad_to(q, Tp, Dp)
+    kp = _pad_to(k, Tp, Dp)
+    vp = _pad_to(v, Tp, Dp)
+    grid = (BH, Tp // _BQ)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          T_real=T, BQ=_BQ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BQ), lambda b, i: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Tp), jnp.float32)],
+        interpret=interpret(),
+    )(qp, kp, vp)
+    return o[:, :T, :D], lse[:, :T]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, T_real, BQ):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    kpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = kpos < T_real
+    if causal:
+        qpos = qi * BQ + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        valid = jnp.logical_and(valid, qpos >= kpos)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, T_real, BK):
+    ki = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (T, D) full queries
+    k = k_ref[0].astype(jnp.float32)                  # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)                # (T, D)
+    lse = lse_ref[0][None, :]                         # (1, T)
+    delta = delta_ref[0][None, :]
+    # transposed scores: (BK, T) = K_blk @ Q^T
+    st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    qpos = lax.broadcasted_iota(jnp.int32, st.shape, 1)
+    valid = qpos < T_real
+    if causal:
+        kpos = ki * BK + lax.broadcasted_iota(jnp.int32, st.shape, 0)
+        valid = jnp.logical_and(valid, qpos >= kpos)
+    pt = jnp.where(valid, jnp.exp(st - lse), 0.0)     # (BK, T)
+    dv = jax.lax.dot_general(pt, do, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # (BK, T)
+    dst = pt * (dpt - delta)
+    dk = jax.lax.dot_general(dst, q, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "causal"))
+def _bwd(q, k, v, o, lse, do, scale, causal):
+    BH, T, D = q.shape
+    Tp = -(-T // _BQ) * _BQ
+    Dp = -(-D // LANES) * LANES
+    qp, kp, vp = (_pad_to(x, Tp, Dp) for x in (q, k, v))
+    dop = _pad_to(do, Tp, Dp)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    deltap = jnp.pad(delta, ((0, 0), (0, Tp - T)))
+    # padded rows: lse=0 would make exp(s-lse) = exp(-1e30)≈0 — safe
+    lsep = jnp.pad(lse, ((0, 0), (0, Tp - T)))
+
+    row_blk = pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    full_blk = pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    vec_row = pl.BlockSpec((1, _BQ), lambda b, i: (b, i),
+                           memory_space=pltpu.VMEM)
+    vec_full = pl.BlockSpec((1, Tp), lambda b, i: (b, 0),
+                            memory_space=pltpu.VMEM)
+    grid = (BH, Tp // _BQ)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          T_real=T, BQ=_BQ),
+        grid=grid,
+        in_specs=[row_blk, full_blk, full_blk, row_blk, vec_row, vec_row],
+        out_specs=row_blk,
+        out_shape=jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
+        interpret=interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          T_real=T, BK=_BQ),
+        grid=grid,
+        in_specs=[full_blk, row_blk, row_blk, full_blk, vec_full, vec_full],
+        out_specs=[row_blk, row_blk],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tp, Dp), v.dtype)],
+        interpret=interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :T, :D], dk[:, :T, :D], dv[:, :T, :D]
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q3, k3, v3, scale: float, causal: bool):
+    o, _ = _fwd(q3, k3, v3, scale, causal)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, scale, causal):
+    o, lse = _fwd(q3, k3, v3, scale, causal)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(scale, causal, res, do):
+    q3, k3, v3, o, lse = res
+    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, scale, causal)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    scale: Optional[float] = None) -> jax.Array:
+    """softmax(q k^T * scale [+ causal mask]) v without materializing the
+    score matrix in HBM.  q, k, v: (B, H, T, D) self-attention operands
+    (equal sequence lengths)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, H, T, D), got {q.shape}")
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError("flash_attention requires matching q/k/v shapes")
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    fold = lambda x: x.reshape(B * H, T, D)
+    out = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal))
+    return out.reshape(B, H, T, D)

@@ -4,6 +4,7 @@
 
 from typing import List, Optional, Tuple
 
+from . import multiproc
 from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, flat_dist_call)
 from .sync_batchnorm import SyncBatchNorm

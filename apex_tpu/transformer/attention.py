@@ -29,19 +29,45 @@ __all__ = ["dot_product_attention", "MultiheadAttention"]
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           scale: Optional[float] = None,
-                          dropout_rate: float = 0.0) -> jax.Array:
+                          dropout_rate: float = 0.0,
+                          causal: bool = False) -> jax.Array:
     """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax.
 
     ``dropout_rate`` applies attention-probability dropout in train mode
-    (rng drawn from the active apply-context, like nn.Dropout)."""
+    (rng drawn from the active apply-context, like nn.Dropout).
+    ``causal=True`` applies the lower-triangular mask; on TPU this (and
+    the mask-free case) dispatches to the fused Pallas flash kernel when
+    no explicit ``mask``/dropout forces the dense path."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    ctx = current_context()
+    train_dropout = (dropout_rate > 0.0 and ctx is not None and ctx.train)
+    if (mask is None and not train_dropout and q.ndim == 4
+            and q.shape == k.shape == v.shape):
+        from ..ops import dispatch
+        if dispatch.use_pallas_for(q):
+            from ..ops import pallas_flash_attention as pfa
+            if pfa.fits_vmem(q.shape[2], q.shape[3]):
+                # same cast policy the dense path applies through its
+                # whitelisted matmuls (op 'dot_product_attention' is in
+                # amp.lists.FP16_FUNCS), so dtype is backend-independent
+                from ..amp import policy as _pol
+                (q, k, v), _ = _pol.cast_op_args("dot_product_attention",
+                                                 (q, k, v), {})
+                return pfa.flash_attention(q, k, v, causal=causal,
+                                           scale=scale)
+    if causal and mask is None:
+        Tq, Tk = q.shape[-2], k.shape[-2]
+        # decode-style alignment: the last query attends to the full key
+        # sequence (q_pos = Tk - Tq + i); reduces to lower-triangular
+        # when Tq == Tk
+        qpos = Tk - Tq + jnp.arange(Tq)
+        mask = qpos[:, None] >= jnp.arange(Tk)[None, :]
     scores = F.matmul(q, jnp.swapaxes(k, -1, -2)).astype(jnp.float32) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))
     probs = jax.nn.softmax(scores, axis=-1)
-    ctx = current_context()
-    if dropout_rate > 0.0 and ctx is not None and ctx.train:
+    if train_dropout:
         probs = F.dropout(probs, dropout_rate, ctx.make_rng())
     return F.matmul(probs.astype(v.dtype), v)
 

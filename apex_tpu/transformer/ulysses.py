@@ -65,12 +65,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kh = _seq_to_head_sharded(k, axis_name)
     vh = _seq_to_head_sharded(v, axis_name)
 
-    T = qh.shape[2]
-    mask = None
-    if causal:
-        pos = jnp.arange(T)
-        mask = pos[:, None] >= pos[None, :]  # (T, T), full sequence local
-    out = dot_product_attention(qh, kh, vh, mask=mask, scale=scale)
+    out = dot_product_attention(qh, kh, vh, scale=scale, causal=causal)
 
     return _head_to_seq_sharded(out, axis_name)
 

@@ -289,3 +289,97 @@ def test_pallas_bn_through_batchnorm_module(monkeypatch):
     np.testing.assert_allclose(float(l_tst), float(l_ref), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(g_tst), np.asarray(g_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused flash attention (pallas_flash_attention)
+# ---------------------------------------------------------------------------
+
+def _dense_attn(q, k, v, causal):
+    import math
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(D)
+    if causal:
+        T = q.shape[2]
+        m = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 64, 16), (1, 3, 130, 24)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_fwd_bwd_matches_dense(shape, causal):
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+    ref = _dense_attn(q, k, v, causal)
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda t: jnp.sum(_dense_attn(*t, causal) ** 2)
+                     )((q, k, v))
+    g_out = jax.grad(lambda t: jnp.sum(
+        flash_attention(*t, causal=causal) ** 2))((q, k, v))
+    for a, b, name in zip(g_ref, g_out, "qkv"):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_flash_attention_bf16():
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (2, 2, 64, 32), jnp.bfloat16)
+               for kk in ks)
+    ref = _dense_attn(q, k, v, True).astype(jnp.float32)
+    raw = flash_attention(q, k, v, causal=True)
+    assert raw.dtype == jnp.bfloat16  # kernel preserves the input dtype
+    np.testing.assert_allclose(np.asarray(raw, np.float32),
+                               np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+def test_dot_product_attention_dispatches_to_flash(monkeypatch):
+    """With pallas forced, the mask-free 4-D path must route through the
+    flash kernel and agree with the dense jnp path."""
+    from apex_tpu.transformer import dot_product_attention
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (2, 2, 64, 16)) for kk in ks)
+
+    ref = dot_product_attention(q, k, v, causal=True)  # jnp (fixture)
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+    called = {}
+    from apex_tpu.ops import pallas_flash_attention as pfa
+    orig = pfa.flash_attention
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pfa, "flash_attention", spy)
+    out = dot_product_attention(q, k, v, causal=True)
+    assert called.get("yes"), "flash path not taken"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_path_respects_amp_policy(monkeypatch):
+    """Under an O1 cast policy the flash branch must return the same half
+    dtype the dense whitelisted-matmul path does."""
+    from apex_tpu.amp import policy as pol
+    from apex_tpu.transformer import dot_product_attention
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 64, 16)) for kk in ks)
+
+    with pol.use_policy(pol.CastPolicy(jnp.bfloat16)):
+        dense = dot_product_attention(q, k, v, causal=True)
+        monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+        monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+        flash = dot_product_attention(q, k, v, causal=True)
+    assert dense.dtype == flash.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(flash, np.float32),
+                               np.asarray(dense, np.float32),
+                               rtol=3e-2, atol=3e-2)

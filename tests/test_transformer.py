@@ -118,3 +118,19 @@ def test_multihead_attention_module():
     out, _ = model.apply(params, x)
     assert out.shape == (B, T, 16)
     assert jnp.all(jnp.isfinite(out))
+
+
+def test_causal_cross_length_decode_mask():
+    """causal=True with Tq < Tk (decode): the last query sees all keys,
+    the first query sees the first Tk-Tq+1 keys."""
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    Tq, Tk = 4, 12
+    q = jax.random.normal(ks[0], (1, 2, Tq, 8))
+    k = jax.random.normal(ks[1], (1, 2, Tk, 8))
+    v = jax.random.normal(ks[2], (1, 2, Tk, 8))
+    out = dot_product_attention(q, k, v, causal=True)
+    qpos = Tk - Tq + jnp.arange(Tq)
+    mask = qpos[:, None] >= jnp.arange(Tk)[None, :]
+    ref = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
