@@ -7,3 +7,20 @@ from .fp16util import (
     network_to_half, prep_param_lists, tofp16)
 from .fp16_optimizer import FP16_Optimizer
 from .loss_scaler import LossScaler, DynamicLossScaler
+
+
+class Fused_Weight_Norm:
+    """Working equivalent of the reference's *dangling* export: apex's
+    reparameterization imports ``Fused_Weight_Norm`` from fp16_utils, but
+    the reference snapshot no longer defines it (weight_norm.py:3 vs
+    fp16_utils/__init__.py:1-16 — SURVEY.md §2.1 flags the breakage).
+    Here the fused norm exists: w = g * v / ||v|| computed in fp32 in one
+    XLA fusion (apex_tpu.reparameterization.compute_weight)."""
+
+    @staticmethod
+    def apply(v, g, dim: int = 0):
+        from ..reparameterization import compute_weight
+        return compute_weight(g, v, dim)
+
+    def __call__(self, v, g, dim: int = 0):
+        return self.apply(v, g, dim)

@@ -5,6 +5,7 @@
 from typing import List, Optional, Tuple
 
 from . import multiproc
+from .topology import make_mesh, mesh_info
 from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, flat_dist_call)
 from .sync_batchnorm import SyncBatchNorm

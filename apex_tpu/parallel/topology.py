@@ -1,0 +1,86 @@
+"""Mesh construction helpers: ICI/DCN-aware device meshes.
+
+The reference's topology story is one line — NCCL over however many GPUs
+the launcher spawned.  On TPU the mesh layout decides which collectives
+ride ICI (fast intra-slice interconnect) and which cross DCN (inter-host
+network), so apex_tpu gives it a first-class helper:
+
+    mesh = make_mesh(data=-1)                      # pure DP over all chips
+    mesh = make_mesh(data=-1, sp=4)                # DP x sequence-parallel
+    mesh = make_mesh(data=-1, tp=8)                # DP over hosts, TP in-slice
+
+Axes are listed outermost-first; one axis may be -1 (inferred).  On
+multi-host runs the outermost axis is laid out across hosts (its
+collectives cross DCN — put data parallelism there, it communicates once
+per step) while inner axes stay within a slice on ICI (put tensor/sequence
+parallelism there, they communicate per layer).  This is the standard
+sharding recipe; ``jax.experimental.mesh_utils`` supplies the
+topology-aware device orderings underneath.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "mesh_info"]
+
+
+def make_mesh(devices: Optional[list] = None, **axes: int) -> Mesh:
+    """Build a Mesh from ``axis_name=size`` kwargs (outermost first).
+
+    One axis may be -1: it absorbs the remaining devices.  Raises if the
+    product does not cover the device count exactly.
+    """
+    if not axes:
+        axes = {"data": -1}
+    devs = list(jax.devices()) if devices is None else list(devices)
+    n = len(devs)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sum(1 for s in sizes if s == -1) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if fixed <= 0 or n % fixed != 0:
+        raise ValueError(
+            f"axis sizes {dict(zip(names, sizes))} do not divide "
+            f"{n} devices")
+    sizes = [n // fixed if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"axis sizes {dict(zip(names, sizes))} != {n} devices")
+
+    try:
+        from jax.experimental import mesh_utils
+        nproc = jax.process_count()
+        if nproc > 1:
+            # outermost axis spans hosts (its collectives cross DCN),
+            # inner axes stay within a slice (ICI)
+            if sizes[0] % nproc != 0:
+                raise ValueError(
+                    f"outermost axis {names[0]}={sizes[0]} must be "
+                    f"divisible by the process count {nproc}")
+            per_slice = (sizes[0] // nproc,) + tuple(sizes[1:])
+            dcn = (nproc,) + (1,) * (len(sizes) - 1)
+            arr = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devs)
+        else:
+            arr = mesh_utils.create_device_mesh(tuple(sizes), devices=devs)
+    except ValueError:
+        raise
+    except Exception:
+        # host-platform CPUs (tests) have no topology; plain reshape
+        arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def mesh_info(mesh: Mesh) -> str:
+    """One-line human description of a mesh, for startup logging."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    plat = mesh.devices.flat[0].platform
+    return (f"mesh {shape} over {mesh.devices.size} {plat} device(s), "
+            f"{jax.process_count()} process(es)")
