@@ -1,4 +1,4 @@
-"""Pallas fused attention kernels (fwd + bwd) for the MXU.
+"""Pallas blocked flash attention (fwd + bwd) for the MXU.
 
 New capability relative to the reference (2019, pre-attention — SURVEY.md
 §5): apex_tpu treats transformer workloads as first-class.  This kernel
@@ -7,25 +7,34 @@ it, ``ulysses_attention``'s per-head local attention.  (Ring attention
 keeps its own jnp online-softmax accumulation: its inner blocks interleave
 with ppermutes and XLA fuses them against the collective.)
 
-Design (memory-efficient attention, Rabe & Staats / FlashAttention
-family): queries are tiled into row blocks; K and V for one (batch, head)
-stay resident in VMEM, so each q-block computes its (BQ, T) score tile in
-one MXU call, softmaxes in fp32, and contracts with V — the full (T, T)
-matrix never exists in HBM.  The forward saves the per-row logsumexp; the
-backward recomputes probabilities from it (no stored probs) in two
-passes: a dQ pass tiled over q rows and a dK/dV pass tiled over k rows,
-each a handful of MXU contractions.
+Design (FlashAttention-style, true blocked form): the grid is
+(batch*heads, q_blocks, k_blocks) with the k axis innermost ("arbitrary"
+semantics, executed sequentially per core).  K and V are *streamed* one
+(BLK, D) block at a time — nothing scales with T in VMEM — while online
+softmax state (running max m, running sum l, unnormalized accumulator)
+lives in VMEM scratch that persists across the k-block sweep.  The
+forward emits the per-row logsumexp; the backward recomputes
+probabilities from it in two streamed passes: a dQ pass (K/V streamed)
+and a dK/dV pass (Q/dO streamed), each a handful of MXU contractions per
+block pair.  Causal q/k block pairs above the diagonal are skipped via
+``pl.when``.
 
-For sequences too long for K/V residency (``fits_vmem`` false) callers
-fall back to the jnp path; at that scale the right tool is ring
-attention's sequence sharding anyway.
+Per-row statistics (lse, delta and the m/l scratch) are stored
+lane-broadcast as (rows, 128) tiles — Mosaic requires the last two block
+dims to be (8k, 128k)-aligned, so a (rows,) vector is carried as a full
+lane tile with every lane equal (same layout the upstream
+jax.experimental.pallas.ops.tpu.flash_attention uses).
+
+Matmuls feed the MXU in the input dtype (bf16 stays bf16) with fp32
+accumulation via ``preferred_element_type``; softmax state is always
+fp32.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -35,20 +44,49 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_common import LANES, interpret
 
-_VMEM_BUDGET = 10 * 1024 * 1024
-_BQ = 256  # query rows per grid step
+_VMEM_BUDGET = 12 * 1024 * 1024
+_BLK = 512          # q/k rows per block (clamped to the padded seq len)
 _NEG = -1e30
 
 
+def _dot(a, b, contract):
+    """MXU contraction with fp32 accumulation.  Precision is pinned here
+    rather than inherited from jax_default_matmul_precision: fp32
+    operands get the full-precision passes (parity-grade), while bf16
+    operands stay native — Mosaic rejects fp32 contract precision on
+    bf16 inputs."""
+    prec = (jax.lax.Precision.HIGHEST if a.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    return lax.dot_general(a, b, (contract, ((), ())),
+                           preferred_element_type=jnp.float32,
+                           precision=prec)
+
+
+def _block_for(T: int) -> int:
+    """Largest block in {512, 256, 128} that divides the lane-padded
+    length — bounds zero-padding at 127 rows (a fixed 512 block would pad
+    T=600 to 1024, wasting 41% of every MXU contraction)."""
+    Tp = -(-T // LANES) * LANES
+    for blk in (_BLK, 256, LANES):
+        if Tp % blk == 0:
+            return min(blk, Tp)
+    return LANES
+
+
 def fits_vmem(T: int, D: int) -> bool:
-    """K, V, (+Q/dO/O tiles) resident per (b, h): keep the resident set
-    comfortably under budget."""
-    Tp = -(-T // _BQ) * _BQ
+    """VMEM needed per grid step — independent of T now that K/V stream
+    through the grid.  Sized for the worst pass (backward dK/dV): six
+    double-buffered operand blocks (q, k, v, do in; dk, dv out), two fp32
+    accumulator scratches, the lane-broadcast stats tiles, and the
+    (blk, blk) score/prob/dp/ds intermediates."""
+    blk = _block_for(T)
     Dp = -(-D // LANES) * LANES
-    resident = (2 * Tp * Dp        # K, V
-                + 2 * _BQ * Tp     # score tile + mask temps
-                + 4 * _BQ * Dp) * 4
-    return resident <= _VMEM_BUDGET
+    operands = 6 * blk * Dp          # q, k, v, do, dk, dv blocks
+    stats = 2 * blk * LANES          # lse + delta tiles
+    resident = 2 * (operands + stats) * 4          # double-buffered
+    scratch = 2 * blk * Dp * 4                     # dk/dv fp32 accumulators
+    score = 3 * blk * blk * 4                      # s/p + dp + ds tiles
+    return resident + scratch + score <= _VMEM_BUDGET
 
 
 def _pad_to(x, T, D):
@@ -59,165 +97,219 @@ def _pad_to(x, T, D):
     return jnp.pad(x, pad)
 
 
+def _lanes(vec, Tp):
+    """(BH, T) → (BH, Tp, LANES) lane-broadcast fp32."""
+    BH, T = vec.shape
+    v = jnp.pad(vec.astype(jnp.float32), ((0, 0), (0, Tp - T)))
+    return jax.lax.broadcast_in_dim(v, (BH, Tp, LANES), (0, 1))
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                T_real, BQ):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-    k = k_ref[0].astype(jnp.float32)                  # (T, D)
-    v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (BQ, T)
-    kpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = kpos < T_real
-    if causal:
-        qpos = qi * BQ + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        valid = jnp.logical_and(valid, qpos >= kpos)
-    s = jnp.where(valid, s, _NEG)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32) / l
-    o_ref[0] = o.astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, T_real, blk, nk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, _NEG, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    # causal: the (i, j) block pair is dead when its lowest q row sits
+    # above its lowest k column (j*blk > i*blk + blk - 1 ⇔ j > i)
+    run = (j <= i) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot(q, k, ((1,), (1,))) * scale
+        kpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kpos < T_real
+        if causal:
+            qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_ref[...][:, :1]                      # (blk, 1)
+        l_prev = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zeroing: when a row is fully masked m_new == _NEG and
+        # exp(s - m_new) would be exp(0) = 1 on the masked entries
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = _dot(p.astype(v.dtype), v, ((1,), (0,)))
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _done():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.broadcast_to(l_safe,
+                                                           lse_ref.shape[1:]))
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal"))
 def _fwd(q, k, v, scale, causal):
     BH, T, D = q.shape
-    Tp = -(-T // _BQ) * _BQ
+    blk = _block_for(T)
+    Tp = -(-T // blk) * blk
     Dp = -(-D // LANES) * LANES
-    qp = _pad_to(q, Tp, Dp)
-    kp = _pad_to(k, Tp, Dp)
-    vp = _pad_to(v, Tp, Dp)
-    grid = (BH, Tp // _BQ)
+    qp, kp, vp = (_pad_to(x, Tp, Dp) for x in (q, k, v))
+    nq, nk = Tp // blk, Tp // blk
+    grid = (BH, nq, nk)
+    row = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, i, 0))
+    col = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
+    stat = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          T_real=T, BQ=_BQ),
+                          T_real=T, blk=blk, nk=nk),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BQ), lambda b, i: (b, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=[row, col, col],
+        out_specs=[row, stat],
         out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
-                   jax.ShapeDtypeStruct((BH, Tp), jnp.float32)],
+                   jax.ShapeDtypeStruct((BH, Tp, LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((blk, LANES), jnp.float32),
+                        pltpu.VMEM((blk, LANES), jnp.float32),
+                        pltpu.VMEM((blk, Dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret(),
     )(qp, kp, vp)
-    return o[:, :T, :D], lse[:, :T]
+    return o[:, :T, :D], lse[:, :T, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, T_real, BQ):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    kpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = kpos < T_real
-    if causal:
-        qpos = qi * BQ + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        valid = jnp.logical_and(valid, qpos >= kpos)
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32) * scale
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, T_real, blk, nk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, jnp.float32)
+
+    run = (j <= i) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = _dot(q, k, ((1,), (1,))) * scale
+        kpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kpos < T_real
+        if causal:
+            qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = _dot(do, v, ((1,), (1,)))
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_acc[...] += _dot(ds, k, ((1,), (0,))) * scale
+
+    @pl.when(j == nk - 1)
+    def _done():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, T_real, BK):
-    ki = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (T, D) full queries
-    k = k_ref[0].astype(jnp.float32)                  # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)                # (T, D)
-    lse = lse_ref[0][None, :]                         # (1, T)
-    delta = delta_ref[0][None, :]
-    # transposed scores: (BK, T) = K_blk @ Q^T
-    st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    qpos = lax.broadcasted_iota(jnp.int32, st.shape, 1)
-    valid = qpos < T_real
-    if causal:
-        kpos = ki * BK + lax.broadcasted_iota(jnp.int32, st.shape, 0)
-        valid = jnp.logical_and(valid, qpos >= kpos)
-    pt = jnp.where(valid, jnp.exp(st - lse), 0.0)     # (BK, T)
-    dv = jax.lax.dot_general(pt, do, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)  # (BK, T)
-    dst = pt * (dpt - delta)
-    dk = jax.lax.dot_general(dst, q, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, T_real,
+                blk, nq):
+    i = pl.program_id(1)          # k block
+    j = pl.program_id(2)          # q block (streamed)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, jnp.float32)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, jnp.float32)
+
+    # causal: q block j only sees k block i when j*blk + blk - 1 >= i*blk
+    run = (j >= i) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = _dot(q, k, ((1,), (1,))) * scale
+        kpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kpos < T_real
+        if causal:
+            qpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        # padded q rows contribute nothing: their do rows are zero
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # (bq, bk)
+        dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        dp = _dot(do, v, ((1,), (1,)))
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[...] += _dot(ds, q, ((0,), (0,))) * scale
+
+    @pl.when(j == nq - 1)
+    def _done():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal"))
 def _bwd(q, k, v, o, lse, do, scale, causal):
     BH, T, D = q.shape
-    Tp = -(-T // _BQ) * _BQ
+    blk = _block_for(T)
+    Tp = -(-T // blk) * blk
     Dp = -(-D // LANES) * LANES
     qp, kp, vp = (_pad_to(x, Tp, Dp) for x in (q, k, v))
     dop = _pad_to(do, Tp, Dp)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
-    deltap = jnp.pad(delta, ((0, 0), (0, Tp - T)))
-    # padded rows: lse=0 would make exp(s-lse) = exp(-1e30)≈0 — safe
-    lsep = jnp.pad(lse, ((0, 0), (0, Tp - T)))
+    deltap = _lanes(delta, Tp)
+    lsep = _lanes(lse, Tp)
+    nq = nk = Tp // blk
+    sem = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-    row_blk = pl.BlockSpec((1, _BQ, Dp), lambda b, i: (b, i, 0),
-                           memory_space=pltpu.VMEM)
-    full_blk = pl.BlockSpec((1, Tp, Dp), lambda b, i: (b, 0, 0),
-                            memory_space=pltpu.VMEM)
-    vec_row = pl.BlockSpec((1, _BQ), lambda b, i: (b, i),
-                           memory_space=pltpu.VMEM)
-    vec_full = pl.BlockSpec((1, Tp), lambda b, i: (b, 0),
-                            memory_space=pltpu.VMEM)
-    grid = (BH, Tp // _BQ)
+    rowi = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, i, 0))
+    colj = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
+    stati = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
+    statj = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          T_real=T, BQ=_BQ),
-        grid=grid,
-        in_specs=[row_blk, full_blk, full_blk, row_blk, vec_row, vec_row],
-        out_specs=row_blk,
+                          T_real=T, blk=blk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[rowi, colj, colj, rowi, stati, stati],
+        out_specs=rowi,
         out_shape=jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk, Dp), jnp.float32)],
+        compiler_params=sem,
         interpret=interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          T_real=T, BK=_BQ),
-        grid=grid,
-        in_specs=[full_blk, row_blk, row_blk, full_blk, vec_full, vec_full],
-        out_specs=[row_blk, row_blk],
+                          T_real=T, blk=blk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[colj, rowi, rowi, colj, statj, statj],
+        out_specs=[rowi, rowi],
         out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), k.dtype),
                    jax.ShapeDtypeStruct((BH, Tp, Dp), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk, Dp), jnp.float32),
+                        pltpu.VMEM((blk, Dp), jnp.float32)],
+        compiler_params=sem,
         interpret=interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
     return dq[:, :T, :D], dk[:, :T, :D], dv[:, :T, :D]
@@ -252,7 +344,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None) -> jax.Array:
     """softmax(q k^T * scale [+ causal mask]) v without materializing the
     score matrix in HBM.  q, k, v: (B, H, T, D) self-attention operands
-    (equal sequence lengths)."""
+    (equal sequence lengths).  K/V are streamed through VMEM in blocks,
+    so the sequence length is bounded by HBM, not VMEM."""
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, T, D), got {q.shape}")
     if q.shape != k.shape or k.shape != v.shape:

@@ -56,13 +56,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                                  (q, k, v), {})
                 return pfa.flash_attention(q, k, v, causal=causal,
                                            scale=scale)
-    if causal and mask is None:
+    if causal:
         Tq, Tk = q.shape[-2], k.shape[-2]
         # decode-style alignment: the last query attends to the full key
         # sequence (q_pos = Tk - Tq + i); reduces to lower-triangular
-        # when Tq == Tk
+        # when Tq == Tk.  A user mask (e.g. padding) ANDs with the
+        # causal constraint — it must never replace it.
         qpos = Tk - Tq + jnp.arange(Tq)
-        mask = qpos[:, None] >= jnp.arange(Tk)[None, :]
+        cmask = qpos[:, None] >= jnp.arange(Tk)[None, :]
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
     scores = F.matmul(q, jnp.swapaxes(k, -1, -2)).astype(jnp.float32) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))

@@ -1,0 +1,112 @@
+"""Long-sequence flash attention parity, compiled on real TPU hardware.
+
+VERDICT round-2 item 5: the blocked kernel must hold fwd/bwd parity at
+T=8192 and T=32768 in bf16 — exactly where the old full-K/V-residency
+kernel silently fell back to dense O(T²) attention.  These tests only
+make sense compiled (interpret mode at T=32768 would run for hours), so
+they skip unless the suite runs with APEX_TPU_TEST_BACKEND=tpu.
+
+The reference is a chunked jnp attention (scan over q blocks, full-K
+softmax per block, jax.checkpoint so the backward rematerializes instead
+of saving O(T²) probabilities).
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("APEX_TPU_TEST_BACKEND") != "tpu",
+    reason="long-sequence parity runs compiled on TPU only")
+
+
+def _chunked_ref(q, k, v, causal, blk=512):
+    """O(T) -memory dense-math reference: softmax over the full key axis,
+    computed one q block at a time."""
+    import math
+    B, H, T, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(_, qi):
+        i, qblk = qi
+        s = jnp.einsum("bhqd,bhkd->bhqk", qblk.astype(jnp.float32),
+                       kf) * scale
+        kpos = jnp.arange(T)[None, :]
+        qpos = i * blk + jnp.arange(blk)[:, None]
+        if causal:
+            s = jnp.where(qpos >= kpos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return None, jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+
+    qb = q.reshape(B, H, T // blk, blk, D).transpose(2, 0, 1, 3, 4)
+    _, ob = jax.lax.scan(body, None, (jnp.arange(T // blk), qb))
+    return ob.transpose(1, 2, 0, 3, 4).reshape(B, H, T, D).astype(q.dtype)
+
+
+@pytest.mark.parametrize("T,causal", [(8192, True), (8192, False),
+                                      (32768, True)])
+def test_flash_long_fwd(T, causal):
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (1, 2, T, 128)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = jax.jit(_chunked_ref, static_argnames=("causal",))(
+        q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("T", [8192, 32768])
+def test_flash_long_bwd(T):
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    shape = (1, 2, T, 128)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+
+    def loss_flash(t):
+        return jnp.sum(flash_attention(*t, causal=True).astype(jnp.float32)
+                       ** 2)
+
+    def loss_ref(t):
+        return jnp.sum(_chunked_ref(*t, causal=True).astype(jnp.float32)
+                       ** 2)
+
+    g_f = jax.jit(jax.grad(loss_flash))((q, k, v))
+    g_r = jax.jit(jax.grad(loss_ref))((q, k, v))
+    for a, b, name in zip(g_f, g_r, "qkv"):
+        af = np.asarray(a, np.float32)
+        bf = np.asarray(b, np.float32)
+        # bf16 grads: elementwise to within bf16 rounding of the grad
+        # scale, plus a direction check over the whole tensor
+        rms = np.sqrt((bf ** 2).mean()) + 1e-8
+        np.testing.assert_allclose(af, bf, rtol=0.15, atol=0.35 * rms,
+                                   err_msg=f"d{name}")
+        cos = (af * bf).sum() / (np.linalg.norm(af) * np.linalg.norm(bf)
+                                 + 1e-8)
+        assert cos > 0.999, f"d{name} cosine {cos}"
+
+
+def test_flash_ulysses_long():
+    """Ulysses (all_to_all head-scatter) must route its local attention
+    through the blocked kernel at long T on the single real chip
+    (mesh of 1: degenerate but exercises the dispatch path)."""
+    from apex_tpu.transformer import dot_product_attention
+    from apex_tpu.ops import dispatch
+    assert dispatch.pallas_enabled()
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 8192, 128), jnp.bfloat16)
+               for kk in ks)
+    out = dot_product_attention(q, k, v, causal=True)
+    ref = jax.jit(_chunked_ref, static_argnames=("causal",))(
+        q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)

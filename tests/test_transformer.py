@@ -134,3 +134,23 @@ def test_causal_cross_length_decode_mask():
     ref = dot_product_attention(q, k, v, mask=mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_causal_with_padding_mask_keeps_causality():
+    """Regression (round-1 advisor, medium): causal=True combined with an
+    explicit mask must AND the two constraints — the old code silently
+    dropped causality whenever any mask was passed."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, T, 8)) for kk in ks)
+    valid_len = T - 7
+    pad = (jnp.arange(T) < valid_len)[None, :]        # key padding mask
+    out = dot_product_attention(q, k, v, mask=pad, causal=True)
+
+    pos = jnp.arange(T)
+    combined = jnp.logical_and(pos[:, None] >= pos[None, :], pad)
+    ref = dot_product_attention(q, k, v, mask=combined)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # and it must differ from the padding-only result (proves the AND)
+    wrong = dot_product_attention(q, k, v, mask=pad)
+    assert not np.allclose(np.asarray(out), np.asarray(wrong))
