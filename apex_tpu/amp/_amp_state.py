@@ -30,6 +30,9 @@ def master_params(optimizer):
     if masters is None:
         raise AttributeError(
             "master_params requires an optimizer returned by amp.initialize")
+    from ._process_optimizer import FlatMasters
+    if isinstance(masters, FlatMasters):
+        masters = masters.as_tree()   # per-tensor views of the flat buffer
     yield from jax.tree_util.tree_leaves(masters)
 
 

@@ -95,7 +95,16 @@ class BoundOptimizer:
             return
         inner = self.optimizer.inner
         ost = self.opt_state
-        if ost.masters is not None:
+        from ._process_optimizer import FlatMasters
+        if isinstance(ost.masters, FlatMasters):
+            lay = ost.masters.layout
+            new_buf, new_inner, half = self.optimizer._flat_inner_step(
+                ost.masters, ost.inner, lay.pack(self._grads32))
+            self.params = lay.rebuild(
+                new_buf, half, jax.tree_util.tree_leaves(self.params))
+            self.opt_state = ost._replace(
+                masters=FlatMasters(new_buf, lay), inner=new_inner)
+        elif ost.masters is not None:
             new_masters, new_inner = inner.update(
                 self._grads32, ost.inner, ost.masters)
             self.params = jax.tree_util.tree_map(

@@ -58,6 +58,22 @@ def interpret_mode() -> bool:
     return backend() != "tpu"
 
 
+def pallas_forced() -> bool:
+    """True only under APEX_TPU_FORCE_PALLAS=1 (kernel parity tests).
+
+    Ops whose jnp form XLA fuses into neighbouring computation for free
+    (e.g. the BatchNorm scale+shift apply) gate on this instead of
+    ``pallas_enabled()``: a standalone kernel there forces an extra HBM
+    round-trip and an (8,128)-misaligned NCHW tiling — measured at ~3x
+    the whole ResNet-50 forward (round-3 profiling).  The fused kernels
+    that *beat* XLA (flash attention, fused Adam, multi-tensor scale over
+    one flat buffer) keep using ``pallas_enabled()``."""
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS") == "1":
+        return False
+    return (os.environ.get("APEX_TPU_FORCE_PALLAS") == "1"
+            and kernels_available())
+
+
 def use_pallas_for(tree: Any) -> bool:
     if not pallas_enabled():
         return False

@@ -44,6 +44,10 @@ class SGDState(NamedTuple):
 
 
 class SGD(Optimizer):
+    # purely elementwise given scalar hyperparams: safe to run on a fused
+    # flat buffer (amp._process_optimizer.FlatMasters fast path)
+    elementwise = True
+
     def __init__(self, lr: Schedule = 0.01, momentum: float = 0.0,
                  weight_decay: float = 0.0, nesterov: bool = False,
                  dampening: float = 0.0):

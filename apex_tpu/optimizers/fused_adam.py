@@ -68,6 +68,11 @@ def _adam_kernel(p, m, v, g, step_size, combined_scale, beta1, beta2, eps,
 class FusedAdam(Optimizer):
     """Signature parity with the reference (fused_adam.py:17-49)."""
 
+    # purely elementwise given scalars: safe on a fused flat buffer, and
+    # the kernel can emit the half model copy in the same pass
+    elementwise = True
+    supports_output_params_dtype = True
+
     def __init__(self, lr=1e-3, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  eps_inside_sqrt: bool = False, weight_decay: float = 0.0,

@@ -95,16 +95,25 @@ def test_flash_long_bwd(T):
 
 
 def test_flash_ulysses_long():
-    """Ulysses (all_to_all head-scatter) must route its local attention
-    through the blocked kernel at long T on the single real chip
-    (mesh of 1: degenerate but exercises the dispatch path)."""
-    from apex_tpu.transformer import dot_product_attention
+    """ulysses_attention (all_to_all head-scatter) must route its local
+    attention through the blocked kernel at long T.  On the single real
+    chip the sp axis has size 1 — the all_to_all is an identity but the
+    whole Ulysses code path (scatter, local flash attention, gather)
+    executes compiled."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer import ulysses_attention
     from apex_tpu.ops import dispatch
     assert dispatch.pallas_enabled()
     ks = jax.random.split(jax.random.PRNGKey(2), 3)
     q, k, v = (jax.random.normal(kk, (1, 2, 8192, 128), jnp.bfloat16)
                for kk in ks)
-    out = dot_product_attention(q, k, v, causal=True)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp",
+                                          causal=True),
+        mesh=mesh, in_specs=(P(None, None, "sp"),) * 3,
+        out_specs=P(None, None, "sp"), check_vma=False))
+    out = f(q, k, v)
     ref = jax.jit(_chunked_ref, static_argnames=("causal",))(
         q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32),

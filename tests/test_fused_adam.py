@@ -148,3 +148,33 @@ def test_fp16_optimizer_masters_stay_fp32():
     new_p, st2, info = fo.step(params, st, grads)
     assert new_p["w"].dtype == jnp.float16
     assert float(info["found_inf"]) == 0.0
+
+
+def test_flat_masters_nonfloat_leaf_roundtrip():
+    """Flat-master fast path with a non-float leaf in the params tree:
+    the int leaf passes through updates untouched and masters_tree /
+    master_params yield None for it instead of crashing."""
+    from apex_tpu import amp
+    from apex_tpu.amp._process_optimizer import FlatMasters
+    import apex_tpu.nn as nn
+
+    class M(nn.Module):
+        def forward(self, params, x):
+            return x * params["w"].sum()
+
+    model, opt = amp.initialize(M(), FusedAdam(lr=0.1), opt_level="O2",
+                                verbosity=0)
+    params = {"w": jnp.ones((4,), jnp.bfloat16),
+              "idx": jnp.arange(3, dtype=jnp.int32)}
+    st = opt.init(params)
+    assert isinstance(st.masters, FlatMasters)
+    grads = {"w": jnp.ones((4,), jnp.bfloat16),
+             "idx": jnp.zeros((3,), jnp.int32)}
+    new_p, new_st, info = opt.step(params, st, grads)
+    assert new_p["idx"].dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(new_p["idx"]), [0, 1, 2])
+    assert new_p["w"].dtype == jnp.bfloat16
+    assert not np.allclose(np.asarray(new_p["w"], np.float32),
+                           np.asarray(params["w"], np.float32))
+    mt = opt.masters_tree(new_st)
+    assert mt["idx"] is None and mt["w"].dtype == jnp.float32
