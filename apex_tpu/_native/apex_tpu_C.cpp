@@ -13,6 +13,7 @@
 // apex_tpu/_native/__init__.py, mirroring the reference's graceful
 // degradation (README.md:90-95).
 
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -241,17 +242,49 @@ struct Loader {
   int64_t next_deliver = 0;
   bool stop = false;
 
-  int64_t SampleIndex(int64_t global_batch, int64_t j) const {
+  // Per-epoch true permutations (Fisher–Yates over a splitmix64 stream),
+  // matching the Python fallback's np.random.permutation semantics: every
+  // sample appears exactly once per epoch.  The previous affine-bijection
+  // "shuffle" was a correlated-stride walk, not a uniform shuffle
+  // (round-1 advisor finding).  Workers can race across an epoch
+  // boundary, so the two most recent epochs stay cached.
+  std::mutex perm_mu;
+  std::array<int64_t, 2> perm_epoch{-1, -1};
+  std::array<std::vector<int64_t>, 2> perms;
+
+  static uint64_t SplitMix64(uint64_t& s) {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+
+  // Returns perm_epoch(epoch)[i] *by value, under the lock*: a reference
+  // escaping the lock could be regenerated in place by a worker two
+  // epochs ahead sharing the same cache slot (tiny datasets put 3+
+  // epochs in flight with the default prefetch depth).
+  int64_t PermAt(int64_t epoch, int64_t i) {
+    std::lock_guard<std::mutex> lock(perm_mu);
+    int slot = epoch & 1;
+    if (perm_epoch[slot] != epoch) {
+      auto& p = perms[slot];
+      p.resize(n);
+      for (int64_t k = 0; k < n; ++k) p[k] = k;
+      uint64_t s = seed + 0x9e3779b97f4a7c15ull * (epoch + 1);
+      for (int64_t k = n - 1; k > 0; --k) {
+        int64_t j = static_cast<int64_t>(SplitMix64(s) % (k + 1));
+        std::swap(p[k], p[j]);
+      }
+      perm_epoch[slot] = epoch;
+    }
+    return perms[slot][i];
+  }
+
+  int64_t SampleIndex(int64_t global_batch, int64_t j) {
     int64_t epoch = global_batch / batches_per_epoch;
     int64_t i = (global_batch % batches_per_epoch) * batch + j;
     if (!shuffle) return i;
-    // affine bijection with a odd and gcd(a, n) == 1
-    uint64_t mix = seed + 0x9e3779b97f4a7c15ull * (epoch + 1);
-    uint64_t a = (mix | 1) % n;
-    if (a == 0) a = 1;
-    while (std::gcd<uint64_t, uint64_t>(a, n) != 1) a += 2;
-    uint64_t cshift = (mix >> 17) % n;
-    return static_cast<int64_t>((a * i + cshift) % n);
+    return PermAt(epoch, i);
   }
 
   void Fill(Slot& s, int64_t b) {
@@ -333,7 +366,10 @@ int64_t apex_loader_next(void* loader, const float** out_images,
   auto* L = static_cast<Loader*>(loader);
   std::unique_lock<std::mutex> lock(L->mu);
   Slot* hit = nullptr;
+  // stop also releases consumers: destroy() must not hang a thread
+  // blocked here (round-1 advisor finding)
   L->cv_ready.wait(lock, [&] {
+    if (L->stop) return true;
     for (auto& s : L->slots) {
       if (s.state == Slot::kReady && s.batch == L->next_deliver) {
         hit = &s;
@@ -342,6 +378,7 @@ int64_t apex_loader_next(void* loader, const float** out_images,
     }
     return false;
   });
+  if (L->stop && hit == nullptr) return -1;
   hit->state = Slot::kInUse;
   L->next_deliver++;
   *out_images = hit->images.data();
@@ -371,6 +408,7 @@ void apex_loader_destroy(void* loader) {
     L->stop = true;
   }
   L->cv_free.notify_all();
+  L->cv_ready.notify_all();   // wake any consumer blocked in next()
   for (auto& wkr : L->workers) wkr.join();
   delete L;
 }

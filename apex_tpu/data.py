@@ -113,6 +113,10 @@ class DataLoader:
         lbl_p = ctypes.c_void_p()
         b = self._lib.apex_loader_next(self._handle, ctypes.byref(img_p),
                                        ctypes.byref(lbl_p))
+        if b < 0:
+            # destroy() woke us mid-wait: the slot pointers were never
+            # filled — stop cleanly instead of dereferencing NULL
+            raise StopIteration("data loader shut down")
         self._held = img_p
         shape = (self.batch_size, self.c, self.h, self.w)
         imgs = np.ctypeslib.as_array(

@@ -361,3 +361,589 @@ def add(a: jax.Array, b: jax.Array) -> jax.Array:
 @op("mul")
 def mul(a: jax.Array, b: jax.Array) -> jax.Array:
     return a * b
+
+
+# ---------------------------------------------------------------------------
+# The full amp.lists surface (round-2 VERDICT item 8): every name the O1
+# tables classify exists as a policy-aware op, so the whitelist/blacklist/
+# promote guarantees hold wherever users reach for the framework's
+# functional layer (the analogue of the reference patching ~200 torch entry
+# points, apex/amp/amp.py:68-177).
+# ---------------------------------------------------------------------------
+
+# -- MXU whitelist: gemm family (torch_overrides.py:7-27) -------------------
+
+@op("mm")
+def mm(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.matmul(a, b)
+
+
+@op("mv")
+def mv(a: jax.Array, v: jax.Array) -> jax.Array:
+    return jnp.matmul(a, v)
+
+
+@op("bmm")
+def bmm(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.matmul(a, b)
+
+
+@op("addmm")
+def addmm(c: jax.Array, a: jax.Array, b: jax.Array, *, beta: float = 1.0,
+          alpha: float = 1.0) -> jax.Array:
+    return beta * c + alpha * jnp.matmul(a, b)
+
+
+@op("addmv")
+def addmv(c: jax.Array, a: jax.Array, v: jax.Array, *, beta: float = 1.0,
+          alpha: float = 1.0) -> jax.Array:
+    return beta * c + alpha * jnp.matmul(a, v)
+
+
+@op("addr")
+def addr(c: jax.Array, u: jax.Array, v: jax.Array, *, beta: float = 1.0,
+         alpha: float = 1.0) -> jax.Array:
+    return beta * c + alpha * jnp.outer(u, v)
+
+
+@op("addbmm")
+def addbmm(c: jax.Array, a: jax.Array, b: jax.Array, *, beta: float = 1.0,
+           alpha: float = 1.0) -> jax.Array:
+    return beta * c + alpha * jnp.sum(jnp.matmul(a, b), axis=0)
+
+
+@op("baddbmm")
+def baddbmm(c: jax.Array, a: jax.Array, b: jax.Array, *, beta: float = 1.0,
+            alpha: float = 1.0) -> jax.Array:
+    return beta * c + alpha * jnp.matmul(a, b)
+
+
+@op("prelu")
+def prelu(x: jax.Array, weight: jax.Array) -> jax.Array:
+    w = weight.reshape((1, -1) + (1,) * (x.ndim - 2)) if x.ndim > 1 else weight
+    return jnp.where(x >= 0, x, w.astype(x.dtype) * x)
+
+
+# -- MXU whitelist: conv family ---------------------------------------------
+
+def _convnd(x, weight, stride, padding, dilation, groups, nd):
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nd
+    if isinstance(padding, int):
+        padding = ((padding, padding),) * nd
+    elif (isinstance(padding, tuple)
+          and all(isinstance(p, int) for p in padding)):
+        padding = tuple((p, p) for p in padding)
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=(lhs, rhs, lhs))
+
+
+@op("conv1d")
+def conv1d(x: jax.Array, weight: jax.Array,
+           bias: Optional[jax.Array] = None, stride=1, padding=0,
+           dilation=1, groups: int = 1) -> jax.Array:
+    """NCW conv; weight (O, I/groups, kW) like torch."""
+    y = _convnd(x, weight, stride, padding, dilation, groups, 1)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None]
+    return y
+
+
+@op("conv3d")
+def conv3d(x: jax.Array, weight: jax.Array,
+           bias: Optional[jax.Array] = None, stride=1, padding=0,
+           dilation=1, groups: int = 1) -> jax.Array:
+    """NCDHW conv; weight (O, I/groups, kD, kH, kW) like torch."""
+    y = _convnd(x, weight, stride, padding, dilation, groups, 3)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None, None, None]
+    return y
+
+
+def _conv_transposend(x, weight, stride, padding, nd):
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    spatial = "DHW"[-nd:]
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    k = weight.shape[2:]
+    pads = tuple((ki - 1 - p, ki - 1 - p) for ki, p in zip(k, padding))
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pads, lhs_dilation=stride,
+        dimension_numbers=(lhs, rhs, lhs))
+
+
+@op("conv_transpose1d")
+def conv_transpose1d(x: jax.Array, weight: jax.Array,
+                     bias: Optional[jax.Array] = None, stride=1,
+                     padding=0) -> jax.Array:
+    """NCW transposed conv; weight (I, O, kW) like torch."""
+    y = _conv_transposend(x, weight, stride, padding, 1)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None]
+    return y
+
+
+@op("conv_transpose3d")
+def conv_transpose3d(x: jax.Array, weight: jax.Array,
+                     bias: Optional[jax.Array] = None, stride=1,
+                     padding=0) -> jax.Array:
+    """NCDHW transposed conv; weight (I, O, kD, kH, kW) like torch."""
+    y = _conv_transposend(x, weight, stride, padding, 3)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)[None, :, None, None, None]
+    return y
+
+
+@op("conv_tbc")
+def conv_tbc(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array],
+             pad: int = 0) -> jax.Array:
+    """Time×Batch×Channels conv (torch.conv_tbc): x (T, B, Cin), weight
+    (kW, Cin, Cout)."""
+    ncw = jnp.transpose(x, (1, 2, 0))                 # (B, Cin, T)
+    w = jnp.transpose(weight, (2, 1, 0))              # (Cout, Cin, kW)
+    y = lax.conv_general_dilated(
+        ncw, w, window_strides=(1,), padding=((pad, pad),),
+        dimension_numbers=("NCW", "OIW", "NCW"))
+    y = jnp.transpose(y, (2, 0, 1))                   # (T', B, Cout)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+# -- fp32 blacklist: pointwise transcendentals ------------------------------
+
+def _fp32_unary(name, fn):
+    @op(name)
+    @functools.wraps(fn)
+    def wrapper(x, *args, **kwargs):
+        return fn(x, *args, **kwargs)
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    return wrapper
+
+
+exp = _fp32_unary("exp", jnp.exp)
+expm1 = _fp32_unary("expm1", jnp.expm1)
+log = _fp32_unary("log", jnp.log)
+log10 = _fp32_unary("log10", jnp.log10)
+log2 = _fp32_unary("log2", jnp.log2)
+log1p = _fp32_unary("log1p", jnp.log1p)
+reciprocal = _fp32_unary("reciprocal", jnp.reciprocal)
+rsqrt = _fp32_unary("rsqrt", lax.rsqrt)
+acos = _fp32_unary("acos", jnp.arccos)
+asin = _fp32_unary("asin", jnp.arcsin)
+cosh = _fp32_unary("cosh", jnp.cosh)
+sinh = _fp32_unary("sinh", jnp.sinh)
+tan = _fp32_unary("tan", jnp.tan)
+erf = _fp32_unary("erf", jax.scipy.special.erf)
+erfinv = _fp32_unary("erfinv", jax.scipy.special.erfinv)
+cumsum = _fp32_unary("cumsum", jnp.cumsum)
+cumprod = _fp32_unary("cumprod", jnp.cumprod)
+
+
+@op("pow")
+def pow(x: jax.Array, exponent) -> jax.Array:  # noqa: A001 (torch name)
+    return jnp.power(x, exponent)
+
+
+@op("softplus")
+def softplus(x: jax.Array, beta: float = 1.0,
+             threshold: float = 20.0) -> jax.Array:
+    scaled = beta * x
+    # clamp the exp argument: where() evaluates both branches, and an
+    # overflowed exp would turn the dead branch's zero cotangent into
+    # 0*inf = NaN in the backward pass
+    safe = jnp.log1p(jnp.exp(jnp.minimum(scaled, threshold))) / beta
+    return jnp.where(scaled > threshold, x, safe)
+
+
+# -- fp32 blacklist: reductions ---------------------------------------------
+
+sum = _fp32_unary("sum", jnp.sum)        # noqa: A001 (torch name)
+mean = _fp32_unary("mean", jnp.mean)
+prod = _fp32_unary("prod", jnp.prod)
+std = _fp32_unary("std", functools.partial(jnp.std, ddof=1))
+var = _fp32_unary("var", functools.partial(jnp.var, ddof=1))
+logsumexp = _fp32_unary("logsumexp", jax.scipy.special.logsumexp)
+
+
+@op("norm")
+def norm(x: jax.Array, p: float = 2.0, axis=None,
+         keepdims: bool = False) -> jax.Array:
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdims) ** (1.0 / p)
+
+
+@op("dist")
+def dist(a: jax.Array, b: jax.Array, p: float = 2.0) -> jax.Array:
+    d = a - b
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(d)))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@op("renorm")
+def renorm(x: jax.Array, p: float, axis: int, maxnorm: float) -> jax.Array:
+    """Per-slice (along ``axis``) p-norm clamp to maxnorm (torch.renorm)."""
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    if p == 2.0:
+        norms = jnp.sqrt(jnp.sum(jnp.square(flat), axis=1))
+    else:
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    factor = jnp.where(norms > maxnorm, maxnorm / (norms + 1e-7), 1.0)
+    out = flat * factor[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+@op("softmin")
+def softmin(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@op("normalize")
+def normalize(x: jax.Array, p: float = 2.0, axis: int = 1,
+              eps: float = 1e-12) -> jax.Array:
+    if p == 2.0:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, eps)
+
+
+@op("cosine_similarity")
+def cosine_similarity(a: jax.Array, b: jax.Array, axis: int = 1,
+                      eps: float = 1e-8) -> jax.Array:
+    num = jnp.sum(a * b, axis=axis)
+    na = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis))
+    nb = jnp.sqrt(jnp.sum(jnp.square(b), axis=axis))
+    return num / jnp.maximum(na * nb, eps)
+
+
+@op("pdist")
+def pdist(x: jax.Array, p: float = 2.0) -> jax.Array:
+    """Condensed pairwise distances of the rows of x (N, D)."""
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    if p == 2.0:
+        d = jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-30)
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return d[iu, ju]
+
+
+# -- fp32 blacklist: norms ---------------------------------------------------
+
+@op("group_norm")
+def group_norm(x: jax.Array, num_groups: int,
+               weight: Optional[jax.Array] = None,
+               bias: Optional[jax.Array] = None,
+               eps: float = 1e-5) -> jax.Array:
+    N, C = x.shape[:2]
+    g = x.reshape(N, num_groups, C // num_groups, *x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean_ = jnp.mean(g, axis=axes, keepdims=True)
+    var_ = jnp.mean(jnp.square(g - mean_), axis=axes, keepdims=True)
+    out = ((g - mean_) * lax.rsqrt(var_ + eps)).reshape(x.shape)
+    shape = (1, C) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("instance_norm")
+def instance_norm(x: jax.Array, weight: Optional[jax.Array] = None,
+                  bias: Optional[jax.Array] = None,
+                  eps: float = 1e-5) -> jax.Array:
+    axes = tuple(range(2, x.ndim))
+    mean_ = jnp.mean(x, axis=axes, keepdims=True)
+    var_ = jnp.mean(jnp.square(x - mean_), axis=axes, keepdims=True)
+    out = (x - mean_) * lax.rsqrt(var_ + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("batch_norm")
+def batch_norm(x: jax.Array, running_mean: Optional[jax.Array],
+               running_var: Optional[jax.Array],
+               weight: Optional[jax.Array] = None,
+               bias: Optional[jax.Array] = None, training: bool = False,
+               momentum: float = 0.1, eps: float = 1e-5) -> jax.Array:
+    """Stateless F.batch_norm parity (stats updates live in the BatchNorm
+    modules; here running stats are inputs)."""
+    if training or running_mean is None:
+        axes = (0,) + tuple(range(2, x.ndim))
+        _, mean_, var_ = batch_norm_stats(x, axes)
+    else:
+        mean_, var_ = running_mean, running_var
+    return batch_norm_apply(x, mean_, var_, weight, bias, eps)
+
+
+# -- fp32 blacklist: losses --------------------------------------------------
+
+@op("smooth_l1_loss")
+def smooth_l1_loss(x: jax.Array, target: jax.Array, beta: float = 1.0,
+                   reduction: str = "mean") -> jax.Array:
+    d = jnp.abs(x - target)
+    loss = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
+    return _reduce(loss, reduction)
+
+
+@op("kl_div")
+def kl_div(log_pred: jax.Array, target: jax.Array,
+           reduction: str = "mean", log_target: bool = False) -> jax.Array:
+    if log_target:
+        loss = jnp.exp(target) * (target - log_pred)
+    else:
+        loss = jnp.where(target > 0, target * (jnp.log(
+            jnp.maximum(target, 1e-38)) - log_pred), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / log_pred.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op("soft_margin_loss")
+def soft_margin_loss(x: jax.Array, target: jax.Array,
+                     reduction: str = "mean") -> jax.Array:
+    return _reduce(jnp.log1p(jnp.exp(-target * x)), reduction)
+
+
+@op("poisson_nll_loss")
+def poisson_nll_loss(log_input: jax.Array, target: jax.Array,
+                     log_input_form: bool = True, full: bool = False,
+                     eps: float = 1e-8,
+                     reduction: str = "mean") -> jax.Array:
+    if log_input_form:
+        loss = jnp.exp(log_input) - target * log_input
+    else:
+        loss = log_input - target * jnp.log(log_input + eps)
+    if full:
+        stirling = (target * jnp.log(jnp.maximum(target, 1.0))
+                    - target + 0.5 * jnp.log(2 * jnp.pi *
+                                             jnp.maximum(target, 1.0)))
+        loss = loss + jnp.where(target > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op("cosine_embedding_loss")
+def cosine_embedding_loss(a: jax.Array, b: jax.Array, target: jax.Array,
+                          margin: float = 0.0,
+                          reduction: str = "mean") -> jax.Array:
+    cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-8)
+    loss = jnp.where(target == 1, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@op("hinge_embedding_loss")
+def hinge_embedding_loss(x: jax.Array, target: jax.Array,
+                         margin: float = 1.0,
+                         reduction: str = "mean") -> jax.Array:
+    loss = jnp.where(target == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+@op("margin_ranking_loss")
+def margin_ranking_loss(x1: jax.Array, x2: jax.Array, target: jax.Array,
+                        margin: float = 0.0,
+                        reduction: str = "mean") -> jax.Array:
+    return _reduce(jnp.maximum(0.0, -target * (x1 - x2) + margin), reduction)
+
+
+@op("triplet_margin_loss")
+def triplet_margin_loss(anchor: jax.Array, positive: jax.Array,
+                        negative: jax.Array, margin: float = 1.0,
+                        p: float = 2.0,
+                        reduction: str = "mean") -> jax.Array:
+    dp = jnp.sum(jnp.abs(anchor - positive) ** p, axis=-1) ** (1.0 / p)
+    dn = jnp.sum(jnp.abs(anchor - negative) ** p, axis=-1) ** (1.0 / p)
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+@op("multi_margin_loss")
+def multi_margin_loss(x: jax.Array, target: jax.Array, p: float = 1.0,
+                      margin: float = 1.0,
+                      reduction: str = "mean") -> jax.Array:
+    N, C = x.shape
+    xy = x[jnp.arange(N), target][:, None]
+    loss = jnp.maximum(0.0, margin - xy + x) ** p
+    loss = loss.at[jnp.arange(N), target].set(0.0)
+    return _reduce(jnp.sum(loss, axis=1) / C, reduction)
+
+
+@op("multilabel_margin_loss")
+def multilabel_margin_loss(x: jax.Array, target: jax.Array,
+                           reduction: str = "mean") -> jax.Array:
+    """torch semantics: per sample, target holds class indices padded with
+    -1 after the first -1; loss sums max(0, 1 - (x[y] - x[k])) over target
+    classes y and non-target classes k, / C."""
+    N, C = x.shape
+    first_neg = jnp.argmax(target < 0, axis=1)
+    has_neg = jnp.any(target < 0, axis=1)
+    count = jnp.where(has_neg, first_neg, C)          # valid targets
+    pos_mask = jnp.arange(C)[None, :] < count[:, None]  # (N, C) positions
+    tgt = jnp.where(pos_mask, target, 0)
+    is_target = jnp.zeros((N, C), bool).at[
+        jnp.repeat(jnp.arange(N), C),
+        tgt.reshape(-1)].max(pos_mask.reshape(-1))
+    xy = jnp.take_along_axis(x, tgt, axis=1)          # (N, C) target scores
+    # pairwise: for each valid target slot j and non-target class k
+    diff = 1.0 - (xy[:, :, None] - x[:, None, :])     # (N, C, C)
+    valid = (pos_mask[:, :, None]
+             & ~is_target[:, None, :])
+    loss = jnp.sum(jnp.where(valid, jnp.maximum(0.0, diff), 0.0),
+                   axis=(1, 2)) / C
+    return _reduce(loss, reduction)
+
+
+# -- promote ops -------------------------------------------------------------
+
+@op("sub")
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a - b
+
+
+@op("div")
+def div(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a / b
+
+
+@op("addcdiv")
+def addcdiv(x: jax.Array, a: jax.Array, b: jax.Array,
+            value: float = 1.0) -> jax.Array:
+    return x + value * (a / b)
+
+
+@op("addcmul")
+def addcmul(x: jax.Array, a: jax.Array, b: jax.Array,
+            value: float = 1.0) -> jax.Array:
+    return x + value * (a * b)
+
+
+@op("atan2")
+def atan2(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.arctan2(a, b)
+
+
+@op("cross")
+def cross(a: jax.Array, b: jax.Array, axis: int = -1) -> jax.Array:
+    return jnp.cross(a, b, axis=axis)
+
+
+@op("dot")
+def dot(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.dot(a, b)
+
+
+@op("bilinear")
+def bilinear(x1: jax.Array, x2: jax.Array, weight: jax.Array,
+             bias: Optional[jax.Array] = None) -> jax.Array:
+    """torch.nn.functional.bilinear: weight (out, in1, in2)."""
+    y = jnp.einsum("...i,oij,...j->...o", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op("eq")
+def eq(a, b):
+    return a == b
+
+
+@op("ne")
+def ne(a, b):
+    return a != b
+
+
+@op("lt")
+def lt(a, b):
+    return a < b
+
+
+@op("gt")
+def gt(a, b):
+    return a > b
+
+
+@op("le")
+def le(a, b):
+    return a <= b
+
+
+@op("ge")
+def ge(a, b):
+    return a >= b
+
+
+@op("equal")
+def equal(a, b):
+    return jnp.array_equal(a, b)
+
+
+@op("min")
+def min(a, b=None, **kwargs):          # noqa: A001 (torch name)
+    if b is None:
+        return jnp.min(a, **kwargs)
+    return jnp.minimum(a, b)
+
+
+@op("max")
+def max(a, b=None, **kwargs):          # noqa: A001 (torch name)
+    if b is None:
+        return jnp.max(a, **kwargs)
+    return jnp.maximum(a, b)
+
+
+@op("fmod")
+def fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@op("remainder")
+def remainder(a, b):
+    return jnp.remainder(a, b)
+
+
+@op("concatenate")
+def concatenate(tensors: Sequence[jax.Array], axis: int = 0) -> jax.Array:
+    return jnp.concatenate(list(tensors), axis=axis)
+
+
+__all__ += [
+    "mm", "mv", "bmm", "addmm", "addmv", "addr", "addbmm", "baddbmm",
+    "prelu", "conv1d", "conv3d", "conv_transpose1d", "conv_transpose3d",
+    "conv_tbc",
+    "exp", "expm1", "log", "log10", "log2", "log1p", "reciprocal", "rsqrt",
+    "acos", "asin", "cosh", "sinh", "tan", "erf", "erfinv", "cumsum",
+    "cumprod", "pow", "softplus",
+    "sum", "mean", "prod", "std", "var", "logsumexp", "norm", "dist",
+    "renorm", "softmin", "normalize", "cosine_similarity", "pdist",
+    "group_norm", "instance_norm", "batch_norm",
+    "smooth_l1_loss", "kl_div", "soft_margin_loss", "poisson_nll_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "margin_ranking_loss",
+    "triplet_margin_loss", "multi_margin_loss", "multilabel_margin_loss",
+    "sub", "div", "addcdiv", "addcmul", "atan2", "cross", "dot", "bilinear",
+    "eq", "ne", "lt", "gt", "le", "ge", "equal", "min", "max", "fmod",
+    "remainder", "concatenate",
+]

@@ -48,6 +48,19 @@ def _axis_size(axis_name: str) -> jax.Array:
     return lax.psum(jnp.ones((), jnp.float32), axis_name)
 
 
+def _path_str(path) -> str:
+    """'/'-joined readable key path for a tree_flatten_with_path entry."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
 def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          message_size: int = 10_000_000,
                          allreduce_always_fp32: bool = False,
@@ -55,13 +68,30 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          gradient_predivide_factor: float = 1.0,
                          delay_allreduce: bool = False,
                          axis_index_groups: Optional[List[List[int]]] = None,
-                         retain_buffers: Optional[list] = None) -> Any:
+                         retain_buffers: Optional[list] = None,
+                         trigger_paths: Optional[set] = None) -> Any:
     """Bucketed gradient allreduce with the reference's semantics
     (allreduce_bucket, distributed.py:378-398).  Must run inside a context
-    where ``axis_name`` is a mapped mesh axis."""
+    where ``axis_name`` is a mapped mesh axis.
+
+    ``trigger_paths``: the reference's ``allreduce_trigger_params``
+    (distributed.py:162-171) — user-chosen params whose grad readiness
+    fires a bucket flush, overriding message_size.  Arrival order doesn't
+    exist under XLA, so the faithful mapping is: the listed leaves mark
+    *bucket boundaries* in tree order; each bucket is one psum the
+    scheduler can overlap independently.  Paths are '/'-joined key paths
+    (e.g. 'layer1/conv/weight'); unknown paths raise."""
+    flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
+    paths = [_path_str(p) for p, _ in flat_paths]
+    if trigger_paths:
+        unknown = set(trigger_paths) - set(paths)
+        if unknown:
+            raise ValueError(
+                f"allreduce_trigger_params paths not found in the gradient "
+                f"tree: {sorted(unknown)}; available: {paths[:8]}...")
 
     # dtype-split buckets, like split_half_float_double (distributed.py:51-58)
     groups: Dict[Any, List[int]] = {}
@@ -74,39 +104,67 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
 
     new_leaves: List[Any] = [None] * len(leaves)
     for dt, idxs in groups.items():
-        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-        comm = flat.astype(jnp.float32) if allreduce_always_fp32 else flat
-        if gradient_predivide_factor != 1.0:
-            comm = comm / jnp.asarray(gradient_predivide_factor, comm.dtype)
-
-        n = comm.shape[0]
-        if delay_allreduce or n <= message_size:
-            reduced = lax.psum(comm, axis_name,
-                               axis_index_groups=axis_index_groups)
+        # trigger params split the group into separately-reduced buckets
+        if trigger_paths:
+            buckets, cur = [], []
+            for i in idxs:
+                cur.append(i)
+                if paths[i] in trigger_paths:
+                    buckets.append(cur)
+                    cur = []
+            if cur:
+                buckets.append(cur)
         else:
-            # chunked psum: XLA schedules the pieces independently, which
-            # is the compiler-native form of the reference's bucket overlap
-            nchunks = math.ceil(n / message_size)
-            pad = nchunks * message_size - n
-            padded = jnp.pad(comm, (0, pad))
-            chunks = padded.reshape(nchunks, message_size)
-            reduced = lax.psum(chunks, axis_name,
-                               axis_index_groups=axis_index_groups)
-            reduced = reduced.reshape(-1)[:n]
+            buckets = [idxs]
 
-        if gradient_average:
-            post = world / gradient_predivide_factor if \
-                gradient_predivide_factor != 1.0 else world
-            reduced = reduced / post.astype(reduced.dtype)
-        reduced = reduced.astype(dt)
-        if retain_buffers is not None:
-            retain_buffers.append(reduced)
-        off = 0
-        for i in idxs:
-            sz = leaves[i].size
-            new_leaves[i] = reduced[off:off + sz].reshape(leaves[i].shape)
-            off += sz
+        for bucket in buckets:
+            flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+            comm = flat.astype(jnp.float32) if allreduce_always_fp32 else flat
+            if gradient_predivide_factor != 1.0:
+                comm = comm / jnp.asarray(gradient_predivide_factor,
+                                          comm.dtype)
+
+            n = comm.shape[0]
+            if delay_allreduce or trigger_paths or n <= message_size:
+                reduced = lax.psum(comm, axis_name,
+                                   axis_index_groups=axis_index_groups)
+            else:
+                # chunked psum: XLA schedules the pieces independently —
+                # the compiler-native form of the reference's bucket overlap
+                nchunks = math.ceil(n / message_size)
+                pad = nchunks * message_size - n
+                padded = jnp.pad(comm, (0, pad))
+                chunks = padded.reshape(nchunks, message_size)
+                reduced = lax.psum(chunks, axis_name,
+                                   axis_index_groups=axis_index_groups)
+                reduced = reduced.reshape(-1)[:n]
+
+            if gradient_average:
+                post = world / gradient_predivide_factor if \
+                    gradient_predivide_factor != 1.0 else world
+                reduced = reduced / post.astype(reduced.dtype)
+            reduced = reduced.astype(dt)
+            if retain_buffers is not None:
+                retain_buffers.append(reduced)
+            off = 0
+            for i in bucket:
+                sz = leaves[i].size
+                new_leaves[i] = reduced[off:off + sz].reshape(leaves[i].shape)
+                off += sz
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _broadcast0(flat: jax.Array, axis_name: str,
+                axis_index_groups=None) -> jax.Array:
+    """Broadcast from rank 0 expressed as a masked psum (XLA lowers this
+    to a collective-broadcast-shaped pattern over ICI).  psum runs in the
+    leaf's own dtype — an fp32 round-trip would corrupt integer leaves
+    beyond 2^24 (e.g. PRNG keys)."""
+    comm = flat.astype(jnp.int32) if flat.dtype == jnp.bool_ else flat
+    src = jnp.where(lax.axis_index(axis_name) == 0, comm,
+                    jnp.zeros_like(comm))
+    return lax.psum(src, axis_name,
+                    axis_index_groups=axis_index_groups).astype(flat.dtype)
 
 
 def flat_dist_call(tree: Any, axis_name: str = "data", op: str = "psum",
@@ -114,7 +172,7 @@ def flat_dist_call(tree: Any, axis_name: str = "data", op: str = "psum",
     """apply_flat_dist_call parity (distributed.py:36-49): one collective
     per dtype group over the flattened tree."""
     reducer = {"psum": lax.psum, "pmean": lax.pmean, "pmax": lax.pmax,
-               "pmin": lax.pmin}[op]
+               "pmin": lax.pmin, "broadcast": _broadcast0}[op]
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     groups: Dict[Any, List[int]] = {}
     for i, g in enumerate(leaves):
@@ -173,6 +231,8 @@ class DistributedDataParallel:
                         axis_index_groups: Optional[List[List[int]]] = None
                         ) -> Any:
         retain = [] if self.retain_allreduce_buffers else None
+        triggers = (set(self.allreduce_trigger_params)
+                    if self.allreduce_trigger_params else None)
         out = allreduce_grads_tree(
             grads, axis_name=self.axis_name, message_size=self.message_size,
             allreduce_always_fp32=self.allreduce_always_fp32,
@@ -180,10 +240,17 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
             delay_allreduce=self.delay_allreduce,
             axis_index_groups=axis_index_groups,
-            retain_buffers=retain)
+            retain_buffers=retain, trigger_paths=triggers)
         if retain is not None:
             self.allreduce_buffers = retain
         return out
+
+    def broadcast_params(self, params: Any) -> Any:
+        """Rank-0 parameter broadcast (reference DDP does this at
+        construction, distributed.py:234).  Under shard_map replicated
+        in_specs make it implicit; call this explicitly when ranks may
+        have diverged (e.g. after independent init under multi-process)."""
+        return flat_dist_call(params, self.axis_name, "broadcast")
 
     # -- whole-step builder for the common 1-D data-parallel mesh ---------
     def make_step(self, step_fn: Callable, mesh: Optional[Mesh] = None,
@@ -212,7 +279,12 @@ class DistributedDataParallel:
 class Reducer:
     """Manual allreduce helper, parity with apex.parallel.Reducer
     (distributed.py:89-126): call ``reduce(tree)`` inside a mapped context
-    to sum (and average) a pytree across the axis."""
+    to sum (and average) a pytree across the axis, and
+    ``broadcast_params(tree)`` for the construction-time rank-0 parameter
+    broadcast the reference performs (distributed.py:100-104) — in the
+    functional world construction has no params in hand, so the broadcast
+    is an explicit call at the top of the first step (or skipped when
+    params are replicated by shard_map, which is the common case)."""
 
     def __init__(self, module_or_tree=None, axis_name: str = "data",
                  gradient_average: bool = True):
@@ -227,3 +299,8 @@ class Reducer:
             red = jax.tree_util.tree_map(
                 lambda x: x / world.astype(x.dtype), red)
         return red
+
+    def broadcast_params(self, tree: Any) -> Any:
+        """Every rank gets rank 0's values (reference init broadcast,
+        distributed.py:100-104 / DDP :234)."""
+        return flat_dist_call(tree, self.axis_name, "broadcast")

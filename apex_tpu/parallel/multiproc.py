@@ -62,6 +62,17 @@ def init_process_group(coordinator_address: Optional[str] = None,
     return process_id
 
 
+def _probe_free_port() -> int:
+    """Ask the kernel for a free TCP port for the coordinator.  The
+    reference's launcher hardcodes 29500 (and so did round 1 here,
+    parallel/multiproc.py:72) — two concurrent groups on one host then
+    collide; an OS-assigned ephemeral port cannot."""
+    import socket
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m apex_tpu.parallel.multiproc",
@@ -69,7 +80,10 @@ def main(argv=None) -> int:
                     "process group")
     p.add_argument("--nprocs", type=int,
                    default=int(os.environ.get("WORLD_SIZE", "2")))
-    p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--port", type=int, default=0,
+                   help="coordinator port; 0 probes for a free one "
+                        "(default; a fixed 29500 collides with any other "
+                        "group on the host)")
     p.add_argument("--backend", choices=["auto", "cpu"], default="auto",
                    help="cpu forces host-platform devices in the children")
     p.add_argument("--devices-per-proc", type=int, default=1,
@@ -78,7 +92,8 @@ def main(argv=None) -> int:
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
-    coord = f"127.0.0.1:{args.port}"
+    port = args.port or _probe_free_port()
+    coord = f"127.0.0.1:{port}"
     if (args.backend == "auto" and args.nprocs > 1
             and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
         print("[multiproc] warning: --backend auto inherits the "

