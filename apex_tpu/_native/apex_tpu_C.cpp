@@ -241,16 +241,23 @@ struct Loader {
   int64_t next_fill = 0;
   int64_t next_deliver = 0;
   bool stop = false;
+  // consumers currently inside apex_loader_next: destroy() must not free
+  // the Loader while one is re-acquiring mu after the stop wakeup
+  int in_next = 0;
+  std::condition_variable cv_quiesce;
 
   // Per-epoch true permutations (Fisher–Yates over a splitmix64 stream),
   // matching the Python fallback's np.random.permutation semantics: every
   // sample appears exactly once per epoch.  The previous affine-bijection
   // "shuffle" was a correlated-stride walk, not a uniform shuffle
-  // (round-1 advisor finding).  Workers can race across an epoch
-  // boundary, so the two most recent epochs stay cached.
+  // (round-1 advisor finding).  Four exact-keyed cache slots cover the
+  // epochs that can be in flight at once (bounded by prefetch depth);
+  // Fill() copies its batch's indices under one lock, so no reference
+  // escapes and workers don't serialize per sample.
+  static constexpr int kPermSlots = 4;
   std::mutex perm_mu;
-  std::array<int64_t, 2> perm_epoch{-1, -1};
-  std::array<std::vector<int64_t>, 2> perms;
+  std::array<int64_t, kPermSlots> perm_epoch{-1, -1, -1, -1};
+  std::array<std::vector<int64_t>, kPermSlots> perms;
 
   static uint64_t SplitMix64(uint64_t& s) {
     uint64_t z = (s += 0x9e3779b97f4a7c15ull);
@@ -259,13 +266,20 @@ struct Loader {
     return z ^ (z >> 31);
   }
 
-  // Returns perm_epoch(epoch)[i] *by value, under the lock*: a reference
-  // escaping the lock could be regenerated in place by a worker two
-  // epochs ahead sharing the same cache slot (tiny datasets put 3+
+  // Copies the batch's sample indices out *by value under one lock*: a
+  // reference escaping the lock could be regenerated in place by a worker
+  // several epochs ahead reusing the cache slot (tiny datasets put 3+
   // epochs in flight with the default prefetch depth).
-  int64_t PermAt(int64_t epoch, int64_t i) {
+  void BatchIndices(int64_t global_batch, std::vector<int64_t>& out) {
+    int64_t epoch = global_batch / batches_per_epoch;
+    int64_t start = (global_batch % batches_per_epoch) * batch;
+    out.resize(batch);
+    if (!shuffle) {
+      for (int64_t j = 0; j < batch; ++j) out[j] = start + j;
+      return;
+    }
     std::lock_guard<std::mutex> lock(perm_mu);
-    int slot = epoch & 1;
+    int slot = static_cast<int>(epoch % kPermSlots);
     if (perm_epoch[slot] != epoch) {
       auto& p = perms[slot];
       p.resize(n);
@@ -277,20 +291,16 @@ struct Loader {
       }
       perm_epoch[slot] = epoch;
     }
-    return perms[slot][i];
-  }
-
-  int64_t SampleIndex(int64_t global_batch, int64_t j) {
-    int64_t epoch = global_batch / batches_per_epoch;
-    int64_t i = (global_batch % batches_per_epoch) * batch + j;
-    if (!shuffle) return i;
-    return PermAt(epoch, i);
+    const auto& p = perms[slot];
+    for (int64_t j = 0; j < batch; ++j) out[j] = p[start + j];
   }
 
   void Fill(Slot& s, int64_t b) {
     float* dst_base = s.images.data();
+    std::vector<int64_t> idx;
+    BatchIndices(b, idx);
     for (int64_t j = 0; j < batch; ++j) {
-      int64_t src_idx = SampleIndex(b, j);
+      int64_t src_idx = idx[j];
       NormalizeImage(images + src_idx * h * w * c,
                      dst_base + j * c * h * w, h, w, c, mean.data(),
                      inv_std.data());
@@ -365,6 +375,7 @@ int64_t apex_loader_next(void* loader, const float** out_images,
                          const int32_t** out_labels) {
   auto* L = static_cast<Loader*>(loader);
   std::unique_lock<std::mutex> lock(L->mu);
+  L->in_next++;
   Slot* hit = nullptr;
   // stop also releases consumers: destroy() must not hang a thread
   // blocked here (round-1 advisor finding)
@@ -378,7 +389,14 @@ int64_t apex_loader_next(void* loader, const float** out_images,
     }
     return false;
   });
-  if (L->stop && hit == nullptr) return -1;
+  if (L->stop && hit == nullptr) {
+    // signal destroy() we are out before it frees the Loader
+    L->in_next--;
+    L->cv_quiesce.notify_all();
+    return -1;
+  }
+  L->in_next--;
+  L->cv_quiesce.notify_all();   // destroy() may be draining concurrently
   hit->state = Slot::kInUse;
   L->next_deliver++;
   *out_images = hit->images.data();
@@ -409,6 +427,12 @@ void apex_loader_destroy(void* loader) {
   }
   L->cv_free.notify_all();
   L->cv_ready.notify_all();   // wake any consumer blocked in next()
+  {
+    // wait until no consumer is inside next() — deleting while one is
+    // re-acquiring mu after the stop wakeup would be a use-after-free
+    std::unique_lock<std::mutex> lock(L->mu);
+    L->cv_quiesce.wait(lock, [L] { return L->in_next == 0; });
+  }
   for (auto& wkr : L->workers) wkr.join();
   delete L;
 }

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["flatten", "unflatten", "split_by_dtype", "TreeFlattener",
-           "pack_flat", "unpack_flat"]
+           "pack_flat", "unpack_flat", "ChunkedFlatLayout", "ChunkedFlat"]
 
 
 def pack_flat(tree: Any, dtype=None) -> Tuple[jax.Array, list, Any]:
@@ -112,3 +112,132 @@ class TreeFlattener:
                 leaves[i] = buf[off:off + n].reshape(self.shapes[i])
                 off += n
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class ChunkedFlatLayout:
+    """Static layout for a *chunk-padded* fused buffer with a segment map.
+
+    Every float leaf is padded to a multiple of ``chunk`` elements, so each
+    chunk belongs to exactly one tensor.  Per-tensor reductions then cost
+    one dense pass (chunk partial sums, an XLA row reduction) plus a
+    segment-sum over the tiny (num_chunks,) vector — the TPU-shaped
+    equivalent of the reference's single multi_tensor_l2norm kernel with a
+    per-tensor output buffer (csrc/multi_tensor_l2norm_kernel.cu:117-180),
+    replacing round-1's per-leaf Python loop (~2 reductions per leaf on a
+    400-leaf tree).  Distinct from amp's dense ``_FlatLayout`` (no padding,
+    fused half-copy rebuild): here padding buys alignment for segment math.
+
+    The layout is static (computed once, hashable) so it can ride pytree
+    aux_data; padded slots hold zeros and are invariant under elementwise
+    optimizer updates with zero gradients.
+    """
+
+    def __init__(self, tree: Any, chunk: int = 1024):
+        import numpy as np
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.chunk = int(chunk)
+        self.shapes = tuple(tuple(l.shape) for l in leaves)
+        self.dtypes = tuple(str(jnp.result_type(l)) for l in leaves)
+        self.is_float = tuple(
+            jnp.issubdtype(jnp.result_type(l), jnp.floating) for l in leaves)
+        sizes, padded, offsets, off = [], [], [], 0
+        for shape, f in zip(self.shapes, self.is_float):
+            n = int(np.prod(shape, dtype=np.int64)) if f else 0
+            p = -(-n // self.chunk) * self.chunk
+            sizes.append(n)
+            padded.append(p)
+            offsets.append(off)
+            off += p
+        self.sizes = tuple(sizes)
+        self.padded = tuple(padded)
+        self.offsets = tuple(offsets)
+        self.total = off
+        self.num_tensors = sum(1 for f in self.is_float if f)
+        seg = np.zeros(off // self.chunk, np.int32)
+        tensor_idx = 0
+        for i, f in enumerate(self.is_float):
+            if not f:
+                continue
+            lo = self.offsets[i] // self.chunk
+            hi = (self.offsets[i] + self.padded[i]) // self.chunk
+            seg[lo:hi] = tensor_idx
+            tensor_idx += 1
+        self._seg_ids = seg            # numpy; jnp-ified lazily per trace
+
+    def _key(self):
+        return (self.treedef, self.shapes, self.dtypes, self.chunk)
+
+    def __eq__(self, other):
+        return (isinstance(other, ChunkedFlatLayout)
+                and self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def pack(self, tree: Any, dtype=jnp.float32) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(tree)
+        parts = []
+        for l, f, n, p in zip(leaves, self.is_float, self.sizes,
+                              self.padded):
+            if not f:
+                continue
+            flat = l.reshape(-1).astype(dtype)
+            if p != n:
+                flat = jnp.pad(flat, (0, p - n))
+            parts.append(flat)
+        if not parts:
+            return jnp.zeros((0,), dtype)
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def unpack(self, flat: jax.Array, like_leaves=None,
+               cast_like: bool = True) -> Any:
+        out = []
+        fi = 0
+        for i, (shape, f) in enumerate(zip(self.shapes, self.is_float)):
+            if not f:
+                out.append(like_leaves[i] if like_leaves is not None
+                           else None)
+                continue
+            piece = jax.lax.dynamic_slice_in_dim(
+                flat, self.offsets[i], self.sizes[i]).reshape(shape)
+            if cast_like:
+                piece = piece.astype(jnp.dtype(self.dtypes[i]))
+            out.append(piece)
+            fi += 1
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    # -- segment math ------------------------------------------------------
+    def per_tensor_sqsum(self, flat: jax.Array) -> jax.Array:
+        """(num_tensors,) sum of squares per tensor: one dense row
+        reduction + a tiny segment-sum."""
+        K = self.total // self.chunk
+        cs = jnp.sum(jnp.square(flat.astype(jnp.float32)).reshape(
+            K, self.chunk), axis=1)
+        return jax.ops.segment_sum(cs, jnp.asarray(self._seg_ids),
+                                   num_segments=self.num_tensors)
+
+    def expand_per_tensor(self, vals: jax.Array) -> jax.Array:
+        """(num_tensors,) -> (total,) per-element broadcast via the chunk
+        segment map (cheap gather of K values, then a dense broadcast)."""
+        K = self.total // self.chunk
+        per_chunk = vals[jnp.asarray(self._seg_ids)]
+        return jnp.broadcast_to(per_chunk[:, None],
+                                (K, self.chunk)).reshape(-1)
+
+
+@jax.tree_util.register_pytree_node_class
+class ChunkedFlat:
+    """A flat buffer + its static ChunkedFlatLayout as one pytree node
+    (single array leaf; layout rides aux_data, same pattern as
+    amp.FlatMasters)."""
+
+    def __init__(self, buf: jax.Array, layout: ChunkedFlatLayout):
+        self.buf = buf
+        self.layout = layout
+
+    def tree_flatten(self):
+        return (self.buf,), self.layout
+
+    @classmethod
+    def tree_unflatten(cls, layout, children):
+        return cls(children[0], layout)

@@ -103,11 +103,23 @@ def multi_tensor_l2norm(tree: Any, per_tensor: bool = False
     if not leaves:
         z = jnp.zeros((), jnp.float32)
         return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
-    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
-    total = jnp.sqrt(sum(sq))
     if per_tensor:
-        return total, jnp.sqrt(jnp.stack(sq))
-    return total, None
+        if all(jnp.issubdtype(jnp.result_type(x), jnp.floating)
+               for x in leaves):
+            # segment-map form: one dense pass + a (num_chunks,)
+            # segment-sum instead of 2 reductions per leaf
+            # (see ChunkedFlatLayout)
+            from .flatten import ChunkedFlatLayout
+            lay = ChunkedFlatLayout(tree)
+            sq = lay.per_tensor_sqsum(lay.pack(tree))
+        else:
+            # non-float leaves: keep one entry per leaf so the output
+            # stays positionally aligned with tree_leaves
+            sq = jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
+                            for x in leaves])
+        return jnp.sqrt(jnp.sum(sq)), jnp.sqrt(sq)
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    return jnp.sqrt(sum(sq)), None
 
 
 def global_grad_norm(tree: Any) -> jax.Array:

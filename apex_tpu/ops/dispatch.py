@@ -44,11 +44,18 @@ def backend() -> str:
 
 
 def pallas_enabled() -> bool:
+    """APEX_TPU_FORCE_PALLAS accepts two values: "1" forces every Pallas
+    path including the parity-test-only ops (pallas_forced), and "prod"
+    reproduces the production TPU gating off-TPU — kernels that are
+    actually dispatched on hardware (fused Adam/LAMB, multi-tensor,
+    flash attention) run Pallas while ops XLA fuses better (BN apply)
+    stay jnp.  The L1 cross-product driver trains under "prod" so its
+    bitwise comparison matches what hardware executes."""
     if os.environ.get("APEX_TPU_DISABLE_PALLAS") == "1":
         return False
     if not kernels_available():
         return False
-    if os.environ.get("APEX_TPU_FORCE_PALLAS") == "1":
+    if os.environ.get("APEX_TPU_FORCE_PALLAS") in ("1", "prod"):
         return True
     return backend() == "tpu"
 

@@ -170,11 +170,20 @@ def multi_tensor_l2norm(tree: Any, per_tensor: bool = False
         z = jnp.zeros((), jnp.float32)
         return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
     if per_tensor:
-        # per-leaf norms are plain XLA reductions (the reference's
-        # per-tensor output buffer, l2norm_kernel.cu:117-180); the global
-        # norm folds them
-        sq = jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
-                        for x in leaves])
+        if all(jnp.issubdtype(jnp.result_type(x), jnp.floating)
+               for x in leaves):
+            # one dense pass + a tiny segment-sum over the chunk-padded
+            # fused buffer (the reference's per-tensor output buffer,
+            # l2norm_kernel.cu:117-180) — replaces round-1's per-leaf
+            # Python loop of ~2 reductions per leaf
+            from ..multi_tensor_apply.flatten import ChunkedFlatLayout
+            lay = ChunkedFlatLayout(tree)
+            sq = lay.per_tensor_sqsum(lay.pack(tree))
+        else:
+            # keep positional alignment with tree_leaves when non-float
+            # leaves are present
+            sq = jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
+                            for x in leaves])
         return jnp.sqrt(jnp.sum(sq)), jnp.sqrt(sq)
     flat, _, _ = pack_flat(tree, jnp.float32)
     return _l2norm_flat(flat), None

@@ -27,14 +27,14 @@ import jax
 import jax.numpy as jnp
 
 from .base import Optimizer, resolve_lr
-from ..multi_tensor_apply import multi_tensor_l2norm
+from ..multi_tensor_apply.flatten import ChunkedFlat, ChunkedFlatLayout
 
 __all__ = ["FusedLAMB", "LambState"]
 
 
 class LambState(NamedTuple):
     step: jax.Array
-    m: Any   # pytree like params (per-tensor trust ratios need leaf identity)
+    m: Any   # ChunkedFlat fp32 moments over the padded fused buffer
     v: Any
 
 
@@ -58,26 +58,39 @@ class FusedLAMB(Optimizer):
         self.use_nvlamb = use_nvlamb
 
     def init(self, params: Any) -> LambState:
-        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        layout = ChunkedFlatLayout(params)
+        zeros = jnp.zeros((layout.total,), jnp.float32)
         return LambState(step=jnp.zeros((), jnp.int32),
-                         m=jax.tree_util.tree_map(zeros, params),
-                         v=jax.tree_util.tree_map(zeros, params))
+                         m=ChunkedFlat(zeros, layout),
+                         v=ChunkedFlat(zeros, layout))
 
     def update(self, grads: Any, state: LambState, params: Any):
         return self.step(params, state, grads)
 
     def step(self, params: Any, state: LambState, grads: Any,
              grad_norm: Optional[jax.Array] = None):
+        """One LAMB step over the chunk-padded fused buffer.
+
+        m/v live flat across steps (round-2 VERDICT item 7: no per-step
+        tree re-pack of state), and the per-tensor ||p||/||update|| norms
+        come from the layout's segment map — one dense pass + a tiny
+        segment-sum, not a Python loop over leaves.  Padded slots carry
+        zero grads, so m/v/update stay zero there and stage 2 leaves the
+        (nonexistent) padded params untouched."""
         beta1, beta2 = self.betas
         t = state.step + 1
         tf = t.astype(jnp.float32)
         lr = resolve_lr(self.lr, state.step)
         beta3 = 1.0 - beta1 if self.grad_averaging else 1.0
 
+        lay = state.m.layout
+        g_flat = lay.pack(grads)
+        p_flat = lay.pack(params)
+
         # global grad-norm clipping (stage_1.cu: grads scaled by
         # global_norm/max_norm when above threshold)
         if grad_norm is None:
-            grad_norm, _ = multi_tensor_l2norm(grads)
+            grad_norm = jnp.sqrt(jnp.sum(lay.per_tensor_sqsum(g_flat)))
         if self.max_grad_norm and self.max_grad_norm > 0:
             clip_factor = jnp.where(grad_norm > self.max_grad_norm,
                                     grad_norm / self.max_grad_norm, 1.0)
@@ -93,73 +106,37 @@ class FusedLAMB(Optimizer):
         wd = self.weight_decay
 
         from ..ops import dispatch
-        if dispatch.use_pallas_for(params):
-            return self._step_pallas(params, state, grads, t, lr, beta1,
-                                     beta2, beta3, bc1, bc2, clip_factor, wd)
-
-        def stage1(p, g, m, v):
-            g32 = g.astype(jnp.float32) / clip_factor
-            p32 = p.astype(jnp.float32)
+        use_pallas = dispatch.use_pallas_for(params)
+        if use_pallas:
+            from ..ops import pallas_lamb
+            upd, new_m, new_v = pallas_lamb.lamb_stage1(
+                g_flat, p_flat, state.m.buf, state.v.buf, 1.0 / clip_factor,
+                1.0 / bc1, 1.0 / bc2, beta1, beta2, beta3, self.eps, wd,
+                self.adam_w_mode)
+        else:
+            g32 = g_flat / clip_factor
             if not self.adam_w_mode and wd:
-                g32 = g32 + wd * p32  # classic L2 ("adam mode")
-            new_m = beta1 * m + beta3 * g32
-            new_v = beta2 * v + (1.0 - beta2) * g32 * g32
-            m_hat = new_m / bc1
-            v_hat = new_v / bc2
-            upd = m_hat / (jnp.sqrt(v_hat) + self.eps)
+                g32 = g32 + wd * p_flat  # classic L2 ("adam mode")
+            new_m = beta1 * state.m.buf + beta3 * g32
+            new_v = beta2 * state.v.buf + (1.0 - beta2) * g32 * g32
+            upd = (new_m / bc1) / (jnp.sqrt(new_v / bc2) + self.eps)
             if self.adam_w_mode and wd:
-                upd = upd + wd * p32  # decoupled decay enters the update
-            return upd, new_m, new_v
-
-        triples = jax.tree_util.tree_map(stage1, params, grads, state.m,
-                                         state.v)
-        is3 = lambda x: isinstance(x, tuple) and len(x) == 3
-        updates = jax.tree_util.tree_map(lambda tr: tr[0], triples, is_leaf=is3)
-        new_m = jax.tree_util.tree_map(lambda tr: tr[1], triples, is_leaf=is3)
-        new_v = jax.tree_util.tree_map(lambda tr: tr[2], triples, is_leaf=is3)
+                upd = upd + wd * p_flat  # decoupled decay enters the update
 
         # stage 2: per-tensor trust ratio (stage_2.cu:38-48)
-        def stage2(p, upd):
-            p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
-            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
-            ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm,
-                              jnp.ones((), jnp.float32))
-            return (p.astype(jnp.float32) - lr * ratio * upd).astype(p.dtype)
+        p_sq = lay.per_tensor_sqsum(p_flat)
+        u_sq = lay.per_tensor_sqsum(upd)
+        ratios = jnp.where((p_sq > 0) & (u_sq > 0),
+                           jnp.sqrt(p_sq) / jnp.sqrt(u_sq),
+                           jnp.ones_like(p_sq))
+        ratio_flat = lay.expand_per_tensor(ratios)
 
-        new_params = jax.tree_util.tree_map(stage2, params, updates)
-        return new_params, LambState(step=t, m=new_m, v=new_v)
+        if use_pallas:
+            new_p = pallas_lamb.lamb_stage2(p_flat, upd, ratio_flat, lr)
+        else:
+            new_p = p_flat - lr * ratio_flat * upd
 
-    def _step_pallas(self, params, state, grads, t, lr, beta1, beta2, beta3,
-                     bc1, bc2, clip_factor, wd):
-        """Flat-buffer kernel path: one stage-1 launch over the fused
-        supervector, per-tensor trust ratios, one stage-2 launch."""
-        from ..multi_tensor_apply.flatten import pack_flat, unpack_flat
-        from ..ops import pallas_lamb
-
-        g_flat, leaves, treedef = pack_flat(grads, jnp.float32)
-        p_flat, p_leaves, _ = pack_flat(params, jnp.float32)
-        m_flat, _, _ = pack_flat(state.m, jnp.float32)
-        v_flat, _, _ = pack_flat(state.v, jnp.float32)
-
-        upd_flat, new_m_flat, new_v_flat = pallas_lamb.lamb_stage1(
-            g_flat, p_flat, m_flat, v_flat, 1.0 / clip_factor, 1.0 / bc1,
-            1.0 / bc2, beta1, beta2, beta3, self.eps, wd, self.adam_w_mode)
-
-        # per-tensor trust ratios (stage_2.cu:38-48) from
-        # multi_tensor_l2norm's per-tensor output, expanded to per-element
-        # for the apply kernel
-        updates = unpack_flat(upd_flat, leaves, treedef, cast_like=False)
-        _, p_norm = multi_tensor_l2norm(params, per_tensor=True)
-        _, u_norm = multi_tensor_l2norm(updates, per_tensor=True)
-        ratios = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm,
-                           jnp.ones_like(p_norm))
-        sizes = [int(l.size) for l in p_leaves]
-        ratio_flat = jnp.repeat(ratios, jnp.asarray(sizes),
-                                total_repeat_length=p_flat.shape[0])
-
-        new_p_flat = pallas_lamb.lamb_stage2(p_flat, upd_flat, ratio_flat, lr)
-
-        new_params = unpack_flat(new_p_flat, p_leaves, treedef)
-        new_m = unpack_flat(new_m_flat, leaves, treedef, cast_like=False)
-        new_v = unpack_flat(new_v_flat, leaves, treedef, cast_like=False)
-        return new_params, LambState(step=t, m=new_m, v=new_v)
+        new_params = lay.unpack(
+            new_p, like_leaves=jax.tree_util.tree_leaves(params))
+        return new_params, LambState(step=t, m=ChunkedFlat(new_m, lay),
+                                     v=ChunkedFlat(new_v, lay))

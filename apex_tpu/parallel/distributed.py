@@ -81,12 +81,13 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     *bucket boundaries* in tree order; each bucket is one psum the
     scheduler can overlap independently.  Paths are '/'-joined key paths
     (e.g. 'layer1/conv/weight'); unknown paths raise."""
-    flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
-    paths = [_path_str(p) for p, _ in flat_paths]
+    paths = None
     if trigger_paths:
+        flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
+        paths = [_path_str(p) for p, _ in flat_paths]
         unknown = set(trigger_paths) - set(paths)
         if unknown:
             raise ValueError(

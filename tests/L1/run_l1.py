@@ -1,0 +1,92 @@
+"""Full L1 cross-product driver — the apex_tpu port of the reference's
+tests/L1/common/run_test.sh + compare.py.
+
+Runs ResNet-18 for >=100 deterministic iterations under the full
+{O0..O3} x {loss-scale} x {keep_batchnorm_fp32} matrix, once with Pallas
+kernels and once with the pure-jnp fallback, then asserts:
+
+- **bitwise-equal** loss trajectories and final-parameter digests for the
+  fp32 configs (compare.py:35-64's discipline), and
+- tolerance-tier agreement for half configs (bf16/fp16 kernels reassociate
+  reductions; bitwise is unattainable there, documented in SURVEY §7).
+
+Meant to run compiled on TPU (~fast steps, compile-dominated); works on
+the CPU mesh with --iters/--configs trimmed.  Writes a JSON log for the
+round artifacts.
+
+  python tests/L1/run_l1.py --iters 100 --out artifacts/L1_r3.json
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from tests.L1.l1_common import FULL_MATRIX, is_fp32_config, train_one
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--image", type=int, default=32)
+    ap.add_argument("--configs", type=int, default=0,
+                    help="run only the first N configs (0 = all 48)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    matrix = FULL_MATRIX[:args.configs] if args.configs else FULL_MATRIX
+    results, failures = [], []
+    for (ol, ls, kbn) in matrix:
+        key = f"{ol}_ls{ls}_kbn{kbn}"
+        t0 = time.time()
+        ref_traj, ref_dig = train_one(ol, ls, kbn, pallas=False,
+                                      iters=args.iters, batch=args.batch,
+                                      image=args.image)
+        tst_traj, tst_dig = train_one(ol, ls, kbn, pallas=True,
+                                      iters=args.iters, batch=args.batch,
+                                      image=args.image)
+        bitwise = (ref_traj.tobytes() == tst_traj.tobytes()
+                   and ref_dig == tst_dig)
+        maxdiff = float(np.max(np.abs(ref_traj - tst_traj)))
+        ok = True
+        if is_fp32_config(ol) and not bitwise:
+            ok = False
+            failures.append(f"{key}: fp32 config not bitwise "
+                            f"(maxdiff {maxdiff})")
+        if not bitwise and maxdiff > 2e-2 * max(1.0, abs(ref_traj).max()):
+            ok = False
+            failures.append(f"{key}: trajectories diverge (max {maxdiff})")
+        if not np.all(np.isfinite(ref_traj)):
+            ok = False
+            failures.append(f"{key}: non-finite losses")
+        if args.iters >= 50 and ref_traj[-1] >= ref_traj[0]:
+            ok = False
+            failures.append(f"{key}: no training progress")
+        results.append({"config": key, "bitwise": bitwise,
+                        "max_traj_diff": maxdiff, "ok": ok,
+                        "loss_first": float(ref_traj[0]),
+                        "loss_last": float(ref_traj[-1]),
+                        "wall_s": round(time.time() - t0, 1)})
+        print(json.dumps(results[-1]), flush=True)
+
+    summary = {"total": len(results),
+               "bitwise": sum(r["bitwise"] for r in results),
+               "ok": sum(r["ok"] for r in results),
+               "failures": failures}
+    print(json.dumps(summary))
+    if args.out:
+        import os
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump({"results": results, "summary": summary}, f,
+                      indent=1)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,3 +103,17 @@ def test_loss_scale_invariance_fp32():
     a = _train("O0", "1.0", None, pallas=False)
     b = _train("O0", "128.0", None, pallas=False)
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet18_prod_dispatch_bitwise():
+    """Industrial-L1 smoke (full matrix lives in tests/L1/run_l1.py, run
+    compiled on TPU): ResNet-18 under production kernel dispatch must be
+    bitwise-equal to the pure-jnp path in fp32 — the reference's
+    compare.py:35-64 discipline applied to the real model."""
+    from tests.L1.l1_common import train_one
+    ref, ref_dig = train_one("O0", None, None, pallas=False, iters=5,
+                             batch=2, image=16)
+    tst, tst_dig = train_one("O0", None, None, pallas=True, iters=5,
+                             batch=2, image=16)
+    assert ref.tobytes() == tst.tobytes(), np.abs(ref - tst).max()
+    assert ref_dig == tst_dig

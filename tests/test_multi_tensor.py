@@ -109,3 +109,34 @@ def test_tree_flattener_groups_by_dtype():
     assert jax.tree_util.tree_structure(back) == \
         jax.tree_util.tree_structure(tree)
     np.testing.assert_array_equal(np.asarray(back["c"]), np.ones(4))
+
+
+def test_per_tensor_l2norm_segment_map_400_leaves():
+    """The segment-map per-tensor norm (round-2 VERDICT item 7) must match
+    the naive per-leaf computation on a big ragged tree."""
+    rng = np.random.RandomState(0)
+    tree = {f"p{i}": jnp.asarray(rng.randn(rng.randint(1, 700)), jnp.float32)
+            for i in range(400)}
+    total, per = multi_tensor_l2norm(tree, per_tensor=True)
+    leaves = jax.tree_util.tree_leaves(tree)
+    ref = np.asarray([np.linalg.norm(np.asarray(l)) for l in leaves])
+    np.testing.assert_allclose(np.asarray(per), ref, rtol=1e-5)
+    np.testing.assert_allclose(float(total), np.sqrt((ref ** 2).sum()),
+                               rtol=1e-5)
+
+
+def test_chunked_flat_layout_roundtrip_mixed():
+    from apex_tpu.multi_tensor_apply.flatten import ChunkedFlatLayout
+    tree = {"a": jnp.arange(5, dtype=jnp.float32),
+            "i": jnp.arange(3, dtype=jnp.int32),
+            "b": jnp.ones((2, 3), jnp.bfloat16)}
+    lay = ChunkedFlatLayout(tree, chunk=8)
+    flat = lay.pack(tree)
+    assert flat.shape[0] == 16  # 5->8 + 6->8, int leaf skipped
+    out = lay.unpack(flat, like_leaves=jax.tree_util.tree_leaves(tree))
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(5))
+    np.testing.assert_array_equal(np.asarray(out["i"]), np.arange(3))
+    assert out["b"].dtype == jnp.bfloat16
+    sq = lay.per_tensor_sqsum(flat)
+    np.testing.assert_allclose(np.asarray(sq),
+                               [np.sum(np.arange(5.0) ** 2), 6.0], rtol=1e-6)
