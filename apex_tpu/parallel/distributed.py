@@ -255,23 +255,40 @@ class DistributedDataParallel:
 
     # -- whole-step builder for the common 1-D data-parallel mesh ---------
     def make_step(self, step_fn: Callable, mesh: Optional[Mesh] = None,
-                  donate_state: bool = True) -> Callable:
+                  donate_state: bool = True,
+                  steps_per_call: int = 1) -> Callable:
         """shard_map ``step_fn(state..., batch) -> (state..., aux)`` over a
         1-D mesh: replicated state, batch sharded on axis 0.  ``step_fn``
         runs per-device and should call ``self.allreduce_grads`` on its
         gradient tree (param broadcast from rank 0 is implicit: replicated
         inputs to shard_map stay replicated, the analogue of the init-time
-        broadcast at distributed.py:234)."""
+        broadcast at distributed.py:234).
+
+        ``steps_per_call > 1`` wraps ``step_fn`` in a ``lax.scan`` over a
+        leading micro-batch axis (batch shaped ``(K, per_step...)``) so
+        one dispatch runs K optimizer steps — amortizes host→device
+        dispatch latency, which on tunneled TPU runtimes is ~ms-scale.
+        The aux output then carries the K per-step values."""
         if mesh is None:
             mesh = Mesh(jax.devices(), (self.axis_name,))
         an = self.axis_name
+        K = int(steps_per_call)
 
-        def wrapped(state, batch):
-            return step_fn(state, batch)
+        if K == 1:
+            def wrapped(state, batch):
+                return step_fn(state, batch)
+        else:
+            def wrapped(state, batch):
+                def body(s, b):
+                    s2, aux = step_fn(s, b)
+                    return s2, aux
+                return lax.scan(body, state, batch)
 
+        # batch sharded on the data axis: micro-batch axis (if any) first
+        bspec = P(an) if K == 1 else P(None, an)
         mapped = jax.shard_map(
             wrapped, mesh=mesh,
-            in_specs=(P(), P(an)),
+            in_specs=(P(), bspec),
             out_specs=(P(), P()),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0,) if donate_state else ())

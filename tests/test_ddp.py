@@ -238,3 +238,45 @@ def test_syncbn_unmapped_axis_check_does_not_swallow_errors():
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 4, 4))
     out, _ = bn.apply(params, x, state=state, train=True)   # no mesh: local
     assert out.shape == x.shape
+
+
+def test_make_step_steps_per_call_matches_sequential(mesh):
+    """K steps in one dispatch (lax.scan) must equal K sequential
+    dispatches bitwise."""
+    from apex_tpu import nn, optimizers
+    from apex_tpu.nn import functional as F
+    model = nn.Sequential([nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2)])
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt = optimizers.SGD(lr=0.1)
+    opt_state = opt.init(params)
+    ddp = DistributedDataParallel(model)
+
+    def step(state, batch):
+        p, s = state
+        x, y = batch
+
+        def loss_fn(p):
+            return jnp.mean((model(p, x) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads = ddp.allreduce_grads(grads)
+        p, s = opt.update(grads, s, p)
+        return (p, s), lax.pmean(loss, "data")
+
+    rng = np.random.RandomState(0)
+    K = 3
+    xs = jnp.asarray(rng.randn(K, 16, 4), jnp.float32)
+    ys = jnp.asarray(rng.randn(K, 16, 2), jnp.float32)
+
+    one = ddp.make_step(step, mesh=mesh, donate_state=False)
+    st = (params, opt_state)
+    for i in range(K):
+        st, loss = one(st, (xs[i], ys[i]))
+
+    multi = ddp.make_step(step, mesh=mesh, donate_state=False,
+                          steps_per_call=K)
+    st2, losses = multi((params, opt_state), (xs, ys))
+    assert losses.shape == (K,)
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
