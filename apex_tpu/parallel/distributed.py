@@ -273,16 +273,19 @@ class DistributedDataParallel:
             mesh = Mesh(jax.devices(), (self.axis_name,))
         an = self.axis_name
         K = int(steps_per_call)
+        if K < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {K}")
 
         if K == 1:
-            def wrapped(state, batch):
-                return step_fn(state, batch)
+            wrapped = step_fn
         else:
             def wrapped(state, batch):
-                def body(s, b):
-                    s2, aux = step_fn(s, b)
-                    return s2, aux
-                return lax.scan(body, state, batch)
+                lead = {l.shape[0] for l in jax.tree_util.tree_leaves(batch)}
+                if lead != {K}:
+                    raise ValueError(
+                        f"steps_per_call={K} needs every batch leaf shaped "
+                        f"(K, per_step...); got leading dims {sorted(lead)}")
+                return lax.scan(step_fn, state, batch)
 
         # batch sharded on the data axis: micro-batch axis (if any) first
         bspec = P(an) if K == 1 else P(None, an)
