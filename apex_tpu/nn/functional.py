@@ -184,18 +184,27 @@ def layer_norm(x: jax.Array, normalized_shape: Sequence[int],
 def batch_norm_stats(x: jax.Array, axes: Tuple[int, ...]
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-channel (count, mean, biased var) in fp32 over ``axes``.
-    Shifted two-pass variance (no E[x^2]-mean^2 cancellation) — the local
-    half of the reference's Welford stats (csrc/welford.cu:259-294)."""
+
+    Single-pass E[x^2]-mean^2 with fp32 accumulation (the flax BatchNorm
+    formulation): the mean and mean-of-squares reductions share one loop,
+    which XLA fuses into a single HBM traversal; a shifted two-pass
+    variance would serialize a second full read of ``x`` behind the mean
+    (measured ~3 ms/step on ResNet-50 B=128, artifacts/PERF_NOTES_r3.md).
+    It also makes local BN bitwise-consistent with the distributed path,
+    which psums (count, Σx, Σx²) in the same form (parallel/
+    sync_batchnorm.py; the local half of csrc/welford.cu:259-294).
+
+    Numerics: cancellation loses ~2·log2(|mean|/std) of the 24 fp32
+    mantissa bits per channel; it is catastrophic only for |mean|/std
+    beyond ~2^12 — far outside any input a BN layer sees in practice.
+    var is clamped at 0 so rounding can never yield a negative variance."""
     x32 = x.astype(jnp.float32)
     n = 1
     for a in axes:
         n *= x.shape[a]
     mean = jnp.mean(x32, axis=axes)
-    shape = [1] * x.ndim
-    for a in range(x.ndim):
-        if a not in axes:
-            shape[a] = x.shape[a]
-    var = jnp.mean(jnp.square(x32 - mean.reshape(shape)), axis=axes)
+    mean_sq = jnp.mean(jnp.square(x32), axis=axes)
+    var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
     return jnp.asarray(n, jnp.float32), mean, var
 
 
