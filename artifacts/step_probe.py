@@ -1,0 +1,129 @@
+"""Step decomposition probe for the ResNet-50 amp-O2 hot path on TPU.
+
+Times, compiled on the real chip with a hard D2H fetch as the barrier:
+  1. forward + loss
+  2. forward + backward (scaled_grad)
+  3. forward + backward + fused-Adam step
+  4. the full sharded DDP step (what bench.py's headline measures)
+  5. (4) wrapped in a steps_per_call=4 lax.scan — amortizes the ~3.5 ms
+     tunnel RTT and lets XLA overlap host dispatch
+
+Run:  python artifacts/step_probe.py  [batch]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/artifacts", 1)[0])
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp, optimizers, parallel, models
+from apex_tpu.nn import functional as F
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+
+def timed(f, *a, iters=10):
+    g = jax.jit(f)
+    out = g(*a)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(*a)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    model, optimizer = amp.initialize(
+        models.resnet50(), optimizers.FusedAdam(lr=0.1), opt_level="O2",
+        verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    opt_state = optimizer.init(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, 3, 224, 224), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, B), jnp.int32)
+
+    def loss_fn(p):
+        out, new_bn = model.apply(p, x, state=bn_state, train=True)
+        return F.cross_entropy(out, y), new_bn
+
+    def fwd(p):
+        l, _ = loss_fn(p)
+        return l
+
+    dt = timed(fwd, params)
+    print(f"fwd+loss:        {dt*1e3:7.2f} ms")
+
+    def fwdbwd(p):
+        _, _, grads = amp.scaled_grad(loss_fn, p, opt_state, has_aux=True)
+        return grads
+
+    dt = timed(fwdbwd, params)
+    print(f"fwd+bwd:         {dt*1e3:7.2f} ms")
+
+    def full(p, st):
+        _, _, grads = amp.scaled_grad(loss_fn, p, opt_state, has_aux=True)
+        p2, _, _ = optimizer.step(p, st, grads)
+        return p2
+
+    dt = timed(full, params, opt_state)
+    print(f"fwd+bwd+opt:     {dt*1e3:7.2f} ms")
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def step(state, batch):
+        params, bn_st, opt_st = state
+        xb, yb = batch
+
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn_st, train=True)
+            return F.cross_entropy(out, yb), new_bn
+
+        loss, new_bn, grads = amp.scaled_grad(loss_fn, params, opt_st,
+                                              has_aux=True)
+        grads = ddp.allreduce_grads(grads)
+        params, opt_st, _ = optimizer.step(params, opt_st, grads)
+        return (params, new_bn, opt_st), lax.pmean(loss, "data")
+
+    train = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
+        out_specs=(P(), P()), check_vma=False))
+    state = (params, bn_state, opt_state)
+    batch = (x, y)
+    state, out = train(state, batch)
+    state, out = train(state, batch)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        state, out = train(state, batch)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    dt = (time.perf_counter() - t0) / 20
+    print(f"full DDP step:   {dt*1e3:7.2f} ms   {B/dt:6.0f} img/s/chip")
+
+    # K steps per dispatch via the make_step scan wrapper (donation off:
+    # donated buffers trip INVALID_ARGUMENT on fetch in this tunneled
+    # runtime — see bench.py)
+    K = 4
+    scan_step = ddp.make_step(step, mesh=mesh, donate_state=False,
+                              steps_per_call=K)
+    kbatch = (jnp.broadcast_to(x, (K,) + x.shape),
+              jnp.broadcast_to(y, (K,) + y.shape))
+    state, out = scan_step(state, kbatch)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        state, out = scan_step(state, kbatch)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    dt = (time.perf_counter() - t0) / (5 * K)
+    print(f"scan x{K} step:    {dt*1e3:7.2f} ms   {B/dt:6.0f} img/s/chip")
+
+
+if __name__ == "__main__":
+    main()
