@@ -11,10 +11,11 @@ Times, compiled on the real chip with a hard D2H fetch as the barrier:
 Run:  python artifacts/step_probe.py  [batch]
 """
 
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/artifacts", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
@@ -105,7 +106,9 @@ def main():
         state, out = train(state, batch)
     float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
     dt = (time.perf_counter() - t0) / 20
-    print(f"full DDP step:   {dt*1e3:7.2f} ms   {B/dt:6.0f} img/s/chip")
+    ndev = len(jax.devices())
+    print(f"full DDP step:   {dt*1e3:7.2f} ms   "
+          f"{B/dt/ndev:6.0f} img/s/chip")
 
     # K steps per dispatch via the make_step scan wrapper (donation off:
     # donated buffers trip INVALID_ARGUMENT on fetch in this tunneled
@@ -122,7 +125,8 @@ def main():
         state, out = scan_step(state, kbatch)
     float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
     dt = (time.perf_counter() - t0) / (5 * K)
-    print(f"scan x{K} step:    {dt*1e3:7.2f} ms   {B/dt:6.0f} img/s/chip")
+    print(f"scan x{K} step:    {dt*1e3:7.2f} ms   "
+          f"{B/dt/ndev:6.0f} img/s/chip")
 
 
 if __name__ == "__main__":
