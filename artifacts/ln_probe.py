@@ -1,0 +1,85 @@
+"""LayerNorm dispatch probe: Pallas FusedLayerNorm vs the jnp form XLA
+fuses, at BERT shapes, fwd and fwd+bwd — the same question round-3
+profiling answered for the BN apply kernel (where the standalone Pallas
+kernel lost ~3x to XLA fusion on the ResNet forward).
+
+Run on TPU:  python artifacts/ln_probe.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timed(f, *a, iters=20):
+    g = jax.jit(f)
+    out = g(*a)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(*a)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from apex_tpu.normalization import fused_layer_norm_affine
+    from apex_tpu.nn import functional as F
+
+    on_tpu = jax.default_backend() == "tpu"
+    shapes = ([(32 * 128, 768), (8 * 512, 1024), (4 * 2048, 1024)]
+              if on_tpu else [(256, 128)])       # smoke size off-TPU
+    dtypes = (jnp.bfloat16, jnp.float32) if on_tpu else (jnp.float32,)
+    for (rows, H) in shapes:
+        for dtype in dtypes:
+            k = jax.random.PRNGKey(0)
+            x = jax.random.normal(k, (rows, H), dtype)
+            w = jnp.ones((H,), jnp.float32)
+            b = jnp.zeros((H,), jnp.float32)
+
+            def pallas_fwd(x):
+                y = x
+                for _ in range(8):
+                    y = fused_layer_norm_affine(y, w, b, (H,), 1e-5)
+                    y = y + 1e-6 * jnp.sum(y, -1, keepdims=True).astype(
+                        y.dtype)      # defeat CSE
+                return y
+
+            def jnp_fwd(x):
+                y = x
+                for _ in range(8):
+                    y = F.layer_norm(y, (H,), w, b, 1e-5)
+                    y = y + 1e-6 * jnp.sum(y, -1, keepdims=True).astype(
+                        y.dtype)
+                return y
+
+            def pallas_fb(x):
+                return jax.grad(
+                    lambda x: jnp.sum(pallas_fwd(x).astype(jnp.float32)))(x)
+
+            def jnp_fb(x):
+                return jax.grad(
+                    lambda x: jnp.sum(jnp_fwd(x).astype(jnp.float32)))(x)
+
+            name = f"({rows},{H}) {jnp.dtype(dtype).name}"
+            old = os.environ.pop("APEX_TPU_DISABLE_PALLAS", None)
+            tp = timed(pallas_fwd, x)
+            tpb = timed(pallas_fb, x)
+            os.environ["APEX_TPU_DISABLE_PALLAS"] = "1"
+            tj = timed(jnp_fwd, x)
+            tjb = timed(jnp_fb, x)
+            if old is None:
+                os.environ.pop("APEX_TPU_DISABLE_PALLAS", None)
+            print(f"{name:24s} fwd x8: pallas {tp*1e3:6.2f} ms  "
+                  f"jnp {tj*1e3:6.2f} ms | fwd+bwd x8: "
+                  f"pallas {tpb*1e3:6.2f} ms  jnp {tjb*1e3:6.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
