@@ -1,0 +1,34 @@
+#!/bin/bash
+# Hardware-validation runbook for when the TPU tunnel is responsive.
+# Runs the round-3 probe/validation sequence, teeing results into
+# artifacts/.  Each stage is independently timeout-guarded so one wedge
+# doesn't lose the rest.
+cd "$(dirname "$0")/.." || exit 1
+TS=$(date -u +%Y%m%dT%H%M%S)
+log() { echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
+
+log "step decomposition probe"
+timeout 900 python artifacts/step_probe.py 2>&1 | grep -v WARNING \
+    | tee artifacts/step_probe_$TS.log
+
+log "layout probe (CSE-fixed)"
+timeout 900 python artifacts/layout_probe.py 2>&1 | grep -v WARNING \
+    | tee artifacts/layout_probe_$TS.log
+
+log "layer-norm dispatch probe"
+timeout 900 python artifacts/ln_probe.py 2>&1 | grep -v WARNING \
+    | tee artifacts/ln_probe_$TS.log
+
+log "L1 cross-product on hardware (full 48-config matrix)"
+timeout 5400 python tests/L1/run_l1.py --out artifacts/l1_tpu_$TS.json \
+    2>&1 | tail -8 | tee artifacts/l1_tpu_$TS.log
+
+log "TPU-compiled kernel suite"
+timeout 3600 env APEX_TPU_TEST_BACKEND=tpu python -m pytest \
+    tests/test_pallas_kernels.py tests/test_flash_long.py -v 2>&1 \
+    | tail -45 | tee artifacts/tpu_kernel_tests_$TS.log
+
+log "full bench"
+timeout 3600 python bench.py 2>/dev/null | tee artifacts/bench_$TS.json
+
+log "runbook done"
