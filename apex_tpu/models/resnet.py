@@ -21,23 +21,34 @@ __all__ = ["ResNet", "BasicBlock", "Bottleneck", "resnet18", "resnet34",
            "resnet50", "resnet101", "resnet152"]
 
 
-def conv3x3(cin, cout, stride=1):
-    return nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False)
+def conv3x3(cin, cout, stride=1, data_format="NCHW"):
+    return nn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False,
+                     data_format=data_format)
 
 
-def conv1x1(cin, cout, stride=1):
-    return nn.Conv2d(cin, cout, 1, stride=stride, bias=False)
+def conv1x1(cin, cout, stride=1, data_format="NCHW"):
+    return nn.Conv2d(cin, cout, 1, stride=stride, bias=False,
+                     data_format=data_format)
+
+
+def _bn(planes, data_format):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
+    return nn.BatchNorm2d(
+        planes, channel_axis=(1 if data_format == "NCHW" else -1))
 
 
 class BasicBlock(nn.Module):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv1 = conv3x3(inplanes, planes, stride)
-        self.bn1 = nn.BatchNorm2d(planes)
-        self.conv2 = conv3x3(planes, planes)
-        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv1 = conv3x3(inplanes, planes, stride, data_format)
+        self.bn1 = _bn(planes, data_format)
+        self.conv2 = conv3x3(planes, planes, data_format=data_format)
+        self.bn2 = _bn(planes, data_format)
         self.downsample = downsample
 
     def forward(self, p, x):
@@ -52,14 +63,16 @@ class BasicBlock(nn.Module):
 class Bottleneck(nn.Module):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv1 = conv1x1(inplanes, planes)
-        self.bn1 = nn.BatchNorm2d(planes)
-        self.conv2 = conv3x3(planes, planes, stride)
-        self.bn2 = nn.BatchNorm2d(planes)
-        self.conv3 = conv1x1(planes, planes * self.expansion)
-        self.bn3 = nn.BatchNorm2d(planes * self.expansion)
+        self.conv1 = conv1x1(inplanes, planes, data_format=data_format)
+        self.bn1 = _bn(planes, data_format)
+        self.conv2 = conv3x3(planes, planes, stride, data_format)
+        self.bn2 = _bn(planes, data_format)
+        self.conv3 = conv1x1(planes, planes * self.expansion,
+                             data_format=data_format)
+        self.bn3 = _bn(planes * self.expansion, data_format)
         self.downsample = downsample
 
     def forward(self, p, x):
@@ -73,33 +86,49 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
+    """``channels_last=True`` runs every internal activation in NHWC —
+    the layout whose channel dim sits on the TPU's 128-lane minor axis —
+    while keeping the public contract unchanged: inputs are accepted in
+    torch's NCHW (transposed once at entry) and the param tree (OIHW
+    conv weights, (C,) batch-norm params) is identical in both modes, so
+    checkpoints, amp casting, and optimizer state are layout-agnostic.
+    """
+
     def __init__(self, block: Type, layers: List[int],
-                 num_classes: int = 1000):
+                 num_classes: int = 1000, channels_last: bool = False):
         super().__init__()
         self.inplanes = 64
-        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
-        self.bn1 = nn.BatchNorm2d(64)
-        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        self.channels_last = channels_last
+        df = self.data_format = "NHWC" if channels_last else "NCHW"
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False,
+                               data_format=df)
+        self.bn1 = _bn(64, df)
+        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1, data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
-        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.avgpool = nn.AdaptiveAvgPool2d(1, data_format=df)
         self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential([
-                conv1x1(self.inplanes, planes * block.expansion, stride),
-                nn.BatchNorm2d(planes * block.expansion)])
-        layers = [block(self.inplanes, planes, stride, downsample)]
+                conv1x1(self.inplanes, planes * block.expansion, stride,
+                        data_format=df),
+                _bn(planes * block.expansion, df)])
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, data_format=df))
         return nn.Sequential(layers)
 
     def forward(self, p, x):
+        if self.channels_last:
+            x = jnp.transpose(x, (0, 2, 3, 1))
         x = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
         x = self.maxpool({}, x)
         x = self.layer1(p["layer1"], x)
@@ -111,21 +140,21 @@ class ResNet(nn.Module):
         return self.fc(p["fc"], x)
 
 
-def resnet18(num_classes=1000):
-    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes)
+def resnet18(num_classes=1000, channels_last=False):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, channels_last)
 
 
-def resnet34(num_classes=1000):
-    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes)
+def resnet34(num_classes=1000, channels_last=False):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, channels_last)
 
 
-def resnet50(num_classes=1000):
-    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes)
+def resnet50(num_classes=1000, channels_last=False):
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, channels_last)
 
 
-def resnet101(num_classes=1000):
-    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes)
+def resnet101(num_classes=1000, channels_last=False):
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, channels_last)
 
 
-def resnet152(num_classes=1000):
-    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes)
+def resnet152(num_classes=1000, channels_last=False):
+    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes, channels_last)

@@ -67,8 +67,18 @@ def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
            stride: Union[int, Tuple[int, int]] = 1,
            padding: Union[int, Tuple[int, int], str] = 0,
            dilation: Union[int, Tuple[int, int]] = 1,
-           groups: int = 1) -> jax.Array:
-    """NCHW conv; weight (O, I/groups, kH, kW) like torch."""
+           groups: int = 1, data_format: str = "NCHW") -> jax.Array:
+    """Conv with torch-shaped (O, I/groups, kH, kW) weights.
+
+    ``data_format`` selects the activation layout: "NCHW" (torch parity,
+    default) or "NHWC" (channels-last — the layout whose channel dim
+    lands on the TPU's 128-lane minor axis).  The weight layout stays
+    OIHW in the param tree either way — XLA consumes it directly via
+    dimension_numbers, so amp casting, optimizers, and checkpoints are
+    layout-agnostic."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(dilation, int):
@@ -80,10 +90,11 @@ def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilation, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(data_format, "OIHW", data_format),
         preferred_element_type=None)
     if bias is not None:
-        y = y + bias.astype(y.dtype)[None, :, None, None]
+        b = bias.astype(y.dtype)
+        y = y + (b if data_format == "NHWC" else b[None, :, None, None])
     return y
 
 
@@ -248,7 +259,11 @@ def dropout(x: jax.Array, rate: float, rng: jax.Array) -> jax.Array:
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
-def _pool2d(x, window, stride, padding, init, reduce_fn):
+def _pool2d(x, window, stride, padding, init, reduce_fn,
+            data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     if isinstance(window, int):
         window = (window, window)
     if stride is None:
@@ -257,35 +272,49 @@ def _pool2d(x, window, stride, padding, init, reduce_fn):
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = (padding, padding)
+    spatial_first = 2 if data_format == "NCHW" else 1
     if isinstance(padding, (tuple, list)) and all(
             isinstance(p, int) for p in padding):
         ph, pw = padding
-        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        pads = [(0, 0)] * 4
+        pads[spatial_first] = (ph, ph)
+        pads[spatial_first + 1] = (pw, pw)
+        padding = tuple(pads)
+    dims = [1] * 4
+    strides = [1] * 4
+    dims[spatial_first:spatial_first + 2] = window
+    strides[spatial_first:spatial_first + 2] = stride
     return lax.reduce_window(
-        x, init, reduce_fn, (1, 1) + tuple(window), (1, 1) + tuple(stride),
-        padding)
+        x, init, reduce_fn, tuple(dims), tuple(strides), padding)
 
 
-def max_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
+def max_pool2d(x: jax.Array, kernel_size, stride=None, padding=0,
+               data_format: str = "NCHW") -> jax.Array:
     # literal init values let XLA recognize the max monoid (autodiff rule)
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
-    return _pool2d(x, kernel_size, stride, padding, neg, lax.max)
+    return _pool2d(x, kernel_size, stride, padding, neg, lax.max,
+                   data_format)
 
 
-def avg_pool2d(x: jax.Array, kernel_size, stride=None, padding=0) -> jax.Array:
+def avg_pool2d(x: jax.Array, kernel_size, stride=None, padding=0,
+               data_format: str = "NCHW") -> jax.Array:
     if isinstance(kernel_size, int):
         denom = kernel_size * kernel_size
     else:
         denom = kernel_size[0] * kernel_size[1]
-    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add)
+    s = _pool2d(x, kernel_size, stride, padding, 0.0, lax.add, data_format)
     return s / jnp.asarray(denom, x.dtype)
 
 
-def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]]
-                        ) -> jax.Array:
+def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]],
+                        data_format: str = "NCHW") -> jax.Array:
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     if output_size in (1, (1, 1)):
-        return jnp.mean(x, axis=(2, 3), keepdims=True).astype(x.dtype)
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        return jnp.mean(x, axis=axes, keepdims=True).astype(x.dtype)
     raise NotImplementedError("adaptive_avg_pool2d supports output_size=1")
 
 

@@ -57,7 +57,8 @@ class Conv2d(Module):
                  kernel_size: Union[int, Tuple[int, int]],
                  stride: Union[int, Tuple[int, int]] = 1,
                  padding: Union[int, Tuple[int, int]] = 0,
-                 dilation: int = 1, groups: int = 1, bias: bool = True):
+                 dilation: int = 1, groups: int = 1, bias: bool = True,
+                 data_format: str = "NCHW"):
         super().__init__()
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size, kernel_size)
@@ -69,6 +70,7 @@ class Conv2d(Module):
         self.dilation = dilation
         self.groups = groups
         self.use_bias = bias
+        self.data_format = data_format
 
     def create_params(self, key):
         wk, bk = jax.random.split(key)
@@ -84,7 +86,8 @@ class Conv2d(Module):
     def forward(self, params, x):
         return F.conv2d(x, params["weight"], params.get("bias"),
                         stride=self.stride, padding=self.padding,
-                        dilation=self.dilation, groups=self.groups)
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
 
 
 class ConvTranspose2d(Module):
@@ -145,13 +148,15 @@ class BatchNorm2d(Module):
 
     def __init__(self, num_features: int, eps: float = 1e-5,
                  momentum: float = 0.1, affine: bool = True,
-                 track_running_stats: bool = True):
+                 track_running_stats: bool = True, channel_axis: int = 1):
         super().__init__()
         self.num_features = num_features
         self.eps = eps
         self.momentum = momentum
         self.affine = affine
         self.track_running_stats = track_running_stats
+        # 1 for NCHW (torch parity, default); -1/3 for channels-last
+        self.channel_axis = channel_axis
 
     def create_params(self, key):
         if not self.affine:
@@ -178,7 +183,9 @@ class BatchNorm2d(Module):
         st = ctx.get_state(self.path) if (ctx is not None and
                                           self.track_running_stats) else None
         if train or st is None:
-            count, mean, var = F.batch_norm_stats(x, (0, 2, 3))
+            ca = self.channel_axis % x.ndim
+            axes = tuple(a for a in range(x.ndim) if a != ca)
+            count, mean, var = F.batch_norm_stats(x, axes)
             count, mean, var = self._sync_stats(count, mean, var)
             if st is not None and ctx.mutable:
                 m = self.momentum
@@ -194,7 +201,8 @@ class BatchNorm2d(Module):
             mean, var = st["running_mean"], st["running_var"]
         w = params.get("weight") if self.affine else None
         b = params.get("bias") if self.affine else None
-        return F.batch_norm_apply(x, mean, var, w, b, self.eps, channel_axis=1)
+        return F.batch_norm_apply(x, mean, var, w, b, self.eps,
+                                  channel_axis=self.channel_axis)
 
 
 class LayerNorm(Module):
@@ -277,27 +285,34 @@ class Flatten(Module):
 
 
 class MaxPool2d(Module):
-    def __init__(self, kernel_size, stride=None, padding=0):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCHW"):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
 
     def forward(self, params, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
 
 
 class AvgPool2d(Module):
-    def __init__(self, kernel_size, stride=None, padding=0):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCHW"):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
 
     def forward(self, params, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
 
 
 class AdaptiveAvgPool2d(Module):
-    def __init__(self, output_size=1):
+    def __init__(self, output_size=1, data_format: str = "NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, params, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)

@@ -37,7 +37,8 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
                 mod.num_features, eps=mod.eps, momentum=mod.momentum,
                 affine=mod.affine,
                 track_running_stats=mod.track_running_stats,
-                process_group=process_group, channel_last=channel_last)
+                process_group=process_group, channel_last=channel_last,
+                channel_axis=mod.channel_axis)
             return new
         return None
 

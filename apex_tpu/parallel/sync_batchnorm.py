@@ -59,10 +59,17 @@ class SyncBatchNorm(BatchNorm2d):
                  track_running_stats: bool = True,
                  process_group: Union[None, str, Tuple[str, List[List[int]]]]
                  = None,
-                 channel_last: bool = False):
+                 channel_last: bool = False, channel_axis: int = 1):
+        # channel_last is the reference's NHWC flag
+        # (optimized_sync_batchnorm.py:69-84); channel_axis generalizes it
+        # for the channels-last module path.  Either spelling lands on the
+        # same native channel_axis handling in BatchNorm2d — no transpose.
+        if channel_last:
+            channel_axis = -1
         super().__init__(num_features, eps=eps, momentum=momentum,
                          affine=affine,
-                         track_running_stats=track_running_stats)
+                         track_running_stats=track_running_stats,
+                         channel_axis=channel_axis)
         if process_group is None:
             self.axis_name: Optional[str] = "data"
             self.axis_index_groups = None
@@ -103,9 +110,3 @@ class SyncBatchNorm(BatchNorm2d):
         g_var = sum_x2 / total - jnp.square(g_mean)
         return total, g_mean, g_var
 
-    def forward(self, params, x):
-        if self.channel_last:
-            x = jnp.moveaxis(x, -1, 1)
-            out = super().forward(params, x)
-            return jnp.moveaxis(out, 1, -1)
-        return super().forward(params, x)

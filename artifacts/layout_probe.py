@@ -100,20 +100,21 @@ def bn_cost():
     print(f"bn apply x8: {timed(apply_only, x)*1e3:.2f} ms")
 
 
-def model_fwd():
+def model_fwd(channels_last=False):
     from apex_tpu import amp, models, optimizers
-    model, _ = amp.initialize(models.resnet50(),
+    model, _ = amp.initialize(models.resnet50(channels_last=channels_last),
                               optimizers.FusedAdam(lr=0.1),
                               opt_level="O2", verbosity=0)
     params, bn = model.init(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (B, 3, 224, 224))
+    tag = "NHWC" if channels_last else "NCHW"
 
     def fwd(p, x):
         out, _ = model.apply(p, x, state=bn, train=True)
         return jnp.sum(out.astype(jnp.float32))
 
     dt = timed(fwd, params, x)
-    print(f"resnet50 O2 fwd (train-mode BN): {dt*1e3:.2f} ms  "
+    print(f"resnet50 O2 {tag} fwd (train-mode BN): {dt*1e3:.2f} ms  "
           f"({B/dt:.0f} img/s)")
 
     def fwd_eval(p, x):
@@ -121,7 +122,16 @@ def model_fwd():
         return jnp.sum(out.astype(jnp.float32))
 
     dt = timed(fwd_eval, params, x)
-    print(f"resnet50 O2 fwd (eval-mode BN): {dt*1e3:.2f} ms  "
+    print(f"resnet50 O2 {tag} fwd (eval-mode BN): {dt*1e3:.2f} ms  "
+          f"({B/dt:.0f} img/s)")
+
+    def fwdbwd(p, x):
+        g = jax.grad(lambda p: fwd(p, x))(p)
+        # timed() wants one array; sum one representative leaf
+        return jax.tree_util.tree_leaves(g)[0]
+
+    dt = timed(fwdbwd, params, x, iters=5)
+    print(f"resnet50 O2 {tag} fwd+bwd (train): {dt*1e3:.2f} ms  "
           f"({B/dt:.0f} img/s)")
 
 
@@ -129,4 +139,5 @@ if __name__ == "__main__":
     conv_stack("NCHW")
     conv_stack("NHWC")
     bn_cost()
-    model_fwd()
+    model_fwd(channels_last=False)
+    model_fwd(channels_last=True)

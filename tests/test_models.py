@@ -222,3 +222,79 @@ def test_ulysses_head_count_check():
         jax.jit(jax.shard_map(
             attn, mesh=mesh, in_specs=(P(None, None, "sp"),),
             out_specs=P(None, None, "sp"), check_vma=False))(x)
+
+
+def test_resnet_channels_last_matches_nchw():
+    """channels_last=True must be numerically identical to the default
+    layout under the same param/state trees (weights stay OIHW, BN params
+    (C,)) — inputs are NCHW in both modes, transposed once at entry."""
+    m_nchw = resnet18(num_classes=10)
+    m_nhwc = resnet18(num_classes=10, channels_last=True)
+    params, state = m_nchw.init(jax.random.PRNGKey(0))
+    params2, state2 = m_nhwc.init(jax.random.PRNGKey(0))
+    # identical trees: layout never leaks into params or running stats
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(params2)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(params2)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 64, 64))
+    out1, st1 = nn.apply(m_nchw, params, x, state=state, train=True)
+    out2, st2 = nn.apply(m_nhwc, params, x, state=state, train=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-4, atol=1e-4)
+    # running stats agree too (stat axes were remapped correctly)
+    for a, b in zip(jax.tree_util.tree_leaves(st1),
+                    jax.tree_util.tree_leaves(st2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_block_channels_last_grads_match():
+    """Layout-parity of gradients, asserted at block granularity: a
+    stride-2 BasicBlock with its downsample path (conv/BN/relu/residual,
+    everything layout-dependent) must produce near-identical train-mode
+    grads in both layouts.  Full-model grad comparison is intentionally
+    NOT asserted tightly: at tiny batch the gradient through 8 stacked
+    train-mode BNs is chaotic — per-layer reassociation noise of ~1e-6
+    is amplified by batch-stat sensitivity into percent-level deviations
+    that say nothing about correctness (forward and running stats DO
+    match tightly, see above)."""
+    from apex_tpu.models.resnet import BasicBlock, conv1x1, _bn
+
+    def block(df):
+        ds = nn.Sequential([conv1x1(8, 16, 2, data_format=df),
+                            _bn(16, df)])
+        return BasicBlock(8, 16, stride=2, downsample=ds, data_format=df)
+
+    b_nchw, b_nhwc = block("NCHW"), block("NHWC")
+    params, state = b_nchw.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16, 16))
+
+    def loss(m, p, df):
+        h = jnp.transpose(x, (0, 2, 3, 1)) if df == "NHWC" else x
+        out, _ = nn.apply(m, p, h, state=state, train=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g1 = jax.grad(lambda p: loss(b_nchw, p, "NCHW"))(params)
+    g2 = jax.grad(lambda p: loss(b_nhwc, p, "NHWC"))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_syncbn_channels_last_native_axis():
+    """SyncBatchNorm with channel_last/channel_axis=-1 normalizes NHWC
+    input without transposes and matches a transposed NCHW reference."""
+    from apex_tpu.parallel import SyncBatchNorm
+    bn_nhwc = SyncBatchNorm(8, channel_last=True)
+    bn_nchw = SyncBatchNorm(8)
+    params = bn_nhwc.init(jax.random.PRNGKey(0))[0]
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 5, 6, 8))
+    out, _ = nn.apply(bn_nhwc, params, x, train=True)
+    ref, _ = nn.apply(bn_nchw, params, jnp.moveaxis(x, -1, 1), train=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.moveaxis(ref, 1, -1)),
+                               rtol=1e-5, atol=1e-5)
