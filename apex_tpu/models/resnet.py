@@ -32,9 +32,8 @@ def conv1x1(cin, cout, stride=1, data_format="NCHW"):
 
 
 def _bn(planes, data_format):
-    if data_format not in ("NCHW", "NHWC"):
-        raise ValueError(f"data_format must be NCHW or NHWC, "
-                         f"got {data_format!r}")
+    from ..nn.functional import _check_data_format
+    _check_data_format(data_format)
     return nn.BatchNorm2d(
         planes, channel_axis=(1 if data_format == "NCHW" else -1))
 

@@ -5,8 +5,10 @@ cast policy (whitelist half, blacklist fp32, promote widest — reference
 apex/amp/lists/*) applies at dispatch time.  With no policy installed the
 ops are plain jnp/lax code and XLA fuses them freely.
 
-Convolutions use NCHW layout to match the reference's examples; XLA
-re-layouts internally for the MXU so this costs nothing at runtime.
+Convolutions and pools default to NCHW layout to match the reference's
+examples, and accept ``data_format="NHWC"`` for channels-last models
+(channels on the TPU's 128-lane minor axis); weights stay OIHW either
+way.
 """
 
 from __future__ import annotations
@@ -19,6 +21,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..amp import policy as _policy
+
+
+def _check_data_format(data_format: str) -> None:
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
 
 __all__ = [
     "linear", "matmul", "conv2d", "conv_transpose2d", "relu", "leaky_relu",
@@ -76,9 +84,7 @@ def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
     OIHW in the param tree either way — XLA consumes it directly via
     dimension_numbers, so amp casting, optimizers, and checkpoints are
     layout-agnostic."""
-    if data_format not in ("NCHW", "NHWC"):
-        raise ValueError(f"data_format must be NCHW or NHWC, "
-                         f"got {data_format!r}")
+    _check_data_format(data_format)
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(dilation, int):
@@ -261,9 +267,7 @@ def dropout(x: jax.Array, rate: float, rng: jax.Array) -> jax.Array:
 
 def _pool2d(x, window, stride, padding, init, reduce_fn,
             data_format="NCHW"):
-    if data_format not in ("NCHW", "NHWC"):
-        raise ValueError(f"data_format must be NCHW or NHWC, "
-                         f"got {data_format!r}")
+    _check_data_format(data_format)
     if isinstance(window, int):
         window = (window, window)
     if stride is None:
@@ -309,9 +313,7 @@ def avg_pool2d(x: jax.Array, kernel_size, stride=None, padding=0,
 
 def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]],
                         data_format: str = "NCHW") -> jax.Array:
-    if data_format not in ("NCHW", "NHWC"):
-        raise ValueError(f"data_format must be NCHW or NHWC, "
-                         f"got {data_format!r}")
+    _check_data_format(data_format)
     if output_size in (1, (1, 1)):
         axes = (2, 3) if data_format == "NCHW" else (1, 2)
         return jnp.mean(x, axis=axes, keepdims=True).astype(x.dtype)

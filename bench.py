@@ -119,8 +119,8 @@ def main():
 
     def resnet_config(metric, opt_level, arch, batch_per_chip, image,
                       iters, warmup, sync_bn=False, vs=None,
-                      steps_per_call=1):
-        model = getattr(models, arch)()
+                      steps_per_call=1, channels_last=False):
+        model = getattr(models, arch)(channels_last=channels_last)
         if sync_bn:
             model = parallel.convert_syncbn_model(model)
         model, optimizer = amp.initialize(
@@ -276,6 +276,11 @@ def main():
                  optimizers.FusedLAMB(lr=1e-3), 8, 128, 8, 2)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
+            ("resnet50_amp_o2_ddp_nhwc_train_throughput",
+             lambda: resnet_config(
+                 "resnet50_amp_o2_ddp_nhwc_train_throughput",
+                 "O2", "resnet50", 128, 224, 10, 2,
+                 vs=BASELINE_IMG_PER_SEC_PER_CHIP, channels_last=True)),
             ("resnet50_amp_o2_ddp_scan4_train_throughput",
              lambda: resnet_config(
                  "resnet50_amp_o2_ddp_scan4_train_throughput",
