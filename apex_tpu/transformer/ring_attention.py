@@ -29,9 +29,19 @@ __all__ = ["ring_attention", "ring_self_attention"]
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   remat: bool = True) -> jax.Array:
     """q, k, v: (B, H, T_local, D) per-device slices; returns the exact
-    attention output for the local queries against the *global* sequence."""
+    attention output for the local queries against the *global* sequence.
+
+    ``remat=True`` (default) wraps each ring step's score/softmax math in
+    ``jax.checkpoint``: without it, reverse-mode AD saves the
+    (B, H, Tq, Tk) probability block of every step — O(T_local·T_global)
+    residual memory, the quadratic cost the ring exists to avoid.  With
+    it, only the linear-memory carries (the rotating K/V blocks and the
+    online-softmax state) are saved and scores are recomputed in the
+    backward, flash-attention style.  The ppermutes stay outside the
+    checkpoint so the backward re-runs matmuls, not communication."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     n = lax.psum(1, axis_name)
@@ -40,7 +50,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Tk = k.shape[2]
 
     q32 = q.astype(jnp.float32) * scale
-    perm = None  # built lazily: static python list needs concrete axis size
 
     acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
     m0 = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
@@ -48,9 +57,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     q_pos = my * Tq + jnp.arange(Tq)
 
-    def body(i, carry):
-        k_blk, v_blk, m, l, acc = carry
-        src = (my - i) % n  # whose kv block we hold at step i
+    def block(q32, k_blk, v_blk, m, l, acc, src):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
                             k_blk.astype(jnp.float32))
         if causal:
@@ -67,11 +74,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         new_acc = acc * corr + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return new_m, new_l, new_acc
+
+    if remat:
+        # prevent_cse=False: the fori_loop lowers to scan, whose loop
+        # structure already rules out the CSE hazard the default barrier
+        # guards against — and the barrier would block XLA from
+        # overlapping the block math with the ppermute DMA
+        block = jax.checkpoint(block, prevent_cse=False)
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - i) % n  # whose kv block we hold at step i
+        m, l, acc = block(q32, k_blk, v_blk, m, l, acc, src)
         # rotate kv to the next ring neighbor over ICI
         nxt = [(j, (j + 1) % n) for j in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, nxt)
         v_blk = lax.ppermute(v_blk, axis_name, nxt)
-        return k_blk, v_blk, new_m, new_l, new_acc
+        return k_blk, v_blk, m, l, acc
 
     _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
