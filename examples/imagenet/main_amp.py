@@ -46,6 +46,9 @@ def parse_args():
     p.add_argument("--keep-batchnorm-fp32", default=None)
     p.add_argument("--half-dtype", default=None,
                    choices=[None, "bfloat16", "float16"])
+    p.add_argument("--channels-last", action="store_true",
+                   help="run internal activations NHWC (TPU lane-aligned "
+                        "channels); input stays NCHW")
     p.add_argument("--sync_bn", action="store_true",
                    help="convert BatchNorm to SyncBatchNorm")
     p.add_argument("--fused-adam", action="store_true",
@@ -77,7 +80,7 @@ def main():
     ndev = len(jax.devices())
     print(f"=> {ndev} device(s) on backend {jax.default_backend()}")
     print(f"=> creating model '{args.arch}'")
-    model = getattr(models, args.arch)()
+    model = getattr(models, args.arch)(channels_last=args.channels_last)
     if args.sync_bn:
         print("using apex_tpu synced BN")
         model = parallel.convert_syncbn_model(model)
