@@ -30,7 +30,9 @@ def parse_args():
     p = argparse.ArgumentParser(description="apex_tpu ImageNet training")
     p.add_argument("--data", default=None,
                    help="optional .npz with images/labels; synthetic if unset")
-    p.add_argument("--arch", "-a", default="resnet50")
+    p.add_argument("--arch", "-a", default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50",
+                            "resnet101", "resnet152"])
     p.add_argument("-b", "--batch-size", type=int, default=128,
                    help="per-device batch size")
     p.add_argument("--epochs", type=int, default=1)

@@ -1,0 +1,93 @@
+"""Property-based tests (hypothesis) for the invariant-heavy substrate:
+flat-buffer round-trips and the dynamic loss-scaler state machine.
+
+These complement the example-based suites: the reference validated the
+same invariants implicitly across thousands of CI iterations
+(tests/L1/common/run_test.sh); here hypothesis drives the state spaces
+directly.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.multi_tensor_apply.flatten import (pack_flat, unpack_flat,
+                                                 split_by_dtype)
+
+
+# -- flat buffers -----------------------------------------------------------
+
+_shapes = st.lists(
+    st.lists(st.integers(1, 5), min_size=0, max_size=3), min_size=1,
+    max_size=6)
+_dtypes = st.lists(
+    st.sampled_from([np.float32, np.float16, np.int32]), min_size=1,
+    max_size=6)
+
+
+@settings(max_examples=30, deadline=None)
+@given(shapes=_shapes, seed=st.integers(0, 2**31 - 1))
+def test_pack_unpack_roundtrip(shapes, seed):
+    rng = np.random.RandomState(seed)
+    tree = {f"p{i}": jnp.asarray(np.asarray(rng.randn(*s), np.float32))
+            for i, s in enumerate(shapes)}
+    flat, leaves, treedef = pack_flat(tree)
+    assert flat.size == sum(int(l.size) for l in leaves)
+    back = unpack_flat(flat, leaves, treedef)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@settings(max_examples=20, deadline=None)
+@given(shapes=_shapes, dtypes=_dtypes, seed=st.integers(0, 2**31 - 1))
+def test_split_by_dtype_partitions_every_leaf(shapes, dtypes, seed):
+    rng = np.random.RandomState(seed)
+    tree = {}
+    for i, s in enumerate(shapes):
+        dt = dtypes[i % len(dtypes)]
+        arr = np.asarray(np.asarray(rng.randn(*s)) * 4, dt)
+        tree[f"p{i}"] = jnp.asarray(arr)
+    leaves = jax.tree_util.tree_leaves(tree)
+    groups = split_by_dtype(leaves)
+    # every (index, leaf) lands in exactly one group, keyed by its dtype,
+    # and the index set is a permutation of the input positions
+    pairs = [p for ls in groups.values() for p in ls]
+    assert sorted(i for i, _ in pairs) == list(range(len(leaves)))
+    for dt, ls in groups.items():
+        assert all(l.dtype == dt for _, l in ls)
+
+
+# -- dynamic loss scaler ----------------------------------------------------
+
+@settings(max_examples=25, deadline=None)
+@given(overflows=st.lists(st.booleans(), min_size=1, max_size=120),
+       window=st.integers(1, 8))
+def test_scaler_transition_invariants(overflows, window):
+    """Model-check the reference transition (apex/amp/scaler.py:190-210)
+    against an independent python model for arbitrary overflow traces:
+    halve on overflow, double after `window` clean steps, never exceed
+    caps, skip-count equals overflow count."""
+    sc = LossScaler(init_scale=2.0 ** 8, scale_window=window,
+                    min_loss_scale=0.5, max_loss_scale=2.0 ** 12)
+    state = sc.init_state()
+
+    model_scale, model_unskipped, model_skipped = 2.0 ** 8, 0, 0
+    for ov in overflows:
+        state = sc.update(state, jnp.asarray(1.0 if ov else 0.0))
+        if ov:
+            model_scale = max(model_scale / 2.0, 0.5)
+            model_unskipped = 0
+            model_skipped += 1
+        else:
+            model_unskipped += 1
+            if model_unskipped >= window:
+                model_scale = min(model_scale * 2.0, 2.0 ** 12)
+                model_unskipped = 0
+        assert float(state.loss_scale) == model_scale, \
+            (float(state.loss_scale), model_scale)
+        assert int(state.unskipped) == model_unskipped
+        assert int(state.steps_skipped) == model_skipped
+    assert 0.5 <= float(state.loss_scale) <= 2.0 ** 12
