@@ -309,13 +309,54 @@ def main():
                                    vs=BASELINE_IMG_PER_SEC_PER_CHIP)),
         ]
 
-    for name, job in jobs:
-        try:
-            job()
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
-            emit(metric=name, value=None, unit=None, vs_baseline=None,
-                 error=traceback.format_exc(limit=1).splitlines()[-1])
+    # Per-config watchdog: the startup probe catches a tunnel that is
+    # already wedged, but a wedge DURING a config would otherwise hang
+    # the whole harness and the round records nothing.  Each config runs
+    # in a daemon thread with a timed join — signal.alarm can't help
+    # here because the wedge blocks inside a C device-fetch call that
+    # never returns.  On timeout the stuck thread is abandoned (it dies
+    # with the process) and the harness emits an error line and moves on.
+    import threading
+
+    per_config_s = 1200 if on_tpu else 3000
+    # a timed-out thread may later revive (transient wedge) and try to
+    # emit its line mid-way through a later config — breaking both the
+    # one-line-per-config and headline-printed-LAST contracts.  Emissions
+    # are gated on the worker's generation still being current.
+    tls = threading.local()
+    cancelled: set = set()
+    emit_lock = threading.Lock()
+    _raw_emit = emit
+
+    def emit(**kw):  # noqa: F811 — deliberate gate over the raw emitter
+        gen = getattr(tls, "gen", None)
+        with emit_lock:
+            if gen in cancelled:
+                return
+            _raw_emit(**kw)
+
+    for gen, (name, job) in enumerate(jobs):
+        box = {}
+
+        def run(job=job, box=box, gen=gen):
+            tls.gen = gen
+            try:
+                job()
+            except BaseException:   # incl. SystemExit: must leave a trace
+                box["err"] = traceback.format_exc()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(per_config_s)
+        if t.is_alive():
+            with emit_lock:
+                cancelled.add(gen)
+            _raw_emit(metric=name, value=None, unit=None, vs_baseline=None,
+                      error=f"config hung > {per_config_s}s (device wedge?)")
+        elif "err" in box:
+            print(box["err"], file=sys.stderr)
+            _raw_emit(metric=name, value=None, unit=None, vs_baseline=None,
+                      error=box["err"].strip().splitlines()[-1])
 
 
 if __name__ == "__main__":
