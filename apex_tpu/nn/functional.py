@@ -109,13 +109,15 @@ def conv_transpose2d(x: jax.Array, weight: jax.Array,
                      bias: Optional[jax.Array] = None,
                      stride: Union[int, Tuple[int, int]] = 1,
                      padding: Union[int, Tuple[int, int]] = 0,
-                     output_padding: Union[int, Tuple[int, int]] = 0
-                     ) -> jax.Array:
-    """NCHW transposed conv; weight (I, O, kH, kW) like torch.
+                     output_padding: Union[int, Tuple[int, int]] = 0,
+                     data_format: str = "NCHW") -> jax.Array:
+    """Transposed conv; weight (I, O, kH, kW) like torch; activations
+    NCHW (default) or NHWC.
 
     Expressed as the gradient-of-conv form ``lax.conv_general_dilated``
     with lhs dilation — the formulation XLA pattern-matches onto the MXU.
     """
+    _check_data_format(data_format)
     if isinstance(stride, int):
         stride = (stride, stride)
     if isinstance(padding, int):
@@ -131,9 +133,10 @@ def conv_transpose2d(x: jax.Array, weight: jax.Array,
     y = lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=pads,
         lhs_dilation=stride,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=(data_format, "OIHW", data_format))
     if bias is not None:
-        y = y + bias.astype(y.dtype)[None, :, None, None]
+        b = bias.astype(y.dtype)
+        y = y + (b if data_format == "NHWC" else b[None, :, None, None])
     return y
 
 

@@ -91,14 +91,15 @@ class Conv2d(Module):
 
 
 class ConvTranspose2d(Module):
-    """NCHW transposed convolution (DCGAN generator upsampling path)."""
+    """Transposed convolution (DCGAN generator upsampling path); NCHW
+    default, NHWC via data_format."""
 
     def __init__(self, in_channels: int, out_channels: int,
                  kernel_size: Union[int, Tuple[int, int]],
                  stride: Union[int, Tuple[int, int]] = 1,
                  padding: Union[int, Tuple[int, int]] = 0,
                  output_padding: Union[int, Tuple[int, int]] = 0,
-                 bias: bool = True):
+                 bias: bool = True, data_format: str = "NCHW"):
         super().__init__()
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size, kernel_size)
@@ -109,6 +110,7 @@ class ConvTranspose2d(Module):
         self.padding = padding
         self.output_padding = output_padding
         self.use_bias = bias
+        self.data_format = data_format
 
     def create_params(self, key):
         wk, bk = jax.random.split(key)
@@ -125,7 +127,8 @@ class ConvTranspose2d(Module):
     def forward(self, params, x):
         return F.conv_transpose2d(x, params["weight"], params.get("bias"),
                                   stride=self.stride, padding=self.padding,
-                                  output_padding=self.output_padding)
+                                  output_padding=self.output_padding,
+                                  data_format=self.data_format)
 
 
 class LeakyReLU(Module):

@@ -319,44 +319,55 @@ def main():
     import threading
 
     per_config_s = 1200 if on_tpu else 3000
-    # a timed-out thread may later revive (transient wedge) and try to
-    # emit its line mid-way through a later config — breaking both the
-    # one-line-per-config and headline-printed-LAST contracts.  Emissions
-    # are gated on the worker's generation still being current.
+    # Workers never print: each config's emissions are BUFFERED
+    # (thread-local) and flushed by the main thread after its join, so a
+    # timed-out thread that later revives can neither print out of order
+    # past the headline nor produce duplicate lines — its appends land in
+    # a buffer nobody flushes again.  An abandoned thread cannot be
+    # killed, though; if one is still alive while later configs run, its
+    # device work contaminates their timings, so later lines carry an
+    # `overlapping_hung_configs` annotation instead of silently reading
+    # as clean measurements.
     tls = threading.local()
-    cancelled: set = set()
-    emit_lock = threading.Lock()
     _raw_emit = emit
 
-    def emit(**kw):  # noqa: F811 — deliberate gate over the raw emitter
-        gen = getattr(tls, "gen", None)
-        with emit_lock:
-            if gen in cancelled:
-                return
-            _raw_emit(**kw)
+    def emit(**kw):  # noqa: F811 — buffer-appending gate over the raw one
+        buf = getattr(tls, "buf", None)
+        if buf is None:
+            _raw_emit(**kw)         # main-thread callers
+        else:
+            buf.append(kw)
 
-    for gen, (name, job) in enumerate(jobs):
-        box = {}
+    hung: list = []                 # (name, thread) of timed-out configs
 
-        def run(job=job, box=box, gen=gen):
-            tls.gen = gen
+    for name, job in jobs:
+        buf: list = []
+        box: dict = {}
+
+        def run(job=job, buf=buf, box=box):
+            tls.buf = buf
             try:
                 job()
             except BaseException:   # incl. SystemExit: must leave a trace
                 box["err"] = traceback.format_exc()
 
+        overlap = [n for n, th in hung if th.is_alive()]
+        extra = {"overlapping_hung_configs": overlap} if overlap else {}
         t = threading.Thread(target=run, daemon=True)
         t.start()
         t.join(per_config_s)
+        for line in list(buf):      # snapshot: thread may still append
+            _raw_emit(**{**line, **extra})
         if t.is_alive():
-            with emit_lock:
-                cancelled.add(gen)
+            hung.append((name, t))
             _raw_emit(metric=name, value=None, unit=None, vs_baseline=None,
-                      error=f"config hung > {per_config_s}s (device wedge?)")
+                      error=f"config hung > {per_config_s}s (device "
+                            f"wedge?); any lines above for it are the "
+                            f"portion completed before the hang", **extra)
         elif "err" in box:
             print(box["err"], file=sys.stderr)
             _raw_emit(metric=name, value=None, unit=None, vs_baseline=None,
-                      error=box["err"].strip().splitlines()[-1])
+                      error=box["err"].strip().splitlines()[-1], **extra)
 
 
 if __name__ == "__main__":

@@ -347,3 +347,16 @@ def test_ring_attention_grads_match_dense(remat):
         for a, b in zip(g_ring, g_dense):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_channels_last_matches_nchw():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8))
+    w = jax.random.normal(jax.random.PRNGKey(1), (4, 6, 3, 3)) * 0.1
+    b = jax.random.normal(jax.random.PRNGKey(2), (6,))
+    ref = F.conv_transpose2d(x, w, b, stride=2, padding=1,
+                             output_padding=1)
+    out = F.conv_transpose2d(jnp.transpose(x, (0, 2, 3, 1)), w, b,
+                             stride=2, padding=1, output_padding=1,
+                             data_format="NHWC")
+    np.testing.assert_allclose(np.asarray(jnp.transpose(out, (0, 3, 1, 2))),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
