@@ -28,6 +28,14 @@ def _check_data_format(data_format: str) -> None:
         raise ValueError(f"data_format must be NCHW or NHWC, "
                          f"got {data_format!r}")
 
+
+def _bias_add(y: jax.Array, bias: Optional[jax.Array],
+              data_format: str) -> jax.Array:
+    if bias is None:
+        return y
+    b = bias.astype(y.dtype)
+    return y + (b if data_format == "NHWC" else b[None, :, None, None])
+
 __all__ = [
     "linear", "matmul", "conv2d", "conv_transpose2d", "relu", "leaky_relu",
     "gelu", "silu", "sigmoid", "tanh",
@@ -98,10 +106,7 @@ def conv2d(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
         rhs_dilation=dilation, feature_group_count=groups,
         dimension_numbers=(data_format, "OIHW", data_format),
         preferred_element_type=None)
-    if bias is not None:
-        b = bias.astype(y.dtype)
-        y = y + (b if data_format == "NHWC" else b[None, :, None, None])
-    return y
+    return _bias_add(y, bias, data_format)
 
 
 @op("conv_transpose2d")
@@ -134,10 +139,7 @@ def conv_transpose2d(x: jax.Array, weight: jax.Array,
         x, w, window_strides=(1, 1), padding=pads,
         lhs_dilation=stride,
         dimension_numbers=(data_format, "OIHW", data_format))
-    if bias is not None:
-        b = bias.astype(y.dtype)
-        y = y + (b if data_format == "NHWC" else b[None, :, None, None])
-    return y
+    return _bias_add(y, bias, data_format)
 
 
 # ---------------------------------------------------------------------------

@@ -108,8 +108,13 @@ def _lanes(vec, Tp):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, T_real, blk, nk):
+def _fwd_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
+    if has_mask:
+        (q_ref, k_ref, v_ref, kvm_ref,
+         o_ref, lse_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        kvm_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -134,6 +139,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         if causal:
             qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
+        if has_mask:
+            # (1, blk) key-validity row, sublane-broadcast tile layout:
+            # k positions on the lane axis, matching s's column axis
+            valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
         s = jnp.where(valid, s, _NEG)
         m_prev = m_ref[...][:, :1]                      # (blk, 1)
         l_prev = l_ref[...][:, :1]
@@ -157,8 +166,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                                                            lse_ref.shape[1:]))
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "causal"))
-def _fwd(q, k, v, scale, causal):
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "H"))
+def _fwd(q, k, v, kvm, scale, causal, H):
+    """kvm: (B, 8, Tp) fp32 key-validity (sublane-broadcast) or None."""
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -169,11 +179,18 @@ def _fwd(q, k, v, scale, causal):
     row = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, i, 0))
     col = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
     stat = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
+    has_mask = kvm is not None
+    in_specs = [row, col, col]
+    operands = [qp, kp, vp]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 8, blk),
+                                     lambda b, i, j: (b // H, 0, j)))
+        operands.append(kvm)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          T_real=T, blk=blk, nk=nk),
+                          has_mask=has_mask, T_real=T, blk=blk, nk=nk),
         grid=grid,
-        in_specs=[row, col, col],
+        in_specs=in_specs,
         out_specs=[row, stat],
         out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
                    jax.ShapeDtypeStruct((BH, Tp, LANES), jnp.float32)],
@@ -183,7 +200,7 @@ def _fwd(q, k, v, scale, causal):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret(),
-    )(qp, kp, vp)
+    )(*operands)
     return o[:, :T, :D], lse[:, :T, 0]
 
 
@@ -191,8 +208,14 @@ def _fwd(q, k, v, scale, causal):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, T_real, blk, nk):
+def _dq_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        kvm_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -216,6 +239,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
+        if has_mask:
+            valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = _dot(do, v, ((1,), (1,)))
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -226,9 +251,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, T_real,
-                blk, nq):
+def _dkv_kernel(*refs, scale, causal, has_mask, T_real, blk, nq):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        kvm_ref = None
     i = pl.program_id(1)          # k block
     j = pl.program_id(2)          # q block (streamed)
 
@@ -254,6 +284,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             qpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
+        if has_mask:
+            valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
         # padded q rows contribute nothing: their do rows are zero
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # (bq, bk)
         dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
@@ -267,8 +299,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "causal"))
-def _bwd(q, k, v, o, lse, do, scale, causal):
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "H"))
+def _bwd(q, k, v, o, lse, do, kvm, scale, causal, H):
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -279,6 +311,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal):
     deltap = _lanes(delta, Tp)
     lsep = _lanes(lse, Tp)
     nq = nk = Tp // blk
+    has_mask = kvm is not None
     sem = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
@@ -286,24 +319,38 @@ def _bwd(q, k, v, o, lse, do, scale, causal):
     colj = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
     stati = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
     statj = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, j, 0))
+    # key-validity tile for the k block: streamed along the j axis in the
+    # dq pass, along the i (k-block) axis in the dk/dv pass
+    kvmj = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, j))
+    kvmi = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, i))
 
+    dq_specs = [rowi, colj, colj, rowi, stati, stati]
+    dq_ops = [qp, kp, vp, dop, lsep, deltap]
+    if has_mask:
+        dq_specs.append(kvmj)
+        dq_ops.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          T_real=T, blk=blk, nk=nk),
+                          has_mask=has_mask, T_real=T, blk=blk, nk=nk),
         grid=(BH, nq, nk),
-        in_specs=[rowi, colj, colj, rowi, stati, stati],
+        in_specs=dq_specs,
         out_specs=rowi,
         out_shape=jax.ShapeDtypeStruct((BH, Tp, Dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk, Dp), jnp.float32)],
         compiler_params=sem,
         interpret=interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*dq_ops)
 
+    dkv_specs = [colj, rowi, rowi, colj, statj, statj]
+    dkv_ops = [qp, kp, vp, dop, lsep, deltap]
+    if has_mask:
+        dkv_specs.append(kvmi)
+        dkv_ops.append(kvm)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          T_real=T, blk=blk, nq=nq),
+                          has_mask=has_mask, T_real=T, blk=blk, nq=nq),
         grid=(BH, nk, nq),
-        in_specs=[colj, rowi, rowi, colj, statj, statj],
+        in_specs=dkv_specs,
         out_specs=[rowi, rowi],
         out_shape=[jax.ShapeDtypeStruct((BH, Tp, Dp), k.dtype),
                    jax.ShapeDtypeStruct((BH, Tp, Dp), v.dtype)],
@@ -311,7 +358,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal):
                         pltpu.VMEM((blk, Dp), jnp.float32)],
         compiler_params=sem,
         interpret=interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*dkv_ops)
     return dq[:, :T, :D], dk[:, :T, :D], dv[:, :T, :D]
 
 
@@ -319,21 +366,23 @@ def _bwd(q, k, v, o, lse, do, scale, causal):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q3, k3, v3, scale: float, causal: bool):
-    o, _ = _fwd(q3, k3, v3, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q3, k3, v3, kvm, scale: float, causal: bool, H: int):
+    o, _ = _fwd(q3, k3, v3, kvm, scale, causal, H)
     return o
 
 
-def _flash_fwd(q3, k3, v3, scale, causal):
-    o, lse = _fwd(q3, k3, v3, scale, causal)
-    return o, (q3, k3, v3, o, lse)
+def _flash_fwd(q3, k3, v3, kvm, scale, causal, H):
+    o, lse = _fwd(q3, k3, v3, kvm, scale, causal, H)
+    return o, (q3, k3, v3, o, lse, kvm)
 
 
-def _flash_bwd(scale, causal, res, do):
-    q3, k3, v3, o, lse = res
-    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, scale, causal)
-    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+def _flash_bwd(scale, causal, H, res, do):
+    q3, k3, v3, o, lse, kvm = res
+    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, kvm, scale, causal, H)
+    dkvm = None if kvm is None else jnp.zeros_like(kvm)
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
+            dkvm)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -341,11 +390,19 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
-                    scale: Optional[float] = None) -> jax.Array:
+                    scale: Optional[float] = None,
+                    kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """softmax(q k^T * scale [+ causal mask]) v without materializing the
     score matrix in HBM.  q, k, v: (B, H, T, D) self-attention operands
     (equal sequence lengths).  K/V are streamed through VMEM in blocks,
-    so the sequence length is bounded by HBM, not VMEM."""
+    so the sequence length is bounded by HBM, not VMEM.
+
+    ``kv_mask``: optional (B, T) bool key-validity (True = attend) — the
+    key-padding mask of BERT-style batches, streamed alongside the K/V
+    blocks as sublane-broadcast (B, 8, T) tiles (the upstream
+    jax.experimental flash kernel's SegmentIds layout).  Composes with
+    ``causal``.  Queries whose keys are ALL masked produce zero output
+    rows (the dense softmax would give a uniform average instead)."""
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, T, D), got {q.shape}")
     if q.shape != k.shape or k.shape != v.shape:
@@ -353,6 +410,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    kvm = None
+    if kv_mask is not None:
+        if kv_mask.shape != (B, T):
+            raise ValueError(f"kv_mask must be (B, T) = {(B, T)}, got "
+                             f"{kv_mask.shape}")
+        blk = _block_for(T)
+        Tp = -(-T // blk) * blk
+        m = jnp.pad(kv_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))
+        kvm = jax.lax.broadcast_in_dim(m, (B, 8, Tp), (0, 2))
     fold = lambda x: x.reshape(B * H, T, D)
-    out = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal))
+    out = _flash(fold(q), fold(k), fold(v), kvm, float(scale),
+                 bool(causal), H)
     return out.reshape(B, H, T, D)

@@ -37,13 +37,29 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (rng drawn from the active apply-context, like nn.Dropout).
     ``causal=True`` applies the lower-triangular mask; on TPU this (and
     the mask-free case) dispatches to the fused Pallas flash kernel when
-    no explicit ``mask``/dropout forces the dense path."""
+    no dropout forces the dense path.  Key-padding masks — a ``mask``
+    with no query-position dependence, shaped ``(B, 1, 1, Tk)`` (or with
+    leading broadcast dims of 1) — ALSO stay on the flash path: the
+    kernel streams the key-validity row alongside the K/V blocks.  Any
+    other mask shape (arbitrary per-pair masks) takes the dense path.
+
+    Caveat on fully-masked rows: flash emits zeros for a query whose
+    keys are all masked, while the dense softmax degrades to a uniform
+    average over all keys; real key-padding batches always keep at least
+    one valid key per sequence."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     ctx = current_context()
     train_dropout = (dropout_rate > 0.0 and ctx is not None and ctx.train)
-    if (mask is None and not train_dropout and q.ndim == 4
-            and q.shape == k.shape == v.shape):
+    B = q.shape[0] if q.ndim == 4 else None
+    Tk = k.shape[-2]
+    kv_mask = None
+    if (mask is not None and q.ndim == 4 and mask.ndim == 4
+            and mask.shape[-2] == 1 and mask.shape[1] == 1
+            and mask.shape[0] in (1, B) and mask.shape[-1] == Tk):
+        kv_mask = jnp.broadcast_to(mask[:, 0, 0, :] != 0, (B, Tk))
+    if ((mask is None or kv_mask is not None) and not train_dropout
+            and q.ndim == 4 and q.shape == k.shape == v.shape):
         from ..ops import dispatch
         if dispatch.use_pallas_for(q):
             from ..ops import pallas_flash_attention as pfa
@@ -55,7 +71,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 (q, k, v), _ = _pol.cast_op_args("dot_product_attention",
                                                  (q, k, v), {})
                 return pfa.flash_attention(q, k, v, causal=causal,
-                                           scale=scale)
+                                           scale=scale, kv_mask=kv_mask)
     if causal:
         Tq, Tk = q.shape[-2], k.shape[-2]
         # decode-style alignment: the last query attends to the full key

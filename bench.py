@@ -337,6 +337,11 @@ def main():
             _raw_emit(**kw)         # main-thread callers
         else:
             buf.append(kw)
+            # crash durability: tee to stderr immediately so a runtime
+            # segfault/OOM between now and the flush still leaves the
+            # measurement on record (stdout keeps the ordering contract)
+            print("# buffered: " + json.dumps({**kw, **base}),
+                  file=sys.stderr, flush=True)
 
     hung: list = []                 # (name, thread) of timed-out configs
 

@@ -383,3 +383,97 @@ def test_flash_path_respects_amp_policy(monkeypatch):
     np.testing.assert_allclose(np.asarray(flash, np.float32),
                                np.asarray(dense, np.float32),
                                rtol=3e-2, atol=3e-2)
+
+
+def _dense_attn_kvmask(q, k, v, causal, kv_mask):
+    import math
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(D)
+    s = jnp.where(kv_mask[:, None, None, :], s, -1e30)
+    if causal:
+        T = q.shape[2]
+        m = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_kv_mask_matches_dense(causal):
+    """Key-padding mask streamed through the kernel == dense masked
+    attention, forward and backward (BERT-style variable-length batch)."""
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    B, H, T, D = 2, 2, 160, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32)
+               for kk in ks)
+    lengths = jnp.array([T, T - 37])
+    kv_mask = jnp.arange(T)[None, :] < lengths[:, None]
+
+    ref = _dense_attn_kvmask(q, k, v, causal, kv_mask)
+    out = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda t: jnp.sum(
+        _dense_attn_kvmask(*t, causal, kv_mask) ** 2))((q, k, v))
+    g_out = jax.grad(lambda t: jnp.sum(
+        flash_attention(*t, causal=causal, kv_mask=kv_mask) ** 2))((q, k, v))
+    for a, b, name in zip(g_ref, g_out, "qkv"):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+    # masked keys must receive zero dk/dv
+    for g, name in ((g_out[1], "dk"), (g_out[2], "dv")):
+        tail = np.asarray(g)[1, :, T - 37:, :]
+        np.testing.assert_array_equal(tail, np.zeros_like(tail),
+                                      err_msg=name)
+
+
+def test_flash_attention_kv_mask_fully_masked_row():
+    """A batch entry whose keys are ALL masked yields zero output and
+    zero/finite grads (dense softmax would emit a uniform average)."""
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    B, H, T, D = 2, 1, 128, 16
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32)
+               for kk in ks)
+    kv_mask = jnp.stack([jnp.ones((T,), bool), jnp.zeros((T,), bool)])
+    out = flash_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_array_equal(np.asarray(out[1]),
+                                  np.zeros_like(np.asarray(out[1])))
+    g = jax.grad(lambda t: jnp.sum(
+        flash_attention(*t, kv_mask=kv_mask) ** 2))((q, k, v))
+    for arr in g:
+        assert np.all(np.isfinite(np.asarray(arr)))
+        np.testing.assert_array_equal(np.asarray(arr[1]),
+                                      np.zeros_like(np.asarray(arr[1])))
+
+
+def test_dot_product_attention_kv_mask_dispatches_to_flash(monkeypatch):
+    """A (B, 1, 1, Tk) padding mask must stay on the flash path and agree
+    with the dense path."""
+    from apex_tpu.transformer import dot_product_attention
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = (jax.random.normal(kk, (B, H, T, D)) for kk in ks)
+    kv_mask = (jnp.arange(T)[None, :] < jnp.array([T, T - 11])[:, None])
+    mask4 = kv_mask[:, None, None, :]
+
+    ref = dot_product_attention(q, k, v, mask4, causal=True)  # jnp path
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+    called = {}
+    import apex_tpu.ops.pallas_flash_attention as pfa
+    orig = pfa.flash_attention
+
+    def spy(*a, **kw):
+        called["kv_mask"] = kw.get("kv_mask")
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pfa, "flash_attention", spy)
+    out = dot_product_attention(q, k, v, mask4, causal=True)
+    assert called.get("kv_mask") is not None, "flash path not taken"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
