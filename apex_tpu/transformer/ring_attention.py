@@ -30,7 +30,8 @@ __all__ = ["ring_attention", "ring_self_attention"]
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
-                   remat: bool = True) -> jax.Array:
+                   remat: bool = True,
+                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """q, k, v: (B, H, T_local, D) per-device slices; returns the exact
     attention output for the local queries against the *global* sequence.
 
@@ -41,7 +42,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     it, only the linear-memory carries (the rotating K/V blocks and the
     online-softmax state) are saved and scores are recomputed in the
     backward, flash-attention style.  The ppermutes stay outside the
-    checkpoint so the backward re-runs matmuls, not communication."""
+    checkpoint so the backward re-runs matmuls, not communication.
+
+    ``kv_mask``: optional (B, T_local) bool key-validity slice, sharded
+    over the sequence axis like k; the mask block rotates around the
+    ring alongside its K/V block.  Queries whose keys are ALL masked
+    produce zero output rows."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     n = lax.psum(1, axis_name)
@@ -56,10 +62,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
 
     q_pos = my * Tq + jnp.arange(Tq)
+    # has_mask is a trace-time constant: the unmasked path carries no
+    # validity block — no third ppermute per step, no extra where over
+    # the (B, H, Tq, Tk) scores
+    has_mask = kv_mask is not None
 
-    def block(q32, k_blk, v_blk, m, l, acc, src):
+    def block(q32, k_blk, v_blk, kvm_blk, m, l, acc, src):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
                             k_blk.astype(jnp.float32))
+        if kvm_blk is not None:
+            scores = jnp.where(kvm_blk[:, None, None, :], scores, -jnp.inf)
         if causal:
             kv_pos = src * Tk + jnp.arange(Tk)
             mask = q_pos[:, None] >= kv_pos[None, :]
@@ -84,16 +96,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         block = jax.checkpoint(block, prevent_cse=False)
 
     def body(i, carry):
-        k_blk, v_blk, m, l, acc = carry
+        if has_mask:
+            k_blk, v_blk, kvm_blk, m, l, acc = carry
+        else:
+            k_blk, v_blk, m, l, acc = carry
+            kvm_blk = None
         src = (my - i) % n  # whose kv block we hold at step i
-        m, l, acc = block(q32, k_blk, v_blk, m, l, acc, src)
-        # rotate kv to the next ring neighbor over ICI
+        m, l, acc = block(q32, k_blk, v_blk, kvm_blk, m, l, acc, src)
+        # rotate kv (and its validity block) to the next ring neighbor
         nxt = [(j, (j + 1) % n) for j in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, nxt)
         v_blk = lax.ppermute(v_blk, axis_name, nxt)
+        if has_mask:
+            kvm_blk = lax.ppermute(kvm_blk, axis_name, nxt)
+            return k_blk, v_blk, kvm_blk, m, l, acc
         return k_blk, v_blk, m, l, acc
 
-    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    carry0 = ((k, v, kv_mask.astype(jnp.bool_), m0, l0, acc0) if has_mask
+              else (k, v, m0, l0, acc0))
+    out_carry = lax.fori_loop(0, n, body, carry0)
+    m, l, acc = out_carry[-3], out_carry[-2], out_carry[-1]
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

@@ -47,11 +47,21 @@ def _head_to_seq_sharded(x: jax.Array, axis_name: str) -> jax.Array:
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = False,
-                      scale: Optional[float] = None) -> jax.Array:
+                      scale: Optional[float] = None,
+                      kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """q, k, v: (B, H, T_local, D) per-device sequence-sharded slices;
     returns the exact attention output for the local queries against the
     global sequence, identical (up to fp reassociation) to
-    ``ring_attention`` on the same operands."""
+    ``ring_attention`` on the same operands — for every query that has at
+    least one valid key.  (Degenerate fully-masked rows differ by
+    construction: ring and the flash kernel emit zeros, while the dense
+    softmax fallback degrades to a uniform average over the keys.)
+
+    ``kv_mask``: optional (B, T_local) bool key-validity slice, sharded
+    over the sequence axis like k.  It is all_gathered to the global
+    (B, T) — a tiny collective next to the K/V all_to_alls — and rides
+    the flash kernel's streamed key-padding path on the head-sharded
+    attention."""
     n = lax.psum(1, axis_name)
     H = q.shape[1]
     if H % n != 0:
@@ -65,7 +75,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kh = _seq_to_head_sharded(k, axis_name)
     vh = _seq_to_head_sharded(v, axis_name)
 
-    out = dot_product_attention(qh, kh, vh, scale=scale, causal=causal)
+    mask4 = None
+    if kv_mask is not None:
+        gmask = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+        mask4 = gmask[:, None, None, :]
+
+    out = dot_product_attention(qh, kh, vh, mask4, scale=scale,
+                                causal=causal)
 
     return _head_to_seq_sharded(out, axis_name)
 

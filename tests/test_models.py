@@ -360,3 +360,39 @@ def test_conv_transpose_channels_last_matches_nchw():
                              data_format="NHWC")
     np.testing.assert_allclose(np.asarray(jnp.transpose(out, (0, 3, 1, 2))),
                                np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_attention_kv_mask_matches_dense(strategy):
+    """Sequence-parallel attention with a key-padding mask == dense masked
+    attention: the ring rotates the mask block with its K/V; Ulysses
+    all_gathers it onto the head-sharded attention."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.transformer import ring_attention, ulysses_attention
+    from apex_tpu.transformer.attention import dot_product_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    rng = np.random.RandomState(7)
+    B, H, T, D = 2, 4, 32, 8
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    lengths = jnp.array([T, T - 9])
+    kv_mask = jnp.arange(T)[None, :] < lengths[:, None]
+
+    fn = ring_attention if strategy == "ring" else ulysses_attention
+
+    for causal in (False, True):
+        def attn(q, k, v, m):
+            return fn(q, k, v, axis_name="sp", causal=causal, kv_mask=m)
+
+        sp = jax.jit(jax.shard_map(
+            attn, mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False))
+        out = sp(q, k, v, kv_mask)
+
+        mask4 = kv_mask[:, None, None, :]
+        ref = dot_product_attention(q, k, v, mask4, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5)
