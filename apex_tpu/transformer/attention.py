@@ -103,11 +103,19 @@ class MultiheadAttention(Module):
         self.out = Linear(embed_dim, embed_dim, bias=bias)
         self.drop = Dropout(dropout)
 
-    def forward(self, params, x, mask: Optional[jax.Array] = None):
+    def forward(self, params, x, mask: Optional[jax.Array] = None,
+                key_padding_mask: Optional[jax.Array] = None):
+        """``key_padding_mask``: (B, T) bool, True = IGNORE that key —
+        torch.nn.MultiheadAttention's convention.  Internally inverted to
+        key-validity and routed as a (B, 1, 1, T) mask, which the flash
+        dispatch streams through the kernel."""
         B, T, E = x.shape
         qkv = self.qkv(params["qkv"], x)
         qkv = qkv.reshape(B, T, 3, self.num_heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        if key_padding_mask is not None:
+            kp = jnp.logical_not(key_padding_mask)[:, None, None, :]
+            mask = kp if mask is None else jnp.logical_and(mask, kp)
         ctx = dot_product_attention(q, k, v, mask)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         ctx = self.drop(params.get("drop", {}), ctx)

@@ -122,14 +122,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_self_attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
                         num_heads: int, axis_name: str = "sp",
-                        causal: bool = False) -> jax.Array:
+                        causal: bool = False,
+                        kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """Convenience fused qkv-projection + ring attention + output proj for
-    (B, T_local, E) sequence-sharded activations."""
+    (B, T_local, E) sequence-sharded activations.  ``kv_mask``:
+    (B, T_local) key-validity slice, as in :func:`ring_attention`."""
     B, T, E = x.shape
     hd = E // num_heads
     qkv = jnp.einsum("bte,fe->btf", x, wqkv)
     qkv = qkv.reshape(B, T, 3, num_heads, hd)
     q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
-    ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    ctx = ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                         kv_mask=kv_mask)
     ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
     return jnp.einsum("bte,fe->btf", ctx, wo)

@@ -154,3 +154,27 @@ def test_causal_with_padding_mask_keeps_causality():
     # and it must differ from the padding-only result (proves the AND)
     wrong = dot_product_attention(q, k, v, mask=pad)
     assert not np.allclose(np.asarray(out), np.asarray(wrong))
+
+
+def test_multihead_key_padding_mask():
+    """torch convention: True = ignore.  Must equal an explicit validity
+    mask, and masked key positions must not influence valid outputs."""
+    from apex_tpu.transformer import MultiheadAttention
+    from apex_tpu import nn
+    mha = MultiheadAttention(16, 2)
+    params, _ = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 12, 16))
+    kpm = jnp.zeros((2, 12), bool).at[1, 8:].set(True)   # ignore tail
+
+    out, _ = nn.apply(mha, params, x, key_padding_mask=kpm)
+    valid4 = jnp.logical_not(kpm)[:, None, None, :]
+    ref, _ = nn.apply(mha, params, x, mask=valid4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # perturbing an ignored key's row must not change any output
+    x2 = x.at[1, 10].add(100.0)
+    out2, _ = nn.apply(mha, params, x2, key_padding_mask=kpm)
+    # row 10 of batch 1 is itself a query, so compare only other rows
+    np.testing.assert_allclose(np.asarray(out2[1, :8]),
+                               np.asarray(out[1, :8]),
+                               rtol=1e-5, atol=1e-5)
