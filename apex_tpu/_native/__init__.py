@@ -72,12 +72,17 @@ def _try_load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float)]
+    try:
+        lib.apex_preprocess_nhwc_u8_to_nhwc_f32.argtypes = \
+            lib.apex_preprocess_nhwc_u8_to_nchw_f32.argtypes
+    except AttributeError:
+        pass    # stale v2 .so; version() gates the NHWC paths below
     lib.apex_native_version.restype = ctypes.c_int
     lib.apex_loader_create.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
         ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
     lib.apex_loader_create.restype = ctypes.c_void_p
     lib.apex_loader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
@@ -156,20 +161,32 @@ def plan_buckets(sizes: Sequence[int], message_size: int) -> np.ndarray:
     return ids
 
 
+def version() -> int:
+    """ABI version of the loaded native lib (0 when unavailable)."""
+    lib = _try_load()
+    return int(lib.apex_native_version()) if lib is not None else 0
+
+
 def preprocess_images(images_u8: np.ndarray, mean: Sequence[float],
-                      std: Sequence[float]) -> np.ndarray:
-    """NHWC uint8 -> normalized NCHW float32 on host threads."""
+                      std: Sequence[float],
+                      data_format: str = "NCHW") -> np.ndarray:
+    """NHWC uint8 -> normalized float32 on host threads, delivered NCHW
+    (default) or NHWC (no transpose)."""
     images_u8 = np.ascontiguousarray(images_u8)
     n, h, w, c = images_u8.shape
+    nhwc_out = data_format == "NHWC"
     lib = _try_load()
-    if lib is None:
+    # the NHWC entry point needs ABI v3 — a stale v2 .so falls back
+    if lib is None or (nhwc_out and version() < 3):
         f = images_u8.astype(np.float32)
         f = (f - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
-        return np.ascontiguousarray(f.transpose(0, 3, 1, 2))
-    out = np.empty((n, c, h, w), np.float32)
+        return np.ascontiguousarray(f if nhwc_out
+                                    else f.transpose(0, 3, 1, 2))
+    out = np.empty((n, h, w, c) if nhwc_out else (n, c, h, w), np.float32)
     mean_c = (ctypes.c_float * c)(*[float(m) for m in mean])
     std_c = (ctypes.c_float * c)(*[float(s) for s in std])
-    lib.apex_preprocess_nhwc_u8_to_nchw_f32(
-        images_u8.ctypes.data_as(ctypes.c_void_p),
-        out.ctypes.data_as(ctypes.c_void_p), n, h, w, c, mean_c, std_c)
+    fn = (lib.apex_preprocess_nhwc_u8_to_nhwc_f32 if nhwc_out
+          else lib.apex_preprocess_nhwc_u8_to_nchw_f32)
+    fn(images_u8.ctypes.data_as(ctypes.c_void_p),
+       out.ctypes.data_as(ctypes.c_void_p), n, h, w, c, mean_c, std_c)
     return out

@@ -114,6 +114,21 @@ inline void NormalizeImage(const uint8_t* src, float* dst, int64_t h,
   }
 }
 
+// channels-last variant: normalize in place order (no transpose) — a
+// straight sequential walk, feeding channels-last models without the
+// NHWC->NCHW->NHWC round trip.
+inline void NormalizeImageNHWC(const uint8_t* src, float* dst, int64_t h,
+                               int64_t w, int64_t c, const float* mean,
+                               const float* inv_std) {
+  for (int64_t p = 0; p < h * w; ++p) {
+    const uint8_t* sp = src + p * c;
+    float* dp = dst + p * c;
+    for (int64_t k = 0; k < c; ++k) {
+      dp[k] = (static_cast<float>(sp[k]) - mean[k]) * inv_std[k];
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -195,7 +210,26 @@ void apex_preprocess_nhwc_u8_to_nchw_f32(const uint8_t* in, float* out,
   pool.Wait();
 }
 
-int apex_native_version() { return 2; }
+// channels-last variant: same threaded normalize, no transpose
+void apex_preprocess_nhwc_u8_to_nhwc_f32(const uint8_t* in, float* out,
+                                         int64_t n, int64_t h, int64_t w,
+                                         int64_t c, const float* mean,
+                                         const float* std) {
+  auto& pool = ThreadPool::Get();
+  std::vector<float> inv_std(c);
+  for (int64_t k = 0; k < c; ++k) inv_std[k] = 1.0f / std[k];
+  const float* inv = inv_std.data();
+  for (int64_t img = 0; img < n; ++img) {
+    const uint8_t* src = in + img * h * w * c;
+    float* dst = out + img * h * w * c;
+    pool.Submit([src, dst, h, w, c, mean, inv] {
+      NormalizeImageNHWC(src, dst, h, w, c, mean, inv);
+    });
+  }
+  pool.Wait();
+}
+
+int apex_native_version() { return 3; }
 
 }  // extern "C"
 
@@ -230,6 +264,7 @@ struct Loader {
   const int32_t* labels;  // (n,)
   int64_t n, h, w, c, batch;
   std::vector<float> mean, inv_std;
+  bool channels_last = false;   // deliver (B, H, W, C) instead of NCHW
   bool shuffle;
   uint64_t seed;
   int64_t batches_per_epoch;
@@ -301,9 +336,13 @@ struct Loader {
     BatchIndices(b, idx);
     for (int64_t j = 0; j < batch; ++j) {
       int64_t src_idx = idx[j];
-      NormalizeImage(images + src_idx * h * w * c,
-                     dst_base + j * c * h * w, h, w, c, mean.data(),
-                     inv_std.data());
+      const uint8_t* src = images + src_idx * h * w * c;
+      float* dst = dst_base + j * c * h * w;
+      if (channels_last) {
+        NormalizeImageNHWC(src, dst, h, w, c, mean.data(), inv_std.data());
+      } else {
+        NormalizeImage(src, dst, h, w, c, mean.data(), inv_std.data());
+      }
       s.labels[j] = labels[src_idx];
     }
   }
@@ -346,13 +385,15 @@ void* apex_loader_create(const uint8_t* images, const int32_t* labels,
                          int64_t n, int64_t h, int64_t w, int64_t c,
                          int64_t batch, int depth, int num_workers,
                          uint64_t seed, const float* mean,
-                         const float* stddev, int shuffle) {
+                         const float* stddev, int shuffle,
+                         int channels_last) {
   if (n < batch || batch <= 0 || depth <= 0 || num_workers <= 0)
     return nullptr;
   auto* L = new Loader();
   L->images = images;
   L->labels = labels;
   L->n = n; L->h = h; L->w = w; L->c = c; L->batch = batch;
+  L->channels_last = channels_last != 0;
   L->shuffle = shuffle != 0;
   L->seed = seed;
   L->batches_per_epoch = n / batch;  // drop-last

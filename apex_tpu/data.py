@@ -53,7 +53,16 @@ class DataLoader:
                  mean: Sequence[float] = IMAGENET_MEAN,
                  std: Sequence[float] = IMAGENET_STD,
                  prefetch: int = 3, workers: int = 4, seed: int = 0,
-                 native: Optional[bool] = None, zero_copy: bool = False):
+                 native: Optional[bool] = None, zero_copy: bool = False,
+                 data_format: str = "NCHW"):
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, "
+                             f"got {data_format!r}")
+        # NHWC delivery skips the transpose entirely (a straight
+        # sequential normalize walk) — pair with channels_last models so
+        # the loader doesn't transpose to NCHW only for the model to
+        # transpose back
+        self.data_format = data_format
         self.zero_copy = zero_copy
         if np.asarray(images).dtype != np.uint8:
             raise TypeError(
@@ -80,6 +89,11 @@ class DataLoader:
         self._handle = None
         self._held: Optional[ctypes.c_void_p] = None
         use_native = _native.available() if native is None else native
+        if use_native and data_format == "NHWC" and _native.version() < 3:
+            # stale v2 .so has the 13-arg create: it would silently fill
+            # NCHW slots that we'd reshape as NHWC — scrambled pixels.
+            # The numpy fallback is correct, just slower.
+            use_native = False
         if use_native:
             lib = _native._try_load()
             if lib is not None:
@@ -93,7 +107,8 @@ class DataLoader:
                         ctypes.POINTER(ctypes.c_float)),
                     self.std.ctypes.data_as(
                         ctypes.POINTER(ctypes.c_float)),
-                    1 if shuffle else 0)
+                    1 if shuffle else 0,
+                    1 if data_format == "NHWC" else 0)
         # python fallback state
         self._py_batch = 0
         self._py_rng = np.random.RandomState(seed)
@@ -118,7 +133,9 @@ class DataLoader:
             # filled — stop cleanly instead of dereferencing NULL
             raise StopIteration("data loader shut down")
         self._held = img_p
-        shape = (self.batch_size, self.c, self.h, self.w)
+        shape = ((self.batch_size, self.h, self.w, self.c)
+                 if self.data_format == "NHWC"
+                 else (self.batch_size, self.c, self.h, self.w))
         imgs = np.ctypeslib.as_array(
             ctypes.cast(img_p, ctypes.POINTER(ctypes.c_float)),
             shape=shape)
@@ -149,7 +166,7 @@ class DataLoader:
         else:
             idx = np.arange(i * self.batch_size, (i + 1) * self.batch_size)
         imgs = _native.preprocess_images(self.images[idx], self.mean,
-                                         self.std)
+                                         self.std, self.data_format)
         return imgs, self.labels[idx], b
 
     # -- iteration ---------------------------------------------------------

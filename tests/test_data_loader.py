@@ -145,3 +145,30 @@ def test_validation_errors():
         DataLoader(images, labels[:10], batch_size=8)
     with pytest.raises(ValueError):
         DataLoader(images, labels, batch_size=8, mean=(1.0,), std=(1.0,))
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_loader_nhwc_delivery_matches_nchw(native):
+    """data_format='NHWC' must deliver the same normalized pixels as the
+    NCHW default, transposed — native path and python fallback."""
+    if native and not _native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (12, 6, 5, 3), dtype=np.uint8)
+    labels = np.arange(12, dtype=np.int64)
+    kw = dict(batch_size=4, shuffle=False, prefetch=2, workers=2,
+              native=native)
+    a = DataLoader(images, labels, **kw)
+    b = DataLoader(images, labels, data_format="NHWC", **kw)
+    try:
+        for _ in range(3):
+            ia, la, _ = a.next_batch()
+            ib, lb, _ = b.next_batch()
+            assert ia.shape == (4, 3, 6, 5)
+            assert ib.shape == (4, 6, 5, 3)
+            np.testing.assert_allclose(ib.transpose(0, 3, 1, 2), ia,
+                                       rtol=1e-6, atol=1e-6)
+            np.testing.assert_array_equal(la, lb)
+    finally:
+        a.close()
+        b.close()
