@@ -11,7 +11,9 @@ API:
   flatten(list[np.ndarray]) -> np.ndarray           (apex_C.flatten)
   unflatten(flat, like) -> list[np.ndarray]         (apex_C.unflatten)
   plan_buckets(sizes, message_size) -> np.ndarray   (DDP bucket planner)
-  preprocess_images(u8_nhwc, mean, std) -> f32 nchw (input pipeline)
+  preprocess_images(u8_nhwc, mean, std, data_format="NCHW"|"NHWC")
+      -> normalized f32, transposed to NCHW or delivered NHWC in place
+      order (input pipeline)
 """
 
 from __future__ import annotations

@@ -192,22 +192,33 @@ int apex_plan_buckets(const int64_t* sizes, int n, int64_t message_size,
 // example's data_prefetcher (examples/imagenet/main_amp.py:264-300), which
 // on GPU ran on a side CUDA stream; on TPU it runs on host threads
 // overlapped with device compute.
-void apex_preprocess_nhwc_u8_to_nchw_f32(const uint8_t* in, float* out,
-                                         int64_t n, int64_t h, int64_t w,
-                                         int64_t c, const float* mean,
-                                         const float* std) {
+static void PreprocessBatch(const uint8_t* in, float* out, int64_t n,
+                            int64_t h, int64_t w, int64_t c,
+                            const float* mean, const float* std,
+                            bool channels_last) {
   auto& pool = ThreadPool::Get();
   std::vector<float> inv_std(c);
   for (int64_t k = 0; k < c; ++k) inv_std[k] = 1.0f / std[k];
   const float* inv = inv_std.data();
   for (int64_t img = 0; img < n; ++img) {
     const uint8_t* src = in + img * h * w * c;
-    float* dst = out + img * c * h * w;
-    pool.Submit([src, dst, h, w, c, mean, inv] {
-      NormalizeImage(src, dst, h, w, c, mean, inv);
+    float* dst = out + img * h * w * c;   // same element count per image
+    pool.Submit([src, dst, h, w, c, mean, inv, channels_last] {
+      if (channels_last) {
+        NormalizeImageNHWC(src, dst, h, w, c, mean, inv);
+      } else {
+        NormalizeImage(src, dst, h, w, c, mean, inv);
+      }
     });
   }
   pool.Wait();
+}
+
+void apex_preprocess_nhwc_u8_to_nchw_f32(const uint8_t* in, float* out,
+                                         int64_t n, int64_t h, int64_t w,
+                                         int64_t c, const float* mean,
+                                         const float* std) {
+  PreprocessBatch(in, out, n, h, w, c, mean, std, /*channels_last=*/false);
 }
 
 // channels-last variant: same threaded normalize, no transpose
@@ -215,18 +226,7 @@ void apex_preprocess_nhwc_u8_to_nhwc_f32(const uint8_t* in, float* out,
                                          int64_t n, int64_t h, int64_t w,
                                          int64_t c, const float* mean,
                                          const float* std) {
-  auto& pool = ThreadPool::Get();
-  std::vector<float> inv_std(c);
-  for (int64_t k = 0; k < c; ++k) inv_std[k] = 1.0f / std[k];
-  const float* inv = inv_std.data();
-  for (int64_t img = 0; img < n; ++img) {
-    const uint8_t* src = in + img * h * w * c;
-    float* dst = out + img * h * w * c;
-    pool.Submit([src, dst, h, w, c, mean, inv] {
-      NormalizeImageNHWC(src, dst, h, w, c, mean, inv);
-    });
-  }
-  pool.Wait();
+  PreprocessBatch(in, out, n, h, w, c, mean, std, /*channels_last=*/true);
 }
 
 int apex_native_version() { return 3; }

@@ -91,13 +91,26 @@ class ResNet(nn.Module):
     torch's NCHW (transposed once at entry) and the param tree (OIHW
     conv weights, (C,) batch-norm params) is identical in both modes, so
     checkpoints, amp casting, and optimizer state are layout-agnostic.
+
+    ``input_format="NHWC"`` (requires ``channels_last=True``) declares
+    that callers feed NHWC batches — e.g. a
+    ``DataLoader(data_format="NHWC")`` — so even the entry transpose
+    disappears and the pipeline is transpose-free end to end.
     """
 
     def __init__(self, block: Type, layers: List[int],
-                 num_classes: int = 1000, channels_last: bool = False):
+                 num_classes: int = 1000, channels_last: bool = False,
+                 input_format: str = "NCHW"):
         super().__init__()
+        if input_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"input_format must be NCHW or NHWC, "
+                             f"got {input_format!r}")
+        if input_format == "NHWC" and not channels_last:
+            raise ValueError("input_format='NHWC' requires "
+                             "channels_last=True")
         self.inplanes = 64
         self.channels_last = channels_last
+        self.input_format = input_format
         df = self.data_format = "NHWC" if channels_last else "NCHW"
         self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False,
                                data_format=df)
@@ -126,7 +139,7 @@ class ResNet(nn.Module):
         return nn.Sequential(layers)
 
     def forward(self, p, x):
-        if self.channels_last:
+        if self.channels_last and self.input_format == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))
         x = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
         x = self.maxpool({}, x)
@@ -139,21 +152,26 @@ class ResNet(nn.Module):
         return self.fc(p["fc"], x)
 
 
-def resnet18(num_classes=1000, channels_last=False):
-    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, channels_last)
+def resnet18(num_classes=1000, channels_last=False, input_format="NCHW"):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, channels_last,
+                  input_format)
 
 
-def resnet34(num_classes=1000, channels_last=False):
-    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, channels_last)
+def resnet34(num_classes=1000, channels_last=False, input_format="NCHW"):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, channels_last,
+                  input_format)
 
 
-def resnet50(num_classes=1000, channels_last=False):
-    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, channels_last)
+def resnet50(num_classes=1000, channels_last=False, input_format="NCHW"):
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, channels_last,
+                  input_format)
 
 
-def resnet101(num_classes=1000, channels_last=False):
-    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, channels_last)
+def resnet101(num_classes=1000, channels_last=False, input_format="NCHW"):
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, channels_last,
+                  input_format)
 
 
-def resnet152(num_classes=1000, channels_last=False):
-    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes, channels_last)
+def resnet152(num_classes=1000, channels_last=False, input_format="NCHW"):
+    return ResNet(Bottleneck, [3, 8, 36, 3], num_classes, channels_last,
+                  input_format)

@@ -82,7 +82,12 @@ def main():
     ndev = len(jax.devices())
     print(f"=> {ndev} device(s) on backend {jax.default_backend()}")
     print(f"=> creating model '{args.arch}'")
-    model = getattr(models, args.arch)(channels_last=args.channels_last)
+    # with --channels-last the whole pipeline is NHWC end to end: the
+    # loader delivers NHWC (no host transpose), the model consumes it
+    # directly (input_format), and every internal activation stays NHWC
+    fmt = "NHWC" if args.channels_last else "NCHW"
+    model = getattr(models, args.arch)(channels_last=args.channels_last,
+                                       input_format=fmt)
     if args.sync_bn:
         print("using apex_tpu synced BN")
         model = parallel.convert_syncbn_model(model)
@@ -118,7 +123,7 @@ def main():
             from apex_tpu.data import DataLoader
             loader = DataLoader(blob["images"], blob["labels"],
                                 batch_size=global_batch, shuffle=True,
-                                seed=args.seed)
+                                seed=args.seed, data_format=fmt)
             print(f"=> native data loader: {loader.native} "
                   f"({loader.batches_per_epoch} batches/epoch)")
             args.iters = min(args.iters, loader.batches_per_epoch)
@@ -127,7 +132,17 @@ def main():
                 imgs, lbls, _ = loader.next_batch()
                 return imgs, lbls
         else:
+            # float blobs are NCHW by contract (uint8 blobs are NHWC);
+            # no layout sniffing — transpose exactly when the model
+            # consumes NHWC
             images_all = blob["images"].astype(np.float32)
+            if images_all.shape[1] != 3:
+                raise SystemExit(
+                    f"float image blobs must be NCHW with C=3, got "
+                    f"shape {images_all.shape}")
+            if fmt == "NHWC":
+                images_all = np.ascontiguousarray(
+                    images_all.transpose(0, 2, 3, 1))
             labels_all = blob["labels"].astype(np.int32)
             n_batches = len(images_all) // global_batch
             args.iters = min(args.iters, n_batches)
@@ -137,9 +152,10 @@ def main():
                 return (images_all[s:s + global_batch],
                         labels_all[s:s + global_batch])
     else:
-        images_all = rng.randn(
-            global_batch, 3, args.image_size, args.image_size
-        ).astype(np.float32)
+        shape = ((global_batch, args.image_size, args.image_size, 3)
+                 if fmt == "NHWC"
+                 else (global_batch, 3, args.image_size, args.image_size))
+        images_all = rng.randn(*shape).astype(np.float32)
         labels_all = rng.randint(0, 1000, global_batch).astype(np.int32)
 
         def get_batch(i):

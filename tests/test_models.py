@@ -396,3 +396,20 @@ def test_sp_attention_kv_mask_matches_dense(strategy):
         ref = dot_product_attention(q, k, v, mask4, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5)
+
+
+def test_resnet_nhwc_input_format():
+    """input_format='NHWC' accepts NHWC batches directly and matches the
+    NCHW-input channels-last model on the same params."""
+    m_in_nchw = resnet18(num_classes=10, channels_last=True)
+    m_in_nhwc = resnet18(num_classes=10, channels_last=True,
+                         input_format="NHWC")
+    params, state = m_in_nchw.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32))
+    out1, _ = nn.apply(m_in_nchw, params, x, state=state, train=True)
+    out2, _ = nn.apply(m_in_nhwc, params, jnp.transpose(x, (0, 2, 3, 1)),
+                       state=state, train=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="requires channels_last"):
+        resnet18(input_format="NHWC")
