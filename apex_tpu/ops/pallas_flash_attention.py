@@ -36,6 +36,8 @@ import functools
 import math
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -62,6 +64,35 @@ def _dot(a, b, contract):
                            precision=prec)
 
 
+def _keep_unit(seed0, seed1, bh, qpos, kpos):
+    """Deterministic per-(batch·head, q-pos, k-pos) uniform in [0, 1).
+
+    Counter-based murmur3-finalizer hash over plain int32 ops (multiply
+    wraps two's-complement, xor, logical shifts) — the same code runs
+    inside the Pallas kernels, under interpret mode, and as the dense
+    test reference, so dropout masks are bitwise-identical across the
+    forward, both backward passes, and the reference implementation.
+    ``seed0``/``seed1`` carry 64 bits of seed (two int32 words — one
+    word would collide by birthday bound across ~1e6 layer·step draws);
+    ``bh`` scalar; ``qpos``/``kpos`` broadcastable int32 position
+    arrays."""
+    # numpy scalar constants inline as jaxpr literals — jnp constants
+    # would become constvars, which pallas_call cannot lower
+    h = (qpos * np.int32(-1640531527)                      # 2654435761
+         ^ kpos * np.int32(-2048144777)                    # 2246822519
+         ^ bh * np.int32(-1028477379)                      # 3266489917
+         ^ seed0)
+    h = h ^ lax.shift_right_logical(h, np.int32(16))
+    h = h * np.int32(-2048144789)
+    h = h ^ seed1
+    h = h ^ lax.shift_right_logical(h, np.int32(16))
+    h = h * np.int32(-1028477387)
+    h = h ^ lax.shift_right_logical(h, np.int32(16))
+    # 31 uniform bits -> [0, 1)
+    bits = jnp.bitwise_and(h, np.int32(0x7FFFFFFF))
+    return bits.astype(jnp.float32) * np.float32(1.0 / 2147483648.0)
+
+
 def _block_for(T: int) -> int:
     """Largest block in {512, 256, 128} that divides the lane-padded
     length — bounds zero-padding at 127 rows (a fixed 512 block would pad
@@ -73,19 +104,22 @@ def _block_for(T: int) -> int:
     return LANES
 
 
-def fits_vmem(T: int, D: int) -> bool:
+def fits_vmem(T: int, D: int, dropout: bool = False) -> bool:
     """VMEM needed per grid step — independent of T now that K/V stream
     through the grid.  Sized for the worst pass (backward dK/dV): six
     double-buffered operand blocks (q, k, v, do in; dk, dv out), two fp32
     accumulator scratches, the lane-broadcast stats tiles, and the
-    (blk, blk) score/prob/dp/ds intermediates."""
+    (blk, blk) score/prob/dp/ds intermediates.  Dropout holds two more
+    live (blk, blk) tiles in the dk/dv pass (the hash tile u and p_acc
+    alongside p/dp/ds)."""
     blk = _block_for(T)
     Dp = -(-D // LANES) * LANES
     operands = 6 * blk * Dp          # q, k, v, do, dk, dv blocks
     stats = 2 * blk * LANES          # lse + delta tiles
     resident = 2 * (operands + stats) * 4          # double-buffered
     scratch = 2 * blk * Dp * 4                     # dk/dv fp32 accumulators
-    score = 3 * blk * blk * 4                      # s/p + dp + ds tiles
+    ntiles = 6 if dropout else 4     # s/p, dp, ds (+ u, p_acc)
+    score = ntiles * blk * blk * 4
     return resident + scratch + score <= _VMEM_BUDGET
 
 
@@ -108,13 +142,15 @@ def _lanes(vec, Tp):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
-    if has_mask:
-        (q_ref, k_ref, v_ref, kvm_ref,
-         o_ref, lse_ref, m_ref, l_ref, acc_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
-        kvm_ref = None
+def _fwd_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
+                nk):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    kvm_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate else None
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -136,8 +172,8 @@ def _fwd_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
         s = _dot(q, k, ((1,), (1,))) * scale
         kpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = kpos < T_real
+        qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         if causal:
-            qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
         if has_mask:
             # (1, blk) key-validity row, sublane-broadcast tile layout:
@@ -151,8 +187,18 @@ def _fwd_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
         # explicit zeroing: when a row is fully masked m_new == _NEG and
         # exp(s - m_new) would be exp(0) = 1 on the masked entries
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        # the softmax normalizer uses the UNdropped probabilities; only
+        # the value accumulation is dropped+rescaled (FlashAttention's
+        # dropout placement — the mask is regenerated bitwise in both
+        # backward passes from the same counter hash)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = _dot(p.astype(v.dtype), v, ((1,), (0,)))
+        if dropout_rate:
+            u = _keep_unit(seed_ref[0, 0], seed_ref[0, 1], b, qpos, kpos)
+            p_acc = jnp.where(u >= dropout_rate, p, 0.0) * (
+                1.0 / (1.0 - dropout_rate))
+        else:
+            p_acc = p
+        pv = _dot(p_acc.astype(v.dtype), v, ((1,), (0,)))
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -166,9 +212,11 @@ def _fwd_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
                                                            lse_ref.shape[1:]))
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "causal", "H"))
-def _fwd(q, k, v, kvm, scale, causal, H):
-    """kvm: (B, 8, Tp) fp32 key-validity (sublane-broadcast) or None."""
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "H",
+                                             "dropout_rate"))
+def _fwd(q, k, v, kvm, seed, scale, causal, H, dropout_rate):
+    """kvm: (B, 8, Tp) fp32 key-validity (sublane-broadcast) or None.
+    seed: (1, 1) int32 dropout counter seed or None."""
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -186,9 +234,13 @@ def _fwd(q, k, v, kvm, scale, causal, H):
         in_specs.append(pl.BlockSpec((1, 8, blk),
                                      lambda b, i, j: (b // H, 0, j)))
         operands.append(kvm)
+    if dropout_rate:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, T_real=T, blk=blk, nk=nk),
+                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          T_real=T, blk=blk, nk=nk),
         grid=grid,
         in_specs=in_specs,
         out_specs=[row, stat],
@@ -208,14 +260,15 @@ def _fwd(q, k, v, kvm, scale, causal, H):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
-    if has_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc) = refs
-        kvm_ref = None
+def _dq_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
+               nk):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    del refs[:6]
+    kvm_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate else None
+    dq_ref, dq_acc = refs
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -236,13 +289,19 @@ def _dq_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
         s = _dot(q, k, ((1,), (1,))) * scale
         kpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = kpos < T_real
+        qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         if causal:
-            qpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
         if has_mask:
             valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = _dot(do, v, ((1,), (1,)))
+        if dropout_rate:
+            # dS = P ∘ (M ∘ (dO Vᵀ)/keep − delta): same counter hash as
+            # the forward, so the mask is bitwise-identical
+            u = _keep_unit(seed_ref[0, 0], seed_ref[0, 1], b, qpos, kpos)
+            dp = jnp.where(u >= dropout_rate, dp, 0.0) * (
+                1.0 / (1.0 - dropout_rate))
         ds = (p * (dp - delta)).astype(k.dtype)
         dq_acc[...] += _dot(ds, k, ((1,), (0,))) * scale
 
@@ -251,14 +310,15 @@ def _dq_kernel(*refs, scale, causal, has_mask, T_real, blk, nk):
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, causal, has_mask, T_real, blk, nq):
-    if has_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kvm_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        kvm_ref = None
+def _dkv_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
+                nq):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    del refs[:6]
+    kvm_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate else None
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
+    b = pl.program_id(0)
     i = pl.program_id(1)          # k block
     j = pl.program_id(2)          # q block (streamed)
 
@@ -281,15 +341,25 @@ def _dkv_kernel(*refs, scale, causal, has_mask, T_real, blk, nq):
         s = _dot(q, k, ((1,), (1,))) * scale
         kpos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = kpos < T_real
+        qpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         if causal:
-            qpos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = jnp.logical_and(valid, qpos >= kpos)
         if has_mask:
             valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
         # padded q rows contribute nothing: their do rows are zero
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # (bq, bk)
-        dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v, ((1,), (1,)))
+        if dropout_rate:
+            # absolute (qpos, kpos) arguments match the fwd/dq passes
+            # exactly, so the regenerated mask is bitwise-identical
+            u = _keep_unit(seed_ref[0, 0], seed_ref[0, 1], b, qpos, kpos)
+            keep = u >= dropout_rate
+            inv_keep = 1.0 / (1.0 - dropout_rate)
+            p_acc = jnp.where(keep, p, 0.0) * inv_keep
+            dp = jnp.where(keep, dp, 0.0) * inv_keep
+        else:
+            p_acc = p
+        dv_acc[...] += _dot(p_acc.astype(do.dtype), do, ((0,), (0,)))
         ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc[...] += _dot(ds, q, ((0,), (0,))) * scale
 
@@ -299,8 +369,9 @@ def _dkv_kernel(*refs, scale, causal, has_mask, T_real, blk, nq):
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "causal", "H"))
-def _bwd(q, k, v, o, lse, do, kvm, scale, causal, H):
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "H",
+                                             "dropout_rate"))
+def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -323,15 +394,20 @@ def _bwd(q, k, v, o, lse, do, kvm, scale, causal, H):
     # dq pass, along the i (k-block) axis in the dk/dv pass
     kvmj = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, j))
     kvmi = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, i))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     dq_specs = [rowi, colj, colj, rowi, stati, stati]
     dq_ops = [qp, kp, vp, dop, lsep, deltap]
     if has_mask:
         dq_specs.append(kvmj)
         dq_ops.append(kvm)
+    if dropout_rate:
+        dq_specs.append(smem)
+        dq_ops.append(seed)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, T_real=T, blk=blk, nk=nk),
+                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          T_real=T, blk=blk, nk=nk),
         grid=(BH, nq, nk),
         in_specs=dq_specs,
         out_specs=rowi,
@@ -346,9 +422,13 @@ def _bwd(q, k, v, o, lse, do, kvm, scale, causal, H):
     if has_mask:
         dkv_specs.append(kvmi)
         dkv_ops.append(kvm)
+    if dropout_rate:
+        dkv_specs.append(smem)
+        dkv_ops.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, T_real=T, blk=blk, nq=nq),
+                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          T_real=T, blk=blk, nq=nq),
         grid=(BH, nk, nq),
         in_specs=dkv_specs,
         out_specs=[rowi, rowi],
@@ -366,23 +446,28 @@ def _bwd(q, k, v, o, lse, do, kvm, scale, causal, H):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q3, k3, v3, kvm, scale: float, causal: bool, H: int):
-    o, _ = _fwd(q3, k3, v3, kvm, scale, causal, H)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q3, k3, v3, kvm, seed, scale: float, causal: bool, H: int,
+           dropout_rate: float):
+    o, _ = _fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate)
     return o
 
 
-def _flash_fwd(q3, k3, v3, kvm, scale, causal, H):
-    o, lse = _fwd(q3, k3, v3, kvm, scale, causal, H)
-    return o, (q3, k3, v3, o, lse, kvm)
+def _flash_fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate):
+    o, lse = _fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate)
+    return o, (q3, k3, v3, o, lse, kvm, seed)
 
 
-def _flash_bwd(scale, causal, H, res, do):
-    q3, k3, v3, o, lse, kvm = res
-    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, kvm, scale, causal, H)
+def _flash_bwd(scale, causal, H, dropout_rate, res, do):
+    q3, k3, v3, o, lse, kvm, seed = res
+    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, kvm, seed, scale, causal, H,
+                      dropout_rate)
     dkvm = None if kvm is None else jnp.zeros_like(kvm)
+    # int primal -> float0 cotangent
+    dseed = (None if seed is None
+             else np.zeros(seed.shape, jax.dtypes.float0))
     return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
-            dkvm)
+            dkvm, dseed)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -391,7 +476,9 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
                     scale: Optional[float] = None,
-                    kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                    kv_mask: Optional[jax.Array] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed: Optional[jax.Array] = None) -> jax.Array:
     """softmax(q k^T * scale [+ causal mask]) v without materializing the
     score matrix in HBM.  q, k, v: (B, H, T, D) self-attention operands
     (equal sequence lengths).  K/V are streamed through VMEM in blocks,
@@ -402,11 +489,25 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     blocks as sublane-broadcast (B, 8, T) tiles (the upstream
     jax.experimental flash kernel's SegmentIds layout).  Composes with
     ``causal``.  Queries whose keys are ALL masked produce zero output
-    rows (the dense softmax would give a uniform average instead)."""
+    rows (the dense softmax would give a uniform average instead).
+
+    ``dropout_rate`` + ``dropout_seed`` (int32 scalar, e.g. drawn per
+    step from a PRNGKey): attention-probability dropout computed INSIDE
+    the kernel from a counter-based hash of the absolute positions —
+    no (T, T) mask materializes, and the backward passes regenerate the
+    identical mask from the same counters (FlashAttention's dropout
+    placement: the softmax normalizer is undropped, the value
+    accumulation is dropped and rescaled by 1/keep)."""
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, T, D), got {q.shape}")
     if q.shape != k.shape or k.shape != v.shape:
         raise ValueError("flash_attention requires matching q/k/v shapes")
+    dropout_rate = float(dropout_rate)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_rate and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
@@ -419,7 +520,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         Tp = -(-T // blk) * blk
         m = jnp.pad(kv_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))
         kvm = jax.lax.broadcast_in_dim(m, (B, 8, Tp), (0, 2))
+    seed = None
+    if dropout_rate:
+        s = jnp.asarray(dropout_seed, jnp.int32).reshape(-1)
+        if s.size == 1:
+            # single-word seeds get a derived second word (no extra
+            # entropy, but the kernel contract is two words)
+            s = jnp.stack([s[0], s[0] ^ np.int32(0x5555AAAA)])
+        elif s.size != 2:
+            raise ValueError("dropout_seed must be 1 or 2 int32 words, "
+                             f"got {s.size}")
+        seed = s.reshape(1, 2)
     fold = lambda x: x.reshape(B * H, T, D)
-    out = _flash(fold(q), fold(k), fold(v), kvm, float(scale),
-                 bool(causal), H)
+    out = _flash(fold(q), fold(k), fold(v), kvm, seed, float(scale),
+                 bool(causal), H, dropout_rate)
     return out.reshape(B, H, T, D)

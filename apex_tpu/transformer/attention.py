@@ -36,12 +36,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``dropout_rate`` applies attention-probability dropout in train mode
     (rng drawn from the active apply-context, like nn.Dropout).
     ``causal=True`` applies the lower-triangular mask; on TPU this (and
-    the mask-free case) dispatches to the fused Pallas flash kernel when
-    no dropout forces the dense path.  Key-padding masks — a ``mask``
-    with no query-position dependence, shaped ``(B, 1, 1, Tk)`` (or with
-    leading broadcast dims of 1) — ALSO stay on the flash path: the
-    kernel streams the key-validity row alongside the K/V blocks.  Any
-    other mask shape (arbitrary per-pair masks) takes the dense path.
+    the mask-free case) dispatches to the fused Pallas flash kernel.
+    Key-padding masks — a ``mask`` with no query-position dependence,
+    shaped ``(B, 1, 1, Tk)`` (or with leading broadcast dims of 1) —
+    ALSO stay on the flash path: the kernel streams the key-validity row
+    alongside the K/V blocks.  Train-mode attention dropout stays on the
+    flash path too (in-kernel counter-hash mask; the dense path and the
+    kernel draw different masks from the rng, so expect statistical, not
+    bitwise, agreement between backends).  Only arbitrary per-pair mask
+    shapes take the dense path.
 
     Caveat on fully-masked rows: flash emits zeros for a query whose
     keys are all masked, while the dense softmax degrades to a uniform
@@ -58,20 +61,30 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             and mask.shape[-2] == 1 and mask.shape[1] == 1
             and mask.shape[0] in (1, B) and mask.shape[-1] == Tk):
         kv_mask = jnp.broadcast_to(mask[:, 0, 0, :] != 0, (B, Tk))
-    if ((mask is None or kv_mask is not None) and not train_dropout
+    if ((mask is None or kv_mask is not None)
             and q.ndim == 4 and q.shape == k.shape == v.shape):
         from ..ops import dispatch
         if dispatch.use_pallas_for(q):
             from ..ops import pallas_flash_attention as pfa
-            if pfa.fits_vmem(q.shape[2], q.shape[3]):
+            if pfa.fits_vmem(q.shape[2], q.shape[3],
+                             dropout=train_dropout):
                 # same cast policy the dense path applies through its
                 # whitelisted matmuls (op 'dot_product_attention' is in
                 # amp.lists.FP16_FUNCS), so dtype is backend-independent
                 from ..amp import policy as _pol
                 (q, k, v), _ = _pol.cast_op_args("dot_product_attention",
                                                  (q, k, v), {})
-                return pfa.flash_attention(q, k, v, causal=causal,
-                                           scale=scale, kv_mask=kv_mask)
+                seed = None
+                if train_dropout:
+                    # both 32-bit key words feed the kernel's counter
+                    # hash — a single word would collide by birthday
+                    # bound over ~1e6 layer x step draws
+                    seed = jax.lax.bitcast_convert_type(
+                        jax.random.key_data(ctx.make_rng()), jnp.int32)
+                return pfa.flash_attention(
+                    q, k, v, causal=causal, scale=scale, kv_mask=kv_mask,
+                    dropout_rate=(dropout_rate if train_dropout else 0.0),
+                    dropout_seed=seed)
     if causal:
         Tq, Tk = q.shape[-2], k.shape[-2]
         # decode-style alignment: the last query attends to the full key

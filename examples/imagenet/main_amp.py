@@ -49,8 +49,9 @@ def parse_args():
     p.add_argument("--half-dtype", default=None,
                    choices=[None, "bfloat16", "float16"])
     p.add_argument("--channels-last", action="store_true",
-                   help="run internal activations NHWC (TPU lane-aligned "
-                        "channels); input stays NCHW")
+                   help="run the whole pipeline NHWC: loader delivery, "
+                        "model input, and every internal activation "
+                        "(channels on the TPU's 128-lane minor axis)")
     p.add_argument("--sync_bn", action="store_true",
                    help="convert BatchNorm to SyncBatchNorm")
     p.add_argument("--fused-adam", action="store_true",

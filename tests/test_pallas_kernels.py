@@ -477,3 +477,138 @@ def test_dot_product_attention_kv_mask_dispatches_to_flash(monkeypatch):
     assert called.get("kv_mask") is not None, "flash path not taken"
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def _dense_attn_dropout(q, k, v, causal, seed, rate):
+    """Dense reference applying the EXACT mask the kernel generates: the
+    same _keep_unit counter hash over absolute (batch*head, qpos, kpos),
+    undropped softmax normalizer, dropped+rescaled value accumulation."""
+    import math
+    from apex_tpu.ops.pallas_flash_attention import _keep_unit
+    B, H, T, D = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(D)
+    if causal:
+        m = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    bh = jnp.arange(B * H, dtype=jnp.int32).reshape(B, H, 1, 1)
+    qpos = jnp.arange(T, dtype=jnp.int32).reshape(1, 1, T, 1)
+    kpos = jnp.arange(T, dtype=jnp.int32).reshape(1, 1, 1, T)
+    u = _keep_unit(jnp.int32(seed),
+                   jnp.int32(seed) ^ jnp.int32(0x5555AAAA), bh, qpos, kpos)
+    p = jnp.where(u >= rate, p, 0.0) / (1.0 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_dropout_matches_dense(causal):
+    """In-kernel dropout == dense attention with the identical
+    counter-hash mask, forward and backward (deterministic: same seed,
+    same mask, everywhere)."""
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    B, H, T, D = 2, 2, 160, 16
+    rate, seed = 0.25, 1234
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32)
+               for kk in ks)
+
+    ref = _dense_attn_dropout(q, k, v, causal, seed, rate)
+    out = flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                          dropout_seed=jnp.int32(seed))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda t: jnp.sum(
+        _dense_attn_dropout(*t, causal, seed, rate) ** 2))((q, k, v))
+    g_out = jax.grad(lambda t: jnp.sum(
+        flash_attention(*t, causal=causal, dropout_rate=rate,
+                        dropout_seed=jnp.int32(seed)) ** 2))((q, k, v))
+    for a, b, name in zip(g_ref, g_out, "qkv"):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+def test_flash_attention_dropout_statistics():
+    """Mask statistics: drop fraction ~= rate, different seeds give
+    different masks, same seed is bitwise deterministic, and
+    dropout_rate=0 is exactly the old path."""
+    from apex_tpu.ops.pallas_flash_attention import (_keep_unit,
+                                                     flash_attention)
+    u = _keep_unit(jnp.int32(7), jnp.int32(11), jnp.int32(3),
+                   jnp.arange(512, dtype=jnp.int32)[:, None],
+                   jnp.arange(512, dtype=jnp.int32)[None, :])
+    frac = float(jnp.mean((u < 0.25).astype(jnp.float32)))
+    assert abs(frac - 0.25) < 0.01, frac          # 512^2 samples
+    # uniformity beyond the threshold: mean ~ 0.5
+    assert abs(float(jnp.mean(u)) - 0.5) < 0.01
+
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 16)) for kk in ks)
+    o1 = flash_attention(q, k, v, dropout_rate=0.5,
+                         dropout_seed=jnp.int32(1))
+    o1b = flash_attention(q, k, v, dropout_rate=0.5,
+                          dropout_seed=jnp.int32(1))
+    o2 = flash_attention(q, k, v, dropout_rate=0.5,
+                         dropout_seed=jnp.int32(2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+    assert float(jnp.max(jnp.abs(o1 - o2))) > 1e-3
+    o0 = flash_attention(q, k, v, dropout_rate=0.0)
+    o_plain = flash_attention(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o_plain))
+
+
+def test_dot_product_attention_dropout_stays_on_flash(monkeypatch):
+    """Train-mode attention dropout must ride the flash kernel (not fall
+    to dense), drop roughly the configured fraction, and keep the
+    no-dropout eval path unchanged."""
+    import apex_tpu.ops.pallas_flash_attention as pfa
+    from apex_tpu import nn
+    from apex_tpu.transformer import MultiheadAttention
+
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+    called = {}
+    orig = pfa.flash_attention
+
+    def spy(*a, **kw):
+        called["dropout_rate"] = kw.get("dropout_rate")
+        called["seed"] = kw.get("dropout_seed")
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pfa, "flash_attention", spy)
+
+    mha = MultiheadAttention(16, 2, dropout=0.0)
+    mha.drop.rate = 0.0
+    # attention-probability dropout lives in dot_product_attention
+    from apex_tpu.transformer import attention as attn_mod
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 16))
+    params, _ = mha.init(jax.random.PRNGKey(1))
+
+    def fwd_train(p, x):
+        q = jnp.moveaxis(
+            mha.qkv(p["qkv"], x).reshape(2, 64, 3, 2, 8)[:, :, 0], 2, 1)
+        return attn_mod.dot_product_attention(q, q, q, dropout_rate=0.5)
+
+    # eval (no ctx): no dropout, flash taken
+    out_eval = fwd_train(params, x)
+    assert called.get("dropout_rate") == 0.0
+
+    # train ctx (module apply context provides ctx.train + rng):
+    class Wrap(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.inner = mha
+        def forward(self, p, x):
+            q = jnp.moveaxis(self.inner.qkv(
+                p["inner"]["qkv"], x).reshape(2, 64, 3, 2, 8)[:, :, 0], 2, 1)
+            return attn_mod.dot_product_attention(q, q, q,
+                                                  dropout_rate=0.5)
+
+    w = Wrap()
+    wp, _ = w.init(jax.random.PRNGKey(3))
+    out_train, _ = nn.apply(w, wp, x, train=True,
+                            rng=jax.random.PRNGKey(4))
+    assert called.get("dropout_rate") == 0.5
+    assert called.get("seed") is not None
