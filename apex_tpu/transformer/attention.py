@@ -30,11 +30,16 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           scale: Optional[float] = None,
                           dropout_rate: float = 0.0,
-                          causal: bool = False) -> jax.Array:
+                          causal: bool = False,
+                          dropout_rng: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax.
 
     ``dropout_rate`` applies attention-probability dropout in train mode
-    (rng drawn from the active apply-context, like nn.Dropout).
+    (rng drawn from the active apply-context, like nn.Dropout) — or
+    unconditionally when an explicit ``dropout_rng`` is given (the
+    functional path: the caller owns the train/eval decision, e.g. the
+    sequence-parallel wrappers fold the device index into this key).
     ``causal=True`` applies the lower-triangular mask; on TPU this (and
     the mask-free case) dispatches to the fused Pallas flash kernel.
     Key-padding masks — a ``mask`` with no query-position dependence,
@@ -52,8 +57,13 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     one valid key per sequence."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
     ctx = current_context()
-    train_dropout = (dropout_rate > 0.0 and ctx is not None and ctx.train)
+    train_dropout = (dropout_rate > 0.0
+                     and (dropout_rng is not None
+                          or (ctx is not None and ctx.train)))
     B = q.shape[0] if q.ndim == 4 else None
     Tk = k.shape[-2]
     kv_mask = None
@@ -79,8 +89,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     # both 32-bit key words feed the kernel's counter
                     # hash — a single word would collide by birthday
                     # bound over ~1e6 layer x step draws
+                    key = (dropout_rng if dropout_rng is not None
+                           else ctx.make_rng())
                     seed = jax.lax.bitcast_convert_type(
-                        jax.random.key_data(ctx.make_rng()), jnp.int32)
+                        jax.random.key_data(key), jnp.int32)
                 return pfa.flash_attention(
                     q, k, v, causal=causal, scale=scale, kv_mask=kv_mask,
                     dropout_rate=(dropout_rate if train_dropout else 0.0),
@@ -99,7 +111,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))
     probs = jax.nn.softmax(scores, axis=-1)
     if train_dropout:
-        probs = F.dropout(probs, dropout_rate, ctx.make_rng())
+        key = dropout_rng if dropout_rng is not None else ctx.make_rng()
+        probs = F.dropout(probs, dropout_rate, key)
     return F.matmul(probs.astype(v.dtype), v)
 
 

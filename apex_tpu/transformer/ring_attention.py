@@ -31,7 +31,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
                    remat: bool = True,
-                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                   kv_mask: Optional[jax.Array] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """q, k, v: (B, H, T_local, D) per-device slices; returns the exact
     attention output for the local queries against the *global* sequence.
 
@@ -47,9 +49,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``kv_mask``: optional (B, T_local) bool key-validity slice, sharded
     over the sequence axis like k; the mask block rotates around the
     ring alongside its K/V block.  Queries whose keys are ALL masked
-    produce zero output rows."""
+    produce zero output rows.
+
+    ``dropout_rate`` + ``dropout_rng``: attention-probability dropout
+    with the flash placement (undropped softmax normalizer, dropped+
+    rescaled value accumulation).  The per-step mask is drawn from
+    ``fold_in(rng, device_index, step)``, so it is deterministic given
+    the rng — the remat'd backward regenerates the identical mask."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    dropout_rate = float(dropout_rate)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_rate and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
@@ -83,7 +97,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(scores - safe_m)
         p = jnp.where(jnp.isfinite(scores), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        # the normalizer uses the UNdropped probabilities; only the value
+        # accumulation is dropped+rescaled (flash dropout placement)
         new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate:
+            from ..nn import functional as F
+            key = jax.random.fold_in(jax.random.fold_in(dropout_rng, my),
+                                     src)
+            p = F.dropout(p, dropout_rate, key)
         new_acc = acc * corr + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
         return new_m, new_l, new_acc

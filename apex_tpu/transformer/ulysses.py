@@ -48,7 +48,9 @@ def _head_to_seq_sharded(x: jax.Array, axis_name: str) -> jax.Array:
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = "sp", causal: bool = False,
                       scale: Optional[float] = None,
-                      kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                      kv_mask: Optional[jax.Array] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """q, k, v: (B, H, T_local, D) per-device sequence-sharded slices;
     returns the exact attention output for the local queries against the
     global sequence, identical (up to fp reassociation) to
@@ -68,6 +70,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"ulysses_attention needs head count divisible by the sp axis "
             f"size, got H={H}, n={n}; use ring_attention instead")
+    if dropout_rate and dropout_rng is None:
+        # same contract as ring_attention: the functional SP wrappers
+        # require an explicit key (no silent no-op outside an apply
+        # context)
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
 
@@ -80,8 +87,15 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         gmask = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
         mask4 = gmask[:, None, None, :]
 
+    # dropout rides the flash kernel's in-kernel counter hash on the
+    # head-sharded attention; the device index is folded into the key —
+    # the hash sees only call-local (b, h_local) indices, so a shared
+    # key would give every head-group the same mask
+    rng_dev = (jax.random.fold_in(dropout_rng, lax.axis_index(axis_name))
+               if dropout_rate else None)
     out = dot_product_attention(qh, kh, vh, mask4, scale=scale,
-                                causal=causal)
+                                causal=causal, dropout_rate=dropout_rate,
+                                dropout_rng=rng_dev)
 
     return _head_to_seq_sharded(out, axis_name)
 
