@@ -612,3 +612,35 @@ def test_dot_product_attention_dropout_stays_on_flash(monkeypatch):
                             rng=jax.random.PRNGKey(4))
     assert called.get("dropout_rate") == 0.5
     assert called.get("seed") is not None
+
+
+def test_flash_attention_dropout_bf16():
+    from apex_tpu.ops.pallas_flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 16), jnp.bfloat16)
+               for kk in ks)
+    out = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                          dropout_seed=jnp.int32(5))
+    assert out.dtype == jnp.bfloat16
+    arr = np.asarray(out, np.float32)
+    assert np.all(np.isfinite(arr))
+    # parity with the dense reference sharing the same hash (bf16 tol)
+    ref = np.asarray(_dense_attn_dropout(q, k, v, True, 5, 0.3),
+                     np.float32)
+    np.testing.assert_allclose(arr, ref, rtol=3e-2, atol=3e-2)
+    # dropout actually perturbs relative to the clean output
+    clean = np.asarray(flash_attention(q, k, v, causal=True), np.float32)
+    assert np.max(np.abs(arr - clean)) > 1e-3
+
+
+def test_fits_vmem_dropout_flag():
+    """The dropout working set costs two extra score-shaped tiles; the
+    gate must be at least as strict with dropout as without."""
+    from apex_tpu.ops.pallas_flash_attention import fits_vmem
+    for T in (128, 512, 4096):
+        for D in (64, 128, 256):
+            assert (not fits_vmem(T, D, dropout=True)
+                    or fits_vmem(T, D))
+    # a discriminating point: base fits exactly at the budget, dropout
+    # exceeds it — catches the accounting regressing to flag-blind
+    assert fits_vmem(4096, 256) and not fits_vmem(4096, 256, dropout=True)
