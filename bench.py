@@ -117,6 +117,25 @@ def main():
             step, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
             out_specs=(P(), P()), check_vma=False))
 
+    def timed_scan(ddp, step, state, arrays, per_step_shapes, K, iters,
+                   warmup):
+        """Build the make_step trainer and time one optimizer step.
+
+        ``arrays``: flat leaves holding K*B leading elements each;
+        ``per_step_shapes``: their per-step shapes (B, ...).  K > 1 runs
+        K real optimizer steps on K distinct micro-batches per dispatch —
+        amortizing the ~ms-scale tunnel RTT; K == 1 keeps no micro axis
+        but routes through the same builder so all configs share
+        construction coverage.  No buffer donation: see sharded()."""
+        train = ddp.make_step(step, mesh=mesh, donate_state=False,
+                              steps_per_call=K)
+        if K == 1:
+            batch = tuple(arrays)
+        else:
+            batch = tuple(a.reshape((K,) + s)
+                          for a, s in zip(arrays, per_step_shapes))
+        return timed(train, state, batch, iters, warmup) / K
+
     def resnet_config(metric, opt_level, arch, batch_per_chip, image,
                       iters, warmup, sync_bn=False, vs=None,
                       steps_per_call=1, channels_last=False):
@@ -136,28 +155,22 @@ def main():
                         jnp.float32)
         y = jnp.asarray(rng.randint(0, 1000, K * global_batch), jnp.int32)
         step = make_resnet_step(model, optimizer, ddp)
-        # K > 1: K real optimizer steps on K distinct micro-batches per
-        # dispatch — amortizes the ~ms-scale tunnel RTT.  K == 1 routes
-        # through the same builder (identical jit(shard_map), batch keeps
-        # no micro axis) so headline and scan configs share construction
-        # coverage.  No buffer donation: see sharded().
-        train = ddp.make_step(step, mesh=mesh, donate_state=False,
-                              steps_per_call=K)
-        if K == 1:
-            batch = (x, y)
-        else:
-            batch = (x.reshape((K, global_batch) + x.shape[1:]),
-                     y.reshape((K, global_batch)))
-        dt = timed(train, (params, bn_state, opt_state), batch, iters,
-                   warmup) / K
+        dt = timed_scan(ddp, step, (params, bn_state, opt_state), (x, y),
+                        ((global_batch,) + x.shape[1:], (global_batch,)),
+                        K, iters, warmup)
         ips_chip = global_batch / dt / ndev
         emit(metric=metric, value=round(ips_chip, 1),
              unit="images/sec/chip", steps_per_call=K,
              vs_baseline=(round(ips_chip / vs, 3) if vs else None))
 
     def bert_config(metric, cfg_name, optimizer, batch_per_chip, seqlen,
-                    iters, warmup):
-        cfg = getattr(models, cfg_name)()
+                    iters, warmup, steps_per_call=1, tiny=False):
+        cfg = (models.BertConfig(vocab_size=128, hidden_size=32,
+                                 num_hidden_layers=2,
+                                 num_attention_heads=4,
+                                 intermediate_size=64,
+                                 max_position_embeddings=seqlen)
+               if tiny else getattr(models, cfg_name)())
         model, optimizer = amp.initialize(
             models.BertForPretraining(cfg), optimizer, opt_level="O2",
             verbosity=0)
@@ -165,14 +178,15 @@ def main():
         params, _ = model.init(jax.random.PRNGKey(0))
         opt_state = optimizer.init(params)
         B = batch_per_chip * ndev
+        K = steps_per_call
         rng = np.random.RandomState(0)
-        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, seqlen)),
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (K * B, seqlen)),
                           jnp.int32)
         mlm = jnp.asarray(
-            np.where(rng.rand(B, seqlen) < 0.15,
-                     rng.randint(0, cfg.vocab_size, (B, seqlen)), -100),
+            np.where(rng.rand(K * B, seqlen) < 0.15,
+                     rng.randint(0, cfg.vocab_size, (K * B, seqlen)), -100),
             jnp.int32)
-        nsp = jnp.asarray(rng.randint(0, 2, (B,)), jnp.int32)
+        nsp = jnp.asarray(rng.randint(0, 2, (K * B,)), jnp.int32)
 
         def step(state, batch):
             params, opt_state = state
@@ -187,14 +201,12 @@ def main():
             params, opt_state, _ = optimizer.step(params, opt_state, grads)
             return (params, opt_state), lax.pmean(loss, "data")
 
-        train = jax.jit(jax.shard_map(
-            step, mesh=mesh,
-            in_specs=(P(), (P("data"), P("data"), P("data"))),
-            out_specs=(P(), P()), check_vma=False))
-        dt = timed(train, (params, opt_state), (ids, mlm, nsp), iters,
-                   warmup)
+        dt = timed_scan(ddp, step, (params, opt_state), (ids, mlm, nsp),
+                        ((B, seqlen), (B, seqlen), (B,)), K, iters,
+                        warmup)
         emit(metric=metric, value=round(B / dt / ndev, 1),
-             unit="sequences/sec/chip", vs_baseline=None)
+             unit="sequences/sec/chip", steps_per_call=K,
+             vs_baseline=None)
 
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
@@ -274,6 +286,11 @@ def main():
              lambda: bert_config(
                  "bert_large_o2_fused_lamb_train_throughput", "bert_large",
                  optimizers.FusedLAMB(lr=1e-3), 8, 128, 8, 2)),
+            ("bert_base_o2_scan4_train_throughput",
+             lambda: bert_config(
+                 "bert_base_o2_scan4_train_throughput", "bert_base",
+                 optimizers.FusedAdam(lr=1e-4), 32, 128, 4, 1,
+                 steps_per_call=4)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet50_amp_o2_ddp_nhwc_train_throughput",
@@ -296,6 +313,11 @@ def main():
             ("resnet18_o0_fp32_train_throughput",
              lambda: resnet_config("resnet18_o0_fp32_train_throughput",
                                    "O0", "resnet18", 4, 32, 2, 1)),
+            ("bert_tiny_o2_scan2_train_throughput",
+             lambda: bert_config(
+                 "bert_tiny_o2_scan2_train_throughput", "bert_base",
+                 optimizers.FusedAdam(lr=1e-4), 2, 16, 2, 1,
+                 steps_per_call=2, tiny=True)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet18_amp_o2_ddp_scan2_train_throughput",
