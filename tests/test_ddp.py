@@ -280,3 +280,23 @@ def test_make_step_steps_per_call_matches_sequential(mesh):
     for a, b in zip(jax.tree_util.tree_leaves(st),
                     jax.tree_util.tree_leaves(st2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_make_mesh_axis_inference_and_errors():
+    from apex_tpu.parallel.topology import make_mesh, mesh_info
+
+    m = make_mesh(data=-1)
+    assert m.axis_names == ("data",)
+    assert m.devices.size == len(jax.devices())
+
+    m2 = make_mesh(data=-1, sp=2)
+    assert m2.axis_names == ("data", "sp")
+    assert m2.devices.shape == (len(jax.devices()) // 2, 2)
+
+    with pytest.raises(ValueError, match="at most one axis"):
+        make_mesh(a=-1, b=-1)
+    with pytest.raises(ValueError, match="do not divide"):
+        make_mesh(data=3)   # 8 CPU devices % 3 != 0
+
+    info = mesh_info(m2)
+    assert "sp" in info and "device(s)" in info
