@@ -104,14 +104,16 @@ def _block_for(T: int) -> int:
     return LANES
 
 
-def fits_vmem(T: int, D: int, dropout: bool = False) -> bool:
+def fits_vmem(T: int, D: int, dropout: bool = False,
+              segments: bool = False) -> bool:
     """VMEM needed per grid step — independent of T now that K/V stream
     through the grid.  Sized for the worst pass (backward dK/dV): six
     double-buffered operand blocks (q, k, v, do in; dk, dv out), two fp32
     accumulator scratches, the lane-broadcast stats tiles, and the
     (blk, blk) score/prob/dp/ds intermediates.  Dropout holds two more
     live (blk, blk) tiles in the dk/dv pass (the hash tile u and p_acc
-    alongside p/dp/ds)."""
+    alongside p/dp/ds); segments double-buffer the q-id (blk, LANES) and
+    k-id (8, blk) tiles plus the (blk, blk) equality mask."""
     blk = _block_for(T)
     Dp = -(-D // LANES) * LANES
     operands = 6 * blk * Dp          # q, k, v, do, dk, dv blocks
@@ -119,6 +121,9 @@ def fits_vmem(T: int, D: int, dropout: bool = False) -> bool:
     resident = 2 * (operands + stats) * 4          # double-buffered
     scratch = 2 * blk * Dp * 4                     # dk/dv fp32 accumulators
     ntiles = 6 if dropout else 4     # s/p, dp, ds (+ u, p_acc)
+    if segments:
+        ntiles += 1                  # the id-equality mask
+        resident += 2 * (blk * LANES + 8 * blk) * 4    # qseg + kseg tiles
     score = ntiles * blk * blk * 4
     return resident + scratch + score <= _VMEM_BUDGET
 
@@ -142,12 +147,17 @@ def _lanes(vec, Tp):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
-                nk):
+def _fwd_kernel(*refs, scale, causal, has_mask, has_segments,
+                dropout_rate, T_real, blk, nk):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     del refs[:3]
     kvm_ref = refs.pop(0) if has_mask else None
+    if has_segments:
+        qseg_ref = refs.pop(0)
+        kseg_ref = refs.pop(0)
+    else:
+        qseg_ref = kseg_ref = None
     seed_ref = refs.pop(0) if dropout_rate else None
     o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
@@ -179,6 +189,12 @@ def _fwd_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
             # (1, blk) key-validity row, sublane-broadcast tile layout:
             # k positions on the lane axis, matching s's column axis
             valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
+        if has_segments:
+            # packed sequences: attend only within the same segment —
+            # q ids ride the lane-broadcast (stat) layout as a (blk, 1)
+            # column, k ids the sublane layout as a (1, blk) row
+            valid = jnp.logical_and(
+                valid, qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
         s = jnp.where(valid, s, _NEG)
         m_prev = m_ref[...][:, :1]                      # (blk, 1)
         l_prev = l_ref[...][:, :1]
@@ -214,9 +230,10 @@ def _fwd_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "H",
                                              "dropout_rate"))
-def _fwd(q, k, v, kvm, seed, scale, causal, H, dropout_rate):
+def _fwd(q, k, v, kvm, qseg, kseg, seed, scale, causal, H, dropout_rate):
     """kvm: (B, 8, Tp) fp32 key-validity (sublane-broadcast) or None.
-    seed: (1, 1) int32 dropout counter seed or None."""
+    qseg/kseg: (B, Tp, LANES) lane- / (B, 8, Tp) sublane-broadcast int32
+    segment ids or None.  seed: (1, 2) int32 dropout seed or None."""
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -228,18 +245,27 @@ def _fwd(q, k, v, kvm, seed, scale, causal, H, dropout_rate):
     col = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
     stat = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
     has_mask = kvm is not None
+    has_segments = qseg is not None
     in_specs = [row, col, col]
     operands = [qp, kp, vp]
     if has_mask:
         in_specs.append(pl.BlockSpec((1, 8, blk),
                                      lambda b, i, j: (b // H, 0, j)))
         operands.append(kvm)
+    if has_segments:
+        in_specs.append(pl.BlockSpec((1, blk, LANES),
+                                     lambda b, i, j: (b // H, i, 0)))
+        operands.append(qseg)
+        in_specs.append(pl.BlockSpec((1, 8, blk),
+                                     lambda b, i, j: (b // H, 0, j)))
+        operands.append(kseg)
     if dropout_rate:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.append(seed)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          has_mask=has_mask, has_segments=has_segments,
+                          dropout_rate=dropout_rate,
                           T_real=T, blk=blk, nk=nk),
         grid=grid,
         in_specs=in_specs,
@@ -260,12 +286,17 @@ def _fwd(q, k, v, kvm, seed, scale, causal, H, dropout_rate):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
-               nk):
+def _dq_kernel(*refs, scale, causal, has_mask, has_segments, dropout_rate,
+               T_real, blk, nk):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     del refs[:6]
     kvm_ref = refs.pop(0) if has_mask else None
+    if has_segments:
+        qseg_ref = refs.pop(0)
+        kseg_ref = refs.pop(0)
+    else:
+        qseg_ref = kseg_ref = None
     seed_ref = refs.pop(0) if dropout_rate else None
     dq_ref, dq_acc = refs
     b = pl.program_id(0)
@@ -294,6 +325,9 @@ def _dq_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
             valid = jnp.logical_and(valid, qpos >= kpos)
         if has_mask:
             valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
+        if has_segments:
+            valid = jnp.logical_and(
+                valid, qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = _dot(do, v, ((1,), (1,)))
         if dropout_rate:
@@ -310,12 +344,17 @@ def _dq_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
-                nq):
+def _dkv_kernel(*refs, scale, causal, has_mask, has_segments,
+                dropout_rate, T_real, blk, nq):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     del refs[:6]
     kvm_ref = refs.pop(0) if has_mask else None
+    if has_segments:
+        qseg_ref = refs.pop(0)
+        kseg_ref = refs.pop(0)
+    else:
+        qseg_ref = kseg_ref = None
     seed_ref = refs.pop(0) if dropout_rate else None
     dk_ref, dv_ref, dk_acc, dv_acc = refs
     b = pl.program_id(0)
@@ -346,6 +385,9 @@ def _dkv_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
             valid = jnp.logical_and(valid, qpos >= kpos)
         if has_mask:
             valid = jnp.logical_and(valid, kvm_ref[0][:1, :] > 0.5)
+        if has_segments:
+            valid = jnp.logical_and(
+                valid, qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
         # padded q rows contribute nothing: their do rows are zero
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # (bq, bk)
         dp = _dot(do, v, ((1,), (1,)))
@@ -371,7 +413,8 @@ def _dkv_kernel(*refs, scale, causal, has_mask, dropout_rate, T_real, blk,
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "H",
                                              "dropout_rate"))
-def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
+def _bwd(q, k, v, o, lse, do, kvm, qseg, kseg, seed, scale, causal, H,
+         dropout_rate):
     BH, T, D = q.shape
     blk = _block_for(T)
     Tp = -(-T // blk) * blk
@@ -383,6 +426,7 @@ def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
     lsep = _lanes(lse, Tp)
     nq = nk = Tp // blk
     has_mask = kvm is not None
+    has_segments = qseg is not None
     sem = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
@@ -390,10 +434,13 @@ def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
     colj = pl.BlockSpec((1, blk, Dp), lambda b, i, j: (b, j, 0))
     stati = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, i, 0))
     statj = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b, j, 0))
-    # key-validity tile for the k block: streamed along the j axis in the
-    # dq pass, along the i (k-block) axis in the dk/dv pass
+    # key-validity / k-segment tiles for the k block: streamed along the
+    # j axis in the dq pass, along the i (k-block) axis in the dk/dv
+    # pass; q-segment ids ride the lane-broadcast (stat) layout
     kvmj = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, j))
     kvmi = pl.BlockSpec((1, 8, blk), lambda b, i, j: (b // H, 0, i))
+    qsegi = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b // H, i, 0))
+    qsegj = pl.BlockSpec((1, blk, LANES), lambda b, i, j: (b // H, j, 0))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     dq_specs = [rowi, colj, colj, rowi, stati, stati]
@@ -401,12 +448,16 @@ def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
     if has_mask:
         dq_specs.append(kvmj)
         dq_ops.append(kvm)
+    if has_segments:
+        dq_specs += [qsegi, kvmj]        # k ids share the kvm layout
+        dq_ops += [qseg, kseg]
     if dropout_rate:
         dq_specs.append(smem)
         dq_ops.append(seed)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          has_mask=has_mask, has_segments=has_segments,
+                          dropout_rate=dropout_rate,
                           T_real=T, blk=blk, nk=nk),
         grid=(BH, nq, nk),
         in_specs=dq_specs,
@@ -422,12 +473,18 @@ def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
     if has_mask:
         dkv_specs.append(kvmi)
         dkv_ops.append(kvm)
+    if has_segments:
+        # dkv grid: i = k block, j = q block — q ids stream along j,
+        # k ids along i (sharing the kvm layouts)
+        dkv_specs += [qsegj, kvmi]
+        dkv_ops += [qseg, kseg]
     if dropout_rate:
         dkv_specs.append(smem)
         dkv_ops.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          has_mask=has_mask, dropout_rate=dropout_rate,
+                          has_mask=has_mask, has_segments=has_segments,
+                          dropout_rate=dropout_rate,
                           T_real=T, blk=blk, nq=nq),
         grid=(BH, nk, nq),
         in_specs=dkv_specs,
@@ -446,28 +503,31 @@ def _bwd(q, k, v, o, lse, do, kvm, seed, scale, causal, H, dropout_rate):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q3, k3, v3, kvm, seed, scale: float, causal: bool, H: int,
-           dropout_rate: float):
-    o, _ = _fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash(q3, k3, v3, kvm, qseg, kseg, seed, scale: float, causal: bool,
+           H: int, dropout_rate: float):
+    o, _ = _fwd(q3, k3, v3, kvm, qseg, kseg, seed, scale, causal, H,
+                dropout_rate)
     return o
 
 
-def _flash_fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate):
-    o, lse = _fwd(q3, k3, v3, kvm, seed, scale, causal, H, dropout_rate)
-    return o, (q3, k3, v3, o, lse, kvm, seed)
+def _flash_fwd(q3, k3, v3, kvm, qseg, kseg, seed, scale, causal, H,
+               dropout_rate):
+    o, lse = _fwd(q3, k3, v3, kvm, qseg, kseg, seed, scale, causal, H,
+                  dropout_rate)
+    return o, (q3, k3, v3, o, lse, kvm, qseg, kseg, seed)
 
 
 def _flash_bwd(scale, causal, H, dropout_rate, res, do):
-    q3, k3, v3, o, lse, kvm, seed = res
-    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, kvm, seed, scale, causal, H,
-                      dropout_rate)
+    q3, k3, v3, o, lse, kvm, qseg, kseg, seed = res
+    dq, dk, dv = _bwd(q3, k3, v3, o, lse, do, kvm, qseg, kseg, seed,
+                      scale, causal, H, dropout_rate)
     dkvm = None if kvm is None else jnp.zeros_like(kvm)
-    # int primal -> float0 cotangent
-    dseed = (None if seed is None
-             else np.zeros(seed.shape, jax.dtypes.float0))
+    # int primals -> float0 cotangents
+    f0 = lambda a: (None if a is None
+                    else np.zeros(a.shape, jax.dtypes.float0))
     return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
-            dkvm, dseed)
+            dkvm, f0(qseg), f0(kseg), f0(seed))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -478,7 +538,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None,
                     kv_mask: Optional[jax.Array] = None,
                     dropout_rate: float = 0.0,
-                    dropout_seed: Optional[jax.Array] = None) -> jax.Array:
+                    dropout_seed: Optional[jax.Array] = None,
+                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """softmax(q k^T * scale [+ causal mask]) v without materializing the
     score matrix in HBM.  q, k, v: (B, H, T, D) self-attention operands
     (equal sequence lengths).  K/V are streamed through VMEM in blocks,
@@ -497,7 +558,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     no (T, T) mask materializes, and the backward passes regenerate the
     identical mask from the same counters (FlashAttention's dropout
     placement: the softmax normalizer is undropped, the value
-    accumulation is dropped and rescaled by 1/keep)."""
+    accumulation is dropped and rescaled by 1/keep).
+
+    ``segment_ids``: optional (B, T) int32 for packed sequences —
+    position pairs attend only within equal ids (q-ids stream as
+    lane-broadcast tiles, k-ids as sublane tiles).  Composes with
+    ``causal``/``kv_mask``/dropout.  Rows whose segment has no other
+    member still see themselves (the diagonal id always matches)."""
     if q.ndim != 4:
         raise ValueError(f"expected (B, H, T, D), got {q.shape}")
     if q.shape != k.shape or k.shape != v.shape:
@@ -511,13 +578,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    blk = _block_for(T)
+    Tp = -(-T // blk) * blk
     kvm = None
     if kv_mask is not None:
         if kv_mask.shape != (B, T):
             raise ValueError(f"kv_mask must be (B, T) = {(B, T)}, got "
                              f"{kv_mask.shape}")
-        blk = _block_for(T)
-        Tp = -(-T // blk) * blk
         m = jnp.pad(kv_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))
         kvm = jax.lax.broadcast_in_dim(m, (B, 8, Tp), (0, 2))
     seed = None
@@ -531,7 +598,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             raise ValueError("dropout_seed must be 1 or 2 int32 words, "
                              f"got {s.size}")
         seed = s.reshape(1, 2)
+    qseg = kseg = None
+    if segment_ids is not None:
+        if segment_ids.shape != (B, T):
+            raise ValueError(f"segment_ids must be (B, T) = {(B, T)}, "
+                             f"got {segment_ids.shape}")
+        # padded positions get id -1 on the q side and -2 on the k side,
+        # so padding never matches anything (incl. other padding)
+        ids = segment_ids.astype(jnp.int32)
+        idq = jnp.pad(ids, ((0, 0), (0, Tp - T)), constant_values=-1)
+        idk = jnp.pad(ids, ((0, 0), (0, Tp - T)), constant_values=-2)
+        qseg = jax.lax.broadcast_in_dim(idq, (B, Tp, LANES), (0, 1))
+        kseg = jax.lax.broadcast_in_dim(idk, (B, 8, Tp), (0, 2))
     fold = lambda x: x.reshape(B * H, T, D)
-    out = _flash(fold(q), fold(k), fold(v), kvm, seed, float(scale),
-                 bool(causal), H, dropout_rate)
+    out = _flash(fold(q), fold(k), fold(v), kvm, qseg, kseg, seed,
+                 float(scale), bool(causal), H, dropout_rate)
     return out.reshape(B, H, T, D)
