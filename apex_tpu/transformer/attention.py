@@ -31,7 +31,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           scale: Optional[float] = None,
                           dropout_rate: float = 0.0,
                           causal: bool = False,
-                          dropout_rng: Optional[jax.Array] = None
+                          dropout_rng: Optional[jax.Array] = None,
+                          segment_ids: Optional[jax.Array] = None
                           ) -> jax.Array:
     """q,k,v: (..., T, H) — softmax(qk^T/sqrt(H)) v with fp32 softmax.
 
@@ -40,6 +41,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     unconditionally when an explicit ``dropout_rng`` is given (the
     functional path: the caller owns the train/eval decision, e.g. the
     sequence-parallel wrappers fold the device index into this key).
+    ``segment_ids``: (B, T) int32 packed-sequence ids — attention is
+    restricted to equal-id pairs (streamed through the flash kernel on
+    TPU; applied as an equality mask on the dense path).
     ``causal=True`` applies the lower-triangular mask; on TPU this (and
     the mask-free case) dispatches to the fused Pallas flash kernel.
     Key-padding masks — a ``mask`` with no query-position dependence,
@@ -60,6 +64,13 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if not 0.0 <= dropout_rate < 1.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got "
                          f"{dropout_rate}")
+    if segment_ids is not None:
+        if q.ndim != 4:
+            raise ValueError("segment_ids requires (B, H, T, D) operands")
+        expect = (q.shape[0], k.shape[-2])
+        if segment_ids.shape != expect:
+            raise ValueError(f"segment_ids must be (B, T) = {expect}, "
+                             f"got {segment_ids.shape}")
     ctx = current_context()
     train_dropout = (dropout_rate > 0.0
                      and (dropout_rng is not None
@@ -77,7 +88,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if dispatch.use_pallas_for(q):
             from ..ops import pallas_flash_attention as pfa
             if pfa.fits_vmem(q.shape[2], q.shape[3],
-                             dropout=train_dropout):
+                             dropout=train_dropout,
+                             segments=segment_ids is not None):
                 # same cast policy the dense path applies through its
                 # whitelisted matmuls (op 'dot_product_attention' is in
                 # amp.lists.FP16_FUNCS), so dtype is backend-independent
@@ -96,7 +108,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 return pfa.flash_attention(
                     q, k, v, causal=causal, scale=scale, kv_mask=kv_mask,
                     dropout_rate=(dropout_rate if train_dropout else 0.0),
-                    dropout_seed=seed)
+                    dropout_seed=seed, segment_ids=segment_ids)
     if causal:
         Tq, Tk = q.shape[-2], k.shape[-2]
         # decode-style alignment: the last query attends to the full key
@@ -109,6 +121,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scores = F.matmul(q, jnp.swapaxes(k, -1, -2)).astype(jnp.float32) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.full_like(scores, -1e30))
+    if segment_ids is not None:
+        seg = (segment_ids[:, None, :, None]
+               == segment_ids[:, None, None, :])
+        scores = jnp.where(seg, scores, jnp.full_like(scores, -1e30))
     probs = jax.nn.softmax(scores, axis=-1)
     if train_dropout:
         key = dropout_rng if dropout_rng is not None else ctx.make_rng()

@@ -178,3 +178,25 @@ def test_multihead_key_padding_mask():
     np.testing.assert_allclose(np.asarray(out2[1, :8]),
                                np.asarray(out[1, :8]),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_dot_product_attention_segment_ids_paths_agree(monkeypatch):
+    """segment_ids on the dense path == the flash path (forced pallas)."""
+    from apex_tpu.transformer import dot_product_attention
+    B, H, T, D = 2, 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D)) for kk in ks)
+    seg = jnp.asarray(np.repeat([0, 1], T // 2)[None, :].repeat(B, 0),
+                      jnp.int32)
+
+    # pin the baseline to the dense path explicitly — on a TPU host the
+    # ambient default would route both calls through the flash kernel
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
+    dense = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "1")
+    monkeypatch.delenv("APEX_TPU_DISABLE_PALLAS", raising=False)
+    flash = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError, match="requires"):
+        dot_product_attention(q[0], k[0], v[0], segment_ids=seg)
