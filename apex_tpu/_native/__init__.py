@@ -80,11 +80,18 @@ def _try_load() -> Optional[ctypes.CDLL]:
     except AttributeError:
         pass    # stale v2 .so; version() gates the NHWC paths below
     lib.apex_native_version.restype = ctypes.c_int
-    lib.apex_loader_create.argtypes = [
+    # ABI v2's create takes 13 args; v3 appended a data_format int.
+    # Declare exactly what the loaded .so expects — passing a surplus
+    # trailing int to a v2 library happens to work on x86-64/aarch64
+    # calling conventions but is not something to rely on.
+    _loader_args = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
         ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    if int(lib.apex_native_version()) >= 3:
+        _loader_args.append(ctypes.c_int)
+    lib.apex_loader_create.argtypes = _loader_args
     lib.apex_loader_create.restype = ctypes.c_void_p
     lib.apex_loader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),

@@ -98,7 +98,7 @@ class DataLoader:
             lib = _native._try_load()
             if lib is not None:
                 self._lib = lib
-                self._handle = lib.apex_loader_create(
+                create_args = [
                     self.images.ctypes.data_as(ctypes.c_void_p),
                     self.labels.ctypes.data_as(ctypes.c_void_p),
                     self.n, self.h, self.w, self.c, self.batch_size,
@@ -107,8 +107,13 @@ class DataLoader:
                         ctypes.POINTER(ctypes.c_float)),
                     self.std.ctypes.data_as(
                         ctypes.POINTER(ctypes.c_float)),
-                    1 if shuffle else 0,
-                    1 if data_format == "NHWC" else 0)
+                    1 if shuffle else 0]
+                if _native.version() >= 3:
+                    # the data_format arg exists only in the v3 ABI; the
+                    # NHWC-on-v2 case was already routed to the numpy
+                    # fallback above
+                    create_args.append(1 if data_format == "NHWC" else 0)
+                self._handle = lib.apex_loader_create(*create_args)
         # python fallback state
         self._py_batch = 0
         self._py_rng = np.random.RandomState(seed)

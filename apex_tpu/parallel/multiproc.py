@@ -113,11 +113,15 @@ def main(argv=None) -> int:
         if args.backend == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)  # detach any TPU plugin
-            flags = env.get("XLA_FLAGS", "")
-            if "xla_force_host_platform_device_count" not in flags:
-                env["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count="
-                    f"{args.devices_per_proc}").strip()
+            # REPLACE any inherited device-count flag: a parent test
+            # process runs on an 8-device virtual mesh, and inheriting
+            # that would give each child 8 devices instead of
+            # devices_per_proc (world 16, not nprocs)
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{args.devices_per_proc}")
+            env["XLA_FLAGS"] = " ".join(flags)
         children.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
 

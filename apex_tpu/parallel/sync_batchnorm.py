@@ -107,6 +107,10 @@ class SyncBatchNorm(BatchNorm2d):
         sum_x2 = lax.psum(m2, self.axis_name,
                           axis_index_groups=self.axis_index_groups)
         g_mean = sum_x / total
-        g_var = sum_x2 / total - jnp.square(g_mean)
+        # E[x^2] - mean^2 can go slightly negative for |mean| >> std
+        # (catastrophic cancellation) — same clamp as the local
+        # batch_norm_stats path; without it rsqrt(var+eps) NaNs when
+        # |var| > eps.
+        g_var = jnp.maximum(sum_x2 / total - jnp.square(g_mean), 0.0)
         return total, g_mean, g_var
 

@@ -23,7 +23,30 @@ from ..nn import functional as F
 from ..nn.module import Module, current_context
 from ..nn.layers import Linear, Dropout
 
-__all__ = ["dot_product_attention", "MultiheadAttention"]
+__all__ = ["dot_product_attention", "MultiheadAttention",
+           "set_path_hook"]
+
+# Trace-time debug hook: parity harnesses comparing backends need to know
+# which path a call compiled to, because flash vs dense differ
+# statistically (dropout masks) and on fully-masked rows (see the
+# dot_product_attention docstring).  The hook receives "flash" or
+# "dense" each time dispatch resolves (at trace time, so once per
+# compilation, not per step).
+_path_hook = None
+
+
+def set_path_hook(hook) -> None:
+    """Install ``hook(path: str)`` (or None to clear).  A setter rather
+    than a rebindable module global: ``from ... import path_hook`` would
+    capture the value and assignments to it would silently install
+    nothing."""
+    global _path_hook
+    _path_hook = hook
+
+
+def _note_path(path: str) -> None:
+    if _path_hook is not None:
+        _path_hook(path)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -105,10 +128,12 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            else ctx.make_rng())
                     seed = jax.lax.bitcast_convert_type(
                         jax.random.key_data(key), jnp.int32)
+                _note_path("flash")
                 return pfa.flash_attention(
                     q, k, v, causal=causal, scale=scale, kv_mask=kv_mask,
                     dropout_rate=(dropout_rate if train_dropout else 0.0),
                     dropout_seed=seed, segment_ids=segment_ids)
+    _note_path("dense")
     if causal:
         Tq, Tk = q.shape[-2], k.shape[-2]
         # decode-style alignment: the last query attends to the full key

@@ -8,6 +8,15 @@ Times, compiled on the real chip with a hard D2H fetch as the barrier:
   5. (4) wrapped in a steps_per_call=4 lax.scan — amortizes the ~3.5 ms
      tunnel RTT and lets XLA overlap host dispatch
 
+Backward decomposition (VERDICT r3 item 2 — 54 of 70 ms was
+bwd+optimizer with no breakdown):
+  6. grad wrt INPUT only — the dgrad chain without any wgrad convs
+  7. eval-mode fwd+bwd — BN uses running stats, so the batch-stat
+     backward (fp32 reductions over activations) drops out
+  8. conv microbench: fwd / dgrad / wgrad per representative ResNet-50
+     conv shape, NCHW vs NHWC, bf16 — names which conv family and which
+     grad direction eats the backward
+
 Run:  python artifacts/step_probe.py  [batch]
 """
 
@@ -69,6 +78,27 @@ def main():
     dt = timed(fwdbwd, params)
     print(f"fwd+bwd:         {dt*1e3:7.2f} ms")
 
+    # -- backward decomposition ------------------------------------------
+    # dgrad-only: differentiate wrt the INPUT — the cotangent chain runs
+    # through every layer but no weight-gradient convs are built
+    def dgrad_only(xx):
+        out, _ = model.apply(params, xx, state=bn_state, train=True)
+        return F.cross_entropy(out, y)
+
+    dt = timed(jax.grad(dgrad_only), x)
+    print(f"fwd+dgrad only:  {dt*1e3:7.2f} ms   (no wgrad convs)")
+
+    # eval-mode backward: BN applies running stats, so the fp32
+    # batch-stat reductions and their backward drop out of the graph
+    def eval_loss(p):
+        out, _ = model.apply(p, x, state=bn_state, train=False)
+        return F.cross_entropy(out, y)
+
+    dt = timed(lambda p: eval_loss(p), params)
+    print(f"fwd eval:        {dt*1e3:7.2f} ms")
+    dt = timed(jax.grad(eval_loss), params)
+    print(f"fwd+bwd eval:    {dt*1e3:7.2f} ms   (no BN-stat backward)")
+
     def full(p, st):
         _, _, grads = amp.scaled_grad(loss_fn, p, opt_state, has_aux=True)
         p2, _, _ = optimizer.step(p, st, grads)
@@ -129,5 +159,90 @@ def main():
           f"{B/dt/ndev:6.0f} img/s/chip")
 
 
+def conv_bench(shapes=None, K=8, iters=3):
+    """fwd / dgrad / wgrad per representative ResNet-50 conv, both
+    layouts, bf16.  K-chained with a data dependence (tanh(mean) folded
+    back) so XLA cannot CSE the repeats and the ~3.5 ms tunnel RTT
+    amortizes over K convs."""
+    rng = np.random.RandomState(0)
+    if shapes is None:
+        # (name, kh, cin, cout, hw, stride) — B fixed at probe batch
+        shapes = [
+            ("stem 7x7s2 3->64 @224", 7, 3, 64, 224, 2),
+            ("3x3 64->64 @56", 3, 64, 64, 56, 1),
+            ("1x1 256->64 @56", 1, 256, 64, 56, 1),
+            ("3x3 128->128 @28", 3, 128, 128, 28, 1),
+            ("3x3 512->512 @7", 3, 512, 512, 7, 1),
+        ]
+    for layout in ("NCHW", "NHWC"):
+        dn_in, dn_k, dn_out = ((layout, "OIHW", layout)
+                               if layout == "NCHW"
+                               else (layout, "HWIO", layout))
+        for name, kh, cin, cout, hw, stride in shapes:
+            if layout == "NCHW":
+                xs = (B, cin, hw, hw)
+                ks = (cout, cin, kh, kh)
+            else:
+                xs = (B, hw, hw, cin)
+                ks = (kh, kh, cin, cout)
+            x = jnp.asarray(rng.randn(*xs), jnp.bfloat16)
+            w = jnp.asarray(rng.randn(*ks) * 0.05, jnp.bfloat16)
+
+            def conv(xx, ww):
+                # pure-bf16 conv, like the model's under amp O2 (the MXU
+                # accumulates fp32 internally regardless)
+                return lax.conv_general_dilated(
+                    xx, ww, (stride, stride), "SAME",
+                    dimension_numbers=(dn_in, dn_k, dn_out))
+
+            ct = conv(x, w)  # cotangent template (output shape)
+            hout = ct.shape[2] if layout == "NCHW" else ct.shape[1]
+            flops = 2 * B * hout * hout * cout * cin * kh * kh
+
+            def chain_fwd(xx, ww):
+                def body(c, _):
+                    y = conv(c, ww)
+                    c = c + jnp.tanh(jnp.mean(y)).astype(c.dtype) * 1e-3
+                    return c, ()
+                return lax.scan(body, xx, None, length=K)[0]
+
+            # conv is LINEAR in each operand, so dx depends only on
+            # (w, ct) and dw only on (x, ct) — never on the carry.  The
+            # cotangent must be perturbed BY the carry each iteration or
+            # XLA hoists the gradient conv out of the scan and the
+            # "per-op" time is K-times too fast (the CSE-in-probes trap
+            # again, loop-invariant-code-motion flavor).
+            def chain_dgrad(xx, ww, cct):
+                def body(c, _):
+                    ci = cct * (1 + jnp.tanh(jnp.mean(c))
+                                .astype(cct.dtype) * 1e-3)
+                    dx = jax.vjp(lambda a: conv(a, ww), c)[1](ci)[0]
+                    return c + dx.astype(c.dtype) * 1e-6, ()
+                return lax.scan(body, xx, None, length=K)[0]
+
+            def chain_wgrad(xx, ww, cct):
+                def body(c, _):
+                    ci = cct * (1 + jnp.tanh(jnp.mean(c))
+                                .astype(cct.dtype) * 1e-3)
+                    dw = jax.vjp(lambda a: conv(xx, a), c)[1](ci)[0]
+                    return c + dw.astype(c.dtype) * 1e-6, ()
+                return lax.scan(body, ww, None, length=K)[0]
+
+            # cotangent in bf16 — matches the real backward, where the
+            # cast transposes deliver bf16 cotangents into the convs
+            ctb = ct.astype(jnp.bfloat16)
+            rows = []
+            for tag, fn, args in (
+                    ("fwd", chain_fwd, (x, w)),
+                    ("dgrad", chain_dgrad, (x, w, ctb)),
+                    ("wgrad", chain_wgrad, (x, w, ctb))):
+                dt = timed(fn, *args, iters=iters) / K
+                rows.append(f"{tag} {dt*1e3:6.2f} ms "
+                            f"{flops/dt/1e12:5.1f} TF/s")
+            print(f"  {layout} {name:24s} " + "  ".join(rows))
+
+
 if __name__ == "__main__":
     main()
+    print("conv microbench (per-op, K-chained, bf16):")
+    conv_bench()

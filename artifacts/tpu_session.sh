@@ -1,17 +1,38 @@
 #!/bin/bash
 # Hardware-validation runbook for when the TPU tunnel is responsive.
-# Runs the round-3 probe/validation sequence, teeing results into
-# artifacts/.  Each stage is independently timeout-guarded so one wedge
-# doesn't lose the rest; per-stage exit status is reported (124 = the
-# timeout killed a wedged stage).
+#
+# ORDER IS WEDGE INSURANCE (VERDICT r3 items 1+7): the round-2/3 wedges
+# taught that the tunnel can die mid-session, so the cheap, highest-value
+# records run FIRST — a full bench (~5 min) and the kernel suite — and
+# the expensive 48-config L1 matrix runs LAST.  A wedge at any point
+# leaves every earlier stage's artifact committed.
+#
+# Each stage is independently timeout-guarded so one wedge doesn't lose
+# the rest; per-stage exit status is reported (124 = the timeout killed a
+# wedged stage).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 TS=$(date -u +%Y%m%dT%H%M%S)
 log() { echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
 stat() { echo "=== stage exit: $1 ==="; }
 
-log "step decomposition probe"
-timeout 900 python artifacts/step_probe.py 2>&1 | grep -v WARNING \
+log "full bench (wedge insurance: capture the round's perf record first)"
+# stdout (JSON lines) -> artifact; stderr (fallback warnings, config
+# tracebacks) -> .err log so a mid-run wedge or crash leaves evidence
+timeout 3600 python bench.py 2> "artifacts/bench_$TS.err" \
+    | tee "artifacts/bench_$TS.json"
+stat $?
+[ -s "artifacts/bench_$TS.err" ] && { echo "--- bench stderr ---"; \
+    cat "artifacts/bench_$TS.err"; }
+
+log "TPU-compiled kernel suite"
+timeout 3600 env APEX_TPU_TEST_BACKEND=tpu python -m pytest \
+    tests/test_pallas_kernels.py tests/test_flash_long.py -v 2>&1 \
+    | tail -45 | tee "artifacts/tpu_kernel_tests_$TS.log"
+stat $?
+
+log "step decomposition probe (bwd breakdown: dgrad/wgrad/BN/optimizer)"
+timeout 1800 python artifacts/step_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/step_probe_$TS.log"
 stat $?
 
@@ -25,24 +46,9 @@ timeout 900 python artifacts/ln_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/ln_probe_$TS.log"
 stat $?
 
-log "L1 cross-product on hardware (full 48-config matrix)"
+log "L1 cross-product on hardware (full 48-config matrix — runs last)"
 timeout 5400 python tests/L1/run_l1.py --out "artifacts/l1_tpu_$TS.json" \
     2>&1 | tail -8 | tee "artifacts/l1_tpu_$TS.log"
 stat $?
-
-log "TPU-compiled kernel suite"
-timeout 3600 env APEX_TPU_TEST_BACKEND=tpu python -m pytest \
-    tests/test_pallas_kernels.py tests/test_flash_long.py -v 2>&1 \
-    | tail -45 | tee "artifacts/tpu_kernel_tests_$TS.log"
-stat $?
-
-log "full bench"
-# stdout (JSON lines) -> artifact; stderr (fallback warnings, config
-# tracebacks) -> .err log so a mid-run wedge or crash leaves evidence
-timeout 3600 python bench.py 2> "artifacts/bench_$TS.err" \
-    | tee "artifacts/bench_$TS.json"
-stat $?
-[ -s "artifacts/bench_$TS.err" ] && { echo "--- bench stderr ---"; \
-    cat "artifacts/bench_$TS.err"; }
 
 log "runbook done"

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Background watcher: probe the TPU tunnel every 2 minutes; the moment a
+# device op completes, launch the full validation runbook
+# (artifacts/tpu_session.sh) and exit.  Round-3 lesson: the wedge can
+# last hours, so this runs detached from the interactive session and
+# leaves artifacts/ + a done-marker for the main loop to pick up.
+cd "$(dirname "$0")/.." || exit 1
+MARKER=artifacts/tpu_watcher_state
+echo "watching $(date -u +%H:%M:%S)" > "$MARKER"
+while true; do
+    if timeout 120 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+# a fast-failing plugin silently downgrades to CPU; that must NOT count
+# as the TPU reviving (the session would burn itself on CPU and exit)
+assert jax.default_backend() != "cpu", "cpu fallback"
+r = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
+print(float(r.sum()))
+EOF
+    then
+        echo "tpu responsive $(date -u +%H:%M:%S); running session" >> "$MARKER"
+        bash artifacts/tpu_session.sh > artifacts/tpu_session_run.log 2>&1
+        echo "session done $(date -u +%H:%M:%S) exit $?" >> "$MARKER"
+        exit 0
+    fi
+    echo "still wedged $(date -u +%H:%M:%S)" >> "$MARKER"
+    sleep 120
+done

@@ -28,13 +28,79 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 10_000.0 / 64.0
 
+# Last-known-good hardware record (VERDICT r3 item 6): every TPU run
+# persists its emitted lines here; a wedge-fallback run replays them with
+# ``stale: true`` so the round's artifact never reads as a 150x
+# regression when the tunnel dies.  The headline stays the LAST line.
+HEADLINE_METRIC = "resnet50_amp_o2_ddp_train_throughput"
+RECORD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts", "last_tpu_bench.json")
+
+
+def save_tpu_record(lines, path=RECORD_PATH, now=None):
+    """Persist the lines of a TPU bench run (error lines and
+    already-stale replays are the caller's job to exclude).
+
+    MERGES per-metric into the existing record rather than overwriting:
+    a partial run — e.g. the headline config hung after earlier configs
+    completed — must not clobber the previous run's headline, or the
+    next wedge replay would end on the wrong metric.  Every line is
+    stamped with its own ``recorded_at``; carried-over lines keep
+    theirs."""
+    if not lines:
+        return
+    now = (now if now is not None
+           else time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    old = load_tpu_record(path)
+    merged = {}
+    if old:
+        for ln in old["lines"]:
+            ln.setdefault("recorded_at", old.get("recorded_at"))
+            merged[ln.get("metric")] = ln
+    for ln in lines:
+        merged[ln.get("metric")] = {**ln, "recorded_at": now}
+    rec = {"recorded_at": now, "lines": list(merged.values())}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, path)
+
+
+def load_tpu_record(path=RECORD_PATH):
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        lines = rec.get("lines", [])
+        return rec if lines else None
+    except (OSError, ValueError):
+        return None
+
+
+def stale_lines(record):
+    """The record's lines re-annotated for replay: ``stale: true`` +
+    provenance, headline moved last so drivers parsing the final line
+    read the last known hardware number instead of a CPU smoke."""
+    out = [{**ln, "stale": True,
+            "stale_recorded_at": ln.get("recorded_at",
+                                        record.get("recorded_at")),
+            "note": ("last known TPU measurement, replayed because the "
+                     "tunnel is wedged this run"
+                     + (" | " + ln["note"] if ln.get("note") else ""))}
+           for ln in record["lines"]]
+    out.sort(key=lambda ln: ln.get("metric") == HEADLINE_METRIC)
+    return out
+
 
 def _tpu_responsive(timeout_s: int = 180) -> bool:
     """Probe device execution in a subprocess: a wedged TPU tunnel hangs
     on the first op forever, and a hung bench records nothing for the
     round.  On timeout the bench falls back to the CPU mesh so the driver
     always gets its JSON lines."""
+    # the backend assertion matters: with a fast-FAILING plugin (vs a
+    # hanging one) jax silently falls back to CPU and the matmul
+    # succeeds — that must count as "TPU not responsive"
     probe = ("import jax, jax.numpy as jnp; "
+             "assert jax.default_backend() != 'cpu', 'cpu fallback'; "
              "r = jax.jit(lambda a: a @ a)(jnp.ones((128, 128))); "
              "print(float(r.sum()))")
     import subprocess
@@ -55,7 +121,8 @@ def main():
     # plugin is actually in play — a CPU-only host skips straight through.
     want_accel = (bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
                   or os.environ.get("JAX_PLATFORMS", "") in ("tpu", "axon"))
-    if want_accel and not _tpu_responsive():
+    wedged = want_accel and not _tpu_responsive()
+    if wedged:
         print("bench: TPU unresponsive, falling back to CPU mesh",
               file=sys.stderr)
         flags = os.environ.get("XLA_FLAGS", "")
@@ -77,8 +144,22 @@ def main():
     base = {"backend": jax.default_backend(), "ndev": ndev,
             "arch": jax.devices()[0].device_kind}
 
+    tpu_record_lines: list = []
+
     def emit(**kw):
-        print(json.dumps({**kw, **base}), flush=True)
+        line = {**kw, **base}
+        # clean hardware measurements feed the last-known-good record;
+        # error lines and hung-overlap-contaminated timings do not
+        if (on_tpu and line.get("value") is not None
+                and "error" not in line
+                and not line.get("overlapping_hung_configs")):
+            tpu_record_lines.append(line)
+            # save incrementally: the runbook's outer timeout can kill
+            # the process mid-suite (exactly the wedge case the record
+            # exists for), and an end-of-run save would lose every
+            # clean line already measured
+            save_tpu_record([line])
+        print(json.dumps(line), flush=True)
 
     def timed(train, state, batch, iters, warmup):
         """sec/step with a hard D2H fetch as the barrier —
@@ -395,6 +476,19 @@ def main():
             print(box["err"], file=sys.stderr)
             _raw_emit(metric=name, value=None, unit=None, vs_baseline=None,
                       error=box["err"].strip().splitlines()[-1], **extra)
+
+    if on_tpu:
+        save_tpu_record(tpu_record_lines)
+    elif want_accel:
+        # covers BOTH fallback shapes: the hang (wedged=True) and a
+        # fast-failing plugin that jax silently downgraded to CPU
+        rec = load_tpu_record()
+        if rec:
+            print("bench: replaying last known TPU record "
+                  f"({rec.get('recorded_at')}) with stale: true",
+                  file=sys.stderr)
+            for ln in stale_lines(rec):
+                print(json.dumps(ln), flush=True)
 
 
 if __name__ == "__main__":

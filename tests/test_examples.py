@@ -11,11 +11,13 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _run(args, timeout=420):
+def _run(args, timeout=420, extra_env=None):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU in children
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    if extra_env:
+        env.update(extra_env)
     return subprocess.run([sys.executable, *args], cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=timeout)
 
@@ -61,3 +63,32 @@ def test_bert_example_lamb_smoke():
               "--print-freq", "1"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "done" in r.stdout
+
+
+def test_cross_process_ddp_parity():
+    """VERDICT r3 item 5: the REAL make_step train loop (amp O2 +
+    FusedAdam + SyncBN + DDP allreduce) run across 2 real processes via
+    jax.distributed must produce a loss trajectory and final params
+    BITWISE equal to the single-process 2-device mesh — the DCN-shaped
+    analogue of the reference's 2-rank NCCL DDP tests
+    (tests/distributed/DDP/ddp_race_condition_test.py:28-68)."""
+    single = _run(["tests/cross_process_ddp_trainee.py"], extra_env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert single.returncode == 0, single.stderr[-2000:]
+
+    multi = _run(["-m", "apex_tpu.parallel.multiproc", "--nprocs", "2",
+                  "--backend", "cpu",
+                  "tests/cross_process_ddp_trainee.py"])
+    assert multi.returncode == 0, multi.stderr[-2000:]
+
+    def lines(out, prefix):
+        return [ln for ln in out.splitlines() if ln.startswith(prefix)]
+
+    traj_s, traj_m = lines(single.stdout, "traj"), lines(multi.stdout,
+                                                         "traj")
+    assert len(traj_s) == 6
+    assert traj_s == traj_m          # bitwise: float.hex per step
+    assert (lines(single.stdout, "params sha256")
+            == lines(multi.stdout, "params sha256"))
+    assert "world 1 processes 2 devices" in single.stdout
+    assert "world 2 processes 2 devices" in multi.stdout

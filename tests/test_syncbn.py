@@ -166,3 +166,50 @@ def test_syncbn_channel_last():
     assert out.shape == x.shape
     np.testing.assert_allclose(np.asarray(out).mean(axis=(0, 1, 2)), 0.0,
                                atol=1e-5)
+
+
+def test_axis_introspection_private_api_still_works(mesh):
+    """Pin the jax._src.core.unsafe_get_axis_names dependency (VERDICT r3
+    weak-5): _axis_in_scope must report False outside any mapped context
+    and True inside shard_map.  If a jax upgrade removes the symbol,
+    _axis_in_scope degrades to always-True (fail-loud-in-psum), which
+    makes the outside-check below fail — loudly, here, instead of
+    silently changing SyncBN behavior."""
+    from apex_tpu.parallel.sync_batchnorm import _axis_in_scope
+
+    # the introspection entry point itself must still exist
+    from jax._src import core as _core
+    assert hasattr(_core, "unsafe_get_axis_names"), (
+        "jax._src.core.unsafe_get_axis_names vanished — update "
+        "_axis_in_scope (apex_tpu/parallel/sync_batchnorm.py)")
+
+    assert not _axis_in_scope("data")   # no mapped axis at top level
+
+    def fn(x):
+        inside = _axis_in_scope("data")     # traced: python-level check
+        assert inside, "axis 'data' not visible inside shard_map"
+        assert not _axis_in_scope("nonexistent_axis")
+        return x
+
+    _shard_run(mesh, fn, jnp.ones((8,)), in_specs=(P("data"),),
+               out_specs=P("data"))
+
+
+def test_syncbn_variance_clamp_large_offset(mesh):
+    """Cross-device E[x^2]-mean^2 can round negative for |mean| >> std
+    (ADVICE r3): near-constant input at a large offset must not NaN
+    through rsqrt(var + eps)."""
+    # channel values ~N(1000.1, 1e-3): var ~1e-6 < fp32 rounding at 1e6
+    rng = np.random.RandomState(7)
+    x_np = (1000.1 + 1e-3 * rng.randn(16, 4, 4, 4)).astype(np.float32)
+    x = jnp.asarray(x_np)
+    sbn = SyncBatchNorm(4)
+    sparams, sstate = sbn.init(jax.random.PRNGKey(0))
+
+    def fn(xb):
+        out, _ = nn.apply(sbn, sparams, xb, state=sstate, train=True)
+        return out
+
+    out = _shard_run(mesh, fn, x, in_specs=(P("data"),),
+                     out_specs=P("data"))
+    assert np.isfinite(np.asarray(out)).all()

@@ -200,3 +200,21 @@ def test_dot_product_attention_segment_ids_paths_agree(monkeypatch):
                                rtol=2e-4, atol=2e-4)
     with pytest.raises(ValueError, match="requires"):
         dot_product_attention(q[0], k[0], v[0], segment_ids=seg)
+
+
+def test_attention_path_hook(monkeypatch):
+    """set_path_hook reports which backend dispatch resolved to (ADVICE
+    r3: parity harnesses need to pin the compiled path)."""
+    # pin dispatch: without this the assertion depends on the ambient
+    # APEX_TPU_FORCE_PALLAS / backend, which kernel-parity runs set
+    monkeypatch.setenv("APEX_TPU_DISABLE_PALLAS", "1")
+    from apex_tpu.transformer import attention
+    seen = []
+    attention.set_path_hook(seen.append)
+    try:
+        q = jnp.asarray(np.random.RandomState(0).randn(2, 2, 16, 8),
+                        jnp.float32)
+        attention.dot_product_attention(q, q, q, causal=True)
+    finally:
+        attention.set_path_hook(None)
+    assert seen == ["dense"]
