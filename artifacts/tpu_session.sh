@@ -36,6 +36,22 @@ timeout 1800 python artifacts/step_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/step_probe_$TS.log"
 stat $?
 
+log "convergence gate on real data (digits, O0 vs O2)"
+timeout 120 python examples/imagenet/make_digits_npz.py /tmp/digits32.npz
+stat $?
+# -b 64: single-chip global batch 64 keeps 22 iters/epoch from the
+# 1437-image train set and fits the 360-image val split (the example
+# refuses a val split smaller than one global batch at startup)
+for OL in O0 O2; do
+    timeout 1200 python examples/imagenet/main_amp.py \
+        --data /tmp/digits32.npz --arch resnet18 --image-size 32 \
+        -b 64 --epochs 10 --iters 1000 --lr 0.05 --lr-decay-epochs 4 \
+        --warmup-epochs 1 --opt-level $OL --target-acc 90 \
+        --print-freq 50 2>&1 | grep -E "Prec@1|FINAL|gate|compiled" \
+        | tee "artifacts/convergence_${OL}_$TS.log"
+    stat $?
+done
+
 log "layout probe (CSE-fixed)"
 timeout 900 python artifacts/layout_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/layout_probe_$TS.log"

@@ -39,8 +39,17 @@ def parse_args():
     p.add_argument("--iters", type=int, default=100,
                    help="iterations per epoch (synthetic data)")
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--lr-decay-epochs", type=int, default=30,
+                   help="epoch period of the reference's step decay "
+                        "(lr * 0.1^(epoch//N), main_amp.py:490-501)")
+    p.add_argument("--warmup-epochs", type=int, default=0,
+                   help="linear LR warmup epochs (reference's scaled-LR "
+                        "recipe ramps over the first 5 epochs)")
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--target-acc", type=float, default=None,
+                   help="exit non-zero unless final val Prec@1 reaches "
+                        "this (convergence gate)")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--opt-level", default="O2")
@@ -93,26 +102,14 @@ def main():
         print("using apex_tpu synced BN")
         model = parallel.convert_syncbn_model(model)
 
-    if args.fused_adam:
-        optimizer = optimizers.FusedAdam(lr=args.lr,
-                                         weight_decay=args.weight_decay)
-    else:
-        optimizer = optimizers.SGD(lr=args.lr, momentum=args.momentum,
-                                   weight_decay=args.weight_decay)
-
-    model, optimizer = amp.initialize(
-        model, optimizer, opt_level=args.opt_level,
-        keep_batchnorm_fp32=args.keep_batchnorm_fp32,
-        loss_scale=args.loss_scale, half_dtype=args.half_dtype)
-    ddp = parallel.DistributedDataParallel(model)
-
-    params, bn_state = model.init(jax.random.PRNGKey(args.seed))
-    opt_state = optimizer.init(params)
-
     global_batch = args.batch_size * ndev
     rng = np.random.RandomState(args.seed)
+    val_images = val_labels = None
     if args.data:
         blob = np.load(args.data)
+        if "val_images" in getattr(blob, "files", ()):
+            val_images = blob["val_images"]
+            val_labels = blob["val_labels"].astype(np.int32)
         if len(blob["images"]) < global_batch:
             raise SystemExit(
                 f"dataset has {len(blob['images'])} images < one global "
@@ -162,6 +159,63 @@ def main():
         def get_batch(i):
             return images_all, labels_all
 
+    # fail misconfigurations at startup, not after an epoch of training:
+    # a convergence gate needs a val split, and the val split must cover
+    # at least one global batch
+    if args.target_acc is not None and val_images is None:
+        raise SystemExit("--target-acc set but the data blob has no "
+                         "val_images/val_labels split — the gate would "
+                         "silently never run")
+    if val_images is not None and len(val_images) < global_batch:
+        raise SystemExit(f"val split ({len(val_images)}) smaller than one "
+                         f"global batch ({global_batch}); lower "
+                         f"--batch-size")
+    # preprocess the val split ONCE (not per epoch): same normalization
+    # the training loader applies
+    val_x = None
+    if val_images is not None:
+        if val_images.dtype == np.uint8 and val_images.shape[-1] == 3:
+            from apex_tpu import _native
+            from apex_tpu.data import IMAGENET_MEAN, IMAGENET_STD
+            val_x = _native.preprocess_images(val_images, IMAGENET_MEAN,
+                                              IMAGENET_STD, fmt)
+        else:
+            val_x = val_images.astype(np.float32)
+            if fmt == "NHWC":
+                val_x = np.ascontiguousarray(val_x.transpose(0, 2, 3, 1))
+
+    # LR recipe after the data section so the schedule knows the real
+    # iters/epoch: the reference's step decay lr * 0.1^(epoch // N)
+    # (main_amp.py:490-501) plus optional linear warmup, expressed as a
+    # step->lr schedule traced into the jitted step (no re-compile on
+    # epoch boundaries)
+    iters_per_epoch = max(args.iters, 1)
+
+    def lr_schedule(step):
+        epoch = step // iters_per_epoch
+        lr = args.lr * jnp.power(
+            0.1, (epoch // args.lr_decay_epochs).astype(jnp.float32))
+        if args.warmup_epochs:
+            warm = args.warmup_epochs * iters_per_epoch
+            lr = lr * jnp.minimum(1.0, (step + 1.0) / warm)
+        return lr
+
+    if args.fused_adam:
+        optimizer = optimizers.FusedAdam(lr=lr_schedule,
+                                         weight_decay=args.weight_decay)
+    else:
+        optimizer = optimizers.SGD(lr=lr_schedule, momentum=args.momentum,
+                                   weight_decay=args.weight_decay)
+
+    model, optimizer = amp.initialize(
+        model, optimizer, opt_level=args.opt_level,
+        keep_batchnorm_fp32=args.keep_batchnorm_fp32,
+        loss_scale=args.loss_scale, half_dtype=args.half_dtype)
+    ddp = parallel.DistributedDataParallel(model)
+
+    params, bn_state = model.init(jax.random.PRNGKey(args.seed))
+    opt_state = optimizer.init(params)
+
     mesh = Mesh(np.array(jax.devices()), ("data",))
 
     def step(state, batch):
@@ -186,6 +240,34 @@ def main():
         step, mesh=mesh,
         in_specs=(P(), (P("data"), P("data"))),
         out_specs=(P(), P()), check_vma=False))
+
+    # validation pass (reference's validate(), main_amp.py:330-390):
+    # eval-mode forward over the held-out split, Prec@1 pmean'd
+    def _eval(state, batch):
+        params, bn_st, _ = state
+        x, y = batch
+        out, _ = model.apply(params, x, state=bn_st, train=False)
+        acc = jnp.mean((jnp.argmax(out, -1) == y).astype(jnp.float32))
+        return lax.pmean(acc, "data") * 100.0
+
+    eval_step = jax.jit(jax.shard_map(
+        _eval, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
+        out_specs=P(), check_vma=False))
+
+    def validate(state):
+        if val_x is None:
+            return None
+        nvb = len(val_x) // global_batch
+        accs = []
+        for i in range(nvb):
+            s = i * global_batch
+            accs.append(float(eval_step(
+                state, (jnp.asarray(val_x[s:s + global_batch]),
+                        jnp.asarray(val_labels[s:s + global_batch])))))
+        return float(np.mean(accs))
+
+    n_val_eval = (0 if val_x is None
+                  else len(val_x) // global_batch * global_batch)
 
     state = (params, bn_state, opt_state)
 
@@ -214,6 +296,7 @@ def main():
     batch_time = AverageMeter()
     losses = AverageMeter()
     top1 = AverageMeter()
+    val_acc = None
 
     for epoch in range(start_epoch, args.epochs):
         end = time.time()
@@ -238,6 +321,12 @@ def main():
                       f"Loss {losses.val:.4f} ({losses.avg:.4f})  "
                       f"Prec@1 {top1.val:.2f}  "
                       f"scale {float(metrics['loss_scale']):.0f}")
+        val_acc = validate(state)
+        if val_acc is not None:
+            # n_val_eval, not len(val_labels): the remainder batch is
+            # dropped, and claiming otherwise would misreport the gate
+            print(f" * Prec@1 {val_acc:.3f}  (epoch {epoch}, "
+                  f"{n_val_eval} val images)")
         if args.checkpoint_dir:
             from apex_tpu.utils import checkpoint as ckpt
             ckpt.save_checkpoint(args.checkpoint_dir, epoch + 1, state,
@@ -245,6 +334,19 @@ def main():
     ips = global_batch / batch_time.avg
     print(f"=> done. avg {ips:.1f} img/s over {args.iters} iters "
           f"({ips / ndev:.1f} img/s/device)")
+    # val_acc already covers the final state: the last loop iteration
+    # validated after the last step
+    if val_acc is None:
+        val_acc = validate(state)
+    if val_acc is not None:
+        print(f"=> FINAL val Prec@1 {val_acc:.3f}")
+        if args.target_acc is not None and val_acc < args.target_acc:
+            raise SystemExit(
+                f"convergence gate FAILED: val Prec@1 {val_acc:.2f} < "
+                f"target {args.target_acc}")
+        if args.target_acc is not None:
+            print(f"=> convergence gate PASSED "
+                  f"(>= {args.target_acc})")
     return ips
 
 

@@ -1,0 +1,43 @@
+"""Build a small REAL-image dataset npz for the convergence gate.
+
+The container has no ImageNet/CIFAR and no network; sklearn ships the
+UCI handwritten-digits set (1797 real 8x8 grayscale scans, 10 classes).
+This upsamples them to 32x32 RGB uint8 NHWC — the exact blob contract of
+``main_amp.py --data`` (uint8 NHWC routes through the native prefetching
+DataLoader) — with a held-out val split for the Prec@1 gate.
+
+    python examples/imagenet/make_digits_npz.py /tmp/digits.npz
+    python examples/imagenet/main_amp.py --data /tmp/digits.npz \
+        --arch resnet18 --image-size 32 -b 16 --epochs 5 \
+        --target-acc 90
+"""
+
+import sys
+
+import numpy as np
+
+
+def build(path: str, val_count: int = 360, upsample: int = 4,
+          seed: int = 0) -> dict:
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    images = d.images.astype(np.float32)        # (1797, 8, 8), values 0..16
+    labels = d.target.astype(np.int32)
+    # deterministic shuffle BEFORE the split: the set is ordered by digit
+    perm = np.random.RandomState(seed).permutation(len(images))
+    images, labels = images[perm], labels[perm]
+    u8 = np.clip(images * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    u8 = u8.repeat(upsample, axis=1).repeat(upsample, axis=2)
+    u8 = np.repeat(u8[..., None], 3, axis=-1)   # grayscale -> RGB NHWC
+    blob = {"images": u8[val_count:], "labels": labels[val_count:],
+            "val_images": u8[:val_count], "val_labels": labels[:val_count]}
+    np.savez_compressed(path, **blob)
+    return blob
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/digits.npz"
+    up = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    blob = build(out, upsample=up)
+    print(f"wrote {out}: train {blob['images'].shape} "
+          f"val {blob['val_images'].shape}")
