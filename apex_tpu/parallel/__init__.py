@@ -10,6 +10,9 @@ from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, flat_dist_call)
 from .sync_batchnorm import SyncBatchNorm
 from .LARC import LARC
+from . import tensor_parallel
+from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
+                              ParallelMLP, ParallelSelfAttention)
 
 
 class ReduceOp:

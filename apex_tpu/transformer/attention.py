@@ -162,7 +162,8 @@ class MultiheadAttention(Module):
                  bias: bool = True):
         super().__init__()
         if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
+            raise ValueError(f"num_heads ({num_heads}) must divide "
+                             f"embed_dim ({embed_dim})")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads

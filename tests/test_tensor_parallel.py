@@ -1,0 +1,241 @@
+"""Tensor-parallel layer parity: sharded column/row linears, the MLP
+block, and head-sharded attention must match their dense single-device
+equivalents bitwise-closely — outputs AND gradients — on the virtual
+mesh, with params entering shard_map through partition_specs.
+
+(Beyond the reference: SURVEY.md §2.3 lists its parallelism inventory as
+data-parallel only.  These are the Megatron patterns expressed as mesh
+collectives.)
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import nn
+from apex_tpu.nn import functional as F
+from apex_tpu.parallel import tensor_parallel as tp
+from apex_tpu.parallel import DistributedDataParallel
+
+
+def tp_mesh(tp_size=4):
+    return Mesh(np.array(jax.devices()[:tp_size]), ("model",))
+
+
+def _run_sharded(mesh, fn, params, specs, *args, arg_specs=None,
+                 out_specs=P()):
+    arg_specs = arg_specs or tuple(P() for _ in args)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(specs, *arg_specs), out_specs=out_specs,
+        check_vma=False))(params, *args)
+
+
+def test_column_row_mlp_matches_dense():
+    mesh = tp_mesh(4)
+    mlp = tp.ParallelMLP(16, 64)
+    params, _ = mlp.init(jax.random.PRNGKey(0))
+    specs = tp.partition_specs(mlp, params)
+    # specs mark the TP dims
+    assert specs["fc_in"]["weight"] == P("model", None)
+    assert specs["fc_in"]["bias"] == P("model")
+    assert specs["fc_out"]["weight"] == P(None, "model")
+    assert specs["fc_out"]["bias"] == P()
+
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6, 16), jnp.float32)
+
+    def fwd(p, xb):
+        return mlp(p, xb)
+
+    y_tp = _run_sharded(mesh, fwd, params, specs, x)
+    # dense reference: same math on the full params outside any mesh
+    y_ref = mlp(params, x)
+    np.testing.assert_allclose(np.asarray(y_tp), np.asarray(y_ref),
+                               atol=2e-5)
+
+
+def test_mlp_gradients_match_dense():
+    mesh = tp_mesh(4)
+    mlp = tp.ParallelMLP(8, 32, activation="relu")
+    params, _ = mlp.init(jax.random.PRNGKey(1))
+    specs = tp.partition_specs(mlp, params)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 5, 8), jnp.float32)
+
+    def loss(p, xb):
+        return jnp.sum(jnp.square(mlp(p, xb)))
+
+    g_tp = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh, in_specs=(specs, P()),
+        out_specs=specs, check_vma=False))(params, x)
+    g_ref = jax.grad(loss)(params, x)
+    _assert_trees_close(g_tp, g_ref, atol=2e-4)
+
+
+def _assert_trees_close(a, b, atol):
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert [jax.tree_util.keystr(p) for p, _ in fa] == \
+        [jax.tree_util.keystr(p) for p, _ in fb]
+    for (pa, xa), (_, xb) in zip(fa, fb):
+        np.testing.assert_allclose(
+            np.asarray(xa), np.asarray(xb), atol=atol,
+            err_msg=jax.tree_util.keystr(pa))
+
+
+def test_column_gather_output():
+    mesh = tp_mesh(4)
+    col = tp.ColumnParallelLinear(8, 16, gather_output=True)
+    params, _ = col.init(jax.random.PRNGKey(2))
+    specs = tp.partition_specs(col, params)
+    x = jnp.asarray(np.random.RandomState(2).randn(3, 8), jnp.float32)
+    y = _run_sharded(mesh, lambda p, xb: col(p, xb), params, specs, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(col(params, x)),
+                               atol=2e-5)
+    assert y.shape == (3, 16)
+
+    # gradient path: the all_gather must transpose to SPLIT, not
+    # reduce-scatter of the replicated cotangent (axis_size inflation)
+    def loss(p, xb):
+        return jnp.sum(jnp.square(col(p, xb)))
+
+    g_tp = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh, in_specs=(specs, P()),
+        out_specs=specs, check_vma=False))(params, x)
+    _assert_trees_close(g_tp, jax.grad(loss)(params, x), atol=2e-4)
+
+
+def test_row_scatter_input():
+    """input_is_parallel=False: a replicated input is sliced down to the
+    device's feature block before the local contraction."""
+    mesh = tp_mesh(4)
+    row = tp.RowParallelLinear(16, 8, input_is_parallel=False)
+    params, _ = row.init(jax.random.PRNGKey(3))
+    specs = tp.partition_specs(row, params)
+    x = jnp.asarray(np.random.RandomState(3).randn(3, 16), jnp.float32)
+    y = _run_sharded(mesh, lambda p, xb: row(p, xb), params, specs, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(row(params, x)),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_parallel_attention_matches_dense(causal):
+    mesh = tp_mesh(4)
+    attn = tp.ParallelSelfAttention(32, 8, causal=causal)
+    params, _ = attn.init(jax.random.PRNGKey(4))
+    specs = tp.partition_specs(attn, params)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 10, 32) * 0.3,
+                    jnp.float32)
+
+    def fwd(p, xb):
+        out, _ = nn.apply(attn, p, xb, train=False)
+        return out
+
+    y_tp = _run_sharded(mesh, fwd, params, specs, x)
+    y_ref = fwd(params, x)
+    np.testing.assert_allclose(np.asarray(y_tp), np.asarray(y_ref),
+                               atol=3e-5)
+
+    # head-sharded attention grads: one f at block entry covers q/k/v
+    def loss(p, xb):
+        return jnp.sum(jnp.square(fwd(p, xb)))
+
+    g_tp = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh, in_specs=(specs, P()),
+        out_specs=specs, check_vma=False))(params, x)
+    _assert_trees_close(g_tp, jax.grad(loss)(params, x), atol=5e-4)
+
+
+def test_attention_head_divisibility_check():
+    mesh = tp_mesh(4)
+    attn = tp.ParallelSelfAttention(12, 6)   # 6 heads, tp=4: invalid
+    params, _ = attn.init(jax.random.PRNGKey(5))
+    specs = tp.partition_specs(attn, params)
+    x = jnp.zeros((1, 4, 12))
+    with pytest.raises(ValueError, match="not divisible"):
+        _run_sharded(mesh, lambda p, xb: nn.apply(attn, p, xb)[0],
+                     params, specs, x)
+
+
+def test_dp_tp_combined_train_step():
+    """2x4 (data, model) mesh: batch over data, TP params over model,
+    DDP allreduce over data only — one step must match the single-device
+    full-batch dense step."""
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    mlp = tp.ParallelMLP(8, 32, activation="relu")
+    params, _ = mlp.init(jax.random.PRNGKey(6))
+    specs = tp.partition_specs(mlp, params)
+    ddp = DistributedDataParallel(mlp)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    lr = 0.1
+
+    def step(p, xb, yb):
+        def loss_fn(pp):
+            return F.mse_loss(mlp(pp, xb), yb)
+        grads = jax.grad(loss_fn)(p)
+        grads = ddp.allreduce_grads(grads)     # data axis only
+        return jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+
+    new_tp = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(specs, P("data"), P("data")),
+        out_specs=specs, check_vma=False))(params, x, y)
+
+    def ref_step(p):
+        grads = jax.grad(lambda pp: F.mse_loss(mlp(pp, x), y))(p)
+        return jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+
+    new_ref = ref_step(params)
+    for a, b in zip(jax.tree_util.tree_leaves(new_tp),
+                    jax.tree_util.tree_leaves(new_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_parallel_attention_per_head_mask():
+    """A (B, num_heads, Tq, Tk) mask is sliced to the device's head
+    block, matching the dense full-head computation."""
+    mesh = tp_mesh(4)
+    attn = tp.ParallelSelfAttention(32, 8)
+    params, _ = attn.init(jax.random.PRNGKey(7))
+    specs = tp.partition_specs(attn, params)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 6, 32) * 0.3, jnp.float32)
+    mask = jnp.asarray(rng.rand(2, 8, 6, 6) > 0.3)
+
+    def fwd(p, xb, mb):
+        out, _ = nn.apply(attn, p, xb, mask=mb, train=False)
+        return out
+
+    y_tp = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P(),
+        check_vma=False))(params, x, mask)
+    np.testing.assert_allclose(np.asarray(y_tp),
+                               np.asarray(fwd(params, x, mask)),
+                               atol=3e-5)
+
+
+def test_parallel_attention_train_dropout_decorrelated():
+    """Train-mode output dropout folds the model-axis index into the rng
+    so shards don't reuse one mask; smoke: runs, differs from eval."""
+    mesh = tp_mesh(4)
+    attn = tp.ParallelSelfAttention(32, 8, dropout=0.5)
+    params, _ = attn.init(jax.random.PRNGKey(8))
+    specs = tp.partition_specs(attn, params)
+    x = jnp.asarray(np.random.RandomState(8).randn(2, 6, 32) * 0.3,
+                    jnp.float32)
+
+    def fwd(p, xb, train):
+        out, _ = nn.apply(attn, p, xb, train=train,
+                          rng=jax.random.PRNGKey(0))
+        return out
+
+    y_train = jax.jit(jax.shard_map(
+        lambda p, xb: fwd(p, xb, True), mesh=mesh,
+        in_specs=(specs, P()), out_specs=P(), check_vma=False))(params, x)
+    y_eval = jax.jit(jax.shard_map(
+        lambda p, xb: fwd(p, xb, False), mesh=mesh,
+        in_specs=(specs, P()), out_specs=P(), check_vma=False))(params, x)
+    assert np.isfinite(np.asarray(y_train)).all()
+    assert np.abs(np.asarray(y_train) - np.asarray(y_eval)).max() > 1e-4
