@@ -13,6 +13,7 @@ from .LARC import LARC
 from . import tensor_parallel
 from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
                               ParallelMLP, ParallelSelfAttention)
+from . import pipeline
 
 
 class ReduceOp:

@@ -1,0 +1,131 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipelining over a
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 — its
+inventory is data-parallel only); this is the TPU-native completion of
+the parallelism surface: homogeneous stages laid out along a ``pp`` mesh
+axis, microbatches streamed through with ``lax.ppermute`` neighbor
+exchanges (ICI hops), the whole schedule expressed as one ``lax.scan``
+XLA can pipeline — no host-side scheduler process the way GPipe/
+PipeDream builds one, because under SPMD every device runs the same
+compiled loop.
+
+Scheme (the classic M-microbatch, S-stage wavefront):
+
+- stage parameters are STACKED on a leading axis: ``init_stacked`` gives
+  a (S, ...) tree, sharded ``P("pp")`` so each device holds one stage's
+  slice (squeezed inside the loop body);
+- the scan runs ``M + S - 1`` ticks; at tick t, stage 0 feeds
+  microbatch t (zeros once the real ones run out), every stage applies
+  its block to its current input and ``ppermute``-shifts the result to
+  stage s+1;
+- the last stage scatters each finished microbatch into an output
+  buffer; a masked psum with identity-backward
+  (``reduce_from_model_parallel``) replicates the buffer without the
+  axis-size gradient inflation a plain psum transpose would cause.
+
+Autodiff: ppermute transposes to the inverse permutation, scan to a
+reverse-time scan — so backward is automatically the reverse wavefront
+(activations rematerialized per jax defaults; wrap ``block`` in
+``jax.checkpoint`` for GPipe's activation-recompute memory profile).
+
+Composes with data parallelism on a second mesh axis (shard the
+microbatch batch dim over ``data``) and with tensor parallelism inside
+the block (``tensor_parallel`` layers over a third axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..nn.module import Module
+from .sync_batchnorm import _axis_in_scope
+from .tensor_parallel import (copy_to_model_parallel,
+                              reduce_from_model_parallel)
+
+__all__ = ["init_stacked", "stacked_specs", "pipeline_apply"]
+
+DEFAULT_AXIS = "pp"
+
+
+def init_stacked(block: Module, key: jax.Array, n_stages: int):
+    """(S, ...) stacked params for ``n_stages`` copies of ``block``
+    (independent init per stage, like S separately-initialized layers)."""
+    keys = jax.random.split(key, n_stages)
+    trees = [block.init(k)[0] for k in keys]
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)
+
+
+def stacked_specs(stacked_params: Any,
+                  axis_name: str = DEFAULT_AXIS) -> Any:
+    """PartitionSpec tree sharding the stage axis: ``P(axis_name)`` on
+    every leaf's leading dim."""
+    return jax.tree_util.tree_map(
+        lambda l: P(axis_name, *([None] * (l.ndim - 1))), stacked_params)
+
+
+def pipeline_apply(block: Module, stacked_params: Any, x: jax.Array,
+                   axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """Run microbatches ``x: (M, B, ...)`` through the S-stage pipeline.
+
+    Call inside ``shard_map`` with ``stacked_params`` sharded by
+    :func:`stacked_specs` (each device sees a (1, ...) slice) and ``x``
+    replicated along ``axis_name``.  Returns the (M, B, ...) outputs,
+    replicated.  Outside any mesh, applies the S stages sequentially —
+    the single-device degradation.
+    """
+    if not _axis_in_scope(axis_name):
+        # single-device degradation: apply the S stages sequentially,
+        # vmapped over the microbatch axis
+        out = x
+        S = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        for s in range(S):
+            p = jax.tree_util.tree_map(lambda l: l[s], stacked_params)
+            out = jax.vmap(lambda mb, p=p: block(p, mb))(out)
+        return out
+
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # f-collective on the input: x's cotangent accumulates only on the
+    # stage-0 device (the injection path); the psum-backward makes the
+    # replicated-input gradient actually replicated, so upstream layers
+    # (embeddings etc.) train identically on every pp rank
+    x = copy_to_model_parallel(x, axis_name)
+    M = x.shape[0]
+    local_p = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
+    zero_in = jnp.zeros_like(x[0])
+    out_buf = jnp.zeros((M,) + x.shape[1:], x.dtype)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        # stage 0 injects microbatch t (zeros during the drain phase);
+        # other stages consume what the previous tick delivered
+        mb = lax.dynamic_index_in_dim(x, jnp.minimum(t, M - 1), 0,
+                                      keepdims=False)
+        inp = jnp.where(idx == 0,
+                        jnp.where(t < M, mb, zero_in), recv)
+        y = block(local_p, inp)
+        # the last stage finished microbatch t - (S - 1) this tick
+        done_t = t - (S - 1)
+        is_last = idx == S - 1
+        valid = jnp.logical_and(done_t >= 0, is_last)
+        out_buf = lax.cond(
+            valid,
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, y, jnp.maximum(done_t, 0), 0),
+            lambda b: b, out_buf)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return (nxt, out_buf), None
+
+    (_, out_buf), _ = lax.scan(tick, (zero_in, out_buf),
+                               jnp.arange(M + S - 1))
+    # replicate the last stage's buffer; identity-backward psum so the
+    # replicated downstream loss doesn't inflate gradients S-fold
+    mask = (idx == S - 1).astype(out_buf.dtype)
+    return reduce_from_model_parallel(out_buf * mask, axis_name)

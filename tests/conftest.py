@@ -92,3 +92,17 @@ if not os.environ.get("APEX_TPU_NO_COMPILE_CACHE"):
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def assert_trees_close(a, b, atol):
+    """Pytree comparison with structure check and key-path error labels
+    (shared by the tensor/pipeline parallel parity tests)."""
+    import numpy as _np
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert [jax.tree_util.keystr(p) for p, _ in fa] == \
+        [jax.tree_util.keystr(p) for p, _ in fb]
+    for (pa, xa), (_, xb) in zip(fa, fb):
+        _np.testing.assert_allclose(
+            _np.asarray(xa), _np.asarray(xb), atol=atol,
+            err_msg=jax.tree_util.keystr(pa))

@@ -72,15 +72,7 @@ def test_mlp_gradients_match_dense():
     _assert_trees_close(g_tp, g_ref, atol=2e-4)
 
 
-def _assert_trees_close(a, b, atol):
-    fa = jax.tree_util.tree_flatten_with_path(a)[0]
-    fb = jax.tree_util.tree_flatten_with_path(b)[0]
-    assert [jax.tree_util.keystr(p) for p, _ in fa] == \
-        [jax.tree_util.keystr(p) for p, _ in fb]
-    for (pa, xa), (_, xb) in zip(fa, fb):
-        np.testing.assert_allclose(
-            np.asarray(xa), np.asarray(xb), atol=atol,
-            err_msg=jax.tree_util.keystr(pa))
+from conftest import assert_trees_close as _assert_trees_close  # noqa: E402
 
 
 def test_column_gather_output():
@@ -188,9 +180,7 @@ def test_dp_tp_combined_train_step():
         return jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
 
     new_ref = ref_step(params)
-    for a, b in zip(jax.tree_util.tree_leaves(new_tp),
-                    jax.tree_util.tree_leaves(new_ref)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    _assert_trees_close(new_tp, new_ref, atol=2e-5)
 
 
 def test_parallel_attention_per_head_mask():
