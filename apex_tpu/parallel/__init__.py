@@ -14,6 +14,8 @@ from . import tensor_parallel
 from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
                               ParallelMLP, ParallelSelfAttention)
 from . import pipeline
+from . import expert_parallel
+from .expert_parallel import ExpertParallelMLP
 
 
 class ReduceOp:

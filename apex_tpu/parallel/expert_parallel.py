@@ -1,0 +1,155 @@
+"""Expert parallelism: Switch-style Mixture-of-Experts over a mesh axis.
+
+The reference predates MoE entirely; this completes apex_tpu's
+parallelism surface (dp/tp/pp/sp/ep).  The design is the GShard/Switch
+SPMD pattern in shard_map form:
+
+- the ``expert`` mesh axis shards BOTH the tokens (data-style) and the
+  expert homes: device d holds tokens-shard d and experts
+  ``[d*E/ep, (d+1)*E/ep)``;
+- each device routes its local tokens (replicated router weights),
+  builds a capacity-bounded dispatch tensor, and one ``all_to_all``
+  ships every token to the device owning its expert; the expert MLPs
+  run as one vmapped batch; the reverse ``all_to_all`` brings results
+  home, where the gate-weighted combine reads them back;
+- tokens over an expert's capacity are DROPPED (contribute zero), the
+  standard Switch behavior — size everything with ``capacity_factor``.
+
+Communication per layer: two all_to_alls (forward) — their transposes
+are all_to_alls again, so backward needs no f/g correction the way
+psum-based TP does.
+
+Router: top-1 (Switch).  The auxiliary load-balancing loss
+(Switch eq. 4: E * sum_e f_e * P_e) is returned by ``forward`` when
+``return_aux_loss`` — add ``aux_weight * aux`` to the task loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..nn.module import Module
+from ..nn import functional as F
+from .sync_batchnorm import _axis_in_scope
+
+__all__ = ["ExpertParallelMLP"]
+
+DEFAULT_AXIS = "expert"
+
+
+class ExpertParallelMLP(Module):
+    """Top-1 routed MoE MLP; experts sharded over ``axis_name``.
+
+    Params: ``router`` (d, E) replicated; ``w_in`` (E, d, hidden) and
+    ``w_out`` (E, hidden, d) sharded on the expert dim (see
+    ``param_specs``).  Call inside shard_map with tokens sharded over
+    the same axis; outside any mesh all experts run locally.
+    """
+
+    def __init__(self, embed_dim: int, hidden_dim: int, n_experts: int,
+                 capacity_factor: float = 1.25,
+                 activation: str = "gelu",
+                 axis_name: str = DEFAULT_AXIS):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.hidden_dim = hidden_dim
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.axis_name = axis_name
+
+    def create_params(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        d, h, E = self.embed_dim, self.hidden_dim, self.n_experts
+        s_in = (2.0 / d) ** 0.5
+        s_out = (2.0 / h) ** 0.5
+        return {
+            "router": jax.random.normal(k1, (d, E), jnp.float32) * 0.02,
+            "w_in": jax.random.normal(k2, (E, d, h), jnp.float32) * s_in,
+            "w_out": jax.random.normal(k3, (E, h, d), jnp.float32) * s_out,
+        }
+
+    def param_specs(self) -> Dict[str, P]:
+        return {"router": P(),
+                "w_in": P(self.axis_name, None, None),
+                "w_out": P(self.axis_name, None, None)}
+
+    # -- routing ----------------------------------------------------------
+    def _dispatch(self, x2d: jax.Array, router: jax.Array, capacity: int
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(dispatch (T,E,C) one-hot, combine (T,E,C) gate-weighted,
+        aux load-balance loss) for the local token block."""
+        T = x2d.shape[0]
+        E = self.n_experts
+        logits = x2d.astype(jnp.float32) @ router
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                    # (T,)
+        gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T,E)
+        # position of each token within its expert's queue (prefix count)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (T,E)
+        keep = (pos >= 0) & (pos < capacity)
+        disp = onehot * keep                                   # (T,E)
+        posc = jax.nn.one_hot(
+            jnp.sum(pos * onehot, -1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                                 # (T,C)
+        dispatch = disp[:, :, None] * posc[:, None, :]         # (T,E,C)
+        combine = dispatch * gate[:, None, None]
+        # Switch aux loss: fraction routed f_e x mean prob P_e, scaled E
+        f_e = jnp.mean(onehot, axis=0)
+        p_e = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f_e * p_e)
+        return dispatch, combine, aux
+
+    def _expert_mlp(self, params, xe):
+        """xe: (E_local, S, d) -> (E_local, S, d), vmapped over experts."""
+        act = getattr(F, self.activation)
+
+        def one(w_in, w_out, t):
+            return act(t @ w_in.astype(t.dtype)) @ w_out.astype(t.dtype)
+
+        return jax.vmap(one)(params["w_in"], params["w_out"], xe)
+
+    def forward(self, params, x, return_aux_loss: bool = False):
+        *lead, d = x.shape
+        x2d = x.reshape(-1, d)
+        T = x2d.shape[0]
+        E = self.n_experts
+        ep = (lax.axis_size(self.axis_name)
+              if _axis_in_scope(self.axis_name) else 1)
+        if E % ep:
+            raise ValueError(f"n_experts={E} not divisible by expert-"
+                             f"parallel size {ep}")
+        capacity = max(1, math.ceil(self.capacity_factor * T / E))
+        dispatch, combine, aux = self._dispatch(x2d, params["router"],
+                                                capacity)
+        # (T,E,C) x (T,d) -> (E,C,d): the local contribution per expert
+        sent = jnp.einsum("tec,td->ecd", dispatch.astype(x2d.dtype), x2d)
+        if ep > 1:
+            e_loc = E // ep
+            # (E,C,d) -> (ep, e_loc, C, d) -all_to_all-> every device
+            # ends up with ITS experts' queues from all source devices
+            sent = sent.reshape(ep, e_loc, capacity, d)
+            recv = lax.all_to_all(sent, self.axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+            # (ep_src, e_loc, C, d) -> (e_loc, ep_src*C, d)
+            xe = jnp.moveaxis(recv, 0, 1).reshape(e_loc, ep * capacity, d)
+            ye = self._expert_mlp(
+                {"w_in": params["w_in"], "w_out": params["w_out"]}, xe)
+            back = jnp.moveaxis(
+                ye.reshape(e_loc, ep, capacity, d), 1, 0)
+            got = lax.all_to_all(back, self.axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+            got = got.reshape(E, capacity, d)
+        else:
+            got = self._expert_mlp(
+                {"w_in": params["w_in"], "w_out": params["w_out"]}, sent)
+        y2d = jnp.einsum("tec,ecd->td", combine.astype(got.dtype), got)
+        y = y2d.reshape(*lead, d)
+        return (y, aux) if return_aux_loss else y

@@ -57,7 +57,8 @@ from .sync_batchnorm import _axis_in_scope
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "ParallelMLP",
-    "ParallelSelfAttention", "partition_specs",
+    "ParallelSelfAttention", "VocabParallelEmbedding",
+    "vocab_parallel_cross_entropy", "partition_specs",
 ]
 
 DEFAULT_AXIS = "model"
@@ -325,6 +326,97 @@ class ParallelSelfAttention(Module):
                                          lax.axis_index(self.axis_name))
             ctx = F.dropout(ctx, self.dropout_rate, key)
         return self.out(params["out"], ctx)
+
+
+class VocabParallelEmbedding(Module):
+    """Embedding with the VOCAB dimension sharded over the model axis —
+    the largest single weight in BERT-class models (vocab x hidden).
+
+    Each device holds a contiguous vocab block; a lookup masks ids
+    outside its block to a local zero row, gathers, and the g-collective
+    psum combines the one-hot contributions (exactly one device is
+    nonzero per id).  Megatron's VocabParallelEmbedding as mesh
+    collectives.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 axis_name: str = DEFAULT_AXIS):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.axis_name = axis_name
+
+    def create_params(self, key):
+        return {"weight": jax.random.normal(
+            key, (self.num_embeddings, self.embedding_dim), jnp.float32)}
+
+    def param_specs(self) -> Dict[str, P]:
+        return {"weight": P(self.axis_name, None)}
+
+    def forward(self, params, ids):
+        w = params["weight"]
+        if not _axis_in_scope(self.axis_name):
+            return F.embedding(ids, w)
+        tp = lax.axis_size(self.axis_name)
+        if self.num_embeddings % tp:
+            raise ValueError(f"num_embeddings={self.num_embeddings} not "
+                             f"divisible by tensor-parallel size {tp}")
+        idx = lax.axis_index(self.axis_name)
+        # derive the block from the actual local shard so a manually
+        # padded table stays consistent with the mask math
+        block = w.shape[0]
+        start = idx * block
+        local = ids - start
+        in_block = (local >= 0) & (local < block)
+        rows = jnp.take(w, jnp.where(in_block, local, 0), axis=0)
+        rows = jnp.where(in_block[..., None], rows, 0.0)
+        return reduce_from_model_parallel(rows, self.axis_name)
+
+
+def vocab_parallel_cross_entropy(local_logits: jax.Array,
+                                 labels: jax.Array,
+                                 axis_name: str = DEFAULT_AXIS,
+                                 ignore_index: int = -100) -> jax.Array:
+    """Cross-entropy over VOCAB-SHARDED logits without gathering them.
+
+    ``local_logits``: (..., V/tp) — this device's vocab block (e.g. the
+    output of a ColumnParallelLinear LM head with gather_output=False).
+    The softmax statistics are combined with two scalar-per-token
+    collectives (pmax for the stable max, psum for the normalizer) and
+    the label's logit is picked out by the one device owning it —
+    communication O(tokens), not O(tokens x vocab), Megatron's
+    _VocabParallelCrossEntropy.  Masked tokens (``ignore_index``)
+    contribute zero, mean over the rest.
+    """
+    f32 = local_logits.astype(jnp.float32)
+    if _axis_in_scope(axis_name):
+        tp = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+    else:
+        tp, idx = 1, 0     # same masked math, degenerate collectives
+    block = local_logits.shape[-1]
+    start = idx * block
+    # stable log-sum-exp across the sharded vocab; the max shift cancels
+    # analytically, so its gradient path (incl. pmax) is cut explicitly
+    local_max = lax.stop_gradient(jnp.max(f32, axis=-1))
+    gmax = (lax.pmax(local_max, axis_name) if tp > 1 else local_max)
+    sumexp = jnp.sum(jnp.exp(f32 - gmax[..., None]), axis=-1)
+    # the partial-sum psum and the label-logit psum are both linear with
+    # device-disjoint/identical-sum structure; plain psum would re-sum
+    # the replicated cotangent in backward (the f/g issue), so both ride
+    # the g-collective
+    gsum = reduce_from_model_parallel(sumexp, axis_name)
+    local_lbl = labels - start
+    in_block = (local_lbl >= 0) & (local_lbl < block)
+    picked = jnp.take_along_axis(
+        f32, jnp.where(in_block, local_lbl, 0)[..., None], axis=-1)[..., 0]
+    picked = jnp.where(in_block, picked, 0.0)
+    label_logit = reduce_from_model_parallel(picked, axis_name)
+    nll = jnp.log(gsum) + gmax - label_logit
+    valid = labels != ignore_index
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return jnp.sum(nll) / denom
 
 
 def partition_specs(module: Module, params: Optional[Any] = None,
