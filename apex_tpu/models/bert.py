@@ -31,7 +31,8 @@ class BertConfig:
                  num_hidden_layers=12, num_attention_heads=12,
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout_prob=0.1,
-                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12):
+                 attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12,
+                 tp_axis=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -42,6 +43,11 @@ class BertConfig:
         self.hidden_dropout_prob = hidden_dropout_prob
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.layer_norm_eps = layer_norm_eps
+        # tensor-parallel mesh axis: when set, attention/MLP/vocab
+        # embedding/MLM head shard over it (Megatron layout, beyond the
+        # reference) — jit with shard_map and
+        # parallel.tensor_parallel.partition_specs(model)
+        self.tp_axis = tp_axis
 
 
 def bert_base():
@@ -59,12 +65,25 @@ class BertSelfAttention(nn.Module):
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.attention_probs_dropout_prob = cfg.attention_probs_dropout_prob
-        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
-        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.tp = cfg.tp_axis is not None
+        if self.tp:
+            from ..parallel.tensor_parallel import ParallelSelfAttention
+            # head-sharded q/k/v + row-parallel out (hidden dropout
+            # stays out here to keep BERT's placement: after out-proj)
+            self.core = ParallelSelfAttention(
+                cfg.hidden_size, cfg.num_attention_heads, dropout=0.0,
+                attn_dropout=cfg.attention_probs_dropout_prob,
+                axis_name=cfg.tp_axis)
+        else:
+            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+            self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, p, x, mask=None):
         B, T, E = x.shape
+        if self.tp:
+            return self.drop(p.get("drop", {}), self.core(p["core"], x,
+                                                          mask))
         qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.num_heads,
                                             self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
@@ -80,8 +99,17 @@ class BertLayer(nn.Module):
         self.attention = BertSelfAttention(cfg)
         self.attention_ln = FusedLayerNorm(cfg.hidden_size,
                                            eps=cfg.layer_norm_eps)
-        self.intermediate = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
-        self.output = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.tp = cfg.tp_axis is not None
+        if self.tp:
+            from ..parallel.tensor_parallel import ParallelMLP
+            # column(intermediate) -> gelu -> row(hidden): one psum
+            self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
+                                   activation="gelu",
+                                   axis_name=cfg.tp_axis)
+        else:
+            self.intermediate = nn.Linear(cfg.hidden_size,
+                                          cfg.intermediate_size)
+            self.output = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.output_ln = FusedLayerNorm(cfg.hidden_size,
                                         eps=cfg.layer_norm_eps)
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
@@ -89,8 +117,11 @@ class BertLayer(nn.Module):
     def forward(self, p, x, mask=None):
         a = self.attention(p["attention"], x, mask)
         x = self.attention_ln(p["attention_ln"], x + a)
-        h = F.gelu(self.intermediate(p["intermediate"], x))
-        h = self.drop(p.get("drop", {}), self.output(p["output"], h))
+        if self.tp:
+            h = self.drop(p.get("drop", {}), self.mlp(p["mlp"], x))
+        else:
+            h = F.gelu(self.intermediate(p["intermediate"], x))
+            h = self.drop(p.get("drop", {}), self.output(p["output"], h))
         return self.output_ln(p["output_ln"], x + h)
 
 
@@ -98,7 +129,13 @@ class BertModel(nn.Module):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
-        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        if cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import VocabParallelEmbedding
+            self.word_embeddings = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size, axis_name=cfg.tp_axis)
+        else:
+            self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                                cfg.hidden_size)
         self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
                                                 cfg.hidden_size)
         self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
@@ -145,8 +182,14 @@ class BertForPretraining(nn.Module):
                                 attention_mask)
         h = self.mlm_ln(p["mlm_ln"], F.gelu(self.mlm_dense(p["mlm_dense"],
                                                            seq)))
-        # decoder tied to word embeddings (standard BERT)
+        # decoder tied to word embeddings (standard BERT); under TP the
+        # table leaf is vocab-sharded, so the logits come out sharded on
+        # the vocab dim (consume with vocab_parallel_cross_entropy) —
+        # the f-collective on h makes its grad sum the blocks
         table = p["bert"]["word_embeddings"]["weight"]
+        if self.cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import copy_to_model_parallel
+            h = copy_to_model_parallel(h, self.cfg.tp_axis)
         mlm_logits = F.matmul(h, table.T.astype(h.dtype))
         nsp_logits = self.nsp(p["nsp"], pooled)
         return mlm_logits, nsp_logits
@@ -155,10 +198,19 @@ class BertForPretraining(nn.Module):
              token_type_ids=None, attention_mask=None, ignore_index=-100):
         mlm_logits, nsp_logits = self(p, input_ids, token_type_ids,
                                       attention_mask)
-        logp = F.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
-        valid = mlm_labels != ignore_index
-        labels = jnp.where(valid, mlm_labels, 0)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        if self.cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import \
+                vocab_parallel_cross_entropy
+            mlm_loss = vocab_parallel_cross_entropy(
+                mlm_logits, mlm_labels, axis_name=self.cfg.tp_axis,
+                ignore_index=ignore_index)
+        else:
+            logp = F.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+            valid = mlm_labels != ignore_index
+            labels = jnp.where(valid, mlm_labels, 0)
+            nll = -jnp.take_along_axis(logp, labels[..., None],
+                                       axis=-1)[..., 0]
+            mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid),
+                                                          1)
         nsp_loss = F.cross_entropy(nsp_logits, nsp_labels)
         return mlm_loss + nsp_loss

@@ -27,7 +27,7 @@ Router: top-1 (Switch).  The auxiliary load-balancing loss
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

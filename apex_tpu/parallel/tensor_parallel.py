@@ -266,6 +266,7 @@ class ParallelSelfAttention(Module):
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = True, causal: bool = False,
+                 attn_dropout: float = 0.0,
                  axis_name: str = DEFAULT_AXIS):
         super().__init__()
         if embed_dim % num_heads:
@@ -276,6 +277,7 @@ class ParallelSelfAttention(Module):
         self.head_dim = embed_dim // num_heads
         self.causal = causal
         self.dropout_rate = dropout
+        self.attn_dropout = attn_dropout    # attention-probs dropout
         self.axis_name = axis_name
         # one f at block entry instead of three: x feeds all three
         # projections, so input_grad_reduce is applied once in forward
@@ -311,7 +313,18 @@ class ParallelSelfAttention(Module):
             idx = lax.axis_index(self.axis_name)
             mask = lax.dynamic_slice_in_dim(mask, idx * h_local, h_local,
                                             axis=1)
-        ctx = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        attn_rng = None
+        actx0 = current_context()
+        if (self.attn_dropout > 0.0 and actx0 is not None and actx0.train):
+            attn_rng = actx0.make_rng()
+            if _axis_in_scope(self.axis_name):
+                # independent attention-probs masks per head block
+                attn_rng = jax.random.fold_in(
+                    attn_rng, lax.axis_index(self.axis_name))
+        ctx = dot_product_attention(
+            q, k, v, mask=mask, causal=self.causal,
+            dropout_rate=self.attn_dropout if attn_rng is not None else 0.0,
+            dropout_rng=attn_rng)
         ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, h_local * self.head_dim)
         actx = current_context()
         if self.dropout_rate > 0.0 and actx is not None and actx.train:

@@ -9,7 +9,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from apex_tpu import nn
 from apex_tpu.parallel import expert_parallel as ep
 from conftest import assert_trees_close
 
