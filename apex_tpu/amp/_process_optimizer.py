@@ -14,13 +14,26 @@ inside jit with no host sync.
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .scaler import LossScaler, ScalerState
 from ..optimizers.base import Optimizer
+
+
+def _axis_in_scope(name: str) -> bool:
+    """True iff ``name`` is a currently-mapped collective axis — local
+    copy of parallel.sync_batchnorm._axis_in_scope (imported inline
+    would pull the parallel package into amp's import graph); the
+    private-API dependency is pinned by
+    tests/test_syncbn.py::test_axis_introspection_private_api_still_works."""
+    try:
+        from jax._src import core as _core
+        return name in _core.unsafe_get_axis_names()
+    except Exception:
+        return True
 
 __all__ = ["AmpOptState", "AmpOptimizer", "FlatMasters"]
 
@@ -186,13 +199,21 @@ class AmpOptimizer(Optimizer):
 
     def step(self, params: Any = None, opt_state: AmpOptState = None,
              scaled_grads: Any = None, loss_id: int = 0,
-             found_inf_extra: Optional[jax.Array] = None
+             found_inf_extra: Optional[jax.Array] = None,
+             found_inf_axes: Optional[Sequence[str]] = None
              ) -> Tuple[Any, AmpOptState, dict]:
         """Unscale grads, update the scaler, apply-or-skip the inner update.
 
         ``scaled_grads`` are gradients of ``loss * loss_scale`` w.r.t. the
         *model* params.  ``found_inf_extra`` lets callers merge additional
         overflow sources (e.g. a pre-computed grad norm).
+        ``found_inf_axes``: mesh axes whose devices hold DISJOINT param
+        shards (tensor/pipeline parallel) — the local overflow flag is
+        pmax'd over them so every shard skips together and the loss
+        scale stays in lockstep.  (A pure data axis doesn't need this:
+        the pre-step gradient allreduce propagates inf to every
+        replica.)  Axes not currently mapped are ignored, so the same
+        step code runs inside and outside shard_map.
         Returns (new_params, new_opt_state, info).
 
         Called with no arguments in eager mode (after amp.stateful.bind +
@@ -213,6 +234,9 @@ class AmpOptimizer(Optimizer):
         grads32, found_inf = self.scaler.unscale(scaled_grads, sstate)
         if found_inf_extra is not None:
             found_inf = jnp.maximum(found_inf, found_inf_extra)
+        for ax in (found_inf_axes or ()):
+            if _axis_in_scope(ax):
+                found_inf = jax.lax.pmax(found_inf, ax)
         new_sstate = self.scaler.update(sstate, found_inf)
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(opt_state.scalers))

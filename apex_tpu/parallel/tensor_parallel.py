@@ -59,6 +59,7 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "ParallelMLP",
     "ParallelSelfAttention", "VocabParallelEmbedding",
     "vocab_parallel_cross_entropy", "partition_specs",
+    "sharded_optimizer_specs",
 ]
 
 DEFAULT_AXIS = "model"
@@ -468,3 +469,89 @@ def partition_specs(module: Module, params: Optional[Any] = None,
         return out
 
     return build(module, params)
+
+
+def _local_shape(shape, spec, mesh):
+    """Per-device shape of a global array sharded by ``spec`` — via
+    NamedSharding, which also rejects non-divisible dims with a clear
+    error instead of silently floor-dividing."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, spec if spec is not None else P()
+                         ).shard_shape(tuple(shape))
+
+
+def sharded_optimizer_specs(optimizer, params: Any, param_specs: Any,
+                            mesh, axis_name: str = DEFAULT_AXIS) -> Any:
+    """PartitionSpec tree for ``optimizer.init(params)``-shaped state
+    under tensor-parallel sharding.
+
+    Optimizer state must be built from the LOCAL param shards (the amp
+    O2 wrapper keeps masters/moments as one flat buffer whose length is
+    the per-device param count), so both ``init`` and ``step`` run
+    inside ``shard_map`` — this derives the matching out/in specs:
+
+    - a leaf whose local shape equals its global shape is replicated
+      (scalars: step counters, loss scale);
+    - a 1-D leaf that shrank is a flat per-device buffer — device-
+      concat layout, ``P(axis_name)``;
+    - a multi-dim leaf that shrank mirrors a sharded param (tree-state
+      optimizers): the shrunken dims get ``axis_name``.
+
+    Usage::
+
+        ospecs = tp.sharded_optimizer_specs(opt, params, specs, mesh)
+        opt_state = jax.jit(jax.shard_map(
+            opt.init, mesh=mesh, in_specs=(specs,), out_specs=ospecs,
+            check_vma=False))(params)
+    """
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    spec_of = {jax.tree_util.keystr(p): s for p, s in
+               jax.tree_util.tree_flatten_with_path(
+                   param_specs, is_leaf=lambda x: isinstance(x, P))[0]}
+    # spec inference for MIRRORED state leaves attributes every shrunken
+    # dim to axis_name, so param_specs may only shard over that one axis
+    # (the tensor-parallel case this helper exists for) — reject other
+    # axes loudly rather than mis-shard silently
+    for k, s in spec_of.items():
+        for names in (s or ()):
+            for n in (names if isinstance(names, tuple)
+                      else (names,) if names is not None else ()):
+                if n != axis_name:
+                    raise ValueError(
+                        f"param spec at {k} shards over axis {n!r}; "
+                        f"sharded_optimizer_specs only supports specs "
+                        f"over the single axis {axis_name!r}")
+    local_params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [jax.ShapeDtypeStruct(
+            _local_shape(l.shape,
+                         spec_of.get(jax.tree_util.keystr(p)),  # None ok
+                         mesh), l.dtype)
+         for p, l in flat_params])
+
+    glob = jax.eval_shape(optimizer.init, params)
+    loc = jax.eval_shape(optimizer.init, local_params)
+
+    def leaf_spec(g, l):
+        if tuple(g.shape) == tuple(l.shape):
+            return P()
+        if l.ndim == 1:
+            return P(axis_name)
+        return P(*[axis_name if gs != ls else None
+                   for gs, ls in zip(g.shape, l.shape)])
+
+    # pair leaves positionally and unflatten on the LOCAL treedef: the
+    # amp wrapper's FlatMasters node carries its layout (shapes/offsets)
+    # as pytree aux data, which differs between the global and local
+    # trees — a tree_map across the two would reject the mismatch, and
+    # shard_map's out_specs must match the structure the mapped init
+    # actually returns (the local one)
+    gl = jax.tree_util.tree_leaves(glob)
+    ll, ldef = jax.tree_util.tree_flatten(loc)
+    if len(gl) != len(ll):
+        raise ValueError(
+            f"optimizer state leaf count differs between global "
+            f"({len(gl)}) and local ({len(ll)}) init — cannot infer "
+            f"sharded state specs for this optimizer")
+    return jax.tree_util.tree_unflatten(
+        ldef, [leaf_spec(g, l) for g, l in zip(gl, ll)])

@@ -375,3 +375,109 @@ def test_bert_tensor_parallel_matches_unmapped():
         jax.grad(loss), mesh=mesh, in_specs=(specs,), out_specs=specs,
         check_vma=False))(params)
     _assert_trees_close(g_tp, jax.grad(loss)(params), atol=5e-5)
+
+
+def test_amp_o2_fused_adam_with_tp_bert():
+    """The apex core (amp O2 + FusedAdam flat masters + dynamic loss
+    scale) composes with tensor parallelism: optimizer state is built
+    from the LOCAL shards inside shard_map via sharded_optimizer_specs,
+    and training descends on a (data, model) mesh with DDP on data."""
+    from apex_tpu import amp, models, optimizers
+    from jax import lax
+
+    cfg = models.BertConfig(vocab_size=64, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=64,
+                            max_position_embeddings=16,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0,
+                            tp_axis="model")
+    model, optimizer = amp.initialize(models.BertForPretraining(cfg),
+                                      optimizers.FusedAdam(lr=2e-3),
+                                      opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    specs = tp.partition_specs(model, params)
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    ospecs = tp.sharded_optimizer_specs(optimizer, params, specs, mesh)
+
+    opt_state = jax.jit(jax.shard_map(
+        optimizer.init, mesh=mesh, in_specs=(specs,), out_specs=ospecs,
+        check_vma=False))(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (8, 8)))
+    mlm = jnp.asarray(np.where(rng.rand(8, 8) < 0.3,
+                               rng.randint(0, 64, (8, 8)), -100))
+    nsp = jnp.asarray(rng.randint(0, 2, (8,)))
+
+    def step(p, os, i, m, n):
+        def loss_fn(pp):
+            return model.loss(pp, i, m, n), ()
+        loss, _, grads = amp.scaled_grad(loss_fn, p, os, has_aux=True)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, "data"), grads)
+        # model-axis shards are disjoint: overflow decisions must merge
+        p, os, info = optimizer.step(p, os, grads,
+                                     found_inf_axes=("model",))
+        return p, os, lax.pmean(loss, "data"), info["loss_scale"]
+
+    train = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, ospecs, P("data"), P("data"), P("data")),
+        out_specs=(specs, ospecs, P(), P()), check_vma=False))
+
+    l0 = None
+    for _ in range(10):
+        params, opt_state, loss, scale = train(params, opt_state, ids,
+                                               mlm, nsp)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0, (l0, float(loss))
+    assert float(scale) > 0
+
+
+def test_tp_overflow_skip_is_global_across_shards():
+    """An inf in ONE model-shard's grads must skip the step on EVERY
+    shard (found_inf_axes pmax) — without the merge, the other shards
+    would apply a partial update and the loss scales would diverge."""
+    from apex_tpu import amp, optimizers
+    from jax import lax
+
+    mesh = tp_mesh(4)
+    col = tp.ColumnParallelLinear(8, 16, bias=False)
+    model, optimizer = amp.initialize(col, optimizers.FusedAdam(lr=0.1),
+                                      opt_level="O2", verbosity=0,
+                                      hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    specs = tp.partition_specs(model, params)
+    ospecs = tp.sharded_optimizer_specs(optimizer, params, specs, mesh)
+    opt_state = jax.jit(jax.shard_map(
+        optimizer.init, mesh=mesh, in_specs=(specs,), out_specs=ospecs,
+        check_vma=False))(params)
+
+    # grads: inf ONLY in rows 0..3 — device 0's weight block
+    g = np.ones((16, 8), np.float32)
+    g[1, 2] = np.inf
+    grads = {"weight": jnp.asarray(g)}
+
+    def step(p, os, gr, merge):
+        kw = {"found_inf_axes": ("model",)} if merge else {}
+        return optimizer.step(p, os, gr, **kw)
+
+    for merge in (True, False):
+        new_p, new_os, info = jax.jit(jax.shard_map(
+            lambda p, os, gr, m=merge: step(p, os, gr, m), mesh=mesh,
+            in_specs=(specs, ospecs, specs), out_specs=(specs, ospecs,
+                                                        P()),
+            check_vma=False))(params, opt_state, grads)
+        w0 = np.asarray(params["weight"])
+        w1 = np.asarray(new_p["weight"], np.float32)
+        if merge:
+            # everyone skipped: weights identical everywhere
+            np.testing.assert_array_equal(np.asarray(w1), w0)
+        else:
+            # documents the hazard: only the inf-owning shard skipped,
+            # the other three applied a partial update
+            np.testing.assert_array_equal(w1[:4], w0[:4])
+            assert np.abs(w1[4:] - w0[4:]).max() > 0
