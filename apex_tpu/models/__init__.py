@@ -5,3 +5,4 @@ from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
 from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
                    bert_large)
 from .dcgan import Generator, Discriminator, dcgan
+from .gpt import GPTConfig, GPT, gpt2_small, gpt2_medium

@@ -1,0 +1,233 @@
+"""GPT-2-class causal language model.
+
+The reference toolkit is BERT-era and ships no decoder-only model; this
+completes the model-family surface with the architecture the framework's
+long-context machinery exists for: pre-LN transformer decoder, causal
+flash attention on TPU (ops/pallas_flash_attention via
+dot_product_attention's dispatch), FusedLayerNorm, weight-tied LM head,
+and optional tensor parallelism (``tp_axis``) reusing the same Megatron
+modules as BERT (models/bert.py).
+
+``generate`` is a jit-compatible fixed-buffer autoregressive loop:
+static (B, block_size) shapes with a length mask, so XLA compiles ONE
+program regardless of prompt/continuation lengths (no per-length
+recompiles, the TPU-native shape discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..transformer.attention import dot_product_attention
+
+__all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
+                 n_head=12, n_embd=768, dropout=0.1,
+                 layer_norm_eps=1e-5, tp_axis=None):
+        self.vocab_size = vocab_size
+        self.block_size = block_size
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.n_embd = n_embd
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.tp_axis = tp_axis
+
+
+def gpt2_small():
+    return GPTConfig()
+
+
+def gpt2_medium():
+    return GPTConfig(n_layer=24, n_head=16, n_embd=1024)
+
+
+class GPTSelfAttention(nn.Module):
+    """Causal self-attention; flash kernel on TPU via dispatch."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.n_head = cfg.n_head
+        self.head_dim = cfg.n_embd // cfg.n_head
+        self.dropout = cfg.dropout
+        self.tp = cfg.tp_axis is not None
+        if self.tp:
+            from ..parallel.tensor_parallel import ParallelSelfAttention
+            self.core = ParallelSelfAttention(
+                cfg.n_embd, cfg.n_head, dropout=0.0, causal=True,
+                attn_dropout=cfg.dropout, axis_name=cfg.tp_axis)
+        else:
+            self.qkv = nn.Linear(cfg.n_embd, 3 * cfg.n_embd)
+            self.out = nn.Linear(cfg.n_embd, cfg.n_embd)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, p, x, mask=None):
+        B, T, E = x.shape
+        if self.tp:
+            return self.drop(p.get("drop", {}),
+                             self.core(p["core"], x, mask))
+        qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.n_head,
+                                            self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        ctx = dot_product_attention(q, k, v, mask, causal=True,
+                                    dropout_rate=self.dropout)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN decoder block (GPT-2 ordering: x + attn(ln(x)))."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = FusedLayerNorm(cfg.n_embd, eps=cfg.layer_norm_eps)
+        self.attn = GPTSelfAttention(cfg)
+        self.ln_2 = FusedLayerNorm(cfg.n_embd, eps=cfg.layer_norm_eps)
+        self.tp = cfg.tp_axis is not None
+        if self.tp:
+            from ..parallel.tensor_parallel import ParallelMLP
+            self.mlp = ParallelMLP(cfg.n_embd, 4 * cfg.n_embd,
+                                   activation="gelu",
+                                   axis_name=cfg.tp_axis)
+        else:
+            self.fc = nn.Linear(cfg.n_embd, 4 * cfg.n_embd)
+            self.proj = nn.Linear(4 * cfg.n_embd, cfg.n_embd)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, p, x, mask=None):
+        x = x + self.attn(p["attn"], self.ln_1(p["ln_1"], x), mask)
+        h = self.ln_2(p["ln_2"], x)
+        if self.tp:
+            h = self.mlp(p["mlp"], h)
+        else:
+            h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
+        return x + self.drop(p.get("drop", {}), h)
+
+
+class GPT(nn.Module):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.n_embd,
+                                              axis_name=cfg.tp_axis)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.n_embd)
+        self.wpe = nn.Embedding(cfg.block_size, cfg.n_embd)
+        self.h = nn.ModuleList([GPTBlock(cfg) for _ in range(cfg.n_layer)])
+        self.ln_f = FusedLayerNorm(cfg.n_embd, eps=cfg.layer_norm_eps)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, p, input_ids, attention_mask: Optional[jax.Array]
+                = None, last_pos: Optional[jax.Array] = None):
+        """Logits (B, T, V) — vocab-sharded under tp_axis.
+
+        ``attention_mask``: (B, T) validity (1 = real token); combined
+        with the causal constraint inside attention.  ``last_pos``:
+        (B,) position indices — project ONLY those rows through the LM
+        head and return (B, 1, V); decode loops read one row per step,
+        and the full-vocab head matmul over all S positions is the
+        dominant per-token cost they'd otherwise pay."""
+        B, T = input_ids.shape
+        if T > self.cfg.block_size:
+            raise ValueError(f"sequence length {T} exceeds block_size "
+                             f"{self.cfg.block_size}")
+        pos = jnp.arange(T)[None, :]
+        x = (self.wte(p["wte"], input_ids)
+             + self.wpe(p["wpe"], pos))
+        x = self.drop(p.get("drop", {}), x)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(self.cfg.n_layer):
+            x = self.h[i](p["h"][str(i)], x, mask)
+        x = self.ln_f(p["ln_f"], x)
+        if last_pos is not None:
+            x = jnp.take_along_axis(x, last_pos[:, None, None], axis=1)
+        # weight-tied LM head (GPT-2); under TP the table is
+        # vocab-sharded -> sharded logits (f-collective on x so its
+        # grad sums the blocks)
+        table = p["wte"]["weight"]
+        if self.cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import copy_to_model_parallel
+            x = copy_to_model_parallel(x, self.cfg.tp_axis)
+        return F.matmul(x, table.T.astype(x.dtype))
+
+    def loss(self, p, input_ids, attention_mask: Optional[jax.Array]
+             = None, ignore_index: int = -100):
+        """Next-token cross-entropy: predict ids[t+1] from prefix <=t.
+        Padding positions (attention_mask == 0) are ignored."""
+        logits = self(p, input_ids, attention_mask)[:, :-1]
+        labels = input_ids[:, 1:]
+        if attention_mask is not None:
+            labels = jnp.where(attention_mask[:, 1:] != 0, labels,
+                               ignore_index)
+        if self.cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import \
+                vocab_parallel_cross_entropy
+            return vocab_parallel_cross_entropy(
+                logits, labels, axis_name=self.cfg.tp_axis,
+                ignore_index=ignore_index)
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def generate(self, p, input_ids, prompt_len, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None):
+        """Fixed-buffer autoregressive decoding (jit-compatible).
+
+        ``input_ids``: (B, block_size) buffer holding the prompt left-
+        aligned (anything at position >= prompt_len is overwritten);
+        ``prompt_len``: (B,) or scalar prompt lengths.  Greedy when
+        ``temperature == 0`` (static python float), else samples with
+        ``rng``.  One compiled program serves any prompt length.
+        Generation for a row stops when its buffer fills: at most
+        ``block_size - prompt_len`` new tokens land; further iterations
+        leave the row untouched (``final_len`` caps at block_size).
+        """
+        B, S = input_ids.shape
+        prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng=")
+
+        def body(i, carry):
+            ids, cur_len, key = carry
+            amask = (jnp.arange(S)[None, :] < cur_len[:, None]).astype(
+                jnp.int32)
+            # one (B, 1, V) head row per step, not (B, S, V)
+            last = self(p, ids, amask,
+                        last_pos=jnp.minimum(cur_len - 1, S - 1))[:, 0]
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, last / temperature)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            # write at cur_len; a saturated row (cur_len == S) keeps its
+            # last slot instead of re-decoding over it every iteration
+            can = cur_len < S
+            wpos = jnp.minimum(cur_len, S - 1)
+            ids = jnp.asarray(ids)
+            ids = jax.vmap(
+                lambda row, pos, tok, c: row.at[pos].set(
+                    jnp.where(c, tok, row[pos])))(
+                ids, wpos, nxt.astype(ids.dtype), can)
+            return ids, jnp.minimum(cur_len + 1, S), key
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        ids, final_len, _ = lax.fori_loop(
+            0, max_new_tokens, body, (input_ids, prompt_len, key))
+        return ids, final_len

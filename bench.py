@@ -289,6 +289,40 @@ def main():
              unit="sequences/sec/chip", steps_per_call=K,
              vs_baseline=None)
 
+    def gpt_config(metric, cfg, batch_per_chip, seqlen, iters, warmup,
+                   steps_per_call=1):
+        model, optimizer = amp.initialize(
+            models.GPT(cfg), optimizers.FusedAdam(lr=1e-4),
+            opt_level="O2", verbosity=0)
+        ddp = parallel.DistributedDataParallel(model)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        opt_state = optimizer.init(params)
+        B = batch_per_chip * ndev
+        K = steps_per_call
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (K * B, seqlen)),
+                          jnp.int32)
+
+        def step(state, batch):
+            params, opt_state = state
+            (ids_b,) = batch
+
+            def loss_fn(p):
+                return model.loss(p, ids_b), ()
+
+            loss, _, grads = amp.scaled_grad(loss_fn, params, opt_state,
+                                             has_aux=True)
+            grads = ddp.allreduce_grads(grads)
+            params, opt_state, _ = optimizer.step(params, opt_state,
+                                                  grads)
+            return (params, opt_state), lax.pmean(loss, "data")
+
+        dt = timed_scan(ddp, step, (params, opt_state), (ids,),
+                        ((B, seqlen),), K, iters, warmup)
+        emit(metric=metric, value=round(B / dt / ndev, 1),
+             unit="sequences/sec/chip", steps_per_call=K,
+             vs_baseline=None)
+
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
         buf = jnp.ones((n,), jnp.float32)
@@ -372,6 +406,13 @@ def main():
                  "bert_base_o2_scan4_train_throughput", "bert_base",
                  optimizers.FusedAdam(lr=1e-4), 32, 128, 4, 1,
                  steps_per_call=4)),
+            ("gpt2_small_o2_causal_flash_train_throughput",
+             lambda: gpt_config(
+                 "gpt2_small_o2_causal_flash_train_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 8, 512, 8, 2)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet50_amp_o2_ddp_nhwc_train_throughput",
@@ -399,6 +440,13 @@ def main():
                  "bert_tiny_o2_scan2_train_throughput", "bert_base",
                  optimizers.FusedAdam(lr=1e-4), 2, 16, 2, 1,
                  steps_per_call=2, tiny=True)),
+            ("gpt_tiny_o2_train_throughput",
+             lambda: gpt_config(
+                 "gpt_tiny_o2_train_throughput",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 2, 16, 2, 1)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet18_amp_o2_ddp_scan2_train_throughput",

@@ -1,0 +1,157 @@
+"""GPT causal LM: shapes, causality, training descent, fixed-buffer
+generation, and tensor-parallel parity."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import nn, models, optimizers
+from conftest import assert_trees_close
+
+
+def tiny_cfg(**kw):
+    d = dict(vocab_size=64, block_size=16, n_layer=2, n_head=4,
+             n_embd=32, dropout=0.0)
+    d.update(kw)
+    return models.GPTConfig(**d)
+
+
+def test_forward_shapes_and_loss():
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 10)))
+    logits = model(params, ids)
+    assert logits.shape == (2, 10, 64)
+    loss = model.loss(params, ids)
+    assert np.isfinite(float(loss))
+    # block_size guard
+    with pytest.raises(ValueError, match="block_size"):
+        model(params, jnp.zeros((1, 17), jnp.int32))
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(1))
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 64, (1, 8))
+    ids2 = ids.copy()
+    ids2[0, 6] = (ids2[0, 6] + 1) % 64
+    l1 = np.asarray(model(params, jnp.asarray(ids)))
+    l2 = np.asarray(model(params, jnp.asarray(ids2)))
+    np.testing.assert_array_equal(l1[0, :6], l2[0, :6])
+    assert np.abs(l1[0, 6:] - l2[0, 6:]).max() > 0
+
+
+def test_padding_mask_ignored_in_loss():
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(2))
+    rng = np.random.RandomState(2)
+    ids = jnp.asarray(rng.randint(0, 64, (2, 12)))
+    amask = jnp.asarray((np.arange(12)[None, :] < [[8], [5]]).astype(
+        np.int32))
+    # garbage in the padding must not move the loss
+    ids_garbage = jnp.where(amask == 0, 63, ids)
+    l1 = float(model.loss(params, ids, amask))
+    l2 = float(model.loss(params, ids_garbage, amask))
+    # padding keys are masked out of attention and padding labels out of
+    # the loss; the embedding of a pad position only feeds its own
+    # (ignored) prediction
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_training_descends():
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(3))
+    opt = optimizers.FusedAdam(lr=2e-3)
+    opt_state = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 64, (4, 16)))
+
+    @jax.jit
+    def step(p, os):
+        loss, g = jax.value_and_grad(lambda pp: model.loss(pp, ids))(p)
+        p, os = opt.update(g, os, p)
+        return p, os, loss
+
+    l0 = None
+    for _ in range(25):
+        params, opt_state, loss = step(params, opt_state)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0 * 0.7, (l0, float(loss))
+
+
+def test_generate_greedy_deterministic_and_prefix_preserving():
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(4)
+    S = 16
+    prompt = rng.randint(0, 64, (2, 4))
+    buf = np.zeros((2, S), np.int32)
+    buf[:, :4] = prompt
+    gen = jax.jit(lambda p, b, n: model.generate(p, b, 4, n))
+    ids1, len1 = gen(params, jnp.asarray(buf), 6)
+    ids2, _ = gen(params, jnp.asarray(buf), 6)
+    np.testing.assert_array_equal(np.asarray(ids1), np.asarray(ids2))
+    np.testing.assert_array_equal(np.asarray(ids1)[:, :4], prompt)
+    assert list(np.asarray(len1)) == [10, 10]
+    # the continuation equals teacher-forced greedy next-token choices
+    step1 = np.asarray(ids1)[0, 4]
+    amask = jnp.asarray((np.arange(S) < 4).astype(np.int32))[None, :]
+    logits = model(params, jnp.asarray(buf[:1]), amask)
+    np.testing.assert_array_equal(
+        step1, int(jnp.argmax(logits[0, 3])))
+
+
+def test_generate_sampling_needs_rng_and_varies():
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(5))
+    buf = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        model.generate(params, buf, 1, 3, temperature=1.0)
+    ids1, _ = model.generate(params, buf, 1, 8, temperature=2.0,
+                             rng=jax.random.PRNGKey(0))
+    ids2, _ = model.generate(params, buf, 1, 8, temperature=2.0,
+                             rng=jax.random.PRNGKey(1))
+    assert np.any(np.asarray(ids1) != np.asarray(ids2))
+
+
+def test_gpt_tensor_parallel_matches_unmapped():
+    from apex_tpu.parallel import tensor_parallel as tp
+    model = models.GPT(tiny_cfg(tp_axis="model"))
+    params, _ = model.init(jax.random.PRNGKey(6))
+    specs = tp.partition_specs(model, params)
+    assert specs["wte"]["weight"] == P("model", None)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("model",))
+    ids = jnp.asarray(np.random.RandomState(6).randint(0, 64, (2, 12)))
+
+    def loss(p):
+        return model.loss(p, ids)
+
+    l_tp = jax.jit(jax.shard_map(
+        loss, mesh=mesh, in_specs=(specs,), out_specs=P(),
+        check_vma=False))(params)
+    np.testing.assert_allclose(float(l_tp), float(loss(params)),
+                               atol=1e-5)
+    g_tp = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh, in_specs=(specs,), out_specs=specs,
+        check_vma=False))(params)
+    assert_trees_close(g_tp, jax.grad(loss)(params), atol=5e-5)
+
+
+def test_generate_saturates_at_block_size():
+    """prompt_len + max_new past block_size: the buffer fills and then
+    stays frozen — no re-decoding over the final slot."""
+    model = models.GPT(tiny_cfg())
+    params, _ = model.init(jax.random.PRNGKey(7))
+    rng = np.random.RandomState(7)
+    S = 16
+    buf = np.zeros((1, S), np.int32)
+    buf[0, :12] = rng.randint(0, 64, 12)
+    ids_exact, len_exact = model.generate(params, jnp.asarray(buf), 12, 4)
+    ids_over, len_over = model.generate(params, jnp.asarray(buf), 12, 9)
+    np.testing.assert_array_equal(np.asarray(ids_exact),
+                                  np.asarray(ids_over))
+    assert int(len_over[0]) == S == int(len_exact[0])
