@@ -84,6 +84,38 @@ class GPTSelfAttention(nn.Module):
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
 
+    def decode(self, p, x, pos, kcache, vcache):
+        """One-token step against the KV cache.
+
+        ``x``: (B, 1, E) this position's activations; ``pos``: scalar
+        position; ``kcache``/``vcache``: (B, H, S, D) static buffers.
+        Writes k/v at ``pos`` and attends q over positions <= pos.
+        Eval-mode path (no dropout).  Returns (out (B, 1, E), kcache,
+        vcache)."""
+        if self.tp:
+            raise NotImplementedError(
+                "KV-cache decode is single-device; run the TP model "
+                "through forward() or shard the batch instead")
+        B, _, E = x.shape
+        S = kcache.shape[2]
+        qkv = self.qkv(p["qkv"], x).reshape(B, 3, self.n_head,
+                                            self.head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # (B, H, D)
+        kcache = lax.dynamic_update_slice_in_dim(
+            kcache, k[:, :, None, :].astype(kcache.dtype), pos, axis=2)
+        vcache = lax.dynamic_update_slice_in_dim(
+            vcache, v[:, :, None, :].astype(vcache.dtype), pos, axis=2)
+        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            kcache.astype(jnp.float32))
+        scores = scores * (1.0 / (self.head_dim ** 0.5))
+        valid = jnp.arange(S)[None, None, :] <= pos
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhs,bhsd->bhd", probs,
+                         vcache.astype(jnp.float32)).astype(x.dtype)
+        ctx = ctx.reshape(B, 1, E)
+        return self.out(p["out"], ctx), kcache, vcache
+
 
 class GPTBlock(nn.Module):
     """Pre-LN decoder block (GPT-2 ordering: x + attn(ln(x)))."""
@@ -112,6 +144,14 @@ class GPTBlock(nn.Module):
         else:
             h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
         return x + self.drop(p.get("drop", {}), h)
+
+    def decode(self, p, x, pos, kcache, vcache):
+        a, kcache, vcache = self.attn.decode(
+            p["attn"], self.ln_1(p["ln_1"], x), pos, kcache, vcache)
+        x = x + a
+        h = self.ln_2(p["ln_2"], x)
+        h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
+        return x + h, kcache, vcache
 
 
 class GPT(nn.Module):
@@ -230,4 +270,104 @@ class GPT(nn.Module):
         key = rng if rng is not None else jax.random.PRNGKey(0)
         ids, final_len, _ = lax.fori_loop(
             0, max_new_tokens, body, (input_ids, prompt_len, key))
+        return ids, final_len
+
+    def init_cache(self, batch_size: int, dtype=jnp.float32):
+        """Per-layer (B, H, S, D) k/v buffers for cached decoding."""
+        cfg = self.cfg
+        shape = (batch_size, cfg.n_head, cfg.block_size,
+                 cfg.n_embd // cfg.n_head)
+        return {str(i): {"k": jnp.zeros(shape, dtype),
+                         "v": jnp.zeros(shape, dtype)}
+                for i in range(cfg.n_layer)}
+
+    def _decode_hidden(self, p, token, pos, cache):
+        """Blocks-only decode step: (B,) token at ``pos`` -> ((B, 1, E)
+        final hidden state, updated cache).  The LM head is separate so
+        prefill steps can skip the full-vocab matmul."""
+        B = token.shape[0]
+        x = (self.wte(p["wte"], token[:, None])
+             + self.wpe(p["wpe"], jnp.full((B, 1), pos)))
+        new_cache = {}
+        for i in range(self.cfg.n_layer):
+            li = str(i)
+            x, k, v = self.h[i].decode(p["h"][li], x, pos,
+                                       cache[li]["k"], cache[li]["v"])
+            new_cache[li] = {"k": k, "v": v}
+        return self.ln_f(p["ln_f"], x), new_cache
+
+    def _head(self, p, x):
+        table = p["wte"]["weight"]
+        return F.matmul(x, table.T.astype(x.dtype))
+
+    def decode_step(self, p, token, pos, cache):
+        """token: (B,) ids at scalar position ``pos`` -> ((B, V) logits
+        for the NEXT position, updated cache).  O(S) per token vs the
+        O(S^2) of re-running the full prefix; eval-mode (no dropout)."""
+        x, new_cache = self._decode_hidden(p, token, pos, cache)
+        return self._head(p, x)[:, 0], new_cache
+
+    def generate_cached(self, p, input_ids, prompt_len,
+                        max_new_tokens: int, temperature: float = 0.0,
+                        rng: Optional[jax.Array] = None,
+                        cache_dtype=None):
+        """KV-cached ``generate``: one fused prefill+decode loop over
+        the buffer positions, O(S) attention per step against the
+        static (B, H, S, D) caches.  Greedy output is IDENTICAL to
+        ``generate`` (parity-tested); single-device (no tp_axis).
+
+        One compiled program serves any prompt length: the loop bound is
+        a traced ``max(final_len) - 1`` (lowered to while_loop), prefill
+        steps skip the full-vocab head matmul entirely (``lax.cond``),
+        and ``cache_dtype`` defaults to the embedding table's dtype (so
+        a bf16 model gets a bf16 cache, half the memory).
+        """
+        if self.cfg.tp_axis is not None:
+            raise NotImplementedError("generate_cached is single-device; "
+                                      "use generate() under TP")
+        B, S = input_ids.shape
+        prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng=")
+        final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+        first_gen = jnp.min(prompt_len)     # earliest live head step
+
+        def body(i, carry):
+            ids, cache, key = carry
+            x, cache = self._decode_hidden(p, ids[:, i], i, cache)
+
+            def live(args):
+                x, key = args
+                logits = self._head(p, x)[:, 0]
+                if temperature > 0.0:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub,
+                                                 logits / temperature)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                return nxt.astype(ids.dtype), key
+
+            def prefill(args):
+                _, key = args
+                return jnp.zeros((B,), ids.dtype), key
+
+            # prefill steps (every row still inside its prompt) skip the
+            # full-vocab head matmul and the sample
+            nxt, key = lax.cond(i + 1 >= first_gen, live, prefill,
+                                (x, key))
+            # position i+1 receives a generated token iff it lies in the
+            # generation window [prompt_len, final_len)
+            should = (i + 1 >= prompt_len) & (i + 1 < final_len)
+            col = jnp.where(should, nxt, ids[:, i + 1])
+            ids = lax.dynamic_update_slice_in_dim(
+                ids, col[:, None], i + 1, axis=1)
+            return ids, cache, key
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        if cache_dtype is None:
+            cache_dtype = p["wte"]["weight"].dtype
+        cache = self.init_cache(B, dtype=cache_dtype)
+        # traced bound: no dead steps past the longest row's final_len
+        ids, _, _ = lax.fori_loop(0, jnp.max(final_len) - 1, body,
+                                  (input_ids, cache, key))
         return ids, final_len
