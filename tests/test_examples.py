@@ -128,3 +128,11 @@ def test_convergence_digits_o0_vs_o2(tmp_path):
     # O2's half-precision trajectory must track O0 fp32 (same seed, same
     # data order; bf16 rounding + different BN stat dtypes separate them)
     assert abs(accs["O0"] - accs["O2"]) <= 6.0, accs
+
+
+@pytest.mark.slow
+def test_gpt_example_smoke():
+    r = _run(["examples/gpt/main_amp.py", "--config", "tiny", "-b", "2",
+              "--iters", "3", "--generate", "8", "--print-freq", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done" in r.stdout and "sample:" in r.stdout

@@ -481,3 +481,59 @@ def test_tp_overflow_skip_is_global_across_shards():
             # the other three applied a partial update
             np.testing.assert_array_equal(w1[:4], w0[:4])
             assert np.abs(w1[4:] - w0[4:]).max() > 0
+
+
+def test_checkpoint_roundtrip_with_tp_sharded_state(tmp_path):
+    """Save/restore of TP-sharded train state (params + per-shard amp
+    optimizer state): the gathered checkpoint restores to an identical
+    trajectory — resume under TP (reference resume flow,
+    examples/imagenet/main_amp.py:170-185, extended to sharded state)."""
+    from apex_tpu import amp, optimizers
+    from apex_tpu.utils import checkpoint as ckpt
+
+    mesh = tp_mesh(4)
+    mlp = tp.ParallelMLP(8, 32, activation="relu")
+    model, optimizer = amp.initialize(mlp, optimizers.FusedAdam(lr=1e-2),
+                                      opt_level="O2", verbosity=0,
+                                      hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    specs = tp.partition_specs(model, params)
+    ospecs = tp.sharded_optimizer_specs(optimizer, params, specs, mesh)
+    opt_state = jax.jit(jax.shard_map(
+        optimizer.init, mesh=mesh, in_specs=(specs,), out_specs=ospecs,
+        check_vma=False))(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 6, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(4, 6, 8), jnp.float32)
+
+    def step(p, os, xb, yb):
+        def loss_fn(pp):
+            out, _ = model.apply(pp, xb)
+            return F.mse_loss(out, yb), ()
+        loss, _, g = amp.scaled_grad(loss_fn, p, os, has_aux=True)
+        p, os, _ = optimizer.step(p, os, g,
+                                  found_inf_axes=("model",))
+        return p, os, loss
+
+    train = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(specs, ospecs, P(), P()),
+        out_specs=(specs, ospecs, P()), check_vma=False))
+
+    for _ in range(3):
+        params, opt_state, _ = train(params, opt_state, x, y)
+
+    # save (gathers shards to host), then CONTINUE two ways
+    ckpt.save_checkpoint(str(tmp_path), 3, {"params": params,
+                                            "opt": opt_state})
+    restored = ckpt.restore_checkpoint(
+        str(tmp_path), {"params": params, "opt": opt_state})
+    p2, os2 = restored["params"], restored["opt"]
+
+    traj_a, traj_b = [], []
+    pa, osa, pb, osb = params, opt_state, p2, os2
+    for _ in range(3):
+        pa, osa, la = train(pa, osa, x, y)
+        pb, osb, lb = train(pb, osb, x, y)
+        traj_a.append(float(la))
+        traj_b.append(float(lb))
+    assert traj_a == traj_b, (traj_a, traj_b)
