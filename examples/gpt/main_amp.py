@@ -134,8 +134,11 @@ def main():
             print(f"iter [{i}/{args.iters}]  Time {bt.val:.3f} "
                   f"({bt.avg:.3f})  Speed {B / bt.val:.1f} seq/s  "
                   f"Loss {losses.val:.4f} ({losses.avg:.4f})")
-    print(f"=> done. avg {B / bt.avg:.1f} seq/s "
-          f"({B / bt.avg / ndev:.1f} seq/s/device)")
+    if bt.avg > 0:
+        print(f"=> done. avg {B / bt.avg:.1f} seq/s "
+              f"({B / bt.avg / ndev:.1f} seq/s/device)")
+    else:
+        print("=> done. (no timed iterations)")
 
     if args.generate:
         params = state[0]
@@ -150,7 +153,9 @@ def main():
             rng=gen_rng))(params, jnp.asarray(buf))
         toks = np.asarray(out)[0][:int(flen[0])]
         itos = {i: c for c, i in stoi.items()}
-        print("=> sample:", "".join(itos[int(t)] for t in toks))
+        # vocab is padded to >= 2; a padding id has no corpus char
+        print("=> sample:", "".join(itos.get(int(t), "\ufffd")
+                                    for t in toks))
     return losses.avg
 
 

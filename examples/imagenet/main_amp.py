@@ -331,9 +331,9 @@ def main():
             from apex_tpu.utils import checkpoint as ckpt
             ckpt.save_checkpoint(args.checkpoint_dir, epoch + 1, state,
                                  keep=3)
-    ips = global_batch / batch_time.avg
+    ips = (global_batch / batch_time.avg if batch_time.avg > 0 else 0.0)
     print(f"=> done. avg {ips:.1f} img/s over {args.iters} iters "
-          f"({ips / ndev:.1f} img/s/device)")
+          f"({ips / ndev if ndev else 0.0:.1f} img/s/device)")
     # val_acc already covers the final state: the last loop iteration
     # validated after the last step
     if val_acc is None:
