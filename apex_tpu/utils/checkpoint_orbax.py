@@ -1,0 +1,138 @@
+"""Sharded/async checkpointing via Orbax — the TPU-native alternative to
+the npz path in :mod:`apex_tpu.utils.checkpoint`.
+
+The npz checkpointer (utils/checkpoint.py) gathers every leaf to host —
+correct and dependency-free, but on a pod that funnels the whole model
+through one host and blocks the step loop.  Orbax writes each shard from
+the process that owns it (TensorStore/OCDBT) and can do so
+asynchronously, which is how large sharded TP/PP state is checkpointed
+in practice.  This module is a thin adapter keeping the same call shape
+as the npz API:
+
+    from apex_tpu.utils import checkpoint_orbax as ckpt
+    ckpt.save_checkpoint(dir, step, {"params": params, "opt": opt_state})
+    state = ckpt.restore_checkpoint(dir, template)          # latest
+    state = ckpt.restore_checkpoint(dir, template, step=7)
+
+``template`` supplies structure/shape/dtype AND SHARDING: pass the live
+state (or equivalently shaped abstract arrays with shardings) so every
+restored leaf lands already-sharded on its devices — no host round trip.
+Restore-time reshard is supported: a template with a different mesh
+layout restores into that layout.
+
+Falls back cleanly when orbax is unavailable (import guarded); callers
+needing the guaranteed-present path use the npz module.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
+           "available_steps"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _mgr_dir(ckpt_dir: str) -> str:
+    return os.path.abspath(ckpt_dir)
+
+
+def _prune(ckpt_dir: str, keep: int) -> None:
+    import shutil
+    for s in available_steps(ckpt_dir)[:-keep]:
+        shutil.rmtree(os.path.join(_mgr_dir(ckpt_dir), f"step_{s}"),
+                      ignore_errors=True)
+
+
+def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
+                    keep: Optional[int] = None,
+                    async_save: bool = False) -> str:
+    """Write ``tree`` under ``ckpt_dir/step_N`` (sharded, per-process).
+
+    ``async_save=True`` returns while the write completes in the
+    background (call :func:`wait` or save again to join — a new save
+    first joins any pending one, so write errors always surface).
+    ``keep`` prunes to the most recent N steps after the save has
+    actually SUCCEEDED (for an async save, at join time)."""
+    import orbax.checkpoint as ocp
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    wait()                        # join + surface any pending async save
+    path = os.path.join(_mgr_dir(ckpt_dir), f"step_{int(step)}")
+    ckptr = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+             if async_save
+             else ocp.Checkpointer(ocp.StandardCheckpointHandler()))
+    ckptr.save(path, tree, force=True)
+    if not async_save:
+        ckptr.close()
+        if keep is not None:
+            _prune(ckpt_dir, keep)
+    else:
+        global _pending
+        # pruning is deferred to the join so a failed background write
+        # can't have already deleted the older good checkpoints
+        _pending = (ckptr, ckpt_dir, keep)
+    return path
+
+
+_pending = None
+
+
+def wait() -> None:
+    """Join an in-flight async save (then apply its deferred pruning)."""
+    global _pending
+    if _pending is not None:
+        ckptr, ckpt_dir, keep = _pending
+        _pending = None
+        ckptr.wait_until_finished()
+        ckptr.close()
+        if keep is not None:
+            _prune(ckpt_dir, keep)
+
+
+def available_steps(ckpt_dir: str) -> list:
+    d = _mgr_dir(ckpt_dir)
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in os.listdir(d):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = available_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, template: Any,
+                       step: Optional[int] = None) -> Any:
+    """Restore into ``template``'s structure, dtypes, AND shardings.
+
+    Leaves come back as jax.Arrays sharded like the template's (live
+    arrays or ShapeDtypeStructs with ``.sharding``); a different mesh
+    layout in the template reshards on read."""
+    import orbax.checkpoint as ocp
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    path = os.path.join(_mgr_dir(ckpt_dir), f"step_{int(step)}")
+
+    def to_abstract(leaf):
+        if hasattr(leaf, "sharding"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                        sharding=leaf.sharding)
+        return jax.ShapeDtypeStruct(jax.numpy.asarray(leaf).shape,
+                                    jax.numpy.asarray(leaf).dtype)
+
+    abstract = jax.tree_util.tree_map(to_abstract, template)
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        return ckptr.restore(path, abstract)
