@@ -13,7 +13,8 @@ O1 registries (register_half_function etc.).
 from .frontend import initialize, Properties, opt_levels, O0, O1, O2, O3
 from .handle import scale_loss, scaled_grad, disable_casts
 from .scaler import LossScaler, ScalerState
-from ._process_optimizer import AmpOptimizer, AmpOptState
+from ._process_optimizer import (AmpOptimizer, AmpOptState,
+                                 zero_optimizer_specs)
 from ._initialize import AmpModel, cast_param_tree
 from ._amp_state import master_params, maybe_print
 from .policy import (CastPolicy, NoPolicy, current_policy, set_policy,

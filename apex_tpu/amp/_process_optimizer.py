@@ -35,7 +35,40 @@ def _axis_in_scope(name: str) -> bool:
     except Exception:
         return True
 
-__all__ = ["AmpOptState", "AmpOptimizer", "FlatMasters"]
+__all__ = ["AmpOptState", "AmpOptimizer", "FlatMasters",
+           "zero_optimizer_specs"]
+
+
+def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
+                         axis_name: str = "data") -> Any:
+    """PartitionSpec tree for ``optimizer.init(params, zero_axis=...)``
+    run inside shard_map — flat master/moment shards are ``P(axis)``
+    (device-concat layout), scalars replicated.  Use as the out_specs of
+    the mapped init and the in/out specs of the mapped step::
+
+        ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+        opt_state = jax.jit(jax.shard_map(
+            lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+            in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+    """
+    from jax.sharding import PartitionSpec as P
+    layout = _FlatLayout(params)
+    layout.zero_axis = axis_name
+
+    def leaf_spec(l):
+        return P() if getattr(l, "ndim", 0) == 0 else P(axis_name)
+
+    inner_abs = jax.eval_shape(
+        optimizer.inner.init,
+        jax.ShapeDtypeStruct((max(layout.total, 1),), jnp.float32))
+    inner_specs = jax.tree_util.tree_map(leaf_spec, inner_abs)
+    scaler_abs = jax.eval_shape(optimizer.scaler.init_state)
+    scaler_specs = tuple(
+        jax.tree_util.tree_map(lambda _: P(), scaler_abs)
+        for _ in range(optimizer.num_losses))
+    return AmpOptState(inner=inner_specs,
+                       masters=FlatMasters(P(axis_name), layout),
+                       scalers=scaler_specs)
 
 
 class AmpOptState(NamedTuple):
@@ -86,9 +119,14 @@ class _FlatLayout:
         self.half_dtype = (jnp.dtype(halves.pop()) if len(halves) == 1
                            else None)
 
+    # ZeRO-1: when set, the flat master/moment buffers hold only THIS
+    # device's slice (sharded over the named data axis); the step
+    # reduce-scatters grads and all-gathers the updated params
+    zero_axis: Optional[str] = None
+
     # layouts are jit-cache keys via FlatMasters aux_data
     def _key(self):
-        return (self.treedef, self.shapes, self.dtypes)
+        return (self.treedef, self.shapes, self.dtypes, self.zero_axis)
 
     def __eq__(self, other):
         return isinstance(other, _FlatLayout) and self._key() == other._key()
@@ -128,6 +166,13 @@ class _FlatLayout:
     def unpack_masters(self, flat32: jax.Array) -> Any:
         """Masters as an fp32 tree (inspection / master_params parity).
         Non-float leaves have no master; they come back as None."""
+        if self.zero_axis is not None:
+            # the buffer holds only this device's shard: offsets past it
+            # would clamp and silently return duplicated tail data
+            raise RuntimeError(
+                f"masters are ZeRO-sharded over axis {self.zero_axis!r}; "
+                f"all_gather the buffer (axis=0, tiled=True) and slice "
+                f"[:layout.total] before unpacking")
         out = []
         for i, (shape, f) in enumerate(zip(self.shapes, self.is_float)):
             if not f:
@@ -173,7 +218,37 @@ class AmpOptimizer(Optimizer):
         self._bound = None
 
     # -- functional API ----------------------------------------------------
-    def init(self, params: Any) -> AmpOptState:
+    def init(self, params: Any, zero_axis: Optional[str] = None
+             ) -> AmpOptState:
+        """``zero_axis``: ZeRO stage-1 — shard the fp32 masters and the
+        inner optimizer's moments across the named DATA-parallel mesh
+        axis (each device owns ``ceil(N/dp)`` elements of the flat
+        buffer).  Must run inside shard_map with the axis mapped (it
+        degrades to the full replicated state outside one); requires an
+        elementwise inner optimizer + master weights (the flat path).
+        The matching step reduce-scatters the UN-reduced local grads —
+        do NOT pre-allreduce them with DDP."""
+        if zero_axis is not None and _axis_in_scope(zero_axis):
+            if not (self.master_weights
+                    and getattr(self.inner, "elementwise", False)):
+                raise ValueError(
+                    "zero_axis requires master weights and an "
+                    "elementwise inner optimizer (the flat-buffer path)")
+            layout = _FlatLayout(params)
+            layout.zero_axis = zero_axis
+            dp = jax.lax.axis_size(zero_axis)
+            shard_n = -(-layout.total // dp)          # ceil
+            full = jnp.pad(layout.pack(params),
+                           (0, shard_n * dp - layout.total))
+            idx = jax.lax.axis_index(zero_axis)
+            shard = jax.lax.dynamic_slice_in_dim(full, idx * shard_n,
+                                                 shard_n)
+            masters = FlatMasters(shard, layout)
+            inner_state = self.inner.init(shard)
+            scalers = tuple(self.scaler.init_state()
+                            for _ in range(self.num_losses))
+            return AmpOptState(inner=inner_state, masters=masters,
+                               scalers=scalers)
         if self.master_weights:
             if getattr(self.inner, "elementwise", False):
                 # elementwise inner optimizers (SGD, FusedAdam) run on one
@@ -227,13 +302,41 @@ class AmpOptimizer(Optimizer):
             return self._bound.step()
         sstate = opt_state.scalers[loss_id]
         flat = isinstance(opt_state.masters, FlatMasters)
+        zaxis = (opt_state.masters.layout.zero_axis
+                 if flat else None)
+        zero = zaxis is not None and _axis_in_scope(zaxis)
+        if zaxis is not None and not zero:
+            # falling through to the plain flat path would apply
+            # UN-reduced grads element-misaligned against the
+            # device-concat shard buffer — silent corruption when the
+            # sizes happen to line up, an opaque shape error when not
+            raise RuntimeError(
+                f"optimizer state is ZeRO-sharded over axis {zaxis!r} "
+                f"but step() was called outside a shard_map mapping it")
         if flat:
             # fused-buffer hot path: one concat, one fused unscale, one
             # optimizer kernel, static slices back out
             scaled_grads = opt_state.masters.layout.pack(scaled_grads)
+        if zero:
+            # ZeRO-1: reduce-scatter the UN-reduced local grads — each
+            # device receives the summed grads for exactly its master
+            # shard (the psum+slice DDP would do, in one collective),
+            # then averages like gradient_average
+            layout = opt_state.masters.layout
+            dp = jax.lax.axis_size(zaxis)
+            shard_n = opt_state.masters.buf.shape[0]
+            scaled_grads = jnp.pad(
+                scaled_grads, (0, shard_n * dp - layout.total))
+            scaled_grads = jax.lax.psum_scatter(
+                scaled_grads, zaxis, scatter_dimension=0, tiled=True)
+            scaled_grads = scaled_grads / dp
         grads32, found_inf = self.scaler.unscale(scaled_grads, sstate)
         if found_inf_extra is not None:
             found_inf = jnp.maximum(found_inf, found_inf_extra)
+        if zero:
+            # each device saw only its grad window: the skip decision
+            # must be global or shards diverge
+            found_inf = jax.lax.pmax(found_inf, zaxis)
         for ax in (found_inf_axes or ()):
             if _axis_in_scope(ax):
                 found_inf = jax.lax.pmax(found_inf, ax)
@@ -241,7 +344,28 @@ class AmpOptimizer(Optimizer):
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(opt_state.scalers))
 
-        if flat:
+        if zero:
+            def do_update(operand):
+                p, masters, inner = operand
+                layout = masters.layout
+                new_buf, new_inner, half = self._flat_inner_step(
+                    masters, inner, grads32)
+                # params are replicated: gather every shard's update.
+                # rebuild reads full32 only for fp32 float leaves — skip
+                # that gather (the biggest collective here) when every
+                # float leaf has the half dtype
+                any_fp32 = any(f and d == "float32" for f, d in
+                               zip(layout.is_float, layout.dtypes))
+                full32 = (jax.lax.all_gather(
+                    new_buf, zaxis, axis=0, tiled=True)[:layout.total]
+                    if any_fp32 or half is None else None)
+                full_half = (jax.lax.all_gather(
+                    half, zaxis, axis=0, tiled=True)[:layout.total]
+                    if half is not None else None)
+                new_p = layout.rebuild(full32, full_half,
+                                       jax.tree_util.tree_leaves(p))
+                return new_p, FlatMasters(new_buf, layout), new_inner
+        elif flat:
             def do_update(operand):
                 p, masters, inner = operand
                 new_buf, new_inner, half = self._flat_inner_step(

@@ -1,0 +1,204 @@
+"""ZeRO stage-1 (optimizer-state sharding over the data axis): the
+reduce-scatter/update-shard/all-gather step must track the
+DDP-allreduce + full-replicated-state trajectory (identical math;
+psum vs psum_scatter reduction order separates them at float
+round-off), with the masters/moments 1/dp the size per device and
+overflow skips staying global."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp, nn, optimizers, parallel
+from apex_tpu.nn import functional as F
+
+
+def _setup(opt_level="O2"):
+    net = nn.Sequential([nn.Conv2d(3, 4, 3, padding=1),
+                         nn.BatchNorm2d(4), nn.ReLU(), nn.Flatten(),
+                         nn.Linear(4 * 8 * 8, 10)])
+    model, optimizer = amp.initialize(
+        net, optimizers.FusedAdam(lr=1e-2), opt_level=opt_level,
+        verbosity=0, hard_override=True)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    return model, optimizer, params, bn_state
+
+
+def _data(n=16):
+    rng = np.random.RandomState(0)
+    return (jnp.asarray(rng.randn(n, 3, 8, 8), jnp.float32),
+            jnp.asarray(rng.randint(0, 10, n), jnp.int32))
+
+
+def test_zero1_matches_ddp_trajectory():
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x, y = _data()
+    ddp = parallel.DistributedDataParallel(model)
+
+    def loss_fn_of(xb, yb, bn):
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), new_bn
+        return loss_fn
+
+    # -- reference: DDP allreduce + replicated optimizer state ----------
+    opt_ref = optimizer.init(params)
+
+    def ddp_step(p, os, bn, xb, yb):
+        loss, new_bn, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                          has_aux=True)
+        g = ddp.allreduce_grads(g)
+        p, os, _ = optimizer.step(p, os, g)
+        return p, os, new_bn, lax.pmean(loss, "data")
+
+    run_ref = jax.jit(jax.shard_map(
+        ddp_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()), check_vma=False))
+
+    # -- ZeRO-1: sharded state, NO pre-allreduce ------------------------
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+    # the flat state really is sharded: the global array is the
+    # device-concat (= padded full buffer), but each DEVICE holds only
+    # a 1/dp slice of it
+    full_elems = optimizer.init(params).masters.buf.size
+    gshape = opt_z.masters.buf.shape[0]
+    assert full_elems <= gshape < full_elems + 8      # padded concat
+    shard_sizes = {np.asarray(s.data).size
+                   for s in opt_z.masters.buf.addressable_shards}
+    assert shard_sizes == {gshape // 8}
+
+    def zero_step(p, os, bn, xb, yb):
+        loss, new_bn, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                          has_aux=True)
+        # no ddp.allreduce_grads: step() reduce-scatters internally
+        p, os, _ = optimizer.step(p, os, g)
+        return p, os, new_bn, lax.pmean(loss, "data")
+
+    run_z = jax.jit(jax.shard_map(
+        zero_step, mesh=mesh,
+        in_specs=(P(), ospecs, P(), P("data"), P("data")),
+        out_specs=(P(), ospecs, P(), P()), check_vma=False))
+
+    # single-step exactness: after ONE step from identical state the
+    # gathered ZeRO master shards equal the replicated masters to float
+    # round-off (the windowing/scatter math is exact; measured 3e-8)
+    def ref_masters(p, os, bn, xb, yb):
+        _, _, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                  has_aux=True)
+        g = ddp.allreduce_grads(g)
+        _, os, _ = optimizer.step(p, os, g)
+        return os.masters.buf
+
+    def zero_masters(p, os, bn, xb, yb):
+        _, _, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                  has_aux=True)
+        _, os, _ = optimizer.step(p, os, g)
+        return lax.all_gather(os.masters.buf, "data", axis=0,
+                              tiled=True)
+
+    mref = jax.jit(jax.shard_map(
+        ref_masters, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=P(), check_vma=False))(params, optimizer.init(params),
+                                         bn_state, x, y)
+    mz = jax.jit(jax.shard_map(
+        zero_masters, mesh=mesh,
+        in_specs=(P(), ospecs, P(), P("data"), P("data")),
+        out_specs=P(), check_vma=False))(params, opt_z, bn_state, x, y)
+    np.testing.assert_allclose(np.asarray(mz)[:mref.size],
+                               np.asarray(mref), atol=1e-6)
+
+    # multi-step: the trajectories track (Adam amplifies the psum-vs-
+    # psum_scatter reduction-order round-off, so bitwise equality is
+    # not expected — closeness of the LOSS curve is)
+    pa, osa, bna = params, optimizer.init(params), bn_state
+    pb, osb, bnb = params, opt_z, bn_state
+    for i in range(4):
+        pa, osa, bna, la = run_ref(pa, osa, bna, x, y)
+        pb, osb, bnb, lb = run_z(pb, osb, bnb, x, y)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-2,
+                                   err_msg=f"step {i}")
+
+
+def test_zero1_overflow_skip_is_global():
+    """An inf that reduce-scatters into ONE device's grad window must
+    skip the update and halve the scale on EVERY device."""
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+
+    # grads: inf in ONE leaf (first conv weight) only
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    k0 = list(grads)[0]
+    leaf0 = list(grads[k0])[0]
+    g0 = jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.inf), grads[k0][leaf0])
+    grads = {**grads, k0: {**grads[k0], leaf0: g0}}
+
+    def step(p, os, g):
+        p, os, info = optimizer.step(p, os, g)
+        return p, os, info["loss_scale"], info["found_inf"]
+
+    new_p, new_os, scale, found = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), ospecs, P()),
+        out_specs=(P(), ospecs, P(), P()), check_vma=False))(
+        params, opt_z, grads)
+    assert float(found) > 0
+    # every param identical to before (skip applied everywhere)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(new_p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # every master shard untouched too
+    np.testing.assert_array_equal(np.asarray(new_os.masters.buf),
+                                  np.asarray(opt_z.masters.buf))
+
+
+def test_zero_requires_flat_path():
+    net = nn.Sequential([nn.Linear(4, 4)])
+    model, optimizer = amp.initialize(
+        net, optimizers.FusedLAMB(lr=1e-3), opt_level="O2",
+        verbosity=0, hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    with pytest.raises(ValueError, match="elementwise"):
+        jax.jit(jax.shard_map(
+            lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+            in_specs=(P(),),
+            out_specs=jax.tree_util.tree_map(lambda _: P(), params),
+            check_vma=False))(params)
+
+
+def test_zero_step_outside_mesh_raises():
+    """A ZeRO-sharded state stepped without the axis mapped must fail
+    loudly — the flat fallback would corrupt params silently."""
+    model, optimizer, params, _ = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    with pytest.raises(RuntimeError, match="ZeRO-sharded"):
+        optimizer.step(params, opt_z, grads)
+
+
+def test_zero_masters_unpack_raises():
+    model, optimizer, params, _ = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+    with pytest.raises(RuntimeError, match="all_gather"):
+        opt_z.masters.as_tree()
