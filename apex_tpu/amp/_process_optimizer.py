@@ -52,6 +52,13 @@ def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
             in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
     """
     from jax.sharding import PartitionSpec as P
+    if not (optimizer.master_weights
+            and getattr(optimizer.inner, "elementwise", False)):
+        # same precondition init enforces — fail at the first API call
+        # instead of inside a jitted trace later
+        raise ValueError(
+            "zero_axis requires master weights and an elementwise inner "
+            "optimizer (the flat-buffer path)")
     layout = _FlatLayout(params)
     layout.zero_axis = axis_name
 

@@ -65,6 +65,9 @@ def parse_args():
                    help="convert BatchNorm to SyncBatchNorm")
     p.add_argument("--fused-adam", action="store_true",
                    help="use FusedAdam instead of SGD")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1: shard optimizer state over the data "
+                        "axis (reduce-scatter grads, all-gather params)")
     p.add_argument("--prof", action="store_true",
                    help="emit a jax profiler trace of 10 hot iterations")
     p.add_argument("--seed", type=int, default=0)
@@ -214,9 +217,20 @@ def main():
     ddp = parallel.DistributedDataParallel(model)
 
     params, bn_state = model.init(jax.random.PRNGKey(args.seed))
-    opt_state = optimizer.init(params)
-
     mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    if args.zero:
+        # ZeRO-1: per-device master/moment shards, built inside the
+        # mesh; the step reduce-scatters grads itself (no DDP allreduce)
+        print("=> ZeRO-1 optimizer-state sharding over the data axis")
+        ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+        opt_state = jax.jit(jax.shard_map(
+            lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+            in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+        state_specs = (P(), P(), ospecs)
+    else:
+        opt_state = optimizer.init(params)
+        state_specs = P()
 
     def step(state, batch):
         params, bn_state, opt_state = state
@@ -228,7 +242,8 @@ def main():
 
         loss, (new_bn, out), grads = amp.scaled_grad(
             loss_fn, params, opt_state, has_aux=True)
-        grads = ddp.allreduce_grads(grads)
+        if not args.zero:
+            grads = ddp.allreduce_grads(grads)
         params, opt_state, info = optimizer.step(params, opt_state, grads)
         acc = jnp.mean((jnp.argmax(out, -1) == y).astype(jnp.float32))
         metrics = {"loss": lax.pmean(loss, "data"),
@@ -238,8 +253,8 @@ def main():
 
     train_step = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), (P("data"), P("data"))),
-        out_specs=(P(), P()), check_vma=False))
+        in_specs=(state_specs, (P("data"), P("data"))),
+        out_specs=(state_specs, P()), check_vma=False))
 
     # validation pass (reference's validate(), main_amp.py:330-390):
     # eval-mode forward over the held-out split, Prec@1 pmean'd
@@ -251,7 +266,7 @@ def main():
         return lax.pmean(acc, "data") * 100.0
 
     eval_step = jax.jit(jax.shard_map(
-        _eval, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
+        _eval, mesh=mesh, in_specs=(state_specs, (P("data"), P("data"))),
         out_specs=P(), check_vma=False))
 
     def validate(state):

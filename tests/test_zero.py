@@ -70,10 +70,11 @@ def test_zero1_matches_ddp_trajectory():
     # a 1/dp slice of it
     full_elems = optimizer.init(params).masters.buf.size
     gshape = opt_z.masters.buf.shape[0]
-    assert full_elems <= gshape < full_elems + 8      # padded concat
+    dp = mesh.devices.size
+    assert full_elems <= gshape < full_elems + dp     # padded concat
     shard_sizes = {np.asarray(s.data).size
                    for s in opt_z.masters.buf.addressable_shards}
-    assert shard_sizes == {gshape // 8}
+    assert shard_sizes == {gshape // dp}
 
     def zero_step(p, os, bn, xb, yb):
         loss, new_bn, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
