@@ -25,6 +25,7 @@ from jax import lax
 from .. import nn
 from ..nn import functional as F
 from ..normalization import FusedLayerNorm
+from ..parallel.sync_batchnorm import _axis_in_scope as _sp_in_scope
 from ..transformer.attention import dot_product_attention
 
 __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium"]
@@ -33,7 +34,7 @@ __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium"]
 class GPTConfig:
     def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
                  n_head=12, n_embd=768, dropout=0.1,
-                 layer_norm_eps=1e-5, tp_axis=None):
+                 layer_norm_eps=1e-5, tp_axis=None, sp_axis=None):
         self.vocab_size = vocab_size
         self.block_size = block_size
         self.n_layer = n_layer
@@ -42,6 +43,17 @@ class GPTConfig:
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
         self.tp_axis = tp_axis
+        # sequence parallelism: tokens sharded over this mesh axis, the
+        # causal attention runs as ring attention (K/V blocks rotate
+        # over ICI), positions and the next-token label shift become
+        # globally consistent automatically — block_size then means the
+        # GLOBAL sequence length
+        self.sp_axis = sp_axis
+        if tp_axis is not None and sp_axis is not None:
+            raise NotImplementedError(
+                "combined tp+sp GPT is not wired; pick one "
+                "(see tests/test_tensor_parallel.py::"
+                "test_3d_parallel_block_data_sp_tp for the pattern)")
 
 
 def gpt2_small():
@@ -60,6 +72,7 @@ class GPTSelfAttention(nn.Module):
         self.n_head = cfg.n_head
         self.head_dim = cfg.n_embd // cfg.n_head
         self.dropout = cfg.dropout
+        self.sp = cfg.sp_axis
         self.tp = cfg.tp_axis is not None
         if self.tp:
             from ..parallel.tensor_parallel import ParallelSelfAttention
@@ -79,8 +92,22 @@ class GPTSelfAttention(nn.Module):
         qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.n_head,
                                             self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
-        ctx = dot_product_attention(q, k, v, mask, causal=True,
-                                    dropout_rate=self.dropout)
+        if self.sp is not None and _sp_in_scope(self.sp):
+            from ..transformer.ring_attention import ring_attention
+            from ..nn.module import current_context
+            actx = current_context()
+            rng = None
+            if self.dropout > 0.0 and actx is not None and actx.train:
+                # same regularizer as the non-sp path: ring_attention's
+                # in-kernel dropout folds device+step into this key
+                rng = actx.make_rng()
+            ctx = ring_attention(
+                q, k, v, axis_name=self.sp, causal=True,
+                dropout_rate=self.dropout if rng is not None else 0.0,
+                dropout_rng=rng)
+        else:
+            ctx = dot_product_attention(q, k, v, mask, causal=True,
+                                        dropout_rate=self.dropout)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
 
@@ -180,10 +207,25 @@ class GPT(nn.Module):
         and the full-vocab head matmul over all S positions is the
         dominant per-token cost they'd otherwise pay."""
         B, T = input_ids.shape
-        if T > self.cfg.block_size:
-            raise ValueError(f"sequence length {T} exceeds block_size "
-                             f"{self.cfg.block_size}")
-        pos = jnp.arange(T)[None, :]
+        sp = self.cfg.sp_axis
+        in_sp = sp is not None and _sp_in_scope(sp)
+        if in_sp:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "attention_mask under sequence parallelism is not "
+                    "wired; pack/pad outside the sp axis instead")
+            spn = lax.axis_size(sp)
+            if T * spn > self.cfg.block_size:
+                raise ValueError(
+                    f"global sequence {T}x{spn} exceeds block_size "
+                    f"{self.cfg.block_size}")
+            # GLOBAL positions for this device's token shard
+            pos = lax.axis_index(sp) * T + jnp.arange(T)[None, :]
+        else:
+            if T > self.cfg.block_size:
+                raise ValueError(f"sequence length {T} exceeds "
+                                 f"block_size {self.cfg.block_size}")
+            pos = jnp.arange(T)[None, :]
         x = (self.wte(p["wte"], input_ids)
              + self.wpe(p["wpe"], pos))
         x = self.drop(p.get("drop", {}), x)
@@ -207,7 +249,42 @@ class GPT(nn.Module):
     def loss(self, p, input_ids, attention_mask: Optional[jax.Array]
              = None, ignore_index: int = -100):
         """Next-token cross-entropy: predict ids[t+1] from prefix <=t.
-        Padding positions (attention_mask == 0) are ignored."""
+        Padding positions (attention_mask == 0) are ignored.
+
+        Under ``sp_axis`` the shift crosses shard boundaries: each
+        device's last position is supervised by the NEXT device's first
+        token (one (B, 1) ppermute), the global last position is
+        masked, and the mean is psum'd over the axis so every device
+        returns the global loss."""
+        sp = self.cfg.sp_axis
+        if sp is not None and _sp_in_scope(sp):
+            if attention_mask is not None:
+                # forward would raise, but the mask must not be dropped
+                # silently before it gets there
+                raise NotImplementedError(
+                    "attention_mask under sequence parallelism is not "
+                    "wired; pack/pad outside the sp axis instead")
+            B, T = input_ids.shape
+            spn = lax.axis_size(sp)
+            idx = lax.axis_index(sp)
+            logits = self(p, input_ids)                 # (B, T, V)
+            nxt_first = lax.ppermute(
+                input_ids[:, :1], sp,
+                [(i, (i - 1) % spn) for i in range(spn)])
+            labels = jnp.concatenate([input_ids[:, 1:], nxt_first], 1)
+            # the global final position has no successor (the wrapped
+            # ppermute delivered shard 0's first token — mask it)
+            is_last = (idx == spn - 1)
+            labels = labels.at[:, -1].set(
+                jnp.where(is_last, ignore_index, labels[:, -1]))
+            logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+            valid = labels != ignore_index
+            safe = jnp.where(valid, labels, 0)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+            num = lax.psum(jnp.sum(nll * valid), sp)
+            den = lax.psum(jnp.sum(valid.astype(jnp.float32)), sp)
+            return num / jnp.maximum(den, 1.0)
         logits = self(p, input_ids, attention_mask)[:, :-1]
         labels = input_ids[:, 1:]
         if attention_mask is not None:

@@ -197,3 +197,99 @@ def test_decode_step_matches_full_forward():
     full = model(params, ids, amask)
     np.testing.assert_allclose(np.asarray(logits_t),
                                np.asarray(full[:, -1]), atol=2e-5)
+
+
+def test_gpt_sequence_parallel_matches_unmapped():
+    """sp_axis: tokens sharded over the mesh, ring attention, global
+    positions, cross-shard label shift — loss equals the full-sequence
+    computation, and grads (pmean'd over sp like a data axis) match."""
+    cfg = tiny_cfg(sp_axis="sp", block_size=16)
+    model = models.GPT(cfg)
+    params, _ = model.init(jax.random.PRNGKey(10))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    ids = jnp.asarray(np.random.RandomState(10).randint(0, 64, (2, 16)))
+
+    def sp_loss(p, i):
+        return model.loss(p, i)
+
+    l_sp = jax.jit(jax.shard_map(
+        sp_loss, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(), check_vma=False))(params, ids)
+
+    # unmapped reference: same model object, full sequence, standard
+    # shifted loss (sp code path inert outside the mesh)
+    l_ref = model.loss(params, ids)
+    np.testing.assert_allclose(float(l_sp), float(l_ref), rtol=2e-6)
+
+    # grads: the sp axis behaves like a data axis — average over it
+    def sp_grad(p, i):
+        g = jax.grad(sp_loss)(p, i)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.pmean(t, "sp"), g)
+
+    g_sp = jax.jit(jax.shard_map(
+        sp_grad, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(), check_vma=False))(params, ids)
+    g_ref = jax.grad(lambda p: model.loss(p, ids))(params)
+    assert_trees_close(g_sp, g_ref, atol=5e-5)
+
+
+def test_gpt_sp_long_sequence_trains():
+    """Train a few steps at a global length that each device only ever
+    sees a quarter of; loss must descend."""
+    from apex_tpu import amp
+    cfg = tiny_cfg(sp_axis="sp", block_size=64)
+    model, opt = amp.initialize(models.GPT(cfg),
+                                optimizers.FusedAdam(lr=3e-3),
+                                opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(11))
+    opt_state = opt.init(params)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    pat = np.tile(np.arange(8), 8)
+    ids = jnp.asarray(np.stack([np.roll(pat, r) for r in range(4)]))
+
+    def step(p, os, i):
+        def loss_fn(pp):
+            return model.loss(pp, i), ()
+        loss, _, g = amp.scaled_grad(loss_fn, p, os, has_aux=True)
+        g = jax.tree_util.tree_map(lambda t: jax.lax.pmean(t, "sp"), g)
+        p, os, _ = opt.step(p, os, g)
+        return p, os, loss
+
+    train = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P(None, "sp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    l0 = None
+    for _ in range(30):
+        params, opt_state, loss = train(params, opt_state, ids)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0 * 0.5, (l0, float(loss))
+
+
+def test_gpt_sp_mask_rejected_and_dropout_active():
+    cfg = tiny_cfg(sp_axis="sp", block_size=16, dropout=0.3)
+    model = models.GPT(cfg)
+    params, _ = model.init(jax.random.PRNGKey(12))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    ids = jnp.asarray(np.random.RandomState(12).randint(0, 64, (2, 16)))
+    amask = jnp.ones((2, 4), jnp.int32)
+
+    with pytest.raises(NotImplementedError, match="attention_mask"):
+        jax.jit(jax.shard_map(
+            lambda p, i, m: model.loss(p, i, m), mesh=mesh,
+            in_specs=(P(), P(None, "sp"), P(None, "sp")), out_specs=P(),
+            check_vma=False))(params, ids, amask)
+
+    # train-mode dropout is live on the sp path: two rngs differ
+    def fwd(p, i, key):
+        out, _ = nn.apply(model, p, i, train=True,
+                          rng=jax.random.PRNGKey(key))
+        return out
+
+    run = jax.jit(jax.shard_map(
+        lambda p, i: fwd(p, i, 0) - fwd(p, i, 1), mesh=mesh,
+        in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"),
+        check_vma=False), static_argnums=())
+    diff = run(params, ids)
+    assert np.abs(np.asarray(diff)).max() > 1e-4
