@@ -32,7 +32,7 @@ class BertConfig:
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12,
-                 tp_axis=None):
+                 tp_axis=None, hidden_act="gelu_tanh"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -43,6 +43,12 @@ class BertConfig:
         self.hidden_dropout_prob = hidden_dropout_prob
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.layer_norm_eps = layer_norm_eps
+        # "gelu_tanh" (the TPU-friendly default) or "gelu_exact" (erf —
+        # HuggingFace BERT's default, for checkpoint-parity use)
+        if hidden_act not in ("gelu_tanh", "gelu_exact"):
+            raise ValueError(f"hidden_act must be 'gelu_tanh' or "
+                             f"'gelu_exact', got {hidden_act!r}")
+        self.hidden_act = hidden_act
         # tensor-parallel mesh axis: when set, attention/MLP/vocab
         # embedding/MLM head shard over it (Megatron layout, beyond the
         # reference) — jit with shard_map and
@@ -102,9 +108,13 @@ class BertLayer(nn.Module):
         self.tp = cfg.tp_axis is not None
         if self.tp:
             from ..parallel.tensor_parallel import ParallelMLP
-            # column(intermediate) -> gelu -> row(hidden): one psum
+            # column(intermediate) -> gelu -> row(hidden): one psum;
+            # the activation honors hidden_act (checkpoint parity)
             self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
-                                   activation="gelu",
+                                   activation=("gelu_exact"
+                                               if cfg.hidden_act
+                                               == "gelu_exact"
+                                               else "gelu"),
                                    axis_name=cfg.tp_axis)
         else:
             self.intermediate = nn.Linear(cfg.hidden_size,
@@ -113,6 +123,7 @@ class BertLayer(nn.Module):
         self.output_ln = FusedLayerNorm(cfg.hidden_size,
                                         eps=cfg.layer_norm_eps)
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+        self.gelu_approx = cfg.hidden_act != "gelu_exact"
 
     def forward(self, p, x, mask=None):
         a = self.attention(p["attention"], x, mask)
@@ -120,7 +131,8 @@ class BertLayer(nn.Module):
         if self.tp:
             h = self.drop(p.get("drop", {}), self.mlp(p["mlp"], x))
         else:
-            h = F.gelu(self.intermediate(p["intermediate"], x))
+            h = F.gelu(self.intermediate(p["intermediate"], x),
+                       approximate=self.gelu_approx)
             h = self.drop(p.get("drop", {}), self.output(p["output"], h))
         return self.output_ln(p["output_ln"], x + h)
 
@@ -180,8 +192,9 @@ class BertForPretraining(nn.Module):
                 attention_mask=None):
         seq, pooled = self.bert(p["bert"], input_ids, token_type_ids,
                                 attention_mask)
-        h = self.mlm_ln(p["mlm_ln"], F.gelu(self.mlm_dense(p["mlm_dense"],
-                                                           seq)))
+        h = self.mlm_ln(p["mlm_ln"], F.gelu(
+            self.mlm_dense(p["mlm_dense"], seq),
+            approximate=self.cfg.hidden_act != "gelu_exact"))
         # decoder tied to word embeddings (standard BERT); under TP the
         # table leaf is vocab-sharded, so the logits come out sharded on
         # the vocab dim (consume with vocab_parallel_cross_entropy) —

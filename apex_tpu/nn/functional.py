@@ -38,7 +38,7 @@ def _bias_add(y: jax.Array, bias: Optional[jax.Array],
 
 __all__ = [
     "linear", "matmul", "conv2d", "conv_transpose2d", "relu", "leaky_relu",
-    "gelu", "silu", "sigmoid", "tanh",
+    "gelu", "gelu_exact", "silu", "sigmoid", "tanh",
     "softmax", "log_softmax", "layer_norm", "batch_norm_stats",
     "batch_norm_apply", "dropout", "max_pool2d", "avg_pool2d",
     "adaptive_avg_pool2d", "embedding", "cross_entropy", "nll_loss",
@@ -157,6 +157,12 @@ def leaky_relu(x: jax.Array, negative_slope: float = 0.01) -> jax.Array:
 @op("gelu")
 def gelu(x: jax.Array, approximate: bool = True) -> jax.Array:
     return jax.nn.gelu(x, approximate=approximate)
+
+def gelu_exact(x: jax.Array) -> jax.Array:
+    """erf-form gelu (HF BERT's 'gelu') — rides gelu's cast policy."""
+    return gelu(x, approximate=False)
+
+
 
 
 def silu(x: jax.Array) -> jax.Array:

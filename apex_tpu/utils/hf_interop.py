@@ -1,0 +1,150 @@
+"""HuggingFace checkpoint interop: torch state_dicts -> apex_tpu params.
+
+A user switching from the reference stack brings torch-ecosystem
+weights; these converters map ``transformers`` BERT / GPT-2 state_dicts
+onto apex_tpu's param trees, and the tests prove output parity against
+the HF torch implementations themselves (random-init models, so no
+network access is needed — the proof is architectural, and a real
+pretrained checkpoint converts the same way).
+
+    hf = transformers.BertModel(hf_cfg)          # or .from_pretrained
+    cfg, params = hf_interop.bert_from_hf(hf)
+    model = apex_tpu.models.BertModel(cfg)
+    seq, pooled = model(params, ids, token_type_ids=tt)
+
+Conventions handled: HF's separate q/k/v projections fuse into the
+(3E, E) qkv weight (head-major row order matches), GPT-2's Conv1D
+weights transpose into Linear layout, and BERT's exact-erf gelu is
+selected via ``hidden_act="gelu_exact"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy())
+
+
+def _lin(sd, prefix):
+    return {"weight": _t(sd[f"{prefix}.weight"]),
+            "bias": _t(sd[f"{prefix}.bias"])}
+
+
+_ln = _lin      # LayerNorm params share the weight/bias naming
+
+
+def bert_from_hf(hf_model) -> Tuple[Any, Any]:
+    """(BertConfig, params) for apex_tpu.models.BertModel from a
+    transformers.BertModel."""
+    from ..models import BertConfig
+    hc = hf_model.config
+    if hc.hidden_act != "gelu":
+        raise ValueError(
+            f"unsupported source activation {hc.hidden_act!r}: the "
+            f"converter maps HF's default 'gelu' (exact erf); other "
+            f"activations would silently diverge")
+    cfg = BertConfig(vocab_size=hc.vocab_size,
+                     hidden_size=hc.hidden_size,
+                     num_hidden_layers=hc.num_hidden_layers,
+                     num_attention_heads=hc.num_attention_heads,
+                     intermediate_size=hc.intermediate_size,
+                     max_position_embeddings=hc.max_position_embeddings,
+                     type_vocab_size=hc.type_vocab_size,
+                     hidden_dropout_prob=hc.hidden_dropout_prob,
+                     attention_probs_dropout_prob=(
+                         hc.attention_probs_dropout_prob),
+                     layer_norm_eps=hc.layer_norm_eps,
+                     hidden_act="gelu_exact")
+    sd = hf_model.state_dict()
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"encoder.layer.{i}"
+        q = _lin(sd, f"{b}.attention.self.query")
+        k = _lin(sd, f"{b}.attention.self.key")
+        v = _lin(sd, f"{b}.attention.self.value")
+        layers[str(i)] = {
+            "attention": {
+                # fused qkv: rows [q; k; v] — matches the (B,T,3,H,D)
+                # reshape order of BertSelfAttention
+                "qkv": {"weight": np.concatenate(
+                            [q["weight"], k["weight"], v["weight"]], 0),
+                        "bias": np.concatenate(
+                            [q["bias"], k["bias"], v["bias"]], 0)},
+                "out": _lin(sd, f"{b}.attention.output.dense"),
+            },
+            "attention_ln": _ln(sd, f"{b}.attention.output.LayerNorm"),
+            "intermediate": _lin(sd, f"{b}.intermediate.dense"),
+            "output": _lin(sd, f"{b}.output.dense"),
+            "output_ln": _ln(sd, f"{b}.output.LayerNorm"),
+        }
+    params = {
+        "word_embeddings": {
+            "weight": _t(sd["embeddings.word_embeddings.weight"])},
+        "position_embeddings": {
+            "weight": _t(sd["embeddings.position_embeddings.weight"])},
+        "token_type_embeddings": {
+            "weight": _t(sd["embeddings.token_type_embeddings.weight"])},
+        "embeddings_ln": _ln(sd, "embeddings.LayerNorm"),
+        "layer": layers,
+        "pooler": _lin(sd, "pooler.dense"),
+    }
+    return cfg, _to_jnp(params)
+
+
+def gpt_from_hf(hf_model) -> Tuple[Any, Any]:
+    """(GPTConfig, params) for apex_tpu.models.GPT from a
+    transformers.GPT2Model.  GPT-2's Conv1D stores (in, out); Linear
+    wants (out, in) — transposed here."""
+    from ..models import GPTConfig
+    hc = hf_model.config
+    if hc.activation_function != "gelu_new":
+        raise ValueError(
+            f"unsupported source activation "
+            f"{hc.activation_function!r}: the converter maps GPT-2's "
+            f"default 'gelu_new' (tanh)")
+    if not (hc.resid_pdrop == hc.attn_pdrop == hc.embd_pdrop):
+        raise ValueError(
+            f"GPTConfig has one dropout rate; the source has "
+            f"resid={hc.resid_pdrop} attn={hc.attn_pdrop} "
+            f"embd={hc.embd_pdrop} — make them equal (or zero for "
+            f"inference) before converting")
+    cfg = GPTConfig(vocab_size=hc.vocab_size,
+                    block_size=hc.n_positions, n_layer=hc.n_layer,
+                    n_head=hc.n_head, n_embd=hc.n_embd,
+                    dropout=hc.resid_pdrop,
+                    layer_norm_eps=hc.layer_norm_epsilon)
+    sd = hf_model.state_dict()
+
+    def conv1d(prefix):
+        return {"weight": _t(sd[f"{prefix}.weight"]).T,
+                "bias": _t(sd[f"{prefix}.bias"])}
+
+    h = {}
+    for i in range(hc.n_layer):
+        b = f"h.{i}"
+        h[str(i)] = {
+            "ln_1": _ln(sd, f"{b}.ln_1"),
+            "attn": {"qkv": conv1d(f"{b}.attn.c_attn"),
+                     "out": conv1d(f"{b}.attn.c_proj")},
+            "ln_2": _ln(sd, f"{b}.ln_2"),
+            "fc": conv1d(f"{b}.mlp.c_fc"),
+            "proj": conv1d(f"{b}.mlp.c_proj"),
+        }
+    params = {
+        "wte": {"weight": _t(sd["wte.weight"])},
+        "wpe": {"weight": _t(sd["wpe.weight"])},
+        "h": h,
+        "ln_f": _ln(sd, "ln_f"),
+    }
+    return cfg, _to_jnp(params)
+
+
+def _to_jnp(tree):
+    import jax.numpy as jnp
+    import jax
+    return jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32),
+                                  tree)
