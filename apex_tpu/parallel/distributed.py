@@ -256,13 +256,20 @@ class DistributedDataParallel:
     # -- whole-step builder for the common 1-D data-parallel mesh ---------
     def make_step(self, step_fn: Callable, mesh: Optional[Mesh] = None,
                   donate_state: bool = True,
-                  steps_per_call: int = 1) -> Callable:
+                  steps_per_call: int = 1,
+                  state_specs: Any = None) -> Callable:
         """shard_map ``step_fn(state..., batch) -> (state..., aux)`` over a
         1-D mesh: replicated state, batch sharded on axis 0.  ``step_fn``
         runs per-device and should call ``self.allreduce_grads`` on its
         gradient tree (param broadcast from rank 0 is implicit: replicated
         inputs to shard_map stay replicated, the analogue of the init-time
         broadcast at distributed.py:234).
+
+        ``state_specs``: PartitionSpec pytree for the state when parts of
+        it are NOT replicated — e.g. a ZeRO-sharded optimizer state
+        (``(P(), P(), amp.zero_optimizer_specs(...))``) or TP-sharded
+        params (``tensor_parallel.partition_specs``).  Defaults to fully
+        replicated (``P()``), the plain-DDP contract.
 
         ``steps_per_call > 1`` wraps ``step_fn`` in a ``lax.scan`` over a
         leading micro-batch axis (batch shaped ``(K, per_step...)``) so
@@ -275,6 +282,8 @@ class DistributedDataParallel:
         K = int(steps_per_call)
         if K < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {K}")
+        if state_specs is None:
+            state_specs = P()
 
         if K == 1:
             wrapped = step_fn
@@ -291,8 +300,8 @@ class DistributedDataParallel:
         bspec = P(an) if K == 1 else P(None, an)
         mapped = jax.shard_map(
             wrapped, mesh=mesh,
-            in_specs=(P(), bspec),
-            out_specs=(P(), P()),
+            in_specs=(state_specs, bspec),
+            out_specs=(state_specs, P()),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0,) if donate_state else ())
 

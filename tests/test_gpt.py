@@ -293,3 +293,37 @@ def test_gpt_sp_mask_rejected_and_dropout_active():
         check_vma=False), static_argnums=())
     diff = run(params, ids)
     assert np.abs(np.asarray(diff)).max() > 1e-4
+
+
+@pytest.mark.parametrize("ol", ["O1", "O3"])
+def test_gpt_trains_under_other_opt_levels(ol):
+    """The decoder family rides the amp opt-level matrix like the
+    reference models: O1 (policy-patched ops, fp32 params) and O3
+    (pure half) both train."""
+    from apex_tpu import amp
+    model, opt = amp.initialize(models.GPT(tiny_cfg()),
+                                optimizers.FusedAdam(lr=2e-3),
+                                opt_level=ol, verbosity=0,
+                                hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(13))
+    leaves = jax.tree_util.tree_leaves(params)
+    if ol == "O1":
+        assert all(l.dtype == jnp.float32 for l in leaves)
+    else:
+        assert any(l.dtype == jnp.bfloat16 for l in leaves)
+    opt_state = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(13).randint(0, 64, (4, 16)))
+
+    @jax.jit
+    def step(p, os):
+        loss, _, g = amp.scaled_grad(
+            lambda pp: (model.loss(pp, ids), ()), p, os, has_aux=True)
+        p, os, _ = opt.step(p, os, g)
+        return p, os, loss
+
+    l0 = None
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0 * 0.8, (ol, l0, float(loss))

@@ -203,3 +203,40 @@ def test_zero_masters_unpack_raises():
         in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
     with pytest.raises(RuntimeError, match="all_gather"):
         opt_z.masters.as_tree()
+
+
+def test_zero1_rides_make_step():
+    """The standard make_step builder accepts the ZeRO state specs
+    (state_specs param), including the steps_per_call scan."""
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+    ddp = parallel.DistributedDataParallel(model)
+    x, y = _data()
+
+    def step(state, batch):
+        p, bn, os = state
+        xb, yb = batch
+
+        def loss_fn(pp):
+            out, nb = model.apply(pp, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), nb
+        loss, nb, g = amp.scaled_grad(loss_fn, p, os, has_aux=True)
+        p, os, _ = optimizer.step(p, os, g)   # reduce-scatter inside
+        return (p, nb, os), lax.pmean(loss, "data")
+
+    train = ddp.make_step(step, mesh=mesh, donate_state=False,
+                          steps_per_call=2,
+                          state_specs=(P(), P(), ospecs))
+    kx = jnp.stack([x, x])
+    ky = jnp.stack([y, y])
+    state = (params, bn_state, opt_z)
+    state, losses = train(state, (kx, ky))
+    assert losses.shape == (2,)
+    assert np.isfinite(np.asarray(losses)).all()
+    # second call continues from the updated sharded state
+    state, losses2 = train(state, (kx, ky))
+    assert float(losses2[-1]) < float(losses[0])
