@@ -11,7 +11,8 @@ O1 registries (register_half_function etc.).
 """
 
 from .frontend import initialize, Properties, opt_levels, O0, O1, O2, O3
-from .handle import scale_loss, scaled_grad, disable_casts
+from .handle import (scale_loss, scaled_grad, scaled_grad_accum,
+                     disable_casts)
 from .scaler import LossScaler, ScalerState
 from ._process_optimizer import (AmpOptimizer, AmpOptState,
                                  zero_optimizer_specs)

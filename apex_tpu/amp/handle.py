@@ -28,7 +28,8 @@ from . import policy as _policy
 from ._amp_state import _amp_state, maybe_print
 from ._process_optimizer import AmpOptimizer, AmpOptState
 
-__all__ = ["scale_loss", "scaled_grad", "disable_casts"]
+__all__ = ["scale_loss", "scaled_grad", "scaled_grad_accum",
+           "disable_casts"]
 
 disable_casts = _policy.disable_casts
 
@@ -57,6 +58,54 @@ def scaled_grad(loss_fn: Callable, params: Any, opt_state: AmpOptState,
         return scaled_loss / scale, aux, grads
     scaled_loss, grads = jax.value_and_grad(scaled_fn)(params)
     return scaled_loss / scale, grads
+
+
+def scaled_grad_accum(loss_fn: Callable, params: Any,
+                      opt_state: AmpOptState, batches: Any,
+                      loss_id: int = 0, average: bool = True):
+    """Gradient accumulation inside jit: K micro-batch backward passes,
+    ONE optimizer step.
+
+    ``loss_fn(params, microbatch) -> loss``; ``batches`` is a pytree
+    whose leaves carry a leading K axis.  Runs a ``lax.scan`` over the
+    micro-batches summing the SCALED gradients (peak memory = one
+    micro-batch's activations + one grad tree), and returns
+    ``(mean_loss, scaled_grads)`` to pass straight to
+    ``AmpOptimizer.step`` — the single unscale there preserves the
+    reference's accumulation semantics (``delay_unscale=True`` across
+    backwards, ``unscale_with_stashed`` once at step time,
+    handle.py:117-137).  ``average=True`` divides by K so the update
+    matches one big batch of the concatenated micro-batches (mean-loss
+    convention); ``False`` leaves the raw sum.
+    """
+    scale = opt_state.scalers[loss_id].loss_scale
+    K = jax.tree_util.tree_leaves(batches)[0].shape[0]
+
+    def one(p, mb):
+        return jax.value_and_grad(
+            lambda pp: loss_fn(pp, mb).astype(jnp.float32) * scale)(p)
+
+    def body(carry, mb):
+        loss_sum, acc = carry
+        scaled_loss, g = one(params, mb)
+        # fp32 accumulator: summing K half-precision grad trees would
+        # lose a few ulps per add (the reference stashes fp32 too)
+        acc = jax.tree_util.tree_map(
+            lambda a, gg: a + gg.astype(a.dtype), acc, g)
+        return (loss_sum + scaled_loss, acc), None
+
+    # value_and_grad rejects non-float params, so every leaf gets a
+    # grad and the fp32 accumulator is always the right dtype
+    zeros = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, jnp.float32), params)
+    (loss_sum, grads), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), batches)
+    if average:
+        grads = jax.tree_util.tree_map(lambda g: g / K, grads)
+        return loss_sum / scale / K, grads
+    # sum convention: loss and grads agree (the caller's objective is
+    # the SUM of micro-batch losses)
+    return loss_sum / scale, grads
 
 
 class _ScaledLoss:

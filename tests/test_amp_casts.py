@@ -205,3 +205,68 @@ def test_properties_string_coercion():
     assert props.keep_batchnorm_fp32 is True
     with pytest.raises(ValueError):
         props.keep_batchnorm_fp32 = "yes"
+
+
+def _accum_setup(opt_level):
+    from apex_tpu import amp, nn, optimizers
+    from apex_tpu.nn import functional as F
+    net = nn.Sequential([nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4)])
+    model, opt = amp.initialize(net, optimizers.FusedAdam(lr=1e-2),
+                                opt_level=opt_level, verbosity=0,
+                                hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(12, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(12, 4), jnp.float32)
+
+    def loss_fn(p, mb):
+        xb, yb = mb
+        out, _ = model.apply(p, xb)
+        return F.mse_loss(out, yb)
+
+    return model, opt, params, opt_state, x, y, loss_fn
+
+
+def test_scaled_grad_accum_matches_big_batch_fp32():
+    """K accumulated micro-batches == one K-times-bigger batch under O0
+    fp32 (exactly — no half-precision batch-shape rounding)."""
+    from apex_tpu import amp
+    _, opt, params, opt_state, x, y, loss_fn = _accum_setup("O0")
+    micro = (x.reshape(3, 4, 8), y.reshape(3, 4, 4))
+    l_acc, g_acc = amp.scaled_grad_accum(loss_fn, params, opt_state,
+                                         micro)
+    l_big, g_big = amp.scaled_grad(lambda p: loss_fn(p, (x, y)), params,
+                                   opt_state)
+    np.testing.assert_allclose(float(l_acc), float(l_big), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_acc),
+                    jax.tree_util.tree_leaves(g_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6)
+
+
+def test_scaled_grad_accum_o2_step_and_overflow():
+    """Under O2 the accumulated grads feed one optimizer step (grads
+    close to the big batch modulo bf16 batch-shape rounding), and an
+    inf in ANY micro-batch survives the sum and skips the step."""
+    from apex_tpu import amp
+    _, opt, params, opt_state, x, y, loss_fn = _accum_setup("O2")
+    micro = (x.reshape(3, 4, 8), y.reshape(3, 4, 4))
+    l_acc, g_acc = amp.scaled_grad_accum(loss_fn, params, opt_state,
+                                         micro)
+    _, g_big = amp.scaled_grad(lambda p: loss_fn(p, (x, y)), params,
+                               opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(g_acc),
+                    jax.tree_util.tree_leaves(g_big)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=1e-3, rtol=0.05)
+    p2, os2, info = opt.step(params, opt_state, g_acc)
+    assert float(info["found_inf"]) == 0.0
+    bad = (micro[0].at[1].set(jnp.inf), micro[1])
+    _, g_bad = amp.scaled_grad_accum(loss_fn, params, opt_state, bad)
+    p3, os3, info3 = opt.step(params, opt_state, g_bad)
+    assert float(info3["found_inf"]) > 0
+    for a, b in zip(jax.tree_util.tree_leaves(p3),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
