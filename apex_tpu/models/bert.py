@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..normalization import FusedLayerNorm
+from ..parallel.sync_batchnorm import _axis_in_scope as _sp_in_scope
 from ..transformer.attention import dot_product_attention
 
 __all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
@@ -32,7 +33,7 @@ class BertConfig:
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12,
-                 tp_axis=None, hidden_act="gelu_tanh"):
+                 tp_axis=None, hidden_act="gelu_tanh", sp_axis=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -54,6 +55,14 @@ class BertConfig:
         # reference) — jit with shard_map and
         # parallel.tensor_parallel.partition_specs(model)
         self.tp_axis = tp_axis
+        # sequence parallelism: tokens shard over this axis,
+        # bidirectional ring attention (padding masks ride the ring's
+        # rotating kv_mask); max_position_embeddings bounds the GLOBAL
+        # length
+        self.sp_axis = sp_axis
+        if tp_axis is not None and sp_axis is not None:
+            raise NotImplementedError(
+                "combined tp+sp BERT is not wired; pick one")
 
 
 def bert_base():
@@ -71,6 +80,7 @@ class BertSelfAttention(nn.Module):
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.attention_probs_dropout_prob = cfg.attention_probs_dropout_prob
+        self.sp = cfg.sp_axis
         self.tp = cfg.tp_axis is not None
         if self.tp:
             from ..parallel.tensor_parallel import ParallelSelfAttention
@@ -85,7 +95,7 @@ class BertSelfAttention(nn.Module):
             self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, p, x, mask=None):
+    def forward(self, p, x, mask=None, kv_mask=None):
         B, T, E = x.shape
         if self.tp:
             return self.drop(p.get("drop", {}), self.core(p["core"], x,
@@ -93,8 +103,28 @@ class BertSelfAttention(nn.Module):
         qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.num_heads,
                                             self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
-        ctx = dot_product_attention(
-            q, k, v, mask, dropout_rate=self.attention_probs_dropout_prob)
+        if self.sp is not None and _sp_in_scope(self.sp):
+            if mask is not None:
+                raise ValueError(
+                    "dense `mask` is ignored under sequence parallelism"
+                    " — pass the (B, T_local) validity slice as kv_mask")
+            from ..transformer.ring_attention import ring_attention
+            from ..nn.module import current_context
+            actx = current_context()
+            rng = None
+            if (self.attention_probs_dropout_prob > 0.0
+                    and actx is not None and actx.train):
+                rng = actx.make_rng()
+            ctx = ring_attention(
+                q, k, v, axis_name=self.sp, causal=False,
+                kv_mask=kv_mask,
+                dropout_rate=(self.attention_probs_dropout_prob
+                              if rng is not None else 0.0),
+                dropout_rng=rng)
+        else:
+            ctx = dot_product_attention(
+                q, k, v, mask,
+                dropout_rate=self.attention_probs_dropout_prob)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
 
@@ -125,8 +155,8 @@ class BertLayer(nn.Module):
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
         self.gelu_approx = cfg.hidden_act != "gelu_exact"
 
-    def forward(self, p, x, mask=None):
-        a = self.attention(p["attention"], x, mask)
+    def forward(self, p, x, mask=None, kv_mask=None):
+        a = self.attention(p["attention"], x, mask, kv_mask=kv_mask)
         x = self.attention_ln(p["attention_ln"], x + a)
         if self.tp:
             h = self.drop(p.get("drop", {}), self.mlp(p["mlp"], x))
@@ -160,20 +190,55 @@ class BertModel(nn.Module):
 
     def forward(self, p, input_ids, token_type_ids=None,
                 attention_mask=None):
+        from jax import lax
         B, T = input_ids.shape
-        pos = jnp.arange(T)[None, :]
+        sp = self.cfg.sp_axis
+        in_sp = sp is not None and _sp_in_scope(sp)
+        if in_sp:
+            spn = lax.axis_size(sp)
+            if T * spn > self.cfg.max_position_embeddings:
+                raise ValueError(
+                    f"global sequence {T}x{spn} exceeds "
+                    f"max_position_embeddings "
+                    f"{self.cfg.max_position_embeddings}")
+            pos = lax.axis_index(sp) * T + jnp.arange(T)[None, :]
+        else:
+            if T > self.cfg.max_position_embeddings:
+                # jnp.take would silently clamp out-of-range positions
+                raise ValueError(
+                    f"sequence length {T} exceeds "
+                    f"max_position_embeddings "
+                    f"{self.cfg.max_position_embeddings}")
+            pos = jnp.arange(T)[None, :]
         emb = self.word_embeddings(p["word_embeddings"], input_ids)
         emb = emb + self.position_embeddings(p["position_embeddings"], pos)
         if token_type_ids is not None:
             emb = emb + self.token_type_embeddings(
                 p["token_type_embeddings"], token_type_ids)
         x = self.embeddings_ln(p["embeddings_ln"], emb)
-        mask = None
+        mask = kv_mask = None
         if attention_mask is not None:
-            mask = attention_mask[:, None, None, :].astype(bool)
+            if in_sp:
+                # the (B, T_local) validity slice rides the ring
+                # alongside its K/V block
+                kv_mask = attention_mask.astype(bool)
+            else:
+                mask = attention_mask[:, None, None, :].astype(bool)
         for i in range(self.cfg.num_hidden_layers):
-            x = self.layer[i](p["layer"][str(i)], x, mask)
-        pooled = F.tanh(self.pooler(p["pooler"], x[:, 0]))
+            x = self.layer[i](p["layer"][str(i)], x, mask,
+                              kv_mask=kv_mask)
+        if in_sp:
+            # the [CLS] hidden state lives on shard 0: broadcast with a
+            # PLAIN psum (one nonzero term).  Deliberately not the
+            # identity-backward g-collective: the plain transpose makes
+            # the NSP path's encoder grads spn-scaled exactly like the
+            # psum'd MLM loss, so ONE convention — pmean grads over the
+            # sp axis — is correct for the whole pretraining loss.
+            cls = jnp.where(lax.axis_index(sp) == 0, x[:, 0], 0.0)
+            cls = lax.psum(cls, sp)
+        else:
+            cls = x[:, 0]
+        pooled = F.tanh(self.pooler(p["pooler"], cls))
         return x, pooled
 
 
@@ -223,7 +288,18 @@ class BertForPretraining(nn.Module):
             labels = jnp.where(valid, mlm_labels, 0)
             nll = -jnp.take_along_axis(logp, labels[..., None],
                                        axis=-1)[..., 0]
-            mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid),
-                                                          1)
+            sp = self.cfg.sp_axis
+            if sp is not None and _sp_in_scope(sp):
+                # MLM is per-position: psum the masked sums so every
+                # shard returns the global mean.  Grads then follow the
+                # same convention as data parallelism — average them
+                # over the sp axis (pmean / DDP) before the optimizer.
+                from jax import lax
+                num = lax.psum(jnp.sum(nll * valid), sp)
+                den = lax.psum(jnp.sum(valid.astype(jnp.float32)), sp)
+                mlm_loss = num / jnp.maximum(den, 1.0)
+            else:
+                mlm_loss = jnp.sum(nll * valid) / jnp.maximum(
+                    jnp.sum(valid), 1)
         nsp_loss = F.cross_entropy(nsp_logits, nsp_labels)
         return mlm_loss + nsp_loss
