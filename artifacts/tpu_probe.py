@@ -1,0 +1,60 @@
+"""TPU tunnel liveness probes for the hardware runbook.
+
+Round-4 lesson: the round-3 watcher's 128x128-matmul probe is NECESSARY
+but not SUFFICIENT — at round-4 start the tunnel completed that matmul
+(03:17 UTC) and then wedged on the first real compile (ResNet-50 O0),
+burning bench.py's per-config watchdog with zero lines recorded.  So the
+watcher now arms the runbook only after BOTH:
+
+  quick   — backend is a real accelerator and a tiny jit executes;
+  compile — a fresh, non-trivially-sized XLA program (conv net fwd+bwd
+            with BN and a reduction) compiles AND executes end-to-end.
+
+`compile` salts the program with the current minute so a cached
+executable from an earlier probe can't mask a tunnel that lost the
+ability to compile (the wedge mode actually observed).
+"""
+import sys
+import time
+
+
+def quick():
+    import jax
+    import jax.numpy as jnp
+    assert jax.default_backend() != "cpu", "cpu fallback"
+    r = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
+    print(float(r.sum()))
+
+
+def compile_probe():
+    import jax
+    import jax.numpy as jnp
+    assert jax.default_backend() != "cpu", "cpu fallback"
+    # salt changes the traced constant -> new HLO -> forces a real
+    # compile RPC through the tunnel every probe
+    salt = float(int(time.time()) // 60 % 997)
+
+    def loss_fn(w1, w2, x):
+        h = jax.lax.conv_general_dilated(x, w1, (1, 1), "SAME")
+        h = jax.nn.relu(h * (1.0 + salt * 1e-6))
+        m = h.mean(axis=(0, 2, 3), keepdims=True)
+        v = jnp.maximum(((h - m) ** 2).mean(axis=(0, 2, 3), keepdims=True), 0.0)
+        h = (h - m) * jax.lax.rsqrt(v + 1e-5)
+        h = jax.lax.conv_general_dilated(h, w2, (2, 2), "SAME")
+        return (h ** 2).mean()
+
+    import numpy as np
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16, 32, 32), jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(16, 16, 3, 3) * 0.1, jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(16, 16, 3, 3) * 0.1, jnp.bfloat16)
+    g = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))
+    t0 = time.time()
+    gw1, gw2 = g(w1, w2, x)
+    jax.block_until_ready((gw1, gw2))
+    print(f"compile+run {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
+    {"quick": quick, "compile": compile_probe}[mode]()

@@ -7,41 +7,77 @@
 # the expensive 48-config L1 matrix runs LAST.  A wedge at any point
 # leaves every earlier stage's artifact committed.
 #
-# Each stage is independently timeout-guarded so one wedge doesn't lose
-# the rest; per-stage exit status is reported (124 = the timeout killed a
-# wedged stage).
+# Round-4 additions after the 03:17 UTC revive-then-wedge burned a bench
+# run with zero lines:
+#   * `alive` liveness guard BETWEEN stages — if the tunnel wedges
+#     mid-session, the runbook aborts instead of burning every later
+#     stage's full timeout; the watcher re-arms and the next session
+#     resumes where this one left off...
+#   * ...because each completed stage drops a `stage_<name>.done` marker
+#     and is skipped on re-entry.  `rm artifacts/stage_*.done` to force a
+#     full re-run.
+#   * a fully-completed runbook drops `session_complete`, which tells
+#     the watcher to stand down.
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 TS=$(date -u +%Y%m%dT%H%M%S)
 log() { echo "=== $1 ($(date -u +%H:%M:%S)) ==="; }
 stat() { echo "=== stage exit: $1 ==="; }
+alive() {
+    timeout 180 python artifacts/tpu_probe.py quick >/dev/null 2>&1 || {
+        echo "=== tunnel wedged before stage '$1' ($(date -u +%H:%M:%S)); aborting runbook ==="
+        exit 9
+    }
+}
+done_mark() { touch "artifacts/stage_$1.done"; }
+skip() { [ -f "artifacts/stage_$1.done" ] && { echo "=== stage '$1' already done; skipping ==="; return 0; }; return 1; }
 
+if ! skip bench; then
 log "full bench (wedge insurance: capture the round's perf record first)"
 # stdout (JSON lines) -> artifact; stderr (fallback warnings, config
 # tracebacks) -> .err log so a mid-run wedge or crash leaves evidence
-timeout 3600 python bench.py 2> "artifacts/bench_$TS.err" \
+timeout 4500 python bench.py 2> "artifacts/bench_$TS.err" \
     | tee "artifacts/bench_$TS.json"
-stat $?
+RC=$?
+stat $RC
 [ -s "artifacts/bench_$TS.err" ] && { echo "--- bench stderr ---"; \
     cat "artifacts/bench_$TS.err"; }
+# done only if at least one clean (non-error) line was recorded
+if grep -q '"value": [0-9]' "artifacts/bench_$TS.json" 2>/dev/null; then
+    done_mark bench
+fi
+fi
 
+alive kernels
+if ! skip kernels; then
 log "TPU-compiled kernel suite"
 timeout 3600 env APEX_TPU_TEST_BACKEND=tpu python -m pytest \
     tests/test_pallas_kernels.py tests/test_flash_long.py -v 2>&1 \
     | tail -45 | tee "artifacts/tpu_kernel_tests_$TS.log"
-stat $?
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark kernels
+fi
 
+alive step_probe
+if ! skip step_probe; then
 log "step decomposition probe (bwd breakdown: dgrad/wgrad/BN/optimizer)"
 timeout 1800 python artifacts/step_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/step_probe_$TS.log"
-stat $?
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark step_probe
+fi
 
+alive convergence
+if ! skip convergence; then
 log "convergence gate on real data (digits, O0 vs O2)"
 timeout 120 python examples/imagenet/make_digits_npz.py /tmp/digits32.npz
 stat $?
 # -b 64: single-chip global batch 64 keeps 22 iters/epoch from the
 # 1437-image train set and fits the 360-image val split (the example
 # refuses a val split smaller than one global batch at startup)
+CONV_OK=1
 for OL in O0 O2; do
     timeout 1200 python examples/imagenet/main_amp.py \
         --data /tmp/digits32.npz --arch resnet18 --image-size 32 \
@@ -49,22 +85,42 @@ for OL in O0 O2; do
         --warmup-epochs 1 --opt-level $OL --target-acc 90 \
         --print-freq 50 2>&1 | grep -E "Prec@1|FINAL|gate|compiled" \
         | tee "artifacts/convergence_${OL}_$TS.log"
-    stat $?
+    RC=$?
+    stat $RC
+    [ $RC -ne 0 ] && CONV_OK=0
 done
+[ $CONV_OK -eq 1 ] && done_mark convergence
+fi
 
+alive layout_probe
+if ! skip layout_probe; then
 log "layout probe (CSE-fixed)"
 timeout 900 python artifacts/layout_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/layout_probe_$TS.log"
-stat $?
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark layout_probe
+fi
 
+alive ln_probe
+if ! skip ln_probe; then
 log "layer-norm dispatch probe"
 timeout 900 python artifacts/ln_probe.py 2>&1 | grep -v WARNING \
     | tee "artifacts/ln_probe_$TS.log"
-stat $?
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark ln_probe
+fi
 
+alive l1
+if ! skip l1; then
 log "L1 cross-product on hardware (full 48-config matrix — runs last)"
 timeout 5400 python tests/L1/run_l1.py --out "artifacts/l1_tpu_$TS.json" \
     2>&1 | tail -8 | tee "artifacts/l1_tpu_$TS.log"
-stat $?
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark l1
+fi
 
 log "runbook done"
+touch artifacts/session_complete

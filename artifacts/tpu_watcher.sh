@@ -1,27 +1,32 @@
 #!/bin/bash
-# Background watcher: probe the TPU tunnel every 2 minutes; the moment a
-# device op completes, launch the full validation runbook
-# (artifacts/tpu_session.sh) and exit.  Round-3 lesson: the wedge can
-# last hours, so this runs detached from the interactive session and
-# leaves artifacts/ + a done-marker for the main loop to pick up.
+# Background watcher: probe the TPU tunnel every 2 minutes; when BOTH
+# liveness probes pass (tiny op AND a fresh real compile — see
+# tpu_probe.py for why the matmul alone is not enough), launch the full
+# validation runbook (artifacts/tpu_session.sh).
+#
+# Round-4 change vs round-3: the watcher RE-ARMS after a session that
+# did not complete its final stage (the tunnel can revive briefly and
+# wedge again mid-session; the per-stage guards in tpu_session.sh abort
+# early in that case).  It exits only after a fully-completed session.
 cd "$(dirname "$0")/.." || exit 1
 MARKER=artifacts/tpu_watcher_state
-echo "watching $(date -u +%H:%M:%S)" > "$MARKER"
+echo "watching $(date -u +%H:%M:%S)" >> "$MARKER"
 while true; do
-    if timeout 120 python - <<'EOF' >/dev/null 2>&1
-import jax, jax.numpy as jnp
-# a fast-failing plugin silently downgrades to CPU; that must NOT count
-# as the TPU reviving (the session would burn itself on CPU and exit)
-assert jax.default_backend() != "cpu", "cpu fallback"
-r = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
-print(float(r.sum()))
-EOF
+    if timeout 120 python artifacts/tpu_probe.py quick >/dev/null 2>&1 \
+       && timeout 420 python artifacts/tpu_probe.py compile >/dev/null 2>&1
     then
+        TS=$(date -u +%H%M%S)
         echo "tpu responsive $(date -u +%H:%M:%S); running session" >> "$MARKER"
-        bash artifacts/tpu_session.sh > artifacts/tpu_session_run.log 2>&1
+        rm -f artifacts/session_complete
+        bash artifacts/tpu_session.sh > "artifacts/tpu_session_$TS.log" 2>&1
         echo "session done $(date -u +%H:%M:%S) exit $?" >> "$MARKER"
-        exit 0
+        if [ -f artifacts/session_complete ]; then
+            echo "runbook fully complete $(date -u +%H:%M:%S)" >> "$MARKER"
+            exit 0
+        fi
+        echo "session aborted mid-run (wedge?); re-arming" >> "$MARKER"
+    else
+        echo "still wedged $(date -u +%H:%M:%S)" >> "$MARKER"
     fi
-    echo "still wedged $(date -u +%H:%M:%S)" >> "$MARKER"
     sleep 120
 done
