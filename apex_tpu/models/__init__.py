@@ -1,7 +1,8 @@
 """apex_tpu.models — model zoo for examples and benchmarks."""
 
 from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
-                     resnet50, resnet101, resnet152)
+                     resnet50, resnet101, resnet152, stem_weight_to_s2d,
+                     convert_stem_to_s2d)
 from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
                    bert_large)
 from .dcgan import Generator, Discriminator, dcgan

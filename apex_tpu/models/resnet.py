@@ -18,7 +18,8 @@ from .. import nn
 from ..nn import functional as F
 
 __all__ = ["ResNet", "BasicBlock", "Bottleneck", "resnet18", "resnet34",
-           "resnet50", "resnet101", "resnet152"]
+           "resnet50", "resnet101", "resnet152", "stem_weight_to_s2d",
+           "convert_stem_to_s2d"]
 
 
 def conv3x3(cin, cout, stride=1, data_format="NCHW"):
@@ -96,11 +97,23 @@ class ResNet(nn.Module):
     that callers feed NHWC batches — e.g. a
     ``DataLoader(data_format="NHWC")`` — so even the entry transpose
     disappears and the pipeline is transpose-free end to end.
+
+    ``stem="space_to_depth"`` replaces the 7x7/s2 cin=3 stem conv with
+    the MLPerf-TPU-style exact rewrite: a 2x2 space-to-depth on the
+    input (3 -> 12 channels, 224 -> 112 spatial) followed by a 4x4
+    stride-1 conv.  Identical function (see ``stem_weight_to_s2d`` for
+    the exact kernel embedding; parity pinned in
+    tests/test_models.py), but the conv reads a dense stride-1 window
+    instead of a strided gather over a 3-channel input — the MXU-
+    friendliest form of the one conv in the network whose contraction
+    dim (cin*kh*kw) XLA cannot tile cleanly.  Adoption for the bench
+    headline is measurement-gated like the NHWC/scan decisions
+    (docs/benchmarks.md).
     """
 
     def __init__(self, block: Type, layers: List[int],
                  num_classes: int = 1000, channels_last: bool = False,
-                 input_format: str = "NCHW"):
+                 input_format: str = "NCHW", stem: str = "conv7"):
         super().__init__()
         if input_format not in ("NCHW", "NHWC"):
             raise ValueError(f"input_format must be NCHW or NHWC, "
@@ -108,12 +121,23 @@ class ResNet(nn.Module):
         if input_format == "NHWC" and not channels_last:
             raise ValueError("input_format='NHWC' requires "
                              "channels_last=True")
+        if stem not in ("conv7", "space_to_depth"):
+            raise ValueError(f"stem must be 'conv7' or 'space_to_depth', "
+                             f"got {stem!r}")
         self.inplanes = 64
         self.channels_last = channels_last
         self.input_format = input_format
+        self.stem = stem
         df = self.data_format = "NHWC" if channels_last else "NCHW"
-        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False,
-                               data_format=df)
+        if stem == "space_to_depth":
+            # out(i) needs s2d rows i-2..i+1  (u = 2*pk + a - 1, see
+            # stem_weight_to_s2d) -> asymmetric pad (lo 2, hi 1)
+            self.conv1 = nn.Conv2d(12, 64, 4, stride=1,
+                                   padding=((2, 1), (2, 1)), bias=False,
+                                   data_format=df)
+        else:
+            self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3,
+                                   bias=False, data_format=df)
         self.bn1 = _bn(64, df)
         self.maxpool = nn.MaxPool2d(3, stride=2, padding=1, data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
@@ -141,6 +165,8 @@ class ResNet(nn.Module):
     def forward(self, p, x):
         if self.channels_last and self.input_format == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))
+        if self.stem == "space_to_depth":
+            x = F.space_to_depth(x, 2, self.data_format)
         x = F.relu(self.bn1(p["bn1"], self.conv1(p["conv1"], x)))
         x = self.maxpool({}, x)
         x = self.layer1(p["layer1"], x)
@@ -152,26 +178,76 @@ class ResNet(nn.Module):
         return self.fc(p["fc"], x)
 
 
-def resnet18(num_classes=1000, channels_last=False, input_format="NCHW"):
+def stem_weight_to_s2d(w7: jnp.ndarray) -> jnp.ndarray:
+    """Exactly embed a (64, 3, 7, 7) OIHW stem-conv weight into the
+    (64, 12, 4, 4) weight of the space-to-depth stem.
+
+    Derivation: the original output is ``sum_u w7[u] * x[2i + u - 3]``
+    (stride 2, pad 3).  After 2x2 space-to-depth, position ``i + pk - 2``
+    of the padded s2d input holds rows ``2i + 2*pk - 4 + a`` of x, so
+    matching terms gives ``u = 2*pk + a - 1`` (same for v/qk/bb);
+    ``u = -1`` (pk=0, a=0) falls outside the 7-tap kernel and stays
+    zero — 147 of the 192 slots are populated, the rest pad the
+    contraction to a dense multiple of 8.  The s2d channel index is
+    ``a*(2*C) + bb*C + c``, matching ``F.space_to_depth``."""
+    O, C, KH, KW = w7.shape
+    if (KH, KW) != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {(KH, KW)}")
+    w4 = jnp.zeros((O, 4 * C, 4, 4), w7.dtype)
+    for a in range(2):
+        for bb in range(2):
+            for pk in range(4):
+                u = 2 * pk + a - 1
+                if not 0 <= u < 7:
+                    continue
+                for qk in range(4):
+                    v = 2 * qk + bb - 1
+                    if not 0 <= v < 7:
+                        continue
+                    cidx = a * (2 * C) + bb * C
+                    w4 = w4.at[:, cidx:cidx + C, pk, qk].set(
+                        w7[:, :, u, v])
+    return w4
+
+
+def convert_stem_to_s2d(params):
+    """Param-tree converter: a checkpoint trained with the conv7 stem
+    loads into a ``stem="space_to_depth"`` model with identical
+    function.  Only ``conv1/weight`` changes shape; BN and every later
+    layer are untouched (arrays shared, the two mutated dict levels
+    copied)."""
+    params = dict(params)
+    params["conv1"] = dict(params["conv1"])
+    params["conv1"]["weight"] = stem_weight_to_s2d(
+        params["conv1"]["weight"])
+    return params
+
+
+def resnet18(num_classes=1000, channels_last=False, input_format="NCHW",
+             stem="conv7"):
     return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, channels_last,
-                  input_format)
+                  input_format, stem)
 
 
-def resnet34(num_classes=1000, channels_last=False, input_format="NCHW"):
+def resnet34(num_classes=1000, channels_last=False, input_format="NCHW",
+             stem="conv7"):
     return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, channels_last,
-                  input_format)
+                  input_format, stem)
 
 
-def resnet50(num_classes=1000, channels_last=False, input_format="NCHW"):
+def resnet50(num_classes=1000, channels_last=False, input_format="NCHW",
+             stem="conv7"):
     return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, channels_last,
-                  input_format)
+                  input_format, stem)
 
 
-def resnet101(num_classes=1000, channels_last=False, input_format="NCHW"):
+def resnet101(num_classes=1000, channels_last=False, input_format="NCHW",
+              stem="conv7"):
     return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, channels_last,
-                  input_format)
+                  input_format, stem)
 
 
-def resnet152(num_classes=1000, channels_last=False, input_format="NCHW"):
+def resnet152(num_classes=1000, channels_last=False, input_format="NCHW",
+              stem="conv7"):
     return ResNet(Bottleneck, [3, 8, 36, 3], num_classes, channels_last,
-                  input_format)
+                  input_format, stem)

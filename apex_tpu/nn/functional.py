@@ -41,7 +41,8 @@ __all__ = [
     "gelu", "gelu_exact", "silu", "sigmoid", "tanh",
     "softmax", "log_softmax", "layer_norm", "batch_norm_stats",
     "batch_norm_apply", "dropout", "max_pool2d", "avg_pool2d",
-    "adaptive_avg_pool2d", "embedding", "cross_entropy", "nll_loss",
+    "adaptive_avg_pool2d", "embedding", "space_to_depth",
+    "cross_entropy", "nll_loss",
     "mse_loss", "l1_loss", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "cat", "stack", "add", "mul",
 ]
@@ -333,6 +334,40 @@ def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]],
 
 def embedding(ids: jax.Array, table: jax.Array) -> jax.Array:
     return jnp.take(table, ids, axis=0)
+
+
+def space_to_depth(x: jax.Array, block_size: int = 2,
+                   data_format: str = "NCHW") -> jax.Array:
+    """Rearrange ``block_size x block_size`` spatial tiles into channels.
+
+    (B, C, H, W) -> (B, b*b*C, H/b, W/b) with channel index
+    ``a*(b*C) + bb*C + c`` for tile offset (a, bb) — the same logical
+    order in NHWC, so the two layouts are transposes of each other and
+    the stem-weight converter (models.resnet.stem_weight_to_s2d) serves
+    both.  Pure reshape/transpose: XLA fuses it into the consumer; on
+    TPU this is the MLPerf-style stem transform that turns the
+    padding-hostile 7x7/s2 cin=3 stem conv into a dense stride-1 conv
+    (see models.ResNet ``stem="space_to_depth"``)."""
+    _check_data_format(data_format)
+    b = int(block_size)
+    if b < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if data_format == "NCHW":
+        B, C, H, W = x.shape
+        if H % b or W % b:
+            raise ValueError(f"spatial dims {(H, W)} not divisible by "
+                             f"block_size {b}")
+        x = x.reshape(B, C, H // b, b, W // b, b)
+        #                  0  1  2     3  4      5   -> (B, a, bb, C, H/b, W/b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(B, b * b * C, H // b, W // b)
+    B, H, W, C = x.shape
+    if H % b or W % b:
+        raise ValueError(f"spatial dims {(H, W)} not divisible by "
+                         f"block_size {b}")
+    x = x.reshape(B, H // b, b, W // b, b, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, H // b, W // b, b * b * C)
 
 
 # ---------------------------------------------------------------------------

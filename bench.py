@@ -219,8 +219,9 @@ def main():
 
     def resnet_config(metric, opt_level, arch, batch_per_chip, image,
                       iters, warmup, sync_bn=False, vs=None,
-                      steps_per_call=1, channels_last=False):
-        model = getattr(models, arch)(channels_last=channels_last)
+                      steps_per_call=1, channels_last=False, stem="conv7"):
+        model = getattr(models, arch)(channels_last=channels_last,
+                                      stem=stem)
         if sync_bn:
             model = parallel.convert_syncbn_model(model)
         model, optimizer = amp.initialize(
@@ -471,6 +472,12 @@ def main():
                  "resnet50_amp_o2_ddp_scan4_train_throughput",
                  "O2", "resnet50", 128, 224, 5, 1,
                  vs=BASELINE_IMG_PER_SEC_PER_CHIP, steps_per_call=4)),
+            ("resnet50_amp_o2_ddp_s2d_train_throughput",
+             lambda: resnet_config(
+                 "resnet50_amp_o2_ddp_s2d_train_throughput",
+                 "O2", "resnet50", 128, 224, 20, 3,
+                 vs=BASELINE_IMG_PER_SEC_PER_CHIP,
+                 stem="space_to_depth")),
             ("resnet50_amp_o2_ddp_train_throughput",
              lambda: resnet_config("resnet50_amp_o2_ddp_train_throughput",
                                    "O2", "resnet50", 128, 224, 20, 3,

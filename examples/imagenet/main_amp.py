@@ -57,6 +57,11 @@ def parse_args():
     p.add_argument("--keep-batchnorm-fp32", default=None)
     p.add_argument("--half-dtype", default=None,
                    choices=[None, "bfloat16", "float16"])
+    p.add_argument("--stem", default="conv7",
+                   choices=["conv7", "space_to_depth"],
+                   help="stem form: torchvision 7x7/s2 conv (reference "
+                        "parity) or the MLPerf-TPU exact space-to-depth "
+                        "rewrite (see models.resnet.stem_weight_to_s2d)")
     p.add_argument("--channels-last", action="store_true",
                    help="run the whole pipeline NHWC: loader delivery, "
                         "model input, and every internal activation "
@@ -100,7 +105,7 @@ def main():
     # directly (input_format), and every internal activation stays NHWC
     fmt = "NHWC" if args.channels_last else "NCHW"
     model = getattr(models, args.arch)(channels_last=args.channels_last,
-                                       input_format=fmt)
+                                       input_format=fmt, stem=args.stem)
     if args.sync_bn:
         print("using apex_tpu synced BN")
         model = parallel.convert_syncbn_model(model)
@@ -291,8 +296,46 @@ def main():
         from apex_tpu.utils import checkpoint as ckpt
         last = ckpt.latest_step(args.checkpoint_dir)
         if last is not None:
-            state = ckpt.restore_checkpoint(args.checkpoint_dir, state,
-                                            step=last)
+            try:
+                state = ckpt.restore_checkpoint(args.checkpoint_dir, state,
+                                                step=last)
+            except ValueError as e:
+                # only the conv1 stem mismatch is convertible; any other
+                # shape drift (num_classes, arch) is a real user error
+                if args.stem != "space_to_depth" or "conv1" not in str(e):
+                    raise
+                if args.zero:
+                    raise SystemExit(
+                        "resuming a conv7 checkpoint into --stem "
+                        "space_to_depth is not supported with --zero "
+                        "(the sharded optimizer state cannot be "
+                        "re-templated in-process); convert offline with "
+                        "models.convert_stem_to_s2d")
+                # conv7-trained checkpoint: restore into a conv7-shaped
+                # template, exactly convert the stem weight
+                # (models.convert_stem_to_s2d), reinit optimizer state
+                print("=> checkpoint has the conv7 stem; converting "
+                      "(identical function; optimizer moments and loss "
+                      "scale reset)")
+                m7 = getattr(models, args.arch)(
+                    channels_last=args.channels_last, input_format=fmt,
+                    stem="conv7")
+                if args.sync_bn:
+                    m7 = parallel.convert_syncbn_model(m7)
+                m7, _ = amp.initialize(
+                    m7, optimizers.SGD(lr=lr_schedule),
+                    opt_level=args.opt_level,
+                    keep_batchnorm_fp32=args.keep_batchnorm_fp32,
+                    loss_scale=args.loss_scale,
+                    half_dtype=args.half_dtype, verbosity=0)
+                p7, bn7 = m7.init(jax.random.PRNGKey(args.seed))
+                # template (params, bn) only: restore_checkpoint reads
+                # just the template's leaves, so the stored optimizer
+                # state (discarded anyway) is never materialized
+                p7, bn7 = ckpt.restore_checkpoint(
+                    args.checkpoint_dir, (p7, bn7), step=last)
+                p_new = models.convert_stem_to_s2d(p7)
+                state = (p_new, bn7, optimizer.init(p_new))
             start_epoch = last
             print(f"=> resumed from epoch {last} "
                   f"(reference main_amp.py:170-185 resume flow)")

@@ -136,3 +136,21 @@ def test_gpt_example_smoke():
               "--iters", "3", "--generate", "8", "--print-freq", "1"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "done" in r.stdout and "sample:" in r.stdout
+
+
+@pytest.mark.slow
+def test_imagenet_resume_conv7_into_s2d_stem(tmp_path):
+    """Resuming a conv7-trained checkpoint with --stem space_to_depth
+    converts the stem weight in-process (models.convert_stem_to_s2d)
+    instead of aborting on the conv1 shape mismatch."""
+    ckdir = str(tmp_path / "ck")
+    base = ["examples/imagenet/main_amp.py", "--arch", "resnet18",
+            "-b", "2", "--iters", "2", "--image-size", "32",
+            "--print-freq", "1", "--checkpoint-dir", ckdir]
+    r = _run([*base, "--epochs", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _run([*base, "--epochs", "2", "--resume",
+              "--stem", "space_to_depth"])
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-2000:])
+    assert "converting" in r.stdout and "resumed from epoch 1" in r.stdout, \
+        r.stdout[-800:]

@@ -541,3 +541,88 @@ def test_bert_sequence_parallel_matches_unmapped():
             check_vma=False))(params, ids, mlm, amask)
         g_ref = jax.grad(loss)(params, ids, mlm, amask)
         assert_trees_close(g_sp, g_ref, atol=1e-4)
+
+
+def test_space_to_depth_rearrange():
+    """Both layouts produce the same logical channel order
+    (a*(2C) + bb*C + c), so they are transposes of one another."""
+    x = jnp.arange(2 * 3 * 8 * 8, dtype=jnp.float32).reshape(2, 3, 8, 8)
+    y = F.space_to_depth(x, 2, "NCHW")
+    assert y.shape == (2, 12, 4, 4)
+    # channel cidx = a*6 + bb*3 + c holds x[c, 2i+a, 2j+bb]
+    for a in range(2):
+        for bb in range(2):
+            for c in range(3):
+                np.testing.assert_array_equal(
+                    np.asarray(y[:, a * 6 + bb * 3 + c]),
+                    np.asarray(x[:, c, a::2, bb::2]))
+    y2 = F.space_to_depth(jnp.transpose(x, (0, 2, 3, 1)), 2, "NHWC")
+    np.testing.assert_array_equal(np.asarray(y2),
+                                  np.asarray(jnp.transpose(y, (0, 2, 3, 1))))
+
+
+def test_s2d_stem_exact_parity():
+    """The space-to-depth stem is an EXACT rewrite of the 7x7/s2 stem:
+    converted weights reproduce the conv7 output to fp32 round-off
+    (same sums, plus zero-weight taps).  Asserted at the stem-conv level
+    and through the full model (reference recipe:
+    examples/imagenet/main_amp.py trains the torchvision conv7 stem;
+    apex_tpu adds the MLPerf-TPU transform as an opt-in)."""
+    from apex_tpu.models.resnet import stem_weight_to_s2d, convert_stem_to_s2d
+
+    rng = np.random.RandomState(0)
+    w7 = jnp.asarray(rng.randn(64, 3, 7, 7) * 0.05, jnp.float32)
+    x = jnp.asarray(rng.randn(2, 3, 64, 64), jnp.float32)
+    ref = F.conv2d(x, w7, stride=2, padding=3)
+    via = F.conv2d(F.space_to_depth(x, 2, "NCHW"), stem_weight_to_s2d(w7),
+                   stride=1, padding=((2, 1), (2, 1)))
+    assert ref.shape == via.shape
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(via),
+                               rtol=1e-5, atol=1e-5)
+
+    # full model: conv7 checkpoint -> s2d model, identical logits
+    m7 = resnet18(num_classes=10)
+    ms = resnet18(num_classes=10, stem="space_to_depth")
+    params, state = m7.init(jax.random.PRNGKey(0))
+    params_s = convert_stem_to_s2d(params)
+    assert params_s["conv1"]["weight"].shape == (64, 12, 4, 4)
+    out7, _ = nn.apply(m7, params, x, state=state, train=False)
+    outs, _ = nn.apply(ms, params_s, x, state=state, train=False)
+    np.testing.assert_allclose(np.asarray(out7), np.asarray(outs),
+                               rtol=1e-4, atol=1e-4)
+
+    # NHWC path shares the converter (same logical channel order)
+    ms_cl = resnet18(num_classes=10, stem="space_to_depth",
+                     channels_last=True)
+    outc, _ = nn.apply(ms_cl, params_s, x, state=state, train=False)
+    np.testing.assert_allclose(np.asarray(outc), np.asarray(outs),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_stem_trains_o2():
+    """The s2d stem rides the normal amp O2 + optimizer path (its conv1
+    weight is cast/mastered like any other conv weight)."""
+    model, opt = amp.initialize(
+        resnet18(num_classes=10, stem="space_to_depth"),
+        optimizers.SGD(0.05, momentum=0.9), opt_level="O2", verbosity=0)
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 3, 32, 32), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 8))
+
+    @jax.jit
+    def step(params, state, opt_state):
+        def loss_fn(p):
+            out, new_st = model.apply(p, x, state=state, train=True)
+            return F.cross_entropy(out, y), new_st
+        loss, new_st, grads = amp.scaled_grad(loss_fn, params, opt_state,
+                                              has_aux=True)
+        params, opt_state, _ = opt.step(params, opt_state, grads)
+        return params, new_st, opt_state, loss
+
+    losses = []
+    for _ in range(6):
+        params, state, opt_state, loss = step(params, state, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
