@@ -1,0 +1,128 @@
+"""Trace-level audit of the hot training step (perf regression guards).
+
+The round-3 perf campaign showed the headline cost lives in the conv
+backward + optimizer (PERF_NOTES_r3.md); these tests pin the properties
+that keep that cost minimal and that a silent regression would destroy:
+
+  * under amp O2 every convolution in the jitted train step — forward,
+    dgrad, and wgrad — consumes bf16 operands (a policy or cast bug
+    that upcasts one conv family to fp32 would double its time on the
+    MXU and halve effective HBM bandwidth);
+  * the channels-last (NHWC input_format) step stays transpose-free on
+    activation-sized tensors (the whole point of the layout mode —
+    reference-side analogue: --channels-last in
+    examples/imagenet/main_amp.py).
+
+Jaxpr properties are backend-independent, so the guard runs on the CPU
+mesh while asserting what the TPU executable will see.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, optimizers, parallel, models
+from apex_tpu.nn import functional as F
+
+
+def _traced_step(channels_last=False, input_format="NCHW", stem="conv7",
+                 B=8, image=32):
+    """Trace the REAL DDP train step — shard_map over the 8-device CPU
+    mesh with the grad allreduce inside — so the audit covers the same
+    graph bench.py's headline and the imagenet example execute."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    model, opt = amp.initialize(
+        models.resnet18(num_classes=10, channels_last=channels_last,
+                        input_format=input_format, stem=stem),
+        optimizers.FusedAdam(1e-3), opt_level="O2", verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, bn = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    rng = np.random.RandomState(0)
+    shape = (B, 3, image, image) if input_format == "NCHW" \
+        else (B, image, image, 3)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+
+    def step(state, batch):
+        params, bn, ost = state
+        xb, yb = batch
+
+        def loss_fn(p):
+            out, nb = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), nb
+
+        loss, nb, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        g = ddp.allreduce_grads(g)
+        params, ost2, _ = opt.step(params, ost, g)
+        return (params, nb, ost2), jax.lax.pmean(loss, "data")
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), (P("data"), P("data"))),
+                           out_specs=(P(), P()), check_vma=False)
+    return jax.make_jaxpr(mapped)((params, bn, ost), (x, y))
+
+
+def _walk(jaxpr):
+    """Yield every eqn in a (closed) jaxpr, recursing into sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in jax.tree_util.tree_leaves(
+                    v, is_leaf=lambda x: isinstance(
+                        x, (jax.extend.core.Jaxpr, jax.extend.core.ClosedJaxpr))):
+                if isinstance(sub, jax.extend.core.ClosedJaxpr):
+                    yield from _walk(sub.jaxpr)
+                elif isinstance(sub, jax.extend.core.Jaxpr):
+                    yield from _walk(sub)
+
+
+def test_o2_step_convs_all_bf16():
+    jpr = _traced_step()
+    convs = [e for e in _walk(jpr.jaxpr)
+             if e.primitive.name == "conv_general_dilated"]
+    # resnet18 fwd has 20 convs (incl. 3 downsample); backward adds
+    # dgrad+wgrad per conv minus the input dgrad -> sanity-floor only
+    assert len(convs) >= 40, f"expected fwd+bwd convs, got {len(convs)}"
+    bad = [(e.invars[0].aval.dtype, e.invars[1].aval.dtype)
+           for e in convs
+           if not (e.invars[0].aval.dtype == jnp.bfloat16
+                   and e.invars[1].aval.dtype == jnp.bfloat16)]
+    assert not bad, f"non-bf16 convs in O2 step: {bad[:5]} (+{len(bad)} total)"
+
+
+def test_o2_nhwc_step_transpose_free():
+    jpr = _traced_step(channels_last=True, input_format="NHWC")
+    big_transposes = [e for e in _walk(jpr.jaxpr)
+                      if e.primitive.name == "transpose"
+                      and np.prod(e.invars[0].aval.shape) >= 4 * 3 * 32 * 32]
+    assert not big_transposes, (
+        "activation-sized transposes in the NHWC step: "
+        f"{[(e.invars[0].aval.shape, e.params) for e in big_transposes[:4]]}")
+
+
+def test_o2_s2d_nhwc_step_convs_bf16_and_transpose_free():
+    jpr = _traced_step(channels_last=True, input_format="NHWC",
+                       stem="space_to_depth")
+    convs = [e for e in _walk(jpr.jaxpr)
+             if e.primitive.name == "conv_general_dilated"]
+    bad = [e for e in convs if e.invars[0].aval.dtype != jnp.bfloat16
+           or e.invars[1].aval.dtype != jnp.bfloat16]
+    assert not bad
+    # the 6-D block rearrange inside F.space_to_depth is the ONE
+    # legitimate activation transpose (forward-only: the input is a
+    # constant, so no gradient flows back through it); anything else
+    # would be a layout leak
+    big_transposes = [e for e in _walk(jpr.jaxpr)
+                      if e.primitive.name == "transpose"
+                      and np.prod(e.invars[0].aval.shape) >= 4 * 3 * 32 * 32
+                      and e.invars[0].aval.ndim != 6]
+    assert not big_transposes
+    s2d_rearranges = [e for e in _walk(jpr.jaxpr)
+                      if e.primitive.name == "transpose"
+                      and e.invars[0].aval.ndim == 6]
+    assert len(s2d_rearranges) <= 1, (
+        f"s2d rearrange should appear once (forward), got "
+        f"{len(s2d_rearranges)}")
