@@ -460,6 +460,17 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 128)),
+            # long-context single-chip: the blocked flash path at 8x the
+            # training context (T=32768 compiles on-chip per
+            # artifacts/tpu_kernel_tests_r3.log; this records sustained
+            # training throughput at a long-but-benchable length)
+            ("gpt2_small_o2_flash_t4096_train_throughput",
+             lambda: gpt_config(
+                 "gpt2_small_o2_flash_t4096_train_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=4096,
+                                  dropout=0.0),
+                 1, 4096, 6, 2)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet50_amp_o2_ddp_nhwc_train_throughput",
