@@ -29,7 +29,18 @@ alive() {
         exit 9
     }
 }
-done_mark() { touch "artifacts/stage_$1.done"; }
+done_mark() {
+    touch "artifacts/stage_$1.done"
+    # commit each stage's artifacts immediately: a crash, re-wedge, or
+    # round-end cutoff must not lose captured hardware evidence.  `|| true`:
+    # racing the interactive session for the index lock just skips; the
+    # next done_mark (or the driver's round-end commit) picks it up.
+    # pathspec-limited commit: whatever the interactive session has
+    # staged for its own next commit stays staged and untouched
+    git add artifacts/ 2>/dev/null && \
+        git commit -q -m "TPU session artifacts: stage $1" \
+            -- artifacts/ 2>/dev/null || true
+}
 skip() { [ -f "artifacts/stage_$1.done" ] && { echo "=== stage '$1' already done; skipping ==="; return 0; }; return 1; }
 
 if ! skip bench; then

@@ -423,9 +423,19 @@ def main():
              note=f"{nleaves}-leaf tree, {total} params, per-tensor "
                   "trust ratios via segment map")
 
-    # -- run the suite: headline last ---------------------------------------
+    # -- run the suite ------------------------------------------------------
+    # On TPU the HEADLINE config runs FIRST: the tunnel has twice revived
+    # briefly and re-wedged (r3; r4 03:17 UTC), and a wedge mid-suite
+    # must not cost the round its money metric.  Each clean line is
+    # saved incrementally, so later-config wedges lose nothing earlier.
+    # (Stale-record replay still prints the headline last — that
+    # ordering contract is about the fallback record, not live runs.)
     if on_tpu:
         jobs = [
+            ("resnet50_amp_o2_ddp_train_throughput",
+             lambda: resnet_config("resnet50_amp_o2_ddp_train_throughput",
+                                   "O2", "resnet50", 128, 224, 20, 3,
+                                   vs=BASELINE_IMG_PER_SEC_PER_CHIP)),
             ("resnet50_o0_fp32_train_throughput",
              lambda: resnet_config("resnet50_o0_fp32_train_throughput",
                                    "O0", "resnet50", 64, 224, 10, 2)),
@@ -489,10 +499,6 @@ def main():
                  "O2", "resnet50", 128, 224, 20, 3,
                  vs=BASELINE_IMG_PER_SEC_PER_CHIP,
                  stem="space_to_depth")),
-            ("resnet50_amp_o2_ddp_train_throughput",
-             lambda: resnet_config("resnet50_amp_o2_ddp_train_throughput",
-                                   "O2", "resnet50", 128, 224, 20, 3,
-                                   vs=BASELINE_IMG_PER_SEC_PER_CHIP)),
         ]
     else:  # smoke sizes so the harness runs anywhere
         jobs = [
@@ -598,6 +604,14 @@ def main():
 
     if on_tpu:
         save_tpu_record(tpu_record_lines)
+        # the headline now EXECUTES first (wedge insurance) but must
+        # still PRINT last — the driver reads the final line as the
+        # round's metric.  Re-emit its clean measurement; the per-metric
+        # merge in save_tpu_record already dedupes the record.
+        for ln in tpu_record_lines:
+            if ln.get("metric") == HEADLINE_METRIC:
+                print(json.dumps(ln), flush=True)
+                break
     elif want_accel:
         # covers BOTH fallback shapes: the hang (wedged=True) and a
         # fast-failing plugin that jax silently downgraded to CPU
