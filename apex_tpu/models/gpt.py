@@ -34,7 +34,16 @@ __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium"]
 class GPTConfig:
     def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
                  n_head=12, n_embd=768, dropout=0.1,
-                 layer_norm_eps=1e-5, tp_axis=None, sp_axis=None):
+                 layer_norm_eps=1e-5, tp_axis=None, sp_axis=None,
+                 head_chunk=8192):
+        # head_chunk: vocab chunk size for the fused LM-head loss
+        # (nn.fused_xent — logits never materialized); None/0 restores
+        # the dense logits + fp32 log_softmax path.  Ignored under
+        # tp_axis (loss() routes to the vocab-parallel cross-entropy,
+        # which already avoids the full-vocab gather; tp+sp combined is
+        # rejected below, so the sp fused path never sees a sharded
+        # table).
+        self.head_chunk = head_chunk
         self.vocab_size = vocab_size
         self.block_size = block_size
         self.n_layer = n_layer
@@ -206,6 +215,42 @@ class GPT(nn.Module):
         head and return (B, 1, V); decode loops read one row per step,
         and the full-vocab head matmul over all S positions is the
         dominant per-token cost they'd otherwise pay."""
+        x = self._backbone(p, input_ids, attention_mask)
+        if last_pos is not None:
+            x = jnp.take_along_axis(x, last_pos[:, None, None], axis=1)
+        # weight-tied LM head (GPT-2); under TP the table is
+        # vocab-sharded -> sharded logits (f-collective on x so its
+        # grad sums the blocks)
+        table = p["wte"]["weight"]
+        if self.cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import copy_to_model_parallel
+            x = copy_to_model_parallel(x, self.cfg.tp_axis)
+        return F.matmul(x, table.T.astype(x.dtype))
+
+    def _head_nll(self, p, x, safe_labels):
+        """Per-position nll (B, T') through the weight-tied head.
+
+        ``head_chunk`` set (default): nn.fused_xent streams the vocab —
+        the (N, V) logits and fp32 logp are never materialized (at
+        GPT-2 T=4096 that is ~1.2 GB of HBM traffic per step saved).
+        ``head_chunk=None``: the dense logits + fp32 log_softmax
+        reference path (kept as the parity oracle, tested equal)."""
+        table = p["wte"]["weight"]
+        B, T, D = x.shape
+        if self.cfg.head_chunk:
+            from ..nn.fused_xent import linear_cross_entropy
+            nll = linear_cross_entropy(x.reshape(B * T, D), table,
+                                       safe_labels.reshape(-1),
+                                       int(self.cfg.head_chunk))
+            return nll.reshape(B, T)
+        logits = F.matmul(x, table.T.astype(x.dtype))
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, safe_labels[..., None],
+                                    axis=-1)[..., 0]
+
+    def _backbone(self, p, input_ids, attention_mask=None):
+        """Pre-head hidden states (B, T, D) — shared by the logits path
+        and the fused-head loss (which never materializes logits)."""
         B, T = input_ids.shape
         sp = self.cfg.sp_axis
         in_sp = sp is not None and _sp_in_scope(sp)
@@ -234,17 +279,7 @@ class GPT(nn.Module):
             mask = attention_mask[:, None, None, :].astype(bool)
         for i in range(self.cfg.n_layer):
             x = self.h[i](p["h"][str(i)], x, mask)
-        x = self.ln_f(p["ln_f"], x)
-        if last_pos is not None:
-            x = jnp.take_along_axis(x, last_pos[:, None, None], axis=1)
-        # weight-tied LM head (GPT-2); under TP the table is
-        # vocab-sharded -> sharded logits (f-collective on x so its
-        # grad sums the blocks)
-        table = p["wte"]["weight"]
-        if self.cfg.tp_axis is not None:
-            from ..parallel.tensor_parallel import copy_to_model_parallel
-            x = copy_to_model_parallel(x, self.cfg.tp_axis)
-        return F.matmul(x, table.T.astype(x.dtype))
+        return self.ln_f(p["ln_f"], x)
 
     def loss(self, p, input_ids, attention_mask: Optional[jax.Array]
              = None, ignore_index: int = -100):
@@ -267,7 +302,7 @@ class GPT(nn.Module):
             B, T = input_ids.shape
             spn = lax.axis_size(sp)
             idx = lax.axis_index(sp)
-            logits = self(p, input_ids)                 # (B, T, V)
+            x = self._backbone(p, input_ids)            # (B, T, D)
             nxt_first = lax.ppermute(
                 input_ids[:, :1], sp,
                 [(i, (i - 1) % spn) for i in range(spn)])
@@ -277,29 +312,27 @@ class GPT(nn.Module):
             is_last = (idx == spn - 1)
             labels = labels.at[:, -1].set(
                 jnp.where(is_last, ignore_index, labels[:, -1]))
-            logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
             valid = labels != ignore_index
             safe = jnp.where(valid, labels, 0)
-            nll = -jnp.take_along_axis(logp, safe[..., None],
-                                       axis=-1)[..., 0]
+            nll = self._head_nll(p, x, safe)
             num = lax.psum(jnp.sum(nll * valid), sp)
             den = lax.psum(jnp.sum(valid.astype(jnp.float32)), sp)
             return num / jnp.maximum(den, 1.0)
-        logits = self(p, input_ids, attention_mask)[:, :-1]
         labels = input_ids[:, 1:]
         if attention_mask is not None:
             labels = jnp.where(attention_mask[:, 1:] != 0, labels,
                                ignore_index)
         if self.cfg.tp_axis is not None:
+            logits = self(p, input_ids, attention_mask)[:, :-1]
             from ..parallel.tensor_parallel import \
                 vocab_parallel_cross_entropy
             return vocab_parallel_cross_entropy(
                 logits, labels, axis_name=self.cfg.tp_axis,
                 ignore_index=ignore_index)
-        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        x = self._backbone(p, input_ids, attention_mask)[:, :-1]
         valid = labels != ignore_index
         safe = jnp.where(valid, labels, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = self._head_nll(p, x, safe)
         return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
 
     def generate(self, p, input_ids, prompt_len, max_new_tokens: int,

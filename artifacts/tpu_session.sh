@@ -36,10 +36,13 @@ done_mark() {
     # racing the interactive session for the index lock just skips; the
     # next done_mark (or the driver's round-end commit) picks it up.
     # pathspec-limited commit: whatever the interactive session has
-    # staged for its own next commit stays staged and untouched
+    # staged for its own next commit stays staged and untouched.  If the
+    # commit loses the index-lock race after the add, unstage artifacts/
+    # so they can't leak into the interactive session's next commit.
     git add artifacts/ 2>/dev/null && \
         git commit -q -m "TPU session artifacts: stage $1" \
-            -- artifacts/ 2>/dev/null || true
+            -- artifacts/ 2>/dev/null || \
+        { git reset -q -- artifacts/ 2>/dev/null; true; }
 }
 skip() { [ -f "artifacts/stage_$1.done" ] && { echo "=== stage '$1' already done; skipping ==="; return 0; }; return 1; }
 

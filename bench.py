@@ -607,11 +607,18 @@ def main():
         # the headline now EXECUTES first (wedge insurance) but must
         # still PRINT last — the driver reads the final line as the
         # round's metric.  Re-emit its clean measurement; the per-metric
-        # merge in save_tpu_record already dedupes the record.
-        for ln in tpu_record_lines:
-            if ln.get("metric") == HEADLINE_METRIC:
-                print(json.dumps(ln), flush=True)
-                break
+        # merge in save_tpu_record already dedupes the record.  If the
+        # headline itself hung/errored this run, fall back to the last
+        # known record's headline (stale-annotated) so the final line is
+        # never a different config's number mistaken for the headline.
+        head = next((ln for ln in tpu_record_lines
+                     if ln.get("metric") == HEADLINE_METRIC), None)
+        if head is None:
+            rec = load_tpu_record()
+            head = next((ln for ln in (stale_lines(rec) if rec else [])
+                         if ln.get("metric") == HEADLINE_METRIC), None)
+        if head is not None:
+            print(json.dumps(head), flush=True)
     elif want_accel:
         # covers BOTH fallback shapes: the hang (wedged=True) and a
         # fast-failing plugin that jax silently downgraded to CPU

@@ -1,0 +1,97 @@
+"""Chunked fused linear+cross-entropy head (nn.fused_xent).
+
+Parity oracle: dense ``logits -> fp32 log_softmax -> gather`` — the
+reference-shaped path this op replaces (apex-era models materialize
+logits and call the fp32 loss; see SURVEY §2.1 amp lists: losses are
+blacklist/fp32).  The fused path must match it to fp32 round-off,
+including grads, the non-divisible tail chunk, and through GPT.loss in
+both the default and ``head_chunk=None`` modes.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.nn.fused_xent import linear_cross_entropy
+
+
+def _dense_nll(h, W, y):
+    logp = jax.nn.log_softmax((h @ W.T).astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+
+
+@pytest.mark.parametrize("V,chunk", [(1003, 128), (512, 128), (96, 200)])
+def test_fwd_parity_incl_tail(V, chunk):
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(37, 64), jnp.float32)
+    W = jnp.asarray(rng.randn(V, 64) * 0.05, jnp.float32)
+    y = jnp.asarray(rng.randint(0, V, 37), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(linear_cross_entropy(h, W, y, chunk)),
+        np.asarray(_dense_nll(h, W, y)), rtol=1e-5, atol=1e-5)
+
+
+def test_grad_parity_fp32():
+    rng = np.random.RandomState(1)
+    h = jnp.asarray(rng.randn(29, 48), jnp.float32)
+    W = jnp.asarray(rng.randn(777, 48) * 0.05, jnp.float32)
+    y = jnp.asarray(rng.randint(0, 777, 29), jnp.int32)
+    # weighted mean (exercises non-uniform per-row cotangents, the
+    # ignore_index masking shape)
+    w = jnp.asarray(rng.rand(29), jnp.float32)
+
+    def mk(fn):
+        return jax.grad(lambda h, W: jnp.sum(fn(h, W) * w) / w.sum(),
+                        argnums=(0, 1))
+
+    gd = mk(lambda h, W: _dense_nll(h, W, y))(h, W)
+    gf = mk(lambda h, W: linear_cross_entropy(h, W, y, 100))(h, W)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_inputs_fp32_accumulation():
+    """bf16 activations/table (the amp O2 shape): fused and dense paths
+    agree within bf16 matmul tolerance, and the returned nll is fp32."""
+    rng = np.random.RandomState(2)
+    h = jnp.asarray(rng.randn(16, 32), jnp.bfloat16)
+    W = jnp.asarray(rng.randn(300, 32) * 0.05, jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 300, 16), jnp.int32)
+    out = linear_cross_entropy(h, W, y, 64)
+    assert out.dtype == jnp.float32
+    ref = _dense_nll(h.astype(jnp.float32), W.astype(jnp.float32), y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    # grad dtypes mirror the primals (what amp O2 + the optimizer expect)
+    gh, gw = jax.grad(lambda h, W: linear_cross_entropy(h, W, y, 64).mean(),
+                      argnums=(0, 1))(h, W)
+    assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+
+
+def test_gpt_loss_fused_matches_dense():
+    """GPT.loss default (fused head) == head_chunk=None (dense oracle),
+    value and grads, including ignore_index masking via attention_mask."""
+    from apex_tpu import models
+
+    kw = dict(vocab_size=311, block_size=32, n_layer=2, n_head=4,
+              n_embd=32, dropout=0.0)
+    m_f = models.GPT(models.GPTConfig(head_chunk=128, **kw))
+    m_d = models.GPT(models.GPTConfig(head_chunk=None, **kw))
+    params, _ = m_f.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 311, (2, 32)), jnp.int32)
+    mask = jnp.asarray(rng.rand(2, 32) > 0.2, jnp.int32)
+
+    lf = m_f.loss(params, ids, attention_mask=mask)
+    ld = m_d.loss(params, ids, attention_mask=mask)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5, atol=1e-5)
+
+    gf = jax.grad(lambda p: m_f.loss(p, ids, attention_mask=mask))(params)
+    gd = jax.grad(lambda p: m_d.loss(p, ids, attention_mask=mask))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-5, atol=5e-5)
