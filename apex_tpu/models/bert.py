@@ -33,7 +33,14 @@ class BertConfig:
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, layer_norm_eps=1e-12,
-                 tp_axis=None, hidden_act="gelu_tanh", sp_axis=None):
+                 tp_axis=None, hidden_act="gelu_tanh", sp_axis=None,
+                 head_chunk=8192):
+        # head_chunk: vocab chunk size for the fused MLM-head loss
+        # (nn.fused_xent — the (B*T, V) logits are never materialized);
+        # None/0 restores the dense logits + fp32 log_softmax path.
+        # Ignored under tp_axis (loss() routes to the vocab-parallel
+        # cross-entropy; tp+sp combined is rejected below).
+        self.head_chunk = head_chunk
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -255,11 +262,8 @@ class BertForPretraining(nn.Module):
 
     def forward(self, p, input_ids, token_type_ids=None,
                 attention_mask=None):
-        seq, pooled = self.bert(p["bert"], input_ids, token_type_ids,
-                                attention_mask)
-        h = self.mlm_ln(p["mlm_ln"], F.gelu(
-            self.mlm_dense(p["mlm_dense"], seq),
-            approximate=self.cfg.hidden_act != "gelu_exact"))
+        h, pooled = self._mlm_hidden(p, input_ids, token_type_ids,
+                                     attention_mask)
         # decoder tied to word embeddings (standard BERT); under TP the
         # table leaf is vocab-sharded, so the logits come out sharded on
         # the vocab dim (consume with vocab_parallel_cross_entropy) —
@@ -272,22 +276,46 @@ class BertForPretraining(nn.Module):
         nsp_logits = self.nsp(p["nsp"], pooled)
         return mlm_logits, nsp_logits
 
+    def _mlm_hidden(self, p, input_ids, token_type_ids=None,
+                    attention_mask=None):
+        """Pre-decoder MLM hidden states (B, T, H) + pooled — shared by
+        the logits path and the fused-head loss."""
+        seq, pooled = self.bert(p["bert"], input_ids, token_type_ids,
+                                attention_mask)
+        h = self.mlm_ln(p["mlm_ln"], F.gelu(
+            self.mlm_dense(p["mlm_dense"], seq),
+            approximate=self.cfg.hidden_act != "gelu_exact"))
+        return h, pooled
+
     def loss(self, p, input_ids, mlm_labels, nsp_labels,
              token_type_ids=None, attention_mask=None, ignore_index=-100):
-        mlm_logits, nsp_logits = self(p, input_ids, token_type_ids,
-                                      attention_mask)
         if self.cfg.tp_axis is not None:
+            mlm_logits, nsp_logits = self(p, input_ids, token_type_ids,
+                                          attention_mask)
             from ..parallel.tensor_parallel import \
                 vocab_parallel_cross_entropy
             mlm_loss = vocab_parallel_cross_entropy(
                 mlm_logits, mlm_labels, axis_name=self.cfg.tp_axis,
                 ignore_index=ignore_index)
         else:
-            logp = F.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+            h, pooled = self._mlm_hidden(p, input_ids, token_type_ids,
+                                         attention_mask)
+            nsp_logits = self.nsp(p["nsp"], pooled)
             valid = mlm_labels != ignore_index
             labels = jnp.where(valid, mlm_labels, 0)
-            nll = -jnp.take_along_axis(logp, labels[..., None],
-                                       axis=-1)[..., 0]
+            table = p["bert"]["word_embeddings"]["weight"]
+            if self.cfg.head_chunk:
+                from ..nn.fused_xent import linear_cross_entropy
+                B, T, H = h.shape
+                nll = linear_cross_entropy(
+                    h.reshape(B * T, H), table, labels.reshape(-1),
+                    int(self.cfg.head_chunk)).reshape(B, T)
+            else:
+                mlm_logits = F.matmul(h, table.T.astype(h.dtype))
+                logp = F.log_softmax(mlm_logits.astype(jnp.float32),
+                                     axis=-1)
+                nll = -jnp.take_along_axis(logp, labels[..., None],
+                                           axis=-1)[..., 0]
             sp = self.cfg.sp_axis
             if sp is not None and _sp_in_scope(sp):
                 # MLM is per-position: psum the masked sums so every

@@ -95,3 +95,36 @@ def test_gpt_loss_fused_matches_dense():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=5e-5, atol=5e-5)
+
+
+def test_bert_mlm_loss_fused_matches_dense():
+    """BertForPretraining.loss default (fused MLM head) ==
+    head_chunk=None dense oracle, value and grads."""
+    from apex_tpu import models
+
+    kw = dict(vocab_size=259, hidden_size=32, num_hidden_layers=2,
+              num_attention_heads=4, intermediate_size=64,
+              max_position_embeddings=32, hidden_dropout_prob=0.0,
+              attention_probs_dropout_prob=0.0)
+    m_f = models.BertForPretraining(models.BertConfig(head_chunk=64, **kw))
+    m_d = models.BertForPretraining(models.BertConfig(head_chunk=None, **kw))
+    params, _ = m_f.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 259, (2, 16)), jnp.int32)
+    mlm = jnp.where(jnp.asarray(rng.rand(2, 16) < 0.15),
+                    jnp.asarray(rng.randint(0, 259, (2, 16))), -100)
+    nsp = jnp.asarray(rng.randint(0, 2, 2), jnp.int32)
+
+    def run(m, p):
+        return m.loss(p, ids, mlm, nsp)
+
+    np.testing.assert_allclose(float(run(m_f, params)),
+                               float(run(m_d, params)),
+                               rtol=1e-5, atol=1e-5)
+    gf = jax.grad(lambda p: run(m_f, p))(params)
+    gd = jax.grad(lambda p: run(m_d, p))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-5, atol=5e-5)
