@@ -1,7 +1,7 @@
 """HuggingFace checkpoint interop: torch state_dicts -> apex_tpu params.
 
 A user switching from the reference stack brings torch-ecosystem
-weights; these converters map ``transformers`` BERT / GPT-2 state_dicts
+weights; these converters map ``transformers`` BERT / GPT-2 / ResNet state_dicts
 onto apex_tpu's param trees, and the tests prove output parity against
 the HF torch implementations themselves (random-init models, so no
 network access is needed — the proof is architectural, and a real
@@ -148,3 +148,95 @@ def _to_jnp(tree):
     import jax
     return jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32),
                                   tree)
+
+
+def resnet_from_hf(hf_model):
+    """(model, params, state) for apex_tpu from a transformers
+    ResNetModel / ResNetForImageClassification.
+
+    HF's default geometry (``downsample_in_bottleneck=False``, stride in
+    the bottleneck's 3x3) matches this repo's torchvision-v1.5-shaped
+    ResNet (models/resnet.py), so the mapping is pure renaming:
+    embedder -> conv1/bn1, encoder.stages.{s}.layers.{l} ->
+    layer{s+1}.{l}, shortcut -> downsample, classifier -> fc.  BN
+    running stats land in the separate state tree (dotted keys, the
+    checkpoint convention).  Output parity vs the HF torch forward is
+    pinned in tests/test_hf_interop.py."""
+    from ..models import ResNet, BasicBlock, Bottleneck
+
+    hc = hf_model.config
+    if getattr(hc, "downsample_in_first_stage", False):
+        raise ValueError("downsample_in_first_stage=True has no "
+                         "equivalent in the torchvision-shaped ResNet")
+    if getattr(hc, "downsample_in_bottleneck", False):
+        raise ValueError("downsample_in_bottleneck=True (v1.0 geometry) "
+                         "is not supported; this ResNet strides in the "
+                         "3x3 (v1.5, HF default)")
+    if hc.layer_type == "bottleneck":
+        block, exp = Bottleneck, 4
+    elif hc.layer_type == "basic":
+        block, exp = BasicBlock, 1
+    else:
+        raise ValueError(f"unknown layer_type {hc.layer_type!r}")
+    if hc.embedding_size != 64 or hc.hidden_act != "relu":
+        raise ValueError("only the standard embedding_size=64 / relu "
+                         "geometry maps onto models.ResNet")
+    expected = [64 * exp * (2 ** i) for i in range(len(hc.depths))]
+    if list(hc.hidden_sizes) != expected or len(hc.depths) != 4:
+        raise ValueError(f"hidden_sizes {hc.hidden_sizes} do not match "
+                         f"the standard progression {expected}")
+
+    sd = hf_model.state_dict()
+    n_classes = getattr(hc, "num_labels", None) or 1000
+    model = ResNet(block, list(hc.depths), num_classes=n_classes)
+
+    def bn_params(prefix):
+        return _lin(sd, prefix)
+
+    def bn_state(prefix):
+        return {"running_mean": _t(sd[f"{prefix}.running_mean"]),
+                "running_var": _t(sd[f"{prefix}.running_var"]),
+                "num_batches_tracked": _t(
+                    sd[f"{prefix}.num_batches_tracked"])}
+
+    # ForImageClassification nests the backbone under "resnet."
+    if "embedder.embedder.convolution.weight" not in sd:
+        sd = {(k[len("resnet."):] if k.startswith("resnet.") else k): v
+              for k, v in sd.items()}
+    params = {
+        "conv1": {"weight": _t(
+            sd["embedder.embedder.convolution.weight"])},
+        "bn1": bn_params("embedder.embedder.normalization"),
+    }
+    state = {"bn1": bn_state("embedder.embedder.normalization")}
+
+    nconvs = 3 if block is Bottleneck else 2
+    for s, depth in enumerate(hc.depths):
+        stage = {}
+        for l in range(depth):
+            hfp = f"encoder.stages.{s}.layers.{l}"
+            blk = {}
+            for j in range(nconvs):
+                blk[f"conv{j+1}"] = {"weight": _t(
+                    sd[f"{hfp}.layer.{j}.convolution.weight"])}
+                blk[f"bn{j+1}"] = bn_params(f"{hfp}.layer.{j}.normalization")
+                state[f"layer{s+1}.{l}.bn{j+1}"] = bn_state(
+                    f"{hfp}.layer.{j}.normalization")
+            if f"{hfp}.shortcut.convolution.weight" in sd:
+                blk["downsample"] = {
+                    "0": {"weight": _t(
+                        sd[f"{hfp}.shortcut.convolution.weight"])},
+                    "1": bn_params(f"{hfp}.shortcut.normalization")}
+                state[f"layer{s+1}.{l}.downsample.1"] = bn_state(
+                    f"{hfp}.shortcut.normalization")
+            stage[str(l)] = blk
+        params[f"layer{s+1}"] = stage
+    if "classifier.1.weight" in sd:
+        params["fc"] = {"weight": _t(sd["classifier.1.weight"]),
+                        "bias": _t(sd["classifier.1.bias"])}
+    else:  # base model: head stays at init (caller replaces or ignores)
+        import numpy as _np
+        D = expected[-1]
+        params["fc"] = {"weight": _np.zeros((n_classes, D), _np.float32),
+                        "bias": _np.zeros((n_classes,), _np.float32)}
+    return model, _to_jnp(params), _to_jnp(state)

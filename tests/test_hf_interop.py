@@ -92,3 +92,40 @@ def test_gpt2_matches_transformers():
     logits = model(params, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(logits), out.logits.numpy(),
                                atol=3e-5)
+
+
+@pytest.mark.parametrize("layer_type,hidden_sizes,depths", [
+    ("basic", [64, 128, 256, 512], [2, 2, 2, 2]),        # resnet18 shape
+    ("bottleneck", [256, 512, 1024, 2048], [2, 2, 2, 2]),  # bottleneck path
+])
+def test_resnet_matches_transformers(layer_type, hidden_sizes, depths):
+    """resnet_from_hf: logits parity vs the HF torch ResNet (random
+    init — the proof is architectural; a pretrained checkpoint converts
+    identically).  Covers stride placement (v1.5, 3x3), shortcut
+    projections, BN running-stat state keys, and the classifier head."""
+    import torch
+    from transformers import ResNetConfig, ResNetForImageClassification
+
+    cfg = ResNetConfig(embedding_size=64, hidden_sizes=hidden_sizes,
+                       depths=depths, layer_type=layer_type, num_labels=7)
+    torch.manual_seed(0)
+    hf = ResNetForImageClassification(cfg).eval()
+    model, params, state = hf_interop.resnet_from_hf(hf)
+    x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(x)).logits.numpy()
+    out = np.asarray(model.apply(params, jnp.asarray(x), state=state,
+                                 train=False)[0])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_from_hf_rejects_v1_geometry():
+    from transformers import ResNetConfig, ResNetModel
+
+    cfg = ResNetConfig(embedding_size=64,
+                       hidden_sizes=[256, 512, 1024, 2048],
+                       depths=[2, 2, 2, 2], layer_type="bottleneck",
+                       downsample_in_bottleneck=True)
+    hf = ResNetModel(cfg)
+    with pytest.raises(ValueError, match="v1.0 geometry"):
+        hf_interop.resnet_from_hf(hf)
