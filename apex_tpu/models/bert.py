@@ -304,6 +304,10 @@ class BertForPretraining(nn.Module):
             valid = mlm_labels != ignore_index
             labels = jnp.where(valid, mlm_labels, 0)
             table = p["bert"]["word_embeddings"]["weight"]
+            from ..quantization import QTensor
+            if isinstance(table, QTensor):
+                # fused_xent slices the table; it needs a real array
+                table = table.dequant(h.dtype)
             if self.cfg.head_chunk:
                 from ..nn.fused_xent import linear_cross_entropy
                 B, T, H = h.shape

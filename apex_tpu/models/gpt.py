@@ -31,6 +31,14 @@ from ..transformer.attention import dot_product_attention
 __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_medium"]
 
 
+def _head_matmul(x, table):
+    """Weight-tied LM head: x @ table.T in the activation dtype.
+    A weight-only-int8 ``quantization.QTensor`` table works through the
+    same expression (its .T/.astype dequantize; the convert fuses into
+    the dot's operand read)."""
+    return F.matmul(x, table.T.astype(x.dtype))
+
+
 class GPTConfig:
     def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
                  n_head=12, n_embd=768, dropout=0.1,
@@ -225,7 +233,7 @@ class GPT(nn.Module):
         if self.cfg.tp_axis is not None:
             from ..parallel.tensor_parallel import copy_to_model_parallel
             x = copy_to_model_parallel(x, self.cfg.tp_axis)
-        return F.matmul(x, table.T.astype(x.dtype))
+        return _head_matmul(x, table)
 
     def _head_nll(self, p, x, safe_labels):
         """Per-position nll (B, T') through the weight-tied head.
@@ -236,6 +244,12 @@ class GPT(nn.Module):
         ``head_chunk=None``: the dense logits + fp32 log_softmax
         reference path (kept as the parity oracle, tested equal)."""
         table = p["wte"]["weight"]
+        from ..quantization import QTensor
+        if isinstance(table, QTensor):
+            # loss on quantized params: fused_xent slices the table, so
+            # it needs a real array (the one QTensor consumer with no
+            # array-shim route)
+            table = table.dequant(x.dtype)
         B, T, D = x.shape
         if self.cfg.head_chunk:
             from ..nn.fused_xent import linear_cross_entropy
@@ -243,7 +257,7 @@ class GPT(nn.Module):
                                        safe_labels.reshape(-1),
                                        int(self.cfg.head_chunk))
             return nll.reshape(B, T)
-        logits = F.matmul(x, table.T.astype(x.dtype))
+        logits = _head_matmul(x, table)
         logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.take_along_axis(logp, safe_labels[..., None],
                                     axis=-1)[..., 0]
@@ -408,7 +422,7 @@ class GPT(nn.Module):
 
     def _head(self, p, x):
         table = p["wte"]["weight"]
-        return F.matmul(x, table.T.astype(x.dtype))
+        return _head_matmul(x, table)
 
     def decode_step(self, p, token, pos, cache):
         """token: (B,) ids at scalar position ``pos`` -> ((B, V) logits

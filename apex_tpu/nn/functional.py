@@ -65,9 +65,11 @@ def op(name: str):
 # ---------------------------------------------------------------------------
 
 @op("linear")
-def linear(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None
+def linear(x: jax.Array, weight, bias: Optional[jax.Array] = None
            ) -> jax.Array:
-    # weight is (out, in) like the reference's nn.Linear
+    # weight is (out, in) like the reference's nn.Linear.  A weight-only
+    # int8 quantization.QTensor works transparently: its .T dequantizes
+    # and XLA fuses the convert+scale into the dot's operand read.
     y = jnp.matmul(x, weight.T)
     if bias is not None:
         y = y + bias
@@ -75,7 +77,7 @@ def linear(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None
 
 
 @op("matmul")
-def matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+def matmul(a, b) -> jax.Array:
     return jnp.matmul(a, b)
 
 
@@ -332,7 +334,10 @@ def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Tuple[int, int]],
     raise NotImplementedError("adaptive_avg_pool2d supports output_size=1")
 
 
-def embedding(ids: jax.Array, table: jax.Array) -> jax.Array:
+def embedding(ids: jax.Array, table) -> jax.Array:
+    from ..quantization import QTensor
+    if isinstance(table, QTensor):
+        return table.take(ids)     # gathered rows dequantize, not the table
     return jnp.take(table, ids, axis=0)
 
 

@@ -382,7 +382,10 @@ class VocabParallelEmbedding(Module):
         start = idx * block
         local = ids - start
         in_block = (local >= 0) & (local < block)
-        rows = jnp.take(w, jnp.where(in_block, local, 0), axis=0)
+        # F.embedding (not a raw take): an int8-quantized table
+        # (quantization.QTensor) then gathers quantized rows and
+        # dequantizes only those
+        rows = F.embedding(jnp.where(in_block, local, 0), w)
         rows = jnp.where(in_block[..., None], rows, 0.0)
         return reduce_from_model_parallel(rows, self.axis_name)
 

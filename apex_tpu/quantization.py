@@ -1,0 +1,130 @@
+"""Weight-only int8 quantization for inference/decoding.
+
+Autoregressive decoding is HBM-bandwidth-bound: every generated token
+re-reads every weight, and the MXU sits mostly idle.  Storing weights
+as int8 with per-output-channel fp scales halves-to-quarters the bytes
+per token; XLA fuses the dequantize (convert + broadcast-multiply) into
+the consuming dot's operand read, so the stored tensor — what HBM
+actually serves — stays int8.
+
+This is the standard weight-only recipe (symmetric, per-channel,
+round-to-nearest); nothing here touches training — the reference
+toolkit's scope (SURVEY §2) ends at mixed-precision training, and this
+module is the inference-side counterpart the switch-over user expects.
+
+    qparams = quantization.quantize_for_decode(params)
+    ids, n = model.generate(qparams, prompt, prompt_len, 64)
+
+``QTensor`` is a pytree node, so quantized trees jit/donate/shard like
+ordinary params; ``nn.functional.linear/matmul/embedding`` accept it
+directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QTensor", "quantize", "quantize_for_decode"]
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 data + fp scale, dequantizing to ``dtype`` on use.
+
+    ``scale`` keeps ``data``'s rank (size 1 except on ``axis``) so
+    ``dequant`` is a plain broadcast multiply.
+    """
+
+    def __init__(self, data, scale, axis: int, dtype=jnp.bfloat16):
+        self.data = data
+        self.scale = scale
+        self.axis = axis
+        self._dtype = jnp.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        """The LOGICAL dtype: what consumers see after dequant."""
+        return self._dtype
+
+    def dequant(self, dtype=None):
+        d = dtype or self._dtype
+        return self.data.astype(d) * self.scale.astype(d)
+
+    # -- array-surface shims ------------------------------------------------
+    # Weight consumers overwhelmingly do ``w.T`` / ``w.astype(dt)`` /
+    # ``jnp.matmul(x, w.T)``; giving QTensor these two methods (both
+    # dequantize — XLA fuses the convert+scale into the consuming dot)
+    # makes every existing call site work without isinstance guards.
+    # Ops with a cheaper quantized form (row gather) use ``take()``.
+    @property
+    def T(self):
+        return self.dequant().T
+
+    def astype(self, dtype):
+        return self.dequant(dtype)
+
+    def take(self, ids):
+        """Row gather (embedding lookup) without dequantizing the whole
+        table: only the gathered rows convert."""
+        if self.axis != 0:
+            raise ValueError("take() needs per-row (axis=0) scales")
+        rows = jnp.take(self.data, ids, axis=0).astype(self._dtype)
+        return rows * jnp.take(self.scale, ids, axis=0).astype(self._dtype)
+
+    def tree_flatten(self):
+        return (self.data, self.scale), (self.axis, self._dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    def __repr__(self):
+        return (f"QTensor(int8{list(self.shape)}, axis={self.axis}, "
+                f"dtype={self._dtype.name})")
+
+
+def quantize(w, axis: int = 0, dtype=jnp.bfloat16) -> QTensor:
+    """Symmetric per-channel int8: scale = amax/127 over all dims except
+    ``axis`` (the output-channel dim: rows of a torch-layout (out, in)
+    Linear weight, rows of a (V, D) embedding table)."""
+    w = jnp.asarray(w)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q.astype(jnp.int8), scale, axis, dtype)
+
+
+def quantize_for_decode(params: Any, dtype=jnp.bfloat16,
+                        min_size: int = 4096) -> Any:
+    """Quantize every 2-D ``weight`` leaf (Linear matrices, embedding
+    tables) of at least ``min_size`` elements; 1-D leaves (LayerNorm,
+    biases) and small tensors stay in floating point.  Structure is
+    preserved, so the result drops into ``model.generate``/``apply``
+    wherever the fp params did."""
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (k == "weight" and hasattr(v, "ndim") and v.ndim == 2
+                        and not isinstance(v, QTensor)
+                        and v.size >= min_size
+                        and jnp.issubdtype(v.dtype, jnp.floating)):
+                    out[k] = quantize(v, axis=0, dtype=dtype)
+                else:
+                    out[k] = walk(v)
+            return out
+        return node
+    return walk(params)

@@ -239,4 +239,16 @@ def resnet_from_hf(hf_model):
         D = expected[-1]
         params["fc"] = {"weight": _np.zeros((n_classes, D), _np.float32),
                         "bias": _np.zeros((n_classes,), _np.float32)}
-    return model, _to_jnp(params), _to_jnp(state)
+    # state keeps integer leaves integer (num_batches_tracked is a
+    # counter the BN train path increments; a float32 version would
+    # diverge from init-produced state trees in dtype)
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(a):
+        a = np.asarray(a)
+        return jnp.asarray(a) if np.issubdtype(a.dtype, np.integer) \
+            else jnp.asarray(a, jnp.float32)
+
+    return (model, _to_jnp(params),
+            jax.tree_util.tree_map(leaf, state))

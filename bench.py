@@ -324,14 +324,20 @@ def main():
              unit="sequences/sec/chip", steps_per_call=K,
              vs_baseline=None)
 
-    def gpt_decode_config(metric, cfg, batch, prompt, new_tokens):
+    def gpt_decode_config(metric, cfg, batch, prompt, new_tokens,
+                          int8_weights=False):
         """KV-cached generation throughput (tokens/sec/chip) — the
-        serving path: static cache buffers, one compiled program."""
+        serving path: static cache buffers, one compiled program.
+        ``int8_weights``: weight-only int8 (quantization module) — the
+        HBM-bandwidth lever for the memory-bound decode loop."""
         model = models.GPT(cfg)
         params, _ = model.init(jax.random.PRNGKey(0))
         params = jax.tree_util.tree_map(
             lambda x: x.astype(jnp.bfloat16)
             if x.dtype == jnp.float32 else x, params)
+        if int8_weights:
+            from apex_tpu import quantization
+            params = quantization.quantize_for_decode(params)
         rng = np.random.RandomState(0)
         buf = np.zeros((batch, cfg.block_size), np.int32)
         buf[:, :prompt] = rng.randint(0, cfg.vocab_size, (batch, prompt))
@@ -361,7 +367,9 @@ def main():
         emit(metric=metric, value=round(batch * new_tokens / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None,
              note=f"KV-cached greedy decode, B={batch}, prompt={prompt}, "
-                  f"{new_tokens} new tokens, bf16 params+cache; {how}")
+                  f"{new_tokens} new tokens, "
+                  f"{'int8 weights' if int8_weights else 'bf16 params'}"
+                  f"+bf16 cache; {how}")
 
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
@@ -470,6 +478,13 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 128)),
+            ("gpt2_small_decode_int8_throughput",
+             lambda: gpt_decode_config(
+                 "gpt2_small_decode_int8_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 8, 64, 128, int8_weights=True)),
             # long-context single-chip: the blocked flash path at 8x the
             # training context (T=32768 compiles on-chip per
             # artifacts/tpu_kernel_tests_r3.log; this records sustained

@@ -1,0 +1,147 @@
+"""Weight-only int8 quantization (apex_tpu.quantization).
+
+Decode is HBM-bound; int8 weights halve the bytes per token.  These
+tests pin the quantization error bound, the QTensor pytree/op wiring
+(linear/matmul/embedding + the GPT head), and end-to-end decode on
+quantized params against the fp oracle.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import models, quantization
+from apex_tpu.nn import functional as F
+from apex_tpu.quantization import QTensor, quantize
+
+
+def test_quantize_error_bound():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 48), jnp.float32)
+    q = quantize(w, axis=0, dtype=jnp.float32)
+    assert q.data.dtype == jnp.int8 and q.shape == w.shape
+    # round-to-nearest: |w - dq| <= scale/2 per row
+    err = jnp.abs(q.dequant(jnp.float32) - w)
+    bound = q.scale.reshape(-1, 1) * 0.5 + 1e-7
+    assert bool(jnp.all(err <= bound))
+
+
+def test_qtensor_is_pytree_and_jits():
+    w = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
+    q = quantize(w)
+    leaves = jax.tree_util.tree_leaves(q)
+    assert len(leaves) == 2
+    y = jax.jit(lambda q, x: F.linear(x, q))(q, jnp.ones((2, 8)))
+    assert y.shape == (2, 16)
+
+
+def test_ops_accept_qtensor():
+    rng = np.random.RandomState(2)
+    w = jnp.asarray(rng.randn(32, 24) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.randn(4, 24), jnp.float32)
+    q = quantize(w, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(F.linear(x, q)),
+                               np.asarray(x @ q.dequant(jnp.float32).T),
+                               rtol=1e-6, atol=1e-6)
+    tab = quantize(jnp.asarray(rng.randn(50, 16), jnp.float32),
+                   dtype=jnp.float32)
+    ids = jnp.asarray([0, 7, 49])
+    np.testing.assert_allclose(
+        np.asarray(F.embedding(ids, tab)),
+        np.asarray(jnp.take(tab.dequant(jnp.float32), ids, axis=0)),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_for_decode_selects_matrices():
+    cfg = models.GPTConfig(vocab_size=211, block_size=16, n_layer=1,
+                           n_head=2, n_embd=32, dropout=0.0)
+    m = models.GPT(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    qp = quantization.quantize_for_decode(params, min_size=256)
+    flat = jax.tree_util.tree_leaves(qp)
+    assert any(l.dtype == jnp.int8 for l in flat)
+    # LayerNorm params stay floating point
+    assert qp["ln_f"]["weight"].dtype == jnp.float32
+    # wte quantized (largest table)
+    assert isinstance(qp["wte"]["weight"], QTensor)
+
+
+def test_quantized_gpt_decode_matches_fp_closely():
+    cfg = models.GPTConfig(vocab_size=211, block_size=32, n_layer=2,
+                           n_head=4, n_embd=64, dropout=0.0)
+    m = models.GPT(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    qp = quantization.quantize_for_decode(params, min_size=256)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 211, (2, 32)), jnp.int32)
+
+    lf = np.asarray(m(params, ids))
+    lq = np.asarray(m(qp, ids).astype(jnp.float32))
+    rel = np.abs(lq - lf) / (np.abs(lf).max() + 1e-6)
+    assert rel.max() < 0.05, rel.max()
+
+    # loss also runs on quantized params (dequant guard in _head_nll)
+    assert np.isfinite(float(m.loss(qp, ids)))
+
+    # both decode loops run on quantized params
+    buf = jnp.zeros((2, 32), jnp.int32).at[:, :4].set(ids[:, :4])
+    out, n = m.generate(qp, buf, 4, 8)
+    assert out.shape == (2, 32) and int(n[0]) == 12
+    out_c, n_c = m.generate_cached(qp, buf, 4, 8)
+    assert out_c.shape == (2, 32) and int(n_c[0]) == 12
+
+
+def test_quantized_bert_forward_and_loss():
+    """quantize_for_decode output drops into BertForPretraining
+    unchanged (the docs' claim): forward logits close to fp, loss
+    finite (finding of r4 review: table.T/astype now dequantize)."""
+    cfg = models.BertConfig(vocab_size=223, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=64,
+                            max_position_embeddings=32,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0)
+    m = models.BertForPretraining(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    qp = quantization.quantize_for_decode(params, min_size=256)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 223, (2, 16)), jnp.int32)
+    lf, _ = m(params, ids)
+    lq, _ = m(qp, ids)
+    rel = np.abs(np.asarray(lq, np.float32) - np.asarray(lf)) / (
+        np.abs(np.asarray(lf)).max() + 1e-6)
+    assert rel.max() < 0.05, rel.max()
+    mlm = jnp.where(jnp.asarray(rng.rand(2, 16) < 0.15),
+                    jnp.asarray(rng.randint(0, 223, (2, 16))), -100)
+    nsp = jnp.asarray(rng.randint(0, 2, 2), jnp.int32)
+    assert np.isfinite(float(m.loss(qp, ids, mlm, nsp)))
+
+
+def test_quantized_vocab_parallel_embedding():
+    """TP vocab-sharded table as QTensor: gather stays quantized
+    per-shard and matches the fp path."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.parallel.tensor_parallel import VocabParallelEmbedding
+
+    ndev = len(jax.devices())
+    emb = VocabParallelEmbedding(64, 16, axis_name="tp")
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 16), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+    dense = np.asarray(jnp.take(w, ids, axis=0))
+
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    shard = w.reshape(ndev, 64 // ndev, 16)
+    qshards = [quantize(shard[i], dtype=jnp.float32) for i in range(ndev)]
+    # concat along rows: P("tp") then hands each device its own
+    # (rows/ndev, D) quantized block, per-shard scales intact
+    qw = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *qshards)
+
+    out = jax.jit(jax.shard_map(
+        lambda wq, i: emb({"weight": wq}, i),
+        mesh=mesh, in_specs=(P("tp"), P()), out_specs=P(),
+        check_vma=False))(qw, ids)
+    rel = np.abs(np.asarray(out) - dense) / (np.abs(dense).max() + 1e-6)
+    assert rel.max() < 0.02, rel.max()
