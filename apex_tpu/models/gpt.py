@@ -128,37 +128,59 @@ class GPTSelfAttention(nn.Module):
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
 
-    def decode(self, p, x, pos, kcache, vcache):
+    def decode(self, p, x, pos, cache):
         """One-token step against the KV cache.
 
         ``x``: (B, 1, E) this position's activations; ``pos``: scalar
-        position; ``kcache``/``vcache``: (B, H, S, D) static buffers.
-        Writes k/v at ``pos`` and attends q over positions <= pos.
-        Eval-mode path (no dropout).  Returns (out (B, 1, E), kcache,
-        vcache)."""
+        position; ``cache``: {"k","v"} (B, H, S, D) static buffers —
+        plus {"k_scale","v_scale"} (B, H, S, 1) when the buffers are
+        int8 (GPT.init_cache(dtype=jnp.int8): per-position symmetric
+        quantization, the cache-bandwidth/capacity lever for long-S
+        serving).  Writes k/v at ``pos`` and attends q over positions
+        <= pos.  Eval-mode path (no dropout).  Returns (out (B, 1, E),
+        updated cache)."""
         if self.tp:
             raise NotImplementedError(
                 "KV-cache decode is single-device; run the TP model "
                 "through forward() or shard the batch instead")
         B, _, E = x.shape
-        S = kcache.shape[2]
+        S = cache["k"].shape[2]
         qkv = self.qkv(p["qkv"], x).reshape(B, 3, self.n_head,
                                             self.head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # (B, H, D)
-        kcache = lax.dynamic_update_slice_in_dim(
-            kcache, k[:, :, None, :].astype(kcache.dtype), pos, axis=2)
-        vcache = lax.dynamic_update_slice_in_dim(
-            vcache, v[:, :, None, :].astype(vcache.dtype), pos, axis=2)
-        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
-                            kcache.astype(jnp.float32))
+        q8 = cache["k"].dtype == jnp.int8
+
+        def put(buf, val):
+            return lax.dynamic_update_slice_in_dim(
+                buf, val[:, :, None, :].astype(buf.dtype), pos, axis=2)
+
+        cache = dict(cache)
+        if q8:
+            for name, val in (("k", k), ("v", v)):
+                amax = jnp.max(jnp.abs(val.astype(jnp.float32)), axis=-1,
+                               keepdims=True)
+                scale = jnp.maximum(amax, 1e-12) / 127.0
+                cache[name] = put(cache[name], jnp.clip(
+                    jnp.round(val.astype(jnp.float32) / scale),
+                    -127, 127))
+                cache[f"{name}_scale"] = put(cache[f"{name}_scale"], scale)
+            kf = (cache["k"].astype(jnp.float32)
+                  * cache["k_scale"].astype(jnp.float32))
+            vf = (cache["v"].astype(jnp.float32)
+                  * cache["v_scale"].astype(jnp.float32))
+        else:
+            cache["k"] = put(cache["k"], k)
+            cache["v"] = put(cache["v"], v)
+            kf = cache["k"].astype(jnp.float32)
+            vf = cache["v"].astype(jnp.float32)
+        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32), kf)
         scores = scores * (1.0 / (self.head_dim ** 0.5))
         valid = jnp.arange(S)[None, None, :] <= pos
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhs,bhsd->bhd", probs,
-                         vcache.astype(jnp.float32)).astype(x.dtype)
+        ctx = jnp.einsum("bhs,bhsd->bhd", probs, vf).astype(x.dtype)
         ctx = ctx.reshape(B, 1, E)
-        return self.out(p["out"], ctx), kcache, vcache
+        return self.out(p["out"], ctx), cache
 
 
 class GPTBlock(nn.Module):
@@ -189,13 +211,13 @@ class GPTBlock(nn.Module):
             h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
         return x + self.drop(p.get("drop", {}), h)
 
-    def decode(self, p, x, pos, kcache, vcache):
-        a, kcache, vcache = self.attn.decode(
-            p["attn"], self.ln_1(p["ln_1"], x), pos, kcache, vcache)
+    def decode(self, p, x, pos, cache):
+        a, cache = self.attn.decode(
+            p["attn"], self.ln_1(p["ln_1"], x), pos, cache)
         x = x + a
         h = self.ln_2(p["ln_2"], x)
         h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
-        return x + h, kcache, vcache
+        return x + h, cache
 
 
 class GPT(nn.Module):
@@ -397,13 +419,22 @@ class GPT(nn.Module):
         return ids, final_len
 
     def init_cache(self, batch_size: int, dtype=jnp.float32):
-        """Per-layer (B, H, S, D) k/v buffers for cached decoding."""
+        """Per-layer (B, H, S, D) k/v buffers for cached decoding.
+
+        ``dtype=jnp.int8`` adds per-position (B, H, S, 1) fp32 scale
+        sidecars: entries quantize symmetrically as they are written
+        and dequantize fused into the attention reads — half the cache
+        bytes of bf16, double the context per HBM byte."""
         cfg = self.cfg
         shape = (batch_size, cfg.n_head, cfg.block_size,
                  cfg.n_embd // cfg.n_head)
-        return {str(i): {"k": jnp.zeros(shape, dtype),
-                         "v": jnp.zeros(shape, dtype)}
-                for i in range(cfg.n_layer)}
+        layer = {"k": jnp.zeros(shape, dtype),
+                 "v": jnp.zeros(shape, dtype)}
+        if dtype == jnp.int8:
+            sshape = shape[:3] + (1,)
+            layer["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            layer["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return {str(i): dict(layer) for i in range(cfg.n_layer)}
 
     def _decode_hidden(self, p, token, pos, cache):
         """Blocks-only decode step: (B,) token at ``pos`` -> ((B, 1, E)
@@ -415,9 +446,8 @@ class GPT(nn.Module):
         new_cache = {}
         for i in range(self.cfg.n_layer):
             li = str(i)
-            x, k, v = self.h[i].decode(p["h"][li], x, pos,
-                                       cache[li]["k"], cache[li]["v"])
-            new_cache[li] = {"k": k, "v": v}
+            x, new_cache[li] = self.h[i].decode(p["h"][li], x, pos,
+                                                cache[li])
         return self.ln_f(p["ln_f"], x), new_cache
 
     def _head(self, p, x):

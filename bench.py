@@ -325,7 +325,7 @@ def main():
              vs_baseline=None)
 
     def gpt_decode_config(metric, cfg, batch, prompt, new_tokens,
-                          int8_weights=False):
+                          int8_weights=False, int8_cache=False):
         """KV-cached generation throughput (tokens/sec/chip) — the
         serving path: static cache buffers, one compiled program.
         ``int8_weights``: weight-only int8 (quantization module) — the
@@ -343,9 +343,11 @@ def main():
         buf[:, :prompt] = rng.randint(0, cfg.vocab_size, (batch, prompt))
         ids = jnp.asarray(buf)
 
+        cache_dtype = jnp.int8 if int8_cache else None
+
         def runner(n):
-            g = jax.jit(lambda p, b: model.generate_cached(p, b, prompt,
-                                                           n))
+            g = jax.jit(lambda p, b: model.generate_cached(
+                p, b, prompt, n, cache_dtype=cache_dtype))
             # timed()'s (state, batch) -> (state, out) shape, reusing its
             # hard-D2H-barrier discipline
             return lambda s, b: (s, g(params, b)[0])
@@ -368,8 +370,8 @@ def main():
              unit="tokens/sec/chip", vs_baseline=None,
              note=f"KV-cached greedy decode, B={batch}, prompt={prompt}, "
                   f"{new_tokens} new tokens, "
-                  f"{'int8 weights' if int8_weights else 'bf16 params'}"
-                  f"+bf16 cache; {how}")
+                  f"{'int8 weights' if int8_weights else 'bf16 params'}+"
+                  f"{'int8' if int8_cache else 'bf16'} cache; {how}")
 
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
@@ -484,7 +486,7 @@ def main():
                  models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
-                 8, 64, 128, int8_weights=True)),
+                 8, 64, 128, int8_weights=True, int8_cache=True)),
             # long-context single-chip: the blocked flash path at 8x the
             # training context (T=32768 compiles on-chip per
             # artifacts/tpu_kernel_tests_r3.log; this records sustained

@@ -145,3 +145,32 @@ def test_quantized_vocab_parallel_embedding():
         check_vma=False))(qw, ids)
     rel = np.abs(np.asarray(out) - dense) / (np.abs(dense).max() + 1e-6)
     assert rel.max() < 0.02, rel.max()
+
+
+def test_int8_kv_cache_decode_close_to_fp():
+    """int8 KV cache (per-position scales): decode_step logits track the
+    fp-cache logits closely, and generate_cached runs end-to-end with
+    cache_dtype=jnp.int8."""
+    cfg = models.GPTConfig(vocab_size=127, block_size=24, n_layer=2,
+                           n_head=4, n_embd=64, dropout=0.0)
+    m = models.GPT(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 127, 10)
+
+    cache_f = m.init_cache(1)
+    cache_q = m.init_cache(1, dtype=jnp.int8)
+    assert cache_q["0"]["k"].dtype == jnp.int8
+    assert cache_q["0"]["k_scale"].shape == (1, 4, 24, 1)
+    for pos, t in enumerate(toks):
+        tok = jnp.asarray([t], jnp.int32)
+        lf, cache_f = m.decode_step(params, tok, pos, cache_f)
+        lq, cache_q = m.decode_step(params, tok, pos, cache_q)
+    rel = np.abs(np.asarray(lq) - np.asarray(lf)) / (
+        np.abs(np.asarray(lf)).max() + 1e-6)
+    assert rel.max() < 0.05, rel.max()
+
+    buf = jnp.zeros((2, 24), jnp.int32).at[:, :4].set(
+        jnp.asarray(rng.randint(0, 127, (2, 4))))
+    out, n = m.generate_cached(params, buf, 4, 6, cache_dtype=jnp.int8)
+    assert out.shape == (2, 24) and int(n[0]) == 10
