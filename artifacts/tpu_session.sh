@@ -83,6 +83,16 @@ stat $RC
 [ $RC -eq 0 ] && done_mark step_probe
 fi
 
+alive donation_probe
+if ! skip donation_probe; then
+log "buffer-donation probe (in-place state update vs the tunnel caveat)"
+timeout 1200 python artifacts/donation_probe.py 2>&1 | grep -v WARNING \
+    | tee "artifacts/donation_probe_$TS.log"
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark donation_probe
+fi
+
 alive convergence
 if ! skip convergence; then
 log "convergence gate on real data (digits, O0 vs O2)"
