@@ -43,7 +43,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
                  n_head=12, n_embd=768, dropout=0.1,
                  layer_norm_eps=1e-5, tp_axis=None, sp_axis=None,
-                 head_chunk=8192):
+                 head_chunk=8192, n_kv_head=None):
         # head_chunk: vocab chunk size for the fused LM-head loss
         # (nn.fused_xent — logits never materialized); None/0 restores
         # the dense logits + fp32 log_softmax path.  Ignored under
@@ -66,6 +66,20 @@ class GPTConfig:
         # globally consistent automatically — block_size then means the
         # GLOBAL sequence length
         self.sp_axis = sp_axis
+        # grouped-query attention: n_kv_head < n_head shares each K/V
+        # head across n_head/n_kv_head query heads — the KV cache (the
+        # long-context serving bottleneck) shrinks by that factor and
+        # composes with the int8 cache.  None = MHA (GPT-2 parity; the
+        # fused qkv weight layout [q-rows; k-rows; v-rows] is then
+        # byte-identical to the pre-GQA layout).
+        self.n_kv_head = n_kv_head if n_kv_head is not None else n_head
+        if self.n_kv_head < 1 or n_head % self.n_kv_head:
+            raise ValueError(f"n_kv_head={self.n_kv_head} must be a "
+                             f"positive divisor of n_head={n_head}")
+        if self.n_kv_head != n_head and tp_axis is not None:
+            raise NotImplementedError(
+                "GQA under tensor parallelism is not wired "
+                "(ParallelSelfAttention is MHA)")
         if tp_axis is not None and sp_axis is not None:
             raise NotImplementedError(
                 "combined tp+sp GPT is not wired; pick one "
@@ -87,6 +101,7 @@ class GPTSelfAttention(nn.Module):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.n_head = cfg.n_head
+        self.n_kv = cfg.n_kv_head
         self.head_dim = cfg.n_embd // cfg.n_head
         self.dropout = cfg.dropout
         self.sp = cfg.sp_axis
@@ -97,18 +112,33 @@ class GPTSelfAttention(nn.Module):
                 cfg.n_embd, cfg.n_head, dropout=0.0, causal=True,
                 attn_dropout=cfg.dropout, axis_name=cfg.tp_axis)
         else:
-            self.qkv = nn.Linear(cfg.n_embd, 3 * cfg.n_embd)
+            self.qkv = nn.Linear(
+                cfg.n_embd, (cfg.n_head + 2 * self.n_kv) * self.head_dim)
             self.out = nn.Linear(cfg.n_embd, cfg.n_embd)
         self.drop = nn.Dropout(cfg.dropout)
+
+    def _split_qkv(self, fused, B, T):
+        """(B, T, (H+2Hkv)*D) -> q (B,H,T,D), k/v (B,Hkv,T,D).  Row
+        order [q; k; v] matches the pre-GQA fused layout when Hkv==H."""
+        H, Hkv, D = self.n_head, self.n_kv, self.head_dim
+        q = fused[..., :H * D].reshape(B, T, H, D)
+        k = fused[..., H * D:(H + Hkv) * D].reshape(B, T, Hkv, D)
+        v = fused[..., (H + Hkv) * D:].reshape(B, T, Hkv, D)
+        return (jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                jnp.moveaxis(v, 2, 1))
 
     def forward(self, p, x, mask=None):
         B, T, E = x.shape
         if self.tp:
             return self.drop(p.get("drop", {}),
                              self.core(p["core"], x, mask))
-        qkv = self.qkv(p["qkv"], x).reshape(B, T, 3, self.n_head,
-                                            self.head_dim)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+        q, k, v = self._split_qkv(self.qkv(p["qkv"], x), B, T)
+        if self.n_kv != self.n_head:
+            # training path: expand K/V to full heads so the flash/ring
+            # kernels see MHA (the cache-size win is the decode path's)
+            rep = self.n_head // self.n_kv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         if self.sp is not None and _sp_in_scope(self.sp):
             from ..transformer.ring_attention import ring_attention
             from ..nn.module import current_context
@@ -132,8 +162,9 @@ class GPTSelfAttention(nn.Module):
         """One-token step against the KV cache.
 
         ``x``: (B, 1, E) this position's activations; ``pos``: scalar
-        position; ``cache``: {"k","v"} (B, H, S, D) static buffers —
-        plus {"k_scale","v_scale"} (B, H, S, 1) when the buffers are
+        position; ``cache``: {"k","v"} (B, Hkv, S, D) static buffers
+        (Hkv = n_kv_head; = n_head under MHA) — plus
+        {"k_scale","v_scale"} (B, Hkv, S, 1) when the buffers are
         int8 (GPT.init_cache(dtype=jnp.int8): per-position symmetric
         quantization, the cache-bandwidth/capacity lever for long-S
         serving).  Writes k/v at ``pos`` and attends q over positions
@@ -145,9 +176,8 @@ class GPTSelfAttention(nn.Module):
                 "through forward() or shard the batch instead")
         B, _, E = x.shape
         S = cache["k"].shape[2]
-        qkv = self.qkv(p["qkv"], x).reshape(B, 3, self.n_head,
-                                            self.head_dim)
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # (B, H, D)
+        q, k, v = self._split_qkv(self.qkv(p["qkv"], x), B, 1)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]  # (B,H,D)/(B,Hkv,D)
         q8 = cache["k"].dtype == jnp.int8
 
         def put(buf, val):
@@ -173,12 +203,16 @@ class GPTSelfAttention(nn.Module):
             cache["v"] = put(cache["v"], v)
             kf = cache["k"].astype(jnp.float32)
             vf = cache["v"].astype(jnp.float32)
-        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32), kf)
+        # grouped attention against the COMPACT (B, Hkv, S, D) cache —
+        # query heads reshape into (Hkv, group) and share each KV head
+        G = self.n_head // self.n_kv
+        qg = q.reshape(B, self.n_kv, G, self.head_dim)
+        scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32), kf)
         scores = scores * (1.0 / (self.head_dim ** 0.5))
-        valid = jnp.arange(S)[None, None, :] <= pos
+        valid = jnp.arange(S)[None, None, None, :] <= pos
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhs,bhsd->bhd", probs, vf).astype(x.dtype)
+        ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vf).astype(x.dtype)
         ctx = ctx.reshape(B, 1, E)
         return self.out(p["out"], ctx), cache
 
@@ -419,14 +453,17 @@ class GPT(nn.Module):
         return ids, final_len
 
     def init_cache(self, batch_size: int, dtype=jnp.float32):
-        """Per-layer (B, H, S, D) k/v buffers for cached decoding.
+        """Per-layer (B, n_kv_head, S, D) k/v buffers for cached
+        decoding (n_kv_head = n_head under MHA; smaller under GQA —
+        that factor is the cache-size win).
 
-        ``dtype=jnp.int8`` adds per-position (B, H, S, 1) fp32 scale
+        ``dtype=jnp.int8`` adds per-position (B, n_kv_head, S, 1) scale
         sidecars: entries quantize symmetrically as they are written
         and dequantize fused into the attention reads — half the cache
         bytes of bf16, double the context per HBM byte."""
         cfg = self.cfg
-        shape = (batch_size, cfg.n_head, cfg.block_size,
+        # GQA: only n_kv_head KV heads are cached (the whole point)
+        shape = (batch_size, cfg.n_kv_head, cfg.block_size,
                  cfg.n_embd // cfg.n_head)
         layer = {"k": jnp.zeros(shape, dtype),
                  "v": jnp.zeros(shape, dtype)}
@@ -467,7 +504,7 @@ class GPT(nn.Module):
                         cache_dtype=None):
         """KV-cached ``generate``: one fused prefill+decode loop over
         the buffer positions, O(S) attention per step against the
-        static (B, H, S, D) caches.  Greedy output is IDENTICAL to
+        static (B, n_kv_head, S, D) caches.  Greedy output is IDENTICAL to
         ``generate`` (parity-tested); single-device (no tp_axis).
 
         One compiled program serves any prompt length: the loop bound is

@@ -327,3 +327,88 @@ def test_gpt_trains_under_other_opt_levels(ol):
         if l0 is None:
             l0 = float(loss)
     assert float(loss) < l0 * 0.8, (ol, l0, float(loss))
+
+
+def test_gqa_cached_decode_matches_uncached():
+    from apex_tpu.models import GPT, GPTConfig
+    """GQA (n_kv_head < n_head): the compact grouped-cache decode is
+    greedy-identical to the uncached forward path (which expands KV to
+    full heads), and the cache is n_kv_head-sized; int8 cache composes."""
+    cfg = GPTConfig(vocab_size=101, block_size=24, n_layer=2, n_head=4,
+                    n_embd=32, dropout=0.0, n_kv_head=2)
+    m = GPT(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    assert params["h"]["0"]["attn"]["qkv"]["weight"].shape == \
+        ((4 + 2 * 2) * 8, 32)
+    assert m.init_cache(2)["0"]["k"].shape == (2, 2, 24, 8)
+
+    rng = np.random.RandomState(0)
+    buf = jnp.zeros((2, 24), jnp.int32).at[:, :5].set(
+        jnp.asarray(rng.randint(0, 101, (2, 5))))
+    out_u, n_u = m.generate(params, buf, 5, 8)
+    out_c, n_c = m.generate_cached(params, buf, 5, 8)
+    np.testing.assert_array_equal(np.asarray(out_u), np.asarray(out_c))
+    np.testing.assert_array_equal(np.asarray(n_u), np.asarray(n_c))
+
+    out_q, _ = m.generate_cached(params, buf, 5, 8, cache_dtype=jnp.int8)
+    assert out_q.shape == (2, 24)
+
+
+def test_gqa_full_heads_is_mha_parity():
+    """Checkpoint compatibility: the fused qkv slice order is pinned
+    DIRECTLY (a crafted fused tensor with distinguishable q/k/v blocks
+    must split in the documented [q; k; v] order — a layout regression
+    would pass a model-vs-itself comparison), and n_kv_head == n_head
+    accepts the default config's params unchanged.  (The [q; k; v] row
+    order vs real GPT-2 checkpoints is independently pinned by
+    test_gpt2_matches_transformers.)"""
+    from apex_tpu.models import GPT, GPTConfig
+    from apex_tpu.models.gpt import GPTSelfAttention
+
+    kw = dict(vocab_size=97, block_size=16, n_layer=1, n_head=4,
+              n_embd=32, dropout=0.0)
+    attn = GPTSelfAttention(GPTConfig(n_kv_head=2, **kw))
+    H, Hkv, D = 4, 2, 8
+    fused = jnp.concatenate([jnp.full((1, 1, H * D), 1.0),
+                             jnp.full((1, 1, Hkv * D), 2.0),
+                             jnp.full((1, 1, Hkv * D), 3.0)], axis=-1)
+    q, k, v = attn._split_qkv(fused, 1, 1)
+    assert q.shape == (1, H, 1, D) and float(q[0, 0, 0, 0]) == 1.0
+    assert k.shape == (1, Hkv, 1, D) and float(k[0, 0, 0, 0]) == 2.0
+    assert v.shape == (1, Hkv, 1, D) and float(v[0, 0, 0, 0]) == 3.0
+
+    m_def = GPT(GPTConfig(**kw))
+    m_gqa = GPT(GPTConfig(n_kv_head=4, **kw))
+    params, _ = m_def.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (2, 16)))
+    np.testing.assert_array_equal(
+        np.asarray(m_def(params, ids)), np.asarray(m_gqa(params, ids)))
+
+
+def test_gqa_trains():
+    from apex_tpu.models import GPT, GPTConfig
+    """GQA model trains through amp O2 (loss decreases)."""
+    from apex_tpu import amp, optimizers
+    cfg = GPTConfig(vocab_size=64, block_size=16, n_layer=2, n_head=4,
+                    n_embd=32, dropout=0.0, n_kv_head=1)
+    model, opt = amp.initialize(GPT(cfg), optimizers.FusedAdam(lr=3e-3),
+                                opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 16)))
+
+    @jax.jit
+    def step(params, ost):
+        def loss_fn(p):
+            return model.loss(p, ids), ()
+        loss, _, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        params, ost, _ = opt.step(params, ost, g)
+        return params, ost, loss
+
+    losses = [None, None]
+    for i in range(40):
+        params, ost, loss = step(params, ost)
+        if i == 0:
+            losses[0] = float(loss)
+    losses[1] = float(loss)
+    assert losses[1] < losses[0], losses
