@@ -1,0 +1,374 @@
+"""Llama-family causal language model (RMSNorm + RoPE + SwiGLU + GQA).
+
+The reference toolkit predates decoder-only LMs entirely; GPT-2
+(models/gpt.py) covers the learned-position/LayerNorm generation, and
+this module covers the modern generation every serving stack expects:
+RMS pre-normalization, rotary position embeddings, SwiGLU MLPs,
+grouped-query attention with the compact KV cache, and the fused
+chunked LM-head loss (nn.fused_xent).  Output parity against the
+HuggingFace torch implementation — including greedy generation token
+for token — is pinned in tests/test_llama.py; ``utils.hf_interop
+.llama_from_hf`` converts checkpoints.
+
+TPU shape discipline matches GPT: fixed-buffer generation (one compiled
+program for any prompt length), flash attention on the training path
+via dot_product_attention's dispatch, int8 weight/KV-cache quantization
+(apex_tpu.quantization) drops in unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from ..transformer.attention import dot_product_attention
+
+__all__ = ["LlamaConfig", "Llama", "RMSNorm"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=None,
+                 max_position_embeddings=2048, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, tie_word_embeddings=False,
+                 head_chunk=8192):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = (num_key_value_heads
+                                    if num_key_value_heads is not None
+                                    else num_attention_heads)
+        if (self.num_key_value_heads < 1
+                or num_attention_heads % self.num_key_value_heads):
+            raise ValueError(
+                f"num_key_value_heads={self.num_key_value_heads} must be "
+                f"a positive divisor of num_attention_heads="
+                f"{num_attention_heads}")
+        if hidden_size % num_attention_heads:
+            raise ValueError("hidden_size must divide into heads")
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.tie_word_embeddings = tie_word_embeddings
+        self.head_chunk = head_chunk
+
+
+class RMSNorm(nn.Module):
+    """x * rsqrt(mean(x^2) + eps) * w — stats in fp32 (the norm is on
+    amp's fp32 side, like LayerNorm), output in the input dtype."""
+
+    def __init__(self, dim: int, eps: float = 1e-6):
+        super().__init__()
+        self.dim = dim
+        self.eps = eps
+
+    def create_params(self, key):
+        return {"weight": jnp.ones((self.dim,), jnp.float32)}
+
+    def forward(self, p, x):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * lax.rsqrt(var + self.eps)
+        return (y * p["weight"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_cos_sin(pos, head_dim, theta, dtype):
+    """HF-llama convention: inv_freq over the first D/2 dims, cos/sin
+    tiled twice (rotate-half pairing, NOT interleaved)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32)
+                           / head_dim))
+    ang = pos.astype(jnp.float32)[..., None] * inv      # (..., T, D/2)
+    emb = jnp.concatenate([ang, ang], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(q, k, pos, theta):
+    """q: (B, H, T, D), k: (B, Hkv, T, D), pos: (B, T) or (T,)."""
+    cos, sin = _rope_cos_sin(jnp.asarray(pos), q.shape[-1], theta,
+                             jnp.float32)
+    while cos.ndim < q.ndim:                  # -> broadcast over heads
+        cos, sin = cos[..., None, :, :], sin[..., None, :, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        return (xf * cos + _rotate_half(xf) * sin).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.H = cfg.num_attention_heads
+        self.Hkv = cfg.num_key_value_heads
+        self.D = cfg.hidden_size // cfg.num_attention_heads
+        self.theta = cfg.rope_theta
+        E = cfg.hidden_size
+        self.q_proj = nn.Linear(E, self.H * self.D, bias=False)
+        self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
+        self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
+        self.o_proj = nn.Linear(E, E, bias=False)
+
+    def _qkv(self, p, x, B, T):
+        q = self.q_proj(p["q_proj"], x).reshape(B, T, self.H, self.D)
+        k = self.k_proj(p["k_proj"], x).reshape(B, T, self.Hkv, self.D)
+        v = self.v_proj(p["v_proj"], x).reshape(B, T, self.Hkv, self.D)
+        return (jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                jnp.moveaxis(v, 2, 1))
+
+    def forward(self, p, x, mask=None):
+        B, T, E = x.shape
+        q, k, v = self._qkv(p, x, B, T)
+        q, k = apply_rope(q, k, jnp.arange(T), self.theta)
+        if self.Hkv != self.H:
+            rep = self.H // self.Hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        ctx = dot_product_attention(q, k, v, mask, causal=True,
+                                    dropout_rate=0.0)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        return self.o_proj(p["o_proj"], ctx)
+
+    def decode(self, p, x, pos, cache):
+        """One-token step; ``cache`` {"k","v"} (B, Hkv, S, D) (+int8
+        scale sidecars) — RoPE applied at ``pos`` before the write, so
+        cached keys are already rotated (the standard layout)."""
+        B, _, E = x.shape
+        S = cache["k"].shape[2]
+        q, k, v = self._qkv(p, x, B, 1)
+        q, k = apply_rope(q, k, jnp.full((1,), pos), self.theta)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+        q8 = cache["k"].dtype == jnp.int8
+
+        def put(buf, val):
+            return lax.dynamic_update_slice_in_dim(
+                buf, val[:, :, None, :].astype(buf.dtype), pos, axis=2)
+
+        cache = dict(cache)
+        if q8:
+            for name, val in (("k", k), ("v", v)):
+                amax = jnp.max(jnp.abs(val.astype(jnp.float32)), axis=-1,
+                               keepdims=True)
+                scale = jnp.maximum(amax, 1e-12) / 127.0
+                cache[name] = put(cache[name], jnp.clip(
+                    jnp.round(val.astype(jnp.float32) / scale), -127, 127))
+                cache[f"{name}_scale"] = put(cache[f"{name}_scale"], scale)
+            kf = (cache["k"].astype(jnp.float32)
+                  * cache["k_scale"].astype(jnp.float32))
+            vf = (cache["v"].astype(jnp.float32)
+                  * cache["v_scale"].astype(jnp.float32))
+        else:
+            cache["k"] = put(cache["k"], k)
+            cache["v"] = put(cache["v"], v)
+            kf = cache["k"].astype(jnp.float32)
+            vf = cache["v"].astype(jnp.float32)
+        G = self.H // self.Hkv
+        qg = q.reshape(B, self.Hkv, G, self.D)
+        scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32), kf)
+        scores = scores * (1.0 / (self.D ** 0.5))
+        valid = jnp.arange(S)[None, None, None, :] <= pos
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vf).astype(x.dtype)
+        return self.o_proj(p["o_proj"], ctx.reshape(B, 1, E)), cache
+
+
+class LlamaMLP(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(cfg.hidden_size,
+                                   cfg.intermediate_size, bias=False)
+        self.up_proj = nn.Linear(cfg.hidden_size,
+                                 cfg.intermediate_size, bias=False)
+        self.down_proj = nn.Linear(cfg.intermediate_size,
+                                   cfg.hidden_size, bias=False)
+
+    def forward(self, p, x):
+        return self.down_proj(
+            p["down_proj"],
+            F.silu(self.gate_proj(p["gate_proj"], x))
+            * self.up_proj(p["up_proj"], x))
+
+
+class LlamaBlock(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, p, x, mask=None):
+        x = x + self.self_attn(p["self_attn"],
+                               self.input_layernorm(
+                                   p["input_layernorm"], x), mask)
+        return x + self.mlp(p["mlp"], self.post_attention_layernorm(
+            p["post_attention_layernorm"], x))
+
+    def decode(self, p, x, pos, cache):
+        a, cache = self.self_attn.decode(
+            p["self_attn"], self.input_layernorm(p["input_layernorm"], x),
+            pos, cache)
+        x = x + a
+        return x + self.mlp(p["mlp"], self.post_attention_layernorm(
+            p["post_attention_layernorm"], x)), cache
+
+
+class Llama(nn.Module):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.ModuleList(
+            [LlamaBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias=False)
+
+    def _table(self, p):
+        return (p["embed_tokens"]["weight"]
+                if self.cfg.tie_word_embeddings
+                else p["lm_head"]["weight"])
+
+    def _backbone(self, p, input_ids, mask=None):
+        B, T = input_ids.shape
+        if T > self.cfg.max_position_embeddings:
+            raise ValueError(f"sequence length {T} exceeds "
+                             f"max_position_embeddings "
+                             f"{self.cfg.max_position_embeddings}")
+        x = self.embed_tokens(p["embed_tokens"], input_ids)
+        m = None
+        if mask is not None:
+            m = mask[:, None, None, :].astype(bool)
+        for i in range(self.cfg.num_hidden_layers):
+            x = self.layers[i](p["layers"][str(i)], x, m)
+        return self.norm(p["norm"], x)
+
+    def forward(self, p, input_ids, attention_mask=None):
+        x = self._backbone(p, input_ids, attention_mask)
+        table = self._table(p)
+        return F.matmul(x, table.T.astype(x.dtype))
+
+    def loss(self, p, input_ids, attention_mask=None, ignore_index=-100):
+        """Next-token cross-entropy via the fused chunked head
+        (nn.fused_xent) — same contract as GPT.loss."""
+        labels = input_ids[:, 1:]
+        if attention_mask is not None:
+            labels = jnp.where(attention_mask[:, 1:] != 0, labels,
+                               ignore_index)
+        x = self._backbone(p, input_ids, attention_mask)[:, :-1]
+        table = self._table(p)
+        from ..quantization import QTensor
+        if isinstance(table, QTensor):
+            table = table.dequant(x.dtype)
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0)
+        B, T, D = x.shape
+        if self.cfg.head_chunk:
+            from ..nn.fused_xent import linear_cross_entropy
+            nll = linear_cross_entropy(
+                x.reshape(B * T, D), table, safe.reshape(-1),
+                int(self.cfg.head_chunk)).reshape(B, T)
+        else:
+            logits = F.matmul(x, table.T.astype(x.dtype))
+            logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    # -- KV-cached decoding (mirrors GPT's fixed-buffer discipline) -----
+    def init_cache(self, batch_size: int, dtype=jnp.float32):
+        cfg = self.cfg
+        shape = (batch_size, cfg.num_key_value_heads,
+                 cfg.max_position_embeddings,
+                 cfg.hidden_size // cfg.num_attention_heads)
+        layer = {"k": jnp.zeros(shape, dtype),
+                 "v": jnp.zeros(shape, dtype)}
+        if dtype == jnp.int8:
+            sshape = shape[:3] + (1,)
+            layer["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            layer["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return {str(i): dict(layer)
+                for i in range(cfg.num_hidden_layers)}
+
+    def _decode_hidden(self, p, token, pos, cache):
+        """Blocks-only decode step — the LM head is separate so prefill
+        steps can skip the full-vocab matmul (GPT's contract)."""
+        new_cache = {}
+        x = self.embed_tokens(p["embed_tokens"], token[:, None])
+        for i in range(self.cfg.num_hidden_layers):
+            li = str(i)
+            x, new_cache[li] = self.layers[i].decode(
+                p["layers"][li], x, pos, cache[li])
+        return self.norm(p["norm"], x), new_cache
+
+    def decode_step(self, p, token, pos, cache):
+        x, new_cache = self._decode_hidden(p, token, pos, cache)
+        table = self._table(p)
+        return F.matmul(x, table.T.astype(x.dtype))[:, 0], new_cache
+
+    def generate_cached(self, p, input_ids, prompt_len,
+                        max_new_tokens: int, temperature: float = 0.0,
+                        rng: Optional[jax.Array] = None,
+                        cache_dtype=None):
+        """Fixed-buffer KV-cached greedy/sampled generation; one
+        compiled program for any prompt length, prefill steps skipping
+        the full-vocab head via ``lax.cond`` (GPT.generate_cached's
+        contract; token-for-token vs HF greedy in tests)."""
+        B, S = input_ids.shape
+        prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng=")
+        final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+        first_gen = jnp.min(prompt_len)
+        if cache_dtype is None:
+            cache_dtype = self._table(p).dtype
+        cache = self.init_cache(B, dtype=cache_dtype)
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def body(i, carry):
+            ids, cache, key = carry
+            x, cache = self._decode_hidden(p, ids[:, i], i, cache)
+
+            def live(args):
+                x, key = args
+                table = self._table(p)
+                logits = F.matmul(x, table.T.astype(x.dtype))[:, 0]
+                if temperature > 0.0:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub,
+                                                 logits / temperature)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                return nxt.astype(ids.dtype), key
+
+            def prefill(args):
+                _, key = args
+                return jnp.zeros((B,), ids.dtype), key
+
+            nxt, key = lax.cond(i + 1 >= first_gen, live, prefill,
+                                (x, key))
+            should = (i + 1 >= prompt_len) & (i + 1 < final_len)
+            col = jnp.where(should, nxt, ids[:, i + 1])
+            ids = lax.dynamic_update_slice_in_dim(
+                ids, col[:, None], i + 1, axis=1)
+            return ids, cache, key
+
+        ids, _, _ = lax.fori_loop(0, jnp.max(final_len) - 1, body,
+                                  (input_ids, cache, key))
+        return ids, final_len

@@ -1,7 +1,7 @@
 """HuggingFace checkpoint interop: torch state_dicts -> apex_tpu params.
 
 A user switching from the reference stack brings torch-ecosystem
-weights; these converters map ``transformers`` BERT / GPT-2 / ResNet state_dicts
+weights; these converters map ``transformers`` BERT / GPT-2 / Llama / ResNet state_dicts
 onto apex_tpu's param trees, and the tests prove output parity against
 the HF torch implementations themselves (random-init models, so no
 network access is needed — the proof is architectural, and a real
@@ -252,3 +252,70 @@ def resnet_from_hf(hf_model):
 
     return (model, _to_jnp(params),
             jax.tree_util.tree_map(leaf, state))
+
+
+def llama_from_hf(hf_model):
+    """(LlamaConfig, params) for apex_tpu.models.Llama from a
+    transformers LlamaModel / LlamaForCausalLM.  Same-layout renaming
+    (separate q/k/v stay separate; RoPE is positional, no weights);
+    greedy-generation parity is pinned in tests/test_llama.py."""
+    from ..models import LlamaConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "silu") != "silu":
+        raise ValueError(f"unsupported activation {hc.hidden_act!r}")
+    if getattr(hc, "attention_bias", False):
+        raise ValueError("attention_bias=True is not mapped")
+    if getattr(hc, "mlp_bias", False):
+        raise ValueError("mlp_bias=True is not mapped (gate/up/down "
+                         "biases would be silently dropped)")
+    if getattr(hc, "rope_scaling", None):
+        raise ValueError(
+            f"rope_scaling={hc.rope_scaling!r} is not implemented "
+            f"(apex_tpu's RoPE uses unscaled theta frequencies; a "
+            f"Llama-3.1-style scaled checkpoint would convert cleanly "
+            f"but generate silently wrong logits)")
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=hc.num_key_value_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.rms_norm_eps, rope_theta=hc.rope_theta,
+        tie_word_embeddings=hc.tie_word_embeddings)
+    sd = hf_model.state_dict()
+    if "model.embed_tokens.weight" in sd:       # ForCausalLM nesting
+        base = "model."
+    else:
+        base = ""
+
+    def w(name):
+        return {"weight": _t(sd[f"{name}.weight"])}
+
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"{base}layers.{i}"
+        layers[str(i)] = {
+            "input_layernorm": w(f"{b}.input_layernorm"),
+            "self_attn": {k: w(f"{b}.self_attn.{k}")
+                          for k in ("q_proj", "k_proj", "v_proj",
+                                    "o_proj")},
+            "post_attention_layernorm": w(
+                f"{b}.post_attention_layernorm"),
+            "mlp": {k: w(f"{b}.mlp.{k}")
+                    for k in ("gate_proj", "up_proj", "down_proj")},
+        }
+    params = {
+        "embed_tokens": w(f"{base}embed_tokens"),
+        "layers": layers,
+        "norm": w(f"{base}norm"),
+    }
+    if not hc.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"weight": _t(sd["lm_head.weight"])}
+        else:   # bare LlamaModel: head stays at init
+            import numpy as _np
+            params["lm_head"] = {"weight": _np.zeros(
+                (hc.vocab_size, hc.hidden_size), _np.float32)}
+    return cfg, _to_jnp(params)
