@@ -26,6 +26,7 @@ from jax import lax
 
 from .. import nn
 from ..nn import functional as F
+from ..parallel.sync_batchnorm import _axis_in_scope as _sp_in_scope
 from ..transformer.attention import dot_product_attention
 
 __all__ = ["LlamaConfig", "Llama", "RMSNorm"]
@@ -37,7 +38,7 @@ class LlamaConfig:
                  num_attention_heads=32, num_key_value_heads=None,
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
-                 head_chunk=8192):
+                 head_chunk=8192, sp_axis=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -59,6 +60,12 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.tie_word_embeddings = tie_word_embeddings
         self.head_chunk = head_chunk
+        # sequence parallelism: tokens sharded over this mesh axis; the
+        # causal attention runs as ring attention (K/V blocks rotate
+        # over ICI) and RoPE uses GLOBAL positions, so
+        # max_position_embeddings bounds the GLOBAL sequence (the GPT
+        # sp contract, models/gpt.py)
+        self.sp_axis = sp_axis
 
 
 class RMSNorm(nn.Module):
@@ -116,6 +123,7 @@ class LlamaAttention(nn.Module):
         self.Hkv = cfg.num_key_value_heads
         self.D = cfg.hidden_size // cfg.num_attention_heads
         self.theta = cfg.rope_theta
+        self.sp = cfg.sp_axis
         E = cfg.hidden_size
         self.q_proj = nn.Linear(E, self.H * self.D, bias=False)
         self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
@@ -132,13 +140,22 @@ class LlamaAttention(nn.Module):
     def forward(self, p, x, mask=None):
         B, T, E = x.shape
         q, k, v = self._qkv(p, x, B, T)
-        q, k = apply_rope(q, k, jnp.arange(T), self.theta)
+        in_sp = self.sp is not None and _sp_in_scope(self.sp)
+        pos = jnp.arange(T)
+        if in_sp:
+            # GLOBAL positions for this device's token shard
+            pos = lax.axis_index(self.sp) * T + pos
+        q, k = apply_rope(q, k, pos, self.theta)
         if self.Hkv != self.H:
             rep = self.H // self.Hkv
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        ctx = dot_product_attention(q, k, v, mask, causal=True,
-                                    dropout_rate=0.0)
+        if in_sp:
+            from ..transformer.ring_attention import ring_attention
+            ctx = ring_attention(q, k, v, axis_name=self.sp, causal=True)
+        else:
+            ctx = dot_product_attention(q, k, v, mask, causal=True,
+                                        dropout_rate=0.0)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.o_proj(p["o_proj"], ctx)
 
@@ -247,7 +264,18 @@ class Llama(nn.Module):
 
     def _backbone(self, p, input_ids, mask=None):
         B, T = input_ids.shape
-        if T > self.cfg.max_position_embeddings:
+        sp = self.cfg.sp_axis
+        if sp is not None and _sp_in_scope(sp):
+            if mask is not None:
+                raise NotImplementedError(
+                    "attention_mask under sequence parallelism is not "
+                    "wired; pack/pad outside the sp axis instead")
+            if T * lax.axis_size(sp) > self.cfg.max_position_embeddings:
+                raise ValueError(
+                    f"global sequence {T}x{lax.axis_size(sp)} exceeds "
+                    f"max_position_embeddings "
+                    f"{self.cfg.max_position_embeddings}")
+        elif T > self.cfg.max_position_embeddings:
             raise ValueError(f"sequence length {T} exceeds "
                              f"max_position_embeddings "
                              f"{self.cfg.max_position_embeddings}")
@@ -266,30 +294,58 @@ class Llama(nn.Module):
 
     def loss(self, p, input_ids, attention_mask=None, ignore_index=-100):
         """Next-token cross-entropy via the fused chunked head
-        (nn.fused_xent) — same contract as GPT.loss."""
+        (nn.fused_xent) — same contract as GPT.loss, including the
+        cross-shard label shift under ``sp_axis``."""
+        sp = self.cfg.sp_axis
+        if sp is not None and _sp_in_scope(sp):
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "attention_mask under sequence parallelism is not "
+                    "wired; pack/pad outside the sp axis instead")
+            B, T = input_ids.shape
+            spn = lax.axis_size(sp)
+            idx = lax.axis_index(sp)
+            x = self._backbone(p, input_ids)
+            nxt_first = lax.ppermute(
+                input_ids[:, :1], sp,
+                [(i, (i - 1) % spn) for i in range(spn)])
+            labels = jnp.concatenate([input_ids[:, 1:], nxt_first], 1)
+            is_last = (idx == spn - 1)
+            labels = labels.at[:, -1].set(
+                jnp.where(is_last, ignore_index, labels[:, -1]))
+            valid = labels != ignore_index
+            safe = jnp.where(valid, labels, 0)
+            nll = self._nll(p, x, safe)
+            num = lax.psum(jnp.sum(nll * valid), sp)
+            den = lax.psum(jnp.sum(valid.astype(jnp.float32)), sp)
+            return num / jnp.maximum(den, 1.0)
         labels = input_ids[:, 1:]
         if attention_mask is not None:
             labels = jnp.where(attention_mask[:, 1:] != 0, labels,
                                ignore_index)
         x = self._backbone(p, input_ids, attention_mask)[:, :-1]
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0)
+        nll = self._nll(p, x, safe)
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def _nll(self, p, x, safe_labels):
+        """Per-position nll (B, T') through the head — fused chunked
+        path by default (GPT._head_nll's contract)."""
         table = self._table(p)
         from ..quantization import QTensor
         if isinstance(table, QTensor):
             table = table.dequant(x.dtype)
-        valid = labels != ignore_index
-        safe = jnp.where(valid, labels, 0)
         B, T, D = x.shape
         if self.cfg.head_chunk:
             from ..nn.fused_xent import linear_cross_entropy
-            nll = linear_cross_entropy(
-                x.reshape(B * T, D), table, safe.reshape(-1),
+            return linear_cross_entropy(
+                x.reshape(B * T, D), table, safe_labels.reshape(-1),
                 int(self.cfg.head_chunk)).reshape(B, T)
-        else:
-            logits = F.matmul(x, table.T.astype(x.dtype))
-            logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
-            nll = -jnp.take_along_axis(logp, safe[..., None],
-                                       axis=-1)[..., 0]
-        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        logits = F.matmul(x, table.T.astype(x.dtype))
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, safe_labels[..., None],
+                                    axis=-1)[..., 0]
 
     # -- KV-cached decoding (mirrors GPT's fixed-buffer discipline) -----
     def init_cache(self, batch_size: int, dtype=jnp.float32):
