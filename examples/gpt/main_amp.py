@@ -31,6 +31,13 @@ _BUILTIN_TEXT = ("the quick brown fox jumps over the lazy dog. " * 200)
 
 def parse_args():
     p = argparse.ArgumentParser(description="apex_tpu GPT training")
+    p.add_argument("--arch", default="gpt", choices=["gpt", "llama"],
+                   help="decoder family: GPT-2 (LayerNorm + learned "
+                        "positions) or Llama (RMSNorm + RoPE + SwiGLU "
+                        "+ GQA)")
+    p.add_argument("--n-kv-head", type=int, default=None,
+                   help="grouped-query attention KV heads (llama; "
+                        "default MHA)")
     p.add_argument("--config", default="tiny",
                    choices=["tiny", "small", "medium"])
     p.add_argument("-b", "--batch-size", type=int, default=8,
@@ -81,11 +88,24 @@ def main():
     if args.block_size:
         shapes["block_size"] = args.block_size
     T = shapes["block_size"]
-    cfg = models.GPTConfig(vocab_size=max(len(vocab), 2), dropout=0.0,
-                           **shapes)
+    if args.arch == "llama":
+        cfg = models.LlamaConfig(
+            vocab_size=max(len(vocab), 2),
+            hidden_size=shapes["n_embd"],
+            intermediate_size=4 * shapes["n_embd"],
+            num_hidden_layers=shapes["n_layer"],
+            num_attention_heads=shapes["n_head"],
+            num_key_value_heads=args.n_kv_head,
+            max_position_embeddings=T, tie_word_embeddings=True)
+        net = models.Llama(cfg)
+    else:
+        cfg = models.GPTConfig(vocab_size=max(len(vocab), 2),
+                               dropout=0.0, n_kv_head=args.n_kv_head,
+                               **shapes)
+        net = models.GPT(cfg)
 
     model, optimizer = amp.initialize(
-        models.GPT(cfg), optimizers.FusedAdam(lr=args.lr),
+        net, optimizers.FusedAdam(lr=args.lr),
         opt_level=args.opt_level, verbosity=0)
     ddp = parallel.DistributedDataParallel(model)
     params, _ = model.init(jax.random.PRNGKey(args.seed))

@@ -154,3 +154,13 @@ def test_imagenet_resume_conv7_into_s2d_stem(tmp_path):
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-2000:])
     assert "converting" in r.stdout and "resumed from epoch 1" in r.stdout, \
         r.stdout[-800:]
+
+
+@pytest.mark.slow
+def test_llama_example_smoke():
+    r = _run(["examples/gpt/main_amp.py", "--arch", "llama",
+              "--config", "tiny", "-b", "2", "--block-size", "32",
+              "--iters", "2", "--print-freq", "1", "--n-kv-head", "2",
+              "--generate", "8"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sample:" in r.stdout, r.stdout[-500:]
