@@ -38,7 +38,7 @@ class LlamaConfig:
                  num_attention_heads=32, num_key_value_heads=None,
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
-                 head_chunk=8192, sp_axis=None):
+                 head_chunk=8192, sp_axis=None, tp_axis=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -66,6 +66,15 @@ class LlamaConfig:
         # max_position_embeddings bounds the GLOBAL sequence (the GPT
         # sp contract, models/gpt.py)
         self.sp_axis = sp_axis
+        # tensor parallelism: Megatron attention/MLP sharding over this
+        # axis (parallel.ParallelSelfAttention with num_kv_heads +
+        # rope_theta; SwiGLU as column/column/row).  Embeddings, norms,
+        # and the LM head stay replicated — the row-parallel psum leaves
+        # x replicated, so the fused head loss is unchanged.
+        self.tp_axis = tp_axis
+        if tp_axis is not None and sp_axis is not None:
+            raise NotImplementedError(
+                "combined tp+sp Llama is not wired; pick one")
 
 
 class RMSNorm(nn.Module):
@@ -124,11 +133,19 @@ class LlamaAttention(nn.Module):
         self.D = cfg.hidden_size // cfg.num_attention_heads
         self.theta = cfg.rope_theta
         self.sp = cfg.sp_axis
+        self.tp = cfg.tp_axis is not None
         E = cfg.hidden_size
-        self.q_proj = nn.Linear(E, self.H * self.D, bias=False)
-        self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
-        self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
-        self.o_proj = nn.Linear(E, E, bias=False)
+        if self.tp:
+            from ..parallel.tensor_parallel import ParallelSelfAttention
+            self.core = ParallelSelfAttention(
+                E, self.H, bias=False, causal=True,
+                axis_name=cfg.tp_axis, num_kv_heads=self.Hkv,
+                rope_theta=cfg.rope_theta)
+        else:
+            self.q_proj = nn.Linear(E, self.H * self.D, bias=False)
+            self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
+            self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
+            self.o_proj = nn.Linear(E, E, bias=False)
 
     def _qkv(self, p, x, B, T):
         q = self.q_proj(p["q_proj"], x).reshape(B, T, self.H, self.D)
@@ -139,6 +156,8 @@ class LlamaAttention(nn.Module):
 
     def forward(self, p, x, mask=None):
         B, T, E = x.shape
+        if self.tp:
+            return self.core(p["core"], x, mask)
         q, k, v = self._qkv(p, x, B, T)
         in_sp = self.sp is not None and _sp_in_scope(self.sp)
         pos = jnp.arange(T)
@@ -163,6 +182,10 @@ class LlamaAttention(nn.Module):
         """One-token step; ``cache`` {"k","v"} (B, Hkv, S, D) (+int8
         scale sidecars) — RoPE applied at ``pos`` before the write, so
         cached keys are already rotated (the standard layout)."""
+        if self.tp:
+            raise NotImplementedError(
+                "KV-cache decode is single-device; run the TP model "
+                "through forward() or shard the batch instead")
         B, _, E = x.shape
         S = cache["k"].shape[2]
         q, k, v = self._qkv(p, x, B, 1)
@@ -206,14 +229,33 @@ class LlamaAttention(nn.Module):
 class LlamaMLP(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
-        self.gate_proj = nn.Linear(cfg.hidden_size,
-                                   cfg.intermediate_size, bias=False)
-        self.up_proj = nn.Linear(cfg.hidden_size,
-                                 cfg.intermediate_size, bias=False)
-        self.down_proj = nn.Linear(cfg.intermediate_size,
-                                   cfg.hidden_size, bias=False)
+        self.tp_axis = cfg.tp_axis
+        if cfg.tp_axis is not None:
+            from ..parallel.tensor_parallel import (ColumnParallelLinear,
+                                                    RowParallelLinear)
+            # SwiGLU Megatron-style: gate/up column-parallel (one f at
+            # entry, shared by both), down row-parallel (one psum)
+            self.gate_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size, bias=False,
+                input_grad_reduce=False, axis_name=cfg.tp_axis)
+            self.up_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size, bias=False,
+                input_grad_reduce=False, axis_name=cfg.tp_axis)
+            self.down_proj = RowParallelLinear(
+                cfg.intermediate_size, cfg.hidden_size, bias=False,
+                axis_name=cfg.tp_axis)
+        else:
+            self.gate_proj = nn.Linear(cfg.hidden_size,
+                                       cfg.intermediate_size, bias=False)
+            self.up_proj = nn.Linear(cfg.hidden_size,
+                                     cfg.intermediate_size, bias=False)
+            self.down_proj = nn.Linear(cfg.intermediate_size,
+                                       cfg.hidden_size, bias=False)
 
     def forward(self, p, x):
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import copy_to_model_parallel
+            x = copy_to_model_parallel(x, self.tp_axis)
         return self.down_proj(
             p["down_proj"],
             F.silu(self.gate_proj(p["gate_proj"], x))

@@ -263,32 +263,50 @@ class ParallelSelfAttention(Module):
     attention block, the Megatron communication pattern.
 
     ``num_heads`` must divide by the axis size at run time.
+
+    ``num_kv_heads < num_heads`` (GQA) shards the compact K/V
+    projections over the same axis (``num_kv_heads % tp == 0``) and
+    repeats them per local query-head group; ``rope_theta`` applies
+    rotary position embeddings to q/k before attention (position-only,
+    so head sharding is transparent) — together these are the Llama
+    tensor-parallel block.
     """
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = True, causal: bool = False,
                  attn_dropout: float = 0.0,
-                 axis_name: str = DEFAULT_AXIS):
+                 axis_name: str = DEFAULT_AXIS,
+                 num_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None):
         super().__init__()
         if embed_dim % num_heads:
             raise ValueError(f"num_heads ({num_heads}) must divide "
                              f"embed_dim ({embed_dim})")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
+        self.num_kv_heads = (num_kv_heads if num_kv_heads is not None
+                             else num_heads)
+        if (self.num_kv_heads < 1
+                or num_heads % self.num_kv_heads):
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} must be a positive "
+                f"divisor of num_heads={num_heads}")
         self.head_dim = embed_dim // num_heads
+        self.rope_theta = rope_theta
         self.causal = causal
         self.dropout_rate = dropout
         self.attn_dropout = attn_dropout    # attention-probs dropout
         self.axis_name = axis_name
+        kv_dim = self.num_kv_heads * self.head_dim
         # one f at block entry instead of three: x feeds all three
         # projections, so input_grad_reduce is applied once in forward
         self.q = ColumnParallelLinear(embed_dim, embed_dim, bias=bias,
                                       input_grad_reduce=False,
                                       axis_name=axis_name)
-        self.k = ColumnParallelLinear(embed_dim, embed_dim, bias=bias,
+        self.k = ColumnParallelLinear(embed_dim, kv_dim, bias=bias,
                                       input_grad_reduce=False,
                                       axis_name=axis_name)
-        self.v = ColumnParallelLinear(embed_dim, embed_dim, bias=bias,
+        self.v = ColumnParallelLinear(embed_dim, kv_dim, bias=bias,
                                       input_grad_reduce=False,
                                       axis_name=axis_name)
         self.out = RowParallelLinear(embed_dim, embed_dim, bias=bias,
@@ -299,14 +317,22 @@ class ParallelSelfAttention(Module):
         x = copy_to_model_parallel(x, self.axis_name)
         B, T, _ = x.shape
         tp = _axis_size(self.axis_name)
-        if self.num_heads % tp:
-            raise ValueError(f"num_heads={self.num_heads} not divisible "
-                             f"by tensor-parallel size {tp}")
+        if self.num_heads % tp or self.num_kv_heads % tp:
+            raise ValueError(f"num_heads={self.num_heads} / num_kv_heads="
+                             f"{self.num_kv_heads} not divisible by "
+                             f"tensor-parallel size {tp}")
         h_local = self.num_heads // tp
+        kv_local = self.num_kv_heads // tp
         q = self.q(params["q"], x).reshape(B, T, h_local, self.head_dim)
-        k = self.k(params["k"], x).reshape(B, T, h_local, self.head_dim)
-        v = self.v(params["v"], x).reshape(B, T, h_local, self.head_dim)
+        k = self.k(params["k"], x).reshape(B, T, kv_local, self.head_dim)
+        v = self.v(params["v"], x).reshape(B, T, kv_local, self.head_dim)
         q, k, v = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        if self.rope_theta is not None:
+            from ..models.llama import apply_rope
+            q, k = apply_rope(q, k, jnp.arange(T), self.rope_theta)
+        if kv_local != h_local:
+            k = jnp.repeat(k, h_local // kv_local, axis=1)
+            v = jnp.repeat(v, h_local // kv_local, axis=1)
         if (mask is not None and mask.ndim == 4
                 and mask.shape[1] == self.num_heads and tp > 1):
             # per-head mask: take this device's head block, like the
