@@ -7,4 +7,4 @@ from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
                    bert_large)
 from .dcgan import Generator, Discriminator, dcgan
 from .gpt import GPTConfig, GPT, gpt2_small, gpt2_medium
-from .llama import LlamaConfig, Llama, RMSNorm
+from .llama import LlamaConfig, Llama, RMSNorm, llama_params_to_tp

@@ -470,3 +470,31 @@ class Llama(nn.Module):
         ids, _, _ = lax.fori_loop(0, jnp.max(final_len) - 1, body,
                                   (input_ids, cache, key))
         return ids, final_len
+
+
+def llama_params_to_tp(params):
+    """Rename a non-TP Llama param tree to the ``tp_axis`` structure.
+
+    Under ``tp_axis`` attention is implemented by
+    ``parallel.tensor_parallel.ParallelSelfAttention``, whose param tree
+    is ``self_attn.core.{q,k,v,out}`` rather than the HF-style
+    ``self_attn.{q_proj,k_proj,v_proj,o_proj}``; the MLP keeps its
+    names (only the sharding layout changes).  Use this to feed
+    ``utils.hf_interop.llama_from_hf`` output — or any checkpoint
+    trained without tp_axis — into ``Llama(LlamaConfig(tp_axis=...))``.
+    Weights stay full-size; sharding is applied by
+    ``parallel.tensor_parallel.partition_specs`` + shard_map.
+    """
+    out = dict(params)
+    out["layers"] = {}
+    for i, blk in params["layers"].items():
+        blk = dict(blk)
+        at = blk.pop("self_attn")
+        blk["self_attn"] = {"core": {
+            "q": {"weight": at["q_proj"]["weight"]},
+            "k": {"weight": at["k_proj"]["weight"]},
+            "v": {"weight": at["v_proj"]["weight"]},
+            "out": {"weight": at["o_proj"]["weight"]},
+        }}
+        out["layers"][i] = blk
+    return out

@@ -162,3 +162,28 @@ def test_gpt_o2_memorizes_through_fused_head():
         if first is None:
             first = float(loss)
     assert float(loss) < 0.15, (first, float(loss))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_shapes_and_chunks(seed):
+    """Kernel-fuzz discipline (reference: test_multi_tensor_scale's
+    size sweep): random (N, D, V, chunk) incl. chunk > V, chunk == V,
+    ragged tails, single-row N — fwd and grads match dense."""
+    rng = np.random.RandomState(seed)
+    N = int(rng.randint(1, 40))
+    D = int(rng.choice([8, 24, 64]))
+    V = int(rng.randint(3, 600))
+    chunk = int(rng.choice([1, 7, 64, V, V + 13, 4096]))
+    h = jnp.asarray(rng.randn(N, D), jnp.float32)
+    W = jnp.asarray(rng.randn(V, D) * 0.1, jnp.float32)
+    y = jnp.asarray(rng.randint(0, V, N), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(linear_cross_entropy(h, W, y, chunk)),
+        np.asarray(_dense_nll(h, W, y)), rtol=2e-5, atol=2e-5)
+    gd = jax.grad(lambda h, W: _dense_nll(h, W, y).sum(),
+                  argnums=(0, 1))(h, W)
+    gf = jax.grad(lambda h, W: linear_cross_entropy(h, W, y, chunk).sum(),
+                  argnums=(0, 1))(h, W)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)

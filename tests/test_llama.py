@@ -158,10 +158,13 @@ def test_llama_sequence_parallel_matches_unmapped():
 
 def test_llama_tensor_parallel_matches_unmapped():
     """tp_axis: Megatron attention (GQA + RoPE shards) + SwiGLU
-    column/column/row — logits and loss match the unmapped model on the
-    same params (shards sliced from the replicated tree)."""
+    column/column/row — logits, loss, AND loss grads match the unmapped
+    model on the same params (shards sliced from the replicated tree).
+    Grads matter: the f/g collectives are identity in forward, so only
+    the gradient check exercises their backward psums."""
     from jax.sharding import Mesh, PartitionSpec as P
     from apex_tpu.parallel import tensor_parallel as tpmod
+    from apex_tpu.models import llama_params_to_tp
 
     kw = dict(vocab_size=97, hidden_size=32, intermediate_size=64,
               num_hidden_layers=2, num_attention_heads=4,
@@ -171,28 +174,8 @@ def test_llama_tensor_parallel_matches_unmapped():
     m_tp = Llama(LlamaConfig(tp_axis="model", **kw))
     params, _ = m_ref.init(jax.random.PRNGKey(0))
 
-    # map the unsharded tree onto the tp structure: q/k/v/o -> core,
-    # gate/up/down keep names but column/row layouts
-    def to_tp(lp):
-        out = {"embed_tokens": lp["embed_tokens"], "norm": lp["norm"],
-               "layers": {}}
-        for i, blk in lp["layers"].items():
-            at = blk["self_attn"]
-            out["layers"][i] = {
-                "input_layernorm": blk["input_layernorm"],
-                "post_attention_layernorm":
-                    blk["post_attention_layernorm"],
-                "self_attn": {"core": {
-                    "q": {"weight": at["q_proj"]["weight"]},
-                    "k": {"weight": at["k_proj"]["weight"]},
-                    "v": {"weight": at["v_proj"]["weight"]},
-                    "out": {"weight": at["o_proj"]["weight"]},
-                }},
-                "mlp": blk["mlp"],
-            }
-        return out
-
-    tp_params = to_tp(params)
+    # library remap: q/k/v/o -> core, mlp keeps names (layouts change)
+    tp_params = llama_params_to_tp(params)
     mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
     specs = tpmod.partition_specs(m_tp, params=tp_params)
     ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (2, 16)))
@@ -207,3 +190,21 @@ def test_llama_tensor_parallel_matches_unmapped():
     out_ref = np.asarray(m_ref(params, ids))
     np.testing.assert_allclose(np.asarray(out_tp), out_ref,
                                rtol=2e-4, atol=2e-4)
+
+    # grads: gathered TP grads (out_specs=specs reassembles the column/
+    # row shards) == unmapped grads remapped onto the tp structure
+    def tp_grad(p, i):
+        return jax.grad(lambda pp: m_tp.loss(pp, i))(p)
+
+    g_tp = jax.jit(jax.shard_map(
+        tp_grad, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+        check_vma=False))(tp_params, ids)
+    g_ref = llama_params_to_tp(
+        jax.grad(lambda pp: m_ref.loss(pp, ids))(params))
+    lt, lr = (jax.tree_util.tree_leaves_with_path(g_tp),
+              jax.tree_util.tree_leaves_with_path(g_ref))
+    assert [k for k, _ in lt] == [k for k, _ in lr]
+    for (path, a), (_, b) in zip(lt, lr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=jax.tree_util.keystr(path))
