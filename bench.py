@@ -291,9 +291,9 @@ def main():
              vs_baseline=None)
 
     def gpt_config(metric, cfg, batch_per_chip, seqlen, iters, warmup,
-                   steps_per_call=1):
+                   steps_per_call=1, model_cls=None):
         model, optimizer = amp.initialize(
-            models.GPT(cfg), optimizers.FusedAdam(lr=1e-4),
+            (model_cls or models.GPT)(cfg), optimizers.FusedAdam(lr=1e-4),
             opt_level="O2", verbosity=0)
         ddp = parallel.DistributedDataParallel(model)
         params, _ = model.init(jax.random.PRNGKey(0))
@@ -325,12 +325,13 @@ def main():
              vs_baseline=None)
 
     def gpt_decode_config(metric, cfg, batch, prompt, new_tokens,
-                          int8_weights=False, int8_cache=False):
+                          int8_weights=False, int8_cache=False,
+                          model_cls=None):
         """KV-cached generation throughput (tokens/sec/chip) — the
         serving path: static cache buffers, one compiled program.
         ``int8_weights``: weight-only int8 (quantization module) — the
         HBM-bandwidth lever for the memory-bound decode loop."""
-        model = models.GPT(cfg)
+        model = (model_cls or models.GPT)(cfg)
         params, _ = model.init(jax.random.PRNGKey(0))
         params = jax.tree_util.tree_map(
             lambda x: x.astype(jnp.bfloat16)
@@ -339,7 +340,9 @@ def main():
             from apex_tpu import quantization
             params = quantization.quantize_for_decode(params)
         rng = np.random.RandomState(0)
-        buf = np.zeros((batch, cfg.block_size), np.int32)
+        ctx = getattr(cfg, "block_size", None) \
+            or cfg.max_position_embeddings
+        buf = np.zeros((batch, ctx), np.int32)
         buf[:, :prompt] = rng.randint(0, cfg.vocab_size, (batch, prompt))
         ids = jnp.asarray(buf)
 
@@ -498,6 +501,29 @@ def main():
                                   vocab_size=50257, block_size=4096,
                                   dropout=0.0),
                  1, 4096, 6, 2)),
+            # Llama family: GQA (4 kv-heads) at GPT-2-small scale —
+            # records the RMSNorm/RoPE/SwiGLU train path and the
+            # compact-GQA-cache decode path on hardware
+            ("llama_gqa_o2_train_throughput",
+             lambda: gpt_config(
+                 "llama_gqa_o2_train_throughput",
+                 models.LlamaConfig(
+                     vocab_size=32000, hidden_size=768,
+                     intermediate_size=2048, num_hidden_layers=12,
+                     num_attention_heads=12, num_key_value_heads=4,
+                     max_position_embeddings=512,
+                     tie_word_embeddings=True),
+                 8, 512, 8, 2, model_cls=models.Llama)),
+            ("llama_gqa_decode_throughput",
+             lambda: gpt_decode_config(
+                 "llama_gqa_decode_throughput",
+                 models.LlamaConfig(
+                     vocab_size=32000, hidden_size=768,
+                     intermediate_size=2048, num_hidden_layers=12,
+                     num_attention_heads=12, num_key_value_heads=4,
+                     max_position_embeddings=512,
+                     tie_word_embeddings=True),
+                 8, 64, 128, model_cls=models.Llama)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet50_amp_o2_ddp_nhwc_train_throughput",
@@ -541,6 +567,16 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 8)),
+            ("llama_tiny_gqa_decode_throughput",
+             lambda: gpt_decode_config(
+                 "llama_tiny_gqa_decode_throughput",
+                 models.LlamaConfig(
+                     vocab_size=128, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=16,
+                     tie_word_embeddings=True),
+                 2, 4, 8, model_cls=models.Llama)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet18_amp_o2_ddp_scan2_train_throughput",
