@@ -8,3 +8,4 @@ from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
 from .dcgan import Generator, Discriminator, dcgan
 from .gpt import GPTConfig, GPT, gpt2_small, gpt2_medium
 from .llama import LlamaConfig, Llama, RMSNorm, llama_params_to_tp
+from .mixtral import MixtralConfig, Mixtral

@@ -288,12 +288,14 @@ class LlamaBlock(nn.Module):
 
 
 class Llama(nn.Module):
+    block_cls = LlamaBlock      # hook for MoE (Mixtral) variants
+
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
         self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.layers = nn.ModuleList(
-            [LlamaBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+            [self.block_cls(cfg) for _ in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
@@ -325,12 +327,19 @@ class Llama(nn.Module):
         m = None
         if mask is not None:
             m = mask[:, None, None, :].astype(bool)
+        aux = 0.0
         for i in range(self.cfg.num_hidden_layers):
-            x = self.layers[i](p["layers"][str(i)], x, m)
-        return self.norm(p["norm"], x)
+            out = self.layers[i](p["layers"][str(i)], x, m)
+            if isinstance(out, tuple):      # MoE block: (x, aux loss)
+                x, a = out
+                aux = aux + a
+            else:
+                x = out
+        return (self.norm(p["norm"], x),
+                aux / self.cfg.num_hidden_layers)
 
     def forward(self, p, input_ids, attention_mask=None):
-        x = self._backbone(p, input_ids, attention_mask)
+        x, _ = self._backbone(p, input_ids, attention_mask)
         table = self._table(p)
         return F.matmul(x, table.T.astype(x.dtype))
 
@@ -347,7 +356,7 @@ class Llama(nn.Module):
             B, T = input_ids.shape
             spn = lax.axis_size(sp)
             idx = lax.axis_index(sp)
-            x = self._backbone(p, input_ids)
+            x, aux = self._backbone(p, input_ids)
             nxt_first = lax.ppermute(
                 input_ids[:, :1], sp,
                 [(i, (i - 1) % spn) for i in range(spn)])
@@ -360,16 +369,27 @@ class Llama(nn.Module):
             nll = self._nll(p, x, safe)
             num = lax.psum(jnp.sum(nll * valid), sp)
             den = lax.psum(jnp.sum(valid.astype(jnp.float32)), sp)
-            return num / jnp.maximum(den, 1.0)
+            return num / jnp.maximum(den, 1.0) + self._aux_term(aux, sp)
         labels = input_ids[:, 1:]
         if attention_mask is not None:
             labels = jnp.where(attention_mask[:, 1:] != 0, labels,
                                ignore_index)
-        x = self._backbone(p, input_ids, attention_mask)[:, :-1]
+        x, aux = self._backbone(p, input_ids, attention_mask)
+        x = x[:, :-1]
         valid = labels != ignore_index
         safe = jnp.where(valid, labels, 0)
         nll = self._nll(p, x, safe)
-        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        return (jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+                + self._aux_term(aux, None))
+
+    def _aux_term(self, aux, sp):
+        """Router load-balance contribution; 0 for dense families."""
+        coef = getattr(self.cfg, "router_aux_loss_coef", 0.0)
+        if not coef:
+            return 0.0
+        if sp is not None:
+            aux = lax.pmean(aux, sp)
+        return coef * aux
 
     def _nll(self, p, x, safe_labels):
         """Per-position nll (B, T') through the head — fused chunked

@@ -1,0 +1,74 @@
+"""Mixtral-style sparse-MoE decoder: the Llama backbone with each
+dense SwiGLU MLP replaced by a top-2-routed bank of SwiGLU experts.
+
+The reference toolkit predates MoE entirely (SURVEY.md §2.3 lists EP as
+absent); this family completes the beyond-reference parallelism story
+at the model level: attention is Llama's GQA+RoPE stack
+(models/llama.py), the FFN is ``parallel.expert_parallel
+.ExpertParallelMLP`` (GShard dispatch, two all_to_alls per layer when
+an ``ep_axis`` mesh axis is in scope), and the router's load-balancing
+auxiliary loss (Switch eq. 4) rides ``loss`` with
+``router_aux_loss_coef`` — HF Mixtral's config names are kept so a
+checkpoint converter can map 1:1.
+
+Decoding inherits Llama's fixed-buffer KV-cached loop unchanged: the
+MoE runs its normal forward on the (B, 1, hidden) decode slice (top-2
+of B tokens, capacity ceil(cf*B/E)).
+
+Training with expert parallelism shards tokens AND experts over the
+same mesh axis (DeepSpeed-MoE style); expert-sharded grads stay local
+while everything else is data-parallel — use
+``expert_parallel.allreduce_replicated_grads`` (or
+``partition_specs``-aware state specs) instead of a blanket psum.
+"""
+
+from __future__ import annotations
+
+from ..nn import module as nn
+from ..parallel.expert_parallel import ExpertParallelMLP
+from .llama import Llama, LlamaBlock, LlamaConfig
+
+__all__ = ["MixtralConfig", "Mixtral"]
+
+
+class MixtralConfig(LlamaConfig):
+    def __init__(self, num_local_experts=8, num_experts_per_tok=2,
+                 router_aux_loss_coef=0.02, capacity_factor=2.0,
+                 ep_axis=None, **kw):
+        super().__init__(**kw)
+        if self.tp_axis is not None:
+            raise NotImplementedError(
+                "Mixtral composes MoE with dp/sp/ep; tensor parallelism "
+                "inside experts is not wired — shard experts (ep_axis) "
+                "instead")
+        self.num_local_experts = num_local_experts
+        self.num_experts_per_tok = num_experts_per_tok
+        self.router_aux_loss_coef = router_aux_loss_coef
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis
+
+
+class MixtralBlock(LlamaBlock):
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__(cfg)
+        self.mlp = ExpertParallelMLP(
+            cfg.hidden_size, cfg.intermediate_size,
+            cfg.num_local_experts,
+            capacity_factor=cfg.capacity_factor,
+            top_k=cfg.num_experts_per_tok,
+            expert_type="swiglu",
+            axis_name=cfg.ep_axis or "expert")
+
+    def forward(self, p, x, mask=None):
+        x = x + self.self_attn(p["self_attn"],
+                               self.input_layernorm(
+                                   p["input_layernorm"], x), mask)
+        h, aux = self.mlp(p["mlp"], self.post_attention_layernorm(
+            p["post_attention_layernorm"], x), return_aux_loss=True)
+        return x + h, aux
+    # decode() inherits: ExpertParallelMLP's default forward returns
+    # just the output, matching LlamaBlock.decode's self.mlp(...) call
+
+
+class Mixtral(Llama):
+    block_cls = MixtralBlock

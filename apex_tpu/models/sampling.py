@@ -1,0 +1,61 @@
+"""Sampling strategies for the generate paths: temperature, top-k,
+nucleus (top-p) — jit-safe (static shapes, no data-dependent control
+flow), shared by GPT / Llama / Mixtral ``generate*``.
+
+The reference toolkit has no generation story (2019, pre-LLM serving);
+this follows the de-facto HF ``generate`` semantics so converted
+checkpoints sample comparably: logits are scaled by ``1/temperature``
+FIRST, then top-k keeps the k best, then top-p keeps the smallest
+prefix of the sorted distribution whose mass reaches ``top_p`` (the
+best token always survives every filter).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["filter_logits", "sample_token"]
+
+
+def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Mask (-inf) every vocab entry of ``logits (..., V)`` that falls
+    outside the top-k set and/or the top-p nucleus."""
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sl, axis=-1)
+        # keep while the mass BEFORE this token is < top_p: the argmax
+        # always survives, and the kept prefix is the smallest one
+        # reaching top_p
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
+def sample_token(key: jax.Array, logits: jax.Array,
+                 temperature: float = 1.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jax.Array:
+    """One token id per row of ``logits (..., V)``.
+
+    ``temperature == 0`` (a static python float) is greedy argmax —
+    ``key`` may be anything; otherwise scaled + filtered categorical.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / temperature
+    return jax.random.categorical(
+        key, filter_logits(scaled, top_k=top_k, top_p=top_p))

@@ -19,9 +19,12 @@ Communication per layer: two all_to_alls (forward) — their transposes
 are all_to_alls again, so backward needs no f/g correction the way
 psum-based TP does.
 
-Router: top-1 (Switch).  The auxiliary load-balancing loss
-(Switch eq. 4: E * sum_e f_e * P_e) is returned by ``forward`` when
-``return_aux_loss`` — add ``aux_weight * aux`` to the task loss.
+Router: top-1 (Switch) by default; ``top_k=2`` with
+``expert_type="swiglu"`` gives the Mixtral shape (renormalized gate
+weights, SwiGLU experts).  The auxiliary load-balancing loss
+(Switch eq. 4: E * sum_e f_e * P_e, fraction counted over all k
+assignments) is returned by ``forward`` when ``return_aux_loss`` — add
+``aux_weight * aux`` to the task loss.
 """
 
 from __future__ import annotations
@@ -38,47 +41,71 @@ from ..nn.module import Module
 from ..nn import functional as F
 from .sync_batchnorm import _axis_in_scope
 
-__all__ = ["ExpertParallelMLP"]
+__all__ = ["ExpertParallelMLP", "allreduce_replicated_grads"]
 
 DEFAULT_AXIS = "expert"
 
 
 class ExpertParallelMLP(Module):
-    """Top-1 routed MoE MLP; experts sharded over ``axis_name``.
+    """Top-k routed MoE MLP; experts sharded over ``axis_name``.
 
     Params: ``router`` (d, E) replicated; ``w_in`` (E, d, hidden) and
     ``w_out`` (E, hidden, d) sharded on the expert dim (see
-    ``param_specs``).  Call inside shard_map with tokens sharded over
-    the same axis; outside any mesh all experts run locally.
+    ``param_specs``); gated experts add ``w_gate`` (E, d, hidden).
+    Call inside shard_map with tokens sharded over the same axis;
+    outside any mesh all experts run locally.
+
+    ``top_k=1`` is Switch (gate = raw top-1 prob).  ``top_k>1`` is the
+    GShard/Mixtral shape: each token goes to its k best experts, gate
+    weights renormalized to sum 1 over the chosen k; capacity slots are
+    assigned first-choice-first (every token's first choice queues
+    before any token's second), so under pressure second choices drop
+    first.  ``expert_type="swiglu"`` makes each expert the Llama MLP
+    ``(silu(x@w_gate) * (x@w_in)) @ w_out`` (Mixtral's expert).
     """
 
     def __init__(self, embed_dim: int, hidden_dim: int, n_experts: int,
                  capacity_factor: float = 1.25,
                  activation: str = "gelu",
-                 axis_name: str = DEFAULT_AXIS):
+                 axis_name: str = DEFAULT_AXIS,
+                 top_k: int = 1,
+                 expert_type: str = "mlp"):
         super().__init__()
+        if not 1 <= top_k <= n_experts:
+            raise ValueError(f"top_k={top_k} not in [1, {n_experts}]")
+        if expert_type not in ("mlp", "swiglu"):
+            raise ValueError(f"unknown expert_type {expert_type!r}")
         self.embed_dim = embed_dim
         self.hidden_dim = hidden_dim
         self.n_experts = n_experts
         self.capacity_factor = capacity_factor
         self.activation = activation
         self.axis_name = axis_name
+        self.top_k = top_k
+        self.expert_type = expert_type
 
     def create_params(self, key):
-        k1, k2, k3 = jax.random.split(key, 3)
+        k1, k2, k3, k4 = jax.random.split(key, 4)
         d, h, E = self.embed_dim, self.hidden_dim, self.n_experts
         s_in = (2.0 / d) ** 0.5
         s_out = (2.0 / h) ** 0.5
-        return {
+        p = {
             "router": jax.random.normal(k1, (d, E), jnp.float32) * 0.02,
             "w_in": jax.random.normal(k2, (E, d, h), jnp.float32) * s_in,
             "w_out": jax.random.normal(k3, (E, h, d), jnp.float32) * s_out,
         }
+        if self.expert_type == "swiglu":
+            p["w_gate"] = (jax.random.normal(k4, (E, d, h), jnp.float32)
+                           * s_in)
+        return p
 
     def param_specs(self) -> Dict[str, P]:
-        return {"router": P(),
-                "w_in": P(self.axis_name, None, None),
-                "w_out": P(self.axis_name, None, None)}
+        s = {"router": P(),
+             "w_in": P(self.axis_name, None, None),
+             "w_out": P(self.axis_name, None, None)}
+        if self.expert_type == "swiglu":
+            s["w_gate"] = P(self.axis_name, None, None)
+        return s
 
     # -- routing ----------------------------------------------------------
     def _dispatch(self, x2d: jax.Array, router: jax.Array, capacity: int
@@ -86,23 +113,31 @@ class ExpertParallelMLP(Module):
         """(dispatch (T,E,C) one-hot, combine (T,E,C) gate-weighted,
         aux load-balance loss) for the local token block."""
         T = x2d.shape[0]
-        E = self.n_experts
+        E, k = self.n_experts, self.top_k
         logits = x2d.astype(jnp.float32) @ router
         probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)                    # (T,)
-        gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
-        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T,E)
-        # position of each token within its expert's queue (prefix count)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (T,E)
+        gates, experts = lax.top_k(probs, k)                   # (T,k)
+        if k > 1:
+            # Mixtral: gate weights renormalized over the chosen k
+            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+        onehot = jax.nn.one_hot(experts, E, dtype=jnp.float32)  # (T,k,E)
+        # queue positions, choice-major: every token's 1st choice is
+        # enqueued before any token's 2nd, so overflow drops 2nd picks
+        ohf = jnp.swapaxes(onehot, 0, 1).reshape(k * T, E)
+        pos = jnp.cumsum(ohf, axis=0) * ohf - 1.0              # (kT,E)
         keep = (pos >= 0) & (pos < capacity)
-        disp = onehot * keep                                   # (T,E)
+        disp = ohf * keep                                      # (kT,E)
         posc = jax.nn.one_hot(
-            jnp.sum(pos * onehot, -1).astype(jnp.int32), capacity,
-            dtype=jnp.float32)                                 # (T,C)
-        dispatch = disp[:, :, None] * posc[:, None, :]         # (T,E,C)
-        combine = dispatch * gate[:, None, None]
-        # Switch aux loss: fraction routed f_e x mean prob P_e, scaled E
-        f_e = jnp.mean(onehot, axis=0)
+            jnp.sum(pos * ohf, -1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                                 # (kT,C)
+        per_choice = (disp[:, :, None]
+                      * posc[:, None, :]).reshape(k, T, E, capacity)
+        # slots are disjoint across choices, so the union is a sum
+        dispatch = jnp.sum(per_choice, axis=0)                 # (T,E,C)
+        combine = jnp.einsum("ktec,tk->tec", per_choice, gates)
+        # Switch aux loss (eq. 4), fraction over all k assignments:
+        # f_e x mean prob P_e, scaled E; reduces to Switch at k=1
+        f_e = jnp.mean(jnp.sum(onehot, axis=1), axis=0) / k
         p_e = jnp.mean(probs, axis=0)
         aux = E * jnp.sum(f_e * p_e)
         return dispatch, combine, aux
@@ -110,6 +145,15 @@ class ExpertParallelMLP(Module):
     def _expert_mlp(self, params, xe):
         """xe: (E_local, S, d) -> (E_local, S, d), vmapped over experts."""
         act = getattr(F, self.activation)
+
+        if self.expert_type == "swiglu":
+            def one(w_gate, w_in, w_out, t):
+                return (F.silu(t @ w_gate.astype(t.dtype))
+                        * (t @ w_in.astype(t.dtype))
+                        ) @ w_out.astype(t.dtype)
+
+            return jax.vmap(one)(params["w_gate"], params["w_in"],
+                                 params["w_out"], xe)
 
         def one(w_in, w_out, t):
             return act(t @ w_in.astype(t.dtype)) @ w_out.astype(t.dtype)
@@ -140,16 +184,40 @@ class ExpertParallelMLP(Module):
                                   concat_axis=0, tiled=False)
             # (ep_src, e_loc, C, d) -> (e_loc, ep_src*C, d)
             xe = jnp.moveaxis(recv, 0, 1).reshape(e_loc, ep * capacity, d)
-            ye = self._expert_mlp(
-                {"w_in": params["w_in"], "w_out": params["w_out"]}, xe)
+            ye = self._expert_mlp(params, xe)
             back = jnp.moveaxis(
                 ye.reshape(e_loc, ep, capacity, d), 1, 0)
             got = lax.all_to_all(back, self.axis_name, split_axis=0,
                                  concat_axis=0, tiled=False)
             got = got.reshape(E, capacity, d)
         else:
-            got = self._expert_mlp(
-                {"w_in": params["w_in"], "w_out": params["w_out"]}, sent)
+            got = self._expert_mlp(params, sent)
         y2d = jnp.einsum("tec,ecd->td", combine.astype(got.dtype), got)
         y = y2d.reshape(*lead, d)
         return (y, aux) if return_aux_loss else y
+
+
+def allreduce_replicated_grads(grads, specs, axis_name: str):
+    """DDP-style psum over ``axis_name`` for the REPLICATED leaves only.
+
+    With experts sharded over the token/data axis (DeepSpeed-MoE
+    style), expert-sharded leaves (their spec mentions ``axis_name``)
+    hold that device's own experts' grads — a blanket psum would be
+    wrong for them, while router/attention/norm grads are data-parallel
+    and need the usual sum.  ``specs`` is the
+    ``tensor_parallel.partition_specs(model)`` tree.
+    """
+    def names_in(spec):
+        out = set()
+        for part in spec:
+            if part is None:
+                continue
+            out.update(part if isinstance(part, tuple) else (part,))
+        return out
+
+    def red(g, s):
+        return g if axis_name in names_in(s) else lax.psum(g, axis_name)
+
+    return jax.tree_util.tree_map(
+        red, grads, specs,
+        is_leaf=lambda x: isinstance(x, P))

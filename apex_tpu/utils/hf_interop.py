@@ -319,3 +319,84 @@ def llama_from_hf(hf_model):
             params["lm_head"] = {"weight": _np.zeros(
                 (hc.vocab_size, hc.hidden_size), _np.float32)}
     return cfg, _to_jnp(params)
+
+
+def mixtral_from_hf(hf_model):
+    """(MixtralConfig, params) for apex_tpu.models.Mixtral from a
+    transformers MixtralModel / MixtralForCausalLM.
+
+    The attention/norm/embedding mapping is Llama's; each expert's
+    ``w1/w3/w2`` (gate/up/down, stored out-features-major) transposes
+    into the stacked ``w_gate/w_in/w_out`` (E, d, h)/(E, h, d) banks,
+    and the router ``gate.weight`` (E, d) transposes to (d, E).
+
+    ``capacity_factor`` is set to ``num_local_experts`` so routing is
+    dropless — HF Mixtral has no capacity limit, and exact logits
+    parity needs every token to reach both its experts.  Lower it for
+    capacity-bounded training throughput.
+    """
+    import numpy as _np
+    from ..models import MixtralConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "silu") != "silu":
+        raise ValueError(f"unsupported activation {hc.hidden_act!r}")
+    if getattr(hc, "attention_bias", False):
+        raise ValueError("attention_bias=True is not mapped")
+    cfg = MixtralConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=hc.num_key_value_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.rms_norm_eps, rope_theta=hc.rope_theta,
+        tie_word_embeddings=hc.tie_word_embeddings,
+        num_local_experts=hc.num_local_experts,
+        num_experts_per_tok=hc.num_experts_per_tok,
+        router_aux_loss_coef=hc.router_aux_loss_coef,
+        capacity_factor=float(hc.num_local_experts))
+    sd = hf_model.state_dict()
+    base = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def w(name):
+        return {"weight": _t(sd[f"{name}.weight"])}
+
+    def stack_T(names):
+        return _np.stack([_np.asarray(_t(sd[n])).T for n in names])
+
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"{base}layers.{i}"
+        moe = f"{b}.block_sparse_moe"
+        E = hc.num_local_experts
+        layers[str(i)] = {
+            "input_layernorm": w(f"{b}.input_layernorm"),
+            "self_attn": {k: w(f"{b}.self_attn.{k}")
+                          for k in ("q_proj", "k_proj", "v_proj",
+                                    "o_proj")},
+            "post_attention_layernorm": w(
+                f"{b}.post_attention_layernorm"),
+            "mlp": {
+                "router": _np.asarray(
+                    _t(sd[f"{moe}.gate.weight"])).T,      # (d, E)
+                "w_gate": stack_T(
+                    [f"{moe}.experts.{e}.w1.weight" for e in range(E)]),
+                "w_in": stack_T(
+                    [f"{moe}.experts.{e}.w3.weight" for e in range(E)]),
+                "w_out": stack_T(
+                    [f"{moe}.experts.{e}.w2.weight" for e in range(E)]),
+            },
+        }
+    params = {
+        "embed_tokens": w(f"{base}embed_tokens"),
+        "layers": layers,
+        "norm": w(f"{base}norm"),
+    }
+    if not hc.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"weight": _t(sd["lm_head.weight"])}
+        else:
+            params["lm_head"] = {"weight": _np.zeros(
+                (hc.vocab_size, hc.hidden_size), _np.float32)}
+    return cfg, _to_jnp(params)

@@ -524,6 +524,20 @@ def main():
                      max_position_embeddings=512,
                      tie_word_embeddings=True),
                  8, 64, 128, model_cls=models.Llama)),
+            # Mixtral family: top-2 SwiGLU MoE (8 experts) on the Llama
+            # backbone — single-chip all experts run locally; the
+            # number records MoE dispatch overhead vs the dense path
+            ("mixtral_8e_top2_o2_train_throughput",
+             lambda: gpt_config(
+                 "mixtral_8e_top2_o2_train_throughput",
+                 models.MixtralConfig(
+                     vocab_size=32000, hidden_size=768,
+                     intermediate_size=2048, num_hidden_layers=8,
+                     num_attention_heads=12, num_key_value_heads=4,
+                     max_position_embeddings=512,
+                     tie_word_embeddings=True, num_local_experts=8,
+                     num_experts_per_tok=2),
+                 4, 512, 6, 2, model_cls=models.Mixtral)),
             ("ddp_allreduce_bandwidth", allreduce_bw),
             ("optimizer_step_time", optimizer_step_time),
             ("resnet50_amp_o2_ddp_nhwc_train_throughput",
@@ -567,6 +581,17 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 8)),
+            ("mixtral_tiny_o2_train_throughput",
+             lambda: gpt_config(
+                 "mixtral_tiny_o2_train_throughput",
+                 models.MixtralConfig(
+                     vocab_size=128, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=16,
+                     tie_word_embeddings=True, num_local_experts=4,
+                     num_experts_per_tok=2),
+                 2, 16, 2, 1, model_cls=models.Mixtral)),
             ("llama_tiny_gqa_decode_throughput",
              lambda: gpt_decode_config(
                  "llama_tiny_gqa_decode_throughput",

@@ -105,3 +105,117 @@ def test_moe_expert_divisibility_check():
             in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
                       P("expert")),
             out_specs=P("expert"), check_vma=False))(params, x)
+
+
+# -- top-k (Mixtral-shape) routing ---------------------------------------
+
+def _loop_moe(moe, params, x):
+    """Per-token loop oracle: choice-major capacity queueing (all first
+    choices enqueue before any second choice), renormalized gates,
+    SwiGLU or plain experts."""
+    import math
+    x2 = np.asarray(x)
+    T, d = x2.shape
+    E, k = moe.n_experts, moe.top_k
+    C = max(1, math.ceil(moe.capacity_factor * T / E))
+    logits = x2 @ np.asarray(params["router"])
+    z = np.exp(logits - logits.max(1, keepdims=True))
+    probs = z / z.sum(1, keepdims=True)
+    top = np.argsort(-probs, axis=1, kind="stable")[:, :k]
+    gates = np.take_along_axis(probs, top, 1)
+    if k > 1:
+        gates = gates / gates.sum(1, keepdims=True)
+    counts = np.zeros(E, np.int64)
+    y = np.zeros_like(x2)
+    wi = np.asarray(params["w_in"])
+    wo = np.asarray(params["w_out"])
+    wg = np.asarray(params.get("w_gate")) if "w_gate" in params else None
+    for c in range(k):
+        for t in range(T):
+            e = top[t, c]
+            if counts[e] >= C:
+                continue
+            counts[e] += 1
+            if wg is not None:
+                h = x2[t] @ wg[e]
+                h = h / (1.0 + np.exp(-h)) * (x2[t] @ wi[e])
+            else:
+                h = x2[t] @ wi[e]
+                h = 0.5 * h * (1.0 + np.tanh(
+                    np.sqrt(2.0 / np.pi) * (h + 0.044715 * h ** 3)))
+            y[t] += gates[t, c] * (h @ wo[e])
+    return y
+
+
+@pytest.mark.parametrize("cap", [2.0, 0.5])
+def test_moe_top2_swiglu_matches_loop_oracle(cap):
+    """top_k=2 + SwiGLU experts vs the per-token loop — including
+    tight capacity (cap=0.5 forces drops, and the oracle's choice-major
+    queue checks that second choices drop first)."""
+    moe = ep.ExpertParallelMLP(8, 16, 8, capacity_factor=cap,
+                               top_k=2, expert_type="swiglu")
+    params, _ = moe.init(jax.random.PRNGKey(5))
+    x = jnp.asarray(np.random.RandomState(5).randn(24, 8), jnp.float32)
+    y = moe(params, x)
+    np.testing.assert_allclose(np.asarray(y), _loop_moe(moe, params, x),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_top2_sharded_matches_per_shard_reference():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+    moe = ep.ExpertParallelMLP(8, 16, 8, capacity_factor=2.0,
+                               top_k=2, expert_type="swiglu")
+    params, _ = moe.init(jax.random.PRNGKey(6))
+    specs = specs_of(moe, params)
+    assert specs["w_gate"] == P("expert", None, None)
+    x = jnp.asarray(np.random.RandomState(6).randn(16, 8), jnp.float32)
+
+    y = jax.jit(jax.shard_map(
+        lambda p, xb: moe(p, xb), mesh=mesh,
+        in_specs=(specs, P("expert")), out_specs=P("expert"),
+        check_vma=False))(params, x)
+    y_ref = _ref_sharded(moe, params, x, 4)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               atol=2e-5)
+
+
+def test_moe_top2_gradients_match_per_shard_reference():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+    moe = ep.ExpertParallelMLP(8, 16, 8, capacity_factor=2.0,
+                               top_k=2, expert_type="swiglu")
+    params, _ = moe.init(jax.random.PRNGKey(7))
+    specs = specs_of(moe, params)
+    x = jnp.asarray(np.random.RandomState(7).randn(16, 8), jnp.float32)
+
+    def sharded_grad(p, xb):
+        g = jax.grad(lambda pp: jnp.sum(jnp.square(moe(pp, xb))))(p)
+        g["router"] = lax.psum(g["router"], "expert")
+        return g
+
+    g_tp = jax.jit(jax.shard_map(
+        sharded_grad, mesh=mesh, in_specs=(specs, P("expert")),
+        out_specs=specs, check_vma=False))(params, x)
+
+    def ref_loss(p):
+        return jnp.sum(jnp.square(_ref_sharded(moe, p, x, 4)))
+
+    assert_trees_close(g_tp, jax.grad(ref_loss)(params), atol=3e-5)
+
+
+def test_moe_top2_gates_renormalized():
+    """Combine weights for an un-dropped token sum to 1 (Mixtral
+    renormalization), not to the raw top-2 softmax mass."""
+    moe = ep.ExpertParallelMLP(8, 16, 4, capacity_factor=8.0, top_k=2)
+    params, _ = moe.init(jax.random.PRNGKey(8))
+    x = jnp.asarray(np.random.RandomState(8).randn(8, 8), jnp.float32)
+    _, combine, _ = moe._dispatch(
+        x, params["router"], capacity=16)
+    np.testing.assert_allclose(np.asarray(combine).sum((1, 2)),
+                               np.ones(8), rtol=1e-5)
+
+
+def test_moe_top_k_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        ep.ExpertParallelMLP(8, 16, 4, top_k=5)
+    with pytest.raises(ValueError, match="expert_type"):
+        ep.ExpertParallelMLP(8, 16, 4, expert_type="dense")

@@ -1,0 +1,104 @@
+"""Sampling filters: hand-computed top-k/top-p supports, greedy
+equivalences, and end-to-end generate parity (top_k=1 == greedy
+through the KV-cached loops)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import sampling
+
+
+def test_top_k_support():
+    logits = jnp.asarray([[2.0, 1.0, 3.0, 0.5]])
+    out = np.asarray(sampling.filter_logits(logits, top_k=2))
+    assert np.isfinite(out[0, [0, 2]]).all()
+    assert np.isneginf(out[0, [1, 3]]).all()
+
+
+def test_top_p_support_hand_example():
+    # probs = [0.5, 0.3, 0.15, 0.05] (descending by construction):
+    # exclusive-cumsum = [0, .5, .8, .95] -> top_p=0.7 keeps {0, 1}
+    probs = np.array([0.5, 0.3, 0.15, 0.05])
+    logits = jnp.asarray(np.log(probs))[None]
+    out = np.asarray(sampling.filter_logits(logits, top_p=0.7))
+    assert np.isfinite(out[0, [0, 1]]).all()
+    assert np.isneginf(out[0, [2, 3]]).all()
+
+
+def test_top_p_tiny_keeps_argmax():
+    logits = jnp.asarray(np.random.RandomState(0).randn(3, 50),
+                         jnp.float32)
+    out = np.asarray(sampling.filter_logits(logits, top_p=1e-6))
+    finite = np.isfinite(out)
+    assert (finite.sum(-1) == 1).all()
+    np.testing.assert_array_equal(np.argmax(out, -1),
+                                  np.argmax(np.asarray(logits), -1))
+
+
+def test_top_p_one_keeps_everything():
+    logits = jnp.asarray(np.random.RandomState(1).randn(2, 20),
+                         jnp.float32)
+    out = np.asarray(sampling.filter_logits(logits, top_p=1.0))
+    assert np.isfinite(out).all()
+
+
+def test_sample_token_greedy_modes():
+    logits = jnp.asarray(np.random.RandomState(2).randn(4, 30),
+                         jnp.float32)
+    greedy = np.argmax(np.asarray(logits), -1)
+    np.testing.assert_array_equal(
+        np.asarray(sampling.sample_token(jax.random.PRNGKey(0), logits,
+                                         temperature=0.0)), greedy)
+    # top_k=1 at any temperature is also greedy
+    np.testing.assert_array_equal(
+        np.asarray(sampling.sample_token(jax.random.PRNGKey(0), logits,
+                                         temperature=2.0, top_k=1)),
+        greedy)
+
+
+def test_samples_stay_in_filtered_support():
+    logits = jnp.asarray(np.random.RandomState(3).randn(64),
+                         jnp.float32)
+    allowed = set(np.nonzero(np.isfinite(np.asarray(
+        sampling.filter_logits(logits[None], top_k=5,
+                               top_p=0.9))[0]))[0].tolist())
+    keys = jax.random.split(jax.random.PRNGKey(4), 200)
+    toks = jax.vmap(lambda k: sampling.sample_token(
+        k, logits, temperature=1.3, top_k=5, top_p=0.9))(keys)
+    assert set(np.asarray(toks).tolist()) <= allowed
+    assert len(set(np.asarray(toks).tolist())) > 1   # actually samples
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        sampling.filter_logits(jnp.zeros((1, 4)), top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        sampling.filter_logits(jnp.zeros((1, 4)), top_p=0.0)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_generate_cached_top_k1_matches_greedy(family):
+    """Through the real KV-cached loops: top_k=1 sampling must retrace
+    the greedy path token-for-token."""
+    from apex_tpu import models
+
+    if family == "gpt":
+        m = models.GPT(models.GPTConfig(vocab_size=97, block_size=16,
+                                        n_layer=2, n_head=4, n_embd=32,
+                                        dropout=0.0))
+    else:
+        m = models.Llama(models.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=16,
+            tie_word_embeddings=True))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    prompt = np.random.RandomState(5).randint(0, 97, (2, 5))
+    buf = jnp.zeros((2, 16), jnp.int32).at[:, :5].set(jnp.asarray(prompt))
+    greedy, _ = m.generate_cached(params, buf, 5, 8)
+    sampled, _ = m.generate_cached(params, buf, 5, 8, temperature=1.7,
+                                   top_k=1, rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy),
+                                  np.asarray(sampled))
