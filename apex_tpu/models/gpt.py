@@ -407,18 +407,22 @@ class GPT(nn.Module):
 
     def generate(self, p, input_ids, prompt_len, max_new_tokens: int,
                  temperature: float = 0.0,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
         """Fixed-buffer autoregressive decoding (jit-compatible).
 
         ``input_ids``: (B, block_size) buffer holding the prompt left-
         aligned (anything at position >= prompt_len is overwritten);
         ``prompt_len``: (B,) or scalar prompt lengths.  Greedy when
         ``temperature == 0`` (static python float), else samples with
-        ``rng``.  One compiled program serves any prompt length.
+        ``rng`` (``top_k``/``top_p`` filter per models/sampling.py).
+        One compiled program serves any prompt length.
         Generation for a row stops when its buffer fills: at most
         ``block_size - prompt_len`` new tokens land; further iterations
         leave the row untouched (``final_len`` caps at block_size).
         """
+        from . import sampling
         B, S = input_ids.shape
         prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
         if temperature > 0.0 and rng is None:
@@ -433,7 +437,8 @@ class GPT(nn.Module):
                         last_pos=jnp.minimum(cur_len - 1, S - 1))[:, 0]
             if temperature > 0.0:
                 key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, last / temperature)
+                nxt = sampling.sample_token(sub, last, temperature,
+                                            top_k=top_k, top_p=top_p)
             else:
                 nxt = jnp.argmax(last, axis=-1)
             # write at cur_len; a saturated row (cur_len == S) keeps its
@@ -501,7 +506,9 @@ class GPT(nn.Module):
     def generate_cached(self, p, input_ids, prompt_len,
                         max_new_tokens: int, temperature: float = 0.0,
                         rng: Optional[jax.Array] = None,
-                        cache_dtype=None):
+                        cache_dtype=None,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None):
         """KV-cached ``generate``: one fused prefill+decode loop over
         the buffer positions, O(S) attention per step against the
         static (B, n_kv_head, S, D) caches.  Greedy output is IDENTICAL to
@@ -512,7 +519,9 @@ class GPT(nn.Module):
         steps skip the full-vocab head matmul entirely (``lax.cond``),
         and ``cache_dtype`` defaults to the embedding table's dtype (so
         a bf16 model gets a bf16 cache, half the memory).
+        ``top_k``/``top_p`` filter sampled steps (models/sampling.py).
         """
+        from . import sampling
         if self.cfg.tp_axis is not None:
             raise NotImplementedError("generate_cached is single-device; "
                                       "use generate() under TP")
@@ -532,8 +541,8 @@ class GPT(nn.Module):
                 logits = self._head(p, x)[:, 0]
                 if temperature > 0.0:
                     key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(sub,
-                                                 logits / temperature)
+                    nxt = sampling.sample_token(sub, logits, temperature,
+                                                top_k=top_k, top_p=top_p)
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
                 return nxt.astype(ids.dtype), key

@@ -443,11 +443,15 @@ class Llama(nn.Module):
     def generate_cached(self, p, input_ids, prompt_len,
                         max_new_tokens: int, temperature: float = 0.0,
                         rng: Optional[jax.Array] = None,
-                        cache_dtype=None):
+                        cache_dtype=None,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None):
         """Fixed-buffer KV-cached greedy/sampled generation; one
         compiled program for any prompt length, prefill steps skipping
         the full-vocab head via ``lax.cond`` (GPT.generate_cached's
-        contract; token-for-token vs HF greedy in tests)."""
+        contract; token-for-token vs HF greedy in tests).
+        ``top_k``/``top_p`` filter sampled steps (models/sampling.py)."""
+        from . import sampling
         B, S = input_ids.shape
         prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
         if temperature > 0.0 and rng is None:
@@ -469,8 +473,8 @@ class Llama(nn.Module):
                 logits = F.matmul(x, table.T.astype(x.dtype))[:, 0]
                 if temperature > 0.0:
                     key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(sub,
-                                                 logits / temperature)
+                    nxt = sampling.sample_token(sub, logits, temperature,
+                                                top_k=top_k, top_p=top_p)
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
                 return nxt.astype(ids.dtype), key
