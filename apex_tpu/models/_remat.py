@@ -1,0 +1,42 @@
+"""Per-block rematerialization for the transformer families.
+
+The reference-era equivalent is torch checkpointing (not in the 2019
+Apex snapshot); on TPU this is the standard HBM lever: activations are
+the long-context memory bottleneck, and ``jax.checkpoint`` around each
+decoder block trades backward-pass FLOPs for not storing them
+(SURVEY.md §preamble: "use jax.checkpoint / rematerialisation to trade
+FLOPs for memory").
+
+Modes (the ``remat=`` config field on GPTConfig/LlamaConfig):
+
+- ``None``        — store everything (XLA default).
+- ``"nothing"``   — save only block boundaries; recompute the whole
+                    block in backward (max memory saving).
+- ``"dots"``      — ``dots_with_no_batch_dims_saveable``: keep matmul
+                    outputs, recompute the cheap elementwise/norm ops —
+                    the usual sweet spot on MXU-bound steps.
+
+Gradients are mathematically identical either way (pinned in
+tests/test_remat.py, along with a backward-FLOPs increase check).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["wrap_block"]
+
+_MODES = (None, "nothing", "dots")
+
+
+def wrap_block(fn, mode):
+    """``fn(params, x) -> out`` wrapped per ``mode`` (see module doc)."""
+    if mode is None:
+        return fn
+    if mode == "nothing":
+        policy = jax.checkpoint_policies.nothing_saveable
+    elif mode == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    else:
+        raise ValueError(f"remat mode {mode!r} not in {_MODES}")
+    return jax.checkpoint(fn, policy=policy)

@@ -43,7 +43,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, block_size=1024, n_layer=12,
                  n_head=12, n_embd=768, dropout=0.1,
                  layer_norm_eps=1e-5, tp_axis=None, sp_axis=None,
-                 head_chunk=8192, n_kv_head=None):
+                 head_chunk=8192, n_kv_head=None, remat=None):
         # head_chunk: vocab chunk size for the fused LM-head loss
         # (nn.fused_xent — logits never materialized); None/0 restores
         # the dense logits + fp32 log_softmax path.  Ignored under
@@ -80,6 +80,12 @@ class GPTConfig:
             raise NotImplementedError(
                 "GQA under tensor parallelism is not wired "
                 "(ParallelSelfAttention is MHA)")
+        # per-block rematerialization: None | "nothing" | "dots"
+        # (models/_remat.py) — the long-context HBM lever
+        from ._remat import _MODES
+        if remat not in _MODES:
+            raise ValueError(f"remat={remat!r} not in {_MODES}")
+        self.remat = remat
         if tp_axis is not None and sp_axis is not None:
             raise NotImplementedError(
                 "combined tp+sp GPT is not wired; pick one "
@@ -347,8 +353,12 @@ class GPT(nn.Module):
         mask = None
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
+        from ._remat import wrap_block
         for i in range(self.cfg.n_layer):
-            x = self.h[i](p["h"][str(i)], x, mask)
+            fn = wrap_block(
+                lambda pp, xx, blk=self.h[i]: blk(pp, xx, mask),
+                self.cfg.remat)
+            x = fn(p["h"][str(i)], x)
         return self.ln_f(p["ln_f"], x)
 
     def loss(self, p, input_ids, attention_mask: Optional[jax.Array]

@@ -38,7 +38,8 @@ class LlamaConfig:
                  num_attention_heads=32, num_key_value_heads=None,
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
-                 head_chunk=8192, sp_axis=None, tp_axis=None):
+                 head_chunk=8192, sp_axis=None, tp_axis=None,
+                 remat=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -75,6 +76,12 @@ class LlamaConfig:
         if tp_axis is not None and sp_axis is not None:
             raise NotImplementedError(
                 "combined tp+sp Llama is not wired; pick one")
+        # per-block rematerialization: None | "nothing" | "dots"
+        # (models/_remat.py) — the long-context HBM lever
+        from ._remat import _MODES
+        if remat not in _MODES:
+            raise ValueError(f"remat={remat!r} not in {_MODES}")
+        self.remat = remat
 
 
 class RMSNorm(nn.Module):
@@ -328,8 +335,12 @@ class Llama(nn.Module):
         if mask is not None:
             m = mask[:, None, None, :].astype(bool)
         aux = 0.0
+        from ._remat import wrap_block
         for i in range(self.cfg.num_hidden_layers):
-            out = self.layers[i](p["layers"][str(i)], x, m)
+            fn = wrap_block(
+                lambda pp, xx, blk=self.layers[i]: blk(pp, xx, m),
+                self.cfg.remat)
+            out = fn(p["layers"][str(i)], x)
             if isinstance(out, tuple):      # MoE block: (x, aux loss)
                 x, a = out
                 aux = aux + a

@@ -501,6 +501,16 @@ def main():
                                   vocab_size=50257, block_size=4096,
                                   dropout=0.0),
                  1, 4096, 6, 2)),
+            # same config under per-block remat ("dots"): records what
+            # the long-context HBM lever costs in recompute throughput
+            # (the lever's value is the larger batch/length it unlocks)
+            ("gpt2_small_o2_flash_t4096_remat_train_throughput",
+             lambda: gpt_config(
+                 "gpt2_small_o2_flash_t4096_remat_train_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=4096,
+                                  dropout=0.0, remat="dots"),
+                 1, 4096, 6, 2)),
             # Llama family: GQA (4 kv-heads) at GPT-2-small scale —
             # records the RMSNorm/RoPE/SwiGLU train path and the
             # compact-GQA-cache decode path on hardware

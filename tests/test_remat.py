@@ -1,0 +1,108 @@
+"""Per-block rematerialization (remat= config): gradients identical to
+the unremat'd model, backward FLOPs demonstrably higher (the memory is
+bought with recompute), dropout rng correctly replayed, MoE tuple
+outputs handled."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import models
+
+LKW = dict(vocab_size=97, hidden_size=32, intermediate_size=64,
+           num_hidden_layers=2, num_attention_heads=4,
+           num_key_value_heads=2, max_position_embeddings=16,
+           tie_word_embeddings=True)
+
+
+def _llama_grads(remat):
+    m = models.Llama(models.LlamaConfig(remat=remat, **LKW))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (2, 16)))
+    loss, g = jax.jit(jax.value_and_grad(
+        lambda p: m.loss(p, ids)))(params, )
+    return float(loss), g
+
+
+@pytest.mark.parametrize("mode", ["nothing", "dots"])
+def test_llama_remat_grads_identical(mode):
+    l0, g0 = _llama_grads(None)
+    l1, g1 = _llama_grads(mode)
+    assert l0 == l1
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_remat_increases_backward_flops():
+    """remat="nothing" must actually recompute: the compiled grad
+    program costs more FLOPs than the store-everything one."""
+    def flops(remat):
+        m = models.GPT(models.GPTConfig(vocab_size=97, block_size=16,
+                                        n_layer=2, n_head=4, n_embd=32,
+                                        dropout=0.0, remat=remat))
+        params, _ = m.init(jax.random.PRNGKey(0))
+        ids = jnp.zeros((2, 16), jnp.int32)
+        c = jax.jit(jax.grad(lambda p: m.loss(p, ids))).lower(
+            params).compile().cost_analysis()
+        ca = c[0] if isinstance(c, (list, tuple)) else c
+        return ca["flops"]
+
+    # ~10% more on this tiny config (the saving scales with depth x
+    # activation size; the assertion just pins that recompute happens)
+    assert flops("nothing") > flops(None) * 1.05
+
+
+def test_gpt_remat_with_dropout_replays_rng():
+    """Same rng -> same loss with and without remat: the checkpointed
+    backward must regenerate identical dropout masks."""
+    from apex_tpu.nn import module as nnmod
+
+    losses = {}
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 97, (2, 16)))
+    for mode in (None, "nothing"):
+        m = models.GPT(models.GPTConfig(vocab_size=97, block_size=16,
+                                        n_layer=2, n_head=4, n_embd=32,
+                                        dropout=0.3, remat=mode))
+        params, _ = m.init(jax.random.PRNGKey(0))
+
+        def nll(p):
+            logits, _ = nnmod.apply(m, p, ids, train=True,
+                                    rng=jax.random.PRNGKey(7))
+            logp = jax.nn.log_softmax(
+                logits[:, :-1].astype(jnp.float32))
+            lab = ids[:, 1:]
+            return -jnp.mean(jnp.take_along_axis(
+                logp, lab[..., None], -1))
+
+        loss, g = jax.jit(jax.value_and_grad(nll))(params)
+        losses[mode] = (float(loss),
+                        np.asarray(jax.tree_util.tree_leaves(g)[0]))
+    assert losses[None][0] == losses["nothing"][0]
+    np.testing.assert_allclose(losses[None][1], losses["nothing"][1],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_mixtral_remat_handles_tuple_blocks():
+    cfg = models.MixtralConfig(num_local_experts=4,
+                               num_experts_per_tok=2,
+                               capacity_factor=2.0,
+                               router_aux_loss_coef=0.02,
+                               remat="nothing", **LKW)
+    m = models.Mixtral(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 97, (2, 16)))
+    loss, g = jax.jit(jax.value_and_grad(
+        lambda p: m.loss(p, ids)))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
+
+
+def test_remat_validation():
+    with pytest.raises(ValueError, match="remat"):
+        models.LlamaConfig(remat="everything", **LKW)
+    with pytest.raises(ValueError, match="remat"):
+        models.GPTConfig(remat="full")
