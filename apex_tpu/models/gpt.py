@@ -76,10 +76,9 @@ class GPTConfig:
         if self.n_kv_head < 1 or n_head % self.n_kv_head:
             raise ValueError(f"n_kv_head={self.n_kv_head} must be a "
                              f"positive divisor of n_head={n_head}")
-        if self.n_kv_head != n_head and tp_axis is not None:
-            raise NotImplementedError(
-                "GQA under tensor parallelism is not wired "
-                "(ParallelSelfAttention is MHA)")
+        # GQA composes with tp_axis: ParallelSelfAttention shards the
+        # compact K/V projections too (n_kv_head % tp_size checked at
+        # trace time inside the layer)
         # per-block rematerialization: None | "nothing" | "dots"
         # (models/_remat.py) — the long-context HBM lever
         from ._remat import _MODES
@@ -116,7 +115,8 @@ class GPTSelfAttention(nn.Module):
             from ..parallel.tensor_parallel import ParallelSelfAttention
             self.core = ParallelSelfAttention(
                 cfg.n_embd, cfg.n_head, dropout=0.0, causal=True,
-                attn_dropout=cfg.dropout, axis_name=cfg.tp_axis)
+                attn_dropout=cfg.dropout, axis_name=cfg.tp_axis,
+                num_kv_heads=cfg.n_kv_head)
         else:
             self.qkv = nn.Linear(
                 cfg.n_embd, (cfg.n_head + 2 * self.n_kv) * self.head_dim)
