@@ -9,3 +9,4 @@ from .dcgan import Generator, Discriminator, dcgan
 from .gpt import GPTConfig, GPT, gpt2_small, gpt2_medium
 from .llama import LlamaConfig, Llama, RMSNorm, llama_params_to_tp
 from .mixtral import MixtralConfig, Mixtral
+from .speculative import generate_speculative

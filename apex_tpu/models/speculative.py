@@ -1,0 +1,122 @@
+"""Speculative decoding: draft-model proposal + target-model
+verification, losslessly reproducing the target's greedy output.
+
+The reference toolkit predates LLM serving; this implements the greedy
+variant of Leviathan et al. (2023): a cheap draft model proposes
+``gamma`` tokens autoregressively, the target scores the whole
+proposed prefix in ONE forward, and the longest prefix the target
+agrees with is accepted plus one corrected token — so every outer
+iteration advances by 1..gamma+1 tokens while the output is EXACTLY
+the target's own greedy continuation (pinned against
+``generate_cached`` in tests/test_speculative.py).
+
+jit-shape discipline matches ``GPT.generate``: fixed (B, S) buffer,
+per-row lengths, one compiled program for any prompt length; the outer
+``while_loop`` terminates because every active row advances at least
+one token per iteration.  Draft and target only need the shared
+``model(params, ids, attention_mask) -> (B, S, V)`` contract, so any
+family pairing works (GPT draft for a Llama target, etc.) as long as
+the tokenizer/vocab agree.
+
+Scope note: both models run full-prefix forwards per iteration (no KV
+cache reuse across iterations).  That keeps the verification exact and
+the program simple; the target-side win is running S-position scoring
+once per 1..gamma+1 accepted tokens instead of once per token.  A
+chunked cached-verify variant is the natural follow-up and would slot
+behind the same API.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["generate_speculative"]
+
+
+def generate_speculative(target, target_params, draft, draft_params,
+                         input_ids, prompt_len, max_new_tokens: int,
+                         gamma: int = 4):
+    """Greedy speculative decoding.  Returns ``(ids, final_len)`` with
+    the same contract as ``GPT.generate``: rows are left-aligned in the
+    (B, S) buffer, generation stops at ``prompt_len + max_new_tokens``
+    or the buffer end, positions past ``final_len`` keep the input
+    buffer's content."""
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    B, S = input_ids.shape
+    orig = jnp.asarray(input_ids)
+    prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+    final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+    pgrid = jnp.arange(S)[None, :]
+
+    def next_token(model, params, ids, cur_len):
+        """Greedy next token per row, reading position cur_len-1."""
+        amask = (pgrid < cur_len[:, None]).astype(jnp.int32)
+        logits = model(params, ids, amask)
+        idx = jnp.clip(cur_len - 1, 0, S - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]
+        return jnp.argmax(last, axis=-1).astype(ids.dtype)
+
+    def write_at(ids, pos, tok, can):
+        return jax.vmap(
+            lambda row, p, t, c: row.at[p].set(
+                jnp.where(c, t, row[p])))(
+            ids, jnp.minimum(pos, S - 1), tok, can)
+
+    def cond(carry):
+        _, cur_len = carry
+        return jnp.any(cur_len < final_len)
+
+    def body(carry):
+        ids, cur_len = carry
+        active = cur_len < final_len
+
+        # 1. draft proposes gamma greedy tokens (rows stop at the
+        # window edge; inactive rows propose nothing)
+        ids_d, len_d = ids, cur_len
+        dtoks = []
+        for _ in range(gamma):
+            t = next_token(draft, draft_params, ids_d, len_d)
+            can = len_d < final_len
+            ids_d = write_at(ids_d, len_d, t, can)
+            dtoks.append(t)
+            len_d = jnp.where(can, len_d + 1, len_d)
+        dtoks = jnp.stack(dtoks, axis=1)                   # (B, gamma)
+
+        # 2. target scores the whole proposed prefix in one forward
+        amask = (pgrid < len_d[:, None]).astype(jnp.int32)
+        tgt_next = jnp.argmax(
+            target(target_params, ids_d, amask), axis=-1)  # (B, S)
+
+        # 3. longest agreeing prefix; proposal j is only eligible if
+        # the correction slot after it still fits the window
+        offs = jnp.arange(gamma)[None, :]
+        vpos = jnp.clip(cur_len[:, None] - 1 + offs, 0, S - 1)
+        agree = dtoks == jnp.take_along_axis(tgt_next, vpos, axis=1)
+        eligible = (cur_len[:, None] + offs) < (final_len[:, None] - 1)
+        n_acc = jnp.sum(jnp.cumprod(agree & eligible, axis=1), axis=1)
+
+        # 4. the corrected token: target's choice after the accepted
+        # prefix (for a fully-agreeing draft this is the bonus token)
+        cpos = jnp.clip(cur_len - 1 + n_acc, 0, S - 1)
+        ctok = jnp.take_along_axis(tgt_next, cpos[:, None],
+                                   axis=1)[:, 0].astype(ids.dtype)
+
+        # 5. rebuild: accepted draft zone from ids_d, correction at
+        # cur_len + n_acc, everything past it restored from the
+        # original buffer (rejected proposals leave no trace)
+        corr_at = cur_len + n_acc
+        keep = pgrid < corr_at[:, None]
+        is_corr = (pgrid == corr_at[:, None]) & active[:, None]
+        ids_new = jnp.where(keep, ids_d,
+                            jnp.where(is_corr, ctok[:, None], orig))
+        new_len = jnp.where(active,
+                            jnp.minimum(corr_at + 1, final_len),
+                            cur_len)
+        return ids_new, new_len
+
+    ids, cur_len = lax.while_loop(cond, body, (orig, prompt_len))
+    return ids, final_len

@@ -1,0 +1,99 @@
+"""Speculative decoding is LOSSLESS: output must be exactly the
+target's own greedy continuation (generate_cached), for ragged
+prompts, any gamma, same-model drafts, and cross-family drafts."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import models
+from apex_tpu.models import generate_speculative
+
+
+def _gpt(n_layer, n_embd, seed):
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
+                                    n_layer=n_layer, n_head=4,
+                                    n_embd=n_embd, dropout=0.0))
+    params, _ = m.init(jax.random.PRNGKey(seed))
+    return m, params
+
+
+def _buf(rng, rows):
+    buf = np.zeros((len(rows), 32), np.int32)
+    for i, n in enumerate(rows):
+        buf[i, :n] = rng.randint(0, 64, n)
+    return jnp.asarray(buf), jnp.asarray(rows)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 8])
+def test_spec_decode_matches_target_greedy(gamma):
+    target, tp = _gpt(2, 32, 0)
+    draft, dp = _gpt(1, 16, 1)           # different (smaller) model
+    ids, plen = _buf(np.random.RandomState(2), [5, 3])
+
+    ref, n_ref = target.generate_cached(tp, ids, plen, 12)
+    out, n = generate_speculative(target, tp, draft, dp, ids, plen,
+                                  12, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(n), np.asarray(n_ref))
+    for b in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(out[b, :int(n[b])]),
+            np.asarray(ref[b, :int(n_ref[b])]))
+
+
+def test_spec_decode_perfect_draft_still_exact():
+    """Draft == target: everything accepted (+1 bonus per round) and
+    the output is still exactly greedy."""
+    target, tp = _gpt(2, 32, 3)
+    ids, plen = _buf(np.random.RandomState(4), [4, 6])
+    ref, _ = target.generate_cached(tp, ids, plen, 10)
+    out, n = generate_speculative(target, tp, target, tp, ids, plen,
+                                  10, gamma=4)
+    for b in range(2):
+        np.testing.assert_array_equal(np.asarray(out[b, :int(n[b])]),
+                                      np.asarray(ref[b, :int(n[b])]))
+
+
+def test_spec_decode_cross_family_draft():
+    """A Llama draft for a GPT target (shared vocab): pairing only
+    needs the (p, ids, mask) -> logits contract."""
+    target, tp = _gpt(2, 32, 5)
+    draft = models.Llama(models.LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1, max_position_embeddings=32,
+        tie_word_embeddings=True))
+    dp, _ = draft.init(jax.random.PRNGKey(6))
+    ids, plen = _buf(np.random.RandomState(7), [5])
+    ref, _ = target.generate_cached(tp, ids, plen, 8)
+    out, n = generate_speculative(target, tp, draft, dp, ids, plen,
+                                  8, gamma=3)
+    np.testing.assert_array_equal(np.asarray(out[0, :int(n[0])]),
+                                  np.asarray(ref[0, :int(n[0])]))
+
+
+def test_spec_decode_saturates_at_buffer():
+    target, tp = _gpt(2, 32, 8)
+    draft, dp = _gpt(1, 16, 9)
+    ids, plen = _buf(np.random.RandomState(10), [28])
+    ref, n_ref = target.generate_cached(tp, ids, plen, 100)
+    out, n = generate_speculative(target, tp, draft, dp, ids, plen,
+                                  100, gamma=4)
+    assert int(n[0]) == 32 == int(n_ref[0])
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_spec_decode_jits_and_validates():
+    target, tp = _gpt(1, 16, 11)
+    draft, dp = _gpt(1, 16, 12)
+    ids, plen = _buf(np.random.RandomState(13), [4])
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(target, tp, draft, dp, ids, plen, 4,
+                             gamma=0)
+    f = jax.jit(lambda t, d, i, p: generate_speculative(
+        target, t, draft, d, i, p, 6, gamma=2))
+    out, n = f(tp, dp, ids, plen)
+    ref, _ = target.generate_cached(tp, ids, plen, 6)
+    np.testing.assert_array_equal(np.asarray(out[0, :int(n[0])]),
+                                  np.asarray(ref[0, :int(n[0])]))
