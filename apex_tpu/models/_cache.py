@@ -1,0 +1,34 @@
+"""KV-cache seeding shared by the GPT/Llama chunked-prefill paths.
+
+``seed_layer`` writes a full (B, Hkv, T, D) K/V block into one layer's
+static cache buffers with EXACTLY the math the per-token ``decode``
+write would have used — including the int8 per-position quantization
+(amax/127 scale sidecars) — so chunked prefill is numerically
+interchangeable with stepping the prompt token by token.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["seed_layer"]
+
+
+def seed_layer(layer_cache, k, v):
+    """New layer-cache dict with k/v (B, Hkv, T, D) written at
+    positions [0, T) (T == the buffer length S for full-buffer
+    prefill)."""
+    out = dict(layer_cache)
+    if layer_cache["k"].dtype == jnp.int8:
+        for name, val in (("k", k), ("v", v)):
+            f = val.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
+            scale = jnp.maximum(amax, 1e-12) / 127.0
+            out[name] = jnp.clip(jnp.round(f / scale), -127,
+                                 127).astype(jnp.int8)
+            out[f"{name}_scale"] = scale.astype(
+                layer_cache[f"{name}_scale"].dtype)
+    else:
+        out["k"] = k.astype(layer_cache["k"].dtype)
+        out["v"] = v.astype(layer_cache["v"].dtype)
+    return out

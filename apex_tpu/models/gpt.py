@@ -164,6 +164,23 @@ class GPTSelfAttention(nn.Module):
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.drop(p.get("drop", {}), self.out(p["out"], ctx))
 
+    def prefill(self, p, x):
+        """Full-sequence attention that also returns the COMPACT K/V
+        for cache seeding: ``(out, k, v)`` with k/v (B, Hkv, T, D) —
+        one MXU-friendly pass instead of T sequential ``decode`` steps
+        (eval-mode path, no dropout, like decode)."""
+        B, T, E = x.shape
+        q, k, v = self._split_qkv(self.qkv(p["qkv"], x), B, T)
+        kc, vc = k, v
+        if self.n_kv != self.n_head:
+            rep = self.n_head // self.n_kv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        ctx = dot_product_attention(q, k, v, None, causal=True,
+                                    dropout_rate=0.0)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        return self.out(p["out"], ctx), kc, vc
+
     def decode(self, p, x, pos, cache):
         """One-token step against the KV cache.
 
@@ -258,6 +275,13 @@ class GPTBlock(nn.Module):
         h = self.ln_2(p["ln_2"], x)
         h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
         return x + h, cache
+
+    def prefill(self, p, x):
+        a, k, v = self.attn.prefill(p["attn"], self.ln_1(p["ln_1"], x))
+        x = x + a
+        h = self.ln_2(p["ln_2"], x)
+        h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
+        return x + h, k, v
 
 
 class GPT(nn.Module):
@@ -518,7 +542,8 @@ class GPT(nn.Module):
                         rng: Optional[jax.Array] = None,
                         cache_dtype=None,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None):
+                        top_p: Optional[float] = None,
+                        prefill_mode: str = "chunked"):
         """KV-cached ``generate``: one fused prefill+decode loop over
         the buffer positions, O(S) attention per step against the
         static (B, n_kv_head, S, D) caches.  Greedy output is IDENTICAL to
@@ -530,11 +555,20 @@ class GPT(nn.Module):
         and ``cache_dtype`` defaults to the embedding table's dtype (so
         a bf16 model gets a bf16 cache, half the memory).
         ``top_k``/``top_p`` filter sampled steps (models/sampling.py).
+
+        ``prefill_mode="chunked"`` (default) seeds the KV cache with
+        ONE full-buffer forward (models/_cache.py) and starts the
+        sequential loop at the earliest prompt end — prompt processing
+        rides the MXU instead of min(prompt_len) dependent steps.
+        ``"step"`` restores the walk-every-position loop.
         """
         from . import sampling
         if self.cfg.tp_axis is not None:
             raise NotImplementedError("generate_cached is single-device; "
                                       "use generate() under TP")
+        if prefill_mode not in ("chunked", "step"):
+            raise ValueError(f"prefill_mode {prefill_mode!r} not in "
+                             f"('chunked', 'step')")
         B, S = input_ids.shape
         prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
         if temperature > 0.0 and rng is None:
@@ -577,7 +611,19 @@ class GPT(nn.Module):
         if cache_dtype is None:
             cache_dtype = p["wte"]["weight"].dtype
         cache = self.init_cache(B, dtype=cache_dtype)
+        start = 0
+        if prefill_mode == "chunked":
+            from ._cache import seed_layer
+            x = (self.wte(p["wte"], input_ids)
+                 + self.wpe(p["wpe"], jnp.arange(S)[None, :]))
+            for i in range(self.cfg.n_layer):
+                li = str(i)
+                x, k, v = self.h[i].prefill(p["h"][li], x)
+                cache[li] = seed_layer(cache[li], k, v)
+            # entries at positions >= first_gen - 1 are rewritten by
+            # the loop before any later position reads them
+            start = jnp.maximum(first_gen - 1, 0)
         # traced bound: no dead steps past the longest row's final_len
-        ids, _, _ = lax.fori_loop(0, jnp.max(final_len) - 1, body,
+        ids, _, _ = lax.fori_loop(start, jnp.max(final_len) - 1, body,
                                   (input_ids, cache, key))
         return ids, final_len

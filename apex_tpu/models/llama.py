@@ -185,6 +185,25 @@ class LlamaAttention(nn.Module):
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.o_proj(p["o_proj"], ctx)
 
+    def prefill(self, p, x):
+        """Full-sequence attention that also returns the COMPACT
+        post-RoPE K/V for cache seeding: ``(out, k, v)`` with k/v
+        (B, Hkv, T, D) — one MXU-friendly pass instead of T sequential
+        ``decode`` steps (values identical to what decode would have
+        written position by position)."""
+        B, T, E = x.shape
+        q, k, v = self._qkv(p, x, B, T)
+        q, k = apply_rope(q, k, jnp.arange(T), self.theta)
+        kc, vc = k, v
+        if self.Hkv != self.H:
+            rep = self.H // self.Hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        ctx = dot_product_attention(q, k, v, None, causal=True,
+                                    dropout_rate=0.0)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        return self.o_proj(p["o_proj"], ctx), kc, vc
+
     def decode(self, p, x, pos, cache):
         """One-token step; ``cache`` {"k","v"} (B, Hkv, S, D) (+int8
         scale sidecars) — RoPE applied at ``pos`` before the write, so
@@ -292,6 +311,13 @@ class LlamaBlock(nn.Module):
         x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x)), cache
+
+    def prefill(self, p, x):
+        a, k, v = self.self_attn.prefill(
+            p["self_attn"], self.input_layernorm(p["input_layernorm"], x))
+        x = x + a
+        return x + self.mlp(p["mlp"], self.post_attention_layernorm(
+            p["post_attention_layernorm"], x)), k, v
 
 
 class Llama(nn.Module):
@@ -456,13 +482,23 @@ class Llama(nn.Module):
                         rng: Optional[jax.Array] = None,
                         cache_dtype=None,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None):
+                        top_p: Optional[float] = None,
+                        prefill_mode: str = "chunked"):
         """Fixed-buffer KV-cached greedy/sampled generation; one
         compiled program for any prompt length, prefill steps skipping
         the full-vocab head via ``lax.cond`` (GPT.generate_cached's
         contract; token-for-token vs HF greedy in tests).
-        ``top_k``/``top_p`` filter sampled steps (models/sampling.py)."""
+        ``top_k``/``top_p`` filter sampled steps (models/sampling.py).
+
+        ``prefill_mode="chunked"`` (default) seeds the KV cache with
+        ONE full-buffer forward (models/_cache.py) and starts the
+        sequential loop at the earliest prompt end — prefill rides the
+        MXU instead of min(prompt_len) dependent steps.  ``"step"``
+        restores the walk-every-position loop."""
         from . import sampling
+        if prefill_mode not in ("chunked", "step"):
+            raise ValueError(f"prefill_mode {prefill_mode!r} not in "
+                             f"('chunked', 'step')")
         B, S = input_ids.shape
         prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
         if temperature > 0.0 and rng is None:
@@ -473,6 +509,17 @@ class Llama(nn.Module):
             cache_dtype = self._table(p).dtype
         cache = self.init_cache(B, dtype=cache_dtype)
         key = rng if rng is not None else jax.random.PRNGKey(0)
+        start = 0
+        if prefill_mode == "chunked":
+            from ._cache import seed_layer
+            x = self.embed_tokens(p["embed_tokens"], input_ids)
+            for i in range(self.cfg.num_hidden_layers):
+                li = str(i)
+                x, k, v = self.layers[i].prefill(p["layers"][li], x)
+                cache[li] = seed_layer(cache[li], k, v)
+            # entries at positions >= first_gen - 1 are rewritten by
+            # the loop before any later position reads them
+            start = jnp.maximum(first_gen - 1, 0)
 
         def body(i, carry):
             ids, cache, key = carry
@@ -502,7 +549,7 @@ class Llama(nn.Module):
                 ids, col[:, None], i + 1, axis=1)
             return ids, cache, key
 
-        ids, _, _ = lax.fori_loop(0, jnp.max(final_len) - 1, body,
+        ids, _, _ = lax.fori_loop(start, jnp.max(final_len) - 1, body,
                                   (input_ids, cache, key))
         return ids, final_len
 
