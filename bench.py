@@ -360,6 +360,14 @@ def main():
         # decode throughput, invariant to the prompt/new-tokens ratio
         dt_full = timed(runner(new_tokens), None, ids, 3, 1)
         dt_prefill = timed(runner(0), None, ids, 3, 1)
+        if prompt > 0 and dt_prefill > 0:
+            # time-to-first-token half of the serving story: with
+            # chunked prefill this is one MXU pass over the buffer
+            emit(metric=f"{metric}_prefill",
+                 value=round(batch * prompt / dt_prefill, 1),
+                 unit="prompt tokens/sec/chip", vs_baseline=None,
+                 note=f"chunked KV-cache prefill, B={batch}, "
+                      f"prompt={prompt}")
         if dt_full > dt_prefill * 1.05:
             dt = dt_full - dt_prefill
             how = "prefill time subtracted"

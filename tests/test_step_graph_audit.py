@@ -126,3 +126,75 @@ def test_o2_s2d_nhwc_step_convs_bf16_and_transpose_free():
     assert len(s2d_rearranges) <= 1, (
         f"s2d rearrange should appear once (forward), got "
         f"{len(s2d_rearranges)}")
+
+
+# -- transformer families ------------------------------------------------
+
+def _transformer_step_jaxpr(family):
+    """Trace the real O2 DDP train step (fused-head loss) for a tiny
+    transformer config over the 8-device CPU mesh."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if family == "gpt":
+        net = models.GPT(models.GPTConfig(
+            vocab_size=97, block_size=16, n_layer=2, n_head=4,
+            n_embd=32, dropout=0.0))
+    else:
+        net = models.Llama(models.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=16,
+            tie_word_embeddings=True))
+    model, opt = amp.initialize(net, optimizers.FusedAdam(1e-3),
+                                opt_level="O2", verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (8, 16)))
+
+    def step(state, batch):
+        params, ost = state
+        (ids_b,) = batch
+
+        def loss_fn(p):
+            return model.loss(p, ids_b), ()
+
+        loss, _, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        g = ddp.allreduce_grads(g)
+        params, ost2, _ = opt.step(params, ost, g)
+        return (params, ost2), jax.lax.pmean(loss, "data")
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), (P("data"),)),
+                           out_specs=(P(), P()), check_vma=False)
+    return jax.make_jaxpr(mapped)((params, ost), (ids,))
+
+
+def _large_dots(jpr, min_elems=256):
+    return [e for e in _walk(jpr.jaxpr)
+            if e.primitive.name == "dot_general"
+            and all(int(np.prod(v.aval.shape)) >= min_elems
+                    for v in e.invars)]
+
+
+def _assert_dots_bf16(jpr):
+    dots = _large_dots(jpr)
+    assert len(dots) >= 10, f"expected fwd+bwd dots, got {len(dots)}"
+    bad = [tuple(v.aval.dtype for v in e.invars) for e in dots
+           if not all(v.aval.dtype == jnp.bfloat16 for v in e.invars)]
+    assert not bad, (f"non-bf16 large dots in O2 step: {bad[:6]} "
+                     f"(+{len(bad)} total); fp32 accumulation belongs "
+                     f"in preferred_element_type, not operand upcasts")
+
+
+def test_gpt_o2_step_large_dots_bf16():
+    """Every activation/param-sized matmul in the GPT O2 train step —
+    qkv/attention/MLP/fused-head, fwd and bwd — must run on bf16
+    operands (fp32 stays in accumulators via preferred_element_type;
+    an operand upcast would halve MXU rate and double HBM traffic)."""
+    _assert_dots_bf16(_transformer_step_jaxpr("gpt"))
+
+
+def test_llama_o2_step_large_dots_bf16():
+    _assert_dots_bf16(_transformer_step_jaxpr("llama"))
