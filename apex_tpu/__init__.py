@@ -37,5 +37,6 @@ from . import transformer
 from . import models
 from . import utils
 from . import data
+from . import lora
 
 __version__ = "0.1.0"

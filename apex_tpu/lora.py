@@ -1,0 +1,118 @@
+"""LoRA — low-rank adaptation as pure param-tree arithmetic.
+
+The reference toolkit predates parameter-efficient fine-tuning; this
+is the TPU-functional take: instead of wrapping layers (the torch
+idiom), adapters are a FLAT dict keyed by the target weight's tree
+path, and ``merge`` produces an ordinary param tree
+``W + scale * B @ A`` that drops into any model/optimizer/serving path
+unchanged — the model code never learns LoRA exists, and XLA fuses the
+rank-r update into the surrounding graph.
+
+Standard init (Hu et al. 2021): A ~ N(0, 1/rank), B = 0, so merged ==
+base at step 0 (pinned bitwise in tests/test_lora.py).  Fine-tuning
+optimizes ONLY the adapter dict; ``scale`` (= alpha/rank) is a static
+python float so the adapter pytree holds nothing an optimizer could
+mistakenly update::
+
+    adapters = lora.init(params, targets=("q_proj", "v_proj"), rank=8,
+                         key=key)
+    s = lora.scale(alpha=16.0, rank=8)
+    def loss_fn(ad):
+        return model.loss(lora.merge(params, ad, s), ids)
+    grads = jax.grad(loss_fn)(adapters)       # base params untouched
+
+Serving: ``merge`` once, then quantize/generate as usual; the adapter
+dict is its own (tiny) checkpoint — save it with utils.checkpoint like
+any pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init", "merge", "scale", "num_params"]
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def scale(alpha: float = 16.0, rank: int = 8) -> float:
+    """The merge scale alpha/rank (kept static on purpose)."""
+    return float(alpha) / float(rank)
+
+
+def init(params: Any, targets: Sequence[str], rank: int = 8,
+         key: Optional[jax.Array] = None) -> Dict[str, Any]:
+    """Adapter dict ``{path: {"a": (r, in), "b": (out, r)}}`` for every
+    2-D leaf whose tree path contains one of ``targets`` (e.g.
+    ``("q_proj", "v_proj")`` for Llama attention, ``("qkv",)`` for
+    GPT).  Weights follow the framework's (out, in) Linear convention.
+    B starts at zero, so ``merge(params, init(...))`` == ``params``."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    adapters: Dict[str, Any] = {}
+    for path, leaf in leaves:
+        pstr = _path_str(path)
+        if getattr(leaf, "ndim", 0) != 2:
+            continue
+        if not any(t in pstr for t in targets):
+            continue
+        out_f, in_f = leaf.shape
+        key, sub = jax.random.split(key)
+        adapters[pstr] = {
+            "a": (jax.random.normal(sub, (rank, in_f), jnp.float32)
+                  / rank),
+            "b": jnp.zeros((out_f, rank), jnp.float32),
+        }
+    if not adapters:
+        raise ValueError(f"no 2-D weights matched targets {targets!r}")
+    return adapters
+
+
+def merge(params: Any, adapters: Dict[str, Any],
+          merge_scale: float = 2.0) -> Any:
+    """New param tree with ``W + merge_scale * B @ A`` at every adapted
+    path (copy-on-write: unadapted subtrees are shared, not copied).
+    Default ``merge_scale`` is ``scale()`` for the default alpha=16,
+    rank=8."""
+    remaining = set(adapters)
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            out = dict(node)
+            for name, sub in node.items():
+                p = f"{prefix}/{name}" if prefix else str(name)
+                if p in adapters:
+                    ad = adapters[p]
+                    remaining.discard(p)
+                    delta = (merge_scale
+                             * (ad["b"] @ ad["a"])).astype(sub.dtype)
+                    out[name] = sub + delta
+                else:
+                    out[name] = walk(sub, p)
+            return out
+        return node
+
+    merged = walk(params, "")
+    if remaining:
+        raise KeyError(f"adapter paths not found in params: "
+                       f"{sorted(remaining)[:4]}")
+    return merged
+
+
+def num_params(adapters: Dict[str, Any]) -> Tuple[int, int]:
+    """(adapter trainable params, full-matrix params at the adapted
+    sites) — the fine-tuning footprint vs full fine-tuning."""
+    small = sum(int(ad["a"].size + ad["b"].size)
+                for ad in adapters.values())
+    full = sum(int(ad["b"].shape[0] * ad["a"].shape[1])
+               for ad in adapters.values())
+    return small, full
