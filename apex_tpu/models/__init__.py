@@ -10,3 +10,4 @@ from .gpt import GPTConfig, GPT, gpt2_small, gpt2_medium
 from .llama import LlamaConfig, Llama, RMSNorm, llama_params_to_tp
 from .mixtral import MixtralConfig, Mixtral
 from .speculative import generate_speculative
+from .beam import beam_search

@@ -1,0 +1,79 @@
+"""Beam search over the fixed-buffer generate contract.
+
+Maximizes total log-probability of the generated continuation with
+``num_beams`` hypotheses per row (fixed length — no EOS concept in the
+buffer contract; rows stop at ``prompt_len + max_new_tokens`` or the
+buffer end).  ``num_beams=1`` reduces exactly to greedy ``generate``
+(pinned in tests/test_beam.py, along with exhaustive-search parity at
+small horizons).
+
+Shape discipline matches ``GPT.generate``: the batch is expanded to
+``B * num_beams`` rows, every step is one full-prefix forward (simple
+and exact — the KV-cached variant would add per-step cache reordering
+by beam index), and all reindexing is static-shape ``top_k`` +
+``take_along_axis``, so the whole search jits as one program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["beam_search"]
+
+NEG = -1e30
+
+
+def beam_search(model, params, input_ids, prompt_len,
+                max_new_tokens: int, num_beams: int = 4):
+    """Returns ``(ids (B, S), final_len (B,), score (B,))`` — the best
+    beam per row and its total continuation log-probability."""
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    B, S = input_ids.shape
+    K = num_beams
+    prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+    final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+    pgrid = jnp.arange(S)[None, :]
+
+    ids0 = jnp.repeat(jnp.asarray(input_ids), K, axis=0)   # (B*K, S)
+    # all beams start identical: only beam 0 is live, or the first
+    # step would pick the same token K times
+    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, NEG)
+    scores0 = jnp.broadcast_to(scores0, (B, K))
+
+    def body(t, carry):
+        ids, scores, cur_len = carry
+        active = cur_len < final_len                        # (B,)
+        lens = jnp.repeat(cur_len, K)
+        amask = (pgrid < lens[:, None]).astype(jnp.int32)
+        logits = model(params, ids, amask)
+        idx = jnp.clip(lens - 1, 0, S - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]       # (B*K, V)
+        V = last.shape[-1]
+        logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+        total = scores[:, :, None] + logp.reshape(B, K, V)
+        top_scores, top_idx = lax.top_k(total.reshape(B, K * V), K)
+        beam_idx = top_idx // V                             # (B, K)
+        tok = (top_idx % V).astype(ids.dtype)
+
+        # reorder beams, then append the chosen token at cur_len
+        ids = jnp.take_along_axis(
+            ids.reshape(B, K, S), beam_idx[:, :, None], axis=1)
+        wpos = jnp.clip(cur_len, 0, S - 1)
+        cols = jax.vmap(lambda row_ids, p, toks: row_ids.at[:, p].set(
+            toks))(ids, wpos, tok)
+        keep = active[:, None, None]
+        ids = jnp.where(keep, cols, ids).reshape(B * K, S)
+        scores = jnp.where(active[:, None], top_scores, scores)
+        return ids, scores, jnp.where(active, cur_len + 1, cur_len)
+
+    ids, scores, _ = lax.fori_loop(
+        0, max_new_tokens, body, (ids0, scores0, prompt_len))
+    best = jnp.argmax(scores, axis=-1)                      # (B,)
+    out = jnp.take_along_axis(
+        ids.reshape(B, K, S), best[:, None, None], axis=1)[:, 0]
+    return out, final_len, jnp.take_along_axis(
+        scores, best[:, None], axis=1)[:, 0]
