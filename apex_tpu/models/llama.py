@@ -39,7 +39,7 @@ class LlamaConfig:
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  head_chunk=8192, sp_axis=None, tp_axis=None,
-                 remat=None):
+                 remat=None, sliding_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -82,6 +82,21 @@ class LlamaConfig:
         if remat not in _MODES:
             raise ValueError(f"remat={remat!r} not in {_MODES}")
         self.remat = remat
+        # Mistral-style sliding-window attention: key j visible to
+        # query i iff i - W < j <= i.  Training takes the dense
+        # (banded-mask) path — the flash kernel streams key-padding
+        # masks, not bands; decode applies the window in its cache
+        # read.  The KV cache stays full-length (HF's rolling buffer
+        # is a memory optimization, not a semantics change).
+        if sliding_window is not None:
+            if sliding_window < 1:
+                raise ValueError(f"sliding_window={sliding_window} "
+                                 f"must be >= 1")
+            if sp_axis is not None or tp_axis is not None:
+                raise NotImplementedError(
+                    "sliding_window composes with dp only; the ring/"
+                    "Megatron attention paths are full-window")
+        self.sliding_window = sliding_window
 
 
 class RMSNorm(nn.Module):
@@ -141,6 +156,7 @@ class LlamaAttention(nn.Module):
         self.theta = cfg.rope_theta
         self.sp = cfg.sp_axis
         self.tp = cfg.tp_axis is not None
+        self.window = getattr(cfg, "sliding_window", None)
         E = cfg.hidden_size
         if self.tp:
             from ..parallel.tensor_parallel import ParallelSelfAttention
@@ -180,10 +196,20 @@ class LlamaAttention(nn.Module):
             from ..transformer.ring_attention import ring_attention
             ctx = ring_attention(q, k, v, axis_name=self.sp, causal=True)
         else:
+            mask = self._with_band(mask, T)
             ctx = dot_product_attention(q, k, v, mask, causal=True,
                                         dropout_rate=0.0)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.o_proj(p["o_proj"], ctx)
+
+    def _with_band(self, mask, T):
+        """AND the sliding-window band (key j visible to query i iff
+        j > i - W; the causal half lives in causal=True) into ``mask``."""
+        if self.window is None:
+            return mask
+        band = (jnp.arange(T)[None, :]
+                > jnp.arange(T)[:, None] - self.window)[None, None]
+        return band if mask is None else (mask & band)
 
     def prefill(self, p, x):
         """Full-sequence attention that also returns the COMPACT
@@ -199,8 +225,8 @@ class LlamaAttention(nn.Module):
             rep = self.H // self.Hkv
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        ctx = dot_product_attention(q, k, v, None, causal=True,
-                                    dropout_rate=0.0)
+        ctx = dot_product_attention(q, k, v, self._with_band(None, T),
+                                    causal=True, dropout_rate=0.0)
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.o_proj(p["o_proj"], ctx), kc, vc
 
@@ -246,6 +272,9 @@ class LlamaAttention(nn.Module):
         scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32), kf)
         scores = scores * (1.0 / (self.D ** 0.5))
         valid = jnp.arange(S)[None, None, None, :] <= pos
+        if self.window is not None:
+            valid = valid & (jnp.arange(S)[None, None, None, :]
+                             > pos - self.window)
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vf).astype(x.dtype)

@@ -400,3 +400,46 @@ def mixtral_from_hf(hf_model):
             params["lm_head"] = {"weight": _np.zeros(
                 (hc.vocab_size, hc.hidden_size), _np.float32)}
     return cfg, _to_jnp(params)
+
+
+def mistral_from_hf(hf_model):
+    """(LlamaConfig, params) for apex_tpu.models.Llama from a
+    transformers MistralModel / MistralForCausalLM.
+
+    Mistral is the Llama architecture with sliding-window attention;
+    the state_dict layout is identical, so this reuses the Llama key
+    mapping and sets ``LlamaConfig(sliding_window=...)`` (None for
+    full-window v0.2+ checkpoints).  The KV cache stays full-length —
+    HF's rolling buffer is a memory optimization with the same
+    semantics."""
+    from ..models import LlamaConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "silu") != "silu":
+        raise ValueError(f"unsupported activation {hc.hidden_act!r}")
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=hc.num_key_value_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.rms_norm_eps, rope_theta=hc.rope_theta,
+        tie_word_embeddings=hc.tie_word_embeddings,
+        sliding_window=getattr(hc, "sliding_window", None))
+    # layer/key layout is Llama's: borrow its mapping wholesale
+    _, params = llama_from_hf(_LlamaShim(hf_model, hc))
+    return cfg, params
+
+
+class _LlamaShim:
+    """Adapter presenting a Mistral model to llama_from_hf (same
+    state_dict keys; strips the Mistral-only config fields the Llama
+    validation would not recognize)."""
+
+    def __init__(self, model, cfg):
+        self._model = model
+        self.config = cfg
+
+    def state_dict(self):
+        return self._model.state_dict()
