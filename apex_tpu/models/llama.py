@@ -39,7 +39,7 @@ class LlamaConfig:
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  head_chunk=8192, sp_axis=None, tp_axis=None,
-                 remat=None, sliding_window=None):
+                 remat=None, sliding_window=None, attention_bias=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -97,6 +97,13 @@ class LlamaConfig:
                     "sliding_window composes with dp only; the ring/"
                     "Megatron attention paths are full-window")
         self.sliding_window = sliding_window
+        # Qwen2-style Q/K/V projection biases (o_proj stays bias-free)
+        if attention_bias and tp_axis is not None:
+            raise NotImplementedError(
+                "attention_bias under tensor parallelism is not wired "
+                "(ParallelSelfAttention biases all projections incl. "
+                "out)")
+        self.attention_bias = attention_bias
 
 
 class RMSNorm(nn.Module):
@@ -165,9 +172,10 @@ class LlamaAttention(nn.Module):
                 axis_name=cfg.tp_axis, num_kv_heads=self.Hkv,
                 rope_theta=cfg.rope_theta)
         else:
-            self.q_proj = nn.Linear(E, self.H * self.D, bias=False)
-            self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
-            self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=False)
+            ab = getattr(cfg, "attention_bias", False)
+            self.q_proj = nn.Linear(E, self.H * self.D, bias=ab)
+            self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
+            self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
             self.o_proj = nn.Linear(E, E, bias=False)
 
     def _qkv(self, p, x, B, T):

@@ -443,3 +443,68 @@ class _LlamaShim:
 
     def state_dict(self):
         return self._model.state_dict()
+
+
+def qwen2_from_hf(hf_model):
+    """(LlamaConfig, params) for apex_tpu.models.Llama from a
+    transformers Qwen2Model / Qwen2ForCausalLM.
+
+    Qwen2 is the Llama architecture with biases on the Q/K/V
+    projections (o_proj and the MLP stay bias-free) and an optional
+    sliding window — both expressed as LlamaConfig options
+    (``attention_bias=True``, ``sliding_window=...``)."""
+    import numpy as _np
+    from ..models import LlamaConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "silu") != "silu":
+        raise ValueError(f"unsupported activation {hc.hidden_act!r}")
+    window = (hc.sliding_window
+              if getattr(hc, "use_sliding_window", False) else None)
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=hc.num_key_value_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.rms_norm_eps, rope_theta=hc.rope_theta,
+        tie_word_embeddings=hc.tie_word_embeddings,
+        attention_bias=True, sliding_window=window)
+    sd = hf_model.state_dict()
+    base = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def w(name, bias=False):
+        out = {"weight": _t(sd[f"{name}.weight"])}
+        if bias:
+            out["bias"] = _t(sd[f"{name}.bias"])
+        return out
+
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"{base}layers.{i}"
+        layers[str(i)] = {
+            "input_layernorm": w(f"{b}.input_layernorm"),
+            "self_attn": {
+                "q_proj": w(f"{b}.self_attn.q_proj", bias=True),
+                "k_proj": w(f"{b}.self_attn.k_proj", bias=True),
+                "v_proj": w(f"{b}.self_attn.v_proj", bias=True),
+                "o_proj": w(f"{b}.self_attn.o_proj"),
+            },
+            "post_attention_layernorm": w(
+                f"{b}.post_attention_layernorm"),
+            "mlp": {k: w(f"{b}.mlp.{k}")
+                    for k in ("gate_proj", "up_proj", "down_proj")},
+        }
+    params = {
+        "embed_tokens": w(f"{base}embed_tokens"),
+        "layers": layers,
+        "norm": w(f"{base}norm"),
+    }
+    if not hc.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"weight": _t(sd["lm_head.weight"])}
+        else:
+            params["lm_head"] = {"weight": _np.zeros(
+                (hc.vocab_size, hc.hidden_size), _np.float32)}
+    return cfg, _to_jnp(params)
