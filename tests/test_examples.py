@@ -164,3 +164,29 @@ def test_llama_example_smoke():
               "--generate", "8"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "sample:" in r.stdout, r.stdout[-500:]
+
+
+def test_cross_process_tp_parity():
+    """Tensor parallelism across a REAL process boundary: the Megatron
+    f/g collectives and vocab-parallel cross-entropy psums running
+    over jax.distributed (2 processes x 1 device) must reproduce the
+    single-process 2-device mesh trajectory bitwise."""
+    single = _run(["tests/cross_process_tp_trainee.py"], extra_env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert single.returncode == 0, single.stderr[-2000:]
+
+    multi = _run(["-m", "apex_tpu.parallel.multiproc", "--nprocs", "2",
+                  "--backend", "cpu",
+                  "tests/cross_process_tp_trainee.py"])
+    assert multi.returncode == 0, multi.stderr[-2000:]
+
+    def lines(out, prefix):
+        return [ln for ln in out.splitlines() if ln.startswith(prefix)]
+
+    traj_s = lines(single.stdout, "traj")
+    assert len(traj_s) == 6
+    assert traj_s == lines(multi.stdout, "traj")
+    assert (lines(single.stdout, "param summary")
+            == lines(multi.stdout, "param summary"))
+    assert "world 1 processes 2 devices" in single.stdout
+    assert "world 2 processes 2 devices" in multi.stdout
