@@ -8,4 +8,5 @@ reference's LAMB stage1/stage2 kernel semantics (SURVEY.md §2.2 gap).
 from .base import Optimizer, SGD, SGDState, resolve_lr
 from .fused_adam import FusedAdam, AdamState
 from .fused_lamb import FusedLAMB, LambState
+from .fused_lion import FusedLion, LionState
 from .fp16_optimizer import FP16_Optimizer, FP16OptState

@@ -13,8 +13,9 @@ from .profiler import (range_push, range_pop, nvtx_range, annotate,
                        AverageMeter)
 from .checkpoint import (save_checkpoint, restore_checkpoint, latest_step,
                          available_steps)
+from . import ema
 
-__all__ = ["range_push", "range_pop", "nvtx_range", "annotate",
+__all__ = ["ema", "range_push", "range_pop", "nvtx_range", "annotate",
            "start_profile", "stop_profile", "profile", "AverageMeter",
            "save_checkpoint", "restore_checkpoint", "latest_step",
            "available_steps"]

@@ -1,0 +1,54 @@
+"""Exponential moving average of parameters (Polyak averaging).
+
+Standard eval-time smoothing (beyond the 2019 reference's scope, but
+table stakes for a training toolkit): keep a decayed running average of
+the param pytree and evaluate/serve with it.  Functional state —
+``(avg, step)`` — so it rides the jit train step like optimizer state::
+
+    ema_state = ema.init(params)
+    ...inside the step...
+    ema_state = ema.update(ema_state, params, decay=0.999)
+    ...at eval...
+    eval_params = ema.value(ema_state, decay=0.999)   # debiased
+
+``value`` divides by ``1 - decay**step`` (Adam-style debias), so early
+checkpoints are unbiased instead of shrunk toward the zero init.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["EmaState", "init", "update", "value"]
+
+
+class EmaState(NamedTuple):
+    avg: Any          # pytree matching params, fp32
+    step: jax.Array   # int32; number of updates applied
+
+
+def init(params: Any) -> EmaState:
+    return EmaState(
+        avg=jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        step=jnp.zeros((), jnp.int32))
+
+
+def update(state: EmaState, params: Any, decay: float = 0.999
+           ) -> EmaState:
+    avg = jax.tree_util.tree_map(
+        lambda a, p: decay * a + (1.0 - decay) * p.astype(jnp.float32),
+        state.avg, params)
+    return EmaState(avg=avg, step=state.step + 1)
+
+
+def value(state: EmaState, decay: float = 0.999) -> Any:
+    """Debiased average, cast back to nothing (fp32 tree) — cast to the
+    model dtype at the call site if needed."""
+    corr = 1.0 - jnp.power(jnp.asarray(decay, jnp.float32),
+                           state.step.astype(jnp.float32))
+    corr = jnp.maximum(corr, 1e-12)
+    return jax.tree_util.tree_map(lambda a: a / corr, state.avg)

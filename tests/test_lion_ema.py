@@ -1,0 +1,108 @@
+"""FusedLion (flat-buffer sign-momentum) vs a per-tensor numpy oracle,
+amp O2 composition, and the EMA utility (debias, convergence,
+jit-step integration)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, models, optimizers
+from apex_tpu.utils import ema
+
+
+def test_lion_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(5, 3), jnp.float32),
+              "b": jnp.asarray(rng.randn(7), jnp.float32)}
+    opt = optimizers.FusedLion(lr=0.01, betas=(0.9, 0.99),
+                               weight_decay=0.1)
+    state = opt.init(params)
+
+    ref = {k: np.asarray(v).copy() for k, v in params.items()}
+    mom = {k: np.zeros_like(v) for k, v in ref.items()}
+    for t in range(5):
+        grads = {k: jnp.asarray(rng.randn(*v.shape), jnp.float32)
+                 for k, v in params.items()}
+        params, state = opt.step(params, state, grads)
+        for k in ref:
+            g = np.asarray(grads[k])
+            u = np.sign(0.9 * mom[k] + 0.1 * g)
+            ref[k] -= 0.01 * (u + 0.1 * ref[k])
+            mom[k] = 0.99 * mom[k] + 0.01 * g
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(params[k]), ref[k],
+                                   rtol=1e-5, atol=1e-6)
+    assert int(state.step) == 5
+
+
+def test_lion_grad_scale_and_half_out():
+    params = {"w": jnp.ones((8,), jnp.float32)}
+    opt = optimizers.FusedLion(lr=0.1)
+    state = opt.init(params)
+    g = {"w": jnp.full((8,), 4.0)}
+    # scale=4 -> unscaled grad 1.0; sign path identical either way, so
+    # check via the momentum buffer
+    p1, s1 = opt.step(params, state, g, scale=4.0)
+    np.testing.assert_allclose(np.asarray(s1.m), (1 - 0.99) * 1.0,
+                               rtol=1e-5)
+    out = opt.step(params, state, g, scale=4.0,
+                   output_params_dtype=jnp.bfloat16)
+    assert out[2].dtype == jnp.bfloat16
+
+
+def test_lion_trains_gpt_under_amp_o2():
+    model, opt = amp.initialize(
+        models.GPT(models.GPTConfig(vocab_size=97, block_size=16,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0)),
+        optimizers.FusedLion(lr=1e-3), opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (2, 16)))
+
+    @jax.jit
+    def step(params, ost):
+        def loss_fn(p):
+            return model.loss(p, ids), ()
+        loss, _, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        params, ost, _ = opt.step(params, ost, g)
+        return params, ost, loss
+
+    first = None
+    for _ in range(30):
+        params, ost, loss = step(params, ost)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 0.3, (first, float(loss))
+
+
+def test_ema_debias_and_convergence():
+    params = {"w": jnp.full((4,), 2.0)}
+    st = ema.init(params)
+    st = ema.update(st, params, decay=0.9)
+    # debiased first step == params exactly
+    np.testing.assert_allclose(
+        np.asarray(ema.value(st, decay=0.9)["w"]), 2.0, rtol=1e-6)
+    for _ in range(200):
+        st = ema.update(st, params, decay=0.9)
+    np.testing.assert_allclose(
+        np.asarray(ema.value(st, decay=0.9)["w"]), 2.0, rtol=1e-6)
+
+
+def test_ema_rides_the_jit_step():
+    params = {"w": jnp.zeros((3,))}
+    st = ema.init(params)
+
+    @jax.jit
+    def step(params, st):
+        params = {"w": params["w"] + 1.0}
+        return params, ema.update(st, params, decay=0.5)
+
+    for _ in range(3):
+        params, st = step(params, st)
+    # avg of 1,2,3 with decay .5 debiased: (0.125*1+... ) check value
+    v = float(ema.value(st, decay=0.5)["w"][0])
+    expect = (0.5 ** 2 * 0.5 * 1 + 0.5 * 0.5 * 2 + 0.5 * 3) \
+        / (1 - 0.5 ** 3)
+    np.testing.assert_allclose(v, expect, rtol=1e-6)
