@@ -190,3 +190,12 @@ def test_cross_process_tp_parity():
             == lines(multi.stdout, "param summary"))
     assert "world 1 processes 2 devices" in single.stdout
     assert "world 2 processes 2 devices" in multi.stdout
+
+
+@pytest.mark.slow
+def test_serving_demo_smoke():
+    r = _run(["examples/serving/demo.py", "--batch", "2", "--prompt",
+              "8", "--new", "8", "--layers", "2", "--width", "32"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "speculative == greedy: True" in r.stdout
+    assert "done" in r.stdout
