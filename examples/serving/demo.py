@@ -17,8 +17,14 @@ relative costs.  Usage:
 """
 
 import argparse
+import os
 import sys
 import time
+
+_repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", ".."))
+if _repo not in sys.path:
+    sys.path.insert(0, _repo)
 
 import numpy as np
 
