@@ -39,7 +39,9 @@ class LlamaConfig:
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  head_chunk=8192, sp_axis=None, tp_axis=None,
-                 remat=None, sliding_window=None, attention_bias=False):
+                 remat=None, sliding_window=None, attention_bias=False,
+                 head_dim=None, mlp_act="silu", rms_unit_offset=False,
+                 embed_scale=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -104,16 +106,33 @@ class LlamaConfig:
                 "(ParallelSelfAttention biases all projections incl. "
                 "out)")
         self.attention_bias = attention_bias
+        # Gemma-family knobs: per-head dim decoupled from hidden_size
+        # (gemma-7b: 16 heads x 256 > 3072), GeGLU MLP activation,
+        # (1 + w) RMSNorm scaling, sqrt(hidden) embedding scale
+        self.head_dim = (head_dim if head_dim is not None
+                         else hidden_size // num_attention_heads)
+        if head_dim is not None and tp_axis is not None:
+            raise NotImplementedError(
+                "custom head_dim under tensor parallelism is not wired")
+        if mlp_act not in ("silu", "gelu_tanh"):
+            raise ValueError(f"mlp_act={mlp_act!r} not in "
+                             f"('silu', 'gelu_tanh')")
+        self.mlp_act = mlp_act
+        self.rms_unit_offset = rms_unit_offset
+        self.embed_scale = embed_scale
 
 
 class RMSNorm(nn.Module):
     """x * rsqrt(mean(x^2) + eps) * w — stats in fp32 (the norm is on
     amp's fp32 side, like LayerNorm), output in the input dtype."""
 
-    def __init__(self, dim: int, eps: float = 1e-6):
+    def __init__(self, dim: int, eps: float = 1e-6,
+                 unit_offset: bool = False):
         super().__init__()
         self.dim = dim
         self.eps = eps
+        # Gemma convention: scale by (1 + w), checkpoint stores w
+        self.unit_offset = unit_offset
 
     def create_params(self, key):
         return {"weight": jnp.ones((self.dim,), jnp.float32)}
@@ -122,7 +141,10 @@ class RMSNorm(nn.Module):
         xf = x.astype(jnp.float32)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
         y = xf * lax.rsqrt(var + self.eps)
-        return (y * p["weight"].astype(jnp.float32)).astype(x.dtype)
+        w = p["weight"].astype(jnp.float32)
+        if self.unit_offset:
+            w = 1.0 + w
+        return (y * w).astype(x.dtype)
 
 
 def _rope_cos_sin(pos, head_dim, theta, dtype):
@@ -159,7 +181,7 @@ class LlamaAttention(nn.Module):
         super().__init__()
         self.H = cfg.num_attention_heads
         self.Hkv = cfg.num_key_value_heads
-        self.D = cfg.hidden_size // cfg.num_attention_heads
+        self.D = cfg.head_dim
         self.theta = cfg.rope_theta
         self.sp = cfg.sp_axis
         self.tp = cfg.tp_axis is not None
@@ -176,7 +198,7 @@ class LlamaAttention(nn.Module):
             self.q_proj = nn.Linear(E, self.H * self.D, bias=ab)
             self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
             self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
-            self.o_proj = nn.Linear(E, E, bias=False)
+            self.o_proj = nn.Linear(self.H * self.D, E, bias=False)
 
     def _qkv(self, p, x, B, T):
         q = self.q_proj(p["q_proj"], x).reshape(B, T, self.H, self.D)
@@ -207,7 +229,8 @@ class LlamaAttention(nn.Module):
             mask = self._with_band(mask, T)
             ctx = dot_product_attention(q, k, v, mask, causal=True,
                                         dropout_rate=0.0)
-        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(
+            B, T, self.H * self.D)
         return self.o_proj(p["o_proj"], ctx)
 
     def _with_band(self, mask, T):
@@ -235,7 +258,8 @@ class LlamaAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=1)
         ctx = dot_product_attention(q, k, v, self._with_band(None, T),
                                     causal=True, dropout_rate=0.0)
-        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(
+            B, T, self.H * self.D)
         return self.o_proj(p["o_proj"], ctx), kc, vc
 
     def decode(self, p, x, pos, cache):
@@ -286,13 +310,15 @@ class LlamaAttention(nn.Module):
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vf).astype(x.dtype)
-        return self.o_proj(p["o_proj"], ctx.reshape(B, 1, E)), cache
+        return self.o_proj(
+            p["o_proj"], ctx.reshape(B, 1, self.H * self.D)), cache
 
 
 class LlamaMLP(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.tp_axis = cfg.tp_axis
+        self.act = getattr(cfg, "mlp_act", "silu")
         if cfg.tp_axis is not None:
             from ..parallel.tensor_parallel import (ColumnParallelLinear,
                                                     RowParallelLinear)
@@ -319,19 +345,22 @@ class LlamaMLP(nn.Module):
         if self.tp_axis is not None:
             from ..parallel.tensor_parallel import copy_to_model_parallel
             x = copy_to_model_parallel(x, self.tp_axis)
+        act = F.silu if self.act == "silu" else F.gelu
         return self.down_proj(
             p["down_proj"],
-            F.silu(self.gate_proj(p["gate_proj"], x))
+            act(self.gate_proj(p["gate_proj"], x))
             * self.up_proj(p["up_proj"], x))
 
 
 class LlamaBlock(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
-        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        uo = getattr(cfg, "rms_unit_offset", False)
+        self.input_layernorm = RMSNorm(cfg.hidden_size,
+                                       cfg.rms_norm_eps, uo)
         self.self_attn = LlamaAttention(cfg)
-        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
-                                                cfg.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(
+            cfg.hidden_size, cfg.rms_norm_eps, uo)
         self.mlp = LlamaMLP(cfg)
 
     def forward(self, p, x, mask=None):
@@ -366,7 +395,8 @@ class Llama(nn.Module):
         self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.layers = nn.ModuleList(
             [self.block_cls(cfg) for _ in range(cfg.num_hidden_layers)])
-        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                            getattr(cfg, "rms_unit_offset", False))
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias=False)
@@ -394,6 +424,8 @@ class Llama(nn.Module):
                              f"max_position_embeddings "
                              f"{self.cfg.max_position_embeddings}")
         x = self.embed_tokens(p["embed_tokens"], input_ids)
+        if self.cfg.embed_scale:
+            x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, x.dtype)
         m = None
         if mask is not None:
             m = mask[:, None, None, :].astype(bool)
@@ -487,8 +519,7 @@ class Llama(nn.Module):
     def init_cache(self, batch_size: int, dtype=jnp.float32):
         cfg = self.cfg
         shape = (batch_size, cfg.num_key_value_heads,
-                 cfg.max_position_embeddings,
-                 cfg.hidden_size // cfg.num_attention_heads)
+                 cfg.max_position_embeddings, cfg.head_dim)
         layer = {"k": jnp.zeros(shape, dtype),
                  "v": jnp.zeros(shape, dtype)}
         if dtype == jnp.int8:
@@ -503,6 +534,8 @@ class Llama(nn.Module):
         steps can skip the full-vocab matmul (GPT's contract)."""
         new_cache = {}
         x = self.embed_tokens(p["embed_tokens"], token[:, None])
+        if self.cfg.embed_scale:
+            x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, x.dtype)
         for i in range(self.cfg.num_hidden_layers):
             li = str(i)
             x, new_cache[li] = self.layers[i].decode(
@@ -550,6 +583,9 @@ class Llama(nn.Module):
         if prefill_mode == "chunked":
             from ._cache import seed_layer
             x = self.embed_tokens(p["embed_tokens"], input_ids)
+            if self.cfg.embed_scale:
+                x = x * jnp.asarray(self.cfg.hidden_size ** 0.5,
+                                    x.dtype)
             for i in range(self.cfg.num_hidden_layers):
                 li = str(i)
                 x, k, v = self.layers[i].prefill(p["layers"][li], x)

@@ -508,3 +508,57 @@ def qwen2_from_hf(hf_model):
             params["lm_head"] = {"weight": _np.zeros(
                 (hc.vocab_size, hc.hidden_size), _np.float32)}
     return cfg, _to_jnp(params)
+
+
+def gemma_from_hf(hf_model):
+    """(LlamaConfig, params) for apex_tpu.models.Llama from a
+    transformers GemmaModel / GemmaForCausalLM.
+
+    Gemma on the Llama backbone = four config knobs: decoupled
+    ``head_dim`` (gemma-7b: 16 heads x 256 over hidden 3072), GeGLU
+    (``mlp_act="gelu_tanh"`` — HF's gelu_pytorch_tanh), ``(1 + w)``
+    RMSNorm scaling (checkpoints store w), and the sqrt(hidden)
+    embedding scale.  The state_dict key layout is Llama's."""
+    import numpy as _np
+    from ..models import LlamaConfig
+
+    hc = hf_model.config
+    act = getattr(hc, "hidden_act", None) \
+        or getattr(hc, "hidden_activation", None)
+    if act not in ("gelu", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported activation {act!r}")
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        num_key_value_heads=hc.num_key_value_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.rms_norm_eps, rope_theta=hc.rope_theta,
+        tie_word_embeddings=True, head_dim=hc.head_dim,
+        mlp_act="gelu_tanh", rms_unit_offset=True, embed_scale=True)
+    sd = hf_model.state_dict()
+    base = "model." if "model.embed_tokens.weight" in sd else ""
+
+    def w(name):
+        return {"weight": _t(sd[f"{name}.weight"])}
+
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"{base}layers.{i}"
+        layers[str(i)] = {
+            "input_layernorm": w(f"{b}.input_layernorm"),
+            "self_attn": {k: w(f"{b}.self_attn.{k}")
+                          for k in ("q_proj", "k_proj", "v_proj",
+                                    "o_proj")},
+            "post_attention_layernorm": w(
+                f"{b}.post_attention_layernorm"),
+            "mlp": {k: w(f"{b}.mlp.{k}")
+                    for k in ("gate_proj", "up_proj", "down_proj")},
+        }
+    params = {
+        "embed_tokens": w(f"{base}embed_tokens"),
+        "layers": layers,
+        "norm": w(f"{base}norm"),
+    }
+    return cfg, _to_jnp(params)
