@@ -181,6 +181,42 @@ class GPTSelfAttention(nn.Module):
         ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, T, E)
         return self.out(p["out"], ctx), kc, vc
 
+    def decode_chunk(self, p, x, pos, cache):
+        """L-token cached step at PER-ROW positions (the speculative-
+        verify workhorse; contract mirrors LlamaAttention.decode_chunk;
+        bf16/fp32 caches only)."""
+        if cache["k"].dtype == jnp.int8:
+            raise NotImplementedError(
+                "decode_chunk with an int8 cache is not wired; use the "
+                "single-token decode path or a bf16 cache")
+        B, L, E = x.shape
+        S = cache["k"].shape[2]
+        q, k, v = self._split_qkv(self.qkv(p["qkv"], x), B, L)
+
+        def put(buf, val):
+            return jax.vmap(
+                lambda b, vv, p0: jax.lax.dynamic_update_slice(
+                    b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
+
+        cache = dict(cache)
+        cache["k"] = put(cache["k"], k)
+        cache["v"] = put(cache["v"], v)
+        kf = cache["k"].astype(jnp.float32)
+        vf = cache["v"].astype(jnp.float32)
+        G = self.n_head // self.n_kv
+        qg = q.reshape(B, self.n_kv, G, L, self.head_dim)
+        scores = jnp.einsum("bkgld,bksd->bkgls",
+                            qg.astype(jnp.float32), kf)
+        scores = scores * (1.0 / (self.head_dim ** 0.5))
+        posL = pos[:, None] + jnp.arange(L)
+        valid = (jnp.arange(S)[None, None, None, None, :]
+                 <= posL[:, None, None, :, None])
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgls,bksd->bkgld", probs, vf).astype(x.dtype)
+        ctx = jnp.transpose(ctx, (0, 3, 1, 2, 4)).reshape(B, L, E)
+        return self.out(p["out"], ctx), cache
+
     def decode(self, p, x, pos, cache):
         """One-token step against the KV cache.
 
@@ -282,6 +318,14 @@ class GPTBlock(nn.Module):
         h = self.ln_2(p["ln_2"], x)
         h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
         return x + h, k, v
+
+    def decode_chunk(self, p, x, pos, cache):
+        a, cache = self.attn.decode_chunk(
+            p["attn"], self.ln_1(p["ln_1"], x), pos, cache)
+        x = x + a
+        h = self.ln_2(p["ln_2"], x)
+        h = self.proj(p["proj"], F.gelu(self.fc(p["fc"], h)))
+        return x + h, cache
 
 
 class GPT(nn.Module):
@@ -526,6 +570,38 @@ class GPT(nn.Module):
                                                 cache[li])
         return self.ln_f(p["ln_f"], x), new_cache
 
+    def prefill_cache(self, p, input_ids, cache=None, cache_dtype=None):
+        """Seed every layer's KV cache with ONE full-buffer forward
+        (models/_cache.py semantics; identical values to walking the
+        positions with decode)."""
+        from ._cache import seed_layer
+        B, S = input_ids.shape
+        if cache is None:
+            if cache_dtype is None:
+                cache_dtype = p["wte"]["weight"].dtype
+            cache = self.init_cache(B, dtype=cache_dtype)
+        x = (self.wte(p["wte"], input_ids)
+             + self.wpe(p["wpe"], jnp.arange(S)[None, :]))
+        for i in range(self.cfg.n_layer):
+            li = str(i)
+            x, k, v = self.h[i].prefill(p["h"][li], x)
+            cache[li] = seed_layer(cache[li], k, v)
+        return cache
+
+    def decode_chunk(self, p, tokens, pos, cache):
+        """Cached multi-token step at per-row positions: ``tokens``
+        (B, L) for positions ``[pos[b], pos[b]+L)`` -> (final hidden
+        (B, L, E), updated cache); head separate like _decode_hidden."""
+        B, L = tokens.shape
+        posL = pos[:, None] + jnp.arange(L)
+        x = (self.wte(p["wte"], tokens) + self.wpe(p["wpe"], posL))
+        new_cache = {}
+        for i in range(self.cfg.n_layer):
+            li = str(i)
+            x, new_cache[li] = self.h[i].decode_chunk(p["h"][li], x, pos,
+                                                      cache[li])
+        return self.ln_f(p["ln_f"], x), new_cache
+
     def _head(self, p, x):
         table = p["wte"]["weight"]
         return _head_matmul(x, table)
@@ -613,13 +689,7 @@ class GPT(nn.Module):
         cache = self.init_cache(B, dtype=cache_dtype)
         start = 0
         if prefill_mode == "chunked":
-            from ._cache import seed_layer
-            x = (self.wte(p["wte"], input_ids)
-                 + self.wpe(p["wpe"], jnp.arange(S)[None, :]))
-            for i in range(self.cfg.n_layer):
-                li = str(i)
-                x, k, v = self.h[i].prefill(p["h"][li], x)
-                cache[li] = seed_layer(cache[li], k, v)
+            cache = self.prefill_cache(p, input_ids, cache)
             # entries at positions >= first_gen - 1 are rewritten by
             # the loop before any later position reads them
             start = jnp.maximum(first_gen - 1, 0)

@@ -262,6 +262,53 @@ class LlamaAttention(nn.Module):
             B, T, self.H * self.D)
         return self.o_proj(p["o_proj"], ctx), kc, vc
 
+    def decode_chunk(self, p, x, pos, cache):
+        """L-token cached step at PER-ROW positions: ``x`` (B, L, E)
+        holds each row's tokens for positions ``[pos[b], pos[b]+L)``;
+        writes the chunk's post-RoPE K/V there and attends each chunk
+        query to cache keys <= its own position (within the sliding
+        window if set).  This is the speculative-verify workhorse: one
+        MXU pass scores gamma+1 proposals against the live cache.
+        bf16/fp32 caches only — the int8 per-position quantization
+        stays on the single-token path."""
+        if cache["k"].dtype == jnp.int8:
+            raise NotImplementedError(
+                "decode_chunk with an int8 cache is not wired; use the "
+                "single-token decode path or a bf16 cache")
+        B, L, E = x.shape
+        S = cache["k"].shape[2]
+        q, k, v = self._qkv(p, x, B, L)
+        posL = pos[:, None] + jnp.arange(L)                 # (B, L)
+        q, k = apply_rope(q, k, posL, self.theta)
+
+        def put(buf, val):
+            # per-row offsets: vmap a dynamic_update_slice over batch
+            return jax.vmap(
+                lambda b, vv, p0: lax.dynamic_update_slice(
+                    b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
+
+        cache = dict(cache)
+        cache["k"] = put(cache["k"], k)
+        cache["v"] = put(cache["v"], v)
+        kf = cache["k"].astype(jnp.float32)
+        vf = cache["v"].astype(jnp.float32)
+        G = self.H // self.Hkv
+        qg = q.reshape(B, self.Hkv, G, L, self.D)
+        scores = jnp.einsum("bkgld,bksd->bkgls",
+                            qg.astype(jnp.float32), kf)
+        scores = scores * (1.0 / (self.D ** 0.5))
+        kpos = jnp.arange(S)[None, None, None, None, :]
+        qpos = posL[:, None, None, :, None]
+        valid = kpos <= qpos
+        if self.window is not None:
+            valid = valid & (kpos > qpos - self.window)
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgls,bksd->bkgld", probs, vf).astype(x.dtype)
+        ctx = jnp.transpose(ctx, (0, 3, 1, 2, 4)).reshape(
+            B, L, self.H * self.D)
+        return self.o_proj(p["o_proj"], ctx), cache
+
     def decode(self, p, x, pos, cache):
         """One-token step; ``cache`` {"k","v"} (B, Hkv, S, D) (+int8
         scale sidecars) — RoPE applied at ``pos`` before the write, so
@@ -384,6 +431,14 @@ class LlamaBlock(nn.Module):
         x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x)), k, v
+
+    def decode_chunk(self, p, x, pos, cache):
+        a, cache = self.self_attn.decode_chunk(
+            p["self_attn"], self.input_layernorm(p["input_layernorm"], x),
+            pos, cache)
+        x = x + a
+        return x + self.mlp(p["mlp"], self.post_attention_layernorm(
+            p["post_attention_layernorm"], x)), cache
 
 
 class Llama(nn.Module):
@@ -547,6 +602,40 @@ class Llama(nn.Module):
         table = self._table(p)
         return F.matmul(x, table.T.astype(x.dtype))[:, 0], new_cache
 
+    def prefill_cache(self, p, input_ids, cache=None, cache_dtype=None):
+        """Seed every layer's KV cache with ONE full-buffer forward
+        (models/_cache.py semantics; identical values to walking the
+        positions with decode)."""
+        from ._cache import seed_layer
+        B, S = input_ids.shape
+        if cache is None:
+            if cache_dtype is None:
+                cache_dtype = self._table(p).dtype
+            cache = self.init_cache(B, dtype=cache_dtype)
+        x = self.embed_tokens(p["embed_tokens"], input_ids)
+        if self.cfg.embed_scale:
+            x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, x.dtype)
+        for i in range(self.cfg.num_hidden_layers):
+            li = str(i)
+            x, k, v = self.layers[i].prefill(p["layers"][li], x)
+            cache[li] = seed_layer(cache[li], k, v)
+        return cache
+
+    def decode_chunk(self, p, tokens, pos, cache):
+        """Cached multi-token step at per-row positions: ``tokens``
+        (B, L) for positions ``[pos[b], pos[b]+L)`` -> (final hidden
+        (B, L, E), updated cache).  The head stays separate (same
+        contract as _decode_hidden)."""
+        x = self.embed_tokens(p["embed_tokens"], tokens)
+        if self.cfg.embed_scale:
+            x = x * jnp.asarray(self.cfg.hidden_size ** 0.5, x.dtype)
+        new_cache = {}
+        for i in range(self.cfg.num_hidden_layers):
+            li = str(i)
+            x, new_cache[li] = self.layers[i].decode_chunk(
+                p["layers"][li], x, pos, cache[li])
+        return self.norm(p["norm"], x), new_cache
+
     def generate_cached(self, p, input_ids, prompt_len,
                         max_new_tokens: int, temperature: float = 0.0,
                         rng: Optional[jax.Array] = None,
@@ -581,15 +670,7 @@ class Llama(nn.Module):
         key = rng if rng is not None else jax.random.PRNGKey(0)
         start = 0
         if prefill_mode == "chunked":
-            from ._cache import seed_layer
-            x = self.embed_tokens(p["embed_tokens"], input_ids)
-            if self.cfg.embed_scale:
-                x = x * jnp.asarray(self.cfg.hidden_size ** 0.5,
-                                    x.dtype)
-            for i in range(self.cfg.num_hidden_layers):
-                li = str(i)
-                x, k, v = self.layers[i].prefill(p["layers"][li], x)
-                cache[li] = seed_layer(cache[li], k, v)
+            cache = self.prefill_cache(p, input_ids, cache)
             # entries at positions >= first_gen - 1 are rewritten by
             # the loop before any later position reads them
             start = jnp.maximum(first_gen - 1, 0)

@@ -18,12 +18,20 @@ one token per iteration.  Draft and target only need the shared
 family pairing works (GPT draft for a Llama target, etc.) as long as
 the tokenizer/vocab agree.
 
-Scope note: both models run full-prefix forwards per iteration (no KV
-cache reuse across iterations).  That keeps the verification exact and
-the program simple; the target-side win is running S-position scoring
-once per 1..gamma+1 accepted tokens instead of once per token.  A
-chunked cached-verify variant is the natural follow-up and would slot
-behind the same API.
+Two verification modes:
+
+- ``verify="cached"`` (default) — the serving path: both models keep
+  live KV caches (seeded by chunked prefill), the draft proposes with
+  single-token cached steps and the target scores all gamma+1
+  positions with ONE ``decode_chunk`` against its cache.  Per
+  iteration the target does O((gamma+1) * S) attention instead of a
+  full O(S^2) re-forward.  Rejected positions need no cache rewind:
+  entries past the accepted point are rewritten by the next
+  iteration's chunk before any query can attend them (the same
+  argument that makes chunked prefill safe).
+- ``verify="full"`` — both models re-run full-prefix forwards each
+  iteration; simplest-possible oracle, used to cross-check the cached
+  path in tests.
 """
 
 from __future__ import annotations
@@ -35,9 +43,17 @@ from jax import lax
 __all__ = ["generate_speculative"]
 
 
+def _head_logits(model, p, h):
+    """(B, L, V) logits from final hidden states, family-agnostic."""
+    if hasattr(model, "_head"):
+        return model._head(p, h)
+    table = model._table(p)
+    return jnp.matmul(h, table.T.astype(h.dtype))
+
+
 def generate_speculative(target, target_params, draft, draft_params,
                          input_ids, prompt_len, max_new_tokens: int,
-                         gamma: int = 4):
+                         gamma: int = 4, verify: str = "cached"):
     """Greedy speculative decoding.  Returns ``(ids, final_len)`` with
     the same contract as ``GPT.generate``: rows are left-aligned in the
     (B, S) buffer, generation stops at ``prompt_len + max_new_tokens``
@@ -45,6 +61,13 @@ def generate_speculative(target, target_params, draft, draft_params,
     buffer's content."""
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if verify not in ("cached", "full"):
+        raise ValueError(f"verify {verify!r} not in ('cached', 'full')")
+    if verify == "cached":
+        return _generate_cached_verify(target, target_params, draft,
+                                       draft_params, input_ids,
+                                       prompt_len, max_new_tokens,
+                                       gamma)
     B, S = input_ids.shape
     orig = jnp.asarray(input_ids)
     prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
@@ -119,4 +142,90 @@ def generate_speculative(target, target_params, draft, draft_params,
         return ids_new, new_len
 
     ids, cur_len = lax.while_loop(cond, body, (orig, prompt_len))
+    return ids, final_len
+
+
+def _generate_cached_verify(target, tp, draft, dp, input_ids,
+                            prompt_len, max_new_tokens: int,
+                            gamma: int):
+    B, S = input_ids.shape
+    L = gamma + 1
+    if L > S:
+        raise ValueError(f"gamma+1={L} exceeds the buffer length {S}")
+    orig = jnp.asarray(input_ids)
+    prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+    final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+    pgrid = jnp.arange(S)[None, :]
+
+    t_cache = target.prefill_cache(tp, orig)
+    d_cache = draft.prefill_cache(dp, orig)
+
+    def write_at(ids, pos, tok, can):
+        return jax.vmap(
+            lambda row, p, t, c: row.at[p].set(
+                jnp.where(c, t, row[p])))(
+            ids, jnp.minimum(pos, S - 1), tok, can)
+
+    def cond(carry):
+        _, cur_len, _, _ = carry
+        return jnp.any(cur_len < final_len)
+
+    def body(carry):
+        ids, cur_len, t_cache, d_cache = carry
+        active = cur_len < final_len
+
+        # 1. draft proposes gamma tokens with single-token cached
+        # steps at PER-ROW positions (posd = last known position)
+        ids_d, posd = ids, cur_len - 1
+        dtoks = []
+        for _ in range(gamma):
+            tok_in = jnp.take_along_axis(
+                ids_d, jnp.clip(posd, 0, S - 1)[:, None], axis=1)
+            h, d_cache = draft.decode_chunk(dp, tok_in, posd, d_cache)
+            t = jnp.argmax(_head_logits(draft, dp, h)[:, 0],
+                           axis=-1).astype(ids.dtype)
+            can = (posd + 1) < final_len
+            ids_d = write_at(ids_d, posd + 1, t, can)
+            dtoks.append(t)
+            posd = jnp.where(can, posd + 1, posd)
+        dtoks = jnp.stack(dtoks, axis=1)                   # (B, gamma)
+
+        # 2. target scores the whole chunk against its cache.  Chunk
+        # start clamps to S - L near the buffer end; `off` re-aligns
+        # the verify indices (re-ingested entries recompute to the
+        # same values — RoPE/positions follow the clamped start)
+        pos0 = jnp.clip(jnp.minimum(cur_len - 1, S - L), 0)
+        chunk = jnp.take_along_axis(
+            ids_d, pos0[:, None] + jnp.arange(L)[None, :], axis=1)
+        th, t_cache = target.decode_chunk(tp, chunk, pos0, t_cache)
+        tgt_next_all = jnp.argmax(_head_logits(target, tp, th),
+                                  axis=-1)                  # (B, L)
+        off = cur_len - 1 - pos0                            # (B,)
+        idx = jnp.clip(off[:, None] + jnp.arange(L)[None, :], 0, L - 1)
+        tgt_next = jnp.take_along_axis(tgt_next_all, idx, axis=1)
+
+        # 3. longest agreeing prefix (correction slot must fit)
+        offs = jnp.arange(gamma)[None, :]
+        agree = dtoks == tgt_next[:, :gamma].astype(dtoks.dtype)
+        eligible = (cur_len[:, None] + offs) < (final_len[:, None] - 1)
+        n_acc = jnp.sum(jnp.cumprod(agree & eligible, axis=1), axis=1)
+
+        # 4. corrected token = target's choice after the accepted run
+        ctok = jnp.take_along_axis(
+            tgt_next, jnp.clip(n_acc, 0, gamma)[:, None],
+            axis=1)[:, 0].astype(ids.dtype)
+
+        # 5. rebuild ids (accepted zone, correction, restore the rest)
+        corr_at = cur_len + n_acc
+        keep = pgrid < corr_at[:, None]
+        is_corr = (pgrid == corr_at[:, None]) & active[:, None]
+        ids_new = jnp.where(keep, ids_d,
+                            jnp.where(is_corr, ctok[:, None], orig))
+        new_len = jnp.where(active,
+                            jnp.minimum(corr_at + 1, final_len),
+                            cur_len)
+        return ids_new, new_len, t_cache, d_cache
+
+    ids, _, _, _ = lax.while_loop(
+        cond, body, (orig, prompt_len, t_cache, d_cache))
     return ids, final_len

@@ -97,3 +97,43 @@ def test_spec_decode_jits_and_validates():
     ref, _ = target.generate_cached(tp, ids, plen, 6)
     np.testing.assert_array_equal(np.asarray(out[0, :int(n[0])]),
                                   np.asarray(ref[0, :int(n[0])]))
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 6])
+def test_cached_verify_matches_full_verify(gamma):
+    """The serving path (live KV caches, decode_chunk scoring) must be
+    token-for-token identical to the full-reforward oracle."""
+    target, tp = _gpt(2, 32, 20)
+    draft, dp = _gpt(1, 16, 21)
+    ids, plen = _buf(np.random.RandomState(22), [5, 3])
+    full, n_f = generate_speculative(target, tp, draft, dp, ids, plen,
+                                     14, gamma=gamma, verify="full")
+    cached, n_c = generate_speculative(target, tp, draft, dp, ids,
+                                       plen, 14, gamma=gamma,
+                                       verify="cached")
+    np.testing.assert_array_equal(np.asarray(n_f), np.asarray(n_c))
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_cached_verify_llama_cross_family():
+    target = models.Llama(models.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        tie_word_embeddings=True))
+    tp, _ = target.init(jax.random.PRNGKey(23))
+    draft, dp = _gpt(1, 16, 24)
+    ids, plen = _buf(np.random.RandomState(25), [6])
+    ref, _ = target.generate_cached(tp, ids, plen, 10)
+    out, n = generate_speculative(target, tp, draft, dp, ids, plen,
+                                  10, gamma=3, verify="cached")
+    np.testing.assert_array_equal(np.asarray(out[0, :int(n[0])]),
+                                  np.asarray(ref[0, :int(n[0])]))
+
+
+def test_verify_mode_validation():
+    target, tp = _gpt(1, 16, 26)
+    with pytest.raises(ValueError, match="verify"):
+        generate_speculative(target, tp, target, tp,
+                             jnp.zeros((1, 32), jnp.int32), 4, 4,
+                             verify="magic")
