@@ -38,5 +38,6 @@ from . import models
 from . import utils
 from . import data
 from . import lora
+from . import serving
 
 __version__ = "0.1.0"

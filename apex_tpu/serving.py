@@ -1,0 +1,161 @@
+"""Continuous-batching serving engine (vLLM-lite, fixed slots).
+
+The reference toolkit predates LLM serving; generate_cached covers the
+static-batch case, and this engine covers the real serving shape:
+requests ARRIVE and FINISH at different times, and the decode step
+always runs the full slot batch so the MXU stays busy while individual
+sequences come and go.
+
+Design (deliberately simple — correctness over paging):
+
+- ``slots`` fixed sequences of length ``buf_len``; per-slot KV cache
+  rows inside the usual (B, Hkv, S, D) buffers;
+- ``add_request`` claims a free slot, seeds ITS cache row with a
+  chunked prefill of the prompt (one scatter per layer), no impact on
+  other slots;
+- ``step()`` is ONE jitted ``decode_chunk(L=1)`` over all slots at
+  per-slot positions (models/llama.py decode_chunk contract) + greedy
+  head; inactive slots decode garbage that is masked out host-side;
+- a request finishes on ``eos_token_id`` or its ``max_new_tokens``;
+  the slot frees immediately and can be reclaimed next ``add_request``.
+
+Exactness: a request's output is token-for-token what
+``generate_cached`` would produce for it alone — regardless of what
+other requests share the batch (pinned in tests/test_serving.py with
+staggered arrivals).
+
+Works with any model exposing ``prefill_cache`` / ``decode_chunk`` /
+``init_cache`` and a greedy head (GPT, Llama and its Mistral / Qwen2 /
+Gemma configs, Mixtral).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .models.speculative import _head_logits
+
+__all__ = ["Engine"]
+
+
+class _Request:
+    def __init__(self, rid, slot, prompt_len, max_new, eos):
+        self.rid = rid
+        self.slot = slot
+        self.prompt_len = prompt_len
+        self.max_new = max_new
+        self.eos = eos
+        self.generated: List[int] = []
+        self.done = False
+
+
+class Engine:
+    def __init__(self, model, params, slots: int, buf_len: int,
+                 cache_dtype=None):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.buf_len = buf_len
+        self.ids = jnp.zeros((slots, buf_len), jnp.int32)
+        self.cur_len = jnp.zeros((slots,), jnp.int32)
+        self.cache = model.init_cache(
+            slots, dtype=cache_dtype if cache_dtype is not None
+            else jnp.float32)
+        self._free = list(range(slots))
+        self._by_slot: Dict[int, _Request] = {}
+        self._finished: Dict[int, _Request] = {}
+        self._next_rid = 0
+
+        def _prefill_slot(ids, cache, slot, row):
+            """Seed one slot: prefill the row alone, scatter its cache
+            row into the batch cache."""
+            row_cache = model.prefill_cache(params, row[None, :],
+                                            jax.tree_util.tree_map(
+                lambda b: jnp.zeros((1,) + b.shape[1:], b.dtype), cache))
+            cache = jax.tree_util.tree_map(
+                lambda b, r: lax.dynamic_update_index_in_dim(
+                    b, r[0].astype(b.dtype), slot, axis=0),
+                cache, row_cache)
+            ids = lax.dynamic_update_index_in_dim(ids, row, slot, axis=0)
+            return ids, cache
+
+        self._prefill_slot = jax.jit(_prefill_slot)
+
+        def _step(ids, cur_len, cache):
+            pos = jnp.maximum(cur_len - 1, 0)
+            tok_in = jnp.take_along_axis(
+                ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
+            h, cache = model.decode_chunk(params, tok_in, pos, cache)
+            nxt = jnp.argmax(_head_logits(model, params, h)[:, 0],
+                             axis=-1).astype(jnp.int32)
+            can = cur_len < buf_len
+            ids = jax.vmap(
+                lambda row, p, t, c: row.at[p].set(
+                    jnp.where(c, t, row[p])))(
+                ids, jnp.minimum(cur_len, buf_len - 1), nxt, can)
+            return ids, jnp.where(can, cur_len + 1, cur_len), cache, nxt
+
+        self._step = jax.jit(_step)
+
+    # -- request lifecycle -------------------------------------------------
+    def add_request(self, prompt: Sequence[int],
+                    max_new_tokens: int,
+                    eos_token_id: Optional[int] = None) -> int:
+        """Claim a slot, prefill it, return the request id.  Raises
+        if no slot is free (callers queue outside)."""
+        if not self._free:
+            raise RuntimeError("no free slot; harvest finished "
+                               "requests or add capacity")
+        if len(prompt) < 1 or len(prompt) >= self.buf_len:
+            raise ValueError(f"prompt length {len(prompt)} not in "
+                             f"[1, {self.buf_len})")
+        slot = self._free.pop()
+        row = np.zeros((self.buf_len,), np.int32)
+        row[:len(prompt)] = prompt
+        self.ids, self.cache = self._prefill_slot(
+            self.ids, self.cache, slot, jnp.asarray(row))
+        self.cur_len = self.cur_len.at[slot].set(len(prompt))
+        rid = self._next_rid
+        self._next_rid += 1
+        self._by_slot[slot] = _Request(rid, slot, len(prompt),
+                                       max_new_tokens, eos_token_id)
+        return rid
+
+    def step(self) -> Dict[int, Any]:
+        """One batched decode step.  Returns {request_id: token} for
+        every live request that emitted this step; finished requests
+        free their slot (their last token, EOS included, is still
+        reported and recorded)."""
+        if not self._by_slot:
+            return {}
+        self.ids, self.cur_len, self.cache, nxt = self._step(
+            self.ids, self.cur_len, self.cache)
+        toks = np.asarray(nxt)
+        out: Dict[int, Any] = {}
+        for slot, req in list(self._by_slot.items()):
+            t = int(toks[slot])
+            req.generated.append(t)
+            out[req.rid] = t
+            hit_eos = req.eos is not None and t == req.eos
+            full = (len(req.generated) >= req.max_new
+                    or req.prompt_len + len(req.generated)
+                    >= self.buf_len)
+            if hit_eos or full:
+                req.done = True
+                del self._by_slot[slot]
+                self._free.append(slot)
+                self._finished[req.rid] = req
+        return out
+
+    def result(self, rid: int) -> List[int]:
+        """Generated tokens (incl. EOS if hit) for a finished request."""
+        return list(self._finished[rid].generated)
+
+    def live(self) -> int:
+        return len(self._by_slot)
