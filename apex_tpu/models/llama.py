@@ -275,6 +275,11 @@ class LlamaAttention(nn.Module):
             raise NotImplementedError(
                 "decode_chunk with an int8 cache is not wired; use the "
                 "single-token decode path or a bf16 cache")
+        if (self.window is not None
+                and cache["k"].shape[2] == self.window):
+            raise NotImplementedError(
+                "decode_chunk over a rolling cache is not wired; use "
+                "full-width caches for chunked verify/serving")
         B, L, E = x.shape
         S = cache["k"].shape[2]
         q, k, v = self._qkv(p, x, B, L)
@@ -323,10 +328,16 @@ class LlamaAttention(nn.Module):
         q, k = apply_rope(q, k, jnp.full((1,), pos), self.theta)
         q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
         q8 = cache["k"].dtype == jnp.int8
+        # rolling buffer: a cache exactly window-wide stores position p
+        # in slot p % W (Mistral's layout) — W entries instead of the
+        # full sequence; the slot's absolute position is reconstructed
+        # below for the validity mask
+        rolling = self.window is not None and S == self.window
+        wpos = (pos % S) if rolling else pos
 
         def put(buf, val):
             return lax.dynamic_update_slice_in_dim(
-                buf, val[:, :, None, :].astype(buf.dtype), pos, axis=2)
+                buf, val[:, :, None, :].astype(buf.dtype), wpos, axis=2)
 
         cache = dict(cache)
         if q8:
@@ -350,10 +361,16 @@ class LlamaAttention(nn.Module):
         qg = q.reshape(B, self.Hkv, G, self.D)
         scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32), kf)
         scores = scores * (1.0 / (self.D ** 0.5))
-        valid = jnp.arange(S)[None, None, None, :] <= pos
-        if self.window is not None:
-            valid = valid & (jnp.arange(S)[None, None, None, :]
-                             > pos - self.window)
+        if rolling:
+            # slot s holds absolute position pos - ((pos - s) mod W)
+            s_idx = jnp.arange(S)
+            p_s = pos - ((pos - s_idx) % S)
+            valid = (p_s >= 0)[None, None, None, :]
+        else:
+            valid = jnp.arange(S)[None, None, None, :] <= pos
+            if self.window is not None:
+                valid = valid & (jnp.arange(S)[None, None, None, :]
+                                 > pos - self.window)
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bkgs,bksd->bkgd", probs, vf).astype(x.dtype)
@@ -571,10 +588,19 @@ class Llama(nn.Module):
                                     axis=-1)[..., 0]
 
     # -- KV-cached decoding (mirrors GPT's fixed-buffer discipline) -----
-    def init_cache(self, batch_size: int, dtype=jnp.float32):
+    def init_cache(self, batch_size: int, dtype=jnp.float32,
+                   rolling: bool = False):
+        """``rolling=True`` (requires ``sliding_window``) allocates
+        window-wide buffers — position p lives in slot p % W, so cache
+        memory is O(window), not O(sequence); decode detects the layout
+        from the buffer width."""
         cfg = self.cfg
+        if rolling and cfg.sliding_window is None:
+            raise ValueError("rolling cache requires sliding_window")
+        width = (cfg.sliding_window if rolling
+                 else cfg.max_position_embeddings)
         shape = (batch_size, cfg.num_key_value_heads,
-                 cfg.max_position_embeddings, cfg.head_dim)
+                 width, cfg.head_dim)
         layer = {"k": jnp.zeros(shape, dtype),
                  "v": jnp.zeros(shape, dtype)}
         if dtype == jnp.int8:
@@ -642,7 +668,8 @@ class Llama(nn.Module):
                         cache_dtype=None,
                         top_k: Optional[int] = None,
                         top_p: Optional[float] = None,
-                        prefill_mode: str = "chunked"):
+                        prefill_mode: str = "chunked",
+                        rolling_cache: bool = False):
         """Fixed-buffer KV-cached greedy/sampled generation; one
         compiled program for any prompt length, prefill steps skipping
         the full-vocab head via ``lax.cond`` (GPT.generate_cached's
@@ -653,11 +680,18 @@ class Llama(nn.Module):
         ONE full-buffer forward (models/_cache.py) and starts the
         sequential loop at the earliest prompt end — prefill rides the
         MXU instead of min(prompt_len) dependent steps.  ``"step"``
-        restores the walk-every-position loop."""
+        restores the walk-every-position loop.
+
+        ``rolling_cache=True`` (sliding-window models) allocates
+        window-wide cache buffers (O(window) memory); the loop walks
+        every position ("step" prefill — slots fill as it goes), and
+        each step attends only the window's W entries."""
         from . import sampling
         if prefill_mode not in ("chunked", "step"):
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"('chunked', 'step')")
+        if rolling_cache:
+            prefill_mode = "step"     # slots fill as the loop walks
         B, S = input_ids.shape
         prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
         if temperature > 0.0 and rng is None:
@@ -666,7 +700,8 @@ class Llama(nn.Module):
         first_gen = jnp.min(prompt_len)
         if cache_dtype is None:
             cache_dtype = self._table(p).dtype
-        cache = self.init_cache(B, dtype=cache_dtype)
+        cache = self.init_cache(B, dtype=cache_dtype,
+                                rolling=rolling_cache)
         key = rng if rng is not None else jax.random.PRNGKey(0)
         start = 0
         if prefill_mode == "chunked":

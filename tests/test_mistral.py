@@ -97,3 +97,32 @@ def test_sliding_window_validation():
         LlamaConfig(sliding_window=0, **kw)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         LlamaConfig(sliding_window=4, sp_axis="sp", **kw)
+
+
+def test_rolling_cache_matches_full_cache():
+    """O(window) rolling KV cache: greedy generation identical to the
+    full-width cache (prompt longer than the window, generation
+    crossing several wrap-arounds)."""
+    _, m, params = _pair(window=5)
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, 151, (2, 9))
+    buf = jnp.zeros((2, 32), jnp.int32).at[:, :9].set(jnp.asarray(prompt))
+    full, n_full = m.generate_cached(params, buf, 9, 16)
+    roll, n_roll = m.generate_cached(params, buf, 9, 16,
+                                     rolling_cache=True)
+    np.testing.assert_array_equal(np.asarray(n_full),
+                                  np.asarray(n_roll))
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(roll))
+    # and the memory claim is real
+    assert m.init_cache(2, rolling=True)["0"]["k"].shape[2] == 5
+
+
+def test_rolling_cache_requires_window():
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=1,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=16,
+                      tie_word_embeddings=True)
+    m = Llama(cfg)
+    with pytest.raises(ValueError, match="rolling"):
+        m.init_cache(1, rolling=True)
