@@ -384,6 +384,45 @@ def main():
                   f"{'int8 weights' if int8_weights else 'bf16 params'}+"
                   f"{'int8' if int8_cache else 'bf16'} cache; {how}")
 
+    def engine_config(metric, cfg, slots, prompt, new_tokens,
+                      model_cls=None):
+        """Continuous-batching engine throughput: keep every slot busy
+        (re-admit a fresh request the moment one finishes) and measure
+        steady-state generated tokens/sec — includes the real per-step
+        host sync serving pays."""
+        from apex_tpu import serving
+        model = (model_cls or models.GPT)(cfg)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        ctx = getattr(cfg, "block_size", None) \
+            or cfg.max_position_embeddings
+        eng = serving.Engine(model, params, slots=slots, buf_len=ctx)
+        rng = np.random.RandomState(0)
+
+        def admit():
+            eng.add_request(list(rng.randint(0, cfg.vocab_size, prompt)),
+                            max_new_tokens=new_tokens)
+
+        for _ in range(slots):
+            admit()
+        for _ in range(5):                      # warmup + compile
+            eng.step()
+        t0 = time.perf_counter()
+        produced = 0
+        steps = max(3 * new_tokens, 30)
+        for _ in range(steps):
+            produced += len(eng.step())
+            while eng._free:
+                admit()
+        dt = time.perf_counter() - t0
+        emit(metric=metric, value=round(produced / dt, 1),
+             unit="tokens/sec/chip", vs_baseline=None,
+             note=f"continuous batching, {slots} slots, prompt="
+                  f"{prompt}, {new_tokens} new/request, slot re-admit "
+                  f"on finish")
+
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
         buf = jnp.ones((n,), jnp.float32)
@@ -542,6 +581,13 @@ def main():
                      max_position_embeddings=512,
                      tie_word_embeddings=True),
                  8, 64, 128, model_cls=models.Llama)),
+            ("gpt2_small_engine_decode_throughput",
+             lambda: engine_config(
+                 "gpt2_small_engine_decode_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 8, 64, 64)),
             # Mixtral family: top-2 SwiGLU MoE (8 experts) on the Llama
             # backbone — single-chip all experts run locally; the
             # number records MoE dispatch overhead vs the dense path
@@ -599,6 +645,13 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 8)),
+            ("gpt_tiny_engine_decode_throughput",
+             lambda: engine_config(
+                 "gpt_tiny_engine_decode_throughput",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 2, 4, 6)),
             ("mixtral_tiny_o2_train_throughput",
              lambda: gpt_config(
                  "mixtral_tiny_o2_train_throughput",
