@@ -41,7 +41,9 @@ class LlamaConfig:
                  head_chunk=8192, sp_axis=None, tp_axis=None,
                  remat=None, sliding_window=None, attention_bias=False,
                  head_dim=None, mlp_act="silu", rms_unit_offset=False,
-                 embed_scale=False):
+                 embed_scale=False, norm_type="rmsnorm",
+                 parallel_residual=False, rotary_pct=1.0,
+                 mlp_type="swiglu", attention_out_bias=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -120,6 +122,25 @@ class LlamaConfig:
         self.mlp_act = mlp_act
         self.rms_unit_offset = rms_unit_offset
         self.embed_scale = embed_scale
+        # GPT-NeoX/Pythia knobs: LayerNorm blocks, parallel residual
+        # (x + attn(ln1 x) + mlp(ln2 x)), partial rotary (first
+        # rotary_pct of each head's dims), biased 2-layer GeLU MLP
+        if norm_type not in ("rmsnorm", "layernorm"):
+            raise ValueError(f"norm_type={norm_type!r} not in "
+                             f"('rmsnorm', 'layernorm')")
+        self.norm_type = norm_type
+        self.parallel_residual = parallel_residual
+        if not 0.0 < rotary_pct <= 1.0:
+            raise ValueError(f"rotary_pct={rotary_pct} not in (0, 1]")
+        self.rotary_pct = rotary_pct
+        if mlp_type not in ("swiglu", "gelu_mlp"):
+            raise ValueError(f"mlp_type={mlp_type!r} not in "
+                             f"('swiglu', 'gelu_mlp')")
+        if mlp_type != "swiglu" and tp_axis is not None:
+            raise NotImplementedError(
+                "gelu_mlp under tensor parallelism is not wired")
+        self.mlp_type = mlp_type
+        self.attention_out_bias = attention_out_bias
 
 
 class RMSNorm(nn.Module):
@@ -186,6 +207,9 @@ class LlamaAttention(nn.Module):
         self.sp = cfg.sp_axis
         self.tp = cfg.tp_axis is not None
         self.window = getattr(cfg, "sliding_window", None)
+        # partial rotary (GPT-NeoX): first rot_dim dims rotate, the
+        # rest pass through
+        self.rot_dim = int(getattr(cfg, "rotary_pct", 1.0) * self.D)
         E = cfg.hidden_size
         if self.tp:
             from ..parallel.tensor_parallel import ParallelSelfAttention
@@ -198,7 +222,17 @@ class LlamaAttention(nn.Module):
             self.q_proj = nn.Linear(E, self.H * self.D, bias=ab)
             self.k_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
             self.v_proj = nn.Linear(E, self.Hkv * self.D, bias=ab)
-            self.o_proj = nn.Linear(self.H * self.D, E, bias=False)
+            self.o_proj = nn.Linear(
+                self.H * self.D, E,
+                bias=getattr(cfg, "attention_out_bias", False))
+
+    def _rope(self, q, k, pos):
+        if self.rot_dim >= self.D:
+            return apply_rope(q, k, pos, self.theta)
+        rd = self.rot_dim
+        q1, k1 = apply_rope(q[..., :rd], k[..., :rd], pos, self.theta)
+        return (jnp.concatenate([q1, q[..., rd:]], axis=-1),
+                jnp.concatenate([k1, k[..., rd:]], axis=-1))
 
     def _qkv(self, p, x, B, T):
         q = self.q_proj(p["q_proj"], x).reshape(B, T, self.H, self.D)
@@ -217,7 +251,7 @@ class LlamaAttention(nn.Module):
         if in_sp:
             # GLOBAL positions for this device's token shard
             pos = lax.axis_index(self.sp) * T + pos
-        q, k = apply_rope(q, k, pos, self.theta)
+        q, k = self._rope(q, k, pos)
         if self.Hkv != self.H:
             rep = self.H // self.Hkv
             k = jnp.repeat(k, rep, axis=1)
@@ -250,7 +284,7 @@ class LlamaAttention(nn.Module):
         written position by position)."""
         B, T, E = x.shape
         q, k, v = self._qkv(p, x, B, T)
-        q, k = apply_rope(q, k, jnp.arange(T), self.theta)
+        q, k = self._rope(q, k, jnp.arange(T))
         kc, vc = k, v
         if self.Hkv != self.H:
             rep = self.H // self.Hkv
@@ -284,7 +318,7 @@ class LlamaAttention(nn.Module):
         S = cache["k"].shape[2]
         q, k, v = self._qkv(p, x, B, L)
         posL = pos[:, None] + jnp.arange(L)                 # (B, L)
-        q, k = apply_rope(q, k, posL, self.theta)
+        q, k = self._rope(q, k, posL)
 
         def put(buf, val):
             # per-row offsets: vmap a dynamic_update_slice over batch
@@ -325,7 +359,7 @@ class LlamaAttention(nn.Module):
         B, _, E = x.shape
         S = cache["k"].shape[2]
         q, k, v = self._qkv(p, x, B, 1)
-        q, k = apply_rope(q, k, jnp.full((1,), pos), self.theta)
+        q, k = self._rope(q, k, jnp.full((1,), pos))
         q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
         q8 = cache["k"].dtype == jnp.int8
         # rolling buffer: a cache exactly window-wide stores position p
@@ -416,21 +450,53 @@ class LlamaMLP(nn.Module):
             * self.up_proj(p["up_proj"], x))
 
 
+class GeluMLP(nn.Module):
+    """GPT-NeoX 2-layer MLP: dense_h_to_4h -> exact gelu ->
+    dense_4h_to_h, biases throughout (param names match the HF
+    checkpoint keys for converter transparency)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.dense_h_to_4h = nn.Linear(cfg.hidden_size,
+                                       cfg.intermediate_size, bias=True)
+        self.dense_4h_to_h = nn.Linear(cfg.intermediate_size,
+                                       cfg.hidden_size, bias=True)
+
+    def forward(self, p, x):
+        return self.dense_4h_to_h(
+            p["dense_4h_to_h"],
+            F.gelu_exact(self.dense_h_to_4h(p["dense_h_to_4h"], x)))
+
+
+def _make_norm(cfg):
+    if getattr(cfg, "norm_type", "rmsnorm") == "layernorm":
+        from ..normalization import FusedLayerNorm
+        return FusedLayerNorm(cfg.hidden_size, eps=cfg.rms_norm_eps)
+    return RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                   getattr(cfg, "rms_unit_offset", False))
+
+
 class LlamaBlock(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
-        uo = getattr(cfg, "rms_unit_offset", False)
-        self.input_layernorm = RMSNorm(cfg.hidden_size,
-                                       cfg.rms_norm_eps, uo)
+        self.input_layernorm = _make_norm(cfg)
         self.self_attn = LlamaAttention(cfg)
-        self.post_attention_layernorm = RMSNorm(
-            cfg.hidden_size, cfg.rms_norm_eps, uo)
-        self.mlp = LlamaMLP(cfg)
+        self.post_attention_layernorm = _make_norm(cfg)
+        self.mlp = (GeluMLP(cfg)
+                    if getattr(cfg, "mlp_type", "swiglu") == "gelu_mlp"
+                    else LlamaMLP(cfg))
+        self.parallel_residual = getattr(cfg, "parallel_residual",
+                                         False)
 
     def forward(self, p, x, mask=None):
-        x = x + self.self_attn(p["self_attn"],
-                               self.input_layernorm(
-                                   p["input_layernorm"], x), mask)
+        a = self.self_attn(p["self_attn"],
+                           self.input_layernorm(
+                               p["input_layernorm"], x), mask)
+        if self.parallel_residual:      # NeoX: both norms see x
+            return x + a + self.mlp(
+                p["mlp"], self.post_attention_layernorm(
+                    p["post_attention_layernorm"], x))
+        x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x))
 
@@ -438,6 +504,10 @@ class LlamaBlock(nn.Module):
         a, cache = self.self_attn.decode(
             p["self_attn"], self.input_layernorm(p["input_layernorm"], x),
             pos, cache)
+        if self.parallel_residual:
+            return x + a + self.mlp(
+                p["mlp"], self.post_attention_layernorm(
+                    p["post_attention_layernorm"], x)), cache
         x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x)), cache
@@ -445,6 +515,10 @@ class LlamaBlock(nn.Module):
     def prefill(self, p, x):
         a, k, v = self.self_attn.prefill(
             p["self_attn"], self.input_layernorm(p["input_layernorm"], x))
+        if self.parallel_residual:
+            return x + a + self.mlp(
+                p["mlp"], self.post_attention_layernorm(
+                    p["post_attention_layernorm"], x)), k, v
         x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x)), k, v
@@ -453,6 +527,10 @@ class LlamaBlock(nn.Module):
         a, cache = self.self_attn.decode_chunk(
             p["self_attn"], self.input_layernorm(p["input_layernorm"], x),
             pos, cache)
+        if self.parallel_residual:
+            return x + a + self.mlp(
+                p["mlp"], self.post_attention_layernorm(
+                    p["post_attention_layernorm"], x)), cache
         x = x + a
         return x + self.mlp(p["mlp"], self.post_attention_layernorm(
             p["post_attention_layernorm"], x)), cache
@@ -467,8 +545,7 @@ class Llama(nn.Module):
         self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.layers = nn.ModuleList(
             [self.block_cls(cfg) for _ in range(cfg.num_hidden_layers)])
-        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
-                            getattr(cfg, "rms_unit_offset", False))
+        self.norm = _make_norm(cfg)
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias=False)

@@ -562,3 +562,85 @@ def gemma_from_hf(hf_model):
         "norm": w(f"{base}norm"),
     }
     return cfg, _to_jnp(params)
+
+
+def gpt_neox_from_hf(hf_model):
+    """(LlamaConfig, params) for apex_tpu.models.Llama from a
+    transformers GPTNeoXModel / GPTNeoXForCausalLM (Pythia et al.).
+
+    GPT-NeoX on the Llama backbone = LayerNorm blocks
+    (``norm_type="layernorm"``), parallel residual, partial rotary
+    (``rotary_pct``), biased fused QKV + output dense
+    (``attention_bias``/``attention_out_bias``), and the biased
+    2-layer GeLU MLP (``mlp_type="gelu_mlp"``).  The fused
+    ``query_key_value`` weight interleaves q/k/v PER HEAD — rows view
+    as (H, 3, D, hidden) and de-interleave into separate projections.
+    """
+    import numpy as _np
+    from ..models import LlamaConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "gelu") != "gelu":
+        raise ValueError(f"unsupported activation {hc.hidden_act!r}")
+    if not getattr(hc, "use_parallel_residual", True):
+        raise ValueError("use_parallel_residual=False NeoX variants "
+                         "are not mapped")
+    cfg = LlamaConfig(
+        vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
+        intermediate_size=hc.intermediate_size,
+        num_hidden_layers=hc.num_hidden_layers,
+        num_attention_heads=hc.num_attention_heads,
+        max_position_embeddings=hc.max_position_embeddings,
+        rms_norm_eps=hc.layer_norm_eps,
+        rope_theta=getattr(hc, "rotary_emb_base", 10000.0),
+        tie_word_embeddings=hc.tie_word_embeddings,
+        norm_type="layernorm", parallel_residual=True,
+        rotary_pct=getattr(hc, "rotary_pct", 1.0),
+        mlp_type="gelu_mlp", attention_bias=True,
+        attention_out_bias=True)
+    sd = hf_model.state_dict()
+    base = ("gpt_neox."
+            if "gpt_neox.embed_in.weight" in sd else "")
+    H = hc.num_attention_heads
+    D = hc.hidden_size // H
+
+    def wb(name):
+        return {"weight": _t(sd[f"{name}.weight"]),
+                "bias": _t(sd[f"{name}.bias"])}
+
+    def split_qkv(prefix):
+        w = _np.asarray(_t(sd[f"{prefix}.weight"]))   # (3E, E)
+        b = _np.asarray(_t(sd[f"{prefix}.bias"]))     # (3E,)
+        wv = w.reshape(H, 3, D, hc.hidden_size)
+        bv = b.reshape(H, 3, D)
+        out = {}
+        for j, k in enumerate(("q_proj", "k_proj", "v_proj")):
+            out[k] = {"weight": wv[:, j].reshape(H * D, hc.hidden_size),
+                      "bias": bv[:, j].reshape(H * D)}
+        return out
+
+    layers = {}
+    for i in range(hc.num_hidden_layers):
+        b = f"{base}layers.{i}"
+        at = split_qkv(f"{b}.attention.query_key_value")
+        at["o_proj"] = wb(f"{b}.attention.dense")
+        layers[str(i)] = {
+            "input_layernorm": wb(f"{b}.input_layernorm"),
+            "self_attn": at,
+            "post_attention_layernorm": wb(
+                f"{b}.post_attention_layernorm"),
+            "mlp": {"dense_h_to_4h": wb(f"{b}.mlp.dense_h_to_4h"),
+                    "dense_4h_to_h": wb(f"{b}.mlp.dense_4h_to_h")},
+        }
+    params = {
+        "embed_tokens": {"weight": _t(sd[f"{base}embed_in.weight"])},
+        "layers": layers,
+        "norm": wb(f"{base}final_layer_norm"),
+    }
+    if not hc.tie_word_embeddings:
+        if "embed_out.weight" in sd:
+            params["lm_head"] = {"weight": _t(sd["embed_out.weight"])}
+        else:
+            params["lm_head"] = {"weight": _np.zeros(
+                (hc.vocab_size, hc.hidden_size), _np.float32)}
+    return cfg, _to_jnp(params)

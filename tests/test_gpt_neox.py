@@ -1,0 +1,75 @@
+"""GPT-NeoX / Pythia on the Llama backbone: LayerNorm + parallel
+residual + partial rotary + interleaved fused QKV — HF logits and
+greedy generation parity."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import Llama, LlamaConfig
+
+
+def _pair(rotary_pct=0.25):
+    import torch
+    from transformers import (GPTNeoXConfig as HFConfig,
+                              GPTNeoXForCausalLM)
+    from apex_tpu.utils import hf_interop
+
+    hf_cfg = HFConfig(vocab_size=151, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=48,
+                      rotary_pct=rotary_pct,
+                      tie_word_embeddings=False,
+                      attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg, params = hf_interop.gpt_neox_from_hf(hf)
+    assert cfg.norm_type == "layernorm" and cfg.parallel_residual
+    assert cfg.rotary_pct == rotary_pct
+    return hf, Llama(cfg), params
+
+
+@pytest.mark.parametrize("rotary_pct", [0.25, 1.0])
+def test_neox_logits_match_transformers(rotary_pct):
+    import torch
+
+    hf, m, params = _pair(rotary_pct)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 151, (2, 24))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    out = np.asarray(m(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(out, ref, rtol=4e-4, atol=4e-4)
+
+
+def test_neox_greedy_generation_matches_transformers():
+    import torch
+
+    hf, m, params = _pair()
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, 151, (2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(prompt), max_new_tokens=10,
+                          do_sample=False).numpy()
+    buf = jnp.zeros((2, 48), jnp.int32).at[:, :6].set(jnp.asarray(prompt))
+    out, n = m.generate_cached(params, buf, 6, 10)
+    assert int(n[0]) == 16
+    # HF generate may stop early at its default eos_token_id; ours has
+    # no EOS concept — compare the prefix HF produced
+    np.testing.assert_array_equal(
+        np.asarray(out[:, :ref.shape[1]]), ref)
+    assert ref.shape[1] > 6          # it did generate something
+
+
+def test_neox_knob_validation():
+    kw = dict(vocab_size=97, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=1, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=16)
+    with pytest.raises(ValueError, match="norm_type"):
+        LlamaConfig(norm_type="batchnorm", **kw)
+    with pytest.raises(ValueError, match="rotary_pct"):
+        LlamaConfig(rotary_pct=0.0, **kw)
+    with pytest.raises(ValueError, match="mlp_type"):
+        LlamaConfig(mlp_type="moe", **kw)
