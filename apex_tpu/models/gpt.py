@@ -184,11 +184,7 @@ class GPTSelfAttention(nn.Module):
     def decode_chunk(self, p, x, pos, cache):
         """L-token cached step at PER-ROW positions (the speculative-
         verify workhorse; contract mirrors LlamaAttention.decode_chunk;
-        bf16/fp32 caches only)."""
-        if cache["k"].dtype == jnp.int8:
-            raise NotImplementedError(
-                "decode_chunk with an int8 cache is not wired; use the "
-                "single-token decode path or a bf16 cache")
+        int8 caches quantize the chunk per position)."""
         B, L, E = x.shape
         S = cache["k"].shape[2]
         q, k, v = self._split_qkv(self.qkv(p["qkv"], x), B, L)
@@ -199,10 +195,24 @@ class GPTSelfAttention(nn.Module):
                     b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
 
         cache = dict(cache)
-        cache["k"] = put(cache["k"], k)
-        cache["v"] = put(cache["v"], v)
-        kf = cache["k"].astype(jnp.float32)
-        vf = cache["v"].astype(jnp.float32)
+        if cache["k"].dtype == jnp.int8:
+            for name, val in (("k", k), ("v", v)):
+                f = val.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
+                scale = jnp.maximum(amax, 1e-12) / 127.0
+                cache[name] = put(cache[name], jnp.clip(
+                    jnp.round(f / scale), -127, 127))
+                cache[f"{name}_scale"] = put(cache[f"{name}_scale"],
+                                             scale)
+            kf = (cache["k"].astype(jnp.float32)
+                  * cache["k_scale"].astype(jnp.float32))
+            vf = (cache["v"].astype(jnp.float32)
+                  * cache["v_scale"].astype(jnp.float32))
+        else:
+            cache["k"] = put(cache["k"], k)
+            cache["v"] = put(cache["v"], v)
+            kf = cache["k"].astype(jnp.float32)
+            vf = cache["v"].astype(jnp.float32)
         G = self.n_head // self.n_kv
         qg = q.reshape(B, self.n_kv, G, L, self.head_dim)
         scores = jnp.einsum("bkgld,bksd->bkgls",

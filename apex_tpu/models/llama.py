@@ -303,12 +303,8 @@ class LlamaAttention(nn.Module):
         query to cache keys <= its own position (within the sliding
         window if set).  This is the speculative-verify workhorse: one
         MXU pass scores gamma+1 proposals against the live cache.
-        bf16/fp32 caches only — the int8 per-position quantization
-        stays on the single-token path."""
-        if cache["k"].dtype == jnp.int8:
-            raise NotImplementedError(
-                "decode_chunk with an int8 cache is not wired; use the "
-                "single-token decode path or a bf16 cache")
+        int8 caches quantize the chunk per position (the same
+        amax/127 sidecar math as the single-token path)."""
         if (self.window is not None
                 and cache["k"].shape[2] == self.window):
             raise NotImplementedError(
@@ -327,10 +323,24 @@ class LlamaAttention(nn.Module):
                     b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
 
         cache = dict(cache)
-        cache["k"] = put(cache["k"], k)
-        cache["v"] = put(cache["v"], v)
-        kf = cache["k"].astype(jnp.float32)
-        vf = cache["v"].astype(jnp.float32)
+        if cache["k"].dtype == jnp.int8:
+            for name, val in (("k", k), ("v", v)):
+                f = val.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
+                scale = jnp.maximum(amax, 1e-12) / 127.0
+                cache[name] = put(cache[name], jnp.clip(
+                    jnp.round(f / scale), -127, 127))
+                cache[f"{name}_scale"] = put(cache[f"{name}_scale"],
+                                             scale)
+            kf = (cache["k"].astype(jnp.float32)
+                  * cache["k_scale"].astype(jnp.float32))
+            vf = (cache["v"].astype(jnp.float32)
+                  * cache["v_scale"].astype(jnp.float32))
+        else:
+            cache["k"] = put(cache["k"], k)
+            cache["v"] = put(cache["v"], v)
+            kf = cache["k"].astype(jnp.float32)
+            vf = cache["v"].astype(jnp.float32)
         G = self.H // self.Hkv
         qg = q.reshape(B, self.Hkv, G, L, self.D)
         scores = jnp.einsum("bkgld,bksd->bkgls",

@@ -49,3 +49,45 @@ def test_prefill_mode_validation():
     with pytest.raises(ValueError, match="prefill_mode"):
         m.generate_cached(params, jnp.zeros((1, 24), jnp.int32), 4, 2,
                           prefill_mode="lazy")
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_decode_chunk_int8_matches_sequential_int8(family):
+    """Chunked decode over an int8 cache must reproduce the
+    single-token int8 walk exactly (identical per-position amax/127
+    quantization)."""
+    m = _models()[family]
+    params, _ = m.init(jax.random.PRNGKey(5))
+    toks = jnp.asarray(np.random.RandomState(5).randint(0, 64, (2, 10)),
+                       jnp.int32)
+
+    cache = m.init_cache(2, dtype=jnp.int8)
+    hs = []
+    for i in range(10):
+        h, cache = m._decode_hidden(params, toks[:, i], i, cache)
+        hs.append(h[:, 0])
+    seq_h = jnp.stack(hs, 1)
+
+    cache = m.init_cache(2, dtype=jnp.int8)
+    for i in range(4):
+        _, cache = m._decode_hidden(params, toks[:, i], i, cache)
+    ch_h, ch_cache = m.decode_chunk(params, toks[:, 4:],
+                                    jnp.asarray([4, 4]), cache)
+    np.testing.assert_allclose(np.asarray(seq_h[:, 4:]),
+                               np.asarray(ch_h), rtol=2e-5, atol=2e-5)
+
+
+def test_engine_int8_cache_matches_solo():
+    from apex_tpu import serving
+    m = _models()["gpt"]
+    params, _ = m.init(jax.random.PRNGKey(6))
+    prompt = list(np.random.RandomState(6).randint(0, 64, 6))
+    eng = serving.Engine(m, params, slots=2, buf_len=24,
+                         cache_dtype=jnp.int8)
+    rid = eng.add_request(prompt, max_new_tokens=6)
+    while eng.live():
+        eng.step()
+    buf = jnp.zeros((1, 24), jnp.int32).at[0, :6].set(jnp.asarray(prompt))
+    solo, flen = m.generate_cached(params, buf, 6, 6,
+                                   cache_dtype=jnp.int8)
+    assert eng.result(rid) == list(np.asarray(solo[0, 6:int(flen[0])]))
