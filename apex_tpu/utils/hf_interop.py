@@ -644,3 +644,40 @@ def gpt_neox_from_hf(hf_model):
             params["lm_head"] = {"weight": _np.zeros(
                 (hc.vocab_size, hc.hidden_size), _np.float32)}
     return cfg, _to_jnp(params)
+
+
+def llama_to_hf(cfg, params):
+    """Inverse of ``llama_from_hf``: a ``transformers``-layout
+    state_dict (numpy arrays, ``model.``-prefixed + ``lm_head``) from
+    an apex_tpu Llama param tree — so checkpoints trained here load
+    straight into ``LlamaForCausalLM.load_state_dict`` (round-trip
+    pinned in tests/test_hf_export.py).  Plain-Llama trees only (no
+    TP rename, no NeoX/Gemma knobs — those checkpoints belong to their
+    own HF classes)."""
+    import numpy as _np
+
+    def t(x):
+        import torch
+        return torch.from_numpy(_np.ascontiguousarray(
+            _np.asarray(x, dtype=_np.float32)))
+
+    sd = {"model.embed_tokens.weight": t(params["embed_tokens"]["weight"]),
+          "model.norm.weight": t(params["norm"]["weight"])}
+    for i in range(cfg.num_hidden_layers):
+        blk = params["layers"][str(i)]
+        b = f"model.layers.{i}"
+        sd[f"{b}.input_layernorm.weight"] = t(
+            blk["input_layernorm"]["weight"])
+        sd[f"{b}.post_attention_layernorm.weight"] = t(
+            blk["post_attention_layernorm"]["weight"])
+        for k in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{b}.self_attn.{k}.weight"] = t(
+                blk["self_attn"][k]["weight"])
+            if "bias" in blk["self_attn"][k]:
+                sd[f"{b}.self_attn.{k}.bias"] = t(
+                    blk["self_attn"][k]["bias"])
+        for k in ("gate_proj", "up_proj", "down_proj"):
+            sd[f"{b}.mlp.{k}.weight"] = t(blk["mlp"][k]["weight"])
+    if not cfg.tie_word_embeddings and "lm_head" in params:
+        sd["lm_head.weight"] = t(params["lm_head"]["weight"])
+    return sd
