@@ -50,7 +50,7 @@ if ! skip bench; then
 log "full bench (wedge insurance: capture the round's perf record first)"
 # stdout (JSON lines) -> artifact; stderr (fallback warnings, config
 # tracebacks) -> .err log so a mid-run wedge or crash leaves evidence
-timeout 4500 python bench.py 2> "artifacts/bench_$TS.err" \
+timeout 6600 python bench.py 2> "artifacts/bench_$TS.err" \
     | tee "artifacts/bench_$TS.json"
 RC=$?
 stat $RC
