@@ -11,7 +11,20 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["seed_layer"]
+__all__ = ["seed_layer", "quantize_kv"]
+
+
+def quantize_kv(val):
+    """THE int8 KV quantization: per-position symmetric amax/127 over
+    the head dim.  Every cache write path (single-token decode,
+    decode_chunk, full-buffer seeding) MUST use this one function —
+    chunked prefill's exactness vs the per-token walk depends on the
+    math staying bit-identical.  Returns (int8 values, fp32 scales)."""
+    f = val.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    return (jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8),
+            scale)
 
 
 def seed_layer(layer_cache, k, v):
@@ -21,11 +34,8 @@ def seed_layer(layer_cache, k, v):
     out = dict(layer_cache)
     if layer_cache["k"].dtype == jnp.int8:
         for name, val in (("k", k), ("v", v)):
-            f = val.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
-            scale = jnp.maximum(amax, 1e-12) / 127.0
-            out[name] = jnp.clip(jnp.round(f / scale), -127,
-                                 127).astype(jnp.int8)
+            ints, scale = quantize_kv(val)
+            out[name] = ints
             out[f"{name}_scale"] = scale.astype(
                 layer_cache[f"{name}_scale"].dtype)
     else:

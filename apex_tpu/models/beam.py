@@ -59,14 +59,17 @@ def beam_search(model, params, input_ids, prompt_len,
         beam_idx = top_idx // V                             # (B, K)
         tok = (top_idx % V).astype(ids.dtype)
 
-        # reorder beams, then append the chosen token at cur_len
-        ids = jnp.take_along_axis(
-            ids.reshape(B, K, S), beam_idx[:, :, None], axis=1)
+        # reorder beams, then append the chosen token at cur_len —
+        # ONLY for active rows: a finished row must keep ids AND
+        # scores frozen together (reordering its ids while freezing
+        # its scores would desynchronize the final argmax)
+        prev = ids.reshape(B, K, S)
+        reord = jnp.take_along_axis(prev, beam_idx[:, :, None], axis=1)
         wpos = jnp.clip(cur_len, 0, S - 1)
         cols = jax.vmap(lambda row_ids, p, toks: row_ids.at[:, p].set(
-            toks))(ids, wpos, tok)
+            toks))(reord, wpos, tok)
         keep = active[:, None, None]
-        ids = jnp.where(keep, cols, ids).reshape(B * K, S)
+        ids = jnp.where(keep, cols, prev).reshape(B * K, S)
         scores = jnp.where(active[:, None], top_scores, scores)
         return ids, scores, jnp.where(active, cur_len + 1, cur_len)
 

@@ -324,12 +324,10 @@ class LlamaAttention(nn.Module):
 
         cache = dict(cache)
         if cache["k"].dtype == jnp.int8:
+            from ._cache import quantize_kv
             for name, val in (("k", k), ("v", v)):
-                f = val.astype(jnp.float32)
-                amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
-                scale = jnp.maximum(amax, 1e-12) / 127.0
-                cache[name] = put(cache[name], jnp.clip(
-                    jnp.round(f / scale), -127, 127))
+                ints, scale = quantize_kv(val)
+                cache[name] = put(cache[name], ints)
                 cache[f"{name}_scale"] = put(cache[f"{name}_scale"],
                                              scale)
             kf = (cache["k"].astype(jnp.float32)
@@ -385,12 +383,10 @@ class LlamaAttention(nn.Module):
 
         cache = dict(cache)
         if q8:
+            from ._cache import quantize_kv
             for name, val in (("k", k), ("v", v)):
-                amax = jnp.max(jnp.abs(val.astype(jnp.float32)), axis=-1,
-                               keepdims=True)
-                scale = jnp.maximum(amax, 1e-12) / 127.0
-                cache[name] = put(cache[name], jnp.clip(
-                    jnp.round(val.astype(jnp.float32) / scale), -127, 127))
+                ints, scale = quantize_kv(val)
+                cache[name] = put(cache[name], ints)
                 cache[f"{name}_scale"] = put(cache[f"{name}_scale"], scale)
             kf = (cache["k"].astype(jnp.float32)
                   * cache["k_scale"].astype(jnp.float32))

@@ -26,7 +26,10 @@ staggered arrivals).
 
 Works with any model exposing ``prefill_cache`` / ``decode_chunk`` /
 ``init_cache`` and a greedy head (GPT, Llama and its Mistral / Qwen2 /
-Gemma configs, Mixtral).
+Gemma / NeoX configs).  MoE models must be served DROPLESS
+(``capacity_factor >= n_experts``, e.g. a ``mixtral_from_hf`` config):
+capacity-bounded routing would make one request's tokens depend on
+which other requests share the batch, and the constructor rejects it.
 """
 
 from __future__ import annotations
@@ -62,11 +65,28 @@ class Engine:
         self.params = params
         self.slots = slots
         self.buf_len = buf_len
+        # capacity-bounded MoE routing would make a request's tokens
+        # depend on what else shares the batch, breaking the
+        # batch-independence contract — require dropless experts
+        from .parallel.expert_parallel import ExpertParallelMLP
+        for mod in model.modules():
+            if (isinstance(mod, ExpertParallelMLP)
+                    and mod.capacity_factor < mod.n_experts):
+                raise ValueError(
+                    f"MoE layer with capacity_factor="
+                    f"{mod.capacity_factor} < n_experts="
+                    f"{mod.n_experts} can drop tokens depending on "
+                    f"batch contents; serve dropless "
+                    f"(capacity_factor >= n_experts) to keep requests "
+                    f"batch-independent")
+        if cache_dtype is None:
+            # follow generate_cached's default: the table/param dtype
+            cache_dtype = (model._table(params).dtype
+                           if hasattr(model, "_table")
+                           else params["wte"]["weight"].dtype)
         self.ids = jnp.zeros((slots, buf_len), jnp.int32)
         self.cur_len = jnp.zeros((slots,), jnp.int32)
-        self.cache = model.init_cache(
-            slots, dtype=cache_dtype if cache_dtype is not None
-            else jnp.float32)
+        self.cache = model.init_cache(slots, dtype=cache_dtype)
         self._free = list(range(slots))
         self._by_slot: Dict[int, _Request] = {}
         self._finished: Dict[int, _Request] = {}

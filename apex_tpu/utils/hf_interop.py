@@ -459,8 +459,22 @@ def qwen2_from_hf(hf_model):
     hc = hf_model.config
     if getattr(hc, "hidden_act", "silu") != "silu":
         raise ValueError(f"unsupported activation {hc.hidden_act!r}")
-    window = (hc.sliding_window
-              if getattr(hc, "use_sliding_window", False) else None)
+    window = None
+    if getattr(hc, "use_sliding_window", False):
+        # HF applies SWA only to layers >= max_window_layers
+        # (config.layer_types); apex_tpu's sliding_window is global —
+        # map only uniform configurations, raise on mixed ones rather
+        # than silently banding full-attention layers
+        lt = getattr(hc, "layer_types", None) or []
+        swa = [t == "sliding_attention" for t in lt]
+        if swa and all(swa):
+            window = hc.sliding_window
+        elif any(swa):
+            raise ValueError(
+                "per-layer sliding window (max_window_layers="
+                f"{hc.max_window_layers} < num_hidden_layers="
+                f"{hc.num_hidden_layers}) is not mapped; apex_tpu's "
+                "sliding_window applies to every layer")
     cfg = LlamaConfig(
         vocab_size=hc.vocab_size, hidden_size=hc.hidden_size,
         intermediate_size=hc.intermediate_size,

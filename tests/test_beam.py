@@ -95,3 +95,24 @@ def test_beam_validation_and_jit():
                                              num_beams=3))
     out, n, score = f(params, ids, jnp.asarray([2]))
     assert out.shape == (1, 16) and int(n[0]) == 6
+
+
+def test_beam_ragged_early_finish_keeps_best_hypothesis():
+    """Regression: a row that finishes early must freeze ids AND
+    scores together — its result equals running beam search on it
+    alone (code-review finding: reorder-before-guard desynchronized
+    frozen scores from permuted ids)."""
+    m, params = _gpt(4)
+    rng = np.random.RandomState(4)
+    buf = np.zeros((2, 16), np.int32)
+    buf[0, :3] = rng.randint(0, 16, 3)     # finishes 6 steps early
+    buf[1, :9] = rng.randint(0, 16, 9)
+    ids, plen = jnp.asarray(buf), jnp.asarray([3, 9])
+    out, n, score = beam_search(m, params, ids, plen, 6, num_beams=4)
+
+    solo, n0, s0 = beam_search(m, params, ids[:1], jnp.asarray([3]), 6,
+                               num_beams=4)
+    np.testing.assert_array_equal(np.asarray(out[0, :int(n[0])]),
+                                  np.asarray(solo[0, :int(n0[0])]))
+    np.testing.assert_allclose(float(score[0]), float(s0[0]),
+                               rtol=1e-5)

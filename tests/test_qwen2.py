@@ -78,3 +78,22 @@ def test_attention_bias_rejects_tp():
                     num_attention_heads=4, num_key_value_heads=2,
                     max_position_embeddings=16, attention_bias=True,
                     tp_axis="model")
+
+
+def test_qwen2_mixed_sliding_window_refused():
+    """HF gates SWA per layer (max_window_layers); a mixed config must
+    raise, not silently band every layer (code-review finding)."""
+    import torch
+    from transformers import Qwen2Config as HFConfig, Qwen2ForCausalLM
+    from apex_tpu.utils import hf_interop
+
+    hf_cfg = HFConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=4,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=32,
+                      use_sliding_window=True, sliding_window=8,
+                      max_window_layers=2)
+    torch.manual_seed(0)
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    with pytest.raises(ValueError, match="per-layer sliding window"):
+        hf_interop.qwen2_from_hf(hf)

@@ -94,3 +94,28 @@ def test_llama_engine_smoke():
     while eng.live():
         eng.step()
     assert eng.result(rid) == _solo(m, params, prompt, 6)
+
+
+def test_engine_rejects_droppy_moe_and_defaults_cache_dtype():
+    from apex_tpu.models import Mixtral, MixtralConfig
+    kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=1, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=16,
+              tie_word_embeddings=True, num_local_experts=4,
+              num_experts_per_tok=2)
+    droppy = Mixtral(MixtralConfig(capacity_factor=2.0, **kw))
+    dparams, _ = droppy.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="dropless"):
+        serving.Engine(droppy, dparams, slots=2, buf_len=16)
+    # dropless Mixtral is admitted
+    ok = Mixtral(MixtralConfig(capacity_factor=4.0, **kw))
+    oparams, _ = ok.init(jax.random.PRNGKey(0))
+    serving.Engine(ok, oparams, slots=2, buf_len=16)
+
+    # cache dtype follows the params (generate_cached's default)
+    m, params = _gpt(7)
+    bf16 = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x, params)
+    eng = serving.Engine(m, bf16, slots=1, buf_len=24)
+    assert eng.cache["0"]["k"].dtype == jnp.bfloat16
