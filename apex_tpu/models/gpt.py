@@ -518,7 +518,26 @@ class GPT(nn.Module):
             # one (B, 1, V) head row per step, not (B, S, V)
             last = self(p, ids, amask,
                         last_pos=jnp.minimum(cur_len - 1, S - 1))[:, 0]
-            if temperature > 0.0:
+            tp = self.cfg.tp_axis
+            if tp is not None and _sp_in_scope(tp):
+                # logits are VOCAB-SHARDED: a local argmax would emit
+                # shard-local ids.  Global greedy: max over shards,
+                # lowest winning global id (ties break like the
+                # unmapped argmax)
+                if temperature > 0.0:
+                    raise NotImplementedError(
+                        "sampled generate under tensor parallelism is "
+                        "not wired (needs the full distribution); use "
+                        "greedy or gather logits outside")
+                vloc = last.shape[-1]
+                lm = jnp.max(last, axis=-1)
+                li = (jnp.argmax(last, axis=-1)
+                      + lax.axis_index(tp) * vloc)
+                gm = lax.pmax(lm, tp)
+                cand = jnp.where(lm == gm, li,
+                                 jnp.iinfo(jnp.int32).max)
+                nxt = lax.pmin(cand, tp)
+            elif temperature > 0.0:
                 key, sub = jax.random.split(key)
                 nxt = sampling.sample_token(sub, last, temperature,
                                             top_k=top_k, top_p=top_p)

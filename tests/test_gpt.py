@@ -436,3 +436,28 @@ def test_gpt_gqa_tensor_parallel_matches_unmapped():
         jax.grad(loss), mesh=mesh, in_specs=(specs,), out_specs=specs,
         check_vma=False))(params)
     assert_trees_close(g_tp, jax.grad(loss)(params), atol=5e-5)
+
+
+def test_generate_under_tp_matches_unmapped():
+    """Serving under TP: generate() inside shard_map must emit GLOBAL
+    token ids (vocab-sharded logits take a cross-shard argmax) and
+    reproduce the unmapped greedy output token-for-token."""
+    from apex_tpu.parallel import tensor_parallel as tp
+    model = models.GPT(tiny_cfg(tp_axis="model"))
+    params, _ = model.init(jax.random.PRNGKey(12))
+    specs = tp.partition_specs(model, params)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    rng = np.random.RandomState(12)
+    buf = np.zeros((2, 16), np.int32)
+    buf[0, :5] = rng.randint(0, 64, 5)
+    buf[1, :7] = rng.randint(0, 64, 7)
+    ids, plen = jnp.asarray(buf), jnp.asarray([5, 7])
+
+    out_tp, n_tp = jax.jit(jax.shard_map(
+        lambda p, i, pl: model.generate(p, i, pl, 6),
+        mesh=mesh, in_specs=(specs, P(), P()), out_specs=(P(), P()),
+        check_vma=False))(params, ids, plen)
+    out_ref, n_ref = model.generate(params, ids, plen, 6)
+    np.testing.assert_array_equal(np.asarray(n_tp), np.asarray(n_ref))
+    np.testing.assert_array_equal(np.asarray(out_tp),
+                                  np.asarray(out_ref))
