@@ -643,7 +643,9 @@ class GPT(nn.Module):
                         cache_dtype=None,
                         top_k: Optional[int] = None,
                         top_p: Optional[float] = None,
-                        prefill_mode: str = "chunked"):
+                        prefill_mode: str = "chunked",
+                        min_p: Optional[float] = None,
+                        repetition_penalty: float = 1.0):
         """KV-cached ``generate``: one fused prefill+decode loop over
         the buffer positions, O(S) attention per step against the
         static (B, n_kv_head, S, D) caches.  Greedy output is IDENTICAL to
@@ -683,10 +685,15 @@ class GPT(nn.Module):
             def live(args):
                 x, key = args
                 logits = self._head(p, x)[:, 0]
+                if repetition_penalty != 1.0:
+                    logits = sampling.apply_repetition_penalty(
+                        logits, ids, jnp.maximum(prompt_len, i + 1),
+                        repetition_penalty)
                 if temperature > 0.0:
                     key, sub = jax.random.split(key)
                     nxt = sampling.sample_token(sub, logits, temperature,
-                                                top_k=top_k, top_p=top_p)
+                                                top_k=top_k, top_p=top_p,
+                                                min_p=min_p)
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
                 return nxt.astype(ids.dtype), key

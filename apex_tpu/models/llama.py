@@ -752,7 +752,9 @@ class Llama(nn.Module):
                         top_k: Optional[int] = None,
                         top_p: Optional[float] = None,
                         prefill_mode: str = "chunked",
-                        rolling_cache: bool = False):
+                        rolling_cache: bool = False,
+                        min_p: Optional[float] = None,
+                        repetition_penalty: float = 1.0):
         """Fixed-buffer KV-cached greedy/sampled generation; one
         compiled program for any prompt length, prefill steps skipping
         the full-vocab head via ``lax.cond`` (GPT.generate_cached's
@@ -801,10 +803,15 @@ class Llama(nn.Module):
                 x, key = args
                 table = self._table(p)
                 logits = F.matmul(x, table.T.astype(x.dtype))[:, 0]
+                if repetition_penalty != 1.0:
+                    logits = sampling.apply_repetition_penalty(
+                        logits, ids, jnp.maximum(prompt_len, i + 1),
+                        repetition_penalty)
                 if temperature > 0.0:
                     key, sub = jax.random.split(key)
                     nxt = sampling.sample_token(sub, logits, temperature,
-                                                top_k=top_k, top_p=top_p)
+                                                top_k=top_k, top_p=top_p,
+                                                min_p=min_p)
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
                 return nxt.astype(ids.dtype), key

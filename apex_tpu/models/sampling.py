@@ -22,14 +22,23 @@ __all__ = ["filter_logits", "sample_token"]
 
 
 def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
-                  top_p: Optional[float] = None) -> jax.Array:
+                  top_p: Optional[float] = None,
+                  min_p: Optional[float] = None) -> jax.Array:
     """Mask (-inf) every vocab entry of ``logits (..., V)`` that falls
-    outside the top-k set and/or the top-p nucleus."""
+    outside the top-k set, the top-p nucleus, and/or below ``min_p``
+    (tokens whose probability is under ``min_p * max_prob`` — the
+    scale-relative cutoff; the best token always survives)."""
     if top_k is not None:
         if top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if min_p is not None:
+        if not 0.0 < min_p <= 1.0:
+            raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+        probs = jax.nn.softmax(logits, axis=-1)
+        cut = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs < cut, -jnp.inf, logits)
     if top_p is not None:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
@@ -48,7 +57,8 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
 def sample_token(key: jax.Array, logits: jax.Array,
                  temperature: float = 1.0,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> jax.Array:
+                 top_p: Optional[float] = None,
+                 min_p: Optional[float] = None) -> jax.Array:
     """One token id per row of ``logits (..., V)``.
 
     ``temperature == 0`` (a static python float) is greedy argmax —
@@ -58,4 +68,24 @@ def sample_token(key: jax.Array, logits: jax.Array,
         return jnp.argmax(logits, axis=-1)
     scaled = logits.astype(jnp.float32) / temperature
     return jax.random.categorical(
-        key, filter_logits(scaled, top_k=top_k, top_p=top_p))
+        key, filter_logits(scaled, top_k=top_k, top_p=top_p,
+                           min_p=min_p))
+
+
+def apply_repetition_penalty(logits: jax.Array, ids: jax.Array,
+                             cur_len: jax.Array,
+                             penalty: float) -> jax.Array:
+    """HF-semantics repetition penalty: for every token already
+    present in ``ids[b, :cur_len[b]]``, positive logits divide by
+    ``penalty`` and negative logits multiply by it.  Static shapes:
+    presence is a scatter over the vocab."""
+    if penalty == 1.0:
+        return logits
+    B, S = ids.shape
+    V = logits.shape[-1]
+    seen_mask = jnp.arange(S)[None, :] < cur_len[:, None]
+    presence = jnp.zeros((B, V), bool).at[
+        jnp.arange(B)[:, None], ids].max(seen_mask)
+    penalized = jnp.where(logits > 0, logits / penalty,
+                          logits * penalty)
+    return jnp.where(presence, penalized, logits)

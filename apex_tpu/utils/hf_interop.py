@@ -672,8 +672,10 @@ def llama_to_hf(cfg, params):
 
     def t(x):
         import torch
-        return torch.from_numpy(_np.ascontiguousarray(
-            _np.asarray(x, dtype=_np.float32)))
+        # copy=True: jnp arrays expose read-only buffers and torch
+        # warns on (and could break with) non-writable views
+        return torch.from_numpy(
+            _np.array(x, dtype=_np.float32, copy=True))
 
     sd = {"model.embed_tokens.weight": t(params["embed_tokens"]["weight"]),
           "model.norm.weight": t(params["norm"]["weight"])}
