@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["generate_speculative"]
+__all__ = ["generate_speculative", "spec_iteration"]
 
 
 def _head_logits(model, p, h):
@@ -163,29 +163,31 @@ def generate_speculative(target, target_params, draft, draft_params,
     return ids, final_len
 
 
-def _generate_cached_verify(target, tp, draft, dp, input_ids,
-                            prompt_len, max_new_tokens: int,
-                            gamma: int, temperature: float = 0.0,
-                            rng=None, top_k=None, top_p=None):
+def spec_iteration(target, tp, draft, dp, ids, cur_len, final_len,
+                   orig, t_cache, d_cache, gamma: int,
+                   key=None, temperature: float = 0.0,
+                   top_k=None, top_p=None):
+    """ONE draft-propose / target-verify round over per-row state —
+    the building block shared by ``generate_speculative`` (which loops
+    it to completion) and ``serving.Engine`` (which runs one round per
+    scheduler tick with requests arriving between rounds).
+
+    Returns ``(ids, new_len, t_cache, d_cache, key)``; every active
+    row advances 1..gamma+1 positions.  ``orig`` supplies the content
+    restored past the correction point (the caller's pre-round buffer:
+    rejected proposals leave no trace)."""
     from .sampling import filter_logits
 
-    B, S = input_ids.shape
+    B, S = ids.shape
     L = gamma + 1
-    if L > S:
-        raise ValueError(f"gamma+1={L} exceeds the buffer length {S}")
     sample = temperature > 0.0
-    orig = jnp.asarray(input_ids)
-    prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
-    final_len = jnp.minimum(prompt_len + max_new_tokens, S)
     pgrid = jnp.arange(S)[None, :]
-
-    t_cache = target.prefill_cache(tp, orig)
-    d_cache = draft.prefill_cache(dp, orig)
-    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     def probs_of(logits):
-        """Filtered sampling distribution (matches models/sampling.py
-        order: scale, then top-k, then top-p)."""
+        # filtered sampling distribution (models/sampling.py order:
+        # scale, then top-k, then top-p)
         fl = filter_logits(logits.astype(jnp.float32) / temperature,
                            top_k=top_k, top_p=top_p)
         return jax.nn.softmax(fl, axis=-1)
@@ -196,111 +198,131 @@ def _generate_cached_verify(target, tp, draft, dp, input_ids,
                 jnp.where(c, t, row[p])))(
             ids, jnp.minimum(pos, S - 1), tok, can)
 
+    active = cur_len < final_len
+
+    # 1. draft proposes gamma tokens with single-token cached
+    # steps at PER-ROW positions (posd = last known position)
+    ids_d, posd = ids, cur_len - 1
+    dtoks, dprobs = [], []
+    for _ in range(gamma):
+        tok_in = jnp.take_along_axis(
+            ids_d, jnp.clip(posd, 0, S - 1)[:, None], axis=1)
+        h, d_cache = draft.decode_chunk(dp, tok_in, posd, d_cache)
+        logits = _head_logits(draft, dp, h)[:, 0]
+        if sample:
+            pd = probs_of(logits)
+            key, sub = jax.random.split(key)
+            t = jax.random.categorical(
+                sub, jnp.log(pd + 1e-30)).astype(ids.dtype)
+            dprobs.append(pd)
+        else:
+            t = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+        can = (posd + 1) < final_len
+        ids_d = write_at(ids_d, posd + 1, t, can)
+        dtoks.append(t)
+        posd = jnp.where(can, posd + 1, posd)
+    dtoks = jnp.stack(dtoks, axis=1)                   # (B, gamma)
+
+    # 2. target scores the whole chunk against its cache.  Chunk
+    # start clamps to S - L near the buffer end; `off` re-aligns
+    # the verify indices (re-ingested entries recompute to the
+    # same values — RoPE/positions follow the clamped start)
+    pos0 = jnp.clip(jnp.minimum(cur_len - 1, S - L), 0)
+    chunk = jnp.take_along_axis(
+        ids_d, pos0[:, None] + jnp.arange(L)[None, :], axis=1)
+    th, t_cache = target.decode_chunk(tp, chunk, pos0, t_cache)
+    t_logits = _head_logits(target, tp, th)             # (B, L, V)
+    off = cur_len - 1 - pos0                            # (B,)
+    idx = jnp.clip(off[:, None] + jnp.arange(L)[None, :], 0, L - 1)
+    t_logits = jnp.take_along_axis(t_logits, idx[:, :, None],
+                                   axis=1)  # aligned: row j is
+    #                                         position cur-1+j
+
+    offs = jnp.arange(gamma)[None, :]
+    eligible = (cur_len[:, None] + offs) < (final_len[:, None] - 1)
+
+    if sample:
+        pt = probs_of(t_logits)                        # (B, L, V)
+        pd = jnp.stack(dprobs, axis=1)                 # (B, g, V)
+        # 3. accept x_j with prob min(1, p_t(x_j) / p_d(x_j))
+        pt_x = jnp.take_along_axis(
+            pt[:, :gamma], dtoks[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        pd_x = jnp.take_along_axis(
+            pd, dtoks[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        key, sub = jax.random.split(key)
+        u = jax.random.uniform(sub, (B, gamma))
+        accept = u * pd_x < pt_x                       # min(1,.)
+        n_acc = jnp.sum(jnp.cumprod(accept & eligible, axis=1),
+                        axis=1)
+        # 4. the token after the accepted run: residual
+        # max(0, p_t - p_d) on a true rejection; p_t itself when
+        # the run ended for eligibility/bonus reasons
+        nai = jnp.clip(n_acc, 0, gamma)[:, None]
+        pt_row = jnp.take_along_axis(
+            pt, nai[..., None], axis=1)[:, 0]          # (B, V)
+        pd_pad = jnp.concatenate(
+            [pd, jnp.zeros((B, 1, pd.shape[-1]), pd.dtype)], axis=1)
+        pd_row = jnp.take_along_axis(
+            pd_pad, nai[..., None], axis=1)[:, 0]
+        el_pad = jnp.concatenate(
+            [eligible, jnp.zeros((B, 1), bool)], axis=1)
+        was_rejection = jnp.take_along_axis(el_pad, nai,
+                                            axis=1)[:, 0]
+        resid = jnp.clip(pt_row - jnp.where(
+            was_rejection[:, None], pd_row, 0.0), 0.0, None)
+        norm = jnp.sum(resid, axis=-1, keepdims=True)
+        resid = jnp.where(norm > 1e-12, resid / norm, pt_row)
+        key, sub = jax.random.split(key)
+        ctok = jax.random.categorical(
+            sub, jnp.log(resid + 1e-30)).astype(ids.dtype)
+    else:
+        tgt_next = jnp.argmax(t_logits, axis=-1)        # (B, L)
+        # 3. longest agreeing prefix (correction slot must fit)
+        agree = dtoks == tgt_next[:, :gamma].astype(dtoks.dtype)
+        n_acc = jnp.sum(jnp.cumprod(agree & eligible, axis=1),
+                        axis=1)
+        # 4. corrected token = target's choice after the run
+        ctok = jnp.take_along_axis(
+            tgt_next, jnp.clip(n_acc, 0, gamma)[:, None],
+            axis=1)[:, 0].astype(ids.dtype)
+
+    # 5. rebuild ids (accepted zone, correction, restore the rest)
+    corr_at = cur_len + n_acc
+    keep = pgrid < corr_at[:, None]
+    is_corr = (pgrid == corr_at[:, None]) & active[:, None]
+    ids_new = jnp.where(keep, ids_d,
+                        jnp.where(is_corr, ctok[:, None], orig))
+    new_len = jnp.where(active,
+                        jnp.minimum(corr_at + 1, final_len),
+                        cur_len)
+    return ids_new, new_len, t_cache, d_cache, key
+
+def _generate_cached_verify(target, tp, draft, dp, input_ids,
+                            prompt_len, max_new_tokens: int,
+                            gamma: int, temperature: float = 0.0,
+                            rng=None, top_k=None, top_p=None):
+    B, S = input_ids.shape
+    if gamma + 1 > S:
+        raise ValueError(f"gamma+1={gamma + 1} exceeds the buffer "
+                         f"length {S}")
+    orig = jnp.asarray(input_ids)
+    prompt_len = jnp.broadcast_to(jnp.asarray(prompt_len), (B,))
+    final_len = jnp.minimum(prompt_len + max_new_tokens, S)
+
+    t_cache = target.prefill_cache(tp, orig)
+    d_cache = draft.prefill_cache(dp, orig)
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+
     def cond(carry):
         _, cur_len, _, _, _ = carry
         return jnp.any(cur_len < final_len)
 
     def body(carry):
         ids, cur_len, t_cache, d_cache, key = carry
-        active = cur_len < final_len
-
-        # 1. draft proposes gamma tokens with single-token cached
-        # steps at PER-ROW positions (posd = last known position)
-        ids_d, posd = ids, cur_len - 1
-        dtoks, dprobs = [], []
-        for _ in range(gamma):
-            tok_in = jnp.take_along_axis(
-                ids_d, jnp.clip(posd, 0, S - 1)[:, None], axis=1)
-            h, d_cache = draft.decode_chunk(dp, tok_in, posd, d_cache)
-            logits = _head_logits(draft, dp, h)[:, 0]
-            if sample:
-                pd = probs_of(logits)
-                key, sub = jax.random.split(key)
-                t = jax.random.categorical(
-                    sub, jnp.log(pd + 1e-30)).astype(ids.dtype)
-                dprobs.append(pd)
-            else:
-                t = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-            can = (posd + 1) < final_len
-            ids_d = write_at(ids_d, posd + 1, t, can)
-            dtoks.append(t)
-            posd = jnp.where(can, posd + 1, posd)
-        dtoks = jnp.stack(dtoks, axis=1)                   # (B, gamma)
-
-        # 2. target scores the whole chunk against its cache.  Chunk
-        # start clamps to S - L near the buffer end; `off` re-aligns
-        # the verify indices (re-ingested entries recompute to the
-        # same values — RoPE/positions follow the clamped start)
-        pos0 = jnp.clip(jnp.minimum(cur_len - 1, S - L), 0)
-        chunk = jnp.take_along_axis(
-            ids_d, pos0[:, None] + jnp.arange(L)[None, :], axis=1)
-        th, t_cache = target.decode_chunk(tp, chunk, pos0, t_cache)
-        t_logits = _head_logits(target, tp, th)             # (B, L, V)
-        off = cur_len - 1 - pos0                            # (B,)
-        idx = jnp.clip(off[:, None] + jnp.arange(L)[None, :], 0, L - 1)
-        t_logits = jnp.take_along_axis(t_logits, idx[:, :, None],
-                                       axis=1)  # aligned: row j is
-        #                                         position cur-1+j
-
-        offs = jnp.arange(gamma)[None, :]
-        eligible = (cur_len[:, None] + offs) < (final_len[:, None] - 1)
-
-        if sample:
-            pt = probs_of(t_logits)                        # (B, L, V)
-            pd = jnp.stack(dprobs, axis=1)                 # (B, g, V)
-            # 3. accept x_j with prob min(1, p_t(x_j) / p_d(x_j))
-            pt_x = jnp.take_along_axis(
-                pt[:, :gamma], dtoks[..., None].astype(jnp.int32),
-                axis=-1)[..., 0]
-            pd_x = jnp.take_along_axis(
-                pd, dtoks[..., None].astype(jnp.int32), axis=-1)[..., 0]
-            key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, (B, gamma))
-            accept = u * pd_x < pt_x                       # min(1,.)
-            n_acc = jnp.sum(jnp.cumprod(accept & eligible, axis=1),
-                            axis=1)
-            # 4. the token after the accepted run: residual
-            # max(0, p_t - p_d) on a true rejection; p_t itself when
-            # the run ended for eligibility/bonus reasons
-            nai = jnp.clip(n_acc, 0, gamma)[:, None]
-            pt_row = jnp.take_along_axis(
-                pt, nai[..., None], axis=1)[:, 0]          # (B, V)
-            pd_pad = jnp.concatenate(
-                [pd, jnp.zeros((B, 1, pd.shape[-1]), pd.dtype)], axis=1)
-            pd_row = jnp.take_along_axis(
-                pd_pad, nai[..., None], axis=1)[:, 0]
-            el_pad = jnp.concatenate(
-                [eligible, jnp.zeros((B, 1), bool)], axis=1)
-            was_rejection = jnp.take_along_axis(el_pad, nai,
-                                                axis=1)[:, 0]
-            resid = jnp.clip(pt_row - jnp.where(
-                was_rejection[:, None], pd_row, 0.0), 0.0, None)
-            norm = jnp.sum(resid, axis=-1, keepdims=True)
-            resid = jnp.where(norm > 1e-12, resid / norm, pt_row)
-            key, sub = jax.random.split(key)
-            ctok = jax.random.categorical(
-                sub, jnp.log(resid + 1e-30)).astype(ids.dtype)
-        else:
-            tgt_next = jnp.argmax(t_logits, axis=-1)        # (B, L)
-            # 3. longest agreeing prefix (correction slot must fit)
-            agree = dtoks == tgt_next[:, :gamma].astype(dtoks.dtype)
-            n_acc = jnp.sum(jnp.cumprod(agree & eligible, axis=1),
-                            axis=1)
-            # 4. corrected token = target's choice after the run
-            ctok = jnp.take_along_axis(
-                tgt_next, jnp.clip(n_acc, 0, gamma)[:, None],
-                axis=1)[:, 0].astype(ids.dtype)
-
-        # 5. rebuild ids (accepted zone, correction, restore the rest)
-        corr_at = cur_len + n_acc
-        keep = pgrid < corr_at[:, None]
-        is_corr = (pgrid == corr_at[:, None]) & active[:, None]
-        ids_new = jnp.where(keep, ids_d,
-                            jnp.where(is_corr, ctok[:, None], orig))
-        new_len = jnp.where(active,
-                            jnp.minimum(corr_at + 1, final_len),
-                            cur_len)
-        return ids_new, new_len, t_cache, d_cache, key
+        return spec_iteration(target, tp, draft, dp, ids, cur_len,
+                              final_len, orig, t_cache, d_cache,
+                              gamma, key, temperature, top_k, top_p)
 
     ids, _, _, _, _ = lax.while_loop(
         cond, body, (orig, prompt_len, t_cache, d_cache, key0))

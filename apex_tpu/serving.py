@@ -60,11 +60,20 @@ class _Request:
 
 class Engine:
     def __init__(self, model, params, slots: int, buf_len: int,
-                 cache_dtype=None):
+                 cache_dtype=None, draft=None, draft_params=None,
+                 gamma: int = 4):
+        """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
+        decoding: one ``spec_iteration`` (models/speculative.py) per
+        tick, so every live request advances 1..gamma+1 tokens per
+        step while staying token-for-token equal to its solo greedy
+        decode."""
         self.model = model
         self.params = params
         self.slots = slots
         self.buf_len = buf_len
+        self.draft = draft
+        self.draft_params = draft_params
+        self.gamma = gamma
         # capacity-bounded MoE routing would make a request's tokens
         # depend on what else shares the batch, breaking the
         # batch-independence contract — require dropless experts
@@ -86,26 +95,45 @@ class Engine:
                            else params["wte"]["weight"].dtype)
         self.ids = jnp.zeros((slots, buf_len), jnp.int32)
         self.cur_len = jnp.zeros((slots,), jnp.int32)
+        self.limit = jnp.zeros((slots,), jnp.int32)   # per-slot final
         self.cache = model.init_cache(slots, dtype=cache_dtype)
+        self.d_cache = (draft.init_cache(slots, dtype=cache_dtype)
+                        if draft is not None else None)
         self._free = list(range(slots))
         self._by_slot: Dict[int, _Request] = {}
         self._finished: Dict[int, _Request] = {}
         self._next_rid = 0
 
-        def _prefill_slot(ids, cache, slot, row):
-            """Seed one slot: prefill the row alone, scatter its cache
-            row into the batch cache."""
-            row_cache = model.prefill_cache(params, row[None, :],
-                                            jax.tree_util.tree_map(
+        def _seed(m, ps, cache, slot, row):
+            row_cache = m.prefill_cache(ps, row[None, :],
+                                        jax.tree_util.tree_map(
                 lambda b: jnp.zeros((1,) + b.shape[1:], b.dtype), cache))
-            cache = jax.tree_util.tree_map(
+            return jax.tree_util.tree_map(
                 lambda b, r: lax.dynamic_update_index_in_dim(
                     b, r[0].astype(b.dtype), slot, axis=0),
                 cache, row_cache)
+
+        def _prefill_slot(ids, cache, d_cache, slot, row):
+            """Seed one slot: prefill the row alone, scatter its cache
+            row into the batch cache(s)."""
+            cache = _seed(model, params, cache, slot, row)
+            if draft is not None:
+                d_cache = _seed(draft, draft_params, d_cache, slot, row)
             ids = lax.dynamic_update_index_in_dim(ids, row, slot, axis=0)
-            return ids, cache
+            return ids, cache, d_cache
 
         self._prefill_slot = jax.jit(_prefill_slot)
+
+        if draft is not None:
+            from .models.speculative import spec_iteration
+
+            def _sstep(ids, cur_len, limit, t_cache, d_cache):
+                ids2, new_len, t_cache, d_cache, _ = spec_iteration(
+                    model, params, draft, draft_params, ids, cur_len,
+                    limit, ids, t_cache, d_cache, gamma)
+                return ids2, new_len, t_cache, d_cache
+
+            self._sstep = jax.jit(_sstep)
 
         def _step(ids, cur_len, cache):
             pos = jnp.maximum(cur_len - 1, 0)
@@ -138,9 +166,11 @@ class Engine:
         slot = self._free.pop()
         row = np.zeros((self.buf_len,), np.int32)
         row[:len(prompt)] = prompt
-        self.ids, self.cache = self._prefill_slot(
-            self.ids, self.cache, slot, jnp.asarray(row))
+        self.ids, self.cache, self.d_cache = self._prefill_slot(
+            self.ids, self.cache, self.d_cache, slot, jnp.asarray(row))
         self.cur_len = self.cur_len.at[slot].set(len(prompt))
+        self.limit = self.limit.at[slot].set(
+            min(len(prompt) + max_new_tokens, self.buf_len))
         rid = self._next_rid
         self._next_rid += 1
         self._by_slot[slot] = _Request(rid, slot, len(prompt),
@@ -148,21 +178,39 @@ class Engine:
         return rid
 
     def step(self) -> Dict[int, Any]:
-        """One batched decode step.  Returns {request_id: token} for
-        every live request that emitted this step; finished requests
-        free their slot (their last token, EOS included, is still
-        reported and recorded)."""
+        """One batched decode step.  Returns {request_id: [tokens]}
+        for every live request that emitted this step (one token on
+        the plain path, 1..gamma+1 under speculative decoding);
+        finished requests free their slot (their last token, EOS
+        included, is still reported and recorded)."""
         if not self._by_slot:
             return {}
-        self.ids, self.cur_len, self.cache, nxt = self._step(
-            self.ids, self.cur_len, self.cache)
-        toks = np.asarray(nxt)
+        if self.draft is not None:
+            old_len = np.asarray(self.cur_len)
+            (self.ids, self.cur_len, self.cache,
+             self.d_cache) = self._sstep(self.ids, self.cur_len,
+                                         self.limit, self.cache,
+                                         self.d_cache)
+            new_len = np.asarray(self.cur_len)
+            rows = np.asarray(self.ids)
+            emitted = {slot: [int(t) for t in
+                              rows[slot, old_len[slot]:new_len[slot]]]
+                       for slot in self._by_slot}
+        else:
+            self.ids, self.cur_len, self.cache, nxt = self._step(
+                self.ids, self.cur_len, self.cache)
+            toks = np.asarray(nxt)
+            emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
         out: Dict[int, Any] = {}
         for slot, req in list(self._by_slot.items()):
-            t = int(toks[slot])
-            req.generated.append(t)
-            out[req.rid] = t
-            hit_eos = req.eos is not None and t == req.eos
+            toks = emitted[slot]
+            if req.eos is not None and req.eos in toks:
+                # truncate a speculative run at the EOS it crossed
+                toks = toks[:toks.index(req.eos) + 1]
+            req.generated.extend(toks)
+            if toks:
+                out[req.rid] = list(toks)
+            hit_eos = req.eos is not None and req.eos in toks
             full = (len(req.generated) >= req.max_new
                     or req.prompt_len + len(req.generated)
                     >= self.buf_len)
@@ -170,6 +218,8 @@ class Engine:
                 req.done = True
                 del self._by_slot[slot]
                 self._free.append(slot)
+                # stop the device from advancing the freed slot
+                self.limit = self.limit.at[slot].set(0)
                 self._finished[req.rid] = req
         return out
 

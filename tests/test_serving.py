@@ -58,7 +58,7 @@ def test_eos_frees_slot_and_reuse_is_clean():
     eng = serving.Engine(m, params, slots=1, buf_len=24)
     ra = eng.add_request(pa, max_new_tokens=8, eos_token_id=first)
     out = eng.step()
-    assert out == {ra: first}
+    assert out == {ra: [first]}
     assert eng.live() == 0               # EOS -> slot freed
     assert eng.result(ra) == [first]
 
@@ -119,3 +119,57 @@ def test_engine_rejects_droppy_moe_and_defaults_cache_dtype():
         if x.dtype == jnp.float32 else x, params)
     eng = serving.Engine(m, bf16, slots=1, buf_len=24)
     assert eng.cache["0"]["k"].dtype == jnp.bfloat16
+
+
+def test_speculative_engine_matches_solo_decoding():
+    """Continuous batching + speculative decoding composed: staggered
+    arrivals, every request token-for-token equal to its solo greedy
+    decode, advancing up to gamma+1 tokens per tick."""
+    m, params = _gpt(10)
+    draft, dparams = _gpt(11)        # different weights, same vocab
+    eng = serving.Engine(m, params, slots=2, buf_len=24,
+                         draft=draft, draft_params=dparams, gamma=3)
+    rng = np.random.RandomState(10)
+    pa = list(rng.randint(0, 64, 6))
+    pb = list(rng.randint(0, 64, 4))
+    ra = eng.add_request(pa, max_new_tokens=9)
+    eng.step()
+    rb = eng.add_request(pb, max_new_tokens=7)
+    steps = 0
+    while eng.live():
+        eng.step()
+        steps += 1
+        assert steps < 40
+    assert eng.result(ra) == _solo(m, params, pa, 9)
+    assert eng.result(rb) == _solo(m, params, pb, 7)
+
+
+def test_speculative_engine_perfect_draft_advances_fast():
+    """Draft == target: every proposal accepted, so a request finishes
+    in ~ceil(new/(gamma+1)) ticks instead of `new` ticks."""
+    m, params = _gpt(12)
+    eng = serving.Engine(m, params, slots=1, buf_len=24,
+                         draft=m, draft_params=params, gamma=3)
+    prompt = list(np.random.RandomState(12).randint(0, 64, 5))
+    rid = eng.add_request(prompt, max_new_tokens=8)
+    ticks = 0
+    while eng.live():
+        eng.step()
+        ticks += 1
+    assert ticks <= 3                # 8 tokens / (gamma+1)=4 -> 2-3
+    assert eng.result(rid) == _solo(m, params, prompt, 8)
+
+
+def test_speculative_engine_eos_mid_chunk():
+    """EOS crossed inside an accepted run truncates the request at the
+    EOS token even though the chunk carried tokens past it."""
+    m, params = _gpt(13)
+    prompt = list(np.random.RandomState(13).randint(0, 64, 5))
+    solo = _solo(m, params, prompt, 8)
+    eos = solo[1]                    # second greedy token as EOS
+    eng = serving.Engine(m, params, slots=1, buf_len=24,
+                         draft=m, draft_params=params, gamma=4)
+    rid = eng.add_request(prompt, max_new_tokens=8, eos_token_id=eos)
+    while eng.live():
+        eng.step()
+    assert eng.result(rid) == solo[:2]
