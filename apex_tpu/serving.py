@@ -61,12 +61,15 @@ class _Request:
 class Engine:
     def __init__(self, model, params, slots: int, buf_len: int,
                  cache_dtype=None, draft=None, draft_params=None,
-                 gamma: int = 4):
+                 gamma: int = 4, temperature: float = 0.0,
+                 top_k=None, top_p=None, rng=None):
         """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
         decoding: one ``spec_iteration`` (models/speculative.py) per
         tick, so every live request advances 1..gamma+1 tokens per
         step while staying token-for-token equal to its solo greedy
-        decode."""
+        decode.  ``temperature > 0`` samples instead (plain path only;
+        combine with a draft for speculative SAMPLING semantics at the
+        generate_speculative level)."""
         self.model = model
         self.params = params
         self.slots = slots
@@ -74,6 +77,13 @@ class Engine:
         self.draft = draft
         self.draft_params = draft_params
         self.gamma = gamma
+        self.temperature = temperature
+        if temperature > 0.0 and draft is not None:
+            raise NotImplementedError(
+                "sampled speculative engine ticks are not wired; use "
+                "greedy speculation or the plain sampled path")
+        self._key = (rng if rng is not None
+                     else jax.random.PRNGKey(0))
         # capacity-bounded MoE routing would make a request's tokens
         # depend on what else shares the batch, breaking the
         # batch-independence contract — require dropless experts
@@ -100,6 +110,7 @@ class Engine:
         self.d_cache = (draft.init_cache(slots, dtype=cache_dtype)
                         if draft is not None else None)
         self._free = list(range(slots))
+        self._waiting: List[Any] = []
         self._by_slot: Dict[int, _Request] = {}
         self._finished: Dict[int, _Request] = {}
         self._next_rid = 0
@@ -135,34 +146,32 @@ class Engine:
 
             self._sstep = jax.jit(_sstep)
 
-        def _step(ids, cur_len, cache):
+        def _step(ids, cur_len, cache, key):
             pos = jnp.maximum(cur_len - 1, 0)
             tok_in = jnp.take_along_axis(
                 ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
             h, cache = model.decode_chunk(params, tok_in, pos, cache)
-            nxt = jnp.argmax(_head_logits(model, params, h)[:, 0],
-                             axis=-1).astype(jnp.int32)
+            logits = _head_logits(model, params, h)[:, 0]
+            if temperature > 0.0:
+                from .models import sampling as smp
+                key, sub = jax.random.split(key)
+                nxt = smp.sample_token(sub, logits, temperature,
+                                       top_k=top_k,
+                                       top_p=top_p).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             can = cur_len < buf_len
             ids = jax.vmap(
                 lambda row, p, t, c: row.at[p].set(
                     jnp.where(c, t, row[p])))(
                 ids, jnp.minimum(cur_len, buf_len - 1), nxt, can)
-            return ids, jnp.where(can, cur_len + 1, cur_len), cache, nxt
+            return (ids, jnp.where(can, cur_len + 1, cur_len), cache,
+                    nxt, key)
 
         self._step = jax.jit(_step)
 
     # -- request lifecycle -------------------------------------------------
-    def add_request(self, prompt: Sequence[int],
-                    max_new_tokens: int,
-                    eos_token_id: Optional[int] = None) -> int:
-        """Claim a slot, prefill it, return the request id.  Raises
-        if no slot is free (callers queue outside)."""
-        if not self._free:
-            raise RuntimeError("no free slot; harvest finished "
-                               "requests or add capacity")
-        if len(prompt) < 1 or len(prompt) >= self.buf_len:
-            raise ValueError(f"prompt length {len(prompt)} not in "
-                             f"[1, {self.buf_len})")
+    def _admit(self, rid, prompt, max_new_tokens, eos_token_id):
         slot = self._free.pop()
         row = np.zeros((self.buf_len,), np.int32)
         row[:len(prompt)] = prompt
@@ -171,11 +180,47 @@ class Engine:
         self.cur_len = self.cur_len.at[slot].set(len(prompt))
         self.limit = self.limit.at[slot].set(
             min(len(prompt) + max_new_tokens, self.buf_len))
-        rid = self._next_rid
-        self._next_rid += 1
         self._by_slot[slot] = _Request(rid, slot, len(prompt),
                                        max_new_tokens, eos_token_id)
+
+    def _check_prompt(self, prompt):
+        if len(prompt) < 1 or len(prompt) >= self.buf_len:
+            raise ValueError(f"prompt length {len(prompt)} not in "
+                             f"[1, {self.buf_len})")
+
+    def add_request(self, prompt: Sequence[int],
+                    max_new_tokens: int,
+                    eos_token_id: Optional[int] = None) -> int:
+        """Claim a slot, prefill it, return the request id.  Raises
+        if no slot is free (``submit`` queues instead)."""
+        if not self._free:
+            raise RuntimeError("no free slot; harvest finished "
+                               "requests, use submit(), or add "
+                               "capacity")
+        self._check_prompt(prompt)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._admit(rid, prompt, max_new_tokens, eos_token_id)
         return rid
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_token_id: Optional[int] = None) -> int:
+        """``add_request`` that QUEUES when the engine is full; queued
+        requests are admitted automatically as slots free at the end
+        of each ``step()`` (arrival order)."""
+        self._check_prompt(prompt)
+        if self._free and not self._waiting:
+            return self.add_request(prompt, max_new_tokens,
+                                    eos_token_id)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._waiting.append((rid, list(prompt), max_new_tokens,
+                              eos_token_id))
+        return rid
+
+    def _drain_queue(self):
+        while self._free and self._waiting:
+            self._admit(*self._waiting.pop(0))
 
     def step(self) -> Dict[int, Any]:
         """One batched decode step.  Returns {request_id: [tokens]}
@@ -197,8 +242,9 @@ class Engine:
                               rows[slot, old_len[slot]:new_len[slot]]]
                        for slot in self._by_slot}
         else:
-            self.ids, self.cur_len, self.cache, nxt = self._step(
-                self.ids, self.cur_len, self.cache)
+            (self.ids, self.cur_len, self.cache, nxt,
+             self._key) = self._step(self.ids, self.cur_len,
+                                     self.cache, self._key)
             toks = np.asarray(nxt)
             emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
         out: Dict[int, Any] = {}
@@ -221,6 +267,7 @@ class Engine:
                 # stop the device from advancing the freed slot
                 self.limit = self.limit.at[slot].set(0)
                 self._finished[req.rid] = req
+        self._drain_queue()
         return out
 
     def result(self, rid: int) -> List[int]:

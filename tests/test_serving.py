@@ -173,3 +173,31 @@ def test_speculative_engine_eos_mid_chunk():
     while eng.live():
         eng.step()
     assert eng.result(rid) == solo[:2]
+
+
+def test_submit_queue_and_sampled_engine():
+    m, params = _gpt(14)
+    eng = serving.Engine(m, params, slots=1, buf_len=24)
+    rng = np.random.RandomState(14)
+    pa = list(rng.randint(0, 64, 5))
+    pb = list(rng.randint(0, 64, 4))
+    ra = eng.submit(pa, max_new_tokens=4)   # takes the slot
+    rb = eng.submit(pb, max_new_tokens=3)   # queues
+    assert eng.live() == 1
+    while eng.live() or eng._waiting:
+        eng.step()
+    assert eng.result(ra) == _solo(m, params, pa, 4)
+    assert eng.result(rb) == _solo(m, params, pb, 3)
+
+    # sampled engine: tokens vary with rng, stay in-range, finite run
+    se = serving.Engine(m, params, slots=2, buf_len=24,
+                        temperature=1.0, top_k=8,
+                        rng=jax.random.PRNGKey(5))
+    r1 = se.add_request(pa, max_new_tokens=5)
+    while se.live():
+        se.step()
+    toks = se.result(r1)
+    assert len(toks) == 5 and all(0 <= t < 64 for t in toks)
+    with pytest.raises(NotImplementedError, match="speculative"):
+        serving.Engine(m, params, slots=1, buf_len=24,
+                       temperature=0.5, draft=m, draft_params=params)
