@@ -11,3 +11,4 @@ from .llama import LlamaConfig, Llama, RMSNorm, llama_params_to_tp
 from .mixtral import MixtralConfig, Mixtral
 from .speculative import generate_speculative
 from .beam import beam_search
+from .t5 import T5Config, T5

@@ -1,0 +1,383 @@
+"""T5 encoder-decoder (Raffel et al.) — the third architecture
+archetype next to BERT (encoder-only) and the GPT/Llama decoders.
+
+Faithful to the HF implementation the converter targets
+(``utils.hf_interop.t5_from_hf``; parity pinned in tests/test_t5.py):
+
+- T5's "LayerNorm" is RMS (no mean subtraction, no bias) — reused from
+  models/llama.RMSNorm;
+- attention is UNSCALED (no 1/sqrt(d_kv)) with a decoupled ``d_kv``;
+- a learned relative-position bias (bucketed, 32 buckets / max
+  distance 128) lives in layer 0 of each stack and is shared by every
+  layer of that stack — bidirectional buckets in the encoder, causal
+  in the decoder;
+- feed-forward is relu (t5) or gated-gelu (t5 v1.1);
+- with tied embeddings the decoder output is rescaled by
+  ``d_model**-0.5`` before the LM head (HF quirk, load-bearing).
+
+Decoding follows the repo's fixed-buffer discipline: the encoder runs
+once, cross-attention K/V are precomputed per layer, and the decoder
+walks its buffer with a (B, H, S, d_kv) self-attention cache —
+one compiled program for any prompt/target length.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from .llama import RMSNorm
+
+__all__ = ["T5Config", "T5"]
+
+
+class T5Config:
+    def __init__(self, vocab_size=32128, d_model=512, d_kv=64,
+                 d_ff=2048, num_layers=6, num_decoder_layers=None,
+                 num_heads=8, relative_attention_num_buckets=32,
+                 relative_attention_max_distance=128,
+                 layer_norm_epsilon=1e-6, dropout_rate=0.1,
+                 feed_forward_proj="relu", tie_word_embeddings=True,
+                 decoder_start_token_id=0, max_length=512):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.d_kv = d_kv
+        self.d_ff = d_ff
+        self.num_layers = num_layers
+        self.num_decoder_layers = (num_decoder_layers
+                                   if num_decoder_layers is not None
+                                   else num_layers)
+        self.num_heads = num_heads
+        self.relative_attention_num_buckets = \
+            relative_attention_num_buckets
+        self.relative_attention_max_distance = \
+            relative_attention_max_distance
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.dropout_rate = dropout_rate
+        if feed_forward_proj not in ("relu", "gated-gelu"):
+            raise ValueError(f"feed_forward_proj="
+                             f"{feed_forward_proj!r} not in "
+                             f"('relu', 'gated-gelu')")
+        self.feed_forward_proj = feed_forward_proj
+        self.tie_word_embeddings = tie_word_embeddings
+        self.decoder_start_token_id = decoder_start_token_id
+        self.max_length = max_length        # decode buffer bound
+
+
+def _relative_position_bucket(relative_position, bidirectional,
+                              num_buckets, max_distance):
+    """HF T5's bucketing, exactly (modeling_t5.py
+    _relative_position_bucket): half the buckets for exact small
+    offsets, the rest log-spaced out to max_distance."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + jnp.where(n < 0, num_buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-20)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5Attention(nn.Module):
+    """Unscaled multi-head attention with decoupled d_kv; layer 0 of a
+    stack owns the shared relative-position bias table."""
+
+    def __init__(self, cfg: T5Config, has_bias_table: bool):
+        super().__init__()
+        self.H = cfg.num_heads
+        self.dkv = cfg.d_kv
+        inner = self.H * self.dkv
+        self.q = nn.Linear(cfg.d_model, inner, bias=False)
+        self.k = nn.Linear(cfg.d_model, inner, bias=False)
+        self.v = nn.Linear(cfg.d_model, inner, bias=False)
+        self.o = nn.Linear(inner, cfg.d_model, bias=False)
+        self.has_bias_table = has_bias_table
+        self.nbuckets = cfg.relative_attention_num_buckets
+        self.maxdist = cfg.relative_attention_max_distance
+        if has_bias_table:
+            self.relative_attention_bias = nn.Embedding(
+                self.nbuckets, self.H)
+
+    def position_bias(self, p, q_pos, k_pos, bidirectional):
+        """(1, H, Tq, Tk) additive bias from the layer-0 table."""
+        rel = k_pos[None, :] - q_pos[:, None]
+        buckets = _relative_position_bucket(
+            rel, bidirectional, self.nbuckets, self.maxdist)
+        vals = self.relative_attention_bias(
+            p["relative_attention_bias"], buckets)      # (Tq, Tk, H)
+        return jnp.transpose(vals, (2, 0, 1))[None]
+
+    def _heads(self, x, B, T):
+        return jnp.moveaxis(x.reshape(B, T, self.H, self.dkv), 2, 1)
+
+    def forward(self, p, x, kv, mask, position_bias):
+        """``kv`` = x for self-attention, encoder states for cross.
+        ``mask``: additive fp mask broadcastable to (B, H, Tq, Tk) or
+        None; ``position_bias`` likewise (None for cross-attention)."""
+        B, Tq, _ = x.shape
+        Tk = kv.shape[1]
+        q = self._heads(self.q(p["q"], x), B, Tq)
+        k = self._heads(self.k(p["k"], kv), B, Tk)
+        v = self._heads(self.v(p["v"], kv), B, Tk)
+        scores = jnp.einsum("bhqd,bhkd->bhqk",
+                            q.astype(jnp.float32),
+                            k.astype(jnp.float32))   # NO 1/sqrt(d)
+        if position_bias is not None:
+            scores = scores + position_bias.astype(jnp.float32)
+        if mask is not None:
+            scores = scores + mask.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(B, Tq, self.H * self.dkv)
+        return self.o(p["o"], ctx)
+
+
+class T5FF(nn.Module):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.gated = cfg.feed_forward_proj == "gated-gelu"
+        if self.gated:
+            self.wi_0 = nn.Linear(cfg.d_model, cfg.d_ff, bias=False)
+            self.wi_1 = nn.Linear(cfg.d_model, cfg.d_ff, bias=False)
+        else:
+            self.wi = nn.Linear(cfg.d_model, cfg.d_ff, bias=False)
+        self.wo = nn.Linear(cfg.d_ff, cfg.d_model, bias=False)
+
+    def forward(self, p, x):
+        if self.gated:
+            h = (F.gelu(self.wi_0(p["wi_0"], x))
+                 * self.wi_1(p["wi_1"], x))
+        else:
+            h = F.relu(self.wi(p["wi"], x))
+        return self.wo(p["wo"], h)
+
+
+class T5EncoderBlock(nn.Module):
+    def __init__(self, cfg: T5Config, first: bool):
+        super().__init__()
+        eps = cfg.layer_norm_epsilon
+        self.ln_attn = RMSNorm(cfg.d_model, eps)
+        self.attn = T5Attention(cfg, has_bias_table=first)
+        self.ln_ff = RMSNorm(cfg.d_model, eps)
+        self.ff = T5FF(cfg)
+
+    def forward(self, p, x, mask, position_bias):
+        x = x + self.attn(p["attn"], self.ln_attn(p["ln_attn"], x),
+                          self.ln_attn(p["ln_attn"], x), mask,
+                          position_bias)
+        return x + self.ff(p["ff"], self.ln_ff(p["ln_ff"], x))
+
+
+class T5DecoderBlock(nn.Module):
+    def __init__(self, cfg: T5Config, first: bool):
+        super().__init__()
+        eps = cfg.layer_norm_epsilon
+        self.ln_self = RMSNorm(cfg.d_model, eps)
+        self.self_attn = T5Attention(cfg, has_bias_table=first)
+        self.ln_cross = RMSNorm(cfg.d_model, eps)
+        self.cross_attn = T5Attention(cfg, has_bias_table=False)
+        self.ln_ff = RMSNorm(cfg.d_model, eps)
+        self.ff = T5FF(cfg)
+
+    def forward(self, p, x, enc, self_mask, cross_mask, position_bias):
+        h = self.ln_self(p["ln_self"], x)
+        x = x + self.self_attn(p["self_attn"], h, h, self_mask,
+                               position_bias)
+        x = x + self.cross_attn(p["cross_attn"],
+                                self.ln_cross(p["ln_cross"], x), enc,
+                                cross_mask, None)
+        return x + self.ff(p["ff"], self.ln_ff(p["ln_ff"], x))
+
+
+def _neg(mask01):
+    """(B, T) 1=keep -> additive (B, 1, 1, T) with -inf-ish holes."""
+    return (1.0 - mask01.astype(jnp.float32))[:, None, None, :] * -1e9
+
+
+class T5(nn.Module):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.shared = nn.Embedding(cfg.vocab_size, cfg.d_model)
+        self.enc_blocks = nn.ModuleList(
+            [T5EncoderBlock(cfg, i == 0)
+             for i in range(cfg.num_layers)])
+        self.enc_norm = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        self.dec_blocks = nn.ModuleList(
+            [T5DecoderBlock(cfg, i == 0)
+             for i in range(cfg.num_decoder_layers)])
+        self.dec_norm = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.d_model, cfg.vocab_size,
+                                     bias=False)
+
+    # -- encoder -----------------------------------------------------------
+    def encode(self, p, input_ids, attention_mask=None):
+        B, T = input_ids.shape
+        x = self.shared(p["shared"], input_ids)
+        mask = (None if attention_mask is None
+                else _neg(attention_mask))
+        pos = jnp.arange(T)
+        bias = self.enc_blocks[0].attn.position_bias(
+            p["enc_blocks"]["0"]["attn"], pos, pos, bidirectional=True)
+        for i in range(self.cfg.num_layers):
+            x = self.enc_blocks[i](p["enc_blocks"][str(i)], x, mask,
+                                   bias)
+        return self.enc_norm(p["enc_norm"], x)
+
+    # -- decoder (full sequence; training/scoring path) --------------------
+    def _decode_hidden_full(self, p, dec_ids, enc, enc_mask):
+        B, T = dec_ids.shape
+        x = self.shared(p["shared"], dec_ids)
+        causal = jnp.where(
+            jnp.arange(T)[None, :] <= jnp.arange(T)[:, None],
+            0.0, -1e9)[None, None]
+        cross = None if enc_mask is None else _neg(enc_mask)
+        pos = jnp.arange(T)
+        bias = self.dec_blocks[0].self_attn.position_bias(
+            p["dec_blocks"]["0"]["self_attn"], pos, pos,
+            bidirectional=False)
+        for i in range(self.cfg.num_decoder_layers):
+            x = self.dec_blocks[i](p["dec_blocks"][str(i)], x, enc,
+                                   causal, cross, bias)
+        return self.dec_norm(p["dec_norm"], x)
+
+    def _head(self, p, x):
+        if self.cfg.tie_word_embeddings:
+            # HF quirk: tied head rescales the decoder output
+            x = x * jnp.asarray(self.cfg.d_model ** -0.5, x.dtype)
+            table = p["shared"]["weight"]
+        else:
+            table = p["lm_head"]["weight"]
+        return F.matmul(x, table.T.astype(x.dtype))
+
+    def forward(self, p, input_ids, decoder_input_ids,
+                attention_mask=None):
+        enc = self.encode(p, input_ids, attention_mask)
+        x = self._decode_hidden_full(p, decoder_input_ids, enc,
+                                     attention_mask)
+        return self._head(p, x)
+
+    def loss(self, p, input_ids, labels, attention_mask=None,
+             ignore_index=-100):
+        """Teacher-forced CE: decoder inputs are labels shifted right
+        with decoder_start_token_id (HF's _shift_right)."""
+        start = jnp.full((labels.shape[0], 1),
+                         self.cfg.decoder_start_token_id,
+                         labels.dtype)
+        safe_in = jnp.where(labels == ignore_index, 0, labels)
+        dec_in = jnp.concatenate([start, safe_in[:, :-1]], axis=1)
+        logits = self.forward(p, input_ids, dec_in, attention_mask)
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0)
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    # -- cached greedy generation ------------------------------------------
+    def generate(self, p, input_ids, max_new_tokens: int,
+                 attention_mask=None):
+        """Greedy decode from ``decoder_start_token_id``: encoder runs
+        once, cross K/V precompute once per layer, decoder self-attn
+        walks a (B, H, S, d_kv) cache.  Returns (B, max_new_tokens)
+        generated ids (incl. whatever EOS convention the checkpoint
+        uses — trimming is the tokenizer's job)."""
+        cfg = self.cfg
+        B = input_ids.shape[0]
+        S = max_new_tokens
+        enc = self.encode(p, input_ids, attention_mask)
+        cross_mask = (None if attention_mask is None
+                      else _neg(attention_mask))
+
+        cross_kv = []
+        for i in range(cfg.num_decoder_layers):
+            ca = self.dec_blocks[i].cross_attn
+            cp = p["dec_blocks"][str(i)]["cross_attn"]
+            Tk = enc.shape[1]
+            cross_kv.append((
+                ca._heads(ca.k(cp["k"], enc), B, Tk),
+                ca._heads(ca.v(cp["v"], enc), B, Tk)))
+
+        cache = [{
+            "k": jnp.zeros((B, cfg.num_heads, S, cfg.d_kv), enc.dtype),
+            "v": jnp.zeros((B, cfg.num_heads, S, cfg.d_kv), enc.dtype),
+        } for _ in range(cfg.num_decoder_layers)]
+
+        bias_p = p["dec_blocks"]["0"]["self_attn"]
+        b0 = self.dec_blocks[0].self_attn
+
+        def body(t, carry):
+            out, cache = carry
+            tok = jnp.where(t == 0,
+                            jnp.full((B,), cfg.decoder_start_token_id),
+                            out[:, jnp.maximum(t - 1, 0)])
+            x = self.shared(p["shared"], tok[:, None])
+            # self-attn bias row for query position t over keys 0..S-1
+            bias = b0.position_bias(
+                bias_p, jnp.full((1,), t), jnp.arange(S),
+                bidirectional=False)
+            key_mask = jnp.where(jnp.arange(S)[None, None, None, :]
+                                 <= t, 0.0, -1e9)
+            new_cache = []
+            for i in range(cfg.num_decoder_layers):
+                blk = self.dec_blocks[i]
+                bp = p["dec_blocks"][str(i)]
+                h = blk.ln_self(bp["ln_self"], x)
+                sa = blk.self_attn
+                q = sa._heads(sa.q(bp["self_attn"]["q"], h), B, 1)
+                k1 = sa._heads(sa.k(bp["self_attn"]["k"], h), B, 1)
+                v1 = sa._heads(sa.v(bp["self_attn"]["v"], h), B, 1)
+                ck = lax.dynamic_update_slice_in_dim(
+                    cache[i]["k"], k1, t, axis=2)
+                cv = lax.dynamic_update_slice_in_dim(
+                    cache[i]["v"], v1, t, axis=2)
+                new_cache.append({"k": ck, "v": cv})
+                scores = jnp.einsum(
+                    "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                    ck.astype(jnp.float32)) + bias + key_mask
+                probs = jax.nn.softmax(scores, -1).astype(x.dtype)
+                ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+                ctx = jnp.moveaxis(ctx, 1, 2).reshape(
+                    B, 1, cfg.num_heads * cfg.d_kv)
+                x = x + sa.o(bp["self_attn"]["o"], ctx)
+                # cross-attention against the precomputed encoder K/V
+                hc = blk.ln_cross(bp["ln_cross"], x)
+                ca = blk.cross_attn
+                qc = ca._heads(ca.q(bp["cross_attn"]["q"], hc), B, 1)
+                ckv, cvv = cross_kv[i]
+                cs = jnp.einsum("bhqd,bhkd->bhqk",
+                                qc.astype(jnp.float32),
+                                ckv.astype(jnp.float32))
+                if cross_mask is not None:
+                    cs = cs + cross_mask
+                cp2 = jax.nn.softmax(cs, -1).astype(x.dtype)
+                cctx = jnp.einsum("bhqk,bhkd->bhqd", cp2, cvv)
+                cctx = jnp.moveaxis(cctx, 1, 2).reshape(
+                    B, 1, cfg.num_heads * cfg.d_kv)
+                x = x + ca.o(bp["cross_attn"]["o"], cctx)
+                x = x + blk.ff(bp["ff"], blk.ln_ff(bp["ln_ff"], x))
+            x = self.dec_norm(p["dec_norm"], x)
+            logits = self._head(p, x)[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out = lax.dynamic_update_slice_in_dim(
+                out, nxt[:, None], t, axis=1)
+            return out, new_cache
+
+        out = jnp.zeros((B, S), jnp.int32)
+        out, _ = lax.fori_loop(0, S, body, (out, cache))
+        return out

@@ -697,3 +697,83 @@ def llama_to_hf(cfg, params):
     if not cfg.tie_word_embeddings and "lm_head" in params:
         sd["lm_head.weight"] = t(params["lm_head"]["weight"])
     return sd
+
+
+def t5_from_hf(hf_model):
+    """(T5Config, params) for apex_tpu.models.T5 from a transformers
+    T5Model / T5ForConditionalGeneration (t5 relu or v1.1 gated-gelu).
+    Same-layout renaming; the layer-0 relative-attention-bias tables
+    map per stack."""
+    import numpy as _np
+    from ..models import T5Config
+
+    hc = hf_model.config
+    ff = hc.feed_forward_proj
+    if ff not in ("relu", "gated-gelu"):
+        raise ValueError(f"unsupported feed_forward_proj {ff!r}")
+    cfg = T5Config(
+        vocab_size=hc.vocab_size, d_model=hc.d_model, d_kv=hc.d_kv,
+        d_ff=hc.d_ff, num_layers=hc.num_layers,
+        num_decoder_layers=hc.num_decoder_layers,
+        num_heads=hc.num_heads,
+        relative_attention_num_buckets=
+        hc.relative_attention_num_buckets,
+        relative_attention_max_distance=
+        hc.relative_attention_max_distance,
+        layer_norm_epsilon=hc.layer_norm_epsilon,
+        dropout_rate=hc.dropout_rate, feed_forward_proj=ff,
+        tie_word_embeddings=hc.tie_word_embeddings,
+        decoder_start_token_id=hc.decoder_start_token_id or 0)
+    sd = hf_model.state_dict()
+
+    def w(name):
+        return {"weight": _t(sd[f"{name}.weight"])}
+
+    def attn(prefix, with_bias_table):
+        out = {k: w(f"{prefix}.{k}") for k in ("q", "k", "v", "o")}
+        if with_bias_table:
+            out["relative_attention_bias"] = w(
+                f"{prefix}.relative_attention_bias")
+        return out
+
+    def ff_params(prefix):
+        if ff == "gated-gelu":
+            return {"wi_0": w(f"{prefix}.wi_0"),
+                    "wi_1": w(f"{prefix}.wi_1"),
+                    "wo": w(f"{prefix}.wo")}
+        return {"wi": w(f"{prefix}.wi"), "wo": w(f"{prefix}.wo")}
+
+    enc = {}
+    for i in range(hc.num_layers):
+        b = f"encoder.block.{i}"
+        enc[str(i)] = {
+            "ln_attn": w(f"{b}.layer.0.layer_norm"),
+            "attn": attn(f"{b}.layer.0.SelfAttention", i == 0),
+            "ln_ff": w(f"{b}.layer.1.layer_norm"),
+            "ff": ff_params(f"{b}.layer.1.DenseReluDense"),
+        }
+    dec = {}
+    for i in range(hc.num_decoder_layers):
+        b = f"decoder.block.{i}"
+        dec[str(i)] = {
+            "ln_self": w(f"{b}.layer.0.layer_norm"),
+            "self_attn": attn(f"{b}.layer.0.SelfAttention", i == 0),
+            "ln_cross": w(f"{b}.layer.1.layer_norm"),
+            "cross_attn": attn(f"{b}.layer.1.EncDecAttention", False),
+            "ln_ff": w(f"{b}.layer.2.layer_norm"),
+            "ff": ff_params(f"{b}.layer.2.DenseReluDense"),
+        }
+    params = {
+        "shared": w("shared"),
+        "enc_blocks": enc,
+        "enc_norm": w("encoder.final_layer_norm"),
+        "dec_blocks": dec,
+        "dec_norm": w("decoder.final_layer_norm"),
+    }
+    if not hc.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"weight": _t(sd["lm_head.weight"])}
+        else:
+            params["lm_head"] = {"weight": _np.zeros(
+                (hc.vocab_size, hc.d_model), _np.float32)}
+    return cfg, _to_jnp(params)

@@ -1,0 +1,108 @@
+"""T5 encoder-decoder: HF logits parity (relu and gated-gelu), greedy
+generation parity through the cached decoder, loss/training smoke."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import T5, T5Config
+
+
+def _pair(ff="relu", tie=True):
+    import torch
+    from transformers import (T5Config as HFConfig,
+                              T5ForConditionalGeneration)
+    from apex_tpu.utils import hf_interop
+
+    hf_cfg = HFConfig(vocab_size=151, d_model=32, d_kv=8, d_ff=64,
+                      num_layers=2, num_decoder_layers=2, num_heads=4,
+                      relative_attention_num_buckets=8,
+                      relative_attention_max_distance=20,
+                      feed_forward_proj=ff, tie_word_embeddings=tie,
+                      dropout_rate=0.0, decoder_start_token_id=0,
+                      eos_token_id=1, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = T5ForConditionalGeneration(hf_cfg).eval()
+    cfg, params = hf_interop.t5_from_hf(hf)
+    return hf, T5(cfg), params
+
+
+@pytest.mark.parametrize("ff,tie", [("relu", True),
+                                    ("gated-gelu", False)])
+def test_t5_logits_match_transformers(ff, tie):
+    import torch
+
+    hf, m, params = _pair(ff, tie)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(2, 151, (2, 12))
+    dec = rng.randint(2, 151, (2, 7))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 decoder_input_ids=torch.from_numpy(dec)).logits.numpy()
+    out = np.asarray(m(params, jnp.asarray(ids), jnp.asarray(dec)))
+    np.testing.assert_allclose(out, ref, rtol=4e-4, atol=4e-4)
+
+
+def test_t5_attention_mask_matches_transformers():
+    import torch
+
+    hf, m, params = _pair()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(2, 151, (2, 10))
+    amask = np.ones((2, 10), np.int64)
+    amask[0, 6:] = 0                       # padded row
+    dec = rng.randint(2, 151, (2, 5))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids),
+                 attention_mask=torch.from_numpy(amask),
+                 decoder_input_ids=torch.from_numpy(dec)).logits.numpy()
+    out = np.asarray(m(params, jnp.asarray(ids), jnp.asarray(dec),
+                       jnp.asarray(amask)))
+    np.testing.assert_allclose(out, ref, rtol=4e-4, atol=4e-4)
+
+
+def test_t5_greedy_generation_matches_transformers():
+    import torch
+
+    hf, m, params = _pair()
+    rng = np.random.RandomState(2)
+    ids = rng.randint(2, 151, (2, 9))
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                          do_sample=False, min_new_tokens=8).numpy()
+    out = np.asarray(m.generate(params, jnp.asarray(ids), 8))
+    # HF prepends decoder_start (0); compare the generated tail, up to
+    # any early EOS stop on HF's side
+    gen = ref[:, 1:]
+    n = gen.shape[1]
+    np.testing.assert_array_equal(out[:, :n], gen)
+
+
+def test_t5_loss_and_training():
+    from apex_tpu import optimizers
+    cfg = T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=1, num_heads=4, dropout_rate=0.0,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=16)
+    m = T5(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(2, 64, (2, 10)))
+    labels = jnp.asarray(rng.randint(2, 64, (2, 6)))
+    opt = optimizers.FusedAdam(lr=3e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(params, ost):
+        loss, g = jax.value_and_grad(
+            lambda p: m.loss(p, ids, labels))(params)
+        params, ost = opt.step(params, ost, g)
+        return params, ost, loss
+
+    first = None
+    for _ in range(25):
+        params, ost, loss = step(params, ost)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 0.5, (first, float(loss))
