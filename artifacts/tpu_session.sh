@@ -78,7 +78,8 @@ if ! skip serving; then
 log "serving/decode surface on chip (families, chunked prefill, engine, speculative)"
 timeout 3600 env APEX_TPU_TEST_BACKEND=tpu python -m pytest \
     tests/test_prefill.py tests/test_serving.py \
-    tests/test_family_training.py tests/test_speculative.py -q 2>&1 \
+    tests/test_family_training.py tests/test_speculative.py \
+    tests/test_t5.py -q 2>&1 \
     | tail -25 | tee "artifacts/tpu_serving_tests_$TS.log"
 RC=$?
 stat $RC

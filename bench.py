@@ -384,6 +384,41 @@ def main():
                   f"{'int8 weights' if int8_weights else 'bf16 params'}+"
                   f"{'int8' if int8_cache else 'bf16'} cache; {how}")
 
+    def t5_config(metric, cfg, batch_per_chip, src_len, tgt_len,
+                  iters, warmup):
+        """Encoder-decoder training throughput (teacher-forced loss)."""
+        model, optimizer = amp.initialize(
+            models.T5(cfg), optimizers.FusedAdam(lr=1e-4),
+            opt_level="O2", verbosity=0)
+        ddp = parallel.DistributedDataParallel(model)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        opt_state = optimizer.init(params)
+        B = batch_per_chip * ndev
+        rng = np.random.RandomState(0)
+        src = jnp.asarray(rng.randint(2, cfg.vocab_size, (B, src_len)),
+                          jnp.int32)
+        tgt = jnp.asarray(rng.randint(2, cfg.vocab_size, (B, tgt_len)),
+                          jnp.int32)
+
+        def step(state, batch):
+            params, opt_state = state
+            src_b, tgt_b = batch
+
+            def loss_fn(p):
+                return model.loss(p, src_b, tgt_b), ()
+
+            loss, _, grads = amp.scaled_grad(loss_fn, params, opt_state,
+                                             has_aux=True)
+            grads = ddp.allreduce_grads(grads)
+            params, opt_state, _ = optimizer.step(params, opt_state,
+                                                  grads)
+            return (params, opt_state), lax.pmean(loss, "data")
+
+        dt = timed_scan(ddp, step, (params, opt_state), (src, tgt),
+                        ((B, src_len), (B, tgt_len)), 1, iters, warmup)
+        emit(metric=metric, value=round(B / dt / ndev, 1),
+             unit="sequences/sec/chip", vs_baseline=None)
+
     def engine_config(metric, cfg, slots, prompt, new_tokens,
                       model_cls=None):
         """Continuous-batching engine throughput: keep every slot busy
@@ -581,6 +616,13 @@ def main():
                      max_position_embeddings=512,
                      tie_word_embeddings=True),
                  8, 64, 128, model_cls=models.Llama)),
+            ("t5_small_o2_train_throughput",
+             lambda: t5_config(
+                 "t5_small_o2_train_throughput",
+                 models.T5Config(vocab_size=32128, d_model=512,
+                                 d_kv=64, d_ff=2048, num_layers=6,
+                                 num_heads=8, dropout_rate=0.0),
+                 8, 256, 64, 8, 2)),
             ("gpt2_small_engine_decode_throughput",
              lambda: engine_config(
                  "gpt2_small_engine_decode_throughput",
@@ -645,6 +687,15 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 8)),
+            ("t5_tiny_o2_train_throughput",
+             lambda: t5_config(
+                 "t5_tiny_o2_train_throughput",
+                 models.T5Config(vocab_size=128, d_model=32, d_kv=8,
+                                 d_ff=64, num_layers=1, num_heads=4,
+                                 dropout_rate=0.0,
+                                 relative_attention_num_buckets=8,
+                                 relative_attention_max_distance=16),
+                 2, 12, 6, 2, 1)),
             ("gpt_tiny_engine_decode_throughput",
              lambda: engine_config(
                  "gpt_tiny_engine_decode_throughput",
