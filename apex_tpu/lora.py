@@ -69,7 +69,7 @@ def init(params: Any, targets: Sequence[str], rank: int = 8,
         key, sub = jax.random.split(key)
         adapters[pstr] = {
             "a": (jax.random.normal(sub, (rank, in_f), jnp.float32)
-                  / rank),
+                  / float(rank) ** 0.5),
             "b": jnp.zeros((out_f, rank), jnp.float32),
         }
     if not adapters:

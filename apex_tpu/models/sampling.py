@@ -6,8 +6,9 @@ The reference toolkit has no generation story (2019, pre-LLM serving);
 this follows the de-facto HF ``generate`` semantics so converted
 checkpoints sample comparably: logits are scaled by ``1/temperature``
 FIRST, then top-k keeps the k best, then top-p keeps the smallest
-prefix of the sorted distribution whose mass reaches ``top_p`` (the
-best token always survives every filter).
+prefix of the sorted distribution whose mass reaches ``top_p``, then
+min-p drops tokens under ``min_p * max_prob`` of the filtered
+distribution (the best token always survives every filter).
 """
 
 from __future__ import annotations
@@ -33,12 +34,6 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if min_p is not None:
-        if not 0.0 < min_p <= 1.0:
-            raise ValueError(f"min_p must be in (0, 1], got {min_p}")
-        probs = jax.nn.softmax(logits, axis=-1)
-        cut = min_p * jnp.max(probs, axis=-1, keepdims=True)
-        logits = jnp.where(probs < cut, -jnp.inf, logits)
     if top_p is not None:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
@@ -51,6 +46,15 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
         thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    # min_p runs LAST, matching HF's warper order (temperature, top_k,
+    # top_p, min_p): its softmax sees the already-filtered distribution,
+    # so combined-filter sampling keeps the same token set HF would.
+    if min_p is not None:
+        if not 0.0 < min_p <= 1.0:
+            raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+        probs = jax.nn.softmax(logits, axis=-1)
+        cut = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs < cut, -jnp.inf, logits)
     return logits
 
 

@@ -19,10 +19,14 @@ Design (deliberately simple — correctness over paging):
 - a request finishes on ``eos_token_id`` or its ``max_new_tokens``;
   the slot frees immediately and can be reclaimed next ``add_request``.
 
-Exactness: a request's output is token-for-token what
-``generate_cached`` would produce for it alone — regardless of what
-other requests share the batch (pinned in tests/test_serving.py with
-staggered arrivals).
+Exactness (greedy and speculative-greedy paths): a request's output is
+token-for-token what ``generate_cached`` would produce for it alone —
+regardless of what other requests share the batch (pinned in
+tests/test_serving.py with staggered arrivals).  In sampled mode
+(``temperature > 0``) the numbers are still per-slot-correct but NOT
+batch-independent: one RNG key is split per step across all slots, so
+a request's draws depend on which other requests share the batch and
+on arrival timing (the same caveat any shared-stream sampler has).
 
 Works with any model exposing ``prefill_cache`` / ``decode_chunk`` /
 ``init_cache`` and a greedy head (GPT, Llama and its Mistral / Qwen2 /

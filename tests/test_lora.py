@@ -30,6 +30,11 @@ def test_merge_at_init_is_identity():
     for a, b in zip(jax.tree_util.tree_leaves(params),
                     jax.tree_util.tree_leaves(merged)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # pin the documented A ~ N(0, 1/rank) init: std 1/sqrt(rank), not
+    # the pre-r5 1/rank (merge-identity alone is scale-invariant)
+    a_all = np.concatenate([np.asarray(v["a"]).ravel()
+                            for v in ad.values()])
+    np.testing.assert_allclose(a_all.std(), 0.5, rtol=0.1)
 
 
 def test_adapter_only_training_learns_and_freezes_base():

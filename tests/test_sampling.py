@@ -115,6 +115,25 @@ def test_min_p_support():
         sampling.filter_logits(jnp.zeros((1, 4)), min_p=0.0)
 
 
+def test_min_p_runs_after_top_p():
+    # HF warper order: top_p filters FIRST, min_p last.  min_p's cut is
+    # ratio-based (p < min_p * p_max, invariant under renorm), so the
+    # order only shows when min_p-first would have shrunk top_p's
+    # cumulative mass.  probs [0.4, 0.3, 0.2, 0.1], top_p=0.75,
+    # min_p=0.4:
+    #   correct (top_p first): prefix mass [0, .4, .7, .9] < .75 keeps
+    #     {0,1,2}; min_p cut 0.4*p_max keeps ratio >= 0.4 -> 0.2/0.4 =
+    #     0.5 survives -> {0,1,2}.
+    #   wrong (min_p first): cut 0.16 kills only token 3; renorm to
+    #     [4/9, 3/9, 2/9]; prefix mass [0, .44, .78] -> top_p keeps
+    #     only {0,1}.
+    probs = np.array([0.4, 0.3, 0.2, 0.1])
+    out = np.asarray(sampling.filter_logits(
+        jnp.asarray(np.log(probs))[None], top_p=0.75, min_p=0.4))
+    assert np.isfinite(out[0, [0, 1, 2]]).all()
+    assert np.isneginf(out[0, 3])
+
+
 def test_repetition_penalty_hand_case():
     logits = jnp.asarray([[2.0, -1.0, 0.5, 3.0]])
     ids = jnp.asarray([[0, 1, 0, 9]])       # tokens 0 and 1 seen
