@@ -4,6 +4,7 @@ provenance, headline last, so the round artifact degrades to "last known
 hardware number" instead of a CPU smoke that reads as a regression."""
 
 import json
+import re
 import os
 import sys
 
@@ -84,7 +85,9 @@ def test_stale_lines_annotate_and_order_headline_last(tmp_path):
     for ln in out:
         assert ln["stale"] is True
         assert ln["stale_recorded_at"] == "2026-07-30T04:55:00Z"
-        assert "last known TPU measurement" in ln["note"]
+        assert ln["note"].startswith(
+            "STALE REPLAY — NOT A FRESH MEASUREMENT")
+        assert re.search(r"captured \d+d ago", ln["note"])
         assert json.loads(json.dumps(ln)) == ln    # JSON-serializable
     # original note preserved after the stale prefix
     assert "chunked-psum path" in out[0]["note"]
