@@ -38,6 +38,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -48,7 +50,8 @@ from .sync_batchnorm import _axis_in_scope
 from .tensor_parallel import (copy_to_model_parallel,
                               reduce_from_model_parallel)
 
-__all__ = ["init_stacked", "stacked_specs", "pipeline_apply"]
+__all__ = ["init_stacked", "stacked_specs", "pipeline_apply",
+           "pipeline_1f1b_grads", "bubble_fraction"]
 
 DEFAULT_AXIS = "pp"
 
@@ -129,3 +132,213 @@ def pipeline_apply(block: Module, stacked_params: Any, x: jax.Array,
     # replicated downstream loss doesn't inflate gradients S-fold
     mask = (idx == S - 1).astype(out_buf.dtype)
     return reduce_from_model_parallel(out_buf * mask, axis_name)
+
+
+def bubble_fraction(n_stages: int, n_micro: int,
+                    schedule: str = "1f1b") -> float:
+    """Idle fraction of the pipeline schedule under the lockstep SPMD
+    cost model (every tick, every device executes the same compiled
+    graph; a stage with no scheduled work that tick burns the tick).
+
+    - ``"gpipe"`` (:func:`pipeline_apply` + autodiff): forward scan of
+      ``M + S - 1`` F-ticks then a transposed backward scan of
+      ``M + S - 1`` B-ticks; each phase wastes ``S - 1`` wavefront
+      ticks -> bubble ``(S - 1) / (M + S - 1)``.
+    - ``"1f1b"`` (:func:`pipeline_1f1b_grads`): ONE scan of
+      ``M + 2(S - 1)`` combined ticks (each executes the F-unit and the
+      B-unit); the warmup/drain wavefronts waste ``2(S - 1)`` ticks ->
+      bubble ``2(S - 1) / (M + 2(S - 1))``.
+
+    For the same M the fractions are equal — lockstep SPMD cannot buy
+    wall-clock with schedule order the way a MIMD host scheduler can
+    (there is no per-device program to reorder).  What 1F1B buys here is
+    PEAK MEMORY: its activation stash is bounded by ``min(M, 2S - 1)``
+    microbatches regardless of M, while GPipe's transposed scan stashes
+    all ``M`` (see ``pipeline_1f1b_grads``).  Driving the bubble itself
+    down means raising M — which GPipe pays for in activation memory
+    and 1F1B does not.
+    """
+    S, M = n_stages, n_micro
+    if schedule == "gpipe":
+        return (S - 1) / (M + S - 1)
+    if schedule == "1f1b":
+        return 2 * (S - 1) / (M + 2 * (S - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def pipeline_1f1b_grads(block: Module, loss_fn, stacked_params: Any,
+                        x: jax.Array, targets: jax.Array,
+                        axis_name: str = DEFAULT_AXIS):
+    """One fused forward+backward pipeline pass under a 1F1B schedule:
+    returns ``(loss, stacked_grads)`` with the activation stash bounded
+    by ``min(M, 2S - 1)`` microbatches instead of GPipe's ``M``.
+
+    ``x``/``targets`` are ``(M, B, ...)`` microbatches (replicated over
+    ``axis_name``); ``loss_fn(y, target) -> scalar`` scores one
+    microbatch of last-stage outputs; ``loss`` is the mean over the M
+    microbatches, replicated; ``stacked_grads`` mirrors
+    ``stacked_params`` (grads of the SUM-scaled-to-mean loss, each
+    device computing exactly its stage's slice — shard with
+    :func:`stacked_specs` in/out).
+
+    Why not ``jax.grad(pipeline_apply)``?  Autodiff of the GPipe scan
+    stashes every tick's residuals — O(M) microbatch activations per
+    stage — and runs a second, transposed scan.  Megatron's 1F1B
+    (PipeDream-flush) bounds in-flight microbatches at O(S) by starting
+    backwards as soon as the first microbatch clears the last stage.
+    This is that schedule, expressed the SPMD way: ONE ``lax.scan`` of
+    ``M + 2(S - 1)`` ticks where every tick runs an F-unit (forward of
+    one microbatch) and a B-unit (VJP of an earlier microbatch):
+
+    - F(s, m) fires at tick ``s + m``; activations hop to s+1 via
+      ``ppermute`` (forward ICI ring);
+    - B(s, m) fires at tick ``2(S-1) - s + m``; cotangents hop to s-1
+      via the reverse ring; the last stage seeds them from
+      ``loss_fn``'s gradient the same tick its forward finishes;
+    - between its F and its B, a microbatch's VJP residuals wait in a
+      rotating ``min(M, 2S-1)``-slot stash — residuals are extracted as
+      arrays with ``jax.closure_convert`` (the closure itself cannot
+      cross a scan boundary), and the tick-invariant parameter
+      residuals are identified by tracer identity and passed live
+      rather than stashed K times;
+    - per-stage grads accumulate in fp32 across microbatches and cast
+      back to the param dtype at the end.
+
+    See :func:`bubble_fraction` for the honest cost model: same bubble
+    as GPipe under lockstep SPMD, O(S) not O(M) activation memory —
+    i.e. the same reason Megatron prefers it (memory, not bubble; its
+    bubble win needs the interleaved variant + a MIMD scheduler).
+
+    Like :func:`pipeline_apply`, the block must be shape-homogeneous
+    (output shape == input shape).  Call inside ``shard_map``; outside
+    any mesh it degrades to the sequential forward + plain autodiff.
+    The reference toolkit has no pipeline story (SURVEY.md §2.3); the
+    schedule itself follows Narayanan et al.'s PipeDream-flush as used
+    by Megatron-LM.
+    """
+    if not _axis_in_scope(axis_name):
+        S = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        M = x.shape[0]
+
+        def seq_loss(p):
+            out = x
+            for s in range(S):
+                ps = jax.tree_util.tree_map(lambda l: l[s], p)
+                out = jax.vmap(lambda mb, ps=ps: block(ps, mb))(out)
+            per_mb = jax.vmap(loss_fn)(out, targets)
+            return jnp.mean(per_mb)
+
+        loss, grads = jax.value_and_grad(seq_loss)(stacked_params)
+        return loss, grads
+
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x.shape[0]
+    T = M + 2 * (S - 1)
+    K = min(M, 2 * S - 1)
+    local_p = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
+
+    def block_fn(p, xin):
+        return block(p, xin)
+
+    # an amp-cast block may compute in a narrower dtype than the fed
+    # x (O2 casts inputs to bf16 at its top); the scan carries must use
+    # the block's OUTPUT dtype or the y/dy rings won't typecheck
+    y_shape = jax.eval_shape(block_fn, local_p, x[0])
+    x = x.astype(y_shape.dtype)
+
+    # one abstract vjp to fix the residual structure; the value-level
+    # computation below is dead code XLA removes — only `conv` (a
+    # closed jaxpr) and the residual shapes/param-identity split are
+    # used.  Param residuals are recognized by tracer identity (stable
+    # across traces of the same function, pinned in tests).
+    y0, vjp0 = jax.vjp(block_fn, local_p, x[0])
+    conv, res0 = jax.closure_convert(vjp0, y0)
+    p_ids = {id(l) for l in jax.tree_util.tree_leaves(local_p)}
+    stash_i = [i for i, r in enumerate(res0) if id(r) not in p_ids]
+    stash0 = [jnp.zeros((K,) + res0[i].shape, res0[i].dtype)
+              for i in stash_i]
+
+    # static schedule tables: microbatch handled by (tick, stage), -1
+    # = idle.  Computed in numpy at trace time — S, M are static.
+    t_idx = np.arange(T)[:, None]
+    s_idx = np.arange(S)[None, :]
+    fwd = t_idx - s_idx
+    fwd_tab = jnp.asarray(np.where((fwd >= 0) & (fwd < M), fwd, -1),
+                          jnp.int32)
+    bwd = t_idx - (2 * (S - 1) - s_idx)
+    bwd_tab = jnp.asarray(np.where((bwd >= 0) & (bwd < M), bwd, -1),
+                          jnp.int32)
+
+    perm_f = [(i, (i + 1) % S) for i in range(S)]
+    perm_b = [(i, (i - 1) % S) for i in range(S)]
+    g0 = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, jnp.float32), local_p)
+
+    def tick(carry, t):
+        recv_y, recv_dy, stash, gacc, lacc = carry
+        mb_f = jnp.take(lax.dynamic_index_in_dim(fwd_tab, t, 0, False),
+                        idx)
+        mb_b = jnp.take(lax.dynamic_index_in_dim(bwd_tab, t, 0, False),
+                        idx)
+
+        # --- F-unit: forward one microbatch, stash its residuals -----
+        x_inj = lax.dynamic_index_in_dim(x, jnp.clip(mb_f, 0, M - 1),
+                                         0, False)
+        xin = jnp.where(idx == 0, x_inj, recv_y)
+        y, vjp = jax.vjp(block_fn, local_p, xin)
+        _, res = jax.closure_convert(vjp, y)
+        # residual-drift canary: the stash indices and the param/
+        # activation split are computed from the OUTER trace (res0) and
+        # applied positionally here — a jax upgrade that reorders
+        # closure_convert's extraction would silently corrupt grads, so
+        # compare the full (shape, dtype) signature, not just the count
+        sig = [(tuple(r.shape), r.dtype) for r in res]
+        sig0 = [(tuple(r.shape), r.dtype) for r in res0]
+        if sig != sig0:
+            raise RuntimeError(
+                "closure_convert residual structure changed between "
+                f"traces ({sig} vs {sig0})")
+        # idle F-ticks scatter out-of-bounds -> dropped, so a drain
+        # tick can't clobber a slot still awaiting its backward
+        slot_w = jnp.where(mb_f >= 0, jnp.clip(mb_f, 0, M - 1) % K, K)
+        stash = [s.at[slot_w].set(res[i], mode="drop")
+                 for s, i in zip(stash, stash_i)]
+
+        # --- B-unit: VJP of an earlier microbatch from the stash -----
+        tgt = lax.dynamic_index_in_dim(targets,
+                                       jnp.clip(mb_b, 0, M - 1), 0,
+                                       False)
+        # last stage: this tick's forward IS microbatch mb_b (the
+        # schedule aligns them), so its loss gradient seeds the chain
+        lval, dy_loss = jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt))(y)
+        is_last = idx == S - 1
+        dy = jnp.where(is_last, dy_loss / M, recv_dy)
+        slot_r = jnp.clip(mb_b, 0, M - 1) % K
+        res_b = list(res)               # param residuals ride live
+        for s, i in zip(stash, stash_i):
+            res_b[i] = lax.dynamic_index_in_dim(s, slot_r, 0, False)
+        # the last stage's residuals for mb_b were stashed THIS tick
+        # (read-after-write above), so the gather sees them
+        dp, dxin = conv(dy, *res_b)
+        b_valid = mb_b >= 0
+        gacc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(b_valid, d, 0).astype(g.dtype),
+            gacc, dp)
+        lacc = lacc + jnp.where(jnp.logical_and(b_valid, is_last),
+                                lval, 0.0) / M
+
+        # --- neighbor exchanges (both rings ride ICI) ----------------
+        y_nxt = lax.ppermute(y, axis_name, perm_f)
+        dy_nxt = lax.ppermute(dxin, axis_name, perm_b)
+        return (y_nxt, dy_nxt, stash, gacc, lacc), None
+
+    zero_y = jnp.zeros_like(x[0])
+    carry0 = (zero_y, zero_y, stash0, g0, jnp.float32(0.0))
+    (_, _, _, gacc, lacc), _ = lax.scan(tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(jnp.where(idx == S - 1, lacc, 0.0), axis_name)
+    grads = jax.tree_util.tree_map(
+        lambda g, l: g.astype(l.dtype)[None], gacc, local_p)
+    return loss, grads

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import nn
@@ -132,3 +133,158 @@ def test_pipeline_with_data_parallel():
     np.testing.assert_allclose(np.asarray(y),
                                np.asarray(_sequential_ref(block, stacked,
                                                           x)), atol=2e-5)
+
+
+# --------------------------- 1F1B schedule ---------------------------
+
+def _mse(y, t):
+    return F.mse_loss(y, t)
+
+
+def _ref_loss_grads(block, stacked, x, targets):
+    def seq_loss(p):
+        out = _sequential_ref(block, p, x)
+        return jnp.mean(jax.vmap(_mse)(out, targets))
+    return jax.value_and_grad(seq_loss)(stacked)
+
+
+@pytest.mark.parametrize("n_micro,S", [(4, 4), (7, 4), (2, 2), (8, 8)])
+def test_1f1b_loss_and_grads_match_sequential(n_micro, S):
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    block = Block(8)
+    stacked = pp.init_stacked(block, jax.random.PRNGKey(5), S)
+    specs = pp.stacked_specs(stacked)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(n_micro, 3, 8), jnp.float32)
+    tgt = jnp.asarray(rng.randn(n_micro, 3, 8), jnp.float32)
+
+    loss, grads = jax.jit(jax.shard_map(
+        lambda p, xb, tb: pp.pipeline_1f1b_grads(block, _mse, p, xb, tb),
+        mesh=mesh, in_specs=(specs, P(), P()),
+        out_specs=(P(), specs), check_vma=False))(stacked, x, tgt)
+    loss_ref, grads_ref = _ref_loss_grads(block, stacked, x, tgt)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    assert_trees_close(grads, grads_ref, atol=2e-4)
+
+
+def test_1f1b_single_device_fallback():
+    block = Block(8)
+    stacked = pp.init_stacked(block, jax.random.PRNGKey(6), 3)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(4, 2, 8), jnp.float32)
+    tgt = jnp.asarray(rng.randn(4, 2, 8), jnp.float32)
+    loss, grads = pp.pipeline_1f1b_grads(block, _mse, stacked, x, tgt)
+    loss_ref, grads_ref = _ref_loss_grads(block, stacked, x, tgt)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-6)
+    assert_trees_close(grads, grads_ref, atol=1e-6)
+
+
+def test_1f1b_loss_replicated_across_ranks():
+    S = 4
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    block = Block(8)
+    stacked = pp.init_stacked(block, jax.random.PRNGKey(7), S)
+    specs = pp.stacked_specs(stacked)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(5, 2, 8), jnp.float32)
+    tgt = jnp.asarray(rng.randn(5, 2, 8), jnp.float32)
+    loss = jax.jit(jax.shard_map(
+        lambda p, xb, tb: pp.pipeline_1f1b_grads(block, _mse, p, xb,
+                                                 tb)[0],
+        mesh=mesh, in_specs=(specs, P(), P()), out_specs=P(),
+        check_vma=False))(stacked, x, tgt)
+    shards = [float(np.asarray(s.data)) for s in loss.addressable_shards]
+    assert all(s == shards[0] for s in shards[1:])
+
+
+def test_1f1b_train_step_pp_dp_amp_o2_fused_adam():
+    """End-to-end: 1F1B pipeline x data parallel x amp O2 (bf16 blocks,
+    fp32 masters, dynamic loss scale) x FusedAdam, one optimizer step —
+    must track the single-device fp32 reference step within bf16
+    tolerance, and skip cleanly on an injected overflow."""
+    from apex_tpu import amp, optimizers
+    from apex_tpu.parallel import distributed as dist
+
+    S, D = 4, 2
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(S, D),
+                ("pp", "data"))
+    block = Block(8)
+    model, opt = amp.initialize(block, optimizers.FusedAdam(lr=1e-2),
+                                opt_level="O2", verbosity=0,
+                                hard_override=True)
+    stacked = pp.init_stacked(model, jax.random.PRNGKey(8), S)
+    specs = pp.stacked_specs(stacked)
+    opt_state = opt.init(stacked)
+    rng = np.random.RandomState(8)
+    x = np.asarray(rng.randn(6, 4, 8), np.float32)       # (M, B, F)
+    tgt = np.asarray(rng.randn(6, 4, 8), np.float32)
+
+    def blk(p, xb):
+        # AmpModel returns (out, state); the pipeline block contract is
+        # plain y = block(p, x)
+        return model(p, xb)[0]
+
+    def grads_fn(p, xb, tb, scale):
+        def scaled_loss(y, t):
+            return _mse(y.astype(jnp.float32), t) * scale
+        loss, g = pp.pipeline_1f1b_grads(blk, scaled_loss, p, xb, tb)
+        # DDP half: mean the stage-sharded grads over the data axis,
+        # and the per-shard losses for a replicated log value
+        g = jax.tree_util.tree_map(
+            lambda l: lax.pmean(l, "data"), g)
+        return lax.pmean(loss, "data") / scale, g
+
+    @jax.jit
+    def train_step(p, os_, xb, tb):
+        scale = os_.scalers[0].loss_scale
+        loss, g = jax.shard_map(
+            lambda pp_, xx, tt: grads_fn(pp_, xx, tt, scale),
+            mesh=mesh, in_specs=(specs, P(None, "data"), P(None, "data")),
+            out_specs=(P(), specs), check_vma=False)(p, xb, tb)
+        p2, os2, info = opt.step(p, os_, g)
+        return p2, os2, loss, info
+
+    # fp32 reference: same init, plain Adam math on the fp32 masters
+    stacked32 = jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32), stacked)
+    ref_loss, ref_g = _ref_loss_grads(block, stacked32, jnp.asarray(x),
+                                      jnp.asarray(tgt))
+
+    p1, os1, loss1, info1 = train_step(stacked, opt_state,
+                                       jnp.asarray(x), jnp.asarray(tgt))
+    assert float(info1["found_inf"]) == 0.0
+    np.testing.assert_allclose(float(loss1), float(ref_loss),
+                               rtol=5e-2)
+    # grads the optimizer consumed match the fp32 reference: check via
+    # the master-weight delta direction (Adam's first step is
+    # -lr * sign-ish update; compare updated bf16 params against a
+    # reference FusedAdam step on the fp32 tree)
+    ref_opt = optimizers.FusedAdam(lr=1e-2)
+    ref_state = ref_opt.init(stacked32)
+    p_ref, _ = ref_opt.step(stacked32, ref_state, ref_g)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=2e-2)
+    # injected overflow: params must not move, scale must halve
+    bad = jnp.asarray(x).at[0, 0, 0].set(jnp.inf)
+    p2, os2, _, info2 = train_step(p1, os1, bad, jnp.asarray(tgt))
+    assert float(info2["found_inf"]) > 0
+    for a, b in zip(jax.tree_util.tree_leaves(p2),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # bf16 O2 runs at loss_scale 1.0 (already min-capped), so the
+    # observable skip evidence is the counter, not a halved scale
+    assert int(os2.scalers[0].steps_skipped) == 1
+    assert int(os1.scalers[0].steps_skipped) == 0
+
+
+def test_bubble_fraction_model():
+    # GPipe and lockstep-1F1B share the bubble; the memory bound is the
+    # difference (documented in bubble_fraction)
+    assert pp.bubble_fraction(4, 12, "gpipe") == pytest.approx(3 / 15)
+    assert pp.bubble_fraction(4, 12, "1f1b") == pytest.approx(6 / 18)
+    assert pp.bubble_fraction(1, 8, "1f1b") == 0.0
+    with pytest.raises(ValueError):
+        pp.bubble_fraction(4, 12, "zb-h1")
