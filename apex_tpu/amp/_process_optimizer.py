@@ -26,12 +26,14 @@ from ..optimizers.base import Optimizer
 def _axis_in_scope(name: str) -> bool:
     """True iff ``name`` is a currently-mapped collective axis — local
     copy of parallel.sync_batchnorm._axis_in_scope (imported inline
-    would pull the parallel package into amp's import graph); the
-    private-API dependency is pinned by
-    tests/test_syncbn.py::test_axis_introspection_private_api_still_works."""
+    would pull the parallel package into amp's import graph).  Public
+    probe: ``lax.axis_index`` raises NameError for an unbound axis;
+    pinned by tests/test_syncbn.py::test_axis_scope_probe."""
     try:
-        from jax._src import core as _core
-        return name in _core.unsafe_get_axis_names()
+        jax.lax.axis_index(name)
+        return True
+    except NameError:
+        return False
     except Exception:
         return True
 

@@ -31,13 +31,20 @@ from ..nn.layers import BatchNorm2d
 
 
 def _axis_in_scope(name: str) -> bool:
-    """True iff ``name`` is a currently-mapped collective axis.  Uses the
-    axis-env introspection jax exposes; if that ever disappears, default
-    to True so a genuinely unmapped axis fails loudly in psum rather
-    than silently skipping stat sync."""
+    """True iff ``name`` is a currently-mapped collective axis.
+
+    Probes via the PUBLIC API: ``lax.axis_index(name)`` raises
+    ``NameError`` at trace time when the axis is unbound and emits a
+    (dead-code-eliminated) index op when it is — no ``jax._src``
+    introspection (the r4 verdict's top drift risk).  Any error other
+    than the documented NameError defaults to True, so a genuinely
+    unmapped axis fails loudly in the subsequent psum rather than
+    silently skipping stat sync."""
     try:
-        from jax._src import core as _core
-        return name in _core.unsafe_get_axis_names()
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
     except Exception:
         return True
 

@@ -1,0 +1,57 @@
+#!/bin/bash
+# jax version-drift matrix — the TPU-native analogue of the reference's
+# docker_extension_builds (reference tests/docker_extension_builds/run.sh
+# builds its CUDA extensions across 7 torch/cuda images to catch API
+# drift before users do).
+#
+# apex_tpu's drift surface is the jax API instead of the torch C++ ABI.
+# Since r5 the package uses NO jax._src private symbols (grep gate
+# below); the remaining drift risks are behavioral contracts pinned by
+# tests:
+#   * lax.axis_index's NameError-on-unbound-axis contract
+#     (tests/test_syncbn.py::test_axis_scope_probe) — beneath SyncBN,
+#     TP/PP/EP guards, and the ZeRO path;
+#   * jax.closure_convert residual extraction order
+#     (parallel/pipeline.py 1F1B stash);
+#   * shard_map/check_vma, Pallas, and optimizer-state pytree layouts.
+#
+# Usage:  tests/ci/version_matrix.sh [jax==X.Y.Z ...]
+#   with no args: the pinned version (sanity) + the latest release.
+#   Requires network access for pip; in the air-gapped build image this
+#   script is documentation + the grep gate only (run with NO_PIP=1).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+echo "== private-API gate (runs everywhere, no network needed) =="
+if grep -rn --include='*.py' -E 'from jax\._src|jax\._src\.[a-z]' \
+        apex_tpu/ | grep -v '``jax\._src``'; then
+    echo "FAIL: jax._src private-API use found in apex_tpu/" >&2
+    exit 1
+fi
+echo "ok: no jax._src use in apex_tpu/"
+
+PINNED=$(python -c "import jax; print(jax.__version__)")
+echo "== pinned jax: $PINNED =="
+
+if [ "${NO_PIP:-0}" = "1" ]; then
+    echo "NO_PIP=1: running the suite on the pinned version only"
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -x
+    exit 0
+fi
+
+VERSIONS=("$@")
+[ ${#VERSIONS[@]} -eq 0 ] && VERSIONS=("jax==$PINNED" "jax")
+
+for spec in "${VERSIONS[@]}"; do
+    name=$(echo "$spec" | tr '=<>~' '_')
+    venv=".ci_venv_$name"
+    echo "== matrix leg: $spec =="
+    python -m venv --system-site-packages "$venv"
+    # --ignore-installed so the venv's jax/jaxlib shadow the system pin
+    "$venv/bin/pip" install -q --ignore-installed "$spec" jaxlib
+    "$venv/bin/python" -c "import jax; print('  jax', jax.__version__)"
+    JAX_PLATFORMS=cpu "$venv/bin/python" -m pytest tests/ -q -x \
+        || { echo "FAIL on $spec" >&2; exit 1; }
+    rm -rf "$venv"
+done
+echo "== version matrix green =="

@@ -168,27 +168,25 @@ def test_syncbn_channel_last():
                                atol=1e-5)
 
 
-def test_axis_introspection_private_api_still_works(mesh):
-    """Pin the jax._src.core.unsafe_get_axis_names dependency (VERDICT r3
-    weak-5): _axis_in_scope must report False outside any mapped context
-    and True inside shard_map.  If a jax upgrade removes the symbol,
-    _axis_in_scope degrades to always-True (fail-loud-in-psum), which
-    makes the outside-check below fail — loudly, here, instead of
-    silently changing SyncBN behavior."""
+def test_axis_scope_probe(mesh):
+    """_axis_in_scope (both copies — parallel and amp) must report False
+    outside any mapped context and True inside shard_map.  Since r5 the
+    probe is the PUBLIC ``lax.axis_index`` NameError contract (no
+    ``jax._src`` introspection); if a jax upgrade changes that error
+    contract, _axis_in_scope degrades to always-True
+    (fail-loud-in-psum), which makes the outside-check below fail —
+    loudly, here, instead of silently changing SyncBN behavior."""
     from apex_tpu.parallel.sync_batchnorm import _axis_in_scope
+    from apex_tpu.amp._process_optimizer import (
+        _axis_in_scope as _amp_axis_in_scope)
 
-    # the introspection entry point itself must still exist
-    from jax._src import core as _core
-    assert hasattr(_core, "unsafe_get_axis_names"), (
-        "jax._src.core.unsafe_get_axis_names vanished — update "
-        "_axis_in_scope (apex_tpu/parallel/sync_batchnorm.py)")
-
-    assert not _axis_in_scope("data")   # no mapped axis at top level
+    for probe in (_axis_in_scope, _amp_axis_in_scope):
+        assert not probe("data")        # no mapped axis at top level
 
     def fn(x):
-        inside = _axis_in_scope("data")     # traced: python-level check
-        assert inside, "axis 'data' not visible inside shard_map"
-        assert not _axis_in_scope("nonexistent_axis")
+        for probe in (_axis_in_scope, _amp_axis_in_scope):
+            assert probe("data"), "axis 'data' not visible in shard_map"
+            assert not probe("nonexistent_axis")
         return x
 
     _shard_run(mesh, fn, jnp.ones((8,)), in_specs=(P("data"),),
