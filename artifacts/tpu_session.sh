@@ -96,6 +96,16 @@ stat $RC
 [ $RC -eq 0 ] && done_mark step_probe
 fi
 
+alive xprof
+if ! skip xprof; then
+log "xprof trace of the headline step (VERDICT r4 item 7)"
+timeout 1800 python artifacts/xprof_probe.py 2>&1 | grep -v WARNING \
+    | tee "artifacts/xprof_probe_$TS.log"
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark xprof
+fi
+
 alive donation_probe
 if ! skip donation_probe; then
 log "buffer-donation probe (in-place state update vs the tunnel caveat)"
