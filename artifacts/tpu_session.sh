@@ -73,6 +73,19 @@ stat $RC
 [ $RC -eq 0 ] && done_mark kernels
 fi
 
+alive kernel_bench_shapes
+if ! skip kernel_bench_shapes; then
+log "Pallas-vs-jnp parity + timing at bench-scale shapes (VERDICT r4 item 8)"
+# budget: 12 workers x 900s worker-timeout (10800s worst case) plus
+# startup and npz-compare margin; the stage timeout must not undercut
+# the probe's own per-family isolation
+timeout 12600 python artifacts/kernel_bench_parity.py 2>&1 \
+    | grep -v WARNING | tee "artifacts/kernel_bench_parity_$TS.log"
+RC=$?
+stat $RC
+[ $RC -eq 0 ] && done_mark kernel_bench_shapes
+fi
+
 alive serving
 if ! skip serving; then
 log "serving/decode surface on chip (families, chunked prefill, engine, speculative)"

@@ -98,7 +98,16 @@ def main():
     profiler.stop_profile()
 
     n_files = sum(len(fs) for _, _, fs in os.walk(logdir))
-    print(f"trace captured: {logdir} ({n_files} files, {ITERS} steps)")
+    # compress to a single artifact: the session runbook auto-commits
+    # artifacts/, and a raw xplane.pb tree would bloat every commit
+    import shutil
+    tar = shutil.make_archive(logdir, "gztar",
+                              root_dir=os.path.dirname(logdir),
+                              base_dir=os.path.basename(logdir))
+    shutil.rmtree(logdir)
+    sz = os.path.getsize(tar) / 1e6
+    print(f"trace captured: {tar} ({n_files} files, {ITERS} steps, "
+          f"{sz:.1f} MB compressed)")
 
 
 if __name__ == "__main__":
