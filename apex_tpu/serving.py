@@ -17,7 +17,12 @@ Design (deliberately simple — correctness over paging):
   per-slot positions (models/llama.py decode_chunk contract) + greedy
   head; inactive slots decode garbage that is masked out host-side;
 - a request finishes on ``eos_token_id`` or its ``max_new_tokens``;
-  the slot frees immediately and can be reclaimed next ``add_request``.
+  the slot frees immediately and can be reclaimed next ``add_request``;
+- optional PREFIX SHARING (``prefix_pool``): registered prompt
+  prefixes are prefilled once into pool rows; matching requests admit
+  by a static KV row-copy + suffix-only chunked prefill (see
+  ``Engine.__init__``) — the static-shape answer to vLLM's prefix
+  cache.
 
 Exactness (greedy and speculative-greedy paths): a request's output is
 token-for-token what ``generate_cached`` would produce for it alone —
@@ -66,14 +71,31 @@ class Engine:
     def __init__(self, model, params, slots: int, buf_len: int,
                  cache_dtype=None, draft=None, draft_params=None,
                  gamma: int = 4, temperature: float = 0.0,
-                 top_k=None, top_p=None, rng=None):
+                 top_k=None, top_p=None, rng=None,
+                 prefix_pool: int = 0, prefix_chunk: int = 32):
         """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
         decoding: one ``spec_iteration`` (models/speculative.py) per
         tick, so every live request advances 1..gamma+1 tokens per
         step while staying token-for-token equal to its solo greedy
         decode.  ``temperature > 0`` samples instead (plain path only;
         combine with a draft for speculative SAMPLING semantics at the
-        generate_speculative level)."""
+        generate_speculative level).
+
+        ``prefix_pool > 0`` enables PREFIX SHARING (the TPU-native
+        answer to vLLM's prefix cache, minus paging — XLA wants static
+        shapes, so reuse is row-granular, not block-granular):
+        ``register_prefix(tokens)`` prefills a dedicated pool row once;
+        any later request whose prompt starts with a registered prefix
+        admits by gathering that pool row's KV, running only the
+        SUFFIX through ``decode_chunk`` in ``prefix_chunk``-wide
+        chunks against the (1, ...) row cache, and scattering the row
+        into its slot — skipping the full-buffer prefill forward
+        entirely.  Causality makes the
+        spliced KV bit-identical to a fresh prefill (positions < L
+        never see the suffix), so the solo-decode exactness contract is
+        unchanged (pinned in tests/test_serving.py).  The chunk fn
+        compiles once; chunks that would run past ``buf_len`` slide
+        back and idempotently recompute the overlap."""
         self.model = model
         self.params = params
         self.slots = slots
@@ -139,6 +161,56 @@ class Engine:
 
         self._prefill_slot = jax.jit(_prefill_slot)
 
+        # -- prefix-sharing pool ------------------------------------------
+        if prefix_chunk < 1:
+            raise ValueError(f"prefix_chunk must be >= 1, got "
+                             f"{prefix_chunk}")
+        self.prefix_pool = prefix_pool
+        self.prefix_chunk = min(prefix_chunk, buf_len)
+        self.prefix_hits = 0
+        self._prefixes: List[tuple] = []
+        if prefix_pool > 0:
+            self._pool_cache = model.init_cache(prefix_pool,
+                                                dtype=cache_dtype)
+            self._pool_d_cache = (draft.init_cache(prefix_pool,
+                                                   dtype=cache_dtype)
+                                  if draft is not None else None)
+
+            def _seed_pool(pool_cache, d_pool, idx, row):
+                pool_cache = _seed(model, params, pool_cache, idx, row)
+                if draft is not None:
+                    d_pool = _seed(draft, draft_params, d_pool, idx,
+                                   row)
+                return pool_cache, d_pool
+
+            self._seed_pool = jax.jit(_seed_pool)
+
+            # splice = one row gather from the pool, K suffix chunks on
+            # the (1, ...) ROW cache (not the whole multi-slot tree —
+            # no full-cache round trip per chunk), one scatter into the
+            # slot.  Shared by target and draft caches.
+            def _take_row(cache, idx):
+                return jax.tree_util.tree_map(
+                    lambda b: lax.dynamic_index_in_dim(
+                        b, idx, 0, keepdims=True), cache)
+
+            def _put_row(cache, rc, slot):
+                return jax.tree_util.tree_map(
+                    lambda b, r: lax.dynamic_update_index_in_dim(
+                        b, r[0].astype(b.dtype), slot, axis=0),
+                    cache, rc)
+
+            self._take_row = jax.jit(_take_row)
+            self._put_row = jax.jit(_put_row)
+            self._chunk_row = {
+                "cache": jax.jit(lambda rc, t, o: model.decode_chunk(
+                    params, t, jnp.full((1,), o, jnp.int32), rc)[1])}
+            if draft is not None:
+                self._chunk_row["d_cache"] = jax.jit(
+                    lambda rc, t, o: draft.decode_chunk(
+                        draft_params, t, jnp.full((1,), o, jnp.int32),
+                        rc)[1])
+
         if draft is not None:
             from .models.speculative import spec_iteration
 
@@ -175,12 +247,69 @@ class Engine:
         self._step = jax.jit(_step)
 
     # -- request lifecycle -------------------------------------------------
+    def register_prefix(self, tokens: Sequence[int]) -> int:
+        """Prefill ``tokens`` into a prefix-pool row once; later
+        prompts starting with them admit via KV splice + suffix-only
+        prefill.  Returns the pool index.  Requires ``prefix_pool``
+        capacity at construction."""
+        if self.prefix_pool == 0:
+            raise RuntimeError("Engine built with prefix_pool=0")
+        if len(self._prefixes) >= self.prefix_pool:
+            raise RuntimeError(f"prefix pool full "
+                               f"({self.prefix_pool} rows)")
+        self._check_prompt(tokens)
+        idx = len(self._prefixes)
+        row = np.zeros((self.buf_len,), np.int32)
+        row[:len(tokens)] = tokens
+        self._pool_cache, self._pool_d_cache = self._seed_pool(
+            self._pool_cache, self._pool_d_cache, idx,
+            jnp.asarray(row))
+        self._prefixes.append(tuple(int(t) for t in tokens))
+        return idx
+
+    def _match_prefix(self, prompt):
+        """(pool_idx, L) of the longest registered prefix the prompt
+        starts with, or (None, 0)."""
+        best, best_len = None, 0
+        pt = tuple(int(t) for t in prompt)
+        for i, pref in enumerate(self._prefixes):
+            if len(pref) > best_len and len(pref) <= len(pt) \
+                    and pt[:len(pref)] == pref:
+                best, best_len = i, len(pref)
+        return best, best_len
+
     def _admit(self, rid, prompt, max_new_tokens, eos_token_id):
         slot = self._free.pop()
         row = np.zeros((self.buf_len,), np.int32)
         row[:len(prompt)] = prompt
-        self.ids, self.cache, self.d_cache = self._prefill_slot(
-            self.ids, self.cache, self.d_cache, slot, jnp.asarray(row))
+        pidx, L = (self._match_prefix(prompt) if self._prefixes
+                   else (None, 0))
+        if pidx is not None:
+            # splice: gather the pool row, run only the suffix
+            # [L, prompt_len) through decode_chunk on that row, scatter
+            # it into the slot
+            self.prefix_hits += 1
+            C = self.prefix_chunk
+            for attr, chunk_fn in self._chunk_row.items():
+                pool = (self._pool_cache if attr == "cache"
+                        else self._pool_d_cache)
+                rc = self._take_row(pool, pidx)
+                off = L
+                while off < len(prompt):
+                    # slide the last chunk back instead of shrinking
+                    # it: one compiled width, overlap recompute is
+                    # idempotent
+                    start = min(off, self.buf_len - C)
+                    toks = jnp.asarray(row[None, start:start + C])
+                    rc = chunk_fn(rc, toks, start)
+                    off = start + C
+                setattr(self, attr,
+                        self._put_row(getattr(self, attr), rc, slot))
+            self.ids = self.ids.at[slot].set(jnp.asarray(row))
+        else:
+            self.ids, self.cache, self.d_cache = self._prefill_slot(
+                self.ids, self.cache, self.d_cache, slot,
+                jnp.asarray(row))
         self.cur_len = self.cur_len.at[slot].set(len(prompt))
         self.limit = self.limit.at[slot].set(
             min(len(prompt) + max_new_tokens, self.buf_len))

@@ -203,6 +203,84 @@ def test_submit_queue_and_sampled_engine():
                        temperature=0.5, draft=m, draft_params=params)
 
 
+def test_prefix_sharing_matches_solo_decoding():
+    """Prefix pool: requests sharing a registered prefix admit via KV
+    splice + suffix-only prefill and must still be token-for-token
+    equal to their solo decode; non-matching prompts take the full
+    prefill path untouched."""
+    m, params = _gpt(31)
+    rng = np.random.RandomState(31)
+    sys_prefix = list(rng.randint(0, 64, 7))
+    eng = serving.Engine(m, params, slots=3, buf_len=24, prefix_pool=2,
+                         prefix_chunk=4)
+    eng.register_prefix(sys_prefix)
+
+    prompts = [sys_prefix + list(rng.randint(0, 64, k))
+               for k in (1, 3, 6)]            # shared prefix, suffixes
+    prompts.append(list(rng.randint(0, 64, 5)))   # no match
+    prompts.append(list(sys_prefix))              # exact-match prompt
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    while eng.live() or eng._waiting:
+        eng.step()
+    for rid, p in zip(rids, prompts):
+        assert eng.result(rid) == _solo(m, params, p, 6), p
+    assert eng.prefix_hits == 4               # all but the non-match
+
+    # slot reuse after a spliced request stays clean (stale pool KV
+    # beyond prompt_len must never leak into a later occupant)
+    extra = list(rng.randint(0, 64, 9))
+    r2 = eng.submit(extra, max_new_tokens=5)
+    while eng.live():
+        eng.step()
+    assert eng.result(r2) == _solo(m, params, extra, 5)
+
+
+def test_prefix_sharing_with_speculative_engine():
+    """The splice covers BOTH caches (target + draft): a speculative
+    engine with a registered prefix must stay exactly solo-greedy."""
+    m, params = _gpt(33)
+    draft, dparams = _gpt(34)
+    eng = serving.Engine(m, params, slots=2, buf_len=24, draft=draft,
+                         draft_params=dparams, gamma=3, prefix_pool=1,
+                         prefix_chunk=4)
+    rng = np.random.RandomState(33)
+    pref = list(rng.randint(0, 64, 6))
+    eng.register_prefix(pref)
+    pa = pref + list(rng.randint(0, 64, 3))
+    pb = pref + list(rng.randint(0, 64, 1))
+    ra = eng.submit(pa, max_new_tokens=7)
+    rb = eng.submit(pb, max_new_tokens=5)
+    steps = 0
+    while eng.live():
+        eng.step()
+        steps += 1
+        assert steps < 40
+    assert eng.prefix_hits == 2
+    assert eng.result(ra) == _solo(m, params, pa, 7)
+    assert eng.result(rb) == _solo(m, params, pb, 5)
+
+
+def test_prefix_pool_validation_and_longest_match():
+    m, params = _gpt(32)
+    eng = serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=1)
+    with pytest.raises(RuntimeError, match="prefix_pool=0"):
+        serving.Engine(m, params, slots=1, buf_len=24).register_prefix(
+            [1, 2])
+    eng.register_prefix([5, 6, 7])
+    with pytest.raises(RuntimeError, match="pool full"):
+        eng.register_prefix([1])
+    with pytest.raises(ValueError, match="prefix_chunk"):
+        serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=1,
+                       prefix_chunk=0)
+    # longest-match selection among registered prefixes
+    e2 = serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=2)
+    e2.register_prefix([5, 6])
+    e2.register_prefix([5, 6, 7, 8])
+    assert e2._match_prefix([5, 6, 7, 8, 9]) == (1, 4)
+    assert e2._match_prefix([5, 6, 9]) == (0, 2)
+    assert e2._match_prefix([9, 5, 6]) == (None, 0)
+
+
 def test_queue_stress_arrivals_exceed_slots_fifo_fair():
     """VERDICT r4 item 6: arrivals >> slots.  20 requests of mixed
     lengths through 3 slots — every result must still equal its solo
