@@ -181,14 +181,19 @@ class BertModel(nn.Module):
         if cfg.tp_axis is not None:
             from ..parallel.tensor_parallel import VocabParallelEmbedding
             self.word_embeddings = VocabParallelEmbedding(
-                cfg.vocab_size, cfg.hidden_size, axis_name=cfg.tp_axis)
+                cfg.vocab_size, cfg.hidden_size, axis_name=cfg.tp_axis,
+                init_std=0.02)
         else:
+            # BERT's initializer_range=0.02
             self.word_embeddings = nn.Embedding(cfg.vocab_size,
-                                                cfg.hidden_size)
+                                                cfg.hidden_size,
+                                                init_std=0.02)
         self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
-                                                cfg.hidden_size)
+                                                cfg.hidden_size,
+                                                init_std=0.02)
         self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
-                                                  cfg.hidden_size)
+                                                  cfg.hidden_size,
+                                                  init_std=0.02)
         self.embeddings_ln = FusedLayerNorm(cfg.hidden_size,
                                             eps=cfg.layer_norm_eps)
         self.layer = nn.ModuleList([BertLayer(cfg)

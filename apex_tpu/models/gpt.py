@@ -340,10 +340,15 @@ class GPT(nn.Module):
         if cfg.tp_axis is not None:
             from ..parallel.tensor_parallel import VocabParallelEmbedding
             self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.n_embd,
-                                              axis_name=cfg.tp_axis)
+                                              axis_name=cfg.tp_axis,
+                                              init_std=0.02)
         else:
-            self.wte = nn.Embedding(cfg.vocab_size, cfg.n_embd)
-        self.wpe = nn.Embedding(cfg.block_size, cfg.n_embd)
+            # GPT-2's initializer_range (the tied head would otherwise
+            # start with ~9x-hot logits and ~40-nat loss)
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.n_embd,
+                                    init_std=0.02)
+        self.wpe = nn.Embedding(cfg.block_size, cfg.n_embd,
+                                init_std=0.02)
         self.h = nn.ModuleList([GPTBlock(cfg) for _ in range(cfg.n_layer)])
         self.ln_f = FusedLayerNorm(cfg.n_embd, eps=cfg.layer_norm_eps)
         self.drop = nn.Dropout(cfg.dropout)

@@ -548,7 +548,11 @@ class Llama(nn.Module):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
-        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        # Llama's initializer_range=0.02 (scratch-training sanity with
+        # tied heads; HF-loaded checkpoints overwrite it anyway)
+        self.embed_tokens = nn.Embedding(cfg.vocab_size,
+                                         cfg.hidden_size,
+                                         init_std=0.02)
         self.layers = nn.ModuleList(
             [self.block_cls(cfg) for _ in range(cfg.num_hidden_layers)])
         self.norm = _make_norm(cfg)

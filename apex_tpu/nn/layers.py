@@ -232,13 +232,20 @@ class LayerNorm(Module):
 
 
 class Embedding(Module):
-    def __init__(self, num_embeddings: int, embedding_dim: int):
+    """``init_std`` defaults to torch's nn.Embedding N(0, 1); the
+    transformer families pass their conventional 0.02
+    (initializer_range) so scratch training starts at ~uniform loss
+    instead of the ~9x-hot logits a unit-variance tied head produces."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 init_std: float = 1.0):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
+        self.init_std = init_std
 
     def create_params(self, key):
-        return {"weight": jax.random.normal(
+        return {"weight": self.init_std * jax.random.normal(
             key, (self.num_embeddings, self.embedding_dim), jnp.float32)}
 
     def forward(self, params, ids):

@@ -380,14 +380,15 @@ class VocabParallelEmbedding(Module):
     """
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
-                 axis_name: str = DEFAULT_AXIS):
+                 axis_name: str = DEFAULT_AXIS, init_std: float = 1.0):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.axis_name = axis_name
+        self.init_std = init_std
 
     def create_params(self, key):
-        return {"weight": jax.random.normal(
+        return {"weight": self.init_std * jax.random.normal(
             key, (self.num_embeddings, self.embedding_dim), jnp.float32)}
 
     def param_specs(self) -> Dict[str, P]:

@@ -154,6 +154,11 @@ def test_generate_cached_repetition_penalty_matches_manual():
                                     n_layer=2, n_head=4, n_embd=32,
                                     dropout=0.0))
     params, _ = m.init(jax.random.PRNGKey(0))
+    # the realistic 0.02 embedding init leaves scratch logits so flat
+    # the /1.7 penalty can't dethrone an argmax; restore unit variance
+    # so the "penalty changes the output" half stays meaningful
+    params["wte"] = {"weight": params["wte"]["weight"] / 0.02}
+    params["wpe"] = {"weight": params["wpe"]["weight"] / 0.02}
     prompt = np.random.RandomState(6).randint(0, 32, (1, 4))
     buf = jnp.zeros((1, 16), jnp.int32).at[:, :4].set(jnp.asarray(prompt))
     out, n = m.generate_cached(params, buf, 4, 8,
