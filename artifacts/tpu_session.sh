@@ -152,6 +152,27 @@ done
 [ $CONV_OK -eq 1 ] && done_mark convergence
 fi
 
+alive lm_convergence
+if ! skip lm_convergence; then
+log "char-LM convergence gate on real text (python stdlib corpus, O0 vs O2)"
+# 4MB of real code text, 12L/768 GPT, 2000 iters: the gate (2.5
+# nats/char, uniform = ~4.6) demands genuinely learned structure well
+# past the digits toy scale; O0-vs-O2 parity is read off the two logs
+LM_OK=1
+for OL in O0 O2; do
+    timeout 3000 python examples/gpt/main_amp.py --config small \
+        --block-size 256 -b 16 --iters 2000 --lr 3e-4 \
+        --stdlib-corpus 4 --val-frac 0.05 --eval-freq 500 \
+        --print-freq 200 --opt-level $OL --target-val-loss 2.5 2>&1 \
+        | grep -E "corpus|compiled|iter \[|FINAL|gate|seq/s" \
+        | tee "artifacts/lm_convergence_${OL}_$TS.log"
+    RC=$?
+    stat $RC
+    [ $RC -ne 0 ] && LM_OK=0
+done
+[ $LM_OK -eq 1 ] && done_mark lm_convergence
+fi
+
 alive layout_probe
 if ! skip layout_probe; then
 log "layout probe (CSE-fixed)"

@@ -29,6 +29,36 @@ if os.path.isdir(os.path.join(_repo, "apex_tpu")) and _repo not in sys.path:
 _BUILTIN_TEXT = ("the quick brown fox jumps over the lazy dog. " * 200)
 
 
+def _stdlib_corpus(mb: float) -> str:
+    """A real multi-megabyte text corpus with zero downloads: the
+    Python standard library's own sources, concatenated in sorted
+    (deterministic) file order and ASCII-filtered, truncated to ``mb``
+    megabytes.  Real code text has genuine structure (syntax,
+    identifiers, indentation) a char LM must learn — a substantive
+    step past toy pangrams for the convergence gate when the machine
+    has no datasets."""
+    import glob
+    import sysconfig
+    root = sysconfig.get_paths()["stdlib"]
+    parts, total, limit = [], 0, int(mb * 1e6)
+    for path in sorted(glob.glob(os.path.join(root, "*.py"))):
+        try:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                t = f.read()
+        except OSError:
+            continue
+        t = "".join(c for c in t if c == "\n" or 32 <= ord(c) < 127)
+        parts.append(t)
+        total += len(t)
+        if total >= limit:
+            break
+    text = "".join(parts)[:limit]
+    if len(text) < limit:
+        print(f"=> stdlib corpus smaller than requested: "
+              f"{len(text) / 1e6:.1f} MB")
+    return text
+
+
 def parse_args():
     p = argparse.ArgumentParser(description="apex_tpu GPT training")
     p.add_argument("--arch", default="gpt", choices=["gpt", "llama"],
@@ -50,6 +80,24 @@ def parse_args():
     p.add_argument("--text", default=None,
                    help="path to a UTF-8 text corpus (char-level); "
                         "built-in pangram corpus if unset")
+    p.add_argument("--stdlib-corpus", type=float, default=None,
+                   metavar="MB",
+                   help="build a real-text corpus from the Python "
+                        "stdlib sources on this machine (deterministic "
+                        "sorted file order, ASCII-filtered), truncated "
+                        "to MB megabytes — a no-download real dataset "
+                        "for the convergence gate")
+    p.add_argument("--val-frac", type=float, default=0.0,
+                   help="hold out this trailing fraction of the corpus "
+                        "for validation (contiguous tail, no leakage)")
+    p.add_argument("--val-batches", type=int, default=8,
+                   help="fixed deterministic val batches per eval")
+    p.add_argument("--eval-freq", type=int, default=0,
+                   help="evaluate val loss every N iters (0: only at "
+                        "the end)")
+    p.add_argument("--target-val-loss", type=float, default=None,
+                   help="convergence gate: exit 1 if the final val "
+                        "loss (nats/char) is above this")
     p.add_argument("--generate", type=int, default=0,
                    help="after training, KV-cached-generate N tokens "
                         "from a corpus prompt")
@@ -72,13 +120,21 @@ def main():
     from apex_tpu.nn import functional as F  # noqa: F401 (parity import)
 
     ndev = len(jax.devices())
-    text = (open(args.text, encoding="utf-8").read() if args.text
-            else _BUILTIN_TEXT)
+    if args.stdlib_corpus:
+        text = _stdlib_corpus(args.stdlib_corpus)
+    elif args.text:
+        text = open(args.text, encoding="utf-8").read()
+    else:
+        text = _BUILTIN_TEXT
     vocab = sorted(set(text))
     stoi = {c: i for i, c in enumerate(vocab)}
     data = np.asarray([stoi[c] for c in text], np.int32)
-    print(f"=> corpus: {len(data)} chars, vocab {len(vocab)}; "
-          f"{ndev} device(s) on {jax.default_backend()}")
+    n_val = int(len(data) * args.val_frac)
+    val_data = data[len(data) - n_val:] if n_val else None
+    data = data[:len(data) - n_val]
+    print(f"=> corpus: {len(data)} train / {n_val} val chars, "
+          f"vocab {len(vocab)}; {ndev} device(s) on "
+          f"{jax.default_backend()}")
 
     shapes = {"tiny": dict(n_layer=2, n_head=4, n_embd=64, block_size=64),
               "small": dict(n_layer=12, n_head=12, n_embd=768,
@@ -88,6 +144,13 @@ def main():
     if args.block_size:
         shapes["block_size"] = args.block_size
     T = shapes["block_size"]
+    if val_data is not None and len(val_data) <= T:
+        # mirrors the imagenet example's refuse-undersized-val-split
+        # startup guard: run_eval needs at least one full block
+        raise SystemExit(
+            f"--val-frac {args.val_frac} holds out only "
+            f"{len(val_data)} chars but the block size is {T}; raise "
+            f"--val-frac or use a bigger corpus")
     if args.arch == "llama":
         cfg = models.LlamaConfig(
             vocab_size=max(len(vocab), 2),
@@ -135,6 +198,26 @@ def main():
         step, mesh=mesh, in_specs=(P(), (P("data"),)),
         out_specs=(P(), P()), check_vma=False))
 
+    eval_loss = jax.jit(jax.shard_map(
+        lambda p, ids: lax.pmean(model.loss(p, ids), "data"),
+        mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False))
+
+    def run_eval(p):
+        """Mean loss over a fixed, deterministic set of val batches
+        (sequential non-overlapping windows from the held-out tail)."""
+        stride = max(1, (len(val_data) - T - 1) // max(
+            1, args.val_batches * B))
+        starts = [(i * stride) % (len(val_data) - T)
+                  for i in range(args.val_batches * B)]
+        tot = 0.0
+        for k in range(args.val_batches):
+            ix = starts[k * B:(k + 1) * B]
+            ids = jnp.asarray(np.stack([val_data[i:i + T]
+                                        for i in ix]))
+            tot += float(eval_loss(p, ids))
+        return tot / args.val_batches
+
     state = (params, opt_state)
     print("=> compiling train step...")
     t0 = time.time()
@@ -154,11 +237,31 @@ def main():
             print(f"iter [{i}/{args.iters}]  Time {bt.val:.3f} "
                   f"({bt.avg:.3f})  Speed {B / bt.val:.1f} seq/s  "
                   f"Loss {losses.val:.4f} ({losses.avg:.4f})")
+        if (val_data is not None and args.eval_freq
+                and i and i % args.eval_freq == 0):
+            print(f"iter [{i}/{args.iters}]  val_loss "
+                  f"{run_eval(state[0]):.4f}")
     if bt.avg > 0:
         print(f"=> done. avg {B / bt.avg:.1f} seq/s "
               f"({B / bt.avg / ndev:.1f} seq/s/device)")
     else:
         print("=> done. (no timed iterations)")
+
+    final_val = None
+    if val_data is not None:
+        final_val = run_eval(state[0])
+        uniform = float(np.log(max(len(vocab), 2)))
+        print(f"FINAL val_loss {final_val:.4f} nats/char "
+              f"(uniform {uniform:.2f})")
+    if args.target_val_loss is not None:
+        if final_val is None:
+            raise SystemExit("--target-val-loss needs --val-frac > 0")
+        ok = final_val <= args.target_val_loss
+        print(f"convergence gate: val_loss {final_val:.4f} "
+              f"{'<=' if ok else '>'} target {args.target_val_loss} "
+              f"-> {'PASS' if ok else 'FAIL'}")
+        if not ok:
+            raise SystemExit(1)
 
     if args.generate:
         params = state[0]

@@ -139,6 +139,21 @@ def test_gpt_example_smoke():
 
 
 @pytest.mark.slow
+def test_gpt_example_stdlib_corpus_val_gate():
+    """Real-text convergence machinery: the stdlib corpus builds, the
+    held-out val loss is computed, and the gate passes at a loose
+    threshold / fails at an absurd one."""
+    base = ["examples/gpt/main_amp.py", "--config", "tiny", "-b", "4",
+            "--iters", "40", "--stdlib-corpus", "0.3", "--val-frac",
+            "0.1", "--print-freq", "20"]
+    r = _run([*base, "--target-val-loss", "4.4"])
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1500:])
+    assert "FINAL val_loss" in r.stdout and "PASS" in r.stdout
+    r = _run([*base, "--iters", "2", "--target-val-loss", "0.01"])
+    assert r.returncode == 1 and "FAIL" in r.stdout
+
+
+@pytest.mark.slow
 def test_imagenet_resume_conv7_into_s2d_stem(tmp_path):
     """Resuming a conv7-trained checkpoint with --stem space_to_depth
     converts the stem weight in-process (models.convert_stem_to_s2d)
