@@ -477,6 +477,53 @@ def main():
                   f"{prompt}, {new_tokens} new/request, slot re-admit "
                   f"on finish")
 
+    def prefix_admit_config(metric, cfg, prompt, prefix_len,
+                            model_cls=None):
+        """Admission latency, full prefill vs prefix-sharing splice:
+        the serving lever for shared system prompts.  Measures mean
+        admit+free time per request both ways on the same engine
+        shapes."""
+        from apex_tpu import serving
+        model = (model_cls or models.GPT)(cfg)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        ctx = getattr(cfg, "block_size", None) \
+            or cfg.max_position_embeddings
+        rng = np.random.RandomState(0)
+        pref = list(rng.randint(0, cfg.vocab_size, prefix_len))
+
+        def run(eng, use_prefix, iters):
+            ts = []
+            for _ in range(iters):
+                p = (pref if use_prefix else list(
+                    rng.randint(0, cfg.vocab_size, prefix_len))) \
+                    + list(rng.randint(0, cfg.vocab_size,
+                                       prompt - prefix_len))
+                t0 = time.perf_counter()
+                rid = eng.add_request(p, max_new_tokens=1)
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(eng.cache)[0])
+                ts.append(time.perf_counter() - t0)
+                eng.step()                  # finish + free the slot
+            return ts
+
+        eng = serving.Engine(model, params, slots=1, buf_len=ctx,
+                             prefix_pool=1)
+        eng.register_prefix(pref)
+        run(eng, False, 3)                  # compile both paths
+        run(eng, True, 3)
+        full = run(eng, False, 10)
+        spliced = run(eng, True, 10)
+        f_ms = float(np.mean(full)) * 1e3
+        s_ms = float(np.mean(spliced)) * 1e3
+        emit(metric=metric, value=round(f_ms / s_ms, 2),
+             unit="admit_speedup_x", vs_baseline=None,
+             note=f"prefix-sharing splice: admit {s_ms:.1f} ms vs full "
+                  f"prefill {f_ms:.1f} ms (prompt={prompt}, shared "
+                  f"prefix={prefix_len}, buf={ctx})")
+
     def allreduce_bw():
         n = 25_000_000 if on_tpu else 1_000_000
         buf = jnp.ones((n,), jnp.float32)
@@ -649,6 +696,13 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 64)),
+            ("gpt2_small_engine_prefix_admit_speedup",
+             lambda: prefix_admit_config(
+                 "gpt2_small_engine_prefix_admit_speedup",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 448, 384)),
             # Mixtral family: top-2 SwiGLU MoE (8 experts) on the Llama
             # backbone — single-chip all experts run locally; the
             # number records MoE dispatch overhead vs the dense path
@@ -722,6 +776,13 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 6)),
+            ("gpt_tiny_engine_prefix_admit_speedup",
+             lambda: prefix_admit_config(
+                 "gpt_tiny_engine_prefix_admit_speedup",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 12, 8)),
             ("mixtral_tiny_o2_train_throughput",
              lambda: gpt_config(
                  "mixtral_tiny_o2_train_throughput",
