@@ -46,6 +46,25 @@ done_mark() {
 }
 skip() { [ -f "artifacts/stage_$1.done" ] && { echo "=== stage '$1' already done; skipping ==="; return 0; }; return 1; }
 
+if ! skip bench_quick; then
+log "QUICK headline capture (survives a revival too brief for the full suite)"
+# one config, ~90s incl. compile: a fresh non-stale headline lands in
+# the record (incremental save) even if the tunnel dies minutes later.
+# __headline__ resolves inside bench.py (no name drift).
+timeout 600 env APEX_BENCH_ONLY=__headline__ \
+    python bench.py 2>> "artifacts/bench_quick_$TS.err" \
+    | tee "artifacts/bench_quick_$TS.json"
+RC=$?
+stat $RC
+# done only on a FRESH measurement: a wedged run emits only the wedge
+# flag + stale-replay lines, which must not retire this stage
+if grep '"value": [0-9]' "artifacts/bench_quick_$TS.json" 2>/dev/null \
+        | grep -v '"stale": true' | grep -qv TPU_TUNNEL_WEDGED; then
+    done_mark bench_quick
+fi
+fi
+
+alive bench
 if ! skip bench; then
 log "full bench (wedge insurance: capture the round's perf record first)"
 # stdout (JSON lines) -> artifact; stderr (fallback warnings, config

@@ -817,6 +817,28 @@ def main():
                                    vs=BASELINE_IMG_PER_SEC_PER_CHIP)),
         ]
 
+    # APEX_BENCH_ONLY=metric1,metric2 filters the job list — the
+    # session runbook's quick stage uses it to land ONE fresh headline
+    # measurement inside a brief tunnel revival (r4's only 2-minute
+    # window died inside the full suite's first big compile).
+    only = os.environ.get("APEX_BENCH_ONLY")
+    if only:
+        # "__headline__" resolves against HEADLINE_METRIC so the
+        # runbook never hardcodes (and can never drift from) the name
+        want = {HEADLINE_METRIC if s.strip() == "__headline__"
+                else s.strip() for s in only.split(",") if s.strip()}
+        jobs = [(n, j) for n, j in jobs if n in want]
+        missing = want - {n for n, _ in jobs}
+        if missing:
+            print(f"bench: APEX_BENCH_ONLY names unknown configs "
+                  f"{sorted(missing)}", file=sys.stderr)
+        if not jobs:
+            # fail loudly: a silently-empty filter would burn the quick
+            # stage's timeout every session while capturing nothing
+            raise SystemExit(
+                f"bench: APEX_BENCH_ONLY={only!r} matched no configs "
+                f"on this backend (on_tpu={on_tpu})")
+
     # Per-config watchdog: the startup probe catches a tunnel that is
     # already wedged, but a wedge DURING a config would otherwise hang
     # the whole harness and the round records nothing.  Each config runs

@@ -260,6 +260,31 @@ def test_prefix_sharing_with_speculative_engine():
     assert eng.result(rb) == _solo(m, params, pb, 5)
 
 
+def test_prefix_sharing_with_int8_kv_cache():
+    """The splice tree_maps over whatever the cache holds — including
+    int8 buffers plus their scale sidecars; parity vs the solo int8
+    decode must hold."""
+    m, params = _gpt(35)
+    rng = np.random.RandomState(35)
+    pref = list(rng.randint(0, 64, 6))
+    eng = serving.Engine(m, params, slots=2, buf_len=24,
+                         cache_dtype=jnp.int8, prefix_pool=1,
+                         prefix_chunk=4)
+    eng.register_prefix(pref)
+    prompts = [pref + list(rng.randint(0, 64, k)) for k in (2, 5)]
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    while eng.live():
+        eng.step()
+    assert eng.prefix_hits == 2
+    for rid, p in zip(rids, prompts):
+        buf = jnp.zeros((1, 24), jnp.int32).at[0, :len(p)].set(
+            jnp.asarray(p))
+        out, fl = m.generate_cached(params, buf, len(p), 6,
+                                    cache_dtype=jnp.int8)
+        solo = list(np.asarray(out[0, len(p):int(fl[0])]))
+        assert eng.result(rid) == solo, p
+
+
 def test_prefix_pool_validation_and_longest_match():
     m, params = _gpt(32)
     eng = serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=1)
