@@ -305,22 +305,30 @@ class LlamaAttention(nn.Module):
         MXU pass scores gamma+1 proposals against the live cache.
         int8 caches quantize the chunk per position (the same
         amax/127 sidecar math as the single-token path)."""
-        if (self.window is not None
-                and cache["k"].shape[2] == self.window):
-            raise NotImplementedError(
-                "decode_chunk over a rolling cache is not wired; use "
-                "full-width caches for chunked verify/serving")
         B, L, E = x.shape
         S = cache["k"].shape[2]
+        rolling = self.window is not None and S == self.window
+        if rolling and L > 1:
+            # a chunk that wraps the ring overwrites slots still inside
+            # EARLIER chunk queries' windows (slot (p' mod W) for a
+            # later p' held p' - W, which is >= p - W + 1 for any
+            # earlier in-chunk query p) — exactness would need per-query
+            # cache snapshots.  L == 1 (the serving engine's tick) has
+            # no such aliasing and is wired below.
+            raise NotImplementedError(
+                "decode_chunk over a rolling cache supports only "
+                "L == 1 (engine ticks); use full-width caches for "
+                "chunked verify/prefill")
         q, k, v = self._qkv(p, x, B, L)
         posL = pos[:, None] + jnp.arange(L)                 # (B, L)
         q, k = self._rope(q, k, posL)
+        wpos = (pos % S) if rolling else pos                # write slot
 
         def put(buf, val):
             # per-row offsets: vmap a dynamic_update_slice over batch
             return jax.vmap(
                 lambda b, vv, p0: lax.dynamic_update_slice(
-                    b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
+                    b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, wpos)
 
         cache = dict(cache)
         if cache["k"].dtype == jnp.int8:
@@ -346,9 +354,16 @@ class LlamaAttention(nn.Module):
         scores = scores * (1.0 / (self.D ** 0.5))
         kpos = jnp.arange(S)[None, None, None, None, :]
         qpos = posL[:, None, None, :, None]
-        valid = kpos <= qpos
-        if self.window is not None:
-            valid = valid & (kpos > qpos - self.window)
+        if rolling:
+            # slot s holds absolute position q - ((q - s) mod W) per
+            # row (the step path's reconstruction, vectorized over B):
+            # always <= q and > q - W, so only p_s >= 0 needs checking
+            p_s = qpos - ((qpos - kpos) % S)
+            valid = p_s >= 0
+        else:
+            valid = kpos <= qpos
+            if self.window is not None:
+                valid = valid & (kpos > qpos - self.window)
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bkgls,bksd->bkgld", probs, vf).astype(x.dtype)

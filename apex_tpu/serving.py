@@ -72,7 +72,8 @@ class Engine:
                  cache_dtype=None, draft=None, draft_params=None,
                  gamma: int = 4, temperature: float = 0.0,
                  top_k=None, top_p=None, rng=None,
-                 prefix_pool: int = 0, prefix_chunk: int = 32):
+                 prefix_pool: int = 0, prefix_chunk: int = 32,
+                 rolling: bool = False):
         """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
         decoding: one ``spec_iteration`` (models/speculative.py) per
         tick, so every live request advances 1..gamma+1 tokens per
@@ -95,7 +96,18 @@ class Engine:
         never see the suffix), so the solo-decode exactness contract is
         unchanged (pinned in tests/test_serving.py).  The chunk fn
         compiles once; chunks that would run past ``buf_len`` slide
-        back and idempotently recompute the overlap."""
+        back and idempotently recompute the overlap.
+
+        ``rolling=True`` serves a sliding-window model (Mistral-class)
+        with O(window) KV memory per slot instead of O(buf_len):
+        position p lives in ring slot p % W.  Admission prefills the
+        prompt into a temporary full-width single-row cache, then
+        relayouts the last W positions into the ring (one gather —
+        exact, because a sliding-window model's decode never attends
+        past W back).  The decode tick is the same ``decode_chunk``
+        (L=1 rolling is wired in the model layer).  Incompatible with
+        ``draft`` (speculative verify needs L>1 chunks) and
+        ``prefix_pool`` (the splice relayout is not wired)."""
         self.model = model
         self.params = params
         self.slots = slots
@@ -129,10 +141,33 @@ class Engine:
             cache_dtype = (model._table(params).dtype
                            if hasattr(model, "_table")
                            else params["wte"]["weight"].dtype)
+        self.rolling = rolling
+        if rolling:
+            if draft is not None:
+                raise NotImplementedError(
+                    "rolling + speculative is not wired (verify needs "
+                    "L>1 chunks over the ring)")
+            if prefix_pool:
+                raise NotImplementedError(
+                    "rolling + prefix_pool is not wired")
+            self._window = getattr(model.cfg, "sliding_window", None)
+            if not self._window:
+                raise ValueError("rolling=True requires a model with "
+                                 "sliding_window set")
+            if cache_dtype == jnp.int8:
+                # admission prefills with fp attention reads, but the
+                # solo rolling decode (step prefill) reads dequantized
+                # int8 for layers >= 1 — the caches differ numerically
+                # and the token-for-token contract would quietly break
+                raise NotImplementedError(
+                    "rolling + int8 cache is not wired (admission "
+                    "parity with the solo step-prefill path)")
         self.ids = jnp.zeros((slots, buf_len), jnp.int32)
         self.cur_len = jnp.zeros((slots,), jnp.int32)
         self.limit = jnp.zeros((slots,), jnp.int32)   # per-slot final
-        self.cache = model.init_cache(slots, dtype=cache_dtype)
+        self.cache = (model.init_cache(slots, dtype=cache_dtype,
+                                       rolling=True) if rolling
+                      else model.init_cache(slots, dtype=cache_dtype))
         self.d_cache = (draft.init_cache(slots, dtype=cache_dtype)
                         if draft is not None else None)
         self._free = list(range(slots))
@@ -160,6 +195,35 @@ class Engine:
             return ids, cache, d_cache
 
         self._prefill_slot = jax.jit(_prefill_slot)
+
+        if rolling:
+            W = self._window
+
+            def _prefill_slot_rolling(ids, cache, slot, row, plen):
+                """Full-width single-row prefill, then relayout the
+                last W positions into the ring (slot j <- the largest
+                position p < plen with p % W == j; unwritten slots stay
+                zero and the ring validity mask never selects them)."""
+                full = model.prefill_cache(
+                    params, row[None, :],
+                    model.init_cache(1, dtype=cache_dtype))
+                j = jnp.arange(W)
+                p_j = plen - 1 - ((plen - 1 - j) % W)
+                gather = jnp.maximum(p_j, 0)    # p_j < plen <= width
+
+                def relayout(b, fb):
+                    ring = jnp.take(fb[0], gather, axis=1)  # width ax 2
+                    ring = jnp.where((p_j >= 0)[None, :, None],
+                                     ring, 0)
+                    return lax.dynamic_update_index_in_dim(
+                        b, ring.astype(b.dtype), slot, axis=0)
+
+                cache = jax.tree_util.tree_map(relayout, cache, full)
+                ids = lax.dynamic_update_index_in_dim(ids, row, slot,
+                                                      axis=0)
+                return ids, cache
+
+            self._prefill_slot_rolling = jax.jit(_prefill_slot_rolling)
 
         # -- prefix-sharing pool ------------------------------------------
         if prefix_chunk < 1:
@@ -284,7 +348,11 @@ class Engine:
         row[:len(prompt)] = prompt
         pidx, L = (self._match_prefix(prompt) if self._prefixes
                    else (None, 0))
-        if pidx is not None:
+        if self.rolling:
+            self.ids, self.cache = self._prefill_slot_rolling(
+                self.ids, self.cache, slot, jnp.asarray(row),
+                len(prompt))
+        elif pidx is not None:
             # splice: gather the pool row, run only the suffix
             # [L, prompt_len) through decode_chunk on that row, scatter
             # it into the slot
