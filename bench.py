@@ -439,7 +439,7 @@ def main():
              unit="sequences/sec/chip", vs_baseline=None)
 
     def engine_config(metric, cfg, slots, prompt, new_tokens,
-                      model_cls=None):
+                      model_cls=None, rolling=False):
         """Continuous-batching engine throughput: keep every slot busy
         (re-admit a fresh request the moment one finishes) and measure
         steady-state generated tokens/sec — includes the real per-step
@@ -452,7 +452,8 @@ def main():
             if x.dtype == jnp.float32 else x, params)
         ctx = getattr(cfg, "block_size", None) \
             or cfg.max_position_embeddings
-        eng = serving.Engine(model, params, slots=slots, buf_len=ctx)
+        eng = serving.Engine(model, params, slots=slots, buf_len=ctx,
+                             rolling=rolling)
         rng = np.random.RandomState(0)
 
         def admit():
@@ -475,7 +476,10 @@ def main():
              unit="tokens/sec/chip", vs_baseline=None,
              note=f"continuous batching, {slots} slots, prompt="
                   f"{prompt}, {new_tokens} new/request, slot re-admit "
-                  f"on finish")
+                  f"on finish"
+                  + (f", O(window) ring cache W="
+                     f"{getattr(cfg, 'sliding_window', None)}"
+                     if rolling else ""))
 
     def prefix_admit_config(metric, cfg, prompt, prefix_len,
                             model_cls=None):
@@ -696,6 +700,16 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 64)),
+            ("mistral_rolling_engine_decode_throughput",
+             lambda: engine_config(
+                 "mistral_rolling_engine_decode_throughput",
+                 models.LlamaConfig(
+                     vocab_size=32000, hidden_size=768,
+                     intermediate_size=2048, num_hidden_layers=8,
+                     num_attention_heads=12, num_key_value_heads=4,
+                     max_position_embeddings=4096, sliding_window=1024,
+                     tie_word_embeddings=True),
+                 8, 512, 64, model_cls=models.Llama, rolling=True)),
             ("gpt2_small_engine_prefix_admit_speedup",
              lambda: prefix_admit_config(
                  "gpt2_small_engine_prefix_admit_speedup",
@@ -776,6 +790,16 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 6)),
+            ("llama_tiny_rolling_engine_decode_throughput",
+             lambda: engine_config(
+                 "llama_tiny_rolling_engine_decode_throughput",
+                 models.LlamaConfig(
+                     vocab_size=128, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=16, sliding_window=6,
+                     tie_word_embeddings=True),
+                 2, 4, 6, model_cls=models.Llama, rolling=True)),
             ("gpt_tiny_engine_prefix_admit_speedup",
              lambda: prefix_admit_config(
                  "gpt_tiny_engine_prefix_admit_speedup",

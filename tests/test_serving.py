@@ -365,6 +365,9 @@ def test_rolling_engine_validation():
     with pytest.raises(NotImplementedError, match="speculative"):
         serving.Engine(lm, lp, slots=1, buf_len=32, rolling=True,
                        draft=lm, draft_params=lp)
+    with pytest.raises(NotImplementedError, match="int8"):
+        serving.Engine(lm, lp, slots=1, buf_len=32, rolling=True,
+                       cache_dtype=jnp.int8)
 
 
 def test_queue_stress_arrivals_exceed_slots_fifo_fair():
