@@ -381,3 +381,135 @@ class T5(nn.Module):
         out = jnp.zeros((B, S), jnp.int32)
         out, _ = lax.fori_loop(0, S, body, (out, cache))
         return out
+
+    # -- slot-granular serving contract (serving.Seq2SeqEngine) ------------
+    def init_seq2seq_state(self, slots: int, src_len: int,
+                           dec_len: int, dtype=jnp.float32):
+        """Per-slot serving state: cross-attention K/V precomputed from
+        each slot's encoder pass, a decoder self-attention cache, and
+        the per-slot source validity mask.  Keys are str layer indices
+        (the cache pytree discipline the decoder-only families use)."""
+        cfg = self.cfg
+        cross = {str(i): {
+            "k": jnp.zeros((slots, cfg.num_heads, src_len, cfg.d_kv),
+                           dtype),
+            "v": jnp.zeros((slots, cfg.num_heads, src_len, cfg.d_kv),
+                           dtype)} for i in range(cfg.num_decoder_layers)}
+        dec = {str(i): {
+            "k": jnp.zeros((slots, cfg.num_heads, dec_len, cfg.d_kv),
+                           dtype),
+            "v": jnp.zeros((slots, cfg.num_heads, dec_len, cfg.d_kv),
+                           dtype)} for i in range(cfg.num_decoder_layers)}
+        return {"cross": cross, "dec": dec,
+                "src_mask": jnp.zeros((slots, src_len), jnp.float32)}
+
+    def seed_slot_seq2seq(self, p, state, slot, src_row, n_src):
+        """Run the encoder for ONE request (``src_row`` (src_len,),
+        valid length ``n_src``) and scatter its cross K/V + source mask
+        into ``slot``; the slot's decoder cache rows reset to zero."""
+        cfg = self.cfg
+        src_len = src_row.shape[0]
+        mask01 = (jnp.arange(src_len) < n_src).astype(jnp.float32)
+        enc = self.encode(p, src_row[None, :], mask01[None, :])
+        state = {"cross": dict(state["cross"]),
+                 "dec": dict(state["dec"]),
+                 "src_mask": state["src_mask"].at[slot].set(mask01)}
+        for i in range(cfg.num_decoder_layers):
+            li = str(i)
+            ca = self.dec_blocks[i].cross_attn
+            cp = p["dec_blocks"][li]["cross_attn"]
+            k = ca._heads(ca.k(cp["k"], enc), 1, src_len)
+            v = ca._heads(ca.v(cp["v"], enc), 1, src_len)
+            layer = state["cross"][li]
+            state["cross"][li] = {
+                "k": lax.dynamic_update_index_in_dim(
+                    layer["k"], k[0].astype(layer["k"].dtype), slot, 0),
+                "v": lax.dynamic_update_index_in_dim(
+                    layer["v"], v[0].astype(layer["v"].dtype), slot, 0)}
+            dlayer = state["dec"][li]
+            state["dec"][li] = {
+                "k": lax.dynamic_update_index_in_dim(
+                    dlayer["k"], jnp.zeros_like(dlayer["k"][0]), slot,
+                    0),
+                "v": lax.dynamic_update_index_in_dim(
+                    dlayer["v"], jnp.zeros_like(dlayer["v"][0]), slot,
+                    0)}
+        return state
+
+    def _row_bias(self, p, pos, dec_len):
+        """Per-row decoder self-attn bias: query at ``pos[b]`` over
+        keys 0..dec_len-1 -> (B, H, 1, dec_len).  position_bias's
+        (1, H, Tq, Tk) shape assumes a shared query position; serving
+        rows sit at different positions."""
+        sa = self.dec_blocks[0].self_attn
+        bp = p["dec_blocks"]["0"]["self_attn"]
+        rel = jnp.arange(dec_len)[None, :] - pos[:, None]    # (B, S)
+        buckets = _relative_position_bucket(
+            rel, False, sa.nbuckets, sa.maxdist)
+        vals = sa.relative_attention_bias(
+            bp["relative_attention_bias"], buckets)          # (B, S, H)
+        return jnp.transpose(vals, (0, 2, 1))[:, :, None, :]
+
+    def decode_step_rows(self, p, tok, pos, state):
+        """One greedy-servable decoder step at PER-ROW positions:
+        ``tok`` (B,) feeds position ``pos[b]`` of each slot; returns
+        (logits (B, V), new state).  Mirrors ``generate``'s inner body
+        but row-batched — the Seq2SeqEngine tick."""
+        cfg = self.cfg
+        B = tok.shape[0]
+        dec_len = state["dec"]["0"]["k"].shape[2]
+        x = self.shared(p["shared"], tok[:, None])
+        bias = self._row_bias(p, pos, dec_len)
+        key_mask = jnp.where(
+            jnp.arange(dec_len)[None, None, None, :]
+            <= pos[:, None, None, None], 0.0, -1e9)
+        cross_mask = ((1.0 - state["src_mask"])
+                      * -1e9)[:, None, None, :]
+        new_state = {"cross": state["cross"], "dec": {},
+                     "src_mask": state["src_mask"]}
+
+        def put_row(buf, val):
+            # (B, H, 1, d) written at per-row positions
+            return jax.vmap(
+                lambda b, vv, p0: lax.dynamic_update_slice(
+                    b, vv.astype(b.dtype), (0, p0, 0)))(buf, val, pos)
+
+        for i in range(cfg.num_decoder_layers):
+            li = str(i)
+            blk = self.dec_blocks[i]
+            bp = p["dec_blocks"][li]
+            h = blk.ln_self(bp["ln_self"], x)
+            sa = blk.self_attn
+            q = sa._heads(sa.q(bp["self_attn"]["q"], h), B, 1)
+            k1 = sa._heads(sa.k(bp["self_attn"]["k"], h), B, 1)
+            v1 = sa._heads(sa.v(bp["self_attn"]["v"], h), B, 1)
+            layer = state["dec"][li]
+            ck = put_row(layer["k"], k1)
+            cv = put_row(layer["v"], v1)
+            new_state["dec"][li] = {"k": ck, "v": cv}
+            scores = jnp.einsum("bhqd,bhkd->bhqk",
+                                q.astype(jnp.float32),
+                                ck.astype(jnp.float32)) \
+                + bias.astype(jnp.float32) + key_mask
+            probs = jax.nn.softmax(scores, -1).astype(x.dtype)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                             cv.astype(probs.dtype))
+            ctx = jnp.moveaxis(ctx, 1, 2).reshape(
+                B, 1, cfg.num_heads * cfg.d_kv)
+            x = x + sa.o(bp["self_attn"]["o"], ctx)
+            hc = blk.ln_cross(bp["ln_cross"], x)
+            ca = blk.cross_attn
+            qc = ca._heads(ca.q(bp["cross_attn"]["q"], hc), B, 1)
+            ckv = state["cross"][li]["k"]
+            cvv = state["cross"][li]["v"]
+            cs = jnp.einsum("bhqd,bhkd->bhqk", qc.astype(jnp.float32),
+                            ckv.astype(jnp.float32)) + cross_mask
+            cp2 = jax.nn.softmax(cs, -1).astype(x.dtype)
+            cctx = jnp.einsum("bhqk,bhkd->bhqd", cp2,
+                              cvv.astype(cp2.dtype))
+            cctx = jnp.moveaxis(cctx, 1, 2).reshape(
+                B, 1, cfg.num_heads * cfg.d_kv)
+            x = x + ca.o(bp["cross_attn"]["o"], cctx)
+            x = x + blk.ff(bp["ff"], blk.ln_ff(bp["ln_ff"], x))
+        x = self.dec_norm(p["dec_norm"], x)
+        return self._head(p, x)[:, 0], new_state

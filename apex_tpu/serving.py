@@ -39,6 +39,10 @@ Gemma / NeoX configs).  MoE models must be served DROPLESS
 (``capacity_factor >= n_experts``, e.g. a ``mixtral_from_hf`` config):
 capacity-bounded routing would make one request's tokens depend on
 which other requests share the batch, and the constructor rejects it.
+
+Encoder-decoder models (T5) get their own :class:`Seq2SeqEngine`: the
+per-slot residents are the request's precomputed cross-attention K/V
+and a decoder self-attention cache instead of one decoder KV cache.
 """
 
 from __future__ import annotations
@@ -53,7 +57,7 @@ from jax import lax
 
 from .models.speculative import _head_logits
 
-__all__ = ["Engine"]
+__all__ = ["Engine", "Seq2SeqEngine"]
 
 
 class _Request:
@@ -67,7 +71,83 @@ class _Request:
         self.done = False
 
 
-class Engine:
+class _SlotScheduler:
+    """Shared request-lifecycle machinery for both engines: slot
+    bookkeeping, the FIFO submit queue, and result harvesting.
+    Subclasses provide ``_admit(rid, prompt, max_new, eos)`` (claim
+    ``self._free.pop()`` and seed device state) and
+    ``_check_prompt(prompt)`` (shape validation), plus their own
+    ``step()``."""
+
+    def _init_scheduler(self, slots: int):
+        self._free = list(range(slots))
+        self._waiting: List[Any] = []
+        self._by_slot: Dict[int, _Request] = {}
+        self._finished: Dict[int, _Request] = {}
+        self._next_rid = 0
+
+    def _check_request(self, prompt, max_new_tokens):
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        self._check_prompt(prompt)
+
+    def add_request(self, prompt: Sequence[int],
+                    max_new_tokens: int,
+                    eos_token_id: Optional[int] = None) -> int:
+        """Claim a slot, seed it, return the request id.  Raises if no
+        slot is free (``submit`` queues instead)."""
+        if not self._free:
+            raise RuntimeError("no free slot; harvest finished "
+                               "requests, use submit(), or add "
+                               "capacity")
+        self._check_request(prompt, max_new_tokens)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._admit(rid, prompt, max_new_tokens, eos_token_id)
+        return rid
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_token_id: Optional[int] = None) -> int:
+        """``add_request`` that QUEUES when the engine is full; queued
+        requests are admitted automatically as slots free at the end
+        of each ``step()`` (arrival order)."""
+        self._check_request(prompt, max_new_tokens)
+        if self._free and not self._waiting:
+            return self.add_request(prompt, max_new_tokens,
+                                    eos_token_id)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._waiting.append((rid, list(prompt), max_new_tokens,
+                              eos_token_id))
+        return rid
+
+    def _drain_queue(self):
+        while self._free and self._waiting:
+            self._admit(*self._waiting.pop(0))
+
+    def _finish(self, slot, req):
+        req.done = True
+        del self._by_slot[slot]
+        self._free.append(slot)
+        self._finished[req.rid] = req
+
+    def result(self, rid: int) -> List[int]:
+        """Generated tokens (incl. EOS if hit) for a finished request."""
+        return list(self._finished[rid].generated)
+
+    def live(self) -> int:
+        return len(self._by_slot)
+
+    def stats(self) -> Dict[str, int]:
+        """Scheduler introspection snapshot."""
+        return {"live": len(self._by_slot),
+                "waiting": len(self._waiting),
+                "free": len(self._free),
+                "finished": len(self._finished)}
+
+
+class Engine(_SlotScheduler):
     def __init__(self, model, params, slots: int, buf_len: int,
                  cache_dtype=None, draft=None, draft_params=None,
                  gamma: int = 4, temperature: float = 0.0,
@@ -170,11 +250,7 @@ class Engine:
                       else model.init_cache(slots, dtype=cache_dtype))
         self.d_cache = (draft.init_cache(slots, dtype=cache_dtype)
                         if draft is not None else None)
-        self._free = list(range(slots))
-        self._waiting: List[Any] = []
-        self._by_slot: Dict[int, _Request] = {}
-        self._finished: Dict[int, _Request] = {}
-        self._next_rid = 0
+        self._init_scheduler(slots)
 
         def _seed(m, ps, cache, slot, row):
             row_cache = m.prefill_cache(ps, row[None, :],
@@ -389,40 +465,6 @@ class Engine:
             raise ValueError(f"prompt length {len(prompt)} not in "
                              f"[1, {self.buf_len})")
 
-    def add_request(self, prompt: Sequence[int],
-                    max_new_tokens: int,
-                    eos_token_id: Optional[int] = None) -> int:
-        """Claim a slot, prefill it, return the request id.  Raises
-        if no slot is free (``submit`` queues instead)."""
-        if not self._free:
-            raise RuntimeError("no free slot; harvest finished "
-                               "requests, use submit(), or add "
-                               "capacity")
-        self._check_prompt(prompt)
-        rid = self._next_rid
-        self._next_rid += 1
-        self._admit(rid, prompt, max_new_tokens, eos_token_id)
-        return rid
-
-    def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_token_id: Optional[int] = None) -> int:
-        """``add_request`` that QUEUES when the engine is full; queued
-        requests are admitted automatically as slots free at the end
-        of each ``step()`` (arrival order)."""
-        self._check_prompt(prompt)
-        if self._free and not self._waiting:
-            return self.add_request(prompt, max_new_tokens,
-                                    eos_token_id)
-        rid = self._next_rid
-        self._next_rid += 1
-        self._waiting.append((rid, list(prompt), max_new_tokens,
-                              eos_token_id))
-        return rid
-
-    def _drain_queue(self):
-        while self._free and self._waiting:
-            self._admit(*self._waiting.pop(0))
-
     def step(self) -> Dict[int, Any]:
         """One batched decode step.  Returns {request_id: [tokens]}
         for every live request that emitted this step (one token on
@@ -462,18 +504,110 @@ class Engine:
                     or req.prompt_len + len(req.generated)
                     >= self.buf_len)
             if hit_eos or full:
-                req.done = True
-                del self._by_slot[slot]
-                self._free.append(slot)
+                self._finish(slot, req)
                 # stop the device from advancing the freed slot
                 self.limit = self.limit.at[slot].set(0)
-                self._finished[req.rid] = req
         self._drain_queue()
         return out
 
-    def result(self, rid: int) -> List[int]:
-        """Generated tokens (incl. EOS if hit) for a finished request."""
-        return list(self._finished[rid].generated)
+    def stats(self) -> Dict[str, int]:
+        """Base snapshot plus prefix-splice admissions so far."""
+        return {**super().stats(), "prefix_hits": self.prefix_hits}
 
-    def live(self) -> int:
-        return len(self._by_slot)
+
+class Seq2SeqEngine(_SlotScheduler):
+    """Continuous batching for ENCODER-DECODER models (T5 family).
+
+    Decoder-only serving reuses one KV cache per slot; seq2seq serving
+    needs two per-slot residents instead: the cross-attention K/V
+    precomputed from that request's encoder pass, and a decoder
+    self-attention cache.  ``add_request`` runs the encoder for the new
+    request alone and scatters both into its slot
+    (``T5.init_seq2seq_state`` / ``seed_slot_seq2seq``); ``step()`` is
+    one jitted ``decode_step_rows`` tick over all slots at per-slot
+    decoder positions — greedy, matching ``T5.generate``'s semantics
+    token-for-token for each request regardless of what shares the
+    batch (pinned in tests/test_serving.py).
+
+    ``src_len`` fixes the padded source width (requests validate
+    against it; shorter sources are masked, exactly like
+    ``generate(attention_mask=...)``); ``max_new_cap`` fixes the
+    decoder cache width, and per-request ``max_new_tokens`` may be
+    anything up to it.  ``submit`` queues FIFO like the decoder-only
+    Engine.
+    """
+
+    def __init__(self, model, params, slots: int, src_len: int,
+                 max_new_cap: int, cache_dtype=None):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.src_len = src_len
+        self.max_new_cap = max_new_cap
+        if cache_dtype is None:
+            cache_dtype = params["shared"]["weight"].dtype
+        self.state = model.init_seq2seq_state(slots, src_len,
+                                              max_new_cap, cache_dtype)
+        self.out = jnp.zeros((slots, max_new_cap), jnp.int32)
+        self.n_new = jnp.zeros((slots,), jnp.int32)
+        self._init_scheduler(slots)
+
+        self._seed = jax.jit(
+            lambda st, slot, row, n: model.seed_slot_seq2seq(
+                params, st, slot, row, n))
+
+        def _step(state, out, n_new):
+            start = jnp.full((slots,),
+                             model.cfg.decoder_start_token_id,
+                             jnp.int32)
+            prev = jnp.take_along_axis(
+                out, jnp.maximum(n_new - 1, 0)[:, None], axis=1)[:, 0]
+            tok = jnp.where(n_new == 0, start, prev)
+            logits, state = model.decode_step_rows(params, tok, n_new,
+                                                   state)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            can = n_new < max_new_cap
+            out = jax.vmap(
+                lambda row, p, t, c: row.at[p].set(
+                    jnp.where(c, t, row[p])))(
+                out, jnp.minimum(n_new, max_new_cap - 1), nxt, can)
+            return state, out, jnp.where(can, n_new + 1, n_new), nxt
+
+        self._step = jax.jit(_step)
+
+    def _check_prompt(self, src):
+        if len(src) < 1 or len(src) > self.src_len:
+            raise ValueError(f"source length {len(src)} not in "
+                             f"[1, {self.src_len}]")
+
+    def _admit(self, rid, src, max_new_tokens, eos_token_id):
+        slot = self._free.pop()
+        row = np.zeros((self.src_len,), np.int32)
+        row[:len(src)] = src
+        self.state = self._seed(self.state, slot, jnp.asarray(row),
+                                len(src))
+        self.n_new = self.n_new.at[slot].set(0)
+        self._by_slot[slot] = _Request(rid, slot, len(src),
+                                      min(max_new_tokens,
+                                          self.max_new_cap),
+                                      eos_token_id)
+
+    def step(self) -> Dict[int, Any]:
+        """One batched decoder tick; {rid: [token]} for live requests.
+        Finishes on per-request EOS or token budget; the slot frees
+        immediately."""
+        if not self._by_slot:
+            return {}
+        self.state, self.out, self.n_new, nxt = self._step(
+            self.state, self.out, self.n_new)
+        toks = np.asarray(nxt)
+        out: Dict[int, Any] = {}
+        for slot, req in list(self._by_slot.items()):
+            t = int(toks[slot])
+            req.generated.append(t)
+            out[req.rid] = [t]
+            hit_eos = req.eos is not None and t == req.eos
+            if hit_eos or len(req.generated) >= req.max_new:
+                self._finish(slot, req)
+        self._drain_queue()
+        return out

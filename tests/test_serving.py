@@ -370,6 +370,56 @@ def test_rolling_engine_validation():
                        cache_dtype=jnp.int8)
 
 
+def test_seq2seq_engine_matches_solo_t5_generate():
+    """Encoder-decoder continuous batching: each request's tokens must
+    equal T5.generate run for it alone (its own source, its own
+    attention mask), under staggered arrivals, mixed source lengths,
+    and slot reuse."""
+    from apex_tpu.models import T5, T5Config
+    cfg = T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4, dropout_rate=0.0,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=16)
+    m = T5(cfg)
+    params, _ = m.init(jax.random.PRNGKey(50))
+    eng = serving.Seq2SeqEngine(m, params, slots=2, src_len=12,
+                                max_new_cap=10)
+    rng = np.random.RandomState(50)
+
+    def solo(src, n):
+        ids = jnp.zeros((1, 12), jnp.int32).at[0, :len(src)].set(
+            jnp.asarray(src))
+        mask = (jnp.arange(12) < len(src)).astype(
+            jnp.float32)[None, :]
+        out = m.generate(params, ids, n, attention_mask=mask)
+        return list(np.asarray(out[0]))
+
+    pa = list(rng.randint(2, 64, 11))
+    pb = list(rng.randint(2, 64, 4))
+    pc = list(rng.randint(2, 64, 7))
+    ra = eng.add_request(pa, max_new_tokens=9)
+    eng.step()
+    rb = eng.add_request(pb, max_new_tokens=5)     # staggered
+    rc = eng.submit(pc, max_new_tokens=7)          # queues (2 slots)
+    steps = 0
+    while eng.live() or eng._waiting:
+        eng.step()
+        steps += 1
+        assert steps < 40
+    assert eng.result(ra) == solo(pa, 9)
+    assert eng.result(rb) == solo(pb, 5)
+    assert eng.result(rc) == solo(pc, 7)           # reused slot
+    assert eng.stats()["finished"] == 3
+
+    # per-request EOS frees the slot early and is recorded
+    first = solo(pa, 1)[0]
+    r4 = eng.add_request(pa, max_new_tokens=8, eos_token_id=first)
+    out = eng.step()
+    assert out[r4] == [first] and eng.live() == 0
+    with pytest.raises(ValueError, match="source length"):
+        eng.add_request(list(range(13)), max_new_tokens=2)
+
+
 def test_queue_stress_arrivals_exceed_slots_fifo_fair():
     """VERDICT r4 item 6: arrivals >> slots.  20 requests of mixed
     lengths through 3 slots — every result must still equal its solo
