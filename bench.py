@@ -481,6 +481,43 @@ def main():
                      f"{getattr(cfg, 'sliding_window', None)}"
                      if rolling else ""))
 
+    def seq2seq_engine_config(metric, cfg, slots, src_len, new_tokens):
+        """Encoder-decoder continuous batching throughput (T5):
+        slot re-admit on finish, steady-state generated tokens/sec."""
+        from apex_tpu import serving
+        model = models.T5(cfg)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        eng = serving.Seq2SeqEngine(model, params, slots=slots,
+                                    src_len=src_len,
+                                    max_new_cap=new_tokens)
+        rng = np.random.RandomState(0)
+
+        def admit():
+            n = int(rng.randint(src_len // 2, src_len + 1))
+            eng.add_request(list(rng.randint(2, cfg.vocab_size, n)),
+                            max_new_tokens=new_tokens)
+
+        for _ in range(slots):
+            admit()
+        for _ in range(5):
+            eng.step()
+        t0 = time.perf_counter()
+        produced = 0
+        steps = max(3 * new_tokens, 30)
+        for _ in range(steps):
+            produced += len(eng.step())
+            while eng._free:
+                admit()
+        dt = time.perf_counter() - t0
+        emit(metric=metric, value=round(produced / dt, 1),
+             unit="tokens/sec/chip", vs_baseline=None,
+             note=f"seq2seq continuous batching, {slots} slots, "
+                  f"src<={src_len}, {new_tokens} new/request, "
+                  f"encoder pass per admission")
+
     def prefix_admit_config(metric, cfg, prompt, prefix_len,
                             model_cls=None):
         """Admission latency, full prefill vs prefix-sharing splice:
@@ -700,6 +737,13 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 64)),
+            ("t5_small_seq2seq_engine_decode_throughput",
+             lambda: seq2seq_engine_config(
+                 "t5_small_seq2seq_engine_decode_throughput",
+                 models.T5Config(vocab_size=32128, d_model=512,
+                                 d_kv=64, d_ff=2048, num_layers=6,
+                                 num_heads=8, dropout_rate=0.0),
+                 8, 128, 64)),
             ("mistral_rolling_engine_decode_throughput",
              lambda: engine_config(
                  "mistral_rolling_engine_decode_throughput",
@@ -790,6 +834,15 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 6)),
+            ("t5_tiny_seq2seq_engine_decode_throughput",
+             lambda: seq2seq_engine_config(
+                 "t5_tiny_seq2seq_engine_decode_throughput",
+                 models.T5Config(vocab_size=64, d_model=32, d_kv=8,
+                                 d_ff=64, num_layers=2, num_heads=4,
+                                 dropout_rate=0.0,
+                                 relative_attention_num_buckets=8,
+                                 relative_attention_max_distance=16),
+                 2, 8, 6)),
             ("llama_tiny_rolling_engine_decode_throughput",
              lambda: engine_config(
                  "llama_tiny_rolling_engine_decode_throughput",
