@@ -9,6 +9,8 @@ and wall time:
   int8     — weight-only int8 + int8 KV cache (HBM levers)
   spec     — lossless speculative decoding with the draft model
   beam     — beam search (num_beams hypotheses)
+  engine   — continuous batching with a shared-prefix KV pool
+  seq2seq  — encoder-decoder (T5) continuous batching
 
 Weights are random (content-free); the point is the mechanics and the
 relative costs.  Usage:
@@ -102,6 +104,54 @@ def main():
     timed("beam", jax.jit(
         lambda: beam_search(target, tp, ids, plen, args.new,
                             num_beams=args.beams)[0]))
+
+    # continuous-batching engine with a shared-prefix pool: half the
+    # requests share a registered system prefix and admit via KV splice
+    from apex_tpu import serving
+
+    half = max(1, args.prompt // 2)
+
+    def run_engine():
+        eng = serving.Engine(target, tp, slots=args.batch,
+                             buf_len=block, prefix_pool=1)
+        sys_prefix = list(rng.randint(0, args.vocab, half))
+        eng.register_prefix(sys_prefix)
+        for i in range(2 * args.batch):
+            pr = (sys_prefix if i % 2 == 0 else
+                  list(rng.randint(0, args.vocab, half))) \
+                + list(rng.randint(0, args.vocab, half))
+            eng.submit(pr, max_new_tokens=args.new)
+        n = 0
+        while eng.live() or eng.stats()["waiting"]:
+            n += len(eng.step())
+        return eng.stats(), n
+
+    st, n = timed("engine", run_engine)
+    print(f"engine: {n} tokens over {st['finished']} requests, "
+          f"{st['prefix_hits']} prefix-splice admissions")
+
+    # encoder-decoder continuous batching (T5)
+    t5 = models.T5(models.T5Config(
+        vocab_size=args.vocab, d_model=args.width, d_kv=16,
+        d_ff=2 * args.width, num_layers=max(1, args.layers // 2),
+        num_heads=4, dropout_rate=0.0))
+    t5p, _ = t5.init(jax.random.PRNGKey(2))
+
+    def run_seq2seq():
+        eng = serving.Seq2SeqEngine(t5, t5p, slots=args.batch,
+                                    src_len=args.prompt,
+                                    max_new_cap=args.new)
+        for _ in range(2 * args.batch):
+            n_src = int(rng.randint(1, args.prompt + 1))
+            eng.submit(list(rng.randint(2, args.vocab, n_src)),
+                       max_new_tokens=args.new)
+        n = 0
+        while eng.live() or eng.stats()["waiting"]:
+            n += len(eng.step())
+        return eng.stats(), n
+
+    st, n = timed("seq2seq", run_seq2seq)
+    print(f"seq2seq engine: {n} tokens over {st['finished']} requests")
     print("done", flush=True)
 
 

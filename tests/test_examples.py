@@ -213,4 +213,6 @@ def test_serving_demo_smoke():
               "8", "--new", "8", "--layers", "2", "--width", "32"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "speculative == greedy: True" in r.stdout
+    assert "prefix-splice admissions" in r.stdout
+    assert "seq2seq engine:" in r.stdout
     assert "done" in r.stdout
