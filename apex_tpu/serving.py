@@ -27,11 +27,14 @@ Design (deliberately simple — correctness over paging):
 Exactness (greedy and speculative-greedy paths): a request's output is
 token-for-token what ``generate_cached`` would produce for it alone —
 regardless of what other requests share the batch (pinned in
-tests/test_serving.py with staggered arrivals).  In sampled mode
-(``temperature > 0``) the numbers are still per-slot-correct but NOT
-batch-independent: one RNG key is split per step across all slots, so
-a request's draws depend on which other requests share the batch and
-on arrival timing (the same caveat any shared-stream sampler has).
+tests/test_serving.py with staggered arrivals).  Sampled mode
+(``temperature > 0``) draws each request from its own key stream,
+advanced once per its own decode step — co-tenants and arrival timing
+never perturb it.  With an explicit ``submit(..., seed=N)`` the stream
+is request-intrinsic (fully batch-independent, pinned in tests); the
+default stream keys off the request id, i.e. it is deterministic given
+the engine's SUBMISSION ORDER.  The two namespaces are
+domain-separated, so an explicit seed never collides with an auto id.
 
 Works with any model exposing ``prefill_cache`` / ``decode_chunk`` /
 ``init_cache`` and a greedy head (GPT, Llama and its Mistral / Qwen2 /
@@ -86,40 +89,50 @@ class _SlotScheduler:
         self._finished: Dict[int, _Request] = {}
         self._next_rid = 0
 
-    def _check_request(self, prompt, max_new_tokens):
+    def _check_request(self, prompt, max_new_tokens, seed):
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
+        if seed is not None and not self._supports_seed:
+            raise ValueError("per-request seed is only meaningful for "
+                             "the sampled decoder-only Engine")
         self._check_prompt(prompt)
+
+    _supports_seed = False
 
     def add_request(self, prompt: Sequence[int],
                     max_new_tokens: int,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    seed: Optional[int] = None) -> int:
         """Claim a slot, seed it, return the request id.  Raises if no
-        slot is free (``submit`` queues instead)."""
+        slot is free (``submit`` queues instead).  ``seed`` names a
+        request-intrinsic sampling stream (Engine sampled mode only;
+        validated HERE so a bad request fails at submission, not
+        mid-harvest in a later ``step()``)."""
         if not self._free:
             raise RuntimeError("no free slot; harvest finished "
                                "requests, use submit(), or add "
                                "capacity")
-        self._check_request(prompt, max_new_tokens)
+        self._check_request(prompt, max_new_tokens, seed)
         rid = self._next_rid
         self._next_rid += 1
-        self._admit(rid, prompt, max_new_tokens, eos_token_id)
+        self._admit(rid, prompt, max_new_tokens, eos_token_id, seed)
         return rid
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None) -> int:
         """``add_request`` that QUEUES when the engine is full; queued
         requests are admitted automatically as slots free at the end
         of each ``step()`` (arrival order)."""
-        self._check_request(prompt, max_new_tokens)
+        self._check_request(prompt, max_new_tokens, seed)
         if self._free and not self._waiting:
             return self.add_request(prompt, max_new_tokens,
-                                    eos_token_id)
+                                    eos_token_id, seed)
         rid = self._next_rid
         self._next_rid += 1
         self._waiting.append((rid, list(prompt), max_new_tokens,
-                              eos_token_id))
+                              eos_token_id, seed))
         return rid
 
     def _drain_queue(self):
@@ -362,7 +375,7 @@ class Engine(_SlotScheduler):
 
             self._sstep = jax.jit(_sstep)
 
-        def _step(ids, cur_len, cache, key):
+        def _step(ids, cur_len, cache, keys):
             pos = jnp.maximum(cur_len - 1, 0)
             tok_in = jnp.take_along_axis(
                 ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
@@ -370,10 +383,17 @@ class Engine(_SlotScheduler):
             logits = _head_logits(model, params, h)[:, 0]
             if temperature > 0.0:
                 from .models import sampling as smp
-                key, sub = jax.random.split(key)
-                nxt = smp.sample_token(sub, logits, temperature,
-                                       top_k=top_k,
-                                       top_p=top_p).astype(jnp.int32)
+                # PER-SLOT key streams: each request draws from its own
+                # fold_in(base, seed) chain, so its tokens depend only
+                # on its own seed and step count — never on co-tenants
+                # or arrival timing (batch-independent sampling)
+                split = jax.vmap(
+                    lambda k: jax.random.split(k, 2))(keys)
+                keys, subs = split[:, 0], split[:, 1]
+                nxt = jax.vmap(
+                    lambda k, l: smp.sample_token(
+                        k, l, temperature, top_k=top_k,
+                        top_p=top_p))(subs, logits).astype(jnp.int32)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             can = cur_len < buf_len
@@ -382,9 +402,12 @@ class Engine(_SlotScheduler):
                     jnp.where(c, t, row[p])))(
                 ids, jnp.minimum(cur_len, buf_len - 1), nxt, can)
             return (ids, jnp.where(can, cur_len + 1, cur_len), cache,
-                    nxt, key)
+                    nxt, keys)
 
         self._step = jax.jit(_step)
+        self._slot_keys = jax.vmap(
+            lambda i: jax.random.fold_in(self._key, i))(
+            jnp.arange(slots))
 
     # -- request lifecycle -------------------------------------------------
     def register_prefix(self, tokens: Sequence[int]) -> int:
@@ -418,8 +441,19 @@ class Engine(_SlotScheduler):
                 best, best_len = i, len(pref)
         return best, best_len
 
-    def _admit(self, rid, prompt, max_new_tokens, eos_token_id):
+    _supports_seed = True
+
+    def _admit(self, rid, prompt, max_new_tokens, eos_token_id,
+               seed=None):
         slot = self._free.pop()
+        # sampling stream: domain-separated so an explicit seed can
+        # never collide with an auto rid.  Default (seed=None) keys off
+        # the rid — deterministic given the SUBMISSION ORDER; an
+        # explicit seed gives a request-intrinsic stream independent of
+        # everything else (the batch-independence contract)
+        base = jax.random.fold_in(self._key, 0 if seed is None else 1)
+        self._slot_keys = self._slot_keys.at[slot].set(
+            jax.random.fold_in(base, rid if seed is None else seed))
         row = np.zeros((self.buf_len,), np.int32)
         row[:len(prompt)] = prompt
         pidx, L = (self._match_prefix(prompt) if self._prefixes
@@ -486,8 +520,9 @@ class Engine(_SlotScheduler):
                        for slot in self._by_slot}
         else:
             (self.ids, self.cur_len, self.cache, nxt,
-             self._key) = self._step(self.ids, self.cur_len,
-                                     self.cache, self._key)
+             self._slot_keys) = self._step(self.ids, self.cur_len,
+                                           self.cache,
+                                           self._slot_keys)
             toks = np.asarray(nxt)
             emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
         out: Dict[int, Any] = {}
@@ -580,7 +615,8 @@ class Seq2SeqEngine(_SlotScheduler):
             raise ValueError(f"source length {len(src)} not in "
                              f"[1, {self.src_len}]")
 
-    def _admit(self, rid, src, max_new_tokens, eos_token_id):
+    def _admit(self, rid, src, max_new_tokens, eos_token_id,
+               seed=None):
         slot = self._free.pop()
         row = np.zeros((self.src_len,), np.int32)
         row[:len(src)] = src
