@@ -311,6 +311,35 @@ def test_1f1b_trains_over_steps():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+def test_1f1b_shape_fuzz():
+    """Grad parity across randomized (S, M, width, batch) — the
+    schedule tables, stash rotation, and ring indexing must hold off
+    the hand-picked sizes."""
+    rng = np.random.RandomState(11)
+    for trial in range(4):
+        S = int(rng.choice([2, 3, 4, 8]))
+        M = int(rng.randint(1, 9))
+        W = int(rng.choice([4, 8]))
+        B = int(rng.randint(1, 4))
+        mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+        block = Block(W)
+        stacked = pp.init_stacked(block,
+                                  jax.random.PRNGKey(100 + trial), S)
+        specs = pp.stacked_specs(stacked)
+        x = jnp.asarray(rng.randn(M, B, W), jnp.float32)
+        tgt = jnp.asarray(rng.randn(M, B, W), jnp.float32)
+        loss, grads = jax.jit(jax.shard_map(
+            lambda p, xb, tb: pp.pipeline_1f1b_grads(block, _mse, p,
+                                                     xb, tb),
+            mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=(P(), specs), check_vma=False))(stacked, x, tgt)
+        loss_ref, grads_ref = _ref_loss_grads(block, stacked, x, tgt)
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5,
+                                   err_msg=f"S={S} M={M} W={W} B={B}")
+        assert_trees_close(grads, grads_ref, atol=3e-4)
+
+
 def test_bubble_fraction_model():
     # GPipe and lockstep-1F1B share the bubble; the memory bound is the
     # difference (documented in bubble_fraction)

@@ -328,6 +328,29 @@ def test_sampled_requests_are_batch_independent():
         s2s.submit([3, 4], max_new_tokens=2, seed=1)
 
 
+def test_prefix_splice_boundary_lengths():
+    """Edges of the splice arithmetic: prompt at buf_len-1 (max legal),
+    suffix exactly one chunk, suffix of 1 token, and a prefix whose
+    length is not a chunk multiple (slide-back overlap recompute)."""
+    m, params = _gpt(37)
+    eng = serving.Engine(m, params, slots=2, buf_len=24, prefix_pool=1,
+                         prefix_chunk=4)
+    rng = np.random.RandomState(37)
+    pref = list(rng.randint(0, 64, 10))       # not a multiple of 4
+    eng.register_prefix(pref)
+    cases = [
+        pref + list(rng.randint(0, 64, 13)),  # prompt = 23 = buf-1
+        pref + list(rng.randint(0, 64, 4)),   # suffix == one chunk
+        pref + list(rng.randint(0, 64, 1)),   # suffix == 1
+    ]
+    rids = [eng.submit(p, max_new_tokens=2) for p in cases]
+    while eng.live() or eng.stats()["waiting"]:
+        eng.step()
+    assert eng.prefix_hits == 3
+    for rid, p in zip(rids, cases):
+        assert eng.result(rid) == _solo(m, params, p, 2), len(p)
+
+
 def test_prefix_pool_validation_and_longest_match():
     m, params = _gpt(32)
     eng = serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=1)
