@@ -1,0 +1,107 @@
+"""Adasum: adaptive gradient summation for data-parallel training.
+
+From the retrieved technique paper "Scaling Distributed Training with
+Adaptive Summation" (Maleki et al., arXiv:2006.02924): summing (or
+averaging) replica gradients treats them as if computed at the same
+point, which degrades once per-replica batches pull in conflicting
+directions at large scale.  Adasum combines a pair instead as
+
+    adasum(a, b) = (1 - a.b / (2 |a|^2)) a + (1 - a.b / (2 |b|^2)) b
+
+— orthogonal gradients ADD (full step), identical gradients AVERAGE
+(no double step), and anti-correlated components are damped; the
+N-replica reduction applies the rule over a fixed binary tree.
+
+TPU-native mapping: the reference implementation rides MPI's
+recursive-halving allreduce; here the same fixed XOR butterfly is
+``log2(N)`` ``lax.ppermute`` exchange stages over the mesh axis, each
+stage combining two half-block values with the (symmetric) rule above
+— every rank converges to the same result because the pairwise
+combine inputs are identical within each half block AND combined in a
+canonical low-block-first operand order (see the in-function comment
+on FMA asymmetry).  The combiner is intentionally NOT associative; the
+tree shape (XOR pairing) is fixed so the result is deterministic, and
+is pinned bitwise against a host-side recursion of the same tree in
+tests/test_adasum.py.
+
+Dot products / norms are per-LEAF in fp32 (the paper's per-layer
+granularity) — a whole-model dot would let one giant layer mask
+conflicts in small ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["adasum_pair", "adasum_grads"]
+
+
+def adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The two-operand Adasum rule for one leaf, fp32 internals.
+    Zero-norm operands degrade to plain addition (a zero gradient
+    contributes nothing and must not zero the other side)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.vdot(af, bf)
+    na = jnp.vdot(af, af)
+    nb = jnp.vdot(bf, bf)
+    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)),
+                   1.0)
+    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)),
+                   1.0)
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def adasum_grads(grads: Any, axis_name: str = "data") -> Any:
+    """Adasum-combine ``grads`` across the mapped ``axis_name``
+    (replacing the plain psum/pmean of a DDP allreduce).  Requires a
+    power-of-two axis size (the fixed XOR reduction tree); call inside
+    ``shard_map``.  Returns the combined tree, identical on every rank.
+    """
+    n = lax.axis_size(axis_name)
+    if n & (n - 1):
+        raise ValueError(f"adasum needs a power-of-two axis size, got "
+                         f"{n} on axis {axis_name!r}")
+    idx = lax.axis_index(axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    sizes = [l.size for l in leaves]
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    # ONE fp32 exchange buffer per stage instead of one ppermute per
+    # leaf — log2(N) collectives total, not log2(N) x num_leaves tiny
+    # ones (the flat_dist_call lesson applied here); the Adasum dots
+    # stay PER-LEAF on segment views.
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).ravel() for l in leaves])
+    stages = n.bit_length() - 1
+    for s in range(stages):
+        stride = 1 << s
+        perm = [(i, i ^ stride) for i in range(n)]
+        theirs = lax.ppermute(flat, axis_name, perm)
+        # canonical low-block-first operand order: mathematically the
+        # pair rule is symmetric, but XLA's FMA fusion is not — in
+        # ca*a + cb*b one product is fused into the add and the other
+        # is rounded separately, so partners combining in swapped
+        # operand order drift by ulps and the butterfly's
+        # consistent-within-block invariant decays stage by stage
+        # (observed on the CPU backend; pinned by the cross-rank
+        # bitwise-equality test).
+        low = (idx & stride) == 0
+        a = jnp.where(low, flat, theirs)
+        b = jnp.where(low, theirs, flat)
+        flat = jnp.concatenate(
+            [adasum_pair(a[offs[i]:offs[i + 1]],
+                         b[offs[i]:offs[i + 1]])
+             for i in range(len(leaves))])
+    out = [flat[offs[i]:offs[i + 1]].reshape(shapes[i]).astype(
+        dtypes[i]) for i in range(len(leaves))]
+    return jax.tree_util.tree_unflatten(treedef, out)

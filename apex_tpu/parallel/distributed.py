@@ -202,7 +202,8 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
-                 axis_name: str = "data"):
+                 axis_name: str = "data",
+                 adasum: bool = False):
         if shared_param is not None:
             raise ValueError("shared_param is deprecated (reference "
                              "distributed.py:176-180)")
@@ -215,6 +216,27 @@ class DistributedDataParallel:
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.axis_name = axis_name
+        # adasum=True swaps the psum for the adaptive-summation
+        # butterfly (parallel/adasum.py, arXiv:2006.02924) — a
+        # beyond-reference combiner for conflict-aware large-batch DP.
+        # It REPLACES the sum-then-average pipeline wholesale, so the
+        # psum-shaping knobs are meaningless with it: reject loudly
+        # instead of silently ignoring them.
+        self.adasum = adasum
+        if adasum:
+            clashes = [name for name, bad in (
+                ("delay_allreduce", delay_allreduce),
+                ("allreduce_trigger_params",
+                 bool(allreduce_trigger_params)),
+                ("retain_allreduce_buffers", retain_allreduce_buffers),
+                ("allreduce_always_fp32", allreduce_always_fp32),
+                ("gradient_average=False", not gradient_average),
+                ("gradient_predivide_factor",
+                 gradient_predivide_factor != 1.0)) if bad]
+            if clashes:
+                raise ValueError(
+                    f"adasum=True replaces the psum pipeline; these "
+                    f"options have no effect with it: {clashes}")
         self.allreduce_buffers: list = []
 
     # -- forward passthrough (wrapper parity) ------------------------------
@@ -231,6 +253,12 @@ class DistributedDataParallel:
     def allreduce_grads(self, grads: Any,
                         axis_index_groups: Optional[List[List[int]]] = None
                         ) -> Any:
+        if self.adasum:
+            from .adasum import adasum_grads
+            if axis_index_groups is not None:
+                raise NotImplementedError(
+                    "adasum over axis_index_groups is not wired")
+            return adasum_grads(grads, self.axis_name)
         retain = [] if self.retain_allreduce_buffers else None
         triggers = (set(self.allreduce_trigger_params)
                     if self.allreduce_trigger_params else None)
