@@ -89,50 +89,65 @@ class _SlotScheduler:
         self._finished: Dict[int, _Request] = {}
         self._next_rid = 0
 
-    def _check_request(self, prompt, max_new_tokens, seed):
+    def _check_request(self, prompt, max_new_tokens, seed,
+                       temperature):
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
         if seed is not None and not self._supports_seed:
             raise ValueError("per-request seed is only meaningful for "
                              "the sampled decoder-only Engine")
+        if temperature is not None:
+            if not self._supports_temperature:
+                raise ValueError(
+                    "per-request temperature needs an engine built "
+                    "with temperature > 0 (the sampled tick); greedy "
+                    "and speculative engines have no override point")
+            if not (temperature >= 0):    # also rejects NaN
+                raise ValueError(f"temperature must be >= 0, got "
+                                 f"{temperature}")
         self._check_prompt(prompt)
 
     _supports_seed = False
+    _supports_temperature = False
 
     def add_request(self, prompt: Sequence[int],
                     max_new_tokens: int,
                     eos_token_id: Optional[int] = None,
-                    seed: Optional[int] = None) -> int:
+                    seed: Optional[int] = None,
+                    temperature: Optional[float] = None) -> int:
         """Claim a slot, seed it, return the request id.  Raises if no
         slot is free (``submit`` queues instead).  ``seed`` names a
-        request-intrinsic sampling stream (Engine sampled mode only;
-        validated HERE so a bad request fails at submission, not
-        mid-harvest in a later ``step()``)."""
+        request-intrinsic sampling stream and ``temperature`` overrides
+        the engine default for THIS request (0.0 = greedy row) — both
+        Engine-sampled-mode only; validated HERE so a bad request fails
+        at submission, not mid-harvest in a later ``step()``."""
         if not self._free:
             raise RuntimeError("no free slot; harvest finished "
                                "requests, use submit(), or add "
                                "capacity")
-        self._check_request(prompt, max_new_tokens, seed)
+        self._check_request(prompt, max_new_tokens, seed, temperature)
         rid = self._next_rid
         self._next_rid += 1
-        self._admit(rid, prompt, max_new_tokens, eos_token_id, seed)
+        self._admit(rid, prompt, max_new_tokens, eos_token_id, seed,
+                    temperature)
         return rid
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_token_id: Optional[int] = None,
-               seed: Optional[int] = None) -> int:
+               seed: Optional[int] = None,
+               temperature: Optional[float] = None) -> int:
         """``add_request`` that QUEUES when the engine is full; queued
         requests are admitted automatically as slots free at the end
         of each ``step()`` (arrival order)."""
-        self._check_request(prompt, max_new_tokens, seed)
+        self._check_request(prompt, max_new_tokens, seed, temperature)
         if self._free and not self._waiting:
             return self.add_request(prompt, max_new_tokens,
-                                    eos_token_id, seed)
+                                    eos_token_id, seed, temperature)
         rid = self._next_rid
         self._next_rid += 1
         self._waiting.append((rid, list(prompt), max_new_tokens,
-                              eos_token_id, seed))
+                              eos_token_id, seed, temperature))
         return rid
 
     def _drain_queue(self):
@@ -375,7 +390,7 @@ class Engine(_SlotScheduler):
 
             self._sstep = jax.jit(_sstep)
 
-        def _step(ids, cur_len, cache, keys):
+        def _step(ids, cur_len, cache, keys, temps):
             pos = jnp.maximum(cur_len - 1, 0)
             tok_in = jnp.take_along_axis(
                 ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
@@ -390,10 +405,19 @@ class Engine(_SlotScheduler):
                 split = jax.vmap(
                     lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
-                nxt = jax.vmap(
+                # per-request temperature: rows pre-scale their logits
+                # (sample_token at T=1 then filters — same semantics as
+                # a static temperature); a per-request T=0 row falls
+                # back to argmax via the where
+                safe_t = jnp.where(temps > 0, temps, 1.0)
+                scaled = (logits.astype(jnp.float32)
+                          / safe_t[:, None])
+                sampled = jax.vmap(
                     lambda k, l: smp.sample_token(
-                        k, l, temperature, top_k=top_k,
-                        top_p=top_p))(subs, logits).astype(jnp.int32)
+                        k, l, 1.0, top_k=top_k,
+                        top_p=top_p))(subs, scaled).astype(jnp.int32)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             can = cur_len < buf_len
@@ -408,6 +432,8 @@ class Engine(_SlotScheduler):
         self._slot_keys = jax.vmap(
             lambda i: jax.random.fold_in(self._key, i))(
             jnp.arange(slots))
+        self._slot_temp = jnp.full((slots,), float(temperature),
+                                   jnp.float32)
 
     # -- request lifecycle -------------------------------------------------
     def register_prefix(self, tokens: Sequence[int]) -> int:
@@ -443,9 +469,18 @@ class Engine(_SlotScheduler):
 
     _supports_seed = True
 
+    @property
+    def _supports_temperature(self):
+        # the sampled tick graph only exists when the engine was built
+        # sampled; a greedy engine has no per-request override point
+        return self.temperature > 0.0 and self.draft is None
+
     def _admit(self, rid, prompt, max_new_tokens, eos_token_id,
-               seed=None):
+               seed=None, temperature=None):
         slot = self._free.pop()
+        self._slot_temp = self._slot_temp.at[slot].set(
+            float(self.temperature if temperature is None
+                  else temperature))
         # sampling stream: domain-separated so an explicit seed can
         # never collide with an auto rid.  Default (seed=None) keys off
         # the rid — deterministic given the SUBMISSION ORDER; an
@@ -522,7 +557,8 @@ class Engine(_SlotScheduler):
             (self.ids, self.cur_len, self.cache, nxt,
              self._slot_keys) = self._step(self.ids, self.cur_len,
                                            self.cache,
-                                           self._slot_keys)
+                                           self._slot_keys,
+                                           self._slot_temp)
             toks = np.asarray(nxt)
             emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
         out: Dict[int, Any] = {}
@@ -616,7 +652,7 @@ class Seq2SeqEngine(_SlotScheduler):
                              f"[1, {self.src_len}]")
 
     def _admit(self, rid, src, max_new_tokens, eos_token_id,
-               seed=None):
+               seed=None, temperature=None):
         slot = self._free.pop()
         row = np.zeros((self.src_len,), np.int32)
         row[:len(src)] = src

@@ -328,6 +328,50 @@ def test_sampled_requests_are_batch_independent():
         s2s.submit([3, 4], max_new_tokens=2, seed=1)
 
 
+def test_per_request_temperature_override():
+    """On a sampled engine: a temperature=0.0 request decodes greedily
+    (== its solo greedy decode) while sampled co-tenants keep drawing;
+    greedy/speculative engines reject the override at submission."""
+    m, params = _gpt(38)
+    # sharp logits (cf. test_mixtral.py::_model): with the realistic
+    # flat 0.02-init logits the Gumbel noise dominates every
+    # temperature, making T indistinguishable — the override's effect
+    # needs real decision margins to show
+    params = dict(params)
+    params["wte"] = {"weight": params["wte"]["weight"] / 0.02}
+    rng = np.random.RandomState(38)
+    pg = list(rng.randint(0, 64, 5))
+    eng = serving.Engine(m, params, slots=2, buf_len=24,
+                         temperature=1.0, top_k=16,
+                         rng=jax.random.PRNGKey(4))
+    rg = eng.add_request(pg, max_new_tokens=6, temperature=0.0)
+    rs = eng.add_request(list(rng.randint(0, 64, 4)),
+                         max_new_tokens=8)
+    while eng.live():
+        eng.step()
+    assert eng.result(rg) == _solo(m, params, pg, 6)   # greedy row
+    toks = eng.result(rs)
+    assert len(toks) == 8 and all(0 <= t < 64 for t in toks)
+    # same seed, near-greedy vs scorching temperature: the sharp
+    # logits make T=0.05 track the argmax while T=50 flattens the
+    # top-k to near-uniform — the sequences must diverge
+    r1 = eng.add_request(pg, max_new_tokens=6, seed=9,
+                         temperature=0.05)
+    while eng.live():
+        eng.step()
+    r2 = eng.add_request(pg, max_new_tokens=6, seed=9,
+                         temperature=50.0)
+    while eng.live():
+        eng.step()
+    assert eng.result(r1) != eng.result(r2)
+
+    greedy_eng = serving.Engine(m, params, slots=1, buf_len=24)
+    with pytest.raises(ValueError, match="temperature"):
+        greedy_eng.add_request(pg, max_new_tokens=2, temperature=0.5)
+    with pytest.raises(ValueError, match="temperature must be"):
+        eng.add_request(pg, max_new_tokens=2, temperature=-1.0)
+
+
 def test_prefix_splice_boundary_lengths():
     """Edges of the splice arithmetic: prompt at buf_len-1 (max legal),
     suffix exactly one chunk, suffix of 1 token, and a prefix whose
