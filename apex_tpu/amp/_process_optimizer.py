@@ -405,9 +405,22 @@ class AmpOptimizer(Optimizer):
             found_inf > 0, skip_update, do_update,
             (params, opt_state.masters, opt_state.inner))
 
+        from ..optimizers.base import global_grad_norm
+        # grad-norm gauge (observability): the unscaled fp32 grads are
+        # already in hand (flat buffer on the fused path), so the norm is
+        # one reduction; callers that drop it from the step's outputs get
+        # it DCE'd — no cost unless consumed.  Under ZeRO each device
+        # holds a disjoint grad window, so the squared sums psum to the
+        # global norm (the pad elements are zero).
+        if zero:
+            grad_norm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(jnp.square(grads32)), zaxis))
+        else:
+            grad_norm = global_grad_norm(grads32)
         info = {"found_inf": found_inf,
                 "loss_scale": new_sstate.loss_scale,
-                "steps_skipped": new_sstate.steps_skipped}
+                "steps_skipped": new_sstate.steps_skipped,
+                "grad_norm": grad_norm}
         return new_params, AmpOptState(inner=new_inner, masters=new_masters,
                                        scalers=scalers), info
 

@@ -19,7 +19,9 @@ import jax.numpy as jnp
 
 from ._amp_state import _amp_state, maybe_print, warn_or_err
 
-__all__ = ["Properties", "O0", "O1", "O2", "O3", "opt_levels", "initialize"]
+__all__ = ["Properties", "O0", "O1", "O2", "O3", "opt_levels", "initialize",
+           "scaler_state", "current_loss_scale", "steps_skipped",
+           "amp_stats", "record_scaler"]
 
 _HALF_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
                 "fp16": jnp.float16, "bf16": jnp.bfloat16}
@@ -273,3 +275,80 @@ def initialize(model, optimizers=None, enabled: bool = True,
 
     _amp_state.opt_properties = props
     return _initialize(model, optimizers, props)
+
+
+# -- scaler introspection (the reference's amp_state surface) -------------
+#
+# The scaler's counters (steps_skipped, current loss scale) are plain
+# device scalars inside AmpOptState — users should not have to dig into
+# ScalerState tuples.  These accessors accept any of: an AmpOptState, a
+# stateful BoundOptimizer (amp.stateful.bind), or an amp-initialized
+# AmpOptimizer that has been bound.  Each call is one explicit host
+# fetch — never call them inside the jitted step.
+
+def _resolve_opt_state(opt):
+    from ._process_optimizer import AmpOptState
+    if isinstance(opt, AmpOptState):
+        return opt
+    # stateful forms: BoundOptimizer, or AmpOptimizer with ._bound
+    state = getattr(opt, "opt_state", None)
+    if isinstance(state, AmpOptState):
+        return state
+    bound = getattr(opt, "_bound", None)
+    if bound is not None and isinstance(
+            getattr(bound, "opt_state", None), AmpOptState):
+        return bound.opt_state
+    raise TypeError(
+        f"expected an AmpOptState, a bound optimizer, or an "
+        f"amp-initialized optimizer with bound state; got {type(opt)!r}")
+
+
+def scaler_state(opt, loss_id: int = 0):
+    """The raw :class:`ScalerState` for ``loss_id`` (device arrays)."""
+    return _resolve_opt_state(opt).scalers[loss_id]
+
+
+def current_loss_scale(opt, loss_id: int = 0) -> float:
+    """Current loss scale as a python float (one host fetch)."""
+    return float(scaler_state(opt, loss_id).loss_scale)
+
+
+def steps_skipped(opt, loss_id: int = 0) -> int:
+    """Total overflow-skipped steps as a python int (one host fetch)."""
+    return int(scaler_state(opt, loss_id).steps_skipped)
+
+
+def amp_stats(opt) -> dict:
+    """All-scaler snapshot: per-loss loss scale / clean-step streak /
+    skip totals, in one host fetch of the scaler tuple."""
+    import jax
+    scalers = jax.device_get(_resolve_opt_state(opt).scalers)
+    per_loss = [{"loss_scale": float(s.loss_scale),
+                 "unskipped": int(s.unskipped),
+                 "steps_skipped": int(s.steps_skipped)} for s in scalers]
+    return {"num_losses": len(per_loss),
+            "loss_scale": per_loss[0]["loss_scale"],
+            "steps_skipped": sum(p["steps_skipped"] for p in per_loss),
+            "per_loss": per_loss}
+
+
+def record_scaler(opt, registry=None, step: Optional[int] = None,
+                  emit_event: bool = False, prefix: str = "amp_") -> dict:
+    """Fold the scaler snapshot into an observability registry: gauge
+    ``amp_loss_scale``, counter ``amp_steps_skipped_total``.  With
+    ``emit_event=True`` also appends a loss-scale timeline point to the
+    default span recorder's JSONL event log (tag it with ``step`` to
+    reconstruct the timeline offline)."""
+    from ..observability import get_registry, event
+    stats = amp_stats(opt)
+    reg = registry if registry is not None else get_registry()
+    reg.gauge(prefix + "loss_scale").set(stats["loss_scale"])
+    reg.counter(prefix + "steps_skipped_total").set_total(
+        stats["steps_skipped"])
+    if emit_event:
+        ev = {"loss_scale": stats["loss_scale"],
+              "steps_skipped": stats["steps_skipped"]}
+        if step is not None:
+            ev["step"] = int(step)
+        event("amp_loss_scale", **ev)
+    return stats

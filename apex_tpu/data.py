@@ -21,6 +21,7 @@ unavailable (the reference's Python-only build invariant).
 from __future__ import annotations
 
 import ctypes
+import time
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -54,7 +55,7 @@ class DataLoader:
                  std: Sequence[float] = IMAGENET_STD,
                  prefetch: int = 3, workers: int = 4, seed: int = 0,
                  native: Optional[bool] = None, zero_copy: bool = False,
-                 data_format: str = "NCHW"):
+                 data_format: str = "NCHW", metrics=None):
         if data_format not in ("NCHW", "NHWC"):
             raise ValueError(f"data_format must be NCHW or NHWC, "
                              f"got {data_format!r}")
@@ -119,6 +120,26 @@ class DataLoader:
         self._py_rng = np.random.RandomState(seed)
         self._py_perm = None
         self._py_epoch = -1
+        # host-side load/wait telemetry: how long the training loop
+        # stalls in next_batch().  Near-zero waits mean the prefetch
+        # ring is ahead of compute; sustained waits mean the loader is
+        # the bottleneck (the thing this pipeline exists to prevent).
+        # stats() reads LOADER-LOCAL metrics; the registry (global by
+        # default) additionally gets process-wide totals, which
+        # aggregate across loaders sharing it.
+        from .observability import get_registry
+        from .observability.metrics import Counter, Histogram
+        self._metrics = metrics if metrics is not None else get_registry()
+        self._m_wait = Histogram(
+            "data_load_wait_seconds",
+            help="training-loop stall per next_batch() call")
+        self._m_batches = Counter("data_batches_total")
+        self._g_wait = self._metrics.histogram(
+            "data_load_wait_seconds",
+            help="training-loop stall per next_batch() call (all "
+                 "loaders on this registry)")
+        self._g_batches = self._metrics.counter(
+            "data_batches_total", help="batches delivered (all loaders)")
 
     @property
     def native(self) -> bool:
@@ -177,9 +198,21 @@ class DataLoader:
     # -- iteration ---------------------------------------------------------
     def next_batch(self) -> Tuple[np.ndarray, np.ndarray, int]:
         """(images, labels, batch_index); endless, in batch order."""
-        if self.native:
-            return self._next_native()
-        return self._next_python()
+        t0 = time.perf_counter()
+        out = self._next_native() if self.native else self._next_python()
+        dt = time.perf_counter() - t0
+        self._m_wait.observe(dt)
+        self._m_batches.inc()
+        self._g_wait.observe(dt)
+        self._g_batches.inc()
+        return out
+
+    def stats(self) -> dict:
+        """Loader telemetry snapshot: batches delivered and the
+        load/wait latency summary."""
+        return {"batches": int(self._m_batches.value),
+                "native": self.native,
+                "load_wait": self._m_wait.summary()}
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         for _ in range(self.batches_per_epoch):

@@ -1,0 +1,43 @@
+"""apex_tpu.observability — unified telemetry subsystem.
+
+Three layers (docs/observability.md):
+
+1. **Metrics** — :class:`MetricsRegistry` of counters / gauges /
+   fixed-bucket histograms for host-side instrumentation, plus
+   :class:`DeviceMetrics` for training-step counters that accumulate as
+   jnp arrays *inside* the jitted step (zero host syncs per step; one
+   explicit fetch at ``flush()``).
+2. **Spans/events** — :class:`SpanRecorder` wall-clock ranges layered on
+   ``utils.profiler``'s nvtx-parity ranges; exports Chrome-trace JSON
+   and a JSONL event log.
+3. **Exporters** — schema-versioned JSONL (what ``bench.py`` emits),
+   Prometheus text exposition, Chrome trace.
+
+Wired consumers: ``serving.Engine``/``Seq2SeqEngine`` (enriched
+``stats()``), ``parallel.distributed`` (comm accounting),
+``amp`` (loss-scale/skip introspection + ``record_scaler``),
+``optimizers`` (grad-norm gauge via ``AmpOptimizer.step`` info),
+``data.DataLoader`` (host load/wait times), and ``bench.py``.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DeviceMetrics, get_registry, set_registry,
+                      DEFAULT_LATENCY_BUCKETS)
+from .tracing import (SpanRecorder, get_recorder, set_recorder, span,
+                      event, export_chrome_trace, export_jsonl)
+from .exporters import (SCHEMA_VERSION, JsonlExporter, prometheus_text,
+                        host_info, validate_bench_record,
+                        validate_bench_jsonl)
+from . import metrics
+from . import tracing
+from . import exporters
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DeviceMetrics",
+    "get_registry", "set_registry", "DEFAULT_LATENCY_BUCKETS",
+    "SpanRecorder", "get_recorder", "set_recorder", "span", "event",
+    "export_chrome_trace", "export_jsonl",
+    "SCHEMA_VERSION", "JsonlExporter", "prometheus_text", "host_info",
+    "validate_bench_record", "validate_bench_jsonl",
+    "metrics", "tracing", "exporters",
+]

@@ -1,0 +1,239 @@
+"""Exporters: schema-versioned JSONL, Prometheus text exposition.
+
+The JSONL exporter is the machine-readable telemetry trail the round-5
+VERDICT asked for: every emitted record carries ``schema_version``, the
+capture host, and a first-class boolean ``stale`` field (replacing the
+ad-hoc "STALE REPLAY" note strings as the *structured* staleness
+signal — the human-readable note stays for people reading artifacts).
+``bench.py`` routes every line through it, and
+``tests/ci/check_bench_schema.py`` validates the output against
+:func:`validate_bench_record`.
+
+Chrome-trace export lives on :class:`tracing.SpanRecorder`; this module
+adds the registry-wide surfaces: Prometheus text exposition for
+scrape-style consumers and a registry→JSONL dump.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import os
+import platform
+import socket
+import sys
+from typing import Any, Dict, IO, Iterable, List, Optional
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+__all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
+           "prometheus_text", "validate_bench_record",
+           "validate_bench_jsonl"]
+
+SCHEMA_VERSION = 1
+
+_host_info_cache: Optional[Dict[str, Any]] = None
+
+
+def host_info() -> Dict[str, Any]:
+    """Capture-host provenance stamped onto every exported record."""
+    global _host_info_cache
+    if _host_info_cache is None:
+        _host_info_cache = {
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "platform": sys.platform,
+            "python": platform.python_version(),
+        }
+    return dict(_host_info_cache)
+
+
+class JsonlExporter:
+    """Write records as schema-versioned JSON lines.
+
+    ``enrich`` fills only *missing* fields: a replayed record that
+    already carries ``stale: true`` / the capture host of the original
+    measurement keeps that provenance instead of being restamped.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 stream: Optional[IO[str]] = None):
+        if (path is None) == (stream is None):
+            raise ValueError("exactly one of path/stream required")
+        self._stream = stream
+        self._path = path
+        self._file: Optional[IO[str]] = None
+
+    @staticmethod
+    def enrich(record: Dict[str, Any], stale: bool = False
+               ) -> Dict[str, Any]:
+        out = dict(record)
+        out.setdefault("schema_version", SCHEMA_VERSION)
+        out.setdefault("host", host_info())
+        out.setdefault("stale", bool(stale))
+        out["stale"] = bool(out["stale"])
+        return out
+
+    def _out(self) -> IO[str]:
+        if self._stream is not None:
+            return self._stream
+        if self._file is None:
+            self._file = open(self._path, "a")
+        return self._file
+
+    def emit(self, record: Dict[str, Any], stale: bool = False
+             ) -> Dict[str, Any]:
+        line = self.enrich(record, stale=stale)
+        out = self._out()
+        out.write(json.dumps(line) + "\n")
+        out.flush()
+        return line
+
+    def emit_registry(self, registry: MetricsRegistry,
+                      **extra) -> List[Dict[str, Any]]:
+        """One record per metric (histograms as their summary)."""
+        lines = []
+        for m in registry.collect():
+            rec = {"metric": m.name, "kind": m.kind, **extra}
+            if isinstance(m, Histogram):
+                rec.update(m.summary())
+            else:
+                rec["value"] = m.value
+            lines.append(self.emit(rec))
+        return lines
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- Prometheus text exposition ------------------------------------------
+
+def _fmt_labels(label_set) -> str:
+    if not label_set:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in label_set) + "}"
+
+
+def _edge_str(e: float) -> str:
+    return repr(e) if e != int(e) else str(int(e))
+
+
+def _expose_one(lines: List[str], m, label_set=()):
+    if isinstance(m, Histogram):
+        acc = 0
+        with m._lock:
+            counts, total, n = list(m._counts), m._sum, m._count
+        for e, c in zip(m.edges, counts):
+            acc += c
+            ls = tuple(label_set) + (("le", _edge_str(e)),)
+            lines.append(f"{m.name}_bucket{_fmt_labels(ls)} {acc}")
+        ls = tuple(label_set) + (("le", "+Inf"),)
+        lines.append(f"{m.name}_bucket{_fmt_labels(ls)} {acc + counts[-1]}")
+        lines.append(f"{m.name}_sum{_fmt_labels(label_set)} {total}")
+        lines.append(f"{m.name}_count{_fmt_labels(label_set)} {n}")
+    else:
+        lines.append(f"{m.name}{_fmt_labels(label_set)} {m.value}")
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Registry contents in the Prometheus text exposition format
+    (labeled children exported under the parent name)."""
+    from .metrics import get_registry
+    reg = registry or get_registry()
+    lines: List[str] = []
+    for m in reg.collect():
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        children = m.children()
+        # a parent that only ever fans out to labeled children (bare
+        # value untouched) contributes no unlabeled sample
+        untouched = (m.count == 0 if isinstance(m, Histogram)
+                     else m.value == 0)
+        if not (children and untouched):
+            _expose_one(lines, m)
+        for key, child in sorted(children.items()):
+            _expose_one(lines, child, key)
+    return "\n".join(lines) + "\n"
+
+
+# -- bench record schema --------------------------------------------------
+
+def validate_bench_record(rec: Any) -> List[str]:
+    """Schema check for one bench JSONL record; returns a list of
+    problems (empty = valid).  Shared by the pytest coverage and the
+    tests/ci/check_bench_schema.py gate."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types, allow_none=False):
+        if key not in rec:
+            errs.append(f"missing required key {key!r}")
+            return None
+        v = rec[key]
+        if v is None and allow_none:
+            return v
+        if not isinstance(v, types) or isinstance(v, bool) != (types is bool):
+            errs.append(f"{key!r} must be {types}, got {type(v).__name__}")
+        return v
+
+    sv = need("schema_version", int)
+    if isinstance(sv, int) and not isinstance(sv, bool) and sv < 1:
+        errs.append(f"schema_version must be >= 1, got {sv}")
+    metric = need("metric", str)
+    if isinstance(metric, str) and not metric:
+        errs.append("metric must be non-empty")
+    need("stale", bool)
+    need("value", numbers.Number, allow_none=True)
+    need("unit", str, allow_none=True)
+    need("backend", str)
+    need("ndev", int)
+    need("arch", str)
+    host = need("host", dict)
+    if isinstance(host, dict):
+        if not isinstance(host.get("hostname"), str):
+            errs.append("host.hostname must be a string")
+        if not isinstance(host.get("pid"), int):
+            errs.append("host.pid must be an int")
+    for opt in ("note", "error", "recorded_at", "stale_recorded_at"):
+        if opt in rec and not isinstance(rec[opt], str):
+            errs.append(f"{opt!r} must be a string when present")
+    if "vs_baseline" in rec and rec["vs_baseline"] is not None \
+            and not isinstance(rec["vs_baseline"], numbers.Number):
+        errs.append("'vs_baseline' must be a number or null")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
+def validate_bench_jsonl(lines: Iterable[str]) -> List[str]:
+    """Validate a bench stdout stream: every non-empty line must parse
+    as JSON and pass the record schema."""
+    errs: List[str] = []
+    n = 0
+    for i, raw in enumerate(lines, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        n += 1
+        try:
+            rec = json.loads(raw)
+        except ValueError as e:
+            errs.append(f"line {i}: not JSON ({e})")
+            continue
+        for e in validate_bench_record(rec):
+            errs.append(f"line {i} ({rec.get('metric', '?')}): {e}")
+    if n == 0:
+        errs.append("no records found")
+    return errs

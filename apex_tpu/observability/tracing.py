@@ -1,0 +1,136 @@
+"""Span/event recorder: wall-clock ranges → Chrome trace / JSONL.
+
+Layered on ``apex_tpu.utils.profiler``: every :meth:`SpanRecorder.span`
+also opens the profiler's nvtx-parity range (``jax.named_scope`` +
+``jax.profiler.TraceAnnotation``), so a span shows up in xprof captures
+*and* in this recorder's exportable timeline.  The recorder itself is
+pure host-side bookkeeping — opening a span inside a jitted trace names
+the traced HLO but times only the (one-off) trace, so put spans around
+eager sections: admission, harvest, checkpointing, data loading.
+
+Exports:
+
+- **Chrome trace JSON** (``chrome://tracing`` / Perfetto): complete
+  events (``ph: "X"``, microsecond timestamps) plus instant events.
+- **JSONL event log**: one JSON object per event, machine-readable for
+  downstream analysis (the bench/CI side of the telemetry trail).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SpanRecorder", "get_recorder", "set_recorder", "span",
+           "event", "export_chrome_trace", "export_jsonl"]
+
+
+class SpanRecorder:
+    """Thread-safe span/event buffer with a per-recorder time origin."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._t0 = clock()
+        self._pid = os.getpid()
+
+    def _now_us(self) -> float:
+        return (self._clock() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Record a complete event for the enclosed block; also opens
+        the profiler range so xprof attribution matches this timeline.
+        Exception-safe and nestable (nesting renders as stacked slices
+        in the Chrome trace viewer)."""
+        from ..utils import profiler
+        tid = threading.get_ident()
+        begin = self._now_us()
+        with profiler.nvtx_range(name):
+            try:
+                yield self
+            finally:
+                end = self._now_us()
+                ev = {"name": name, "ph": "X", "ts": begin,
+                      "dur": max(end - begin, 0.0),
+                      "pid": self._pid, "tid": tid}
+                if attrs:
+                    ev["args"] = dict(attrs)
+                with self._lock:
+                    self._events.append(ev)
+
+    def event(self, name: str, **attrs):
+        """Instant (zero-duration) event — loss-scale changes, engine
+        admissions, flush points."""
+        ev = {"name": name, "ph": "i", "s": "t", "ts": self._now_us(),
+              "pid": self._pid, "tid": threading.get_ident()}
+        if attrs:
+            ev["args"] = dict(attrs)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    # -- exports -----------------------------------------------------------
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The Chrome trace-event JSON object (traceEvents array form)."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+    def export_jsonl(self, path: str) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+_global_recorder = SpanRecorder()
+
+
+def get_recorder() -> SpanRecorder:
+    return _global_recorder
+
+
+def set_recorder(recorder: SpanRecorder) -> SpanRecorder:
+    global _global_recorder
+    prev, _global_recorder = _global_recorder, recorder
+    return prev
+
+
+def span(name: str, **attrs):
+    """``with observability.span("checkpoint_save"): ...`` on the
+    process-wide default recorder."""
+    return _global_recorder.span(name, **attrs)
+
+
+def event(name: str, **attrs):
+    return _global_recorder.event(name, **attrs)
+
+
+def export_chrome_trace(path: str,
+                        recorder: Optional[SpanRecorder] = None) -> str:
+    return (recorder or _global_recorder).export_chrome_trace(path)
+
+
+def export_jsonl(path: str,
+                 recorder: Optional[SpanRecorder] = None) -> str:
+    return (recorder or _global_recorder).export_jsonl(path)

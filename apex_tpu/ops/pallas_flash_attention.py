@@ -44,6 +44,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax<0.5 compat: CompilerParams was still named TPUCompilerParams
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from .pallas_common import LANES, interpret
 
 _VMEM_BUDGET = 12 * 1024 * 1024

@@ -19,7 +19,8 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Optimizer", "SGD", "SGDState", "resolve_lr"]
+__all__ = ["Optimizer", "SGD", "SGDState", "resolve_lr",
+           "global_grad_norm"]
 
 Schedule = Union[float, Callable[[jax.Array], jax.Array]]
 
@@ -28,6 +29,19 @@ def resolve_lr(lr: Schedule, step: jax.Array) -> jax.Array:
     if callable(lr):
         return jnp.asarray(lr(step), jnp.float32)
     return jnp.asarray(lr, jnp.float32)
+
+
+def global_grad_norm(grads: Any) -> jax.Array:
+    """Global L2 norm over a gradient pytree (or flat buffer) as an fp32
+    device scalar — the observability gauge the amp step reports in its
+    info dict.  Pure jnp, so it composes with jit/shard_map; under
+    data-parallel the grads are already allreduced, so every replica
+    computes the same value with no extra collective."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
 
 
 class Optimizer:

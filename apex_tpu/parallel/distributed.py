@@ -69,7 +69,8 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          delay_allreduce: bool = False,
                          axis_index_groups: Optional[List[List[int]]] = None,
                          retain_buffers: Optional[list] = None,
-                         trigger_paths: Optional[set] = None) -> Any:
+                         trigger_paths: Optional[set] = None,
+                         comm_stats: Optional[list] = None) -> Any:
     """Bucketed gradient allreduce with the reference's semantics
     (allreduce_bucket, distributed.py:378-398).  Must run inside a context
     where ``axis_name`` is a mapped mesh axis.
@@ -80,7 +81,15 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     exist under XLA, so the faithful mapping is: the listed leaves mark
     *bucket boundaries* in tree order; each bucket is one psum the
     scheduler can overlap independently.  Paths are '/'-joined key paths
-    (e.g. 'layer1/conv/weight'); unknown paths raise."""
+    (e.g. 'layer1/conv/weight'); unknown paths raise.
+
+    ``comm_stats``: observability out-param — one dict per reduced
+    bucket ({dtype, comm_dtype, leaves, elements, bytes, cause, chunks})
+    appended at TRACE time (like ``retain_buffers``), i.e. once per
+    compiled step, describing what every execution of that step
+    communicates.  ``cause`` records why the bucket flushed: a trigger
+    boundary, ``delay_allreduce``, fitting under ``message_size``
+    (``single``), or the chunked-psum path."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -126,12 +135,16 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                                           comm.dtype)
 
             n = comm.shape[0]
+            nchunks = 1
             if delay_allreduce or trigger_paths or n <= message_size:
+                cause = ("trigger" if trigger_paths
+                         else "delay" if delay_allreduce else "single")
                 reduced = lax.psum(comm, axis_name,
                                    axis_index_groups=axis_index_groups)
             else:
                 # chunked psum: XLA schedules the pieces independently —
                 # the compiler-native form of the reference's bucket overlap
+                cause = "chunked"
                 nchunks = math.ceil(n / message_size)
                 pad = nchunks * message_size - n
                 padded = jnp.pad(comm, (0, pad))
@@ -139,6 +152,13 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                 reduced = lax.psum(chunks, axis_name,
                                    axis_index_groups=axis_index_groups)
                 reduced = reduced.reshape(-1)[:n]
+
+            if comm_stats is not None:
+                comm_stats.append({
+                    "dtype": str(dt), "comm_dtype": str(comm.dtype),
+                    "leaves": len(bucket), "elements": int(n),
+                    "bytes": int(n) * jnp.dtype(comm.dtype).itemsize,
+                    "cause": cause, "chunks": nchunks})
 
             if gradient_average:
                 post = world / gradient_predivide_factor if \
@@ -238,6 +258,10 @@ class DistributedDataParallel:
                     f"adasum=True replaces the psum pipeline; these "
                     f"options have no effect with it: {clashes}")
         self.allreduce_buffers: list = []
+        # trace-time comm accounting (observability): one record per
+        # bucket of the most recently traced allreduce — see
+        # allreduce_grads_tree(comm_stats=...)
+        self.last_comm_stats: list = []
 
     # -- forward passthrough (wrapper parity) ------------------------------
     def __call__(self, *args, **kwargs):
@@ -258,10 +282,19 @@ class DistributedDataParallel:
             if axis_index_groups is not None:
                 raise NotImplementedError(
                     "adasum over axis_index_groups is not wired")
+            leaves = jax.tree_util.tree_leaves(grads)
+            self.last_comm_stats = [{
+                "dtype": str(jnp.dtype(l.dtype)),
+                "comm_dtype": str(jnp.dtype(l.dtype)),
+                "leaves": 1, "elements": int(l.size),
+                "bytes": int(l.size) * jnp.dtype(l.dtype).itemsize,
+                "cause": "adasum", "chunks": 1} for l in leaves]
+            self._record_comm_stats()
             return adasum_grads(grads, self.axis_name)
         retain = [] if self.retain_allreduce_buffers else None
         triggers = (set(self.allreduce_trigger_params)
                     if self.allreduce_trigger_params else None)
+        comm_stats: list = []
         out = allreduce_grads_tree(
             grads, axis_name=self.axis_name, message_size=self.message_size,
             allreduce_always_fp32=self.allreduce_always_fp32,
@@ -269,10 +302,32 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
             delay_allreduce=self.delay_allreduce,
             axis_index_groups=axis_index_groups,
-            retain_buffers=retain, trigger_paths=triggers)
+            retain_buffers=retain, trigger_paths=triggers,
+            comm_stats=comm_stats)
         if retain is not None:
             self.allreduce_buffers = retain
+        self.last_comm_stats = comm_stats
+        self._record_comm_stats()
         return out
+
+    def _record_comm_stats(self):
+        """Fold the per-bucket accounting into the process observability
+        registry: per-(dtype, cause) bucket counts and per-dtype bytes.
+        Runs at TRACE time — totals count compiled traces, not executed
+        steps (per-step totals = these x steps on that executable); the
+        adaptive-summation / cross-replica sharding comm work in
+        PAPERS.md plans against exactly this per-bucket record."""
+        from ..observability import get_registry
+        reg = get_registry()
+        buckets = reg.counter(
+            "ddp_allreduce_buckets_total",
+            help="gradient allreduce buckets per compiled trace")
+        bts = reg.counter(
+            "ddp_allreduce_bytes_total",
+            help="one replica's communicated gradient bytes per trace")
+        for b in self.last_comm_stats:
+            buckets.labels(dtype=b["comm_dtype"], cause=b["cause"]).inc()
+            bts.labels(dtype=b["comm_dtype"]).inc(b["bytes"])
 
     def broadcast_params(self, params: Any) -> Any:
         """Rank-0 parameter broadcast (reference DDP does this at

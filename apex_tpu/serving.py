@@ -50,6 +50,7 @@ and a decoder self-attention cache instead of one decoder KV cache.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -59,8 +60,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from .models.speculative import _head_logits
+from .observability import MetricsRegistry
 
 __all__ = ["Engine", "Seq2SeqEngine"]
+
+# generated tokens/sec per request spans toy CPU engines (~1/s) to
+# hardware batch decode (~10k/s)
+_TPS_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                1000.0, 2000.0, 5000.0, 10000.0, 20000.0)
 
 
 class _Request:
@@ -72,6 +79,12 @@ class _Request:
         self.eos = eos
         self.generated: List[int] = []
         self.done = False
+        # telemetry timestamps (engine clock): queue entry, slot
+        # admission, first emitted token, finish
+        self.t_submit: Optional[float] = None
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_finish: Optional[float] = None
 
 
 class _SlotScheduler:
@@ -82,12 +95,75 @@ class _SlotScheduler:
     ``_check_prompt(prompt)`` (shape validation), plus their own
     ``step()``."""
 
-    def _init_scheduler(self, slots: int):
+    def _init_scheduler(self, slots: int,
+                        metrics: Optional[MetricsRegistry] = None):
         self._free = list(range(slots))
         self._waiting: List[Any] = []
         self._by_slot: Dict[int, _Request] = {}
         self._finished: Dict[int, _Request] = {}
         self._next_rid = 0
+        # -- telemetry: per-engine registry (pass one in to aggregate
+        # several engines or to export alongside other process metrics)
+        self._clock = time.perf_counter
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._submit_ts: Dict[int, float] = {}
+        # engine-LOCAL totals for stats(): registry counters are shared
+        # when several engines share a registry, and per-engine fields
+        # (notably prefix_hit_rate's denominator) must not conflate
+        # another engine's traffic
+        self._n_admitted = 0
+        self._n_tokens = 0
+        self._n_steps = 0
+        self._m_prefill = self.metrics.histogram(
+            "engine_prefill_seconds",
+            help="admission latency: prompt prefill + slot seed")
+        self._m_decode = self.metrics.histogram(
+            "engine_decode_step_seconds",
+            help="one batched decode tick incl. the host fetch")
+        self._m_queue_wait = self.metrics.histogram(
+            "engine_queue_wait_seconds",
+            help="submit-to-admission wait in the FIFO queue")
+        self._m_ttft = self.metrics.histogram(
+            "engine_ttft_seconds",
+            help="submit to first emitted token, per request")
+        self._m_tps = self.metrics.histogram(
+            "engine_request_tokens_per_sec", buckets=_TPS_BUCKETS,
+            help="generated tokens/sec per finished request")
+        self._m_admitted = self.metrics.counter("engine_admitted_total")
+        self._m_finished = self.metrics.counter("engine_finished_total")
+        self._m_tokens = self.metrics.counter("engine_tokens_total")
+        self._m_steps = self.metrics.counter("engine_decode_steps_total")
+
+    def _admit_timed(self, rid, *rest):
+        """All admissions (direct and queue-drained) route through here:
+        times the prefill/seed, stamps the request's lifecycle
+        timestamps, and feeds the admission histograms."""
+        t0 = self._clock()
+        self._admit(rid, *rest)
+        t1 = self._clock()
+        self._m_prefill.observe(t1 - t0)
+        self._m_admitted.inc()
+        self._n_admitted += 1
+        req = next((r for r in self._by_slot.values() if r.rid == rid),
+                   None)
+        if req is not None:
+            req.t_submit = self._submit_ts.pop(rid, t0)
+            req.t_admit = t1
+            self._m_queue_wait.observe(max(t0 - req.t_submit, 0.0))
+
+    def _record_step(self, t0: float) -> float:
+        """Per-tick bookkeeping after the device fetch; returns `now` so
+        harvest loops stamp first-token times without re-reading the
+        clock per request."""
+        now = self._clock()
+        self._m_decode.observe(now - t0)
+        self._m_steps.inc()
+        self._n_steps += 1
+        self.metrics.gauge("engine_live").set(len(self._by_slot))
+        self.metrics.gauge("engine_queue_depth").set(len(self._waiting))
+        self.metrics.gauge("engine_occupancy").set(
+            len(self._by_slot) / self.slots)
+        return now
 
     def _check_request(self, prompt, max_new_tokens, seed,
                        temperature):
@@ -129,8 +205,9 @@ class _SlotScheduler:
         self._check_request(prompt, max_new_tokens, seed, temperature)
         rid = self._next_rid
         self._next_rid += 1
-        self._admit(rid, prompt, max_new_tokens, eos_token_id, seed,
-                    temperature)
+        self._submit_ts.setdefault(rid, self._clock())
+        self._admit_timed(rid, prompt, max_new_tokens, eos_token_id, seed,
+                          temperature)
         return rid
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -146,19 +223,28 @@ class _SlotScheduler:
                                     eos_token_id, seed, temperature)
         rid = self._next_rid
         self._next_rid += 1
+        self._submit_ts[rid] = self._clock()
         self._waiting.append((rid, list(prompt), max_new_tokens,
                               eos_token_id, seed, temperature))
         return rid
 
     def _drain_queue(self):
         while self._free and self._waiting:
-            self._admit(*self._waiting.pop(0))
+            self._admit_timed(*self._waiting.pop(0))
 
     def _finish(self, slot, req):
         req.done = True
+        req.t_finish = self._clock()
         del self._by_slot[slot]
         self._free.append(slot)
         self._finished[req.rid] = req
+        self._m_finished.inc()
+        if req.t_first is not None and req.t_submit is not None:
+            self._m_ttft.observe(req.t_first - req.t_submit)
+        if req.generated and req.t_admit is not None:
+            dur = req.t_finish - req.t_admit
+            if dur > 0:
+                self._m_tps.observe(len(req.generated) / dur)
 
     def result(self, rid: int) -> List[int]:
         """Generated tokens (incl. EOS if hit) for a finished request."""
@@ -167,12 +253,31 @@ class _SlotScheduler:
     def live(self) -> int:
         return len(self._by_slot)
 
-    def stats(self) -> Dict[str, int]:
-        """Scheduler introspection snapshot."""
+    def stats(self) -> Dict[str, Any]:
+        """Scheduler + telemetry snapshot.  The four original counters
+        (live/waiting/free/finished) keep their flat-int shape; the
+        telemetry additions are occupancy ratios, monotonic totals, and
+        latency-histogram summaries ({count, sum, mean, p50, p99} — the
+        percentiles are fixed-bucket estimates).  ``queue_depth``
+        mirrors ``waiting`` under the name the metrics registry uses.
+        The scalar totals are engine-LOCAL; the histogram summaries come
+        from ``self.metrics``, so with an explicitly shared registry
+        they aggregate every engine sharing it."""
         return {"live": len(self._by_slot),
                 "waiting": len(self._waiting),
                 "free": len(self._free),
-                "finished": len(self._finished)}
+                "finished": len(self._finished),
+                "slots": self.slots,
+                "occupancy": len(self._by_slot) / self.slots,
+                "queue_depth": len(self._waiting),
+                "admitted": self._n_admitted,
+                "tokens_generated": self._n_tokens,
+                "decode_steps": self._n_steps,
+                "prefill_latency": self._m_prefill.summary(),
+                "decode_step_latency": self._m_decode.summary(),
+                "queue_wait": self._m_queue_wait.summary(),
+                "ttft": self._m_ttft.summary(),
+                "request_tokens_per_sec": self._m_tps.summary()}
 
 
 class Engine(_SlotScheduler):
@@ -181,7 +286,8 @@ class Engine(_SlotScheduler):
                  gamma: int = 4, temperature: float = 0.0,
                  top_k=None, top_p=None, rng=None,
                  prefix_pool: int = 0, prefix_chunk: int = 32,
-                 rolling: bool = False):
+                 rolling: bool = False,
+                 metrics: Optional[MetricsRegistry] = None):
         """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
         decoding: one ``spec_iteration`` (models/speculative.py) per
         tick, so every live request advances 1..gamma+1 tokens per
@@ -278,7 +384,7 @@ class Engine(_SlotScheduler):
                       else model.init_cache(slots, dtype=cache_dtype))
         self.d_cache = (draft.init_cache(slots, dtype=cache_dtype)
                         if draft is not None else None)
-        self._init_scheduler(slots)
+        self._init_scheduler(slots, metrics)
 
         def _seed(m, ps, cache, slot, row):
             row_cache = m.prefill_cache(ps, row[None, :],
@@ -502,6 +608,7 @@ class Engine(_SlotScheduler):
             # [L, prompt_len) through decode_chunk on that row, scatter
             # it into the slot
             self.prefix_hits += 1
+            self.metrics.counter("engine_prefix_hits_total").inc()
             C = self.prefix_chunk
             for attr, chunk_fn in self._chunk_row.items():
                 pool = (self._pool_cache if attr == "cache"
@@ -542,6 +649,7 @@ class Engine(_SlotScheduler):
         included, is still reported and recorded)."""
         if not self._by_slot:
             return {}
+        t0 = self._clock()
         if self.draft is not None:
             old_len = np.asarray(self.cur_len)
             (self.ids, self.cur_len, self.cache,
@@ -561,6 +669,7 @@ class Engine(_SlotScheduler):
                                            self._slot_temp)
             toks = np.asarray(nxt)
             emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
+        now = self._record_step(t0)
         out: Dict[int, Any] = {}
         for slot, req in list(self._by_slot.items()):
             toks = emitted[slot]
@@ -570,6 +679,10 @@ class Engine(_SlotScheduler):
             req.generated.extend(toks)
             if toks:
                 out[req.rid] = list(toks)
+                if req.t_first is None:
+                    req.t_first = now
+                self._m_tokens.inc(len(toks))
+                self._n_tokens += len(toks)
             hit_eos = req.eos is not None and req.eos in toks
             full = (len(req.generated) >= req.max_new
                     or req.prompt_len + len(req.generated)
@@ -581,9 +694,15 @@ class Engine(_SlotScheduler):
         self._drain_queue()
         return out
 
-    def stats(self) -> Dict[str, int]:
-        """Base snapshot plus prefix-splice admissions so far."""
-        return {**super().stats(), "prefix_hits": self.prefix_hits}
+    def stats(self) -> Dict[str, Any]:
+        """Base snapshot plus prefix-cache effectiveness: splice
+        admissions so far and the hit rate over all admissions (0.0 on
+        an engine with no admissions yet or no prefix pool)."""
+        s = super().stats()
+        s["prefix_hits"] = self.prefix_hits
+        s["prefix_hit_rate"] = (self.prefix_hits / s["admitted"]
+                                if s["admitted"] else 0.0)
+        return s
 
 
 class Seq2SeqEngine(_SlotScheduler):
@@ -609,7 +728,8 @@ class Seq2SeqEngine(_SlotScheduler):
     """
 
     def __init__(self, model, params, slots: int, src_len: int,
-                 max_new_cap: int, cache_dtype=None):
+                 max_new_cap: int, cache_dtype=None,
+                 metrics: Optional[MetricsRegistry] = None):
         self.model = model
         self.params = params
         self.slots = slots
@@ -621,7 +741,7 @@ class Seq2SeqEngine(_SlotScheduler):
                                               max_new_cap, cache_dtype)
         self.out = jnp.zeros((slots, max_new_cap), jnp.int32)
         self.n_new = jnp.zeros((slots,), jnp.int32)
-        self._init_scheduler(slots)
+        self._init_scheduler(slots, metrics)
 
         self._seed = jax.jit(
             lambda st, slot, row, n: model.seed_slot_seq2seq(
@@ -670,14 +790,20 @@ class Seq2SeqEngine(_SlotScheduler):
         immediately."""
         if not self._by_slot:
             return {}
+        t0 = self._clock()
         self.state, self.out, self.n_new, nxt = self._step(
             self.state, self.out, self.n_new)
         toks = np.asarray(nxt)
+        now = self._record_step(t0)
         out: Dict[int, Any] = {}
         for slot, req in list(self._by_slot.items()):
             t = int(toks[slot])
             req.generated.append(t)
             out[req.rid] = [t]
+            if req.t_first is None:
+                req.t_first = now
+            self._m_tokens.inc()
+            self._n_tokens += 1
             hit_eos = req.eos is not None and t == req.eos
             if hit_eos or len(req.generated) >= req.max_new:
                 self._finish(slot, req)

@@ -24,7 +24,8 @@ from typing import Optional
 import jax
 
 __all__ = ["range_push", "range_pop", "nvtx_range", "annotate",
-           "start_profile", "stop_profile", "profile", "AverageMeter"]
+           "start_profile", "stop_profile", "profile", "profiling_active",
+           "AverageMeter"]
 
 _tls = threading.local()
 
@@ -81,28 +82,53 @@ def annotate(name: Optional[str] = None):
     return deco
 
 
-_trace_active = False
+# Trace-window state: jax.profiler.start_trace is a process-wide
+# singleton, so concurrent/nested windows must be refcounted under a
+# lock — the bare `_trace_active` bool raced two threads into a double
+# start_trace (RuntimeError) and a nested profile() used to stop the
+# OUTER window on inner exit.
+_trace_lock = threading.Lock()
+_trace_depth = 0
 
 
 def start_profile(logdir: str = "/tmp/apex_tpu_profile") -> None:
     """Begin an xprof trace window (cudaProfilerStart parity,
-    main_amp.py:329)."""
-    global _trace_active
-    if not _trace_active:
-        jax.profiler.start_trace(logdir)
-        _trace_active = True
+    main_amp.py:329).  Reentrant: only the outermost call starts the
+    trace; nested calls increment the window refcount and no-op."""
+    global _trace_depth
+    with _trace_lock:
+        if _trace_depth == 0:
+            # start first, increment after: a failed start_trace (e.g. a
+            # foreign trace already active) must not leave a phantom
+            # refcount that makes every later call a silent no-op
+            jax.profiler.start_trace(logdir)
+        _trace_depth += 1
 
 
 def stop_profile() -> None:
-    """End the trace window (cudaProfilerStop parity, main_amp.py:351)."""
-    global _trace_active
-    if _trace_active:
-        jax.profiler.stop_trace()
-        _trace_active = False
+    """End the trace window (cudaProfilerStop parity, main_amp.py:351).
+    Only the outermost matching call stops the trace; an unmatched stop
+    is a no-op."""
+    global _trace_depth
+    with _trace_lock:
+        if _trace_depth == 0:
+            return
+        _trace_depth -= 1
+        if _trace_depth == 0:
+            jax.profiler.stop_trace()
+
+
+def profiling_active() -> bool:
+    """True while a trace window is open (any nesting depth)."""
+    with _trace_lock:
+        return _trace_depth > 0
 
 
 @contextlib.contextmanager
 def profile(logdir: str = "/tmp/apex_tpu_profile"):
+    """Context-manager trace window; nesting-safe — an inner profile()
+    joins the outer window instead of racing jax.profiler.start_trace
+    or closing the outer window early."""
     start_profile(logdir)
     try:
         yield

@@ -1,0 +1,595 @@
+"""Telemetry subsystem: metrics registry (host + device-resident),
+span tracing / Chrome-trace export, exporters, engine stats, and the
+bench JSONL schema."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import models, observability as obs, serving
+from apex_tpu.observability import exporters
+
+
+# -- host metrics ---------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("c_total")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    g.set(7.0)
+    assert g.value == 7.0
+    # get-or-create returns the same object; kind clash raises
+    assert reg.counter("c_total") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("c_total")
+
+
+def test_counter_labels_accumulate_separately():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("bytes_total")
+    c.labels(dtype="float32").inc(100)
+    c.labels(dtype="bfloat16").inc(7)
+    c.labels(dtype="float32").inc(1)
+    assert c.labels(dtype="float32").value == 101
+    assert c.labels(dtype="bfloat16").value == 7
+
+
+def test_histogram_bucket_edges_le_semantics():
+    """Prometheus ``le``: an observation exactly on an edge lands in
+    that edge's bucket, strictly-greater goes to the next."""
+    h = obs.Histogram("h", buckets=(1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 1.0000001, 2.0, 5.0, 5.1):
+        h.observe(v)
+    cum = h.cumulative()
+    assert cum["1.0"] == 2          # 0.5 and exactly-1.0
+    assert cum["2.0"] == 4          # + 1.0000001 and exactly-2.0
+    assert cum["5.0"] == 5          # + exactly-5.0
+    assert cum["+Inf"] == 6         # + 5.1 overflow
+    assert h.count == 6
+    assert h.sum == pytest.approx(14.6000001)
+    s = h.summary()
+    assert s["count"] == 6 and s["mean"] == pytest.approx(h.sum / 6)
+    assert h.percentile(0.0) <= h.percentile(0.99) <= 5.0
+    with pytest.raises(ValueError, match="increasing"):
+        obs.Histogram("bad", buckets=(2.0, 1.0))
+
+
+def test_histogram_empty_summary():
+    h = obs.Histogram("h")
+    assert h.summary() == {"count": 0, "sum": 0.0, "mean": None,
+                           "p50": None, "p99": None}
+
+
+def test_registry_thread_safety():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("n")
+    h = reg.histogram("lat", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000 and h.cumulative()["0.5"] == 8000
+
+
+# -- device metrics -------------------------------------------------------
+
+def test_device_counters_accumulate_under_jit_single_fetch(monkeypatch):
+    dm = obs.DeviceMetrics(counters=("steps", "overflows"),
+                           gauges=("scale",))
+    st = dm.init()
+
+    @jax.jit
+    def step(st, ovf):
+        st = dm.inc(st, "steps")
+        st = dm.inc(st, "overflows", ovf)
+        st = dm.set(st, "scale", 2.0 ** 10)
+        return st
+
+    for i in range(5):
+        st = step(st, jnp.asarray(float(i == 2)))
+
+    # counters stay on device until flush...
+    assert all(isinstance(v, jax.Array) for v in st.values())
+    # ...which is ONE device_get of the whole tree
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: calls.append(1) or real(x))
+    reg = obs.MetricsRegistry()
+    vals = dm.flush(st, reg)
+    assert len(calls) == 1
+    assert vals["steps"] == 5.0 and vals["overflows"] == 1.0
+    assert vals["scale"] == 2.0 ** 10
+    # host registry now mirrors the device totals; repeated flushes are
+    # idempotent (set_total, not +=)
+    assert reg.counter("steps").value == 5.0
+    dm.flush(st, reg)
+    assert reg.counter("steps").value == 5.0
+
+
+def test_device_metrics_jaxpr_is_host_transfer_free():
+    dm = obs.DeviceMetrics(counters=("n",), histograms={"h": (1.0, 2.0)})
+    st = dm.init()
+
+    def step(st):
+        st = dm.inc(st, "n", 3.0)
+        st = dm.observe(st, "h", 1.5)
+        return st
+
+    jpr = jax.make_jaxpr(step)(st)
+    prims = {e.primitive.name for e in jpr.jaxpr.eqns}
+    assert not prims & {"pure_callback", "io_callback", "debug_callback",
+                        "outfeed", "infeed", "device_put"}
+
+
+def test_device_metrics_under_shard_map():
+    """Per-device increments + an in-graph psum: the flushed counter is
+    the global total, with the state replicated across the mesh."""
+    dm = obs.DeviceMetrics(counters=("tokens",))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def step(st, x):
+        return dm.inc(st, "tokens", lax.psum(jnp.sum(x), "data"))
+
+    mapped = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False))
+    st = dm.init()
+    x = jnp.ones((8, 4), jnp.float32)
+    for _ in range(3):
+        st = mapped(st, x)
+    assert dm.flush(st, obs.MetricsRegistry())["tokens"] == 3 * 32
+
+
+def test_device_histogram_buckets():
+    dm = obs.DeviceMetrics(histograms={"lat": (1.0, 2.0, 5.0)})
+    st = dm.init()
+
+    @jax.jit
+    def step(st, v):
+        return dm.observe(st, "lat", v)
+
+    for v in (0.5, 1.0, 3.0, 100.0):
+        st = step(st, jnp.asarray(v))
+    reg = obs.MetricsRegistry()
+    dm.flush(st, reg)
+    h = reg.histogram("lat", buckets=(1.0, 2.0, 5.0))
+    assert h.cumulative() == {"1.0": 2, "2.0": 2, "5.0": 3, "+Inf": 4}
+    assert h.sum == pytest.approx(104.5)
+
+
+def test_device_metrics_name_validation():
+    dm = obs.DeviceMetrics(counters=("a",), gauges=("b",))
+    st = dm.init()
+    with pytest.raises(KeyError):
+        dm.inc(st, "b")           # gauge is not a counter
+    with pytest.raises(KeyError):
+        dm.set(st, "nope", 1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        obs.DeviceMetrics(counters=("x",), gauges=("x",))
+
+
+# -- tracing --------------------------------------------------------------
+
+def test_chrome_trace_export_well_formed(tmp_path):
+    rec = obs.SpanRecorder()
+    with rec.span("outer", phase="test"):
+        with rec.span("inner"):
+            pass
+    rec.event("mark", step=3)
+    path = str(tmp_path / "trace.json")
+    rec.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    assert [e["name"] for e in evs] == ["inner", "outer", "mark"]
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], float) and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    outer = evs[1]
+    inner = evs[0]
+    # nesting: inner lies within outer's span
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"phase": "test"}
+    assert evs[2]["args"] == {"step": 3}
+
+
+def test_jsonl_event_export(tmp_path):
+    rec = obs.SpanRecorder()
+    with rec.span("a"):
+        pass
+    rec.event("b")
+    path = str(tmp_path / "events.jsonl")
+    rec.export_jsonl(path)
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert [ln["name"] for ln in lines] == ["a", "b"]
+    rec.clear()
+    assert rec.events() == []
+
+
+def test_span_exception_safe():
+    rec = obs.SpanRecorder()
+    with pytest.raises(RuntimeError):
+        with rec.span("boom"):
+            raise RuntimeError("x")
+    assert [e["name"] for e in rec.events()] == ["boom"]
+
+
+# -- exporters ------------------------------------------------------------
+
+def test_prometheus_text_exposition():
+    reg = obs.MetricsRegistry()
+    reg.counter("req_total", help="requests").inc(3)
+    reg.gauge("depth").set(2)
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    b = reg.counter("bytes_total")
+    b.labels(dtype="float32").inc(64)
+    text = exporters.prometheus_text(reg)
+    assert "# HELP req_total requests" in text
+    assert "# TYPE req_total counter" in text
+    assert "req_total 3.0" in text
+    assert "depth 2.0" in text
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    assert "lat_count 2" in text
+    assert 'bytes_total{dtype="float32"} 64.0' in text
+
+
+def test_jsonl_exporter_enrich_and_emit(tmp_path):
+    path = str(tmp_path / "out.jsonl")
+    with exporters.JsonlExporter(path=path) as ex:
+        line = ex.emit({"metric": "m", "value": 1.0, "unit": "x"})
+        # replayed record keeps its own provenance
+        replay = ex.emit({"metric": "m2", "value": 2.0, "stale": True,
+                          "host": {"hostname": "cap", "pid": 1}})
+    assert line["schema_version"] == exporters.SCHEMA_VERSION
+    assert line["stale"] is False
+    assert line["host"]["hostname"]
+    assert replay["stale"] is True
+    assert replay["host"] == {"hostname": "cap", "pid": 1}
+    with open(path) as f:
+        assert len(f.readlines()) == 2
+
+
+def test_bench_record_schema_validation():
+    good = exporters.JsonlExporter.enrich(
+        {"metric": "m", "value": 1.5, "unit": "x", "vs_baseline": None,
+         "backend": "cpu", "ndev": 8, "arch": "cpu"})
+    assert exporters.validate_bench_record(good) == []
+    # error lines (value null) are valid
+    err_line = exporters.JsonlExporter.enrich(
+        {"metric": "m", "value": None, "unit": None, "vs_baseline": None,
+         "backend": "cpu", "ndev": 8, "arch": "cpu", "error": "boom"})
+    assert exporters.validate_bench_record(err_line) == []
+    # missing stale / wrong types are caught
+    bad = dict(good)
+    del bad["stale"]
+    assert any("stale" in e for e in exporters.validate_bench_record(bad))
+    bad = dict(good, value="fast")
+    assert any("value" in e for e in exporters.validate_bench_record(bad))
+    bad = dict(good, schema_version=0)
+    assert any("schema_version" in e
+               for e in exporters.validate_bench_record(bad))
+    assert exporters.validate_bench_record([1, 2]) != []
+
+
+def test_bench_emits_schema_valid_jsonl(tmp_path):
+    """bench.py's emit/replay paths produce schema-valid lines: enrich a
+    fresh line, save it to a record, and validate the stale replay."""
+    import bench
+    fresh = exporters.JsonlExporter.enrich(
+        {"metric": bench.HEADLINE_METRIC, "value": 1830.0,
+         "unit": "images/sec/chip", "vs_baseline": 11.7,
+         "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite"})
+    assert exporters.validate_bench_record(fresh) == []
+    p = str(tmp_path / "rec.json")
+    bench.save_tpu_record([fresh], path=p, now="2026-07-30T04:55:00Z")
+    rec = bench.load_tpu_record(path=p)
+    replayed = [exporters.JsonlExporter.enrich(ln)
+                for ln in bench.stale_lines(rec)]
+    assert exporters.validate_bench_jsonl(
+        [json.dumps(ln) for ln in replayed]) == []
+    assert replayed[-1]["stale"] is True
+    assert replayed[-1]["metric"] == bench.HEADLINE_METRIC
+
+
+def test_check_bench_schema_cli(tmp_path):
+    """The tests/ci gate accepts a valid stream and rejects a broken
+    one."""
+    import subprocess
+    import sys
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "tests", "ci", "check_bench_schema.py")
+    good = json.dumps(exporters.JsonlExporter.enrich(
+        {"metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+         "ndev": 8, "arch": "cpu"}))
+    r = subprocess.run([sys.executable, script], input=good + "\n",
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([sys.executable, script],
+                       input='{"metric": "m"}\n',
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+
+
+# -- engine telemetry -----------------------------------------------------
+
+def _gpt(seed=0):
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=24,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(seed))
+    return m, params
+
+
+def test_engine_stats_enriched_fields():
+    m, params = _gpt()
+    eng = serving.Engine(m, params, slots=2, buf_len=24)
+    rng = np.random.RandomState(0)
+    rids = [eng.submit(list(rng.randint(0, 64, 5)), max_new_tokens=4)
+            for _ in range(3)]                  # 3rd queues (2 slots)
+    s = eng.stats()
+    assert s["queue_depth"] == s["waiting"] == 1
+    assert s["occupancy"] == 1.0 and s["slots"] == 2
+    assert s["admitted"] == 2
+    assert s["prefill_latency"]["count"] == 2
+    while eng.live() or eng.stats()["waiting"]:
+        eng.step()
+    s = eng.stats()
+    assert s["finished"] == 3 and s["admitted"] == 3
+    assert s["tokens_generated"] == 12
+    assert s["decode_steps"] == s["decode_step_latency"]["count"] > 0
+    assert s["ttft"]["count"] == 3 and s["ttft"]["mean"] > 0
+    assert s["request_tokens_per_sec"]["count"] == 3
+    assert s["queue_wait"]["count"] == 3
+    assert s["prefix_hits"] == 0 and s["prefix_hit_rate"] == 0.0
+    for rid in rids:
+        assert len(eng.result(rid)) == 4
+
+
+def test_engine_stats_prefix_cache_hit_rate():
+    m, params = _gpt(1)
+    eng = serving.Engine(m, params, slots=2, buf_len=24, prefix_pool=1)
+    rng = np.random.RandomState(1)
+    pref = list(rng.randint(0, 64, 8))
+    eng.register_prefix(pref)
+    eng.add_request(pref + list(rng.randint(0, 64, 3)), max_new_tokens=2)
+    eng.add_request(list(rng.randint(0, 64, 6)), max_new_tokens=2)
+    while eng.live():
+        eng.step()
+    s = eng.stats()
+    assert s["prefix_hits"] == 1 and s["admitted"] == 2
+    assert s["prefix_hit_rate"] == 0.5
+    assert eng.metrics.counter("engine_prefix_hits_total").value == 1
+
+
+def test_engine_stats_rolling_mode():
+    cfg = models.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=16,
+        sliding_window=6, tie_word_embeddings=True)
+    m = models.Llama(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    eng = serving.Engine(m, params, slots=2, buf_len=16, rolling=True)
+    rng = np.random.RandomState(0)
+    eng.add_request(list(rng.randint(0, 64, 4)), max_new_tokens=3)
+    while eng.live():
+        eng.step()
+    s = eng.stats()
+    assert s["finished"] == 1 and s["tokens_generated"] == 3
+    assert s["prefill_latency"]["count"] == 1
+    assert s["ttft"]["count"] == 1
+
+
+def test_seq2seq_engine_stats():
+    cfg = models.T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                          num_layers=2, num_heads=4, dropout_rate=0.0,
+                          relative_attention_num_buckets=8,
+                          relative_attention_max_distance=16)
+    m = models.T5(cfg)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    eng = serving.Seq2SeqEngine(m, params, slots=1, src_len=8,
+                                max_new_cap=4)
+    eng.submit([3, 4, 5], max_new_tokens=3)
+    eng.submit([6, 7], max_new_tokens=2)       # queues behind slot 0
+    while eng.live() or eng.stats()["waiting"]:
+        eng.step()
+    s = eng.stats()
+    assert s["finished"] == 2 and s["tokens_generated"] == 5
+    assert s["ttft"]["count"] == 2
+    assert s["queue_wait"]["count"] == 2
+    # the queued request waited at least one decode tick
+    assert s["queue_wait"]["sum"] > 0
+
+
+def test_engine_custom_metrics_registry():
+    m, params = _gpt(2)
+    reg = obs.MetricsRegistry()
+    eng = serving.Engine(m, params, slots=1, buf_len=24, metrics=reg)
+    eng.add_request([1, 2, 3], max_new_tokens=2)
+    while eng.live():
+        eng.step()
+    assert eng.metrics is reg
+    assert reg.counter("engine_tokens_total").value == 2
+
+
+# -- amp / optimizer / profiler satellites --------------------------------
+
+def test_amp_scaler_introspection():
+    from apex_tpu import amp, optimizers as opts
+    from apex_tpu import nn
+
+    class Lin(nn.Module):
+        def init(self, key):
+            return {"w": jnp.ones((4, 4), jnp.float32)}, ()
+
+        def apply(self, p, x, state=(), train=False):
+            return x @ p["w"], state
+
+    model, opt = amp.initialize(Lin(), opts.FusedAdam(1e-3),
+                                opt_level="O2", half_dtype="float16",
+                                verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    assert amp.current_loss_scale(ost) == 2.0 ** 16
+    assert amp.steps_skipped(ost) == 0
+    st = amp.amp_stats(ost)
+    assert st["num_losses"] == 1
+    assert st["per_loss"][0]["loss_scale"] == 2.0 ** 16
+    # overflow: scale halves, skip count exposed through the frontend
+    g = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, jnp.inf, jnp.float32), params)
+    _, ost2, info = opt.step(params, ost, g)
+    assert amp.steps_skipped(ost2) == 1
+    assert amp.current_loss_scale(ost2) == 2.0 ** 15
+    # registry recording (loss-scale timeline point)
+    reg = obs.MetricsRegistry()
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        amp.record_scaler(ost2, registry=reg, step=1, emit_event=True)
+    finally:
+        obs.set_recorder(prev)
+    assert reg.gauge("amp_loss_scale").value == 2.0 ** 15
+    assert reg.counter("amp_steps_skipped_total").value == 1
+    ev = rec.events()[-1]
+    assert ev["name"] == "amp_loss_scale" and ev["args"]["step"] == 1
+    with pytest.raises(TypeError):
+        amp.amp_stats({"not": "an opt state"})
+
+
+def test_step_info_grad_norm():
+    from apex_tpu import amp, optimizers as opts
+    from apex_tpu import nn
+
+    class Lin(nn.Module):
+        def init(self, key):
+            return {"w": jnp.ones((3,), jnp.float32)}, ()
+
+        def apply(self, p, x, state=(), train=False):
+            return x * p["w"], state
+
+    model, opt = amp.initialize(Lin(), opts.FusedAdam(1e-3),
+                                opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    g = {"w": jnp.asarray([3.0, 4.0, 0.0], jnp.bfloat16)}
+    _, _, info = opt.step(params, ost, g)
+    assert float(info["grad_norm"]) == pytest.approx(5.0, rel=1e-3)
+    assert float(opts.global_grad_norm(
+        {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])})) == \
+        pytest.approx(5.0)
+    assert float(opts.global_grad_norm({})) == 0.0
+
+
+def test_profiler_nesting_and_threads(monkeypatch):
+    """Nested profile() must not stop the outer window; concurrent
+    start/stop must produce exactly one start_trace/stop_trace pair."""
+    from apex_tpu.utils import profiler
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append("stop"))
+    with profiler.profile("/tmp/x"):
+        assert profiler.profiling_active()
+        with profiler.profile("/tmp/x"):   # nested: must no-op cleanly
+            assert calls == ["start"]
+        assert calls == ["start"]          # inner exit didn't stop it
+        assert profiler.profiling_active()
+    assert calls == ["start", "stop"]
+    assert not profiler.profiling_active()
+    profiler.stop_profile()                # unmatched stop: no-op
+    assert calls == ["start", "stop"]
+
+    # hammer it from 8 threads: starts/stops stay balanced, never nested
+    calls.clear()
+    def work():
+        for _ in range(50):
+            with profiler.profile("/tmp/x"):
+                pass
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not profiler.profiling_active()
+    assert calls.count("start") == calls.count("stop")
+    depth = 0
+    for c in calls:
+        depth += 1 if c == "start" else -1
+        assert depth in (0, 1)             # never two open windows
+    assert depth == 0
+
+
+def test_data_loader_records_wait_times():
+    from apex_tpu.data import DataLoader
+    reg = obs.MetricsRegistry()
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (16, 8, 8, 3)).astype(np.uint8)
+    lbls = rng.randint(0, 10, 16)
+    dl = DataLoader(imgs, lbls, batch_size=4, shuffle=False, native=False,
+                    metrics=reg)
+    for _ in range(3):
+        dl.next_batch()
+    s = dl.stats()
+    assert s["batches"] == 3
+    assert s["load_wait"]["count"] == 3 and s["load_wait"]["sum"] >= 0
+    assert reg.counter("data_batches_total").value == 3
+
+
+def test_ddp_comm_stats_recorded():
+    from apex_tpu import parallel
+    ddp = parallel.DistributedDataParallel(message_size=100)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    grads = {"a": jnp.ones((300,), jnp.float32),
+             "b": jnp.ones((10,), jnp.bfloat16)}
+
+    def step(g):
+        return ddp.allreduce_grads(g)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False))(grads)
+    assert float(out["a"][0]) == 1.0    # psum(1)*8 / world (averaged)
+    by_dtype = {b["dtype"]: b for b in ddp.last_comm_stats}
+    assert by_dtype["float32"]["cause"] == "chunked"
+    assert by_dtype["float32"]["chunks"] == 3
+    assert by_dtype["float32"]["bytes"] == 300 * 4
+    assert by_dtype["bfloat16"]["cause"] == "single"
+    assert by_dtype["bfloat16"]["bytes"] == 10 * 2
+    # folded into the process registry under (dtype, cause) labels
+    reg = obs.get_registry()
+    c = reg.counter("ddp_allreduce_buckets_total")
+    assert c.labels(dtype="float32", cause="chunked").value >= 1
+    assert reg.counter("ddp_allreduce_bytes_total").labels(
+        dtype="float32").value >= 1200

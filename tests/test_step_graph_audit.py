@@ -21,15 +21,19 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from apex_tpu import amp, optimizers, parallel, models
+from apex_tpu import amp, observability, optimizers, parallel, models
 from apex_tpu.nn import functional as F
 
 
 def _traced_step(channels_last=False, input_format="NCHW", stem="conv7",
-                 B=8, image=32):
+                 B=8, image=32, telemetry=False):
     """Trace the REAL DDP train step — shard_map over the 8-device CPU
     mesh with the grad allreduce inside — so the audit covers the same
-    graph bench.py's headline and the imagenet example execute."""
+    graph bench.py's headline and the imagenet example execute.
+
+    ``telemetry=True`` threads an observability.DeviceMetrics state
+    through the step carry (step/overflow counters, loss-scale and
+    grad-norm gauges) — the fully-instrumented shape of the hot loop."""
     from jax.sharding import Mesh, PartitionSpec as P
 
     model, opt = amp.initialize(
@@ -44,9 +48,15 @@ def _traced_step(channels_last=False, input_format="NCHW", stem="conv7",
         else (B, image, image, 3)
     x = jnp.asarray(rng.randn(*shape), jnp.float32)
     y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+    dm = observability.DeviceMetrics(
+        counters=("steps", "overflows"),
+        gauges=("loss_scale", "grad_norm")) if telemetry else None
 
     def step(state, batch):
-        params, bn, ost = state
+        if telemetry:
+            params, bn, ost, tele = state
+        else:
+            params, bn, ost = state
         xb, yb = batch
 
         def loss_fn(p):
@@ -55,14 +65,21 @@ def _traced_step(channels_last=False, input_format="NCHW", stem="conv7",
 
         loss, nb, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
         g = ddp.allreduce_grads(g)
-        params, ost2, _ = opt.step(params, ost, g)
+        params, ost2, info = opt.step(params, ost, g)
+        if telemetry:
+            tele = dm.inc(tele, "steps")
+            tele = dm.inc(tele, "overflows", info["found_inf"])
+            tele = dm.set(tele, "loss_scale", info["loss_scale"])
+            tele = dm.set(tele, "grad_norm", info["grad_norm"])
+            return (params, nb, ost2, tele), jax.lax.pmean(loss, "data")
         return (params, nb, ost2), jax.lax.pmean(loss, "data")
 
+    state = (params, bn, ost) + ((dm.init(),) if telemetry else ())
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"), P("data"))),
                            out_specs=(P(), P()), check_vma=False)
-    return jax.make_jaxpr(mapped)((params, bn, ost), (x, y))
+    return jax.make_jaxpr(mapped)(state, (x, y))
 
 
 def _walk(jaxpr):
@@ -126,6 +143,40 @@ def test_o2_s2d_nhwc_step_convs_bf16_and_transpose_free():
     assert len(s2d_rearranges) <= 1, (
         f"s2d rearrange should appear once (forward), got "
         f"{len(s2d_rearranges)}")
+
+
+# -- telemetry ------------------------------------------------------------
+
+# primitives that move data across the host boundary: any of these inside
+# the step jaxpr means a per-iteration host sync — the exact cost the
+# device-resident scaler (and now the device-resident telemetry) exists
+# to avoid
+_HOST_TRANSFER_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                        "callback", "outfeed", "infeed", "device_put"}
+
+
+def _host_transfers(jpr):
+    return [e.primitive.name for e in _walk(jpr.jaxpr)
+            if e.primitive.name in _HOST_TRANSFER_PRIMS]
+
+
+def test_telemetry_step_adds_zero_host_transfers():
+    """Enabling DeviceMetrics telemetry on the jitted DDP+amp-O2 train
+    step must add ZERO host transfers: the counters/gauges accumulate as
+    jnp scalars in the step carry and only flush() (outside the step)
+    touches the host.  A callback- or outfeed-based metrics
+    implementation would turn every train step into a host round-trip —
+    the regression this guard exists to catch."""
+    base = _traced_step()
+    tele = _traced_step(telemetry=True)
+    assert _host_transfers(tele) == _host_transfers(base) == []
+    # the instrumented graph keeps the same conv population — telemetry
+    # reads existing step outputs (found_inf, loss scale, grad norm)
+    # instead of perturbing the compute
+    def convs(j):
+        return len([e for e in _walk(j.jaxpr)
+                    if e.primitive.name == "conv_general_dilated"])
+    assert convs(tele) == convs(base)
 
 
 # -- transformer families ------------------------------------------------
