@@ -577,13 +577,21 @@ class GPT(nn.Module):
         # GQA: only n_kv_head KV heads are cached (the whole point)
         shape = (batch_size, cfg.n_kv_head, cfg.block_size,
                  cfg.n_embd // cfg.n_head)
-        layer = {"k": jnp.zeros(shape, dtype),
-                 "v": jnp.zeros(shape, dtype)}
-        if dtype == jnp.int8:
-            sshape = shape[:3] + (1,)
-            layer["k_scale"] = jnp.zeros(sshape, jnp.float32)
-            layer["v_scale"] = jnp.zeros(sshape, jnp.float32)
-        return {str(i): dict(layer) for i in range(cfg.n_layer)}
+
+        # one allocation PER LAYER: sharing a single zeros buffer
+        # across layers (the old `dict(layer)` shallow copy) breaks
+        # buffer donation — donating the cache would donate the same
+        # buffer n_layer times (serving.Engine donates its caches)
+        def layer():
+            out = {"k": jnp.zeros(shape, dtype),
+                   "v": jnp.zeros(shape, dtype)}
+            if dtype == jnp.int8:
+                sshape = shape[:3] + (1,)
+                out["k_scale"] = jnp.zeros(sshape, jnp.float32)
+                out["v_scale"] = jnp.zeros(sshape, jnp.float32)
+            return out
+
+        return {str(i): layer() for i in range(cfg.n_layer)}
 
     def _decode_hidden(self, p, token, pos, cache):
         """Blocks-only decode step: (B,) token at ``pos`` -> ((B, 1, E)

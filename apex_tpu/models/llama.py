@@ -703,13 +703,20 @@ class Llama(nn.Module):
                  else cfg.max_position_embeddings)
         shape = (batch_size, cfg.num_key_value_heads,
                  width, cfg.head_dim)
-        layer = {"k": jnp.zeros(shape, dtype),
-                 "v": jnp.zeros(shape, dtype)}
-        if dtype == jnp.int8:
-            sshape = shape[:3] + (1,)
-            layer["k_scale"] = jnp.zeros(sshape, jnp.float32)
-            layer["v_scale"] = jnp.zeros(sshape, jnp.float32)
-        return {str(i): dict(layer)
+
+        # one allocation PER LAYER — a zeros buffer shared across
+        # layers would be donated num_hidden_layers times by the
+        # serving engine's cache mutators (XLA rejects double donation)
+        def layer():
+            out = {"k": jnp.zeros(shape, dtype),
+                   "v": jnp.zeros(shape, dtype)}
+            if dtype == jnp.int8:
+                sshape = shape[:3] + (1,)
+                out["k_scale"] = jnp.zeros(sshape, jnp.float32)
+                out["v_scale"] = jnp.zeros(sshape, jnp.float32)
+            return out
+
+        return {str(i): layer()
                 for i in range(cfg.num_hidden_layers)}
 
     def _decode_hidden(self, p, token, pos, cache):

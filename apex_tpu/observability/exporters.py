@@ -210,6 +210,26 @@ def validate_bench_record(rec: Any) -> List[str]:
     if "vs_baseline" in rec and rec["vs_baseline"] is not None \
             and not isinstance(rec["vs_baseline"], numbers.Number):
         errs.append("'vs_baseline' must be a number or null")
+    # serving decode-window fields (PR 2): ``window`` is the in-graph
+    # decode ticks per host sync — tokens/sec lines are only comparable
+    # given it, so fresh engine-decode measurements must carry it.
+    # Stale replays of pre-window records and error lines are exempt.
+    if "window" in rec:
+        w = rec["window"]
+        if not isinstance(w, int) or isinstance(w, bool) or w < 1:
+            errs.append(f"'window' must be an int >= 1, got {w!r}")
+    if "tokens_per_sync" in rec and not isinstance(
+            rec["tokens_per_sync"], numbers.Number):
+        errs.append("'tokens_per_sync' must be a number when present")
+    if (isinstance(metric, str) and "engine_decode" in metric
+            and "error" not in rec and not rec.get("stale")):
+        if "window" not in rec:
+            errs.append("engine decode records must carry 'window' "
+                        "(decode ticks per host sync)")
+        unit = rec.get("unit")
+        if isinstance(unit, str) and "tokens/sec" not in unit:
+            errs.append(f"engine decode records must report a "
+                        f"tokens/sec unit, got {unit!r}")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:

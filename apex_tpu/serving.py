@@ -13,9 +13,19 @@ Design (deliberately simple — correctness over paging):
 - ``add_request`` claims a free slot, seeds ITS cache row with a
   chunked prefill of the prompt (one scatter per layer), no impact on
   other slots;
-- ``step()`` is ONE jitted ``decode_chunk(L=1)`` over all slots at
-  per-slot positions (models/llama.py decode_chunk contract) + greedy
-  head; inactive slots decode garbage that is masked out host-side;
+- ``step()`` is ONE jitted dispatch: a DECODE WINDOW of ``window``
+  in-graph decode ticks (``lax.scan`` over ``decode_chunk(L=1)`` at
+  per-slot positions + greedy head, models/llama.py decode_chunk
+  contract), emitting a ``[slots, window]`` token buffer plus validity
+  masks that the host unpacks ONCE per window — the per-token
+  host-sync tax becomes a per-window tax.  Inactive slots decode
+  garbage that the masks drop; a slot that hits its EOS or token
+  limit mid-window FREEZES in-graph (ids/cur_len/cache/RNG stream
+  stop advancing) so exactness survives any window size; arrivals
+  are admitted at window boundaries;
+- every jitted cache mutator donates its KV buffers
+  (``donate_argnums``): the multi-GB cache is updated in place
+  instead of XLA keeping a second copy alive across every tick;
 - a request finishes on ``eos_token_id`` or its ``max_new_tokens``;
   the slot frees immediately and can be reclaimed next ``add_request``;
 - optional PREFIX SHARING (``prefix_pool``): registered prompt
@@ -114,12 +124,16 @@ class _SlotScheduler:
         self._n_admitted = 0
         self._n_tokens = 0
         self._n_steps = 0
+        self._n_syncs = 0
+        self._last_util = 0.0
+        self.window = int(getattr(self, "window", 1))
         self._m_prefill = self.metrics.histogram(
             "engine_prefill_seconds",
             help="admission latency: prompt prefill + slot seed")
         self._m_decode = self.metrics.histogram(
             "engine_decode_step_seconds",
-            help="one batched decode tick incl. the host fetch")
+            help="per-TOKEN decode latency: one window's wall time "
+                 "(incl. the host fetch) / tokens it emitted")
         self._m_queue_wait = self.metrics.histogram(
             "engine_queue_wait_seconds",
             help="submit-to-admission wait in the FIFO queue")
@@ -132,7 +146,18 @@ class _SlotScheduler:
         self._m_admitted = self.metrics.counter("engine_admitted_total")
         self._m_finished = self.metrics.counter("engine_finished_total")
         self._m_tokens = self.metrics.counter("engine_tokens_total")
-        self._m_steps = self.metrics.counter("engine_decode_steps_total")
+        self._m_steps = self.metrics.counter(
+            "engine_decode_steps_total",
+            help="device decode dispatches (one per window, NOT per "
+                 "token)")
+        self._m_syncs = self.metrics.counter(
+            "engine_host_syncs_total",
+            help="device->host result fetches the decode loop paid "
+                 "(one per window; 1/window per token when full)")
+        self.metrics.gauge(
+            "engine_window_size",
+            help="in-graph decode ticks per host round trip").set(
+            float(self.window))
 
     def _admit_timed(self, rid, *rest):
         """All admissions (direct and queue-drained) route through here:
@@ -151,19 +176,64 @@ class _SlotScheduler:
             req.t_admit = t1
             self._m_queue_wait.observe(max(t0 - req.t_submit, 0.0))
 
-    def _record_step(self, t0: float) -> float:
-        """Per-tick bookkeeping after the device fetch; returns `now` so
-        harvest loops stamp first-token times without re-reading the
-        clock per request."""
+    def _record_step(self, t0: float, tokens: int = 1,
+                     capacity: int = 0) -> float:
+        """Per-dispatch bookkeeping after the device fetch; returns
+        `now` so harvest loops stamp first-token times without
+        re-reading the clock per request.  ``tokens`` is what the
+        window emitted (the decode histogram observes wall time /
+        tokens — per-TOKEN latency, not raw window time);
+        ``capacity`` is ``live_slots * window``, the window's token
+        budget, feeding the utilization gauge (speculative ticks can
+        exceed 1.0 — that is the acceptance rate showing)."""
         now = self._clock()
-        self._m_decode.observe(now - t0)
+        self._m_decode.observe((now - t0) / max(tokens, 1))
         self._m_steps.inc()
         self._n_steps += 1
+        self._m_syncs.inc()
+        self._n_syncs += 1
+        if capacity > 0:
+            self._last_util = tokens / capacity
+            self.metrics.gauge(
+                "engine_window_utilization",
+                help="tokens emitted / (live slots * window size) of "
+                     "the last dispatch").set(self._last_util)
         self.metrics.gauge("engine_live").set(len(self._by_slot))
         self.metrics.gauge("engine_queue_depth").set(len(self._waiting))
         self.metrics.gauge("engine_occupancy").set(
             len(self._by_slot) / self.slots)
         return now
+
+    def _harvest(self, emitted, t0):
+        """Shared post-dispatch harvest for both engines: per-token
+        metrics, first-token stamps, EOS truncation (windowed paths
+        already mask in-graph — this also covers the speculative path,
+        whose accepted run can cross the EOS), finish + device-freeze
+        of done slots, queue drain.  ``emitted`` maps every live slot
+        to the tokens its request emitted this dispatch."""
+        n_emitted = sum(len(t) for t in emitted.values())
+        now = self._record_step(t0, tokens=n_emitted,
+                                capacity=len(emitted) * self.window)
+        out: Dict[int, Any] = {}
+        for slot, req in list(self._by_slot.items()):
+            toks = emitted[slot]
+            if req.eos is not None and req.eos in toks:
+                toks = toks[:toks.index(req.eos) + 1]
+            req.generated.extend(toks)
+            if toks:
+                out[req.rid] = list(toks)
+                if req.t_first is None:
+                    req.t_first = now
+                self._m_tokens.inc(len(toks))
+                self._n_tokens += len(toks)
+            hit_eos = req.eos is not None and req.eos in toks
+            if hit_eos or self._out_of_budget(req):
+                self._finish(slot, req)
+                # stop the device from advancing the freed slot (also
+                # what marks it inactive for the next window's scan)
+                self._freeze_slot(slot)
+        self._drain_queue()
+        return out
 
     def _check_request(self, prompt, max_new_tokens, seed,
                        temperature):
@@ -273,6 +343,11 @@ class _SlotScheduler:
                 "admitted": self._n_admitted,
                 "tokens_generated": self._n_tokens,
                 "decode_steps": self._n_steps,
+                "window": self.window,
+                "host_syncs": self._n_syncs,
+                "window_utilization": self._last_util,
+                "tokens_per_sync": (self._n_tokens / self._n_syncs
+                                    if self._n_syncs else 0.0),
                 "prefill_latency": self._m_prefill.summary(),
                 "decode_step_latency": self._m_decode.summary(),
                 "queue_wait": self._m_queue_wait.summary(),
@@ -286,7 +361,7 @@ class Engine(_SlotScheduler):
                  gamma: int = 4, temperature: float = 0.0,
                  top_k=None, top_p=None, rng=None,
                  prefix_pool: int = 0, prefix_chunk: int = 32,
-                 rolling: bool = False,
+                 rolling: bool = False, window: int = 1,
                  metrics: Optional[MetricsRegistry] = None):
         """``draft``/``draft_params`` switch ``step()`` to SPECULATIVE
         decoding: one ``spec_iteration`` (models/speculative.py) per
@@ -321,7 +396,19 @@ class Engine(_SlotScheduler):
         past W back).  The decode tick is the same ``decode_chunk``
         (L=1 rolling is wired in the model layer).  Incompatible with
         ``draft`` (speculative verify needs L>1 chunks) and
-        ``prefix_pool`` (the splice relayout is not wired)."""
+        ``prefix_pool`` (the splice relayout is not wired).
+
+        ``window=K`` runs K decode ticks IN-GRAPH per ``step()``
+        (``lax.scan``): the host fetches a ``[slots, K]`` token buffer
+        + validity masks once per window instead of one token per
+        round trip, so the per-token host-sync tax drops to 1/K.
+        EOS/token-limit masking happens in-graph — a finished slot
+        freezes mid-window — so the token-for-token exactness
+        contract (vs ``generate_cached`` and vs the K=1 engine) is
+        unchanged; arrivals are admitted at window boundaries, which
+        bounds added TTFT at one window of ticks.  Incompatible with
+        ``draft`` (spec_iteration already amortizes the sync over up
+        to gamma+1 tokens; composing the two is not wired)."""
         self.model = model
         self.params = params
         self.slots = slots
@@ -330,6 +417,15 @@ class Engine(_SlotScheduler):
         self.draft_params = draft_params
         self.gamma = gamma
         self.temperature = temperature
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if self.window > 1 and draft is not None:
+            raise NotImplementedError(
+                "windowed decode + speculative is not wired "
+                "(spec_iteration already amortizes the host sync over "
+                "up to gamma+1 tokens per tick); use window=1 with a "
+                "draft")
         if temperature > 0.0 and draft is not None:
             raise NotImplementedError(
                 "sampled speculative engine ticks are not wired; use "
@@ -379,6 +475,10 @@ class Engine(_SlotScheduler):
         self.ids = jnp.zeros((slots, buf_len), jnp.int32)
         self.cur_len = jnp.zeros((slots,), jnp.int32)
         self.limit = jnp.zeros((slots,), jnp.int32)   # per-slot final
+        # per-slot EOS id for the in-graph window masking; -1 = none.
+        # limit doubles as the liveness source: _finish zeroes it, so
+        # cur_len < limit is exactly "this slot is serving a request"
+        self._eos = jnp.full((slots,), -1, jnp.int32)
         self.cache = (model.init_cache(slots, dtype=cache_dtype,
                                        rolling=True) if rolling
                       else model.init_cache(slots, dtype=cache_dtype))
@@ -404,7 +504,11 @@ class Engine(_SlotScheduler):
             ids = lax.dynamic_update_index_in_dim(ids, row, slot, axis=0)
             return ids, cache, d_cache
 
-        self._prefill_slot = jax.jit(_prefill_slot)
+        # donate_argnums on every cache mutator: the KV buffers are
+        # scattered/updated in place instead of XLA holding the old
+        # multi-GB cache alive next to the new one per dispatch
+        self._prefill_slot = jax.jit(_prefill_slot,
+                                     donate_argnums=(0, 1, 2))
 
         if rolling:
             W = self._window
@@ -433,7 +537,8 @@ class Engine(_SlotScheduler):
                                                       axis=0)
                 return ids, cache
 
-            self._prefill_slot_rolling = jax.jit(_prefill_slot_rolling)
+            self._prefill_slot_rolling = jax.jit(
+                _prefill_slot_rolling, donate_argnums=(0, 1))
 
         # -- prefix-sharing pool ------------------------------------------
         if prefix_chunk < 1:
@@ -457,7 +562,8 @@ class Engine(_SlotScheduler):
                                    row)
                 return pool_cache, d_pool
 
-            self._seed_pool = jax.jit(_seed_pool)
+            self._seed_pool = jax.jit(_seed_pool,
+                                      donate_argnums=(0, 1))
 
             # splice = one row gather from the pool, K suffix chunks on
             # the (1, ...) ROW cache (not the whole multi-slot tree —
@@ -474,8 +580,10 @@ class Engine(_SlotScheduler):
                         b, r[0].astype(b.dtype), slot, axis=0),
                     cache, rc)
 
+            # _take_row must NOT donate: the pool rows are the shared
+            # prefix capital, reused by every later matching admission
             self._take_row = jax.jit(_take_row)
-            self._put_row = jax.jit(_put_row)
+            self._put_row = jax.jit(_put_row, donate_argnums=(0,))
             self._chunk_row = {
                 "cache": jax.jit(lambda rc, t, o: model.decode_chunk(
                     params, t, jnp.full((1,), o, jnp.int32), rc)[1])}
@@ -494,47 +602,89 @@ class Engine(_SlotScheduler):
                     limit, ids, t_cache, d_cache, gamma)
                 return ids2, new_len, t_cache, d_cache
 
-            self._sstep = jax.jit(_sstep)
+            # NOT cur_len (argnum 1): donating it corrupts the
+            # executable when reloaded from the persistent XLA:CPU
+            # compilation cache (jax 0.4.37 AOT quirk — fresh compiles
+            # are fine, cache loads decode garbage; pinned by running
+            # the serving suite twice against one cache dir).  The
+            # multi-GB wins are the two cache trees; ids rides along.
+            self._sstep = jax.jit(_sstep, donate_argnums=(0, 3, 4))
 
-        def _step(ids, cur_len, cache, keys, temps):
-            pos = jnp.maximum(cur_len - 1, 0)
-            tok_in = jnp.take_along_axis(
-                ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
-            h, cache = model.decode_chunk(params, tok_in, pos, cache)
-            logits = _head_logits(model, params, h)[:, 0]
-            if temperature > 0.0:
-                from .models import sampling as smp
-                # PER-SLOT key streams: each request draws from its own
-                # fold_in(base, seed) chain, so its tokens depend only
-                # on its own seed and step count — never on co-tenants
-                # or arrival timing (batch-independent sampling)
-                split = jax.vmap(
-                    lambda k: jax.random.split(k, 2))(keys)
-                keys, subs = split[:, 0], split[:, 1]
-                # per-request temperature: rows pre-scale their logits
-                # (sample_token at T=1 then filters — same semantics as
-                # a static temperature); a per-request T=0 row falls
-                # back to argmax via the where
-                safe_t = jnp.where(temps > 0, temps, 1.0)
-                scaled = (logits.astype(jnp.float32)
-                          / safe_t[:, None])
-                sampled = jax.vmap(
-                    lambda k, l: smp.sample_token(
-                        k, l, 1.0, top_k=top_k,
-                        top_p=top_p))(subs, scaled).astype(jnp.int32)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                nxt = jnp.where(temps > 0, sampled, greedy)
-            else:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            can = cur_len < buf_len
-            ids = jax.vmap(
-                lambda row, p, t, c: row.at[p].set(
-                    jnp.where(c, t, row[p])))(
-                ids, jnp.minimum(cur_len, buf_len - 1), nxt, can)
-            return (ids, jnp.where(can, cur_len + 1, cur_len), cache,
-                    nxt, keys)
+        K = self.window
 
-        self._step = jax.jit(_step)
+        def _step_k(ids, cur_len, cache, keys, temps, limit, eos):
+            """K decode ticks in-graph (``lax.scan``) — ONE host round
+            trip per window.  The carry holds a per-slot active mask:
+            a slot that emits its EOS or reaches its token limit
+            freezes for the rest of the window (ids/cur_len/cache/RNG
+            stream stop advancing), so every request's tokens are
+            exactly its solo decode regardless of K.  Emits the
+            ``[slots, K]`` token buffer + validity mask the host
+            unpacks once."""
+
+            def tick(carry, _):
+                ids, cur_len, cache, keys, alive = carry
+                pos = jnp.maximum(cur_len - 1, 0)
+                tok_in = jnp.take_along_axis(
+                    ids, jnp.clip(pos, 0, buf_len - 1)[:, None], axis=1)
+                # frozen/garbage slots recompute the KV their position
+                # already holds (same token, same pos -> same values):
+                # the write is idempotent, so the cache needs no mask
+                h, cache = model.decode_chunk(params, tok_in, pos,
+                                              cache)
+                logits = _head_logits(model, params, h)[:, 0]
+                if temperature > 0.0:
+                    from .models import sampling as smp
+                    # PER-SLOT key streams: each request draws from its
+                    # own fold_in(base, seed) chain, advanced once per
+                    # its OWN decode step (frozen slots hold their
+                    # key), so its tokens depend only on its seed and
+                    # step count — never on co-tenants, arrival timing,
+                    # or the window size (batch-independent sampling)
+                    split = jax.vmap(
+                        lambda k: jax.random.split(k, 2))(keys)
+                    new_keys, subs = split[:, 0], split[:, 1]
+                    # per-request temperature: rows pre-scale their
+                    # logits (sample_token at T=1 then filters — same
+                    # semantics as a static temperature); a per-request
+                    # T=0 row falls back to argmax via the where
+                    safe_t = jnp.where(temps > 0, temps, 1.0)
+                    scaled = (logits.astype(jnp.float32)
+                              / safe_t[:, None])
+                    sampled = jax.vmap(
+                        lambda k, l: smp.sample_token(
+                            k, l, 1.0, top_k=top_k,
+                            top_p=top_p))(subs, scaled).astype(jnp.int32)
+                    greedy = jnp.argmax(logits,
+                                        axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(temps > 0, sampled, greedy)
+                    keys = jnp.where(alive[:, None], new_keys, keys)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                can = alive & (cur_len < buf_len)
+                ids = jax.vmap(
+                    lambda row, p, t, c: row.at[p].set(
+                        jnp.where(c, t, row[p])))(
+                    ids, jnp.minimum(cur_len, buf_len - 1), nxt, can)
+                new_len = jnp.where(can, cur_len + 1, cur_len)
+                emitted = alive
+                hit_eos = (eos >= 0) & (nxt == eos)
+                alive = alive & ~hit_eos & (new_len < limit)
+                return ((ids, new_len, cache, keys, alive),
+                        (nxt, emitted))
+
+            alive0 = cur_len < limit
+            (ids, cur_len, cache, keys, _), (toks, valid) = lax.scan(
+                tick, (ids, cur_len, cache, keys, alive0), None,
+                length=K)
+            return ids, cur_len, cache, keys, toks.T, valid.T
+
+        # donate ids + the KV cache + the key table, NOT cur_len: the
+        # per-slot length vector is the argnum class whose donation
+        # corrupts executables reloaded from the persistent XLA:CPU
+        # compilation cache (see _sstep below), and donating a
+        # (slots,)-int32 buys nothing anyway
+        self._step_k = jax.jit(_step_k, donate_argnums=(0, 2, 3))
         self._slot_keys = jax.vmap(
             lambda i: jax.random.fold_in(self._key, i))(
             jnp.arange(slots))
@@ -633,6 +783,8 @@ class Engine(_SlotScheduler):
         self.cur_len = self.cur_len.at[slot].set(len(prompt))
         self.limit = self.limit.at[slot].set(
             min(len(prompt) + max_new_tokens, self.buf_len))
+        self._eos = self._eos.at[slot].set(
+            -1 if eos_token_id is None else int(eos_token_id))
         self._by_slot[slot] = _Request(rid, slot, len(prompt),
                                        max_new_tokens, eos_token_id)
 
@@ -642,14 +794,17 @@ class Engine(_SlotScheduler):
                              f"[1, {self.buf_len})")
 
     def step(self) -> Dict[int, Any]:
-        """One batched decode step.  Returns {request_id: [tokens]}
-        for every live request that emitted this step (one token on
-        the plain path, 1..gamma+1 under speculative decoding);
+        """One batched decode dispatch — a WINDOW of ``window``
+        in-graph decode ticks.  Returns {request_id: [tokens]} for
+        every live request that emitted this window (1..window tokens
+        on the plain path, 1..gamma+1 under speculative decoding);
         finished requests free their slot (their last token, EOS
-        included, is still reported and recorded)."""
+        included, is still reported and recorded) and queued arrivals
+        admit at the window boundary."""
         if not self._by_slot:
             return {}
         t0 = self._clock()
+        live = list(self._by_slot)
         if self.draft is not None:
             old_len = np.asarray(self.cur_len)
             (self.ids, self.cur_len, self.cache,
@@ -662,37 +817,24 @@ class Engine(_SlotScheduler):
                               rows[slot, old_len[slot]:new_len[slot]]]
                        for slot in self._by_slot}
         else:
-            (self.ids, self.cur_len, self.cache, nxt,
-             self._slot_keys) = self._step(self.ids, self.cur_len,
-                                           self.cache,
-                                           self._slot_keys,
-                                           self._slot_temp)
-            toks = np.asarray(nxt)
-            emitted = {slot: [int(toks[slot])] for slot in self._by_slot}
-        now = self._record_step(t0)
-        out: Dict[int, Any] = {}
-        for slot, req in list(self._by_slot.items()):
-            toks = emitted[slot]
-            if req.eos is not None and req.eos in toks:
-                # truncate a speculative run at the EOS it crossed
-                toks = toks[:toks.index(req.eos) + 1]
-            req.generated.extend(toks)
-            if toks:
-                out[req.rid] = list(toks)
-                if req.t_first is None:
-                    req.t_first = now
-                self._m_tokens.inc(len(toks))
-                self._n_tokens += len(toks)
-            hit_eos = req.eos is not None and req.eos in toks
-            full = (len(req.generated) >= req.max_new
-                    or req.prompt_len + len(req.generated)
-                    >= self.buf_len)
-            if hit_eos or full:
-                self._finish(slot, req)
-                # stop the device from advancing the freed slot
-                self.limit = self.limit.at[slot].set(0)
-        self._drain_queue()
-        return out
+            (self.ids, self.cur_len, self.cache, self._slot_keys,
+             toks, valid) = self._step_k(self.ids, self.cur_len,
+                                         self.cache, self._slot_keys,
+                                         self._slot_temp, self.limit,
+                                         self._eos)
+            # THE host sync: one fetch per window, not per token
+            toks_h, valid_h = jax.device_get((toks, valid))
+            emitted = {slot: [int(t) for t, v
+                              in zip(toks_h[slot], valid_h[slot]) if v]
+                       for slot in live}
+        return self._harvest(emitted, t0)
+
+    def _out_of_budget(self, req):
+        return (len(req.generated) >= req.max_new
+                or req.prompt_len + len(req.generated) >= self.buf_len)
+
+    def _freeze_slot(self, slot):
+        self.limit = self.limit.at[slot].set(0)
 
     def stats(self) -> Dict[str, Any]:
         """Base snapshot plus prefix-cache effectiveness: splice
@@ -724,47 +866,76 @@ class Seq2SeqEngine(_SlotScheduler):
     ``generate(attention_mask=...)``); ``max_new_cap`` fixes the
     decoder cache width, and per-request ``max_new_tokens`` may be
     anything up to it.  ``submit`` queues FIFO like the decoder-only
-    Engine.
+    Engine.  ``window=K`` scans K decoder ticks in-graph per
+    ``step()`` with the same mid-window EOS/limit freeze and
+    once-per-window host fetch as the decoder-only engine.
     """
 
     def __init__(self, model, params, slots: int, src_len: int,
-                 max_new_cap: int, cache_dtype=None,
+                 max_new_cap: int, cache_dtype=None, window: int = 1,
                  metrics: Optional[MetricsRegistry] = None):
         self.model = model
         self.params = params
         self.slots = slots
         self.src_len = src_len
         self.max_new_cap = max_new_cap
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         if cache_dtype is None:
             cache_dtype = params["shared"]["weight"].dtype
         self.state = model.init_seq2seq_state(slots, src_len,
                                               max_new_cap, cache_dtype)
         self.out = jnp.zeros((slots, max_new_cap), jnp.int32)
         self.n_new = jnp.zeros((slots,), jnp.int32)
+        # per-slot token budget (n_new < s_limit == slot is live; zeroed
+        # on finish) and EOS id (-1 = none) for the in-graph masking
+        self.s_limit = jnp.zeros((slots,), jnp.int32)
+        self._eos = jnp.full((slots,), -1, jnp.int32)
         self._init_scheduler(slots, metrics)
 
+        # donate the slot state: the encoder scatter updates the cross
+        # K/V + decoder cache in place instead of duplicating them
         self._seed = jax.jit(
             lambda st, slot, row, n: model.seed_slot_seq2seq(
-                params, st, slot, row, n))
+                params, st, slot, row, n), donate_argnums=(0,))
 
-        def _step(state, out, n_new):
-            start = jnp.full((slots,),
-                             model.cfg.decoder_start_token_id,
-                             jnp.int32)
-            prev = jnp.take_along_axis(
-                out, jnp.maximum(n_new - 1, 0)[:, None], axis=1)[:, 0]
-            tok = jnp.where(n_new == 0, start, prev)
-            logits, state = model.decode_step_rows(params, tok, n_new,
-                                                   state)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            can = n_new < max_new_cap
-            out = jax.vmap(
-                lambda row, p, t, c: row.at[p].set(
-                    jnp.where(c, t, row[p])))(
-                out, jnp.minimum(n_new, max_new_cap - 1), nxt, can)
-            return state, out, jnp.where(can, n_new + 1, n_new), nxt
+        def _step_k(state, out, n_new, limit, eos):
+            """K decoder ticks in-graph; same freeze/validity contract
+            as the decoder-only ``_step_k``."""
 
-        self._step = jax.jit(_step)
+            def tick(carry, _):
+                state, out, n_new, alive = carry
+                start = jnp.full((slots,),
+                                 model.cfg.decoder_start_token_id,
+                                 jnp.int32)
+                prev = jnp.take_along_axis(
+                    out, jnp.maximum(n_new - 1, 0)[:, None],
+                    axis=1)[:, 0]
+                tok = jnp.where(n_new == 0, start, prev)
+                logits, state = model.decode_step_rows(params, tok,
+                                                       n_new, state)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                can = alive & (n_new < max_new_cap)
+                out = jax.vmap(
+                    lambda row, p, t, c: row.at[p].set(
+                        jnp.where(c, t, row[p])))(
+                    out, jnp.minimum(n_new, max_new_cap - 1), nxt, can)
+                new_n = jnp.where(can, n_new + 1, n_new)
+                emitted = alive
+                hit_eos = (eos >= 0) & (nxt == eos)
+                alive = alive & ~hit_eos & (new_n < limit)
+                return (state, out, new_n, alive), (nxt, emitted)
+
+            alive0 = n_new < limit
+            (state, out, n_new, _), (toks, valid) = lax.scan(
+                tick, (state, out, n_new, alive0), None,
+                length=self.window)
+            return state, out, n_new, toks.T, valid.T
+
+        # state + out donated; n_new deliberately not (the per-slot
+        # length vector — see the donation note on Engine._step_k)
+        self._step_k = jax.jit(_step_k, donate_argnums=(0, 1))
 
     def _check_prompt(self, src):
         if len(src) < 1 or len(src) > self.src_len:
@@ -779,33 +950,34 @@ class Seq2SeqEngine(_SlotScheduler):
         self.state = self._seed(self.state, slot, jnp.asarray(row),
                                 len(src))
         self.n_new = self.n_new.at[slot].set(0)
-        self._by_slot[slot] = _Request(rid, slot, len(src),
-                                      min(max_new_tokens,
-                                          self.max_new_cap),
-                                      eos_token_id)
+        max_new = min(max_new_tokens, self.max_new_cap)
+        self.s_limit = self.s_limit.at[slot].set(max_new)
+        self._eos = self._eos.at[slot].set(
+            -1 if eos_token_id is None else int(eos_token_id))
+        self._by_slot[slot] = _Request(rid, slot, len(src), max_new,
+                                       eos_token_id)
 
     def step(self) -> Dict[int, Any]:
-        """One batched decoder tick; {rid: [token]} for live requests.
-        Finishes on per-request EOS or token budget; the slot frees
-        immediately."""
+        """One batched decoder dispatch — a window of ``window``
+        in-graph ticks; {rid: [tokens]} for live requests.  Finishes
+        on per-request EOS or token budget (frozen mid-window
+        in-graph); the slot frees at the window boundary."""
         if not self._by_slot:
             return {}
         t0 = self._clock()
-        self.state, self.out, self.n_new, nxt = self._step(
-            self.state, self.out, self.n_new)
-        toks = np.asarray(nxt)
-        now = self._record_step(t0)
-        out: Dict[int, Any] = {}
-        for slot, req in list(self._by_slot.items()):
-            t = int(toks[slot])
-            req.generated.append(t)
-            out[req.rid] = [t]
-            if req.t_first is None:
-                req.t_first = now
-            self._m_tokens.inc()
-            self._n_tokens += 1
-            hit_eos = req.eos is not None and t == req.eos
-            if hit_eos or len(req.generated) >= req.max_new:
-                self._finish(slot, req)
-        self._drain_queue()
-        return out
+        live = list(self._by_slot)
+        (self.state, self.out, self.n_new, toks, valid) = self._step_k(
+            self.state, self.out, self.n_new, self.s_limit, self._eos)
+        # THE host sync: one fetch per window, not per token
+        toks_h, valid_h = jax.device_get((toks, valid))
+        emitted = {slot: [int(t) for t, v
+                          in zip(toks_h[slot], valid_h[slot]) if v]
+                   for slot in live}
+        return self._harvest(emitted, t0)
+
+    def _out_of_budget(self, req):
+        # req.max_new is already min(max_new_tokens, max_new_cap)
+        return len(req.generated) >= req.max_new
+
+    def _freeze_slot(self, slot):
+        self.s_limit = self.s_limit.at[slot].set(0)

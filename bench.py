@@ -446,11 +446,14 @@ def main():
              unit="sequences/sec/chip", vs_baseline=None)
 
     def engine_config(metric, cfg, slots, prompt, new_tokens,
-                      model_cls=None, rolling=False):
+                      model_cls=None, rolling=False, window=1):
         """Continuous-batching engine throughput: keep every slot busy
         (re-admit a fresh request the moment one finishes) and measure
-        steady-state generated tokens/sec — includes the real per-step
-        host sync serving pays."""
+        steady-state generated TOKENS (not step() calls — a windowed
+        step emits up to ``window`` per slot) per second.  ``window=1``
+        pays the per-token host sync; ``window=K`` fetches once per K
+        in-graph ticks, so the w1-vs-wK line pair is the decode-window
+        speedup measured on the same shapes."""
         from apex_tpu import serving
         model = (model_cls or models.GPT)(cfg)
         params, _ = model.init(jax.random.PRNGKey(0))
@@ -460,7 +463,7 @@ def main():
         ctx = getattr(cfg, "block_size", None) \
             or cfg.max_position_embeddings
         eng = serving.Engine(model, params, slots=slots, buf_len=ctx,
-                             rolling=rolling)
+                             rolling=rolling, window=window)
         rng = np.random.RandomState(0)
 
         def admit():
@@ -475,22 +478,27 @@ def main():
         produced = 0
         steps = max(3 * new_tokens, 30)
         for _ in range(steps):
-            produced += len(eng.step())
+            produced += sum(len(t) for t in eng.step().values())
             while eng._free:
                 admit()
         dt = time.perf_counter() - t0
+        s = eng.stats()
         emit(metric=metric, value=round(produced / dt, 1),
-             unit="tokens/sec/chip", vs_baseline=None,
-             note=f"continuous batching, {slots} slots, prompt="
+             unit="tokens/sec/chip", vs_baseline=None, window=window,
+             tokens_per_sync=round(s["tokens_per_sync"], 2),
+             note=f"continuous batching, {slots} slots, decode window="
+                  f"{window} (host syncs 1/{window} per token), prompt="
                   f"{prompt}, {new_tokens} new/request, slot re-admit "
                   f"on finish"
                   + (f", O(window) ring cache W="
                      f"{getattr(cfg, 'sliding_window', None)}"
                      if rolling else ""))
 
-    def seq2seq_engine_config(metric, cfg, slots, src_len, new_tokens):
+    def seq2seq_engine_config(metric, cfg, slots, src_len, new_tokens,
+                              window=1):
         """Encoder-decoder continuous batching throughput (T5):
-        slot re-admit on finish, steady-state generated tokens/sec."""
+        slot re-admit on finish, steady-state generated tokens/sec;
+        ``window`` as in engine_config."""
         from apex_tpu import serving
         model = models.T5(cfg)
         params, _ = model.init(jax.random.PRNGKey(0))
@@ -499,7 +507,8 @@ def main():
             if x.dtype == jnp.float32 else x, params)
         eng = serving.Seq2SeqEngine(model, params, slots=slots,
                                     src_len=src_len,
-                                    max_new_cap=new_tokens)
+                                    max_new_cap=new_tokens,
+                                    window=window)
         rng = np.random.RandomState(0)
 
         def admit():
@@ -515,15 +524,16 @@ def main():
         produced = 0
         steps = max(3 * new_tokens, 30)
         for _ in range(steps):
-            produced += len(eng.step())
+            produced += sum(len(t) for t in eng.step().values())
             while eng._free:
                 admit()
         dt = time.perf_counter() - t0
         emit(metric=metric, value=round(produced / dt, 1),
-             unit="tokens/sec/chip", vs_baseline=None,
+             unit="tokens/sec/chip", vs_baseline=None, window=window,
              note=f"seq2seq continuous batching, {slots} slots, "
-                  f"src<={src_len}, {new_tokens} new/request, "
-                  f"encoder pass per admission")
+                  f"decode window={window}, src<={src_len}, "
+                  f"{new_tokens} new/request, encoder pass per "
+                  f"admission")
 
     def prefix_admit_config(metric, cfg, prompt, prefix_len,
                             model_cls=None):
@@ -744,6 +754,15 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 64)),
+            # same shapes, decode window 8: the w1/w8 pair measures
+            # what the once-per-window host fetch buys on hardware
+            ("gpt2_small_engine_decode_w8_throughput",
+             lambda: engine_config(
+                 "gpt2_small_engine_decode_w8_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 8, 64, 64, window=8)),
             ("t5_small_seq2seq_engine_decode_throughput",
              lambda: seq2seq_engine_config(
                  "t5_small_seq2seq_engine_decode_throughput",
@@ -841,6 +860,23 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 6)),
+            # decode-window pair: identical shapes, window 1 vs 8, and
+            # new_tokens a window multiple so wK runs full windows —
+            # the w1/w8 ratio is the pure host-sync amortization win
+            ("gpt_tiny_engine_decode_w1_throughput",
+             lambda: engine_config(
+                 "gpt_tiny_engine_decode_w1_throughput",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 2, 4, 8, window=1)),
+            ("gpt_tiny_engine_decode_w8_throughput",
+             lambda: engine_config(
+                 "gpt_tiny_engine_decode_w8_throughput",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 2, 4, 8, window=8)),
             ("t5_tiny_seq2seq_engine_decode_throughput",
              lambda: seq2seq_engine_config(
                  "t5_tiny_seq2seq_engine_decode_throughput",

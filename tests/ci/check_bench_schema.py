@@ -3,7 +3,11 @@
 
 Every stdout line bench emits must be a JSON object carrying
 ``schema_version``, the capture host, and a boolean ``stale`` field
-(apex_tpu/observability/exporters.py::validate_bench_record).  Usage:
+(apex_tpu/observability/exporters.py::validate_bench_record).  Fresh
+serving decode lines (metric containing ``engine_decode``) must also
+carry the decode-window fields: ``window`` (int >= 1, in-graph decode
+ticks per host sync) and a tokens/sec unit — the w1-vs-wK comparison
+is meaningless without them.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl

@@ -48,6 +48,7 @@ def test_beam1_equals_greedy():
             np.asarray(ref[b, :int(n_ref[b])]))
 
 
+@pytest.mark.slow
 def test_beam_equals_exhaustive_at_small_horizon():
     """K = V beams over a 2-token horizon IS exhaustive search: the
     result must be the argmax over all V^2 continuations."""
@@ -72,6 +73,9 @@ def test_beam_equals_exhaustive_at_small_horizon():
     np.testing.assert_allclose(float(score[0]), best_lp, rtol=1e-4)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_beam_score_dominates_greedy():
     m, params = _gpt(2)
     rng = np.random.RandomState(2)

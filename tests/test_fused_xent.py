@@ -70,6 +70,7 @@ def test_bf16_inputs_fp32_accumulation():
     assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_gpt_loss_fused_matches_dense():
     """GPT.loss default (fused head) == head_chunk=None (dense oracle),
     value and grads, including ignore_index masking via attention_mask."""
@@ -97,6 +98,9 @@ def test_gpt_loss_fused_matches_dense():
                                    rtol=5e-5, atol=5e-5)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_bert_mlm_loss_fused_matches_dense():
     """BertForPretraining.loss default (fused MLM head) ==
     head_chunk=None dense oracle, value and grads."""
@@ -130,6 +134,7 @@ def test_bert_mlm_loss_fused_matches_dense():
                                    rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_gpt_o2_memorizes_through_fused_head():
     """End-to-end training correctness of the fused head: a tiny GPT
     under amp O2 + FusedAdam must memorize a fixed batch (loss -> ~0),

@@ -29,6 +29,9 @@ def _pair():
     return hf, Llama(cfg), params
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_gemma_logits_match_transformers():
     import torch
 

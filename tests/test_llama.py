@@ -95,6 +95,7 @@ def test_llama_loss_fused_matches_dense_and_trains():
     assert float(loss) < first
 
 
+@pytest.mark.slow
 def test_llama_int8_weights_and_cache():
     """quantization composes: int8 weights + int8 GQA cache decode."""
     cfg = LlamaConfig(vocab_size=97, hidden_size=64,

@@ -300,6 +300,45 @@ def test_bench_record_schema_validation():
     assert exporters.validate_bench_record([1, 2]) != []
 
 
+def test_bench_record_schema_serving_decode_window_fields():
+    """Fresh engine-decode lines must carry the decode-window fields
+    (PR 2); stale replays of pre-window records and error lines stay
+    valid without them."""
+    base = {"metric": "gpt_tiny_engine_decode_throughput", "value": 9.0,
+            "unit": "tokens/sec/chip", "vs_baseline": None,
+            "backend": "cpu", "ndev": 8, "arch": "cpu"}
+    good = exporters.JsonlExporter.enrich(
+        dict(base, window=8, tokens_per_sync=7.5))
+    assert exporters.validate_bench_record(good) == []
+    # missing window on a fresh decode line is a schema violation
+    missing = exporters.JsonlExporter.enrich(dict(base))
+    assert any("window" in e
+               for e in exporters.validate_bench_record(missing))
+    # wrong types / values are caught wherever the field appears
+    for w in (0, -2, 1.5, True, "8"):
+        bad = exporters.JsonlExporter.enrich(dict(base, window=w))
+        assert any("window" in e
+                   for e in exporters.validate_bench_record(bad)), w
+    bad = exporters.JsonlExporter.enrich(
+        dict(base, window=8, tokens_per_sync="lots"))
+    assert any("tokens_per_sync" in e
+               for e in exporters.validate_bench_record(bad))
+    # a windowed line must report tokens/sec
+    bad = exporters.JsonlExporter.enrich(
+        dict(base, window=8, unit="steps/sec"))
+    assert any("tokens/sec" in e
+               for e in exporters.validate_bench_record(bad))
+    # stale replay of an old (pre-window) record: exempt
+    stale = exporters.JsonlExporter.enrich(dict(base), stale=True)
+    assert exporters.validate_bench_record(stale) == []
+    # error line for a hung decode config: exempt
+    err = exporters.JsonlExporter.enrich(
+        {"metric": "gpt_tiny_engine_decode_throughput", "value": None,
+         "unit": None, "vs_baseline": None, "backend": "cpu",
+         "ndev": 8, "arch": "cpu", "error": "config hung"})
+    assert exporters.validate_bench_record(err) == []
+
+
 def test_bench_emits_schema_valid_jsonl(tmp_path):
     """bench.py's emit/replay paths produce schema-valid lines: enrich a
     fresh line, save it to a record, and validate the stale replay."""

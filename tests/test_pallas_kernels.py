@@ -261,6 +261,7 @@ def test_pallas_bn_apply_grads_match_jnp():
                                    rtol=1e-4, atol=1e-4, err_msg=name)
 
 
+@pytest.mark.slow
 def test_pallas_bn_through_batchnorm_module(monkeypatch):
     """Full BatchNorm2d train-mode fwd+bwd: pallas-dispatched apply vs jnp
     apply must give identical loss and input grads (stats chain rule
@@ -309,6 +310,7 @@ def _dense_attn(q, k, v, causal):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(2, 2, 64, 16), (1, 3, 130, 24)])
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_fwd_bwd_matches_dense(shape, causal):
@@ -400,6 +402,9 @@ def _dense_attn_kvmask(q, k, v, causal, kv_mask):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_kv_mask_matches_dense(causal):
     """Key-padding mask streamed through the kernel == dense masked
@@ -431,6 +436,7 @@ def test_flash_attention_kv_mask_matches_dense(causal):
                                       err_msg=name)
 
 
+@pytest.mark.slow
 def test_flash_attention_kv_mask_fully_masked_row():
     """A batch entry whose keys are ALL masked yields zero output and
     zero/finite grads (dense softmax would emit a uniform average)."""
@@ -502,6 +508,7 @@ def _dense_attn_dropout(q, k, v, causal, seed, rate):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_dropout_matches_dense(causal):
     """In-kernel dropout == dense attention with the identical
@@ -530,6 +537,7 @@ def test_flash_attention_dropout_matches_dense(causal):
                                    rtol=1e-3, atol=1e-3, err_msg=name)
 
 
+@pytest.mark.slow
 def test_flash_attention_dropout_statistics():
     """Mask statistics: drop fraction ~= rate, different seeds give
     different masks, same seed is bitwise deterministic, and
@@ -559,6 +567,7 @@ def test_flash_attention_dropout_statistics():
     np.testing.assert_array_equal(np.asarray(o0), np.asarray(o_plain))
 
 
+@pytest.mark.slow
 def test_dot_product_attention_dropout_stays_on_flash(monkeypatch):
     """Train-mode attention dropout must ride the flash kernel (not fall
     to dense), drop roughly the configured fraction, and keep the
@@ -614,6 +623,7 @@ def test_dot_product_attention_dropout_stays_on_flash(monkeypatch):
     assert called.get("seed") is not None
 
 
+@pytest.mark.slow
 def test_flash_attention_dropout_bf16():
     from apex_tpu.ops.pallas_flash_attention import flash_attention
     ks = jax.random.split(jax.random.PRNGKey(12), 3)
@@ -662,6 +672,7 @@ def _dense_attn_segments(q, k, v, causal, segment_ids):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_segment_ids_matches_dense(causal):
     """Packed-sequence masking: pairs attend only within equal segment
@@ -701,6 +712,7 @@ def test_flash_attention_segment_ids_matches_dense(causal):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_flash_attention_segment_ids_compose_kv_mask_dropout():
     """All three masking mechanisms compose in one call."""
     from apex_tpu.ops.pallas_flash_attention import flash_attention

@@ -57,6 +57,7 @@ def test_pipeline_matches_sequential(n_micro):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match_sequential():
     S = 4
     mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
@@ -394,6 +395,9 @@ def test_1f1b_composes_with_tensor_parallel():
     assert_trees_close(grads, grads_ref, atol=3e-4)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_1f1b_shape_fuzz():
     """Grad parity across randomized (S, M, width, batch) — the
     schedule tables, stash rotation, and ring indexing must hold off

@@ -77,6 +77,9 @@ def test_decode_chunk_int8_matches_sequential_int8(family):
                                np.asarray(ch_h), rtol=2e-5, atol=2e-5)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_engine_int8_cache_matches_solo():
     from apex_tpu import serving
     m = _models()["gpt"]

@@ -150,6 +150,7 @@ def test_transcendentals_force_fp32():
         assert F.erfinv(x * 0.1).dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_reductions_force_fp32():
     x = jnp.ones((3, 4), jnp.float16)
     with o1():

@@ -6,6 +6,7 @@ tests pin the quantization error bound, the QTensor pytree/op wiring
 quantized params against the fp oracle.
 """
 
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,9 @@ def test_quantize_for_decode_selects_matrices():
     assert isinstance(qp["wte"]["weight"], QTensor)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_quantized_gpt_decode_matches_fp_closely():
     cfg = models.GPTConfig(vocab_size=211, block_size=32, n_layer=2,
                            n_head=4, n_embd=64, dropout=0.0)
@@ -147,6 +151,7 @@ def test_quantized_vocab_parallel_embedding():
     assert rel.max() < 0.02, rel.max()
 
 
+@pytest.mark.slow
 def test_int8_kv_cache_decode_close_to_fp():
     """int8 KV cache (per-position scales): decode_step logits track the
     fp-cache logits closely, and generate_cached runs end-to-end with

@@ -146,6 +146,9 @@ def test_repetition_penalty_hand_case():
     assert same is logits
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_generate_cached_repetition_penalty_matches_manual():
     """End-to-end: greedy decode with penalty equals recomputing
     argmax(penalized logits) step by step with full forwards."""

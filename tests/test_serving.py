@@ -235,6 +235,7 @@ def test_prefix_sharing_matches_solo_decoding():
     assert eng.result(r2) == _solo(m, params, extra, 5)
 
 
+@pytest.mark.slow
 def test_prefix_sharing_with_speculative_engine():
     """The splice covers BOTH caches (target + draft): a speculative
     engine with a registered prefix must stay exactly solo-greedy."""
@@ -480,6 +481,7 @@ def test_rolling_engine_validation():
                        cache_dtype=jnp.int8)
 
 
+@pytest.mark.slow
 def test_seq2seq_engine_matches_solo_t5_generate():
     """Encoder-decoder continuous batching: each request's tokens must
     equal T5.generate run for it alone (its own source, its own
@@ -530,6 +532,9 @@ def test_seq2seq_engine_matches_solo_t5_generate():
         eng.add_request(list(range(13)), max_new_tokens=2)
 
 
+# tier-1 budget (PR 2): slowest tests by --durations carry the slow
+# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+@pytest.mark.slow
 def test_queue_stress_arrivals_exceed_slots_fifo_fair():
     """VERDICT r4 item 6: arrivals >> slots.  20 requests of mixed
     lengths through 3 slots — every result must still equal its solo
@@ -565,3 +570,217 @@ def test_queue_stress_arrivals_exceed_slots_fifo_fair():
     # correctness under churn: every result == its solo decode
     for rid, prompt, n in reqs:
         assert eng.result(rid) == _solo(m, params, prompt, n), rid
+
+
+# -- decode window (PR 2): K in-graph ticks per host round trip -----------
+
+def test_windowed_engine_matches_solo_and_k1():
+    """The decode window must be invisible to results: K in-graph ticks
+    per host fetch, staggered arrivals admitted at window boundaries,
+    max-token freeze mid-window — every request token-for-token equal
+    to generate_cached AND to the K=1 engine under the same schedule."""
+    m, params = _gpt(60)
+    rng = np.random.RandomState(60)
+    pa = list(rng.randint(0, 64, 6))
+    pb = list(rng.randint(0, 64, 4))
+    pc = list(rng.randint(0, 64, 9))
+
+    def run(window):
+        eng = serving.Engine(m, params, slots=3, buf_len=24,
+                             window=window)
+        ra = eng.add_request(pa, max_new_tokens=8)
+        eng.step()                      # A runs alone for one window
+        rb = eng.add_request(pb, max_new_tokens=10)  # window boundary
+        eng.step()
+        rc = eng.add_request(pc, max_new_tokens=5)   # finishes mid-win
+        while eng.live():
+            eng.step()
+        return [eng.result(r) for r in (ra, rb, rc)]
+
+    want = [_solo(m, params, pa, 8), _solo(m, params, pb, 10),
+            _solo(m, params, pc, 5)]
+    k1 = run(1)
+    assert k1 == want
+    for K in (4, 8):
+        assert run(K) == k1 == want, K
+
+
+def test_windowed_engine_mid_window_eos_frees_and_reuses():
+    """EOS hit at an interior tick of the window: the slot freezes
+    in-graph, the host sees exactly the tokens up to and including
+    EOS, and the freed slot is clean for its next occupant."""
+    m, params = _gpt(61)
+    rng = np.random.RandomState(61)
+    pa = list(rng.randint(0, 64, 5))
+    solo = _solo(m, params, pa, 8)
+    eos = solo[2]                       # EOS lands mid-window (K=8)
+    want = solo[:solo.index(eos) + 1]
+    eng = serving.Engine(m, params, slots=1, buf_len=24, window=8)
+    ra = eng.add_request(pa, max_new_tokens=8, eos_token_id=eos)
+    out = eng.step()
+    assert out == {ra: want}
+    assert eng.live() == 0              # slot freed at window boundary
+    pb = list(rng.randint(0, 64, 7))
+    rb = eng.add_request(pb, max_new_tokens=6)
+    while eng.live():
+        eng.step()
+    assert eng.result(rb) == _solo(m, params, pb, 6)
+
+
+def test_windowed_engine_queue_admits_between_windows():
+    """A request arriving through submit() while the engine is full is
+    admitted at the next window boundary and still decodes exactly as
+    its solo run (the mid-window freeze never leaks into it)."""
+    m, params = _gpt(63)
+    rng = np.random.RandomState(63)
+    pa = list(rng.randint(0, 64, 5))
+    pb = list(rng.randint(0, 64, 7))
+    eng = serving.Engine(m, params, slots=1, buf_len=24, window=4)
+    ra = eng.submit(pa, max_new_tokens=6)     # takes the slot
+    rb = eng.submit(pb, max_new_tokens=9)     # queues
+    assert eng.live() == 1
+    steps = 0
+    while eng.live() or eng.stats()["waiting"]:
+        eng.step()
+        steps += 1
+        assert steps < 30
+    assert eng.result(ra) == _solo(m, params, pa, 6)
+    assert eng.result(rb) == _solo(m, params, pb, 9)
+    # 6 then 9 tokens through K=4 windows: 2 + 3 dispatches
+    assert eng.stats()["host_syncs"] == 5
+
+
+def test_windowed_sampled_mode_matches_k1_with_explicit_seeds():
+    """Sampled windowed decode: per-request streams advance once per
+    OWN token (frozen slots hold their key), so an explicitly seeded
+    request draws identical tokens at any window size and under any
+    co-tenancy/arrival pattern."""
+    m, params = _gpt(62)
+    rng = np.random.RandomState(62)
+    pa = list(rng.randint(0, 64, 5))
+    pb = list(rng.randint(0, 64, 7))
+
+    def run(window, stagger):
+        eng = serving.Engine(m, params, slots=2, buf_len=24,
+                             temperature=1.0, top_k=8,
+                             rng=jax.random.PRNGKey(9), window=window)
+        ra = eng.add_request(pa, max_new_tokens=9, seed=3)
+        if stagger:
+            eng.step()
+        rb = eng.add_request(pb, max_new_tokens=6, seed=4)
+        while eng.live():
+            eng.step()
+        return eng.result(ra), eng.result(rb)
+
+    base = run(1, False)
+    assert run(4, False) == base
+    assert run(4, True) == base         # arrival timing-independent
+    a, b = base
+    assert len(a) == 9 and len(b) == 6
+    assert all(0 <= t < 64 for t in a + b)
+
+
+@pytest.mark.slow
+def test_windowed_rolling_engine_matches_solo():
+    """window > 1 composes with the O(window-KV) rolling mode: the
+    scanned ring writes stay exact across wrap-arounds."""
+    from apex_tpu.models import Llama, LlamaConfig
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=32, sliding_window=5,
+                      tie_word_embeddings=True)
+    m = Llama(cfg)
+    params, _ = m.init(jax.random.PRNGKey(64))
+    params["embed_tokens"] = {
+        "weight": params["embed_tokens"]["weight"] / 0.02}
+    eng = serving.Engine(m, params, slots=2, buf_len=32, rolling=True,
+                         window=4)
+    rng = np.random.RandomState(64)
+    pa = list(rng.randint(0, 97, 9))        # prompt > window
+    ra = eng.add_request(pa, max_new_tokens=12)
+    eng.step()
+    pb = list(rng.randint(0, 97, 3))
+    rb = eng.add_request(pb, max_new_tokens=14)
+    while eng.live():
+        eng.step()
+
+    def solo(p, n):
+        buf = jnp.zeros((1, 32), jnp.int32).at[0, :len(p)].set(
+            jnp.asarray(p))
+        out, fl = m.generate_cached(params, buf, len(p), n,
+                                    rolling_cache=True)
+        return list(np.asarray(out[0, len(p):int(fl[0])]))
+
+    assert eng.result(ra) == solo(pa, 12)
+    assert eng.result(rb) == solo(pb, 14)
+
+
+def test_windowed_engine_validation():
+    m, params = _gpt(65)
+    with pytest.raises(ValueError, match="window"):
+        serving.Engine(m, params, slots=1, buf_len=24, window=0)
+    with pytest.raises(NotImplementedError, match="speculative"):
+        serving.Engine(m, params, slots=1, buf_len=24, window=4,
+                       draft=m, draft_params=params)
+    from apex_tpu.models import T5, T5Config
+    t5 = T5(T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                     num_layers=1, num_heads=4, dropout_rate=0.0,
+                     relative_attention_num_buckets=8,
+                     relative_attention_max_distance=16))
+    t5p, _ = t5.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="window"):
+        serving.Seq2SeqEngine(t5, t5p, slots=1, src_len=8,
+                              max_new_cap=4, window=0)
+
+
+def test_seq2seq_windowed_matches_solo_and_k1():
+    """Seq2SeqEngine gets the same windowed loop: staggered arrivals,
+    mid-window EOS, and slot reuse all token-for-token equal to
+    T5.generate and to the K=1 seq2seq engine."""
+    from apex_tpu.models import T5, T5Config
+    cfg = T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4, dropout_rate=0.0,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=16)
+    m = T5(cfg)
+    params, _ = m.init(jax.random.PRNGKey(66))
+    rng = np.random.RandomState(66)
+
+    def solo(src, n):
+        ids = jnp.zeros((1, 12), jnp.int32).at[0, :len(src)].set(
+            jnp.asarray(src))
+        mask = (jnp.arange(12) < len(src)).astype(jnp.float32)[None, :]
+        return list(np.asarray(m.generate(params, ids, n,
+                                          attention_mask=mask)[0]))
+
+    pa = list(rng.randint(2, 64, 11))
+    pb = list(rng.randint(2, 64, 4))
+
+    def run(window):
+        eng = serving.Seq2SeqEngine(m, params, slots=2, src_len=12,
+                                    max_new_cap=10, window=window)
+        ra = eng.add_request(pa, max_new_tokens=9)
+        eng.step()
+        rb = eng.add_request(pb, max_new_tokens=5)
+        while eng.live():
+            eng.step()
+        return [eng.result(ra), eng.result(rb)]
+
+    want = [solo(pa, 9), solo(pb, 5)]
+    assert run(1) == want
+    assert run(4) == want
+
+    # mid-window EOS on the windowed engine frees the slot cleanly
+    eng = serving.Seq2SeqEngine(m, params, slots=1, src_len=12,
+                                max_new_cap=10, window=4)
+    sol = solo(pa, 6)
+    eos = sol[1]
+    want_eos = sol[:sol.index(eos) + 1]
+    r4 = eng.add_request(pa, max_new_tokens=6, eos_token_id=eos)
+    out = eng.step()
+    assert out == {r4: want_eos} and eng.live() == 0
+    r5 = eng.add_request(pb, max_new_tokens=5)
+    while eng.live():
+        eng.step()
+    assert eng.result(r5) == solo(pb, 5)

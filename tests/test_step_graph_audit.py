@@ -249,3 +249,81 @@ def test_gpt_o2_step_large_dots_bf16():
 
 def test_llama_o2_step_large_dots_bf16():
     _assert_dots_bf16(_transformer_step_jaxpr("llama"))
+
+
+# -- serving decode window ------------------------------------------------
+
+def _window_engine(window=8):
+    from apex_tpu import serving
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    eng = serving.Engine(m, params, slots=2, buf_len=32, window=window)
+    return eng, m, params
+
+
+def _window_args(eng):
+    return (eng.ids, eng.cur_len, eng.cache, eng._slot_keys,
+            eng._slot_temp, eng.limit, eng._eos)
+
+
+def test_serving_window_step_zero_host_transfers():
+    """The jitted K-tick decode window must contain ZERO host-transfer
+    primitives: the whole point of the window is that the host touches
+    the device once per K tokens — a callback/outfeed smuggled into the
+    scan would reintroduce the per-token sync tax."""
+    eng, _, _ = _window_engine(window=8)
+    jpr = jax.make_jaxpr(eng._step_k)(*_window_args(eng))
+    assert _host_transfers(jpr) == []
+
+
+def test_serving_window_step_cache_buffers_donated():
+    """The big mutated decode-window inputs — ids, the KV cache tree,
+    the RNG keys — must be DONATED (input/output aliased in the
+    lowered module): without donation XLA keeps a second copy of the
+    multi-GB cache alive across every dispatch.  The per-slot length
+    vector (cur_len) is deliberately NOT donated — donating that
+    argnum class corrupts executables reloaded from the persistent
+    XLA:CPU compilation cache (serving.py's _sstep note).  The
+    lowering emits one ``tf.aliasing_output`` attribute per donated
+    buffer."""
+    eng, _, _ = _window_engine(window=8)
+    txt = eng._step_k.lower(*_window_args(eng)).as_text()
+    n_cache = len(jax.tree_util.tree_leaves(eng.cache))
+    want = n_cache + 2              # + ids, slot keys
+    got = txt.count("tf.aliasing_output")
+    assert got == want, (
+        f"expected {want} donated buffers (cache {n_cache} + ids + "
+        f"keys), lowering aliases {got}")
+    # admission-path mutators donate too (cache scattered in place)
+    ptxt = eng._prefill_slot.lower(
+        eng.ids, eng.cache, None, 0,
+        jnp.zeros((32,), jnp.int32)).as_text()
+    assert ptxt.count("tf.aliasing_output") == n_cache + 1  # + ids
+
+
+def test_serving_window_host_syncs_per_token():
+    """The acceptance number: with window=K the engine pays <= 1/K
+    host syncs per generated token (pinned via the engine metrics),
+    while ``engine_decode_steps_total`` keeps counting device
+    dispatches and the decode histogram observes PER-TOKEN latency."""
+    eng, _, _ = _window_engine(window=8)
+    prompt = list(np.random.RandomState(5).randint(0, 64, 4))
+    rid = eng.add_request(prompt, max_new_tokens=16)
+    while eng.live():
+        eng.step()
+    s = eng.stats()
+    assert len(eng.result(rid)) == 16
+    assert s["host_syncs"] == 2                 # ceil(16 / 8) windows
+    assert s["host_syncs"] / s["tokens_generated"] <= 1 / 8
+    assert s["tokens_per_sync"] == 8.0
+    assert s["window"] == 8
+    assert s["decode_steps"] == 2               # dispatches, not ticks
+    assert s["decode_step_latency"]["count"] == 2
+    assert eng.metrics.counter("engine_host_syncs_total").value == 2
+    assert eng.metrics.counter("engine_decode_steps_total").value == 2
+    assert eng.metrics.gauge("engine_window_size").value == 8.0
+    # one live slot, full windows: utilization pinned at 1.0
+    assert eng.metrics.gauge("engine_window_utilization").value == 1.0
+    assert s["window_utilization"] == 1.0
