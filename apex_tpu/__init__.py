@@ -68,5 +68,6 @@ from . import observability
 from . import data
 from . import lora
 from . import serving
+from . import analysis
 
 __version__ = "0.1.0"

@@ -11,8 +11,8 @@ O1 registries (register_half_function etc.).
 """
 
 from .frontend import (initialize, Properties, opt_levels, O0, O1, O2, O3,
-                       scaler_state, current_loss_scale, steps_skipped,
-                       amp_stats, record_scaler)
+                       compute_dtype, scaler_state, current_loss_scale,
+                       steps_skipped, amp_stats, record_scaler)
 from .handle import (scale_loss, scaled_grad, scaled_grad_accum,
                      disable_casts)
 from .scaler import LossScaler, ScalerState

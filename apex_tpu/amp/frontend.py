@@ -20,8 +20,8 @@ import jax.numpy as jnp
 from ._amp_state import _amp_state, maybe_print, warn_or_err
 
 __all__ = ["Properties", "O0", "O1", "O2", "O3", "opt_levels", "initialize",
-           "scaler_state", "current_loss_scale", "steps_skipped",
-           "amp_stats", "record_scaler"]
+           "compute_dtype", "scaler_state", "current_loss_scale",
+           "steps_skipped", "amp_stats", "record_scaler"]
 
 _HALF_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
                 "fp16": jnp.float16, "bf16": jnp.bfloat16}
@@ -203,6 +203,20 @@ class O0(OptLevel):
 
 
 opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+def compute_dtype(opt_level: str, half_dtype: str = "bfloat16"):
+    """The dtype the O-level policy puts on MXU operands (conv/matmul
+    lhs+rhs, fwd and bwd): fp32 at O0, the half dtype at O1 (op-boundary
+    casts whitelist conv/matmul), O2, and O3.  This is the single source
+    of truth ``apex_tpu.analysis``'s amp-dtype rule checks traced train
+    steps against — fp32 accumulation lives in
+    ``preferred_element_type``, never in operand upcasts."""
+    if opt_level not in opt_levels:
+        raise ValueError(f"unknown opt_level {opt_level!r}")
+    if opt_level == "O0":
+        return jnp.float32
+    return _HALF_DTYPES[half_dtype]
 
 
 def initialize(model, optimizers=None, enabled: bool = True,

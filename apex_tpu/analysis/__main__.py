@@ -1,0 +1,105 @@
+"""CLI: ``python -m apex_tpu.analysis`` — lint the hot graphs.
+
+Stdout is pure schema-versioned JSONL (the bench.py contract): one
+``graph_lint`` record per finding plus one ``graph_lint_summary``
+record, all enriched by ``observability.exporters.JsonlExporter`` and
+validated by ``tests/ci/check_bench_schema.py``.  Human-readable
+progress goes to stderr.  Exit status: 0 = clean, 1 = any
+error-severity finding (the CI gate tests/ci/graph_lint.py relies on
+this), 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List
+
+
+def _force_virtual_mesh():
+    """Mirror tests/conftest.py: the DDP/TP entry points trace an
+    8-device mesh, so force the virtual CPU mesh before the first
+    backend initialization.  Jaxpr properties are backend-independent
+    — the CPU trace pins what the TPU executable will see.  Set
+    APEX_TPU_ANALYSIS_BACKEND=native to lint on the ambient backend
+    instead."""
+    if os.environ.get("APEX_TPU_ANALYSIS_BACKEND") == "native":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    # jax is already imported (we live inside the package), so flip the
+    # platform via jax.config — effective as long as no backend has
+    # been initialized yet (tests/conftest.py's strategy)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv: List[str] = None) -> int:
+    _force_virtual_mesh()
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="Static graph lint over the hot entry points.")
+    p.add_argument("--entry-points", default=None,
+                   help="comma-separated entry-point names "
+                        "(default: all registered)")
+    p.add_argument("--tags", default=None,
+                   help="comma-separated tags to select entry points "
+                        "(e.g. training,serving)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule names (default: all)")
+    p.add_argument("--list", action="store_true",
+                   help="list entry points and rules, run nothing")
+    p.add_argument("--out", default=None,
+                   help="append JSONL findings to this path instead of "
+                        "stdout")
+    args = p.parse_args(argv)
+
+    from . import ENTRY_POINTS, RULES, get_rule, run_lint, select
+    from ..observability.exporters import JsonlExporter
+
+    if args.list:
+        for ep in ENTRY_POINTS.values():
+            print(f"{ep.name:32s} [{', '.join(sorted(ep.tags))}] "
+                  f"{ep.description}")
+        print(f"rules: {', '.join(sorted(RULES))}")
+        return 0
+
+    try:
+        eps = select(
+            names=args.entry_points.split(",")
+            if args.entry_points else None,
+            tags=args.tags.split(",") if args.tags else None)
+        rules = ([get_rule(r) for r in args.rules.split(",")]
+                 if args.rules else None)
+    except KeyError as e:
+        print(f"graph lint: {e.args[0]}", file=sys.stderr)
+        return 2
+    if not eps:
+        print("no entry points selected", file=sys.stderr)
+        return 2
+
+    def progress(ep, findings, dt):
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        print(f"{ep.name:32s} {len(findings)} finding(s) [{dt:.1f}s]",
+              file=sys.stderr)
+
+    exp = JsonlExporter(path=args.out) if args.out \
+        else JsonlExporter(stream=sys.stdout)
+    t0 = time.perf_counter()
+    with exp:
+        summary = run_lint(entry_points=eps, rules=rules,
+                           emit=exp.emit, progress=progress)
+    print(f"graph lint: {summary['entry_points']} entry point(s), "
+          f"{summary['errors']} error(s), {summary['warnings']} "
+          f"warning(s) in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    return 1 if summary["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

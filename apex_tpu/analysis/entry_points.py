@@ -1,0 +1,498 @@
+"""Registry of HOT entry points: the real graphs bench.py, the examples
+and the serving engines execute, traced for the rule engine.
+
+Each entry point builds the same step the production path dispatches —
+DDP ResNet train steps across O0–O3 (telemetry on/off, channels-last
+variants), the transformer-family O2 steps, the serving engines' jitted
+mutators, and the tensor-parallel step — and carries the expectations
+the rules check.  Expectations are *derived from the subsystems that
+own them* wherever possible: conv/matmul dtypes from
+``amp.compute_dtype``, DDP psum counts and on-wire bytes from
+``parallel.allreduce_comm_plan``, donation names/blocklist from
+``serving``'s constants.  Jaxpr properties are backend-independent, so
+tracing on the CPU mesh pins what the TPU executable will see.
+
+Builders run lazily and cache: registering is free, ``ep.graph()`` pays
+the trace once per process (tests, the CI gate and the CLI share it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .graphs import Graph
+
+__all__ = ["EntryPoint", "ENTRY_POINTS", "register_entry_point", "get",
+           "select", "names"]
+
+
+class EntryPoint:
+    """One hot graph: ``build(ep)`` returns a :class:`Graph` and may
+    fill derived expectations into ``ep.expect`` before rules run."""
+
+    def __init__(self, name: str, build: Callable[["EntryPoint"], Graph],
+                 tags: Iterable[str] = (),
+                 expect: Optional[Dict[str, Any]] = None,
+                 description: str = ""):
+        self.name = name
+        self.tags = frozenset(tags)
+        self.expect: Dict[str, Any] = dict(expect or {})
+        self.description = description
+        self._build = build
+        self._graph: Optional[Graph] = None
+
+    def graph(self) -> Graph:
+        if self._graph is None:
+            # leak barrier: amp.initialize(O1) installs a PROCESS-WIDE
+            # cast policy (the reference's monkey-patch analogue) and
+            # nothing uninstalls it — without this restore, building
+            # the O1 entry point would silently re-dtype every graph
+            # built after it (tests only dodge this via conftest's
+            # autouse _reset_amp_policy).  Builders that need a policy
+            # at trace time scope it explicitly via _scoped().
+            from ..amp import policy as amp_policy
+            base = amp_policy.current_policy()
+            try:
+                self._graph = self._build(self)
+            finally:
+                amp_policy.set_policy(base)
+        return self._graph
+
+    def __repr__(self):
+        return f"EntryPoint({self.name!r}, tags={sorted(self.tags)})"
+
+
+ENTRY_POINTS: Dict[str, EntryPoint] = {}
+
+
+def register_entry_point(name: str, tags: Iterable[str] = (),
+                         expect: Optional[Dict[str, Any]] = None,
+                         description: str = ""):
+    def deco(build):
+        if name in ENTRY_POINTS:
+            raise ValueError(f"duplicate entry point {name!r}")
+        ENTRY_POINTS[name] = EntryPoint(name, build, tags=tags,
+                                        expect=expect,
+                                        description=description)
+        return build
+    return deco
+
+
+def get(name: str) -> EntryPoint:
+    try:
+        return ENTRY_POINTS[name]
+    except KeyError:
+        raise KeyError(f"unknown entry point {name!r}; known: "
+                       f"{sorted(ENTRY_POINTS)}")
+
+
+def names() -> List[str]:
+    return list(ENTRY_POINTS)
+
+
+def select(names: Optional[Iterable[str]] = None,
+           tags: Optional[Iterable[str]] = None) -> List[EntryPoint]:
+    if names is not None:
+        return [get(n) for n in names]
+    eps = list(ENTRY_POINTS.values())
+    if tags is not None:
+        tags = frozenset(tags)
+        eps = [ep for ep in eps if ep.tags & tags]
+    return eps
+
+
+def _scoped(pol, fn):
+    """Defer ``fn`` under the amp cast-policy environment the builder
+    intends — traces run lazily, long after the builder's global policy
+    state has been restored by the EntryPoint.graph() leak barrier."""
+    def run():
+        from ..amp import policy as amp_policy
+        with amp_policy.use_policy(pol):
+            return fn()
+    return run
+
+
+def _no_policy():
+    from ..amp import policy as amp_policy
+    return amp_policy.NoPolicy()
+
+
+def _require_devices(n: int):
+    import jax
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"this entry point traces an {n}-device mesh; run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"(the CLI and tests/ci/graph_lint.py set this before the "
+            f"backend initializes)")
+
+
+# -- DDP ResNet train steps (O0-O3, layouts, telemetry) -------------------
+
+# activation threshold for the layout rule: one NHWC input batch
+# (4, 32, 32, 3) on the 8-way mesh — anything that size or bigger being
+# transposed is a relayout of real data, not index bookkeeping
+_RESNET_ACT_ELEMS = 4 * 3 * 32 * 32
+
+
+def _ddp_resnet_graph(ep, opt_level, channels_last=False,
+                      input_format="NCHW", stem="conv7",
+                      telemetry=False, B=8, image=32):
+    """Trace the REAL DDP train step — shard_map over the 8-device CPU
+    mesh with the grad allreduce inside — the same graph bench.py's
+    headline and examples/imagenet execute.  ``telemetry=True`` threads
+    a DeviceMetrics state through the step carry (the fully
+    instrumented shape of the hot loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .. import amp, observability, optimizers, parallel, models
+    from ..nn import functional as F
+
+    model, opt = amp.initialize(
+        models.resnet18(num_classes=10, channels_last=channels_last,
+                        input_format=input_format, stem=stem),
+        optimizers.FusedAdam(1e-3), opt_level=opt_level, verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, bn = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    rng = np.random.RandomState(0)
+    shape = (B, 3, image, image) if input_format == "NCHW" \
+        else (B, image, image, 3)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+    dm = observability.DeviceMetrics(
+        counters=("steps", "overflows"),
+        gauges=("loss_scale", "grad_norm")) if telemetry else None
+
+    def step(state, batch):
+        if telemetry:
+            params, bn, ost, tele = state
+        else:
+            params, bn, ost = state
+        xb, yb = batch
+
+        def loss_fn(p):
+            out, nb = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), nb
+
+        loss, nb, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        g = ddp.allreduce_grads(g)
+        params, ost2, info = opt.step(params, ost, g)
+        if telemetry:
+            tele = dm.inc(tele, "steps")
+            tele = dm.inc(tele, "overflows", info["found_inf"])
+            tele = dm.set(tele, "loss_scale", info["loss_scale"])
+            tele = dm.set(tele, "grad_norm", info["grad_norm"])
+            return (params, nb, ost2, tele), jax.lax.pmean(loss, "data")
+        return (params, nb, ost2), jax.lax.pmean(loss, "data")
+
+    _fill_ddp_expectations(ep, opt_level, params)
+    state = (params, bn, ost) + ((dm.init(),) if telemetry else ())
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), (P("data"), P("data"))),
+                           out_specs=(P(), P()), check_vma=False)
+    # O1's op-boundary casts consult the policy amp.initialize just
+    # installed; capture it for the deferred trace (O0/O2/O3 see the
+    # clean base policy thanks to the graph() leak barrier)
+    from ..amp import policy as amp_policy
+    pol = amp_policy.current_policy()
+    return Graph(trace=_scoped(
+        pol, lambda: jax.make_jaxpr(mapped)(state, (x, y))))
+
+
+def _fill_ddp_expectations(ep, opt_level, params):
+    """Derive the amp + collective expectations for a DDP train step.
+
+    Comm accounting: the step's psum population is exactly the grad
+    buckets of ``allreduce_comm_plan`` (one psum eqn per bucket, padded
+    chunks included in the wire bytes) plus two fp32 scalars — the
+    axis-size psum ``gradient_average`` divides by, and the
+    ``pmean(loss)`` the step returns.  Grad dtypes equal the amp-cast
+    param dtypes (``scaled_grad`` differentiates wrt the cast tree), so
+    the plan over ``params`` IS the plan over the grads.
+    """
+    from .. import amp, parallel
+    dt = str(np.dtype(amp.compute_dtype(opt_level)))
+    ep.expect.setdefault("amp", {
+        # resnet18 fwd has 20 convs; backward adds dgrad+wgrad per conv
+        # minus the input dgrad — 40 is a sanity floor, not a census
+        "opt_level": opt_level, "conv_dtype": dt, "min_convs": 40,
+        # the fc head forward dot; dgrad/wgrad have a (B, 10)-sized
+        # operand below the large-dot threshold
+        "dot_dtype": dt, "min_dots": 1})
+    plan = parallel.allreduce_comm_plan(params)
+    ep.expect.setdefault("collectives", {
+        "counts": {"psum": len(plan) + 2},
+        "payload_bytes": sum(b["wire_bytes"] for b in plan) + 2 * 4})
+
+
+for _lvl in ("O0", "O1", "O2", "O3"):
+    register_entry_point(
+        f"ddp_resnet18_{_lvl.lower()}", tags=("training", "ddp", "amp"),
+        description=f"DDP resnet18 {_lvl} train step, NCHW, 8-way mesh")(
+        lambda ep, lvl=_lvl: _ddp_resnet_graph(ep, lvl))
+
+register_entry_point(
+    "ddp_resnet18_o2_telemetry", tags=("training", "ddp", "amp",
+                                       "telemetry"),
+    description="DDP resnet18 O2 step with DeviceMetrics threaded "
+                "through the carry — must stay host-transfer-free")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", telemetry=True))
+
+register_entry_point(
+    "ddp_resnet18_o2_nhwc", tags=("training", "ddp", "amp", "layout"),
+    expect={"layout": {"min_activation_elems": _RESNET_ACT_ELEMS,
+                       "allowed_6d_rearranges": 0}},
+    description="DDP resnet18 O2 channels-last step — transpose-free")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", channels_last=True,
+                                 input_format="NHWC"))
+
+register_entry_point(
+    "ddp_resnet18_o2_nhwc_s2d", tags=("training", "ddp", "amp", "layout"),
+    # the 6-D block rearrange inside F.space_to_depth is the ONE
+    # legitimate activation transpose (forward-only: the input is a
+    # constant, so no gradient flows back through it)
+    expect={"layout": {"min_activation_elems": _RESNET_ACT_ELEMS,
+                       "allowed_6d_rearranges": 1}},
+    description="DDP resnet18 O2 NHWC space-to-depth stem step")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", channels_last=True,
+                                 input_format="NHWC",
+                                 stem="space_to_depth"))
+
+
+# -- transformer-family O2 train steps ------------------------------------
+
+def _transformer_graph(ep, family):
+    """The real O2 DDP train step (fused-head loss) for a tiny
+    transformer config over the 8-device CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .. import amp, optimizers, parallel, models
+
+    if family == "gpt":
+        net = models.GPT(models.GPTConfig(
+            vocab_size=97, block_size=16, n_layer=2, n_head=4,
+            n_embd=32, dropout=0.0))
+    else:
+        net = models.Llama(models.LlamaConfig(
+            vocab_size=97, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=16,
+            tie_word_embeddings=True))
+    model, opt = amp.initialize(net, optimizers.FusedAdam(1e-3),
+                                opt_level="O2", verbosity=0)
+    ddp = parallel.DistributedDataParallel(model)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (8, 16)))
+
+    def step(state, batch):
+        params, ost = state
+        (ids_b,) = batch
+
+        def loss_fn(p):
+            return model.loss(p, ids_b), ()
+
+        loss, _, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        g = ddp.allreduce_grads(g)
+        params, ost2, _ = opt.step(params, ost, g)
+        return (params, ost2), jax.lax.pmean(loss, "data")
+
+    dt = str(np.dtype(amp.compute_dtype("O2")))
+    ep.expect.setdefault("amp", {
+        # qkv/attention/MLP/fused-head dots, fwd and bwd
+        "opt_level": "O2", "dot_dtype": dt, "min_dots": 10})
+    plan = parallel.allreduce_comm_plan(params)
+    ep.expect.setdefault("collectives", {
+        "counts": {"psum": len(plan) + 2},
+        "payload_bytes": sum(b["wire_bytes"] for b in plan) + 2 * 4})
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), (P("data"),)),
+                           out_specs=(P(), P()), check_vma=False)
+    from ..amp import policy as amp_policy
+    pol = amp_policy.current_policy()
+    return Graph(trace=_scoped(
+        pol, lambda: jax.make_jaxpr(mapped)((params, ost), (ids,))))
+
+
+register_entry_point(
+    "gpt_o2_train_step", tags=("training", "ddp", "amp", "transformer"),
+    description="GPT O2 DDP train step (fused-head loss)")(
+    lambda ep: _transformer_graph(ep, "gpt"))
+
+register_entry_point(
+    "llama_o2_train_step", tags=("training", "ddp", "amp", "transformer"),
+    description="Llama O2 DDP train step (GQA, tied embeddings)")(
+    lambda ep: _transformer_graph(ep, "llama"))
+
+
+# -- serving engines ------------------------------------------------------
+
+def _tiny_engine():
+    import jax
+    from .. import models, serving
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    return serving.Engine(m, params, slots=2, buf_len=32, window=8)
+
+
+def _engine_step_k_graph(ep):
+    import jax
+    from .. import serving
+    eng = _tiny_engine()
+    args = (eng.ids, eng.cur_len, eng.cache, eng._slot_keys,
+            eng._slot_temp, eng.limit, eng._eos)
+    n_cache = len(jax.tree_util.tree_leaves(eng.cache))
+    ep.expect.setdefault("donation", {
+        # the big mutated window inputs — ids, the KV cache tree, the
+        # RNG keys — must alias; the per-slot length vector cur_len is
+        # covered by serving.DONATION_BLOCKLIST (PR 2 compile-cache
+        # gotcha), and limit/eos are read-only scheduler state
+        "expect_donated": ("ids", "cache", "keys"),
+        "forbid_donated": ("temps", "limit", "eos"),
+        "min_aliased": n_cache + 2})
+    return Graph(trace=_scoped(
+                     _no_policy(),
+                     lambda: jax.make_jaxpr(eng._step_k)(*args)),
+                 lower=_scoped(_no_policy(),
+                               lambda: eng._step_k.lower(*args)),
+                 arg_names=serving.STEP_K_ARG_NAMES, example_args=args)
+
+
+register_entry_point(
+    "engine_step_k", tags=("serving", "donation"),
+    description="Engine._step_k: the K-tick jitted decode window")(
+    _engine_step_k_graph)
+
+
+def _engine_prefill_graph(ep):
+    import jax
+    import jax.numpy as jnp
+    from .. import serving
+    eng = _tiny_engine()
+    args = (eng.ids, eng.cache, None, 0, jnp.zeros((32,), jnp.int32))
+    n_cache = len(jax.tree_util.tree_leaves(eng.cache))
+    ep.expect.setdefault("donation", {
+        # admission-path mutator: the cache row is scattered in place
+        "expect_donated": ("ids", "cache"),
+        "forbid_donated": ("slot", "row"),
+        "min_aliased": n_cache + 1})
+    return Graph(trace=_scoped(
+                     _no_policy(),
+                     lambda: jax.make_jaxpr(eng._prefill_slot)(*args)),
+                 lower=_scoped(_no_policy(),
+                               lambda: eng._prefill_slot.lower(*args)),
+                 arg_names=serving.PREFILL_SLOT_ARG_NAMES,
+                 example_args=args)
+
+
+register_entry_point(
+    "engine_prefill_slot", tags=("serving", "donation"),
+    description="Engine._prefill_slot: per-slot admission prefill")(
+    _engine_prefill_graph)
+
+
+def _seq2seq_step_k_graph(ep):
+    import jax
+    from .. import models, serving
+    t5 = models.T5(models.T5Config(
+        vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=1,
+        num_heads=4, dropout_rate=0.0, relative_attention_num_buckets=8,
+        relative_attention_max_distance=16))
+    t5p, _ = t5.init(jax.random.PRNGKey(0))
+    eng = serving.Seq2SeqEngine(t5, t5p, slots=2, src_len=8,
+                                max_new_cap=8, window=4)
+    args = (eng.state, eng.out, eng.n_new, eng.s_limit, eng._eos)
+    ep.expect.setdefault("donation", {
+        # slot state + the output buffer mutate every window; n_new is
+        # the per-slot length vector (global blocklist)
+        "expect_donated": ("state", "out"),
+        "forbid_donated": ("limit", "eos")})
+    return Graph(trace=_scoped(
+                     _no_policy(),
+                     lambda: jax.make_jaxpr(eng._step_k)(*args)),
+                 lower=_scoped(_no_policy(),
+                               lambda: eng._step_k.lower(*args)),
+                 arg_names=serving.SEQ2SEQ_STEP_K_ARG_NAMES,
+                 example_args=args)
+
+
+register_entry_point(
+    "seq2seq_step_k", tags=("serving", "donation", "seq2seq"),
+    description="Seq2SeqEngine._step_k: K decoder ticks in-graph")(
+    _seq2seq_step_k_graph)
+
+
+# -- tensor parallel ------------------------------------------------------
+
+def _tp_train_step_graph(ep):
+    """2x4 (data, model) mesh ParallelMLP train step: Megatron comm
+    pattern — ONE row-parallel psum forward, ONE f-copy psum backward,
+    plus the DDP grad bucket + axis-size scalar over data."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .. import parallel
+    from ..parallel import tensor_parallel as tp
+    from ..nn import functional as F
+
+    _require_devices(8)
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    mlp = tp.ParallelMLP(8, 32, activation="relu")
+    params, _ = mlp.init(jax.random.PRNGKey(6))
+    specs = tp.partition_specs(mlp, params)
+    ddp = parallel.DistributedDataParallel(mlp)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 8), jnp.float32)
+
+    def step(p, xb, yb):
+        def loss_fn(pp):
+            return F.mse_loss(mlp(pp, xb), yb)
+        grads = jax.grad(loss_fn)(p)
+        grads = ddp.allreduce_grads(grads)     # data axis only
+        return jax.tree_util.tree_map(lambda w, g: w - 0.1 * g, p, grads)
+
+    # comm accounting, derived: ONE model-axis psum — the row-parallel
+    # forward output, (B/2, 8) fp32 rows per device (the f-copy
+    # backward psum computes dL/dx, which nothing consumes, so DCE
+    # removes it); DDP over data contributes one psum per comm-plan
+    # bucket over the LOCAL param shards (specs divide the model-axis
+    # dims by 4) plus the axis-size scalar gradient_average divides by
+    local = [
+        jax.ShapeDtypeStruct(
+            tuple(d // mesh.shape[ax] if ax else d
+                  for d, ax in zip(leaf.shape, tuple(spec)
+                                   + (None,) * leaf.ndim)),
+            leaf.dtype)
+        for leaf, spec in zip(jax.tree_util.tree_leaves(params),
+                              jax.tree_util.tree_leaves(
+                                  specs, is_leaf=lambda s:
+                                  isinstance(s, P)))]
+    plan = parallel.allreduce_comm_plan(local)
+    act_bytes = (x.shape[0] // mesh.shape["data"]) * 8 * 4
+    ep.expect.setdefault("collectives", {
+        "counts": {"psum": 1 + len(plan) + 1},
+        "payload_bytes": act_bytes
+        + sum(b["wire_bytes"] for b in plan) + 4})
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(specs, P("data"), P("data")),
+                           out_specs=specs, check_vma=False)
+    return Graph(trace=_scoped(
+        _no_policy(), lambda: jax.make_jaxpr(mapped)(params, x, y)))
+
+
+register_entry_point(
+    "tp_mlp_train_step", tags=("training", "tp"),
+    description="DP x TP (2x4) ParallelMLP train step — Megatron "
+                "psum pattern + DDP grad bucket")(
+    _tp_train_step_graph)

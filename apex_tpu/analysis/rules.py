@@ -1,0 +1,275 @@
+"""The core rule set: every hot-path invariant the repo has paid to
+learn, pinned mechanically.
+
+Expectation schema (per entry point, all keys optional — a rule only
+runs where its key is present):
+
+``host_transfer`` (always on; opt out with ``allow_host_transfers``)
+    No host-boundary primitive may appear in a jitted hot graph.
+
+``donation``::
+
+    {"expect_donated": ("ids", "cache", "keys"),   # must be aliased
+     "forbid_donated": ("temps",),                 # extra local bans
+     "min_aliased": None}                          # default: donated leaf count
+
+    The global blocklist (``serving.DONATION_BLOCKLIST``: per-slot
+    length vectors ``cur_len``/``n_new``) is enforced on every donation
+    entry point — donating that argnum class corrupted executables
+    reloaded from the persistent XLA:CPU compile cache (PR 2).
+
+``amp``::
+
+    {"opt_level": "O2", "conv_dtype": "bfloat16", "dot_dtype": "bfloat16",
+     "min_convs": 40, "min_dots": 0, "dot_min_elems": 256}
+
+    ``conv_dtype``/``dot_dtype`` of ``None`` skips that op family.  The
+    ``min_*`` floors keep the rule non-vacuous: an empty graph is a
+    finding, not a pass.
+
+``layout``::
+
+    {"min_activation_elems": 12288, "allowed_6d_rearranges": 0}
+
+    No transpose on activation-sized tensors in channels-last graphs;
+    the 6-D block rearrange inside space_to_depth is the one sanctioned
+    exception (budgeted, not open-ended).
+
+``collectives``::
+
+    {"counts": {"psum": 4}, "payload_bytes": 40038408}
+
+    Exact comm accounting: any collective primitive not named in
+    ``counts`` is budgeted at zero, and the total on-wire payload must
+    match to the byte (``payload_tolerance`` relaxes it when needed).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+from .core import Rule, Finding, register_rule
+from . import graphs as G
+
+__all__ = ["HostTransferRule", "DonationRule", "AmpDtypeRule",
+           "LayoutRule", "CollectiveRule"]
+
+
+@register_rule
+class HostTransferRule(Rule):
+    """No device_get/callback/transfer primitives inside jitted hot
+    graphs — each one is a per-dispatch host round-trip."""
+
+    name = "host-transfer"
+    expect_key = None                        # unconditional
+
+    def applies(self, ep):
+        return not ep.expect.get("allow_host_transfers", False)
+
+    def check(self, ep, graph) -> List[Finding]:
+        hits = Counter(e.primitive.name
+                       for e in G.host_transfer_eqns(graph.jaxpr))
+        return [self.finding(
+            ep, f"host-transfer primitive {prim!r} appears {n}x in the "
+                f"jitted graph — a per-dispatch host sync",
+            primitive=prim, count=n) for prim, n in sorted(hits.items())]
+
+
+@register_rule
+class DonationRule(Rule):
+    """Every buffer the entry point promises to donate is actually
+    aliased in the lowered module; blocklisted per-slot length vectors
+    are never donated; no donated buffer is shared (double donation)."""
+
+    name = "donation"
+    expect_key = "donation"
+
+    def check(self, ep, graph) -> List[Finding]:
+        from ..serving import DONATION_BLOCKLIST
+        want = ep.expect["donation"]
+        out: List[Finding] = []
+        if graph.arg_names is None:
+            return [self.finding(
+                ep, "donation expectation without arg_names — cannot "
+                    "map donated buffers to arguments")]
+        donated, partial = G.donated_arg_names(graph.lowered,
+                                               graph.arg_names)
+        for name in want.get("expect_donated", ()):
+            if name not in donated:
+                out.append(self.finding(
+                    ep, f"argument {name!r} must be donated (multi-GB "
+                        f"buffer mutated every dispatch) but the "
+                        f"lowering does not alias it", argument=name))
+        forbid = tuple(want.get("forbid_donated", ())) + \
+            tuple(DONATION_BLOCKLIST)
+        for name in forbid:
+            if name in donated:
+                blocked = name in DONATION_BLOCKLIST
+                out.append(self.finding(
+                    ep, f"argument {name!r} is donated but "
+                        + ("is on the donation blocklist (per-slot "
+                           "length vectors corrupt executables reloaded "
+                           "from the persistent XLA compile cache — "
+                           "PR 2 gotcha)" if blocked else
+                           "this entry point forbids donating it"),
+                    argument=name, blocklisted=blocked))
+        for name in partial:
+            out.append(self.finding(
+                ep, f"argument {name!r} is only partially donated — "
+                    f"some leaves alias, some keep a second copy alive",
+                argument=name))
+        # the lowering must honor every requested donation
+        import jax
+        args_info, _ = graph.lowered.args_info
+        n_donated = sum(bool(i.donated)
+                        for i in jax.tree_util.tree_leaves(args_info))
+        min_aliased = want.get("min_aliased")
+        if min_aliased is None:
+            min_aliased = n_donated
+        n_aliased = G.aliased_output_count(graph.stablehlo)
+        if n_aliased < min_aliased:
+            out.append(self.finding(
+                ep, f"lowering aliases {n_aliased} buffers but "
+                    f"{min_aliased} donations were requested — XLA "
+                    f"silently dropped some (both copies stay alive)",
+                aliased=n_aliased, requested=min_aliased))
+        if graph.example_args is not None:
+            for dup in G.duplicate_donated_leaves(
+                    graph.lowered, graph.arg_names, graph.example_args):
+                out.append(self.finding(
+                    ep, f"double donation: {dup} — XLA rejects donating "
+                        f"one buffer twice (per-layer cache allocation "
+                        f"required; no dict(layer) shallow copies)",
+                    duplicate=dup))
+        return out
+
+
+@register_rule
+class AmpDtypeRule(Rule):
+    """Conv/matmul operand dtypes match the O-level policy — forward,
+    dgrad, and wgrad.  A single silently-upcast fp32 conv halves MXU
+    rate and doubles HBM traffic on that op; fp32 accumulation belongs
+    in ``preferred_element_type``, not operand upcasts."""
+
+    name = "amp-dtype"
+    expect_key = "amp"
+
+    def check(self, ep, graph) -> List[Finding]:
+        want = ep.expect["amp"]
+        out: List[Finding] = []
+        lvl = want.get("opt_level", "?")
+
+        conv_dtype = want.get("conv_dtype")
+        if conv_dtype is not None:
+            convs = G.conv_eqns(graph.jaxpr)
+            floor = want.get("min_convs", 1)
+            if len(convs) < floor:
+                out.append(self.finding(
+                    ep, f"vacuous check: expected >= {floor} convs "
+                        f"(fwd+dgrad+wgrad) in the {lvl} step, traced "
+                        f"{len(convs)}", convs=len(convs), floor=floor))
+            bad = Counter(
+                (str(e.invars[0].aval.dtype), str(e.invars[1].aval.dtype))
+                for e in convs
+                if not all(str(v.aval.dtype) == conv_dtype
+                           for v in e.invars[:2]))
+            for (lhs, rhs), n in sorted(bad.items()):
+                out.append(self.finding(
+                    ep, f"{n} conv(s) with ({lhs}, {rhs}) operands in "
+                        f"the {lvl} step — policy requires {conv_dtype} "
+                        f"(silent upcast)",
+                    lhs=lhs, rhs=rhs, count=n, expected=conv_dtype))
+
+        dot_dtype = want.get("dot_dtype")
+        if dot_dtype is not None:
+            dots = G.large_dot_eqns(graph.jaxpr,
+                                    want.get("dot_min_elems", 256))
+            floor = want.get("min_dots", 1)
+            if len(dots) < floor:
+                out.append(self.finding(
+                    ep, f"vacuous check: expected >= {floor} large dots "
+                        f"in the {lvl} step, traced {len(dots)}",
+                    dots=len(dots), floor=floor))
+            bad = Counter(
+                tuple(str(v.aval.dtype) for v in e.invars) for e in dots
+                if not all(str(v.aval.dtype) == dot_dtype
+                           for v in e.invars))
+            for dts, n in sorted(bad.items()):
+                out.append(self.finding(
+                    ep, f"{n} large dot(s) with {dts} operands in the "
+                        f"{lvl} step — policy requires {dot_dtype}",
+                    operands=list(dts), count=n, expected=dot_dtype))
+        return out
+
+
+@register_rule
+class LayoutRule(Rule):
+    """Channels-last graphs stay transpose-free on activation-sized
+    tensors — the whole point of the NHWC mode; a layout leak pays a
+    relayout on every step."""
+
+    name = "layout"
+    expect_key = "layout"
+
+    def check(self, ep, graph) -> List[Finding]:
+        want = ep.expect["layout"]
+        min_elems = want["min_activation_elems"]
+        out: List[Finding] = []
+        big = G.transpose_eqns(graph.jaxpr, min_elems)
+        # the 6-D block rearrange inside F.space_to_depth is the one
+        # sanctioned activation transpose (forward-only); it gets a
+        # budget, not a blanket pass
+        six_d = [e for e in big if e.invars[0].aval.ndim == 6]
+        other = [e for e in big if e.invars[0].aval.ndim != 6]
+        for e in other:
+            out.append(self.finding(
+                ep, f"activation-sized transpose "
+                    f"{tuple(e.invars[0].aval.shape)} "
+                    f"(permutation {e.params.get('permutation')}) in a "
+                    f"channels-last graph — layout leak",
+                shape=list(map(int, e.invars[0].aval.shape)),
+                permutation=list(e.params.get("permutation", ()))))
+        budget = want.get("allowed_6d_rearranges", 0)
+        if len(six_d) > budget:
+            out.append(self.finding(
+                ep, f"{len(six_d)} 6-D block rearranges, budget is "
+                    f"{budget} (space_to_depth runs forward-only; a "
+                    f"second copy means gradient flows through the "
+                    f"rearrange)", count=len(six_d), budget=budget))
+        return out
+
+
+@register_rule
+class CollectiveRule(Rule):
+    """The comm pattern is exactly what the algorithm assumes: expected
+    psum/all-gather eqn counts and on-wire payload bytes in DDP/TP/ZeRO
+    graphs.  A missing psum is a wrong answer; an extra one is a
+    regression the profiler would surface weeks later."""
+
+    name = "collective"
+    expect_key = "collectives"
+
+    def check(self, ep, graph) -> List[Finding]:
+        want = ep.expect["collectives"]
+        out: List[Finding] = []
+        eqns = G.collective_eqns(graph.jaxpr)
+        got = Counter(e.primitive.name for e in eqns)
+        expected = dict(want.get("counts", {}))
+        for prim in sorted(set(got) | set(expected)):
+            g, w = got.get(prim, 0), expected.get(prim, 0)
+            if g != w:
+                out.append(self.finding(
+                    ep, f"expected {w} {prim} eqn(s), graph has {g}",
+                    primitive=prim, expected=w, got=g))
+        if "payload_bytes" in want:
+            total = sum(G.eqn_payload_bytes(e) for e in eqns)
+            w = want["payload_bytes"]
+            tol = want.get("payload_tolerance", 0)
+            if abs(total - w) > tol:
+                out.append(self.finding(
+                    ep, f"collective payload is {total} bytes on the "
+                        f"wire, expected {w}"
+                        + (f" (+/- {tol})" if tol else ""),
+                    payload_bytes=total, expected_bytes=w))
+        return out

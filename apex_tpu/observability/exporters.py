@@ -28,7 +28,8 @@ from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "prometheus_text", "validate_bench_record",
-           "validate_bench_jsonl"]
+           "validate_bench_jsonl", "validate_lint_record",
+           "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 SCHEMA_VERSION = 1
 
@@ -167,6 +168,35 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
 
 # -- bench record schema --------------------------------------------------
 
+def _need(rec, errs, key, types, allow_none=False):
+    """Shared required-key type check (bool is not an int here)."""
+    if key not in rec:
+        errs.append(f"missing required key {key!r}")
+        return None
+    v = rec[key]
+    if v is None and allow_none:
+        return v
+    if not isinstance(v, types) or isinstance(v, bool) != (types is bool):
+        errs.append(f"{key!r} must be {types}, got {type(v).__name__}")
+    return v
+
+
+def _check_envelope(rec, errs):
+    """The common record envelope every exported line carries
+    (schema_version / capture host / first-class ``stale``) — one
+    implementation for bench and lint records."""
+    sv = _need(rec, errs, "schema_version", int)
+    if isinstance(sv, int) and not isinstance(sv, bool) and sv < 1:
+        errs.append(f"schema_version must be >= 1, got {sv}")
+    _need(rec, errs, "stale", bool)
+    host = _need(rec, errs, "host", dict)
+    if isinstance(host, dict):
+        if not isinstance(host.get("hostname"), str):
+            errs.append("host.hostname must be a string")
+        if not isinstance(host.get("pid"), int):
+            errs.append("host.pid must be an int")
+
+
 def validate_bench_record(rec: Any) -> List[str]:
     """Schema check for one bench JSONL record; returns a list of
     problems (empty = valid).  Shared by the pytest coverage and the
@@ -176,34 +206,17 @@ def validate_bench_record(rec: Any) -> List[str]:
         return [f"record is {type(rec).__name__}, not an object"]
 
     def need(key, types, allow_none=False):
-        if key not in rec:
-            errs.append(f"missing required key {key!r}")
-            return None
-        v = rec[key]
-        if v is None and allow_none:
-            return v
-        if not isinstance(v, types) or isinstance(v, bool) != (types is bool):
-            errs.append(f"{key!r} must be {types}, got {type(v).__name__}")
-        return v
+        return _need(rec, errs, key, types, allow_none)
 
-    sv = need("schema_version", int)
-    if isinstance(sv, int) and not isinstance(sv, bool) and sv < 1:
-        errs.append(f"schema_version must be >= 1, got {sv}")
+    _check_envelope(rec, errs)
     metric = need("metric", str)
     if isinstance(metric, str) and not metric:
         errs.append("metric must be non-empty")
-    need("stale", bool)
     need("value", numbers.Number, allow_none=True)
     need("unit", str, allow_none=True)
     need("backend", str)
     need("ndev", int)
     need("arch", str)
-    host = need("host", dict)
-    if isinstance(host, dict):
-        if not isinstance(host.get("hostname"), str):
-            errs.append("host.hostname must be a string")
-        if not isinstance(host.get("pid"), int):
-            errs.append("host.pid must be an int")
     for opt in ("note", "error", "recorded_at", "stale_recorded_at"):
         if opt in rec and not isinstance(rec[opt], str):
             errs.append(f"{opt!r} must be a string when present")
@@ -240,6 +253,76 @@ def validate_bench_record(rec: Any) -> List[str]:
 def validate_bench_jsonl(lines: Iterable[str]) -> List[str]:
     """Validate a bench stdout stream: every non-empty line must parse
     as JSON and pass the record schema."""
+    return _validate_jsonl(lines, validate_bench_record)
+
+
+# -- graph-lint record schema ---------------------------------------------
+
+_LINT_SEVERITIES = ("error", "warning", "info")
+
+
+def validate_lint_record(rec: Any) -> List[str]:
+    """Schema check for one graph-lint JSONL record (what
+    ``python -m apex_tpu.analysis`` and tests/ci/graph_lint.py emit):
+    the common envelope (schema_version / host / stale) plus either a
+    finding (``kind: graph_lint``) or the run summary
+    (``kind: graph_lint_summary``)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types):
+        return _need(rec, errs, key, types)
+
+    _check_envelope(rec, errs)
+    kind = rec.get("kind")
+    if kind == "graph_lint":
+        for key in ("rule", "entry_point", "message"):
+            v = need(key, str)
+            if isinstance(v, str) and not v:
+                errs.append(f"{key!r} must be non-empty")
+        sev = need("severity", str)
+        if isinstance(sev, str) and sev not in _LINT_SEVERITIES:
+            errs.append(f"severity must be one of {_LINT_SEVERITIES}, "
+                        f"got {sev!r}")
+        if "detail" in rec and not isinstance(rec["detail"], dict):
+            errs.append("'detail' must be an object when present")
+    elif kind == "graph_lint_summary":
+        for key in ("entry_points", "rules", "findings", "errors",
+                    "warnings"):
+            v = need(key, int)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                errs.append(f"{key!r} must be >= 0, got {v}")
+        f, e, w = (rec.get("findings"), rec.get("errors"),
+                   rec.get("warnings"))
+        if all(isinstance(v, int) for v in (f, e, w)) and f != e + w:
+            errs.append(f"findings ({f}) != errors ({e}) + warnings ({w})")
+    else:
+        errs.append(f"unknown lint kind {kind!r}")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
+def validate_telemetry_record(rec: Any) -> List[str]:
+    """Dispatching validator: graph-lint records (by ``kind``) go
+    through :func:`validate_lint_record`, everything else through the
+    bench schema — so one stream may interleave bench measurements and
+    lint findings (``bench.py --graph-lint``)."""
+    if isinstance(rec, dict) and rec.get("kind") in (
+            "graph_lint", "graph_lint_summary"):
+        return validate_lint_record(rec)
+    return validate_bench_record(rec)
+
+
+def validate_telemetry_jsonl(lines: Iterable[str]) -> List[str]:
+    """Validate a mixed bench + graph-lint JSONL stream."""
+    return _validate_jsonl(lines, validate_telemetry_record)
+
+
+def _validate_jsonl(lines: Iterable[str], validate) -> List[str]:
     errs: List[str] = []
     n = 0
     for i, raw in enumerate(lines, 1):
@@ -252,8 +335,10 @@ def validate_bench_jsonl(lines: Iterable[str]) -> List[str]:
         except ValueError as e:
             errs.append(f"line {i}: not JSON ({e})")
             continue
-        for e in validate_bench_record(rec):
-            errs.append(f"line {i} ({rec.get('metric', '?')}): {e}")
+        label = rec.get("metric") or rec.get("kind") or "?" \
+            if isinstance(rec, dict) else "?"
+        for e in validate(rec):
+            errs.append(f"line {i} ({label}): {e}")
     if n == 0:
         errs.append("no records found")
     return errs

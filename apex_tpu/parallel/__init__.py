@@ -7,7 +7,8 @@ from typing import List, Optional, Tuple
 from . import multiproc
 from .topology import make_mesh, mesh_info
 from .distributed import (DistributedDataParallel, Reducer,
-                          allreduce_grads_tree, flat_dist_call)
+                          allreduce_grads_tree, allreduce_comm_plan,
+                          flat_dist_call)
 from .sync_batchnorm import SyncBatchNorm
 from .LARC import LARC
 from . import tensor_parallel

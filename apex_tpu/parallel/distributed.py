@@ -41,7 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
-           "flat_dist_call"]
+           "allreduce_comm_plan", "flat_dist_call"]
 
 
 def _axis_size(axis_name: str) -> jax.Array:
@@ -173,6 +173,79 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                 new_leaves[i] = reduced[off:off + sz].reshape(leaves[i].shape)
                 off += sz
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def allreduce_comm_plan(grads: Any, message_size: int = 10_000_000,
+                        allreduce_always_fp32: bool = False,
+                        delay_allreduce: bool = False,
+                        trigger_paths: Optional[set] = None
+                        ) -> List[dict]:
+    """Static twin of :func:`allreduce_grads_tree`'s bucketing: what the
+    comm pattern of one allreduce WILL be, computed from shapes alone
+    (no tracing).  One dict per bucket::
+
+        {dtype, comm_dtype, leaves, elements, chunks, cause,
+         wire_elements, wire_bytes}
+
+    ``wire_elements`` includes chunk padding — the bytes a psum of this
+    bucket actually moves per replica.  Each bucket is exactly one psum
+    eqn in the traced step (the chunked path reshapes into one
+    ``(chunks, message_size)`` psum), so ``len(plan)`` is the expected
+    grad-psum count.  ``apex_tpu.analysis``'s collective-accounting rule
+    derives its DDP expectations from this plan: if the bucketing
+    algorithm changes, the plan and the traced graph move together,
+    while an accidental extra/missing/fatter collective still flags."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    plan: List[dict] = []
+    if not leaves:
+        return plan
+    paths = None
+    if trigger_paths:
+        flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
+        paths = [_path_str(p) for p, _ in flat_paths]
+        unknown = set(trigger_paths) - set(paths)
+        if unknown:
+            # mirror allreduce_grads_tree: a plan for a comm pattern
+            # the real step would refuse to trace is not a plan
+            raise ValueError(
+                f"allreduce_trigger_params paths not found in the "
+                f"gradient tree: {sorted(unknown)}; available: "
+                f"{paths[:8]}...")
+
+    groups: Dict[Any, List[int]] = {}
+    for i, g in enumerate(leaves):
+        groups.setdefault(jnp.dtype(g.dtype), []).append(i)
+
+    for dt, idxs in groups.items():
+        if trigger_paths:
+            buckets, cur = [], []
+            for i in idxs:
+                cur.append(i)
+                if paths[i] in trigger_paths:
+                    buckets.append(cur)
+                    cur = []
+            if cur:
+                buckets.append(cur)
+        else:
+            buckets = [idxs]
+        for bucket in buckets:
+            n = sum(int(leaves[i].size) for i in bucket)
+            comm_dt = jnp.dtype(jnp.float32) if allreduce_always_fp32 \
+                else dt
+            if delay_allreduce or trigger_paths or n <= message_size:
+                cause = ("trigger" if trigger_paths
+                         else "delay" if delay_allreduce else "single")
+                chunks, wire = 1, n
+            else:
+                cause = "chunked"
+                chunks = math.ceil(n / message_size)
+                wire = chunks * message_size
+            plan.append({
+                "dtype": str(dt), "comm_dtype": str(comm_dt),
+                "leaves": len(bucket), "elements": n, "chunks": chunks,
+                "cause": cause, "wire_elements": wire,
+                "wire_bytes": wire * comm_dt.itemsize})
+    return plan
 
 
 def _broadcast0(flat: jax.Array, axis_name: str,

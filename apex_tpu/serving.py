@@ -72,7 +72,26 @@ from jax import lax
 from .models.speculative import _head_logits
 from .observability import MetricsRegistry
 
-__all__ = ["Engine", "Seq2SeqEngine"]
+__all__ = ["Engine", "Seq2SeqEngine", "DONATION_BLOCKLIST",
+           "STEP_K_ARG_NAMES", "PREFILL_SLOT_ARG_NAMES",
+           "SEQ2SEQ_STEP_K_ARG_NAMES"]
+
+# Argument names the engine jits must NEVER donate: per-slot length
+# vectors.  Donating `_sstep`'s cur_len made executables RELOADED from
+# the persistent XLA:CPU compile cache decode garbage (fresh compiles
+# fine — single runs pass, the next warm run hangs; jax 0.4.37 AOT
+# quirk, PR 2).  apex_tpu.analysis's donation rule enforces this
+# blocklist over every registered serving entry point, so the gotcha
+# stays pinned even if the inline comments rot.
+DONATION_BLOCKLIST = ("cur_len", "n_new")
+
+# Positional parameter names of the jitted hot mutators, in signature
+# order — the analysis donation rule maps `Lowered.args_info` donation
+# flags back through these to name what is (and is not) aliased.
+STEP_K_ARG_NAMES = ("ids", "cur_len", "cache", "keys", "temps",
+                    "limit", "eos")
+PREFILL_SLOT_ARG_NAMES = ("ids", "cache", "d_cache", "slot", "row")
+SEQ2SEQ_STEP_K_ARG_NAMES = ("state", "out", "n_new", "limit", "eos")
 
 # generated tokens/sec per request spans toy CPU engines (~1/s) to
 # hardware batch decode (~10k/s)

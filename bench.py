@@ -187,6 +187,28 @@ def main():
             save_tpu_record([line])
         print(json.dumps(line), flush=True)
 
+    lint_errors = 0
+    if "--graph-lint" in sys.argv:
+        # prepend static graph-lint findings to the telemetry stream
+        # (validated by check_bench_schema.py's dispatching schema):
+        # bench certifies throughput, the lint certifies the graphs it
+        # measured kept their invariants.  run_lint is the same driver
+        # the CLI and CI gate use — summary shape and severity tallies
+        # cannot drift.  Entry points tracing an 8-way mesh skip on
+        # smaller ambient device counts (plain-CPU smoke hosts).
+        from apex_tpu import analysis
+        summary = analysis.run_lint(
+            emit=lambda rec: print(
+                json.dumps(JsonlExporter.enrich(rec)), flush=True),
+            skip_runtime_errors=True,
+            on_skip=lambda ep, e: print(
+                f"bench --graph-lint: skipping {ep.name}: {e}",
+                file=sys.stderr))
+        lint_errors = summary["errors"]
+        print(f"bench --graph-lint: {lint_errors} error(s), "
+              f"{summary.get('skipped_entry_points', 0)} skipped "
+              f"entry point(s)", file=sys.stderr)
+
     def timed(train, state, batch, iters, warmup):
         """sec/step with a hard D2H fetch as the barrier —
         block_until_ready is not a reliable completion barrier on
@@ -1063,6 +1085,10 @@ def main():
             for ln in stale_lines(rec):
                 print(json.dumps(JsonlExporter.enrich(ln)), flush=True)
 
+    # --graph-lint failures surface in the exit status (measurements
+    # above still ran and were emitted); plain runs keep exiting 0
+    return 1 if lint_errors else 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -123,7 +123,7 @@ def main():
             eng.submit(pr, max_new_tokens=args.new)
         n = 0
         while eng.live() or eng.stats()["waiting"]:
-            n += len(eng.step())
+            n += sum(len(t) for t in eng.step().values())
         return eng.stats(), n
 
     st, n = timed("engine", run_engine)
@@ -147,7 +147,7 @@ def main():
                        max_new_tokens=args.new)
         n = 0
         while eng.live() or eng.stats()["waiting"]:
-            n += len(eng.step())
+            n += sum(len(t) for t in eng.step().values())
         return eng.stats(), n
 
     st, n = timed("seq2seq", run_seq2seq)

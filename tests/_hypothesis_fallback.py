@@ -1,0 +1,94 @@
+"""Minimal stand-in for the slice of hypothesis tests/test_properties.py
+uses, for environments without the real package (this container bakes
+its deps and tier-1 must still COLLECT AND RUN the property suite, not
+skip it).
+
+Faithful where it matters, deliberately small everywhere else:
+
+- ``given(**kwargs)`` draws ``max_examples`` pseudo-random examples per
+  test from a fixed seed (deterministic across runs — a property
+  failure reproduces) and reports the failing example like hypothesis
+  does;
+- strategies implement only ``integers``, ``booleans``, ``lists``,
+  ``sampled_from`` — the combinators the suite needs;
+- no shrinking, no database, no deadline machinery (``settings`` only
+  honors ``max_examples``).
+
+If the real hypothesis is installed it wins (see the import guard in
+test_properties.py); this module never shadows it.
+"""
+
+import numpy as np
+
+__all__ = ["given", "settings", "strategies"]
+
+_DEFAULT_MAX_EXAMPLES = 20
+_SEED = 0xA9E7  # fixed: failures must reproduce run-to-run
+
+
+class _Strategy:
+    def __init__(self, draw):
+        self._draw = draw
+
+    def example(self, rng):
+        return self._draw(rng)
+
+
+class strategies:
+    """Namespace mirroring ``hypothesis.strategies`` (imported
+    ``as st``)."""
+
+    @staticmethod
+    def integers(min_value, max_value):
+        return _Strategy(
+            lambda rng: int(rng.randint(min_value, max_value + 1)))
+
+    @staticmethod
+    def booleans():
+        return _Strategy(lambda rng: bool(rng.randint(0, 2)))
+
+    @staticmethod
+    def sampled_from(options):
+        options = list(options)
+        return _Strategy(lambda rng: options[rng.randint(len(options))])
+
+    @staticmethod
+    def lists(elements, min_size=0, max_size=10):
+        def draw(rng):
+            n = int(rng.randint(min_size, max_size + 1))
+            return [elements.example(rng) for _ in range(n)]
+        return _Strategy(draw)
+
+
+def settings(max_examples=_DEFAULT_MAX_EXAMPLES, deadline=None, **_):
+    """Decorator: stash the example budget on the (given-wrapped)
+    test."""
+    def deco(fn):
+        fn._fallback_max_examples = max_examples
+        return fn
+    return deco
+
+
+def given(**strats):
+    def deco(fn):
+        # NOT functools.wraps: that sets __wrapped__, which makes
+        # pytest resolve the ORIGINAL signature and demand fixtures
+        # named like the strategy kwargs — the wrapper must present a
+        # zero-arg test
+        def wrapper():
+            n = getattr(wrapper, "_fallback_max_examples",
+                        _DEFAULT_MAX_EXAMPLES)
+            rng = np.random.RandomState(_SEED)
+            for i in range(n):
+                drawn = {k: s.example(rng) for k, s in strats.items()}
+                try:
+                    fn(**drawn)
+                except Exception as e:
+                    raise AssertionError(
+                        f"falsifying example ({i + 1}/{n}): "
+                        f"{drawn!r}") from e
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__module__ = fn.__module__
+        return wrapper
+    return deco

@@ -7,10 +7,16 @@ Every stdout line bench emits must be a JSON object carrying
 serving decode lines (metric containing ``engine_decode``) must also
 carry the decode-window fields: ``window`` (int >= 1, in-graph decode
 ticks per host sync) and a tokens/sec unit — the w1-vs-wK comparison
-is meaningless without them.  Usage:
+is meaningless without them.  Graph-lint records (``kind:
+graph_lint`` / ``graph_lint_summary``, from ``python -m
+apex_tpu.analysis``, ``bench.py --graph-lint`` or
+tests/ci/graph_lint.py) are validated against the lint schema
+(``validate_lint_record``); the two record families may interleave in
+one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
+    python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
 
 Exit status 0 = every record valid; 1 = any schema violation (each is
 printed).  Stderr chatter must not be piped in — bench keeps stdout
@@ -46,13 +52,13 @@ def _load_exporters():
 
 
 def main(argv):
-    validate_bench_jsonl = _load_exporters().validate_bench_jsonl
+    validate_telemetry_jsonl = _load_exporters().validate_telemetry_jsonl
     if len(argv) > 1:
         with open(argv[1]) as f:
             lines = f.readlines()
     else:
         lines = sys.stdin.readlines()
-    errs = validate_bench_jsonl(lines)
+    errs = validate_telemetry_jsonl(lines)
     for e in errs:
         print(f"check_bench_schema: {e}", file=sys.stderr)
     if errs:

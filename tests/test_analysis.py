@@ -1,0 +1,473 @@
+"""Mutation tests for apex_tpu.analysis: every rule must FLAG its
+deliberately-broken graph and PASS its fixed twin — no rule is allowed
+to pass vacuously.
+
+The clean-repo assertions (zero findings over the real entry-point
+registry) live in tests/test_step_graph_audit.py; here we feed the rule
+engine synthetic entry points with seeded violations: a host sync
+smuggled into a scan body, an un-donated cache, a blocklisted
+``cur_len`` donation, a shared-buffer double donation, a forced fp32
+conv under an O2 expectation, an injected activation transpose, and a
+comm pattern that disagrees with its accounting.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import analysis, serving
+from apex_tpu.analysis import EntryPoint, Graph
+from apex_tpu.observability import exporters
+
+
+def _ep(name, expect=None, **graph_kw):
+    ep = EntryPoint(name, lambda ep: Graph(**graph_kw), expect=expect)
+    return ep
+
+
+def _run(ep, rule):
+    return analysis.analyze_entry_point(ep, rules=[rule])
+
+
+# -- host-transfer rule ---------------------------------------------------
+
+def test_host_transfer_rule_flags_seeded_callback():
+    """A pure_callback inside the scanned decode body is exactly the
+    per-tick host sync the serving window exists to kill — the rule
+    must see through the scan."""
+    def tick(carry, _):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct(carry.shape, carry.dtype), carry)
+        return y + 1.0, y.sum()
+
+    def stepped(x):
+        out, ys = jax.lax.scan(tick, x, None, length=4)
+        return out, ys
+
+    ep = _ep("mutant_host_sync",
+             trace=lambda: jax.make_jaxpr(stepped)(jnp.ones(8)))
+    found = _run(ep, "host-transfer")
+    assert len(found) == 1
+    assert found[0].severity == "error"
+    assert found[0].detail["primitive"] == "pure_callback"
+    assert found[0].detail["count"] == 1      # scan body counted once
+
+    clean = _ep("clean_host_sync",
+                trace=lambda: jax.make_jaxpr(
+                    lambda x: jax.lax.scan(
+                        lambda c, _: (c + 1.0, c.sum()), x, None,
+                        length=4))(jnp.ones(8)))
+    assert _run(clean, "host-transfer") == []
+
+
+def test_host_transfer_rule_optout():
+    ep = _ep("optout", expect={"allow_host_transfers": True},
+             trace=lambda: None)
+    assert not analysis.get_rule("host-transfer").applies(ep)
+
+
+# -- donation rule --------------------------------------------------------
+
+def _donation_ep(name, fn, donate, args, arg_names, expect_donation):
+    jitted = jax.jit(fn, donate_argnums=donate)
+    return _ep(name, expect={"donation": expect_donation},
+               trace=lambda: jax.make_jaxpr(fn)(*args),
+               lower=lambda: jitted.lower(*args),
+               arg_names=arg_names, example_args=args)
+
+
+def _bump(ids, cache):
+    return ids + 1, jax.tree_util.tree_map(lambda c: c + 1.0, cache)
+
+
+def test_donation_rule_flags_unaliased_cache():
+    """An entry point that promises a donated KV cache but whose jit
+    forgot donate_argnums: without donation XLA keeps a second copy of
+    the multi-GB cache alive across every dispatch."""
+    cache = {"0": jnp.zeros((2, 8)), "1": jnp.zeros((2, 8))}
+    args = (jnp.zeros((2, 4), jnp.int32), cache)
+    broken = _donation_ep("mutant_undonated", _bump, (), args,
+                          ("ids", "cache"),
+                          {"expect_donated": ("ids", "cache")})
+    found = _run(broken, "donation")
+    assert {f.detail.get("argument") for f in found} == {"ids", "cache"}
+    assert all(f.severity == "error" for f in found)
+
+    fixed = _donation_ep("fixed_donated", _bump, (0, 1), args,
+                         ("ids", "cache"),
+                         {"expect_donated": ("ids", "cache")})
+    assert _run(fixed, "donation") == []
+
+
+def test_donation_rule_flags_blocklisted_cur_len():
+    """Donating the per-slot length vector is the PR 2 compile-cache
+    corruption; serving.DONATION_BLOCKLIST pins it permanently and the
+    rule enforces it even when the entry point's own expectation
+    forgot to mention cur_len."""
+    assert "cur_len" in serving.DONATION_BLOCKLIST
+    assert "n_new" in serving.DONATION_BLOCKLIST
+
+    def stepish(cur_len, cache):
+        return cur_len + 1, jax.tree_util.tree_map(lambda c: c + 1.0,
+                                                   cache)
+
+    cache = {"k": jnp.zeros((2, 8))}
+    args = (jnp.zeros((2,), jnp.int32), cache)
+    broken = _donation_ep("mutant_blocklist", stepish, (0, 1), args,
+                          ("cur_len", "cache"),
+                          {"expect_donated": ("cache",)})
+    found = _run(broken, "donation")
+    assert len(found) == 1
+    assert found[0].detail["argument"] == "cur_len"
+    assert found[0].detail["blocklisted"] is True
+
+    fixed = _donation_ep("fixed_blocklist", stepish, (1,), args,
+                         ("cur_len", "cache"),
+                         {"expect_donated": ("cache",)})
+    assert _run(fixed, "donation") == []
+
+
+def test_donation_rule_flags_double_donation():
+    """The gpt init_cache gotcha: a zeros buffer shared across layers
+    (dict(layer) shallow copy) donated once per layer — XLA rejects
+    'Attempt to donate the same buffer twice' only at compile time;
+    the rule catches it statically from the example args."""
+    shared = jnp.zeros((2, 8))
+    cache = {"0": {"k": shared}, "1": {"k": shared}}   # the bug
+    args = (jnp.zeros((2, 4), jnp.int32), cache)
+    broken = _donation_ep("mutant_double", _bump, (0, 1), args,
+                          ("ids", "cache"),
+                          {"expect_donated": ("ids", "cache")})
+    found = _run(broken, "donation")
+    assert len(found) == 1
+    assert "shares a buffer" in found[0].detail["duplicate"]
+
+    per_layer = {"0": {"k": jnp.zeros((2, 8))},
+                 "1": {"k": jnp.zeros((2, 8))}}
+    fixed = _donation_ep("fixed_double", _bump, (0, 1),
+                         (jnp.zeros((2, 4), jnp.int32), per_layer),
+                         ("ids", "cache"),
+                         {"expect_donated": ("ids", "cache")})
+    assert _run(fixed, "donation") == []
+
+
+def test_donation_rule_flags_forbidden_argument():
+    args = (jnp.zeros((2, 4), jnp.int32), {"k": jnp.zeros((2, 8))})
+    broken = _donation_ep("mutant_forbidden", _bump, (0, 1), args,
+                          ("ids", "cache"),
+                          {"expect_donated": ("cache",),
+                           "forbid_donated": ("ids",)})
+    found = _run(broken, "donation")
+    assert len(found) == 1
+    assert found[0].detail["argument"] == "ids"
+    assert found[0].detail["blocklisted"] is False
+
+
+# -- amp dtype rule -------------------------------------------------------
+
+def _conv_graph(dtype):
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x = jnp.ones((2, 8, 8, 4), dtype)
+    w = jnp.ones((3, 3, 4, 4), dtype)
+    return lambda: jax.make_jaxpr(f)(x, w)
+
+
+_O2_AMP = {"opt_level": "O2", "conv_dtype": "bfloat16", "min_convs": 1}
+
+
+def test_amp_rule_flags_forced_fp32_conv():
+    broken = _ep("mutant_fp32_conv", expect={"amp": dict(_O2_AMP)},
+                 trace=_conv_graph(jnp.float32))
+    found = _run(broken, "amp-dtype")
+    assert len(found) == 1
+    assert found[0].detail == {"lhs": "float32", "rhs": "float32",
+                               "count": 1, "expected": "bfloat16"}
+
+    fixed = _ep("fixed_bf16_conv", expect={"amp": dict(_O2_AMP)},
+                trace=_conv_graph(jnp.bfloat16))
+    assert _run(fixed, "amp-dtype") == []
+
+
+def test_amp_rule_vacuity_guard():
+    """A graph with no convs under a conv expectation is a finding,
+    not a silent pass — the floor keeps every rule non-vacuous."""
+    empty = _ep("mutant_convless", expect={"amp": dict(_O2_AMP)},
+                trace=lambda: jax.make_jaxpr(lambda x: x * 2.0)(
+                    jnp.ones((4,), jnp.bfloat16)))
+    found = _run(empty, "amp-dtype")
+    assert len(found) == 1
+    assert "vacuous" in found[0].message
+
+
+def test_amp_rule_flags_fp32_dot():
+    def f(a, b):
+        return a @ b
+    broken = _ep("mutant_fp32_dot",
+                 expect={"amp": {"opt_level": "O2",
+                                 "dot_dtype": "bfloat16",
+                                 "min_dots": 1}},
+                 trace=lambda: jax.make_jaxpr(f)(
+                     jnp.ones((32, 32)), jnp.ones((32, 32))))
+    found = _run(broken, "amp-dtype")
+    assert len(found) == 1
+    assert found[0].detail["operands"] == ["float32", "float32"]
+
+
+# -- layout rule ----------------------------------------------------------
+
+_LAYOUT = {"min_activation_elems": 256, "allowed_6d_rearranges": 0}
+
+
+def test_layout_rule_flags_injected_transpose():
+    def leaky(x):
+        return jnp.transpose(x, (0, 3, 1, 2)).sum()   # NHWC -> NCHW
+
+    broken = _ep("mutant_transpose", expect={"layout": dict(_LAYOUT)},
+                 trace=lambda: jax.make_jaxpr(leaky)(
+                     jnp.ones((2, 8, 8, 4))))
+    found = _run(broken, "layout")
+    assert len(found) == 1
+    assert found[0].detail["shape"] == [2, 8, 8, 4]
+    assert found[0].detail["permutation"] == [0, 3, 1, 2]
+
+    fixed = _ep("fixed_transpose", expect={"layout": dict(_LAYOUT)},
+                trace=lambda: jax.make_jaxpr(lambda x: x.sum())(
+                    jnp.ones((2, 8, 8, 4))))
+    assert _run(fixed, "layout") == []
+
+
+def test_layout_rule_6d_budget():
+    def s2d_like(x):
+        b, h, w, c = x.shape
+        y = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        return jnp.transpose(y, (0, 1, 3, 2, 4, 5)).sum()
+
+    over = _ep("mutant_6d", expect={"layout": dict(_LAYOUT)},
+               trace=lambda: jax.make_jaxpr(s2d_like)(
+                   jnp.ones((2, 8, 8, 4))))
+    found = _run(over, "layout")
+    assert len(found) == 1
+    assert found[0].detail == {"count": 1, "budget": 0}
+
+    budgeted = _ep("fixed_6d",
+                   expect={"layout": dict(_LAYOUT,
+                                          allowed_6d_rearranges=1)},
+                   trace=lambda: jax.make_jaxpr(s2d_like)(
+                       jnp.ones((2, 8, 8, 4))))
+    assert _run(budgeted, "layout") == []
+
+
+# -- collective accounting rule -------------------------------------------
+
+def _psum_graph(n_psums):
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def f(x):
+        for _ in range(n_psums):
+            x = jax.lax.psum(x, "data")
+        return x
+
+    mapped = jax.shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                           out_specs=P("data"), check_vma=False)
+    return lambda: jax.make_jaxpr(mapped)(jnp.ones((4, 8)))
+
+
+def test_collective_rule_flags_wrong_count_and_payload():
+    """An algorithm that assumes 2 allreduces but traces 1 (or moves
+    the wrong number of bytes) is a wrong answer, not a perf bug —
+    exactly what adaptive-summation-style schemes depend on."""
+    broken = _ep("mutant_collective",
+                 expect={"collectives": {"counts": {"psum": 2},
+                                         "payload_bytes": 2 * 2 * 8 * 4}},
+                 trace=_psum_graph(1))
+    found = _run(broken, "collective")
+    assert {f.detail.get("primitive", "payload")
+            for f in found} == {"psum", "payload"}
+    count = [f for f in found if "primitive" in f.detail][0]
+    assert (count.detail["expected"], count.detail["got"]) == (2, 1)
+
+    fixed = _ep("fixed_collective",
+                expect={"collectives": {"counts": {"psum": 2},
+                                        "payload_bytes": 2 * 2 * 8 * 4}},
+                trace=_psum_graph(2))
+    assert _run(fixed, "collective") == []
+
+
+def test_collective_rule_flags_unbudgeted_collective():
+    """A collective primitive the expectation never mentioned is
+    budgeted at zero — a smuggled all-gather can't hide."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    mapped = jax.shard_map(
+        lambda x: jax.lax.all_gather(x, "data"), mesh=mesh,
+        in_specs=(P("data"),), out_specs=P(), check_vma=False)
+    ep = _ep("mutant_unbudgeted",
+             expect={"collectives": {"counts": {}}},
+             trace=lambda: jax.make_jaxpr(mapped)(jnp.ones((4, 8))))
+    found = _run(ep, "collective")
+    assert len(found) == 1
+    assert found[0].detail["primitive"] == "all_gather"
+
+
+def test_comm_plan_matches_traced_buckets():
+    """allreduce_comm_plan is the static twin of the traced bucketing:
+    per-dtype buckets, chunk padding and wire bytes line up with what
+    allreduce_grads_tree records at trace time."""
+    from apex_tpu.parallel import allreduce_comm_plan
+    grads = {"a": jnp.zeros((3000,)), "b": jnp.zeros((5000,)),
+             "c": jnp.zeros((100,), jnp.bfloat16)}
+    plan = allreduce_comm_plan(grads, message_size=4096)
+    by_dtype = {b["dtype"]: b for b in plan}
+    f32 = by_dtype["float32"]
+    assert (f32["elements"], f32["chunks"], f32["cause"]) == \
+        (8000, 2, "chunked")
+    assert f32["wire_bytes"] == 2 * 4096 * 4
+    bf16 = by_dtype["bfloat16"]
+    assert (bf16["elements"], bf16["chunks"], bf16["cause"]) == \
+        (100, 1, "single")
+    assert bf16["wire_bytes"] == 200
+    # the plan mirrors the runtime's unknown-trigger-path rejection: a
+    # plan for a comm pattern the real step refuses to trace is no plan
+    with pytest.raises(ValueError, match="not found"):
+        allreduce_comm_plan(grads, trigger_paths={"nope/typo"})
+
+
+# -- findings as JSONL: schema + exporters integration --------------------
+
+def _enriched(finding):
+    return exporters.JsonlExporter.enrich(finding.to_record())
+
+
+def test_lint_record_schema_roundtrip():
+    f = analysis.Finding(rule="donation", entry_point="engine_step_k",
+                         message="cache not aliased",
+                         detail={"argument": "cache"})
+    rec = _enriched(f)
+    assert exporters.validate_lint_record(rec) == []
+    assert rec["kind"] == "graph_lint"
+    assert rec["schema_version"] >= 1 and rec["stale"] is False
+
+    bad = dict(rec)
+    bad["severity"] = "catastrophic"
+    assert any("severity" in e
+               for e in exporters.validate_lint_record(bad))
+    missing = {k: v for k, v in rec.items() if k != "rule"}
+    assert any("rule" in e
+               for e in exporters.validate_lint_record(missing))
+
+
+def test_lint_summary_schema():
+    good = exporters.JsonlExporter.enrich(
+        {"kind": "graph_lint_summary", "entry_points": 13, "rules": 5,
+         "findings": 2, "errors": 1, "warnings": 1})
+    assert exporters.validate_lint_record(good) == []
+    bad = dict(good, findings=3)
+    assert any("errors" in e for e in exporters.validate_lint_record(bad))
+
+
+def test_telemetry_jsonl_validates_mixed_stream():
+    """One stream may interleave bench records and lint findings
+    (bench.py --graph-lint); the dispatching validator checks each
+    against its own schema."""
+    import json
+    bench_rec = exporters.JsonlExporter.enrich(
+        {"metric": "engine_decode", "value": 100.0,
+         "unit": "tokens/sec", "backend": "cpu", "ndev": 1,
+         "arch": "gpt", "window": 8, "tokens_per_sync": 8.0})
+    lint_rec = _enriched(analysis.Finding(
+        rule="layout", entry_point="x", message="leak"))
+    lines = [json.dumps(bench_rec), json.dumps(lint_rec)]
+    assert exporters.validate_telemetry_jsonl(lines) == []
+    # a lint violation is caught positionally
+    lint_rec2 = dict(lint_rec, message="")
+    lines = [json.dumps(bench_rec), json.dumps(lint_rec2)]
+    errs = exporters.validate_telemetry_jsonl(lines)
+    assert len(errs) == 1 and "line 2" in errs[0]
+    # and a bench violation still is too
+    bench_bad = {k: v for k, v in bench_rec.items() if k != "window"}
+    errs = exporters.validate_telemetry_jsonl([json.dumps(bench_bad)])
+    assert any("window" in e for e in errs)
+
+
+def test_findings_to_records_and_registry_surface():
+    assert set(analysis.RULES) == {"host-transfer", "donation",
+                                   "amp-dtype", "layout", "collective"}
+    for name in ("ddp_resnet18_o2", "engine_step_k", "seq2seq_step_k",
+                 "tp_mlp_train_step"):
+        assert name in analysis.ENTRY_POINTS
+    f = analysis.Finding(rule="r", entry_point="e", message="m")
+    (rec,) = analysis.findings_to_records([f])
+    assert rec == {"kind": "graph_lint", "rule": "r", "severity": "error",
+                   "entry_point": "e", "message": "m"}
+
+
+def test_entry_point_build_restores_global_policy():
+    """amp.initialize(O1) installs a process-wide cast policy and
+    nothing uninstalls it; EntryPoint.graph() must restore the global
+    after every build, or the O1 entry point silently re-dtypes every
+    graph built after it in the same process (the CLI has no conftest
+    _reset_amp_policy to hide behind — this leak shifted the TP entry
+    point's psum payload from fp32 to bf16 when first caught)."""
+    from apex_tpu import amp, models, optimizers
+    from apex_tpu.amp import policy as P
+    name = "mutant_policy_leak"
+
+    def build(ep):
+        amp.initialize(models.resnet18(num_classes=10),
+                       optimizers.FusedAdam(1e-3), opt_level="O1",
+                       verbosity=0)
+        assert not isinstance(P.current_policy(), P.NoPolicy)
+        return Graph(trace=lambda: None)
+
+    analysis.register_entry_point(name)(build)
+    try:
+        before = P.current_policy()
+        analysis.get(name).graph()
+        assert P.current_policy() is before
+    finally:
+        del analysis.ENTRY_POINTS[name]
+
+
+# -- CLI ------------------------------------------------------------------
+
+def test_cli_list_and_single_entry_point(capsys):
+    from apex_tpu.analysis.__main__ import main
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "engine_step_k" in out and "rules:" in out
+
+    # lint one cheap entry point end to end: stdout must be pure
+    # schema-valid JSONL ending in a summary record
+    assert main(["--entry-points", "engine_prefill_slot"]) == 0
+    out = capsys.readouterr().out
+    assert exporters.validate_telemetry_jsonl(out.splitlines()) == []
+    import json
+    last = json.loads(out.strip().splitlines()[-1])
+    assert last["kind"] == "graph_lint_summary"
+    assert last["errors"] == 0
+
+
+def test_cli_exit_nonzero_on_finding(monkeypatch):
+    """The CI gate contract: any error finding => exit 1.  Register a
+    throwaway broken entry point, lint only it, then clean up."""
+    from apex_tpu.analysis.__main__ import main
+    name = "mutant_cli_host_sync"
+
+    def build(ep):
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return Graph(trace=lambda: jax.make_jaxpr(f)(jnp.ones(4)))
+
+    analysis.register_entry_point(name)(build)
+    try:
+        assert main(["--entry-points", name,
+                     "--rules", "host-transfer"]) == 1
+    finally:
+        del analysis.ENTRY_POINTS[name]

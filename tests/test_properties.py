@@ -10,7 +10,13 @@ directly.
 import numpy as np
 import jax
 import jax.numpy as jnp
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    # the container bakes its deps; the property suite still collects
+    # and RUNS on the minimal deterministic fallback (no shrinking)
+    from _hypothesis_fallback import given, settings, strategies as st
 
 from apex_tpu.amp.scaler import LossScaler
 from apex_tpu.multi_tensor_apply.flatten import (pack_flat, unpack_flat,

@@ -1,314 +1,194 @@
-"""Trace-level audit of the hot training step (perf regression guards).
+"""Trace-level audit of the hot graphs, driven by ``apex_tpu.analysis``.
 
 The round-3 perf campaign showed the headline cost lives in the conv
-backward + optimizer (PERF_NOTES_r3.md); these tests pin the properties
-that keep that cost minimal and that a silent regression would destroy:
+backward + optimizer; PR 1 added device-resident telemetry and PR 2 the
+donated decode window.  The properties that keep those wins — bf16 MXU
+operands under O2, transpose-free channels-last, zero host transfers in
+jitted hot graphs, every KV buffer aliased, the exact DDP/TP collective
+pattern — are now pinned by the static-analysis framework: these tests
+run the SAME rules over the SAME entry-point registry as the CI gate
+(tests/ci/graph_lint.py) and the CLI (``python -m apex_tpu.analysis``),
+so there is exactly one implementation of every invariant.
 
-  * under amp O2 every convolution in the jitted train step — forward,
-    dgrad, and wgrad — consumes bf16 operands (a policy or cast bug
-    that upcasts one conv family to fp32 would double its time on the
-    MXU and halve effective HBM bandwidth);
-  * the channels-last (NHWC input_format) step stays transpose-free on
-    activation-sized tensors (the whole point of the layout mode —
-    reference-side analogue: --channels-last in
-    examples/imagenet/main_amp.py).
-
-Jaxpr properties are backend-independent, so the guard runs on the CPU
-mesh while asserting what the TPU executable will see.
+Mutation coverage (each rule demonstrably catches its broken-graph
+counterpart) lives in tests/test_analysis.py; this file asserts the
+clean repo is clean, plus the runtime host-sync arithmetic no jaxpr
+can express.  Jaxpr properties are backend-independent, so the guard
+runs on the CPU mesh while asserting what the TPU executable will see.
 """
 
 import numpy as np
+import pytest
 import jax
-import jax.numpy as jnp
 
-from apex_tpu import amp, observability, optimizers, parallel, models
-from apex_tpu.nn import functional as F
+from apex_tpu import analysis
 
 
-def _traced_step(channels_last=False, input_format="NCHW", stem="conv7",
-                 B=8, image=32, telemetry=False):
-    """Trace the REAL DDP train step — shard_map over the 8-device CPU
-    mesh with the grad allreduce inside — so the audit covers the same
-    graph bench.py's headline and the imagenet example execute.
-
-    ``telemetry=True`` threads an observability.DeviceMetrics state
-    through the step carry (step/overflow counters, loss-scale and
-    grad-norm gauges) — the fully-instrumented shape of the hot loop."""
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    model, opt = amp.initialize(
-        models.resnet18(num_classes=10, channels_last=channels_last,
-                        input_format=input_format, stem=stem),
-        optimizers.FusedAdam(1e-3), opt_level="O2", verbosity=0)
-    ddp = parallel.DistributedDataParallel(model)
-    params, bn = model.init(jax.random.PRNGKey(0))
-    ost = opt.init(params)
-    rng = np.random.RandomState(0)
-    shape = (B, 3, image, image) if input_format == "NCHW" \
-        else (B, image, image, 3)
-    x = jnp.asarray(rng.randn(*shape), jnp.float32)
-    y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
-    dm = observability.DeviceMetrics(
-        counters=("steps", "overflows"),
-        gauges=("loss_scale", "grad_norm")) if telemetry else None
-
-    def step(state, batch):
-        if telemetry:
-            params, bn, ost, tele = state
-        else:
-            params, bn, ost = state
-        xb, yb = batch
-
-        def loss_fn(p):
-            out, nb = model.apply(p, xb, state=bn, train=True)
-            return F.cross_entropy(out, yb), nb
-
-        loss, nb, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
-        g = ddp.allreduce_grads(g)
-        params, ost2, info = opt.step(params, ost, g)
-        if telemetry:
-            tele = dm.inc(tele, "steps")
-            tele = dm.inc(tele, "overflows", info["found_inf"])
-            tele = dm.set(tele, "loss_scale", info["loss_scale"])
-            tele = dm.set(tele, "grad_norm", info["grad_norm"])
-            return (params, nb, ost2, tele), jax.lax.pmean(loss, "data")
-        return (params, nb, ost2), jax.lax.pmean(loss, "data")
-
-    state = (params, bn, ost) + ((dm.init(),) if telemetry else ())
-    mesh = Mesh(np.array(jax.devices()), ("data",))
-    mapped = jax.shard_map(step, mesh=mesh,
-                           in_specs=(P(), (P("data"), P("data"))),
-                           out_specs=(P(), P()), check_vma=False)
-    return jax.make_jaxpr(mapped)(state, (x, y))
+def _findings(name, rules=None):
+    return analysis.analyze_entry_point(analysis.get(name), rules=rules)
 
 
-def _walk(jaxpr):
-    """Yield every eqn in a (closed) jaxpr, recursing into sub-jaxprs."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for sub in jax.tree_util.tree_leaves(
-                    v, is_leaf=lambda x: isinstance(
-                        x, (jax.extend.core.Jaxpr, jax.extend.core.ClosedJaxpr))):
-                if isinstance(sub, jax.extend.core.ClosedJaxpr):
-                    yield from _walk(sub.jaxpr)
-                elif isinstance(sub, jax.extend.core.Jaxpr):
-                    yield from _walk(sub)
+def _assert_clean(name, rules=None):
+    found = _findings(name, rules=rules)
+    assert not found, "\n".join(str(f) for f in found)
 
+
+# -- amp dtype policy: the O2 train step keeps bf16 on the MXU ------------
 
 def test_o2_step_convs_all_bf16():
-    jpr = _traced_step()
-    convs = [e for e in _walk(jpr.jaxpr)
-             if e.primitive.name == "conv_general_dilated"]
-    # resnet18 fwd has 20 convs (incl. 3 downsample); backward adds
-    # dgrad+wgrad per conv minus the input dgrad -> sanity-floor only
-    assert len(convs) >= 40, f"expected fwd+bwd convs, got {len(convs)}"
-    bad = [(e.invars[0].aval.dtype, e.invars[1].aval.dtype)
-           for e in convs
-           if not (e.invars[0].aval.dtype == jnp.bfloat16
-                   and e.invars[1].aval.dtype == jnp.bfloat16)]
-    assert not bad, f"non-bf16 convs in O2 step: {bad[:5]} (+{len(bad)} total)"
+    """Under amp O2 every convolution in the jitted DDP train step —
+    forward, dgrad, and wgrad — consumes bf16 operands (a policy or
+    cast bug that upcasts one conv family to fp32 would double its time
+    on the MXU and halve effective HBM bandwidth).  The amp-dtype rule
+    carries a >= 40 conv floor, so this cannot pass vacuously."""
+    _assert_clean("ddp_resnet18_o2", rules=["amp-dtype"])
+    # the rule really saw the full fwd+bwd conv population
+    g = analysis.get("ddp_resnet18_o2").graph()
+    assert len(analysis.conv_eqns(g.jaxpr)) >= 40
 
+
+@pytest.mark.parametrize("lvl", ["o0", "o1", "o3"])
+def test_other_opt_levels_match_policy(lvl):
+    """O0 stays pure fp32 (accuracy baseline); O1/O3 put bf16 on the
+    MXU — each level's traced step matches amp.compute_dtype."""
+    _assert_clean(f"ddp_resnet18_{lvl}", rules=["amp-dtype"])
+
+
+# -- layout: channels-last steps stay transpose-free ----------------------
 
 def test_o2_nhwc_step_transpose_free():
-    jpr = _traced_step(channels_last=True, input_format="NHWC")
-    big_transposes = [e for e in _walk(jpr.jaxpr)
-                      if e.primitive.name == "transpose"
-                      and np.prod(e.invars[0].aval.shape) >= 4 * 3 * 32 * 32]
-    assert not big_transposes, (
-        "activation-sized transposes in the NHWC step: "
-        f"{[(e.invars[0].aval.shape, e.params) for e in big_transposes[:4]]}")
+    _assert_clean("ddp_resnet18_o2_nhwc", rules=["layout"])
 
 
 def test_o2_s2d_nhwc_step_convs_bf16_and_transpose_free():
-    jpr = _traced_step(channels_last=True, input_format="NHWC",
-                       stem="space_to_depth")
-    convs = [e for e in _walk(jpr.jaxpr)
-             if e.primitive.name == "conv_general_dilated"]
-    bad = [e for e in convs if e.invars[0].aval.dtype != jnp.bfloat16
-           or e.invars[1].aval.dtype != jnp.bfloat16]
-    assert not bad
-    # the 6-D block rearrange inside F.space_to_depth is the ONE
-    # legitimate activation transpose (forward-only: the input is a
-    # constant, so no gradient flows back through it); anything else
-    # would be a layout leak
-    big_transposes = [e for e in _walk(jpr.jaxpr)
-                      if e.primitive.name == "transpose"
-                      and np.prod(e.invars[0].aval.shape) >= 4 * 3 * 32 * 32
-                      and e.invars[0].aval.ndim != 6]
-    assert not big_transposes
-    s2d_rearranges = [e for e in _walk(jpr.jaxpr)
-                      if e.primitive.name == "transpose"
-                      and e.invars[0].aval.ndim == 6]
-    assert len(s2d_rearranges) <= 1, (
-        f"s2d rearrange should appear once (forward), got "
-        f"{len(s2d_rearranges)}")
+    """space_to_depth keeps its single sanctioned 6-D block rearrange
+    (forward-only — the input is a constant, so no gradient flows back
+    through it); anything else is a layout leak, and the convs stay
+    bf16."""
+    _assert_clean("ddp_resnet18_o2_nhwc_s2d", rules=["layout",
+                                                     "amp-dtype"])
+    ep = analysis.get("ddp_resnet18_o2_nhwc_s2d")
+    six_d = [e for e in analysis.transpose_eqns(
+        ep.graph().jaxpr, ep.expect["layout"]["min_activation_elems"])
+        if e.invars[0].aval.ndim == 6]
+    assert len(six_d) <= 1
 
 
-# -- telemetry ------------------------------------------------------------
-
-# primitives that move data across the host boundary: any of these inside
-# the step jaxpr means a per-iteration host sync — the exact cost the
-# device-resident scaler (and now the device-resident telemetry) exists
-# to avoid
-_HOST_TRANSFER_PRIMS = {"pure_callback", "io_callback", "debug_callback",
-                        "callback", "outfeed", "infeed", "device_put"}
-
-
-def _host_transfers(jpr):
-    return [e.primitive.name for e in _walk(jpr.jaxpr)
-            if e.primitive.name in _HOST_TRANSFER_PRIMS]
-
+# -- telemetry: device-resident metrics add zero host transfers -----------
 
 def test_telemetry_step_adds_zero_host_transfers():
     """Enabling DeviceMetrics telemetry on the jitted DDP+amp-O2 train
-    step must add ZERO host transfers: the counters/gauges accumulate as
-    jnp scalars in the step carry and only flush() (outside the step)
-    touches the host.  A callback- or outfeed-based metrics
+    step must add ZERO host transfers: the counters/gauges accumulate
+    as jnp scalars in the step carry and only flush() (outside the
+    step) touches the host.  A callback- or outfeed-based metrics
     implementation would turn every train step into a host round-trip —
     the regression this guard exists to catch."""
-    base = _traced_step()
-    tele = _traced_step(telemetry=True)
-    assert _host_transfers(tele) == _host_transfers(base) == []
+    _assert_clean("ddp_resnet18_o2_telemetry", rules=["host-transfer"])
+    _assert_clean("ddp_resnet18_o2", rules=["host-transfer"])
     # the instrumented graph keeps the same conv population — telemetry
     # reads existing step outputs (found_inf, loss scale, grad norm)
     # instead of perturbing the compute
-    def convs(j):
-        return len([e for e in _walk(j.jaxpr)
-                    if e.primitive.name == "conv_general_dilated"])
-    assert convs(tele) == convs(base)
+    base = analysis.get("ddp_resnet18_o2").graph()
+    tele = analysis.get("ddp_resnet18_o2_telemetry").graph()
+    assert len(analysis.conv_eqns(tele.jaxpr)) == \
+        len(analysis.conv_eqns(base.jaxpr))
 
 
-# -- transformer families ------------------------------------------------
+# -- collective accounting: the comm pattern is what DDP assumes ----------
 
-def _transformer_step_jaxpr(family):
-    """Trace the real O2 DDP train step (fused-head loss) for a tiny
-    transformer config over the 8-device CPU mesh."""
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    if family == "gpt":
-        net = models.GPT(models.GPTConfig(
-            vocab_size=97, block_size=16, n_layer=2, n_head=4,
-            n_embd=32, dropout=0.0))
-    else:
-        net = models.Llama(models.LlamaConfig(
-            vocab_size=97, hidden_size=32, intermediate_size=64,
-            num_hidden_layers=2, num_attention_heads=4,
-            num_key_value_heads=2, max_position_embeddings=16,
-            tie_word_embeddings=True))
-    model, opt = amp.initialize(net, optimizers.FusedAdam(1e-3),
-                                opt_level="O2", verbosity=0)
-    ddp = parallel.DistributedDataParallel(model)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    ost = opt.init(params)
-    ids = jnp.asarray(np.random.RandomState(0).randint(0, 97, (8, 16)))
-
-    def step(state, batch):
-        params, ost = state
-        (ids_b,) = batch
-
-        def loss_fn(p):
-            return model.loss(p, ids_b), ()
-
-        loss, _, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
-        g = ddp.allreduce_grads(g)
-        params, ost2, _ = opt.step(params, ost, g)
-        return (params, ost2), jax.lax.pmean(loss, "data")
-
-    mesh = Mesh(np.array(jax.devices()), ("data",))
-    mapped = jax.shard_map(step, mesh=mesh,
-                           in_specs=(P(), (P("data"),)),
-                           out_specs=(P(), P()), check_vma=False)
-    return jax.make_jaxpr(mapped)((params, ost), (ids,))
+def test_ddp_collective_accounting():
+    """Exact psum census for the O2 step: one psum per
+    allreduce_comm_plan bucket (fp32 batchnorm stash + chunked bf16
+    bulk) + the axis-size scalar + the loss pmean — and the on-wire
+    bytes match the plan to the byte (chunk padding included)."""
+    _assert_clean("ddp_resnet18_o2", rules=["collective"])
+    want = analysis.get("ddp_resnet18_o2").expect["collectives"]
+    assert want["counts"]["psum"] == 4        # 2 buckets + 2 scalars
+    g = analysis.get("ddp_resnet18_o2").graph()
+    total = sum(analysis.eqn_payload_bytes(e)
+                for e in analysis.collective_eqns(g.jaxpr))
+    assert total == want["payload_bytes"]
 
 
-def _large_dots(jpr, min_elems=256):
-    return [e for e in _walk(jpr.jaxpr)
-            if e.primitive.name == "dot_general"
-            and all(int(np.prod(v.aval.shape)) >= min_elems
-                    for v in e.invars)]
+def test_tp_collective_accounting():
+    """The DPxTP ParallelMLP step carries exactly the Megatron comm
+    pattern: one row-parallel forward psum over the model axis plus
+    the DDP grad bucket (+ axis-size scalar) over data."""
+    _assert_clean("tp_mlp_train_step")
 
 
-def _assert_dots_bf16(jpr):
-    dots = _large_dots(jpr)
-    assert len(dots) >= 10, f"expected fwd+bwd dots, got {len(dots)}"
-    bad = [tuple(v.aval.dtype for v in e.invars) for e in dots
-           if not all(v.aval.dtype == jnp.bfloat16 for v in e.invars)]
-    assert not bad, (f"non-bf16 large dots in O2 step: {bad[:6]} "
-                     f"(+{len(bad)} total); fp32 accumulation belongs "
-                     f"in preferred_element_type, not operand upcasts")
-
+# -- transformer families -------------------------------------------------
 
 def test_gpt_o2_step_large_dots_bf16():
     """Every activation/param-sized matmul in the GPT O2 train step —
     qkv/attention/MLP/fused-head, fwd and bwd — must run on bf16
     operands (fp32 stays in accumulators via preferred_element_type;
-    an operand upcast would halve MXU rate and double HBM traffic)."""
-    _assert_dots_bf16(_transformer_step_jaxpr("gpt"))
+    an operand upcast would halve MXU rate and double HBM traffic).
+    The rule's >= 10 dot floor keeps it non-vacuous."""
+    _assert_clean("gpt_o2_train_step", rules=["amp-dtype"])
 
 
 def test_llama_o2_step_large_dots_bf16():
-    _assert_dots_bf16(_transformer_step_jaxpr("llama"))
+    _assert_clean("llama_o2_train_step", rules=["amp-dtype"])
 
 
 # -- serving decode window ------------------------------------------------
 
-def _window_engine(window=8):
-    from apex_tpu import serving
-    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
-                                    n_layer=2, n_head=4, n_embd=32,
-                                    dropout=0.0, n_kv_head=2))
-    params, _ = m.init(jax.random.PRNGKey(0))
-    eng = serving.Engine(m, params, slots=2, buf_len=32, window=window)
-    return eng, m, params
-
-
-def _window_args(eng):
-    return (eng.ids, eng.cur_len, eng.cache, eng._slot_keys,
-            eng._slot_temp, eng.limit, eng._eos)
-
-
 def test_serving_window_step_zero_host_transfers():
     """The jitted K-tick decode window must contain ZERO host-transfer
     primitives: the whole point of the window is that the host touches
-    the device once per K tokens — a callback/outfeed smuggled into the
-    scan would reintroduce the per-token sync tax."""
-    eng, _, _ = _window_engine(window=8)
-    jpr = jax.make_jaxpr(eng._step_k)(*_window_args(eng))
-    assert _host_transfers(jpr) == []
+    the device once per K tokens."""
+    _assert_clean("engine_step_k", rules=["host-transfer"])
 
 
 def test_serving_window_step_cache_buffers_donated():
     """The big mutated decode-window inputs — ids, the KV cache tree,
-    the RNG keys — must be DONATED (input/output aliased in the
-    lowered module): without donation XLA keeps a second copy of the
-    multi-GB cache alive across every dispatch.  The per-slot length
-    vector (cur_len) is deliberately NOT donated — donating that
-    argnum class corrupts executables reloaded from the persistent
-    XLA:CPU compilation cache (serving.py's _sstep note).  The
-    lowering emits one ``tf.aliasing_output`` attribute per donated
-    buffer."""
-    eng, _, _ = _window_engine(window=8)
-    txt = eng._step_k.lower(*_window_args(eng)).as_text()
-    n_cache = len(jax.tree_util.tree_leaves(eng.cache))
-    want = n_cache + 2              # + ids, slot keys
-    got = txt.count("tf.aliasing_output")
-    assert got == want, (
-        f"expected {want} donated buffers (cache {n_cache} + ids + "
-        f"keys), lowering aliases {got}")
-    # admission-path mutators donate too (cache scattered in place)
-    ptxt = eng._prefill_slot.lower(
-        eng.ids, eng.cache, None, 0,
-        jnp.zeros((32,), jnp.int32)).as_text()
-    assert ptxt.count("tf.aliasing_output") == n_cache + 1  # + ids
+    the RNG keys — must be DONATED (input/output aliased in the lowered
+    module); the per-slot length vector (cur_len) is on the permanent
+    donation blocklist (donating it corrupts executables reloaded from
+    the persistent XLA:CPU compile cache — serving.DONATION_BLOCKLIST).
+    Admission-path mutators donate too (cache scattered in place)."""
+    _assert_clean("engine_step_k", rules=["donation"])
+    _assert_clean("engine_prefill_slot", rules=["donation"])
+    # every donated buffer really got a tf.aliasing_output attribute
+    g = analysis.get("engine_step_k").graph()
+    n_cache = len(jax.tree_util.tree_leaves(g.example_args[2]))
+    assert analysis.aliased_output_count(g.stablehlo) == n_cache + 2
+    gp = analysis.get("engine_prefill_slot").graph()
+    assert analysis.aliased_output_count(gp.stablehlo) == n_cache + 1
+    # and the blocklisted length vector is NOT among the donated args
+    donated, _ = analysis.donated_arg_names(g.lowered, g.arg_names)
+    assert "cur_len" not in donated
 
+
+def test_seq2seq_window_step_donation():
+    _assert_clean("seq2seq_step_k")
+
+
+# -- the acceptance pin: the clean repo lints clean -----------------------
+
+def test_full_registry_zero_findings():
+    """`python -m apex_tpu.analysis` must report zero findings on the
+    clean repo — same registry, same rules, same implementation (the
+    graphs are already traced and cached by the tests above, so this
+    is cheap)."""
+    findings = analysis.analyze()
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+# -- runtime host-sync arithmetic (not expressible as a jaxpr property) ---
 
 def test_serving_window_host_syncs_per_token():
     """The acceptance number: with window=K the engine pays <= 1/K
     host syncs per generated token (pinned via the engine metrics),
     while ``engine_decode_steps_total`` keeps counting device
     dispatches and the decode histogram observes PER-TOKEN latency."""
-    eng, _, _ = _window_engine(window=8)
+    from apex_tpu import models, serving
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    eng = serving.Engine(m, params, slots=2, buf_len=32, window=8)
     prompt = list(np.random.RandomState(5).randint(0, 64, 4))
     rid = eng.add_request(prompt, max_new_tokens=16)
     while eng.live():
