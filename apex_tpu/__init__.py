@@ -68,6 +68,7 @@ from . import observability
 from . import data
 from . import lora
 from . import serving
+from . import fleet
 from . import analysis
 
 __version__ = "0.1.0"

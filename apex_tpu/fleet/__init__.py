@@ -1,0 +1,36 @@
+"""Serving fleet: multi-replica orchestration over the engines.
+
+The single :class:`~apex_tpu.serving.Engine` solved continuous
+batching on one device program; this package is the host-side layer
+the ROADMAP's "heavy traffic" goal needs above it — the inference-side
+sibling of ``apex.parallel.DistributedDataParallel``'s replica model:
+
+- :class:`Fleet` (fleet.py): N replicas behind one
+  submit/step/result API, bounded-queue backpressure
+  (:class:`FleetOverloaded`), failover that restarts reclaimed
+  requests on survivors with the exactness contract intact;
+- routing policies (router.py): :class:`RoundRobin`,
+  :class:`LeastLoaded`, :class:`PrefixAffinity`, plus
+  :class:`RetryPolicy` (exponential backoff, seeded jitter);
+- health (health.py): EWMA-driven ``healthy`` / ``degraded`` /
+  ``dead`` states, a circuit breaker with half-open probing, and
+  graceful drain for rolling restarts;
+- faults (faults.py): :class:`FaultyReplica`, the seeded
+  deterministic fault-injection harness the tests use to prove the
+  failover story instead of asserting it.
+
+See docs/fleet.md.
+"""
+
+from .fleet import Fleet
+from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
+                     STATE_CODES, Ewma, HealthConfig, ReplicaHealth)
+from .router import (FleetOverloaded, LeastLoaded, PrefixAffinity,
+                     RetryPolicy, RoundRobin, make_policy)
+from .faults import FaultyReplica, ReplicaFault
+
+__all__ = ["Fleet", "FleetOverloaded", "RetryPolicy", "RoundRobin",
+           "LeastLoaded", "PrefixAffinity", "make_policy",
+           "HealthConfig", "ReplicaHealth", "Ewma", "HEALTHY",
+           "DEGRADED", "DEAD", "DRAINING", "DRAINED", "STATE_CODES",
+           "FaultyReplica", "ReplicaFault"]

@@ -1,0 +1,155 @@
+"""Seeded, deterministic fault injection around one replica.
+
+:class:`FaultyReplica` wraps an ``Engine``/``Seq2SeqEngine`` (or
+anything exposing the same surface) and misbehaves ON SCHEDULE: every
+fault is a half-open step-count window ``(start, stop)`` over the
+wrapper's own ``step()`` counter, so a test that says "the replica
+dies at step 3" gets exactly that, every run.  An optional seeded
+``p_error`` adds random step failures that are still deterministic per
+seed — soak-style tests without flakiness.
+
+Fault kinds (all composable):
+
+- ``raise_on_step`` — ``step()`` raises :class:`ReplicaFault` BEFORE
+  touching the wrapped engine, which therefore stays internally
+  consistent (no half-donated buffers); this is the crash/failover
+  fault the exactness tests lean on.
+- ``raise_on_prefill`` — ``add_request``/``submit`` raise instead of
+  admitting; exercises dispatch-retry.
+- ``stall`` — ``step()`` returns ``{}`` without stepping the engine
+  (optionally sleeping ``stall_s`` first): the hang that never raises.
+  Only the fleet's no-progress watchdog can catch it.
+- ``slow`` — ``step()`` sleeps ``slow_s`` then steps normally: correct
+  results at degraded latency; feeds the latency EWMA.
+- ``drop_results`` — the engine steps (state advances!) but the
+  emitted tokens are swallowed.  The wrapped engine will still finish
+  the requests internally; a fleet that relies on per-step emissions
+  for liveness sees silence — watchdog territory again.
+
+Everything else (``stats``, ``result``, ``cancel``, ``take_waiting``,
+``free_slots``, …) proxies straight through, so a ``FaultyReplica`` is
+a drop-in fleet member.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ReplicaFault", "FaultyReplica"]
+
+
+class ReplicaFault(RuntimeError):
+    """An injected failure (never raised by real engines)."""
+
+
+def _windows(spec) -> Tuple[Tuple[int, Optional[int]], ...]:
+    """Normalize a window spec: None/() = never; True = always;
+    (start, stop) or a sequence of such pairs; stop None = forever."""
+    if spec is None or spec == ():
+        return ()
+    if spec is True:
+        return ((0, None),)
+    if (isinstance(spec, (tuple, list)) and len(spec) == 2
+            and all(isinstance(x, int) or x is None for x in spec)):
+        return (tuple(spec),)
+    return tuple(tuple(w) for w in spec)
+
+
+def _in(windows, t: int) -> bool:
+    return any(s <= t and (e is None or t < e) for s, e in windows)
+
+
+class FaultyReplica:
+    """Deterministic misbehaving proxy around ``replica``.
+
+    All windows are half-open ``[start, stop)`` intervals of the
+    wrapper's step counter (``stop=None`` = forever); ``p_error``
+    raises on a seeded coin flip per step, on top of any windows."""
+
+    def __init__(self, replica, *,
+                 raise_on_step=(), raise_on_prefill=(), stall=(),
+                 slow=(), drop_results=(),
+                 slow_s: float = 0.05, stall_s: float = 0.0,
+                 p_error: float = 0.0, seed: int = 0):
+        self._inner = replica
+        self._raise_on_step = _windows(raise_on_step)
+        self._raise_on_prefill = _windows(raise_on_prefill)
+        self._stall = _windows(stall)
+        self._slow = _windows(slow)
+        self._drop_results = _windows(drop_results)
+        self.slow_s = slow_s
+        self.stall_s = stall_s
+        self.p_error = p_error
+        self._rng = np.random.RandomState(seed)
+        self.steps = 0                  # step() calls observed
+        self.faults_fired = 0
+
+    # -- faulted surface ---------------------------------------------------
+    def step(self):
+        t = self.steps
+        self.steps += 1
+        if _in(self._stall, t):
+            self.faults_fired += 1
+            if self.stall_s:
+                time.sleep(self.stall_s)
+            return {}
+        if _in(self._raise_on_step, t) or (
+                self.p_error > 0.0
+                and self._rng.uniform() < self.p_error):
+            self.faults_fired += 1
+            raise ReplicaFault(f"injected step fault at step {t}")
+        if _in(self._slow, t):
+            self.faults_fired += 1
+            time.sleep(self.slow_s)
+        out = self._inner.step()
+        if _in(self._drop_results, t):
+            self.faults_fired += 1
+            return {}
+        return out
+
+    def _check_prefill_fault(self):
+        if _in(self._raise_on_prefill, self.steps):
+            self.faults_fired += 1
+            raise ReplicaFault(
+                f"injected prefill fault at step {self.steps}")
+
+    def add_request(self, *a, **kw):
+        self._check_prefill_fault()
+        return self._inner.add_request(*a, **kw)
+
+    def submit(self, *a, **kw):
+        self._check_prefill_fault()
+        return self._inner.submit(*a, **kw)
+
+    def arm(self, *, relative: bool = True, **kinds):
+        """(Re)program fault windows at runtime.  With ``relative=True``
+        (default) window offsets count from the CURRENT step counter —
+        ``arm(raise_on_step=(6, None))`` means "die 6 steps from now",
+        which is how a bench arms a mid-run death AFTER its warmup
+        traffic (a constructor window would fire during warmup).
+        Passing ``()`` clears a fault kind."""
+        known = ("raise_on_step", "raise_on_prefill", "stall", "slow",
+                 "drop_results")
+        unknown = set(kinds) - set(known)
+        if unknown:
+            raise TypeError(f"unknown fault kind(s) {sorted(unknown)}; "
+                            f"known: {list(known)}")
+        for kind in known:
+            if kind not in kinds:
+                continue
+            ws = _windows(kinds[kind])
+            if relative:
+                ws = tuple((s + self.steps,
+                            None if e is None else e + self.steps)
+                           for s, e in ws)
+            setattr(self, "_" + kind, ws)
+
+    # -- transparent proxy -------------------------------------------------
+    def __getattr__(self, name):
+        # only reached for names not defined on the wrapper: stats,
+        # result, cancel, take_waiting, free_slots, is_finished,
+        # register_prefix, slots, metrics, ...
+        return getattr(self._inner, name)

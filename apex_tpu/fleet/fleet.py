@@ -1,0 +1,632 @@
+"""The fleet: N serving replicas behind one submit/step/result API.
+
+``Fleet`` owns the request lifecycle end to end:
+
+- ``submit`` validates nothing about shapes (replicas do that at
+  dispatch) but enforces BACKPRESSURE: the fleet queue is bounded, and
+  a full queue raises :class:`router.FleetOverloaded` instead of
+  growing without bound — the explicit shed the single engine's
+  ``_waiting`` list never had.
+- ``step()`` is one cooperative fleet tick: breaker cooldowns advance,
+  deadlines are enforced, queued requests dispatch through the routing
+  policy onto admissible replicas (free slot, or a short per-replica
+  queue of depth ``replica_queue_cap`` so engines can admit at their
+  own window boundaries), every steppable replica takes one ``step()``
+  with latency + errors feeding its :class:`health.ReplicaHealth`,
+  finishes are harvested, and a replica whose dispatch raised — or
+  that sat silent on live work past the stall watchdog — FAILS OVER:
+  its in-flight and queued requests are reclaimed (best-effort
+  cancelled on the sick replica) and restarted from their prompts on
+  survivors.
+- ``result`` returns the request's final tokens from the replica that
+  actually finished it.  Because a failed-over request restarts from
+  its prompt and greedy / explicitly-seeded sampled decodes are
+  request-intrinsic, those final tokens are token-for-token what an
+  undisturbed single engine produces (pinned in tests/test_fleet.py).
+  ``step()``'s incremental emissions, by contrast, are at-least-once
+  across a failover (the restart re-emits from the beginning) —
+  consume ``result()`` for exactness, emissions for liveness.
+
+Drain (rolling restart): ``drain(i)`` stops admission, re-enqueues the
+replica's waiting queue onto the fleet (→ survivors), and keeps
+stepping its in-flight requests until they finish, at which point the
+replica parks as ``drained``; ``undrain(i)`` re-enlists it.
+
+Failure is bounded: each dispatch failure or failover consumes one of
+``RetryPolicy.max_attempts`` attempts (with exponential-backoff
+step delays between dispatch retries), after which — or after a
+per-request ``deadline`` passes — the request lands in ``result()`` as
+a raised ``RuntimeError`` instead of spinning forever.
+
+Telemetry: a fleet-level :class:`~apex_tpu.observability.MetricsRegistry`
+carries ``fleet_retries_total`` / ``fleet_shed_total`` /
+``fleet_failover_total`` / ``fleet_drains_total`` (and friends) plus
+per-replica labeled gauges; ``stats()`` aggregates the replicas'
+own ``stats()``; ``record()`` is the ``kind: fleet`` JSONL record
+``observability.exporters.validate_fleet_record`` pins.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import MetricsRegistry
+from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
+                     STATE_CODES, HealthConfig, ReplicaHealth)
+from .router import FleetOverloaded, RetryPolicy, make_policy
+
+__all__ = ["Fleet"]
+
+
+class _FleetRequest:
+    def __init__(self, rid, prompt, max_new, eos, seed, temperature,
+                 deadline_at):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.eos = eos
+        self.seed = seed
+        self.temperature = temperature
+        self.deadline_at = deadline_at      # absolute clock time or None
+        self.assigned: Optional[Tuple[int, int]] = None  # (replica, rrid)
+        self.attempts = 0                   # failed dispatches + failovers
+        self.next_attempt_step = 0
+        self.restarts = 0
+        self.generated: List[int] = []
+        self.error: Optional[str] = None
+        self.t_submit: Optional[float] = None
+        self.t_finish: Optional[float] = None
+
+
+class Fleet:
+    """Front ``replicas`` (Engine / Seq2SeqEngine / FaultyReplica —
+    anything with the scheduler surface) behind one API.
+
+    ``policy`` is a name (``"round_robin"`` / ``"least_loaded"`` /
+    ``"prefix_affinity"``) or an instance; ``max_queue`` bounds the
+    fleet queue (full = shed); ``replica_queue_cap`` bounds how much
+    the fleet will queue ON a replica beyond its free slots (0 = admit
+    only into free slots); ``retry`` and ``health`` take
+    :class:`router.RetryPolicy` / :class:`health.HealthConfig`;
+    ``clock`` is injectable for deterministic deadline tests."""
+
+    def __init__(self, replicas: Sequence[Any],
+                 policy="least_loaded",
+                 max_queue: int = 64,
+                 replica_queue_cap: int = 2,
+                 retry: Optional[RetryPolicy] = None,
+                 health: Optional[HealthConfig] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock=None,
+                 step_workers: Optional[int] = None):
+        if not replicas:
+            raise ValueError("Fleet needs at least one replica")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if replica_queue_cap < 0:
+            raise ValueError(f"replica_queue_cap must be >= 0, got "
+                             f"{replica_queue_cap}")
+        self.replicas = list(replicas)
+        self.policy = make_policy(policy)
+        self.max_queue = max_queue
+        self.replica_queue_cap = replica_queue_cap
+        self.retry = retry or RetryPolicy()
+        self.health_config = health or HealthConfig()
+        self.health = [ReplicaHealth(self.health_config)
+                       for _ in self.replicas]
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._clock = clock if clock is not None else time.perf_counter
+        # replica step() dispatches can overlap across a thread pool:
+        # jax releases the GIL inside XLA execution and the device
+        # fetch, so replicas backed by SEPARATE devices genuinely run
+        # concurrently.  Results are identical either way (replicas
+        # never share mutable state); the default only goes parallel
+        # when the host has cores beyond what one dispatch's XLA
+        # intra-op pool already uses — on a small shared-CPU host,
+        # threading replicas OVERSUBSCRIBES those cores and loses
+        # ~30% (measured), so serial is the floor, not a fallback.
+        if step_workers is None:
+            step_workers = max(1, min(len(self.replicas),
+                                      (os.cpu_count() or 2) // 2))
+        if step_workers < 1:
+            raise ValueError(f"step_workers must be >= 1, got "
+                             f"{step_workers}")
+        self.step_workers = step_workers
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: List[_FleetRequest] = []
+        self._inflight: Dict[Tuple[int, int], _FleetRequest] = {}
+        self._results: Dict[int, _FleetRequest] = {}
+        self._next_rid = 0
+        self._step_no = 0
+        self._idle_steps = [0] * len(self.replicas)
+        self._prefix_map: Dict[tuple, int] = {}
+        # fleet-LOCAL totals (registry counters aggregate across fleets
+        # sharing a registry; stats() must not — same rule as the
+        # engine scheduler)
+        self._n_submitted = 0
+        self._n_finished = 0
+        self._n_failed = 0
+        self._n_tokens = 0
+        self._n_shed = 0
+        self._n_retries = 0
+        self._n_failovers = 0
+        self._n_drains = 0
+        self._n_deadline = 0
+        m = self.metrics
+        self._m_submitted = m.counter("fleet_submitted_total")
+        self._m_finished = m.counter("fleet_finished_total")
+        self._m_failed = m.counter(
+            "fleet_failed_total",
+            help="requests failed after retry exhaustion or deadline")
+        self._m_tokens = m.counter("fleet_tokens_total")
+        self._m_retries = m.counter(
+            "fleet_retries_total",
+            help="dispatch attempts that failed and were retried")
+        self._m_shed = m.counter(
+            "fleet_shed_total",
+            help="submissions refused with FleetOverloaded (bounded "
+                 "queue full)")
+        self._m_failover = m.counter(
+            "fleet_failover_total",
+            help="requests reclaimed from a sick replica and "
+                 "restarted on a survivor")
+        self._m_drains = m.counter("fleet_drains_total")
+        self._m_deadline = m.counter("fleet_deadline_exceeded_total")
+        self._m_latency = m.histogram(
+            "fleet_request_seconds",
+            help="submit-to-finish latency per completed request")
+        m.gauge("fleet_replicas").set(float(len(self.replicas)))
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None,
+               temperature: Optional[float] = None,
+               deadline: Optional[float] = None) -> int:
+        """Queue a request; returns the fleet request id.  Raises
+        :class:`FleetOverloaded` (retriable) when the bounded fleet
+        queue is full.  ``deadline`` is seconds from now: a request
+        not finished in time fails with a deadline error instead of
+        occupying capacity forever."""
+        if len(self._pending) >= self.max_queue:
+            self._n_shed += 1
+            self._m_shed.inc()
+            raise FleetOverloaded(len(self._pending), self.max_queue)
+        if deadline is not None and deadline <= 0:
+            raise ValueError(f"deadline must be > 0 seconds, got "
+                             f"{deadline}")
+        rid = self._next_rid
+        self._next_rid += 1
+        now = self._clock()
+        req = _FleetRequest(rid, prompt, max_new_tokens, eos_token_id,
+                            seed, temperature,
+                            None if deadline is None else now + deadline)
+        req.t_submit = now
+        self._pending.append(req)
+        self._n_submitted += 1
+        self._m_submitted.inc()
+        return rid
+
+    def register_prefix(self, tokens: Sequence[int],
+                        replica: Optional[int] = None) -> int:
+        """Prefill ``tokens`` into ONE replica's prefix pool and
+        remember the owner: with the ``prefix_affinity`` policy, later
+        prompts starting with these tokens route there (KV-splice
+        admission).  Returns the owning replica index."""
+        if replica is None:
+            cands = [i for i in range(len(self.replicas))
+                     if self.health[i].admissible()]
+            if not cands:
+                raise RuntimeError("no admissible replica to own the "
+                                   "prefix")
+            replica = min(cands, key=lambda i: (
+                self.replicas[i].stats()["occupancy"], i))
+        self.replicas[replica].register_prefix(tokens)
+        self._prefix_map[tuple(int(t) for t in tokens)] = replica
+        return replica
+
+    def prefix_owner(self, prompt: Sequence[int]) -> Optional[int]:
+        """Replica owning the longest registered prefix of ``prompt``,
+        or None."""
+        pt = tuple(int(t) for t in prompt)
+        best, best_len = None, 0
+        for pref, owner in self._prefix_map.items():
+            if len(pref) > best_len and pt[:len(pref)] == pref:
+                best, best_len = owner, len(pref)
+        return best
+
+    # -- the fleet tick ----------------------------------------------------
+    def step(self) -> Dict[int, List[int]]:
+        """One cooperative tick over every replica; returns
+        ``{fleet_rid: [tokens]}`` emitted this tick.  Emissions are
+        at-least-once across failovers (a restarted request re-emits
+        from its first token); ``result()`` is the exactly-once
+        surface."""
+        self._step_no += 1
+        for h in self.health:
+            h.tick()
+        self._check_deadlines()
+        self._dispatch()
+        out: Dict[int, List[int]] = {}
+        plan = []
+        for i, rep in enumerate(self.replicas):
+            mine = [k for k in self._inflight if k[0] == i]
+            if self.health[i].steppable() and (mine
+                                               or rep.live() > 0):
+                plan.append((i, rep, mine))
+
+        def dispatch(item):
+            i, rep, _ = item
+            t0 = self._clock()
+            try:
+                return i, rep.step(), self._clock() - t0, None
+            except Exception as e:  # noqa: BLE001 — any replica death
+                return i, None, self._clock() - t0, e
+
+        if self.step_workers > 1 and len(plan) > 1:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.step_workers,
+                    thread_name_prefix="fleet-step")
+            stepped = list(self._pool.map(dispatch, plan))
+        else:
+            stepped = [dispatch(item) for item in plan]
+
+        # post-processing stays on the fleet thread, in replica order —
+        # health, failover and harvest are deterministic regardless of
+        # how the pool interleaved the dispatches
+        for (i, rep, mine), (_, emitted, dt, exc) in zip(plan, stepped):
+            h = self.health[i]
+            if exc is not None:
+                self._replica_failed(i, f"step raised: {exc}")
+                continue
+            if mine:
+                h.record_success(dt)
+            progressed = False
+            for rrid, toks in emitted.items():
+                req = self._inflight.get((i, rrid))
+                if req is None:        # stale pre-failover slot: drop
+                    continue
+                if toks:
+                    progressed = True
+                    out.setdefault(req.rid, []).extend(int(t)
+                                                       for t in toks)
+            for key in mine:
+                req = self._inflight.get(key)
+                if req is None:
+                    continue
+                try:
+                    done = rep.is_finished(key[1])
+                except Exception:
+                    done = False
+                if done:
+                    progressed = True
+                    del self._inflight[key]
+                    self._finish(req, rep.result(key[1]))
+            # no-progress watchdog: live fleet work, zero tokens, zero
+            # finishes — a stall or result-dropper that never raises
+            if mine and not progressed:
+                self._idle_steps[i] += 1
+                if self._idle_steps[i] >= self.health_config.stall_steps:
+                    self._idle_steps[i] = 0
+                    self._replica_failed(
+                        i, f"no progress for "
+                           f"{self.health_config.stall_steps} steps "
+                           f"(stall watchdog)")
+            else:
+                self._idle_steps[i] = 0
+        for i, h in enumerate(self.health):
+            if h.draining and not any(k[0] == i for k in self._inflight):
+                h.finish_drain()
+        self._update_gauges()
+        return out
+
+    # -- dispatch / routing ------------------------------------------------
+    def _candidates(self) -> List[int]:
+        cands = []
+        for i, rep in enumerate(self.replicas):
+            h = self.health[i]
+            if not h.admissible():
+                continue
+            inflight_here = sum(1 for k in self._inflight if k[0] == i)
+            if h.circuit == "half_open":
+                # half-open admits exactly ONE probe request
+                if inflight_here == 0 and rep.free_slots() > 0:
+                    cands.append(i)
+                continue
+            if (rep.free_slots() > 0
+                    or rep.queue_depth() < self.replica_queue_cap):
+                cands.append(i)
+        # prefer healthy replicas — but a half-open replica MUST stay
+        # eligible or its recovery probe never dispatches under
+        # non-saturating load and it idles degraded forever (the
+        # one-probe budget above keeps the risk to a single request)
+        preferred = [i for i in cands
+                     if self.health[i].state == HEALTHY
+                     or self.health[i].circuit == "half_open"]
+        return preferred or cands
+
+    def _dispatch(self):
+        if not self._pending:
+            return
+        # candidate capacity only changes when a dispatch lands (or
+        # fails), so recompute per outcome, not per queued request —
+        # the backlog can be hundreds deep and this loop is per tick
+        cands = self._candidates()
+        for req in list(self._pending):
+            if not cands:
+                break                   # capacity is request-independent
+            if req.next_attempt_step > self._step_no:
+                continue
+            i = self.policy.select(self, cands, req)
+            rep = self.replicas[i]
+            try:
+                rrid = rep.submit(req.prompt, req.max_new, req.eos,
+                                  req.seed, req.temperature)
+            except ValueError as e:
+                # request-shaped rejection (bad prompt length, seed on
+                # a greedy engine, ...): the replica is fine and no
+                # other replica would take it either — fail, no retry
+                self._pending.remove(req)
+                self._fail(req, f"rejected at dispatch: {e}")
+                continue
+            except Exception as e:      # noqa: BLE001 — replica fault
+                self.health[i].record_error()
+                self._n_retries += 1
+                self._m_retries.inc()
+                req.attempts += 1
+                if req.attempts >= self.retry.max_attempts:
+                    self._pending.remove(req)
+                    self._fail(req, f"dispatch failed after "
+                                    f"{req.attempts} attempts; last: "
+                                    f"{e}")
+                else:
+                    req.next_attempt_step = (
+                        self._step_no
+                        + self.retry.delay_steps(req.attempts - 1))
+                cands = self._candidates()   # health may have tripped
+                continue
+            self._pending.remove(req)
+            req.assigned = (i, rrid)
+            self._inflight[(i, rrid)] = req
+            cands = self._candidates()       # replica i consumed capacity
+
+    # -- failure handling --------------------------------------------------
+    def _replica_failed(self, i: int, reason: str):
+        """Record the error (the breaker may open) and fail over every
+        fleet request on replica ``i`` — reclaimed, best-effort
+        cancelled there, and restarted from their prompts on whoever
+        the router picks next tick."""
+        self.health[i].record_error()
+        # a raise mid-step must not carry a previously accumulated
+        # stall count into the replica's next life — the watchdog
+        # would fire on its first slow tick after recovery
+        self._idle_steps[i] = 0
+        rep = self.replicas[i]
+        keys = sorted((k for k in self._inflight if k[0] == i),
+                      key=lambda k: self._inflight[k].rid)
+        moved = []
+        for key in keys:
+            req = self._inflight.pop(key)
+            try:
+                rep.cancel(key[1])
+            except Exception:           # noqa: BLE001 — sick replica
+                pass
+            req.assigned = None
+            req.restarts += 1
+            req.attempts += 1
+            req.generated = []
+            self._n_failovers += 1
+            self._m_failover.inc()
+            if req.attempts >= self.retry.max_attempts:
+                self._fail(req, f"failed over {req.restarts}x "
+                                f"(attempt budget exhausted); replica "
+                                f"{i}: {reason}")
+            else:
+                req.next_attempt_step = self._step_no + 1
+                moved.append(req)
+        # leftovers in the replica's own waiting queue (queued-on-
+        # replica dispatches) came back via the keys above; anything
+        # else there was submitted behind the fleet's back — drop it
+        # back out so the sick replica holds no queued work
+        try:
+            rep.take_waiting()
+        except Exception:               # noqa: BLE001
+            pass
+        # restarted requests go to the FRONT in submission order: they
+        # were admitted before anything still pending
+        self._pending[:0] = moved
+
+    def _fail(self, req: _FleetRequest, msg: str):
+        req.error = msg
+        req.t_finish = self._clock()
+        self._results[req.rid] = req
+        self._n_failed += 1
+        self._m_failed.inc()
+
+    def _finish(self, req: _FleetRequest, tokens: List[int]):
+        req.generated = [int(t) for t in tokens]
+        req.t_finish = self._clock()
+        self._results[req.rid] = req
+        self._n_finished += 1
+        self._m_finished.inc()
+        self._n_tokens += len(req.generated)
+        self._m_tokens.inc(len(req.generated))
+        if req.t_submit is not None:
+            self._m_latency.observe(req.t_finish - req.t_submit)
+
+    def _check_deadlines(self):
+        now = self._clock()
+        for req in [r for r in self._pending
+                    if r.deadline_at is not None
+                    and now > r.deadline_at]:
+            self._pending.remove(req)
+            self._deadline_fail(req)
+        for key, req in list(self._inflight.items()):
+            if req.deadline_at is not None and now > req.deadline_at:
+                del self._inflight[key]
+                try:
+                    self.replicas[key[0]].cancel(key[1])
+                except Exception:       # noqa: BLE001
+                    pass
+                self._deadline_fail(req)
+
+    def _deadline_fail(self, req: _FleetRequest):
+        self._n_deadline += 1
+        self._m_deadline.inc()
+        self._fail(req, f"deadline exceeded after "
+                        f"{self._clock() - req.t_submit:.3f}s")
+
+    # -- drain / rolling restart -------------------------------------------
+    def drain(self, i: int):
+        """Graceful drain of replica ``i``: stop admitting, re-enqueue
+        its waiting queue onto the fleet (→ survivors), keep stepping
+        its in-flight requests to completion; the replica then parks
+        ``drained`` until :meth:`undrain`."""
+        h = self.health[i]
+        if h.draining or h.drained:
+            return
+        h.start_drain()
+        self._n_drains += 1
+        self._m_drains.inc()
+        moved = []
+        try:
+            taken = self.replicas[i].take_waiting()
+        except Exception:               # noqa: BLE001
+            taken = []
+        for rrid, *_ in taken:
+            req = self._inflight.pop((i, rrid), None)
+            if req is not None:
+                req.assigned = None
+                req.next_attempt_step = self._step_no
+                moved.append(req)
+        moved.sort(key=lambda r: r.rid)
+        self._pending[:0] = moved
+        if not any(k[0] == i for k in self._inflight):
+            h.finish_drain()
+
+    def undrain(self, i: int):
+        """Re-enlist a drained (or draining) replica with a fresh
+        health record — the post-rolling-restart handshake."""
+        self.health[i].reset()
+
+    # -- results / introspection -------------------------------------------
+    def result(self, rid: int) -> List[int]:
+        """Final tokens of a finished request; raises ``KeyError`` if
+        unknown/unfinished and ``RuntimeError`` if the request failed
+        (retries exhausted, rejected, or deadline exceeded)."""
+        req = self._results[rid]
+        if req.error is not None:
+            raise RuntimeError(f"request {rid} failed: {req.error}")
+        return list(req.generated)
+
+    def close(self):
+        """Join the step-worker pool (idempotent).  A later ``step()``
+        lazily recreates it, so close when the fleet is retired — the
+        pool's threads are non-daemon and otherwise live until
+        interpreter exit."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def latency(self, rid: int) -> float:
+        """Submit-to-finish seconds for a completed (or failed)
+        request — the per-request tail-latency surface ``bench.py
+        --fleet`` percentiles over; raises ``KeyError`` while the
+        request is still in flight."""
+        req = self._results[rid]
+        return req.t_finish - req.t_submit
+
+    def status(self, rid: int) -> str:
+        """``queued`` / ``inflight`` / ``finished`` / ``failed``."""
+        if rid in self._results:
+            return ("failed" if self._results[rid].error is not None
+                    else "finished")
+        if any(r.rid == rid for r in self._pending):
+            return "queued"
+        if any(r.rid == rid for r in self._inflight.values()):
+            return "inflight"
+        raise KeyError(f"unknown request id {rid}")
+
+    def live(self) -> int:
+        """Requests still owed an outcome (queued + in-flight)."""
+        return len(self._pending) + len(self._inflight)
+
+    def states(self) -> List[str]:
+        return [h.state for h in self.health]
+
+    def _update_gauges(self):
+        m = self.metrics
+        m.gauge("fleet_queue_depth").set(float(len(self._pending)))
+        states = self.states()
+        for s, g in ((HEALTHY, "fleet_replicas_healthy"),
+                     (DEGRADED, "fleet_replicas_degraded"),
+                     (DEAD, "fleet_replicas_dead")):
+            m.gauge(g).set(float(states.count(s)))
+        occ = m.gauge("fleet_replica_occupancy")
+        liv = m.gauge("fleet_replica_live")
+        qd = m.gauge("fleet_replica_queue_depth")
+        st = m.gauge("fleet_replica_state_code",
+                     help="0 healthy, 1 degraded, 2 dead, 3 draining, "
+                          "4 drained")
+        for i, rep in enumerate(self.replicas):
+            # cheap accessors, not stats(): this runs every tick and
+            # stats() builds five histogram summaries per replica
+            lbl = {"replica": i}
+            occ.labels(**lbl).set(rep.live() / rep.slots)
+            liv.labels(**lbl).set(float(rep.live()))
+            qd.labels(**lbl).set(float(rep.queue_depth()))
+            st.labels(**lbl).set(float(STATE_CODES[states[i]]))
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregated snapshot: fleet totals, per-replica health
+        states, and every replica's own ``stats()``."""
+        states = self.states()
+        return {"replicas": len(self.replicas),
+                "policy": getattr(self.policy, "name",
+                                  type(self.policy).__name__),
+                "queue_depth": len(self._pending),
+                "inflight": len(self._inflight),
+                "submitted": self._n_submitted,
+                "finished": self._n_finished,
+                "failed": self._n_failed,
+                "tokens_generated": self._n_tokens,
+                "shed": self._n_shed,
+                "retries": self._n_retries,
+                "failovers": self._n_failovers,
+                "drains": self._n_drains,
+                "deadline_exceeded": self._n_deadline,
+                "states": states,
+                "healthy": states.count(HEALTHY),
+                "degraded": states.count(DEGRADED),
+                "dead": states.count(DEAD),
+                "draining": states.count(DRAINING),
+                "drained": states.count(DRAINED),
+                "request_latency": self._m_latency.summary(),
+                "replica_stats": [r.stats() for r in self.replicas]}
+
+    def record(self) -> Dict[str, Any]:
+        """The ``kind: fleet`` JSONL record
+        (``observability.exporters.validate_fleet_record``); feed it
+        through a :class:`~apex_tpu.observability.exporters.JsonlExporter`
+        (or ``JsonlExporter.enrich``) to stamp the envelope."""
+        s = self.stats()
+        return {"kind": "fleet",
+                "replicas": s["replicas"], "policy": s["policy"],
+                "healthy": s["healthy"], "degraded": s["degraded"],
+                "dead": s["dead"],
+                "queue_depth": s["queue_depth"],
+                "submitted": s["submitted"], "finished": s["finished"],
+                "failed": s["failed"], "shed": s["shed"],
+                "retries": s["retries"], "failovers": s["failovers"],
+                "drains": s["drains"],
+                "tokens": s["tokens_generated"]}

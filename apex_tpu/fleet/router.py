@@ -1,0 +1,163 @@
+"""Routing policies, retry/backoff, and fleet-level backpressure.
+
+A policy answers one question per dispatch: *which admissible replica
+takes this request?*  The fleet pre-filters the candidate list (healthy
+before degraded, free slot required, breaker/drain respected), so
+policies stay pure ranking functions over live scheduler stats and are
+trivially testable.
+
+- :class:`RoundRobin` — cycle through candidates; the baseline.
+- :class:`LeastLoaded` — rank by each replica's ``stats()`` occupancy
+  plus its queue depth (normalized by slot count), ties to the lowest
+  index.  The default.
+- :class:`PrefixAffinity` — prompts sharing a prefix registered through
+  ``Fleet.register_prefix`` route to the replica holding that prefix's
+  pool row (its KV splice makes admission cheap THERE and nowhere
+  else); everything else falls through to an inner policy.
+
+:class:`RetryPolicy` is the dispatch-failure schedule: exponential
+backoff with seeded jitter, measured in FLEET STEPS so the whole retry
+timeline is deterministic under the fault harness.  ``max_attempts``
+exhausted fails the request (``Fleet.result`` raises with the last
+error).  :class:`FleetOverloaded` is the explicit shed signal raised by
+``Fleet.submit`` when the bounded fleet queue is full — retriable by
+construction: the queue drains as replicas finish work.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["FleetOverloaded", "RetryPolicy", "RoundRobin",
+           "LeastLoaded", "PrefixAffinity", "make_policy"]
+
+
+class FleetOverloaded(RuntimeError):
+    """The bounded fleet queue is full: the request was SHED, not
+    queued.  Retriable — resubmit after backoff; ``queue_depth`` and
+    ``max_queue`` say how far over capacity the caller found us."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        super().__init__(
+            f"fleet queue full ({queue_depth}/{max_queue}); request "
+            f"shed — retry after backoff")
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+class RetryPolicy:
+    """Exponential backoff with full seeded jitter, in fleet steps.
+
+    Attempt k (0-based) that fails waits
+    ``min(base_delay_steps * backoff**k, max_delay_steps)`` steps,
+    scaled by ``uniform(1 - jitter, 1 + jitter)`` from a seeded RNG —
+    deterministic per policy instance, which is what lets the tests
+    pin exact retry timelines."""
+
+    def __init__(self, max_attempts: int = 4,
+                 base_delay_steps: int = 1,
+                 max_delay_steps: int = 16,
+                 backoff: float = 2.0,
+                 jitter: float = 0.5,
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got "
+                             f"{max_attempts}")
+        if not (0.0 <= jitter < 1.0):
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.max_attempts = max_attempts
+        self.base_delay_steps = base_delay_steps
+        self.max_delay_steps = max_delay_steps
+        self.backoff = backoff
+        self.jitter = jitter
+        self._rng = np.random.RandomState(seed)
+
+    def delay_steps(self, attempt: int) -> int:
+        """Steps to wait after failed attempt number ``attempt``
+        (0-based)."""
+        d = min(self.base_delay_steps * self.backoff ** attempt,
+                float(self.max_delay_steps))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.uniform() - 1.0)
+        return max(1, int(round(d)))
+
+
+# -- policies --------------------------------------------------------------
+
+def _load(replica) -> float:
+    """Occupancy + queued work, both normalized per slot — one scalar
+    'how busy' from the scheduler's cheap accessors (``stats()`` is
+    too heavy for a per-dispatch read)."""
+    slots = max(replica.slots, 1)
+    return replica.live() / slots + replica.queue_depth() / slots
+
+
+class RoundRobin:
+    """Cycle through the candidate list."""
+    name = "round_robin"
+
+    def __init__(self):
+        self._next = 0
+
+    def select(self, fleet, candidates: Sequence[int], req) -> int:
+        # candidates are sorted replica indices; take the first one at
+        # or after the cursor so removal of a replica (drain/death)
+        # cannot wedge the rotation
+        pick = next((i for i in candidates if i >= self._next),
+                    candidates[0])
+        self._next = pick + 1
+        return pick
+
+
+class LeastLoaded:
+    """Lowest occupancy+queue replica wins; ties to the lowest
+    index."""
+    name = "least_loaded"
+
+    def select(self, fleet, candidates: Sequence[int], req) -> int:
+        return min(candidates,
+                   key=lambda i: (_load(fleet.replicas[i]), i))
+
+
+class PrefixAffinity:
+    """Route prompts to the replica holding their registered prefix.
+
+    ``Fleet.register_prefix`` prefills the prefix into ONE replica's
+    pool and records the owner; a prompt starting with a registered
+    prefix prefers that owner (longest match wins) whenever it is an
+    admissible candidate — landing the request on the replica where
+    admission is a KV splice instead of a full prefill.  Everything
+    else (no match, owner dead/draining/full) falls through to
+    ``fallback``."""
+    name = "prefix_affinity"
+
+    def __init__(self, fallback=None):
+        self.fallback = fallback or LeastLoaded()
+
+    def select(self, fleet, candidates: Sequence[int], req) -> int:
+        owner = fleet.prefix_owner(req.prompt)
+        if owner is not None and owner in candidates:
+            return owner
+        return self.fallback.select(fleet, candidates, req)
+
+
+_POLICIES = {"round_robin": RoundRobin, "least_loaded": LeastLoaded,
+             "prefix_affinity": PrefixAffinity}
+
+
+def make_policy(policy) -> object:
+    """Resolve a policy name or pass an instance through."""
+    if isinstance(policy, str):
+        try:
+            return _POLICIES[policy]()
+        except KeyError:
+            raise ValueError(
+                f"unknown routing policy {policy!r}; known: "
+                f"{sorted(_POLICIES)}") from None
+    if not hasattr(policy, "select"):
+        raise TypeError(f"policy must be a name or expose "
+                        f".select(fleet, candidates, req); got "
+                        f"{type(policy).__name__}")
+    return policy

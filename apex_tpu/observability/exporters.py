@@ -29,7 +29,8 @@ from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
-           "validate_telemetry_record", "validate_telemetry_jsonl"]
+           "validate_fleet_record", "validate_telemetry_record",
+           "validate_telemetry_jsonl"]
 
 SCHEMA_VERSION = 1
 
@@ -306,19 +307,80 @@ def validate_lint_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- fleet record schema ---------------------------------------------------
+
+# monotonic fleet totals every ``kind: fleet`` record must carry —
+# Fleet.record() emits exactly these (plus replicas/policy/state tallies)
+_FLEET_COUNTS = ("queue_depth", "submitted", "finished", "failed",
+                 "shed", "retries", "failovers", "drains", "tokens")
+
+
+def validate_fleet_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: fleet`` JSONL record
+    (``Fleet.record()`` enriched by the exporter): the common envelope
+    plus the replica/state tallies and the fleet counters
+    (shed/retries/failovers/drains & co), with the cross-field sanity
+    checks a dashboard would otherwise discover at 3am — state tallies
+    cannot exceed the replica count, finishes cannot exceed
+    submissions."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types):
+        return _need(rec, errs, key, types)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "fleet":
+        errs.append(f"kind must be 'fleet', got {rec.get('kind')!r}")
+    pol = need("policy", str)
+    if isinstance(pol, str) and not pol:
+        errs.append("policy must be non-empty")
+    n = need("replicas", int)
+    if isinstance(n, int) and not isinstance(n, bool) and n < 1:
+        errs.append(f"replicas must be >= 1, got {n}")
+    tally = 0
+    for key in ("healthy", "degraded", "dead"):
+        v = need(key, int)
+        if isinstance(v, int) and not isinstance(v, bool):
+            if v < 0:
+                errs.append(f"{key!r} must be >= 0, got {v}")
+            tally += v
+    if isinstance(n, int) and not isinstance(n, bool) and tally > n:
+        errs.append(f"healthy+degraded+dead ({tally}) exceeds "
+                    f"replicas ({n})")
+    for key in _FLEET_COUNTS:
+        v = need(key, int)
+        if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+            errs.append(f"{key!r} must be >= 0, got {v}")
+    fin, sub = rec.get("finished"), rec.get("submitted")
+    if (isinstance(fin, int) and isinstance(sub, int)
+            and not isinstance(fin, bool) and not isinstance(sub, bool)
+            and fin > sub):
+        errs.append(f"finished ({fin}) exceeds submitted ({sub})")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 def validate_telemetry_record(rec: Any) -> List[str]:
-    """Dispatching validator: graph-lint records (by ``kind``) go
-    through :func:`validate_lint_record`, everything else through the
-    bench schema — so one stream may interleave bench measurements and
-    lint findings (``bench.py --graph-lint``)."""
+    """Dispatching validator: graph-lint and fleet records (by
+    ``kind``) go through their own schemas, everything else through
+    the bench schema — so one stream may interleave bench
+    measurements, lint findings (``bench.py --graph-lint``) and fleet
+    snapshots (``bench.py --fleet N``)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "fleet":
+        return validate_fleet_record(rec)
     return validate_bench_record(rec)
 
 
 def validate_telemetry_jsonl(lines: Iterable[str]) -> List[str]:
-    """Validate a mixed bench + graph-lint JSONL stream."""
+    """Validate a mixed bench + graph-lint + fleet JSONL stream."""
     return _validate_jsonl(lines, validate_telemetry_record)
 
 

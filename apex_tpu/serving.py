@@ -315,11 +315,72 @@ class _SlotScheduler:
         self._submit_ts[rid] = self._clock()
         self._waiting.append((rid, list(prompt), max_new_tokens,
                               eos_token_id, seed, temperature))
+        self._set_queue_gauge()
         return rid
+
+    def _set_queue_gauge(self):
+        # the gauge must track every mutation of the waiting queue, not
+        # only the end-of-step snapshot: the fleet layer sheds, drains
+        # and re-enqueues between steps, and its tests read the gauge
+        # against stats()["queue_depth"] after each such move
+        self.metrics.gauge("engine_queue_depth").set(len(self._waiting))
 
     def _drain_queue(self):
         while self._free and self._waiting:
             self._admit_timed(*self._waiting.pop(0))
+        self._set_queue_gauge()
+
+    def take_waiting(self) -> List[tuple]:
+        """Pop and return the whole waiting queue (FIFO order) as
+        ``(rid, prompt, max_new_tokens, eos_token_id, seed,
+        temperature)`` tuples — the drain/failover hook: a fleet
+        re-enqueues these onto surviving replicas.  The popped rids are
+        dead to THIS engine (its queue-depth gauge and stats drop
+        them); the caller owns re-submission."""
+        taken, self._waiting = self._waiting, []
+        for rid, *_ in taken:
+            self._submit_ts.pop(rid, None)
+        self._set_queue_gauge()
+        return taken
+
+    def free_slots(self) -> int:
+        """Slots a new request could claim right now (admission-control
+        surface for routers that must not grow ``_waiting``)."""
+        return len(self._free)
+
+    def queue_depth(self) -> int:
+        """Waiting-queue length, without the histogram-summary cost of
+        ``stats()`` — the fleet router reads this every dispatch."""
+        return len(self._waiting)
+
+    def is_finished(self, rid: int) -> bool:
+        """True once ``result(rid)`` will return (harvest surface for a
+        fleet polling many replicas)."""
+        return rid in self._finished
+
+    def cancel(self, rid: int) -> bool:
+        """Abandon a request: a waiting request is dropped from the
+        queue, a live one frees its slot and freezes on device (its
+        partial tokens are discarded — it never enters ``result()``).
+        Returns False for unknown/finished rids.  The fleet layer uses
+        this to clear stale work off a replica being drained or
+        recovered after a failover."""
+        for i, item in enumerate(self._waiting):
+            if item[0] == rid:
+                del self._waiting[i]
+                self._submit_ts.pop(rid, None)
+                self._set_queue_gauge()
+                return True
+        for slot, req in list(self._by_slot.items()):
+            if req.rid == rid:
+                del self._by_slot[slot]
+                self._free.append(slot)
+                self._freeze_slot(slot)
+                self.metrics.gauge("engine_live").set(len(self._by_slot))
+                self.metrics.gauge("engine_occupancy").set(
+                    len(self._by_slot) / self.slots)
+                return True
+        return False
 
     def _finish(self, slot, req):
         req.done = True
@@ -820,6 +881,11 @@ class Engine(_SlotScheduler):
         finished requests free their slot (their last token, EOS
         included, is still reported and recorded) and queued arrivals
         admit at the window boundary."""
+        if not self._by_slot and self._waiting:
+            # cancel() can free every slot without draining the queue
+            # (unlike _finish, which drains via _harvest); admit here so
+            # queued requests never strand on an idle engine
+            self._drain_queue()
         if not self._by_slot:
             return {}
         t0 = self._clock()
@@ -981,6 +1047,10 @@ class Seq2SeqEngine(_SlotScheduler):
         in-graph ticks; {rid: [tokens]} for live requests.  Finishes
         on per-request EOS or token budget (frozen mid-window
         in-graph); the slot frees at the window boundary."""
+        if not self._by_slot and self._waiting:
+            # see Engine.step: cancel() may leave waiting work on an
+            # otherwise idle engine
+            self._drain_queue()
         if not self._by_slot:
             return {}
         t0 = self._clock()

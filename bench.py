@@ -187,6 +187,149 @@ def main():
             save_tpu_record([line])
         print(json.dumps(line), flush=True)
 
+    # --fleet N: multi-replica serving-fleet bench — steady-state
+    # throughput and per-request tail latency of an N-replica Fleet vs
+    # a single replica (same engine shape, same workload), then the
+    # same fleet workload with one replica KILLED mid-run by the
+    # seeded fault harness (failover cost made visible).  Emits bench
+    # metric lines plus `kind: fleet` snapshot records; the whole
+    # stream stays check_bench_schema clean.  Runs INSTEAD of the job
+    # list (it is an explicit opt-in comparison, not a smoke config)
+    # but AFTER --graph-lint, which still gates the exit status.
+    fleet_n = 0
+    if "--fleet" in sys.argv:
+        idx = sys.argv.index("--fleet")
+        try:
+            fleet_n = int(sys.argv[idx + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("bench: --fleet needs an integer replica "
+                             "count (e.g. --fleet 2)")
+        if fleet_n < 1:
+            raise SystemExit(f"bench: --fleet must be >= 1, got "
+                             f"{fleet_n}")
+
+    def run_fleet_bench():
+        from apex_tpu import serving
+        from apex_tpu.fleet import FaultyReplica, Fleet, RetryPolicy
+
+        cfg = models.GPTConfig(vocab_size=128, block_size=32,
+                               n_layer=2, n_head=4, n_embd=32,
+                               dropout=0.0)
+        model = models.GPT(cfg)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        slots, prompt_len, new_tokens = 4, 4, 16
+        requests = 32 * max(fleet_n, 2)
+        rounds = 4
+
+        def _round(x, nd=4):
+            return None if x is None else round(x, nd)
+
+        def build_fleet(n_replicas, inject_death=False):
+            reps = [serving.Engine(model, params, slots=slots,
+                                   buf_len=cfg.block_size)
+                    for _ in range(n_replicas)]
+            if inject_death:
+                reps[0] = FaultyReplica(reps[0])
+            # a replica death burns one attempt per failover plus one
+            # per sacrificed half-open probe; the default budget of 4
+            # can strand a request mid-bench, which would understate
+            # the failover story — give requests room to survive it.
+            # step_workers=1 FORCES the serial loop the emitted note
+            # describes: this comparison isolates orchestration cost,
+            # and on a shared-CPU host threaded replicas oversubscribe
+            # the XLA intra-op pool and corrupt the measurement
+            return Fleet(reps, policy="least_loaded",
+                         max_queue=2 * requests,
+                         retry=RetryPolicy(max_attempts=10),
+                         step_workers=1), reps
+
+        def measure(fl, n_requests=None):
+            """One saturated pass of the workload; returns
+            (tokens/sec, sorted per-request latencies)."""
+            rng = np.random.RandomState(0)
+            rids = [fl.submit(
+                list(rng.randint(0, cfg.vocab_size, prompt_len)),
+                max_new_tokens=new_tokens)
+                for _ in range(n_requests or requests)]
+            tok0 = fl.stats()["tokens_generated"]
+            t0 = time.perf_counter()
+            while fl.live():
+                fl.step()
+            dt = time.perf_counter() - t0
+            lat = sorted(fl.latency(r) for r in rids
+                         if fl.status(r) == "finished")
+            return (fl.stats()["tokens_generated"] - tok0) / dt, lat
+
+        def pcts(lat):
+            if not lat:
+                return None, None
+            return (lat[len(lat) // 2],
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))])
+
+        # Warm both fleets once (every Engine instance jits its own
+        # closures — a cold timed run measures compiles, not serving),
+        # then INTERLEAVE best-of-N measured passes: single and fleet
+        # alternate, so background-load drift on a shared host hits
+        # both sides instead of whichever ran second.
+        f_single, _ = build_fleet(1)
+        f_multi, _ = build_fleet(fleet_n)
+        measure(f_single, n_requests=2 * slots)
+        measure(f_multi, n_requests=2 * slots * fleet_n)
+        s_best, f_best = (0.0, []), (0.0, [])
+        for _ in range(rounds):
+            s_best = max(s_best, measure(f_single), key=lambda x: x[0])
+            f_best = max(f_best, measure(f_multi), key=lambda x: x[0])
+        f_single.close()
+        f_multi.close()
+        (single_tput, s_lat), (tput, f_lat) = s_best, f_best
+        s_p50, s_p99 = pcts(s_lat)
+        p50, p99 = pcts(f_lat)
+        shared_note = (f"best of {rounds} interleaved passes on warm "
+                       f"fleets, {requests} requests x {new_tokens} "
+                       f"new, {slots} slots/replica, serial stepping; "
+                       f"on a shared-CPU host replicas add no compute "
+                       f"— the fleet's edge is per-tick cost "
+                       f"amortization; real scale-out needs replicas "
+                       f"on separate accelerators")
+        emit(metric="gpt_tiny_fleet_single_decode_throughput",
+             value=round(single_tput, 1), unit="tokens/sec",
+             vs_baseline=None, window=1,
+             p50_latency_s=_round(s_p50), p99_latency_s=_round(s_p99),
+             note=f"1 replica — the --fleet baseline; {shared_note}")
+        emit(metric=f"gpt_tiny_fleet{fleet_n}_decode_throughput",
+             value=round(tput, 1), unit="tokens/sec",
+             vs_baseline=round(tput / single_tput, 3), window=1,
+             p50_latency_s=_round(p50), p99_latency_s=_round(p99),
+             note=f"{fleet_n} replicas, least_loaded; vs_baseline is "
+                  f"the fleet/single throughput ratio; {shared_note}")
+        emit(**f_multi.record())
+
+        # same workload, one replica killed mid-run: armed AFTER
+        # warmup to raise 6 steps into the timed run (a constructor
+        # window would fire during warmup and kill the replica before
+        # t0); the breaker opens and every reclaimed request restarts
+        # on the survivors
+        fl_d, reps_d = build_fleet(fleet_n, inject_death=True)
+        measure(fl_d, n_requests=2 * slots * fleet_n)    # warm
+        reps_d[0].arm(raise_on_step=(6, None))
+        tput_d, d_lat = measure(fl_d)
+        fl_d.close()
+        p50_d, p99_d = pcts(d_lat)
+        emit(metric=f"gpt_tiny_fleet{fleet_n}_decode_throughput_"
+                    f"replica_death",
+             value=round(tput_d, 1), unit="tokens/sec",
+             vs_baseline=round(tput_d / single_tput, 3), window=1,
+             p50_latency_s=_round(p50_d),
+             p99_latency_s=_round(p99_d),
+             note=f"{fleet_n} replicas, replica 0 armed to raise 6 "
+                  f"steps into the timed run (seeded fault harness): "
+                  f"failovers={fl_d.stats()['failovers']}, survivors "
+                  f"absorb the reclaimed requests")
+        emit(**fl_d.record())
+
     lint_errors = 0
     if "--graph-lint" in sys.argv:
         # prepend static graph-lint findings to the telemetry stream
@@ -208,6 +351,12 @@ def main():
         print(f"bench --graph-lint: {lint_errors} error(s), "
               f"{summary.get('skipped_entry_points', 0)} skipped "
               f"entry point(s)", file=sys.stderr)
+
+    if fleet_n:
+        run_fleet_bench()
+        # --graph-lint (if also passed) already ran above and still
+        # gates the exit status; the job list is skipped
+        return 1 if lint_errors else 0
 
     def timed(train, state, batch, iters, warmup):
         """sec/step with a hard D2H fetch as the barrier —

@@ -11,10 +11,13 @@ is meaningless without them.  Graph-lint records (``kind:
 graph_lint`` / ``graph_lint_summary``, from ``python -m
 apex_tpu.analysis``, ``bench.py --graph-lint`` or
 tests/ci/graph_lint.py) are validated against the lint schema
-(``validate_lint_record``); the two record families may interleave in
-one stream.  Usage:
+(``validate_lint_record``), and fleet snapshots (``kind: fleet``,
+from ``bench.py --fleet N`` / ``Fleet.record()``) against the fleet
+schema (``validate_fleet_record``); all record families may
+interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
+    python bench.py --fleet 2 | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
     python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
 

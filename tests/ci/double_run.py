@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""CI gate: run the serving + fleet suites TWICE against ONE
+persistent compile-cache dir.
+
+Why twice: the PR 2 donation gotcha.  On jax 0.4.37's XLA:CPU,
+donating the wrong argnum class (the per-slot length vectors,
+``serving.DONATION_BLOCKLIST``) produces executables that work when
+freshly compiled but decode garbage when RELOADED from the persistent
+compilation cache — so a single green run proves nothing about the
+next warm one.  Run 1 populates a dedicated cache dir; run 2 executes
+the very same jitted mutators from AOT-reloaded executables.  Both
+must pass.  The static donation rule (apex_tpu/analysis) pins the
+blocklist structurally; this gate pins the runtime behavior.
+
+Usage:
+
+    python tests/ci/double_run.py             # temp cache dir
+    python tests/ci/double_run.py /some/dir   # persistent across CI runs
+    python tests/ci/double_run.py --keep      # leave the temp dir behind
+
+Extra pytest args go after ``--``:
+
+    python tests/ci/double_run.py -- -x -q
+
+Exit status 0 = both runs green; the failing run's status otherwise.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), os.pardir, os.pardir))
+
+# the suites exercising every donated cache mutator: the engines
+# directly, and the fleet driving many engine instances (each with its
+# own jit closures -> its own cache entries)
+SUITES = ["tests/test_serving.py", "tests/test_fleet.py"]
+
+
+def main(argv):
+    args = argv[1:]
+    extra = []
+    if "--" in args:
+        split = args.index("--")
+        args, extra = args[:split], args[split + 1:]
+    keep = "--keep" in args
+    args = [a for a in args if a != "--keep"]
+    if args:
+        cache_dir, made_tmp = os.path.abspath(args[0]), False
+        os.makedirs(cache_dir, exist_ok=True)
+    else:
+        cache_dir = tempfile.mkdtemp(prefix="apex_tpu_double_run_")
+        made_tmp = True
+
+    env = dict(os.environ)
+    env["APEX_TPU_COMPILE_CACHE_DIR"] = cache_dir
+    env.pop("APEX_TPU_NO_COMPILE_CACHE", None)
+
+    status = 0
+    try:
+        for run in (1, 2):
+            label = ("cold (populates the cache)" if run == 1
+                     else "warm (AOT-reloaded executables)")
+            print(f"double_run: run {run}/2 — {label}; cache dir "
+                  f"{cache_dir}", flush=True)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", *SUITES, "-q",
+                 *(extra or ["-x"])],
+                cwd=_ROOT, env=env)
+            if proc.returncode != 0:
+                print(f"double_run: run {run}/2 FAILED "
+                      f"(exit {proc.returncode})"
+                      + ("" if run == 1 else
+                         " — executables reloaded from the persistent "
+                         "compile cache misbehaved; suspect a donation "
+                         "change (see serving.DONATION_BLOCKLIST)"),
+                      file=sys.stderr)
+                status = proc.returncode
+                break
+        else:
+            print("double_run: both runs green — donated executables "
+                  "survive the AOT cache round trip")
+    finally:
+        if made_tmp and not keep:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

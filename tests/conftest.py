@@ -87,8 +87,14 @@ def mesh8():
 # cross-worker repeats near-free.  Disable with APEX_TPU_NO_COMPILE_CACHE=1
 # (e.g. if the XLA:CPU AOT loader's machine-feature check ever misfires).
 if not os.environ.get("APEX_TPU_NO_COMPILE_CACHE"):
-    _cache_dir = os.path.join(os.path.dirname(__file__), "..",
-                              ".jax_compile_cache")
+    # APEX_TPU_COMPILE_CACHE_DIR points the suite at a DEDICATED cache
+    # dir — tests/ci/double_run.py uses it to run the serving+fleet
+    # suites twice against one fresh persistent cache (the regression
+    # gate for the PR 2 donated-executable AOT-reload gotcha).
+    _cache_dir = os.environ.get(
+        "APEX_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..",
+                     ".jax_compile_cache"))
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -371,9 +371,9 @@ def test_lint_summary_schema():
 
 
 def test_telemetry_jsonl_validates_mixed_stream():
-    """One stream may interleave bench records and lint findings
-    (bench.py --graph-lint); the dispatching validator checks each
-    against its own schema."""
+    """One stream may interleave bench records, lint findings
+    (bench.py --graph-lint) and fleet snapshots (bench.py --fleet N);
+    the dispatching validator checks each against its own schema."""
     import json
     bench_rec = exporters.JsonlExporter.enrich(
         {"metric": "engine_decode", "value": 100.0,
@@ -381,13 +381,26 @@ def test_telemetry_jsonl_validates_mixed_stream():
          "arch": "gpt", "window": 8, "tokens_per_sync": 8.0})
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
-    lines = [json.dumps(bench_rec), json.dumps(lint_rec)]
+    fleet_rec = exporters.JsonlExporter.enrich(
+        {"kind": "fleet", "replicas": 2, "policy": "least_loaded",
+         "healthy": 1, "degraded": 0, "dead": 1, "queue_depth": 0,
+         "submitted": 8, "finished": 8, "failed": 0, "shed": 0,
+         "retries": 1, "failovers": 3, "drains": 0, "tokens": 64})
+    lines = [json.dumps(bench_rec), json.dumps(lint_rec),
+             json.dumps(fleet_rec)]
     assert exporters.validate_telemetry_jsonl(lines) == []
     # a lint violation is caught positionally
     lint_rec2 = dict(lint_rec, message="")
-    lines = [json.dumps(bench_rec), json.dumps(lint_rec2)]
+    lines = [json.dumps(bench_rec), json.dumps(lint_rec2),
+             json.dumps(fleet_rec)]
     errs = exporters.validate_telemetry_jsonl(lines)
     assert len(errs) == 1 and "line 2" in errs[0]
+    # a fleet violation too (kind-dispatched, not bench-shaped)
+    fleet_bad = dict(fleet_rec, failovers=-1)
+    errs = exporters.validate_telemetry_jsonl(
+        [json.dumps(bench_rec), json.dumps(fleet_bad)])
+    assert len(errs) == 1 and "line 2" in errs[0] \
+        and "failovers" in errs[0]
     # and a bench violation still is too
     bench_bad = {k: v for k, v in bench_rec.items() if k != "window"}
     errs = exporters.validate_telemetry_jsonl([json.dumps(bench_bad)])

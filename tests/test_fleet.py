@@ -1,0 +1,668 @@
+"""Serving fleet: routing, health/breaker, drain, backpressure, and —
+the pin that matters — failover EXACTNESS: a request reclaimed from a
+replica killed mid-decode and restarted on a survivor must produce
+token-for-token the output of an undisturbed single engine.
+
+Two layers of coverage: the orchestration machinery (breaker
+transitions, retry backoff, shed, drain, deadlines, watchdog) runs
+against a jax-free stub replica wrapped by the seeded fault harness —
+every schedule is exact and instant; the exactness and prefix-affinity
+contracts run against real Engines on the tiny GPT config."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import models, serving
+from apex_tpu.fleet import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
+                            FaultyReplica, Fleet, FleetOverloaded,
+                            HealthConfig, LeastLoaded, PrefixAffinity,
+                            ReplicaFault, RetryPolicy, RoundRobin,
+                            make_policy)
+from apex_tpu.observability.exporters import (JsonlExporter,
+                                              validate_fleet_record,
+                                              validate_telemetry_record)
+
+
+# -- jax-free stub replica: the scheduler surface, deterministic tokens ---
+
+class _StubReplica:
+    """Minimal scheduler-surface replica: request k's token number j is
+    ``100 * (len(prompt)) + j`` — content-free but fully deterministic,
+    so restart-exactness holds by construction and the tests can focus
+    on the orchestration."""
+
+    def __init__(self, slots=2):
+        self.slots = slots
+        self._free = list(range(slots))
+        self._live = {}                  # rid -> [prompt, max_new, done]
+        self._waiting = []
+        self._finished = {}
+        self._next_rid = 0
+
+    @staticmethod
+    def expected(prompt, max_new):
+        return [100 * len(prompt) + j for j in range(max_new)]
+
+    def _admit(self, rid, prompt, max_new):
+        self._free.pop()
+        self._live[rid] = [list(prompt), max_new, []]
+
+    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
+                    seed=None, temperature=None):
+        if not self._free:
+            raise RuntimeError("no free slot")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._admit(rid, prompt, max_new_tokens)
+        return rid
+
+    def submit(self, prompt, max_new_tokens, eos_token_id=None,
+               seed=None, temperature=None):
+        if self._free and not self._waiting:
+            return self.add_request(prompt, max_new_tokens)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._waiting.append((rid, list(prompt), max_new_tokens,
+                              eos_token_id, seed, temperature))
+        return rid
+
+    def step(self):
+        out = {}
+        for rid, rec in list(self._live.items()):
+            prompt, max_new, got = rec
+            tok = 100 * len(prompt) + len(got)
+            got.append(tok)
+            out[rid] = [tok]
+            if len(got) >= max_new:
+                del self._live[rid]
+                self._free.append(0)
+                self._finished[rid] = got
+        while self._free and self._waiting:
+            rid, prompt, max_new, *_ = self._waiting.pop(0)
+            self._admit(rid, prompt, max_new)
+        return out
+
+    def live(self):
+        return len(self._live)
+
+    def free_slots(self):
+        return len(self._free)
+
+    def queue_depth(self):
+        return len(self._waiting)
+
+    def is_finished(self, rid):
+        return rid in self._finished
+
+    def result(self, rid):
+        return list(self._finished[rid])
+
+    def cancel(self, rid):
+        for i, item in enumerate(self._waiting):
+            if item[0] == rid:
+                del self._waiting[i]
+                return True
+        if rid in self._live:
+            del self._live[rid]
+            self._free.append(0)
+            return True
+        return False
+
+    def take_waiting(self):
+        taken, self._waiting = self._waiting, []
+        return taken
+
+    def stats(self):
+        return {"live": len(self._live), "slots": self.slots,
+                "occupancy": len(self._live) / self.slots,
+                "queue_depth": len(self._waiting),
+                "free": len(self._free)}
+
+
+def _drive(fl, limit=200):
+    n = 0
+    while fl.live():
+        fl.step()
+        n += 1
+        assert n < limit, "fleet failed to converge"
+    return n
+
+
+# -- orchestration machinery (stub replicas) -------------------------------
+
+def test_policies_route_and_validate():
+    fl = Fleet([_StubReplica(), _StubReplica(), _StubReplica()],
+               policy="round_robin", step_workers=1)
+    for _ in range(3):
+        fl.submit([1, 2], max_new_tokens=2)
+    fl.step()
+    # round robin spread one request per replica
+    assert [r.live() + len(r._finished) for r in fl.replicas] == [1, 1, 1]
+
+    # least-loaded prefers the emptiest replica
+    a, b = _StubReplica(slots=4), _StubReplica(slots=4)
+    fl2 = Fleet([a, b], policy="least_loaded", step_workers=1)
+    a._free = [0]                        # a is 3/4 full
+    a._live = {100 + i: [[1], 1, []] for i in range(3)}
+    fl2.submit([1, 2, 3], max_new_tokens=1)
+    fl2.step()
+    assert b.live() + len(b._finished) == 1
+
+    assert isinstance(make_policy("least_loaded"), LeastLoaded)
+    assert isinstance(make_policy("round_robin"), RoundRobin)
+    assert isinstance(make_policy("prefix_affinity"), PrefixAffinity)
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        make_policy("wat")
+    with pytest.raises(TypeError, match="select"):
+        make_policy(object())
+    with pytest.raises(ValueError, match="at least one replica"):
+        Fleet([])
+
+
+def test_results_exact_and_threaded_equals_serial():
+    prompts = [[1] * (1 + i % 4) for i in range(8)]
+    outs = []
+    for workers in (1, 4):
+        fl = Fleet([_StubReplica(), _StubReplica()],
+                   step_workers=workers)
+        rids = [fl.submit(p, max_new_tokens=3) for p in prompts]
+        _drive(fl)
+        outs.append([fl.result(r) for r in rids])
+    assert outs[0] == outs[1]
+    assert outs[0] == [_StubReplica.expected(p, 3) for p in prompts]
+
+
+def test_backpressure_bounded_queue_sheds():
+    """The fleet queue is BOUNDED: overflow raises the retriable
+    FleetOverloaded instead of growing some _waiting list forever."""
+    fl = Fleet([_StubReplica(slots=1)], max_queue=2,
+               replica_queue_cap=0, step_workers=1)
+    fl.submit([1], max_new_tokens=50)
+    fl.step()                            # occupy the only slot
+    fl.submit([1, 2], max_new_tokens=1)  # queued (fleet level)
+    fl.submit([1, 2, 3], max_new_tokens=1)
+    with pytest.raises(FleetOverloaded) as ei:
+        fl.submit([1, 2, 3, 4], max_new_tokens=1)
+    assert ei.value.queue_depth == 2 and ei.value.max_queue == 2
+    s = fl.stats()
+    assert s["shed"] == 1 and s["queue_depth"] == 2
+    assert fl.metrics.counter("fleet_shed_total").value == 1.0
+    # shed is retriable: capacity comes back as requests finish
+    _drive(fl)
+    fl.submit([1, 2, 3, 4], max_new_tokens=1)
+    _drive(fl)
+    assert fl.stats()["failed"] == 0
+
+
+def test_dispatch_retry_backoff_then_success():
+    """Prefill faults burn attempts on an exponential step schedule
+    (jitter 0 → exact), then the request lands and completes."""
+    rep = FaultyReplica(_StubReplica(), raise_on_prefill=(0, None))
+    fl = Fleet([rep], retry=RetryPolicy(max_attempts=5,
+                                        base_delay_steps=1, backoff=2.0,
+                                        jitter=0.0),
+               step_workers=1)
+    rid = fl.submit([1, 2], max_new_tokens=2)
+    # prefill faults key off the wrapper's step counter, which only
+    # advances when the replica is stepped; with no live work the fleet
+    # never steps it, so the fault window is effectively permanent
+    # until we lift it
+    for _ in range(4):
+        fl.step()
+    assert fl.status(rid) == "queued"
+    assert fl.stats()["retries"] >= 1
+    # attempts 1..k fire at steps 1, 2, 4, 8 (backoff 2, no jitter)
+    req = fl._pending[0]
+    assert req.next_attempt_step > fl._step_no
+    rep._raise_on_prefill = ()           # heal the replica
+    _drive(fl, limit=40)
+    assert fl.result(rid) == _StubReplica.expected([1, 2], 2)
+    assert fl.metrics.counter("fleet_retries_total").value >= 1.0
+
+
+def test_retry_exhaustion_fails_request():
+    rep = FaultyReplica(_StubReplica(), raise_on_prefill=(0, None))
+    fl = Fleet([rep], retry=RetryPolicy(max_attempts=2, jitter=0.0),
+               step_workers=1)
+    rid = fl.submit([1], max_new_tokens=1)
+    for _ in range(6):
+        fl.step()
+    assert fl.status(rid) == "failed"
+    with pytest.raises(RuntimeError, match="dispatch failed after 2"):
+        fl.result(rid)
+    assert fl.stats()["failed"] == 1
+    # a shape-invalid request fails immediately, without blaming health
+    class _Picky(_StubReplica):
+        def submit(self, prompt, *a, **kw):
+            raise ValueError("prompt length bad")
+    fl2 = Fleet([_Picky()], step_workers=1)
+    bad = fl2.submit([1] * 99, max_new_tokens=1)
+    fl2.step()
+    with pytest.raises(RuntimeError, match="rejected at dispatch"):
+        fl2.result(bad)
+    assert fl2.health[0].errors_total == 0
+
+
+def test_circuit_breaker_dead_halfopen_recovery():
+    """Two consecutive step faults open the breaker; the replica is
+    not stepped during cooldown; the half-open probe closes it and the
+    reclaimed request still finishes exactly."""
+    rep = FaultyReplica(_StubReplica(), raise_on_step=(0, 2))
+    fl = Fleet([rep],
+               health=HealthConfig(dead_consecutive=2, cooldown_steps=4),
+               retry=RetryPolicy(max_attempts=10, jitter=0.0),
+               step_workers=1)
+    rid = fl.submit([1, 2, 3], max_new_tokens=4)
+    fl.step()                            # fault 1 -> failover, requeue
+    assert fl.states()[0] != DEAD        # one error: not dead yet
+    fl.step()                            # re-dispatch, fault 2 -> DEAD
+    assert fl.states() == [DEAD]
+    assert fl.health[0].circuit == "open"
+    steps_before = rep.steps
+    for _ in range(3):                   # cooldown: never stepped
+        fl.step()
+    assert rep.steps == steps_before
+    assert fl.health[0].circuit == "open"
+    fl.step()          # cooldown elapses -> half-open probe fires NOW
+    assert rep.steps == steps_before + 1
+    assert fl.health[0].circuit == "closed"   # clean probe closed it
+    _drive(fl, limit=20)
+    assert fl.states() == [HEALTHY]
+    assert fl.result(rid) == _StubReplica.expected([1, 2, 3], 4)
+    assert fl.stats()["failovers"] == 2
+
+
+def test_half_open_probe_dispatches_despite_healthy_capacity():
+    """Recovery must not starve: even when a healthy replica could
+    absorb every request, the half-open replica still receives its
+    one probe — otherwise it idles degraded forever and the fleet
+    permanently runs at reduced capacity."""
+    rep = FaultyReplica(_StubReplica(), raise_on_step=(0, 1))
+    ok = _StubReplica(slots=8)
+    fl = Fleet([rep, ok], policy="least_loaded",
+               health=HealthConfig(dead_consecutive=1, cooldown_steps=2),
+               retry=RetryPolicy(max_attempts=10, jitter=0.0),
+               step_workers=1)
+    rids = [fl.submit([1], max_new_tokens=2) for _ in range(2)]
+    fl.step()                            # replica 0 raises once -> DEAD
+    assert fl.states()[0] == DEAD
+    recovered_at = None
+    for i in range(10):                  # trickle: ok never saturates
+        fl.submit([2, 3], max_new_tokens=1)
+        fl.step()
+        if fl.health[0].circuit == "closed":
+            recovered_at = i
+            break
+    assert recovered_at is not None      # the probe DID dispatch
+    _drive(fl, limit=40)
+    assert fl.stats()["failed"] == 0
+    assert all(fl.result(r) == _StubReplica.expected([1], 2)
+               for r in rids)
+
+
+def test_failed_probe_doubles_cooldown():
+    rep = FaultyReplica(_StubReplica(), raise_on_step=(0, 3))
+    fl = Fleet([rep],
+               health=HealthConfig(dead_consecutive=2, cooldown_steps=2,
+                                   cooldown_backoff=2.0),
+               retry=RetryPolicy(max_attempts=20, jitter=0.0),
+               step_workers=1)
+    fl.submit([1], max_new_tokens=2)
+    fl.step()
+    fl.step()                            # 2 faults -> open, cooldown 2
+    assert fl.health[0].circuit == "open"
+    fl.step()                            # cooling
+    fl.step()          # half-open this step; probe raises (3rd fault)
+    assert fl.health[0].circuit == "open"
+    assert fl.health[0]._cooldown == 4   # doubled
+    _drive(fl, limit=40)                 # window over: recovers, finishes
+    assert fl.stats()["finished"] == 1
+
+
+def test_stall_watchdog_fails_over_silent_replica():
+    """A stalled replica (returns {} without stepping — never raises)
+    is caught by the no-progress watchdog and its work restarts on the
+    survivor, exact."""
+    stalled = FaultyReplica(_StubReplica(), stall=(0, None))
+    ok = _StubReplica()
+    fl = Fleet([stalled, ok], policy="round_robin",
+               health=HealthConfig(stall_steps=3, dead_consecutive=2),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0),
+               step_workers=1)
+    rids = [fl.submit([1, 2], max_new_tokens=3) for _ in range(2)]
+    _drive(fl, limit=60)
+    assert all(fl.result(r) == _StubReplica.expected([1, 2], 3)
+               for r in rids)
+    assert fl.stats()["failovers"] >= 1
+    assert fl.health[0].errors_total >= 1
+    # drop_results is the same silence with internal progress — the
+    # watchdog treats it identically
+    dropper = FaultyReplica(_StubReplica(), drop_results=(0, None))
+    fl2 = Fleet([dropper, _StubReplica()], policy="round_robin",
+                health=HealthConfig(stall_steps=3, dead_consecutive=2),
+                retry=RetryPolicy(max_attempts=6, jitter=0.0),
+                step_workers=1)
+    r2 = [fl2.submit([3], max_new_tokens=8) for _ in range(2)]
+    _drive(fl2, limit=80)
+    assert all(fl2.result(r) == _StubReplica.expected([3], 8)
+               for r in r2)
+
+
+def test_faulty_replica_arm_after_warmup_and_fleet_close():
+    """arm() programs fault windows RELATIVE to the current step
+    counter — 'die k steps from now', the post-warmup idiom bench.py
+    --fleet uses — and Fleet.close() joins the worker pool without
+    retiring the fleet."""
+    rep = FaultyReplica(_StubReplica())
+    fl = Fleet([rep, _StubReplica()], policy="round_robin",
+               health=HealthConfig(dead_consecutive=2),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0))
+    for _ in range(2):
+        fl.submit([1], max_new_tokens=2)
+    _drive(fl)                           # warmup: no faults fire
+    assert rep.faults_fired == 0 and rep.steps >= 2
+    base = rep.steps
+    rep.arm(raise_on_step=(1, None))     # die 1 step from NOW
+    assert rep._raise_on_step == ((base + 1, None),)
+    rids = [fl.submit([1, 2], max_new_tokens=3) for _ in range(2)]
+    _drive(fl, limit=80)
+    assert rep.faults_fired >= 1
+    assert all(fl.result(r) == _StubReplica.expected([1, 2], 3)
+               for r in rids)
+    with pytest.raises(TypeError, match="unknown fault kind"):
+        rep.arm(explode=(0, None))
+    rep.arm(raise_on_step=())            # clear the fault
+    assert rep._raise_on_step == ()
+    fl.close()                           # idempotent; step() revives
+    fl.close()
+    assert fl._pool is None
+    fl.undrain(0)                        # fresh record for replica 0
+    r = fl.submit([3], max_new_tokens=1)
+    _drive(fl, limit=20)
+    assert fl.result(r) == _StubReplica.expected([3], 1)
+
+
+def test_drain_reenqueues_waiting_finishes_inflight():
+    a, b = _StubReplica(slots=1), _StubReplica(slots=1)
+    fl = Fleet([a, b], policy="round_robin", replica_queue_cap=1,
+               step_workers=1)
+    rids = [fl.submit([1] * (i + 1), max_new_tokens=4)
+            for i in range(4)]
+    fl.step()   # a: slot+queue, b: slot+queue
+    assert a.queue_depth() == 1 and b.queue_depth() == 1
+    fl.drain(0)
+    # a's queued request went back to the fleet; its in-flight stays
+    assert a.queue_depth() == 0
+    assert fl.states()[0] == DRAINING and a.live() == 1
+    assert fl.stats()["drains"] == 1
+    _drive(fl, limit=60)
+    assert fl.states()[0] == DRAINED
+    for i, r in enumerate(rids):
+        assert fl.result(r) == _StubReplica.expected([1] * (i + 1), 4)
+    # drained replicas take no new work...
+    r5 = fl.submit([9], max_new_tokens=1)
+    _drive(fl, limit=20)
+    assert len(a._finished) == 1         # only its pre-drain request
+    # ...until re-enlisted
+    fl.undrain(0)
+    assert fl.states()[0] == HEALTHY
+    fl.submit([8], max_new_tokens=1)
+    fl.submit([7], max_new_tokens=1)
+    _drive(fl, limit=20)
+    assert fl.stats()["failed"] == 0 and fl.result(r5) == [100]
+
+
+def test_deadline_exceeded_fails_pending_and_inflight():
+    t = [0.0]
+    stub = _StubReplica(slots=2)
+    fl = Fleet([stub], clock=lambda: t[0],
+               replica_queue_cap=0, step_workers=1)
+    slow = fl.submit([1], max_new_tokens=100)
+    fl.step()                            # occupies slot 0
+    # submission order: `inflight` grabs the last slot, `queued` stays
+    # in the fleet queue — one deadline fires in each state
+    inflight = fl.submit([1, 2, 3], max_new_tokens=200, deadline=8.0)
+    queued = fl.submit([1, 2], max_new_tokens=1, deadline=5.0)
+    with pytest.raises(ValueError, match="deadline"):
+        fl.submit([1], max_new_tokens=1, deadline=0.0)
+    fl.step()
+    assert fl.status(inflight) == "inflight"
+    assert fl.status(queued) == "queued"
+    t[0] = 6.0                           # past queued's deadline
+    fl.step()
+    assert fl.status(queued) == "failed"
+    with pytest.raises(RuntimeError, match="deadline exceeded"):
+        fl.result(queued)
+    t[0] = 9.0                           # past inflight's deadline
+    fl.step()
+    assert fl.status(inflight) == "failed"
+    assert stub.live() == 1              # cancelled off the replica
+    assert fl.stats()["deadline_exceeded"] == 2
+    assert fl.status(slow) == "inflight"  # no deadline: untouched
+    with pytest.raises(KeyError):
+        fl.status(12345)
+
+
+def test_prefix_owner_longest_match_on_stub():
+    fl = Fleet([_StubReplica(), _StubReplica()], step_workers=1)
+    fl._prefix_map[(1, 2)] = 0
+    fl._prefix_map[(1, 2, 3)] = 1
+    assert fl.prefix_owner([1, 2, 3, 4]) == 1    # longest wins
+    assert fl.prefix_owner([1, 2, 9]) == 0
+    assert fl.prefix_owner([2, 1]) is None
+
+
+def test_fleet_record_schema_and_gauges():
+    fl = Fleet([_StubReplica(), _StubReplica()], step_workers=1)
+    rids = [fl.submit([1, 2], max_new_tokens=2) for _ in range(3)]
+    _drive(fl)
+    rec = JsonlExporter.enrich(fl.record())
+    assert validate_fleet_record(rec) == []
+    assert validate_telemetry_record(rec) == []   # kind-dispatch
+    assert rec["finished"] == 3 and rec["replicas"] == 2
+    # mutations the validator must catch
+    assert validate_fleet_record({**rec, "kind": "wat"})
+    assert validate_fleet_record({**rec, "policy": ""})
+    assert validate_fleet_record({**rec, "failovers": -1})
+    assert validate_fleet_record({**rec, "healthy": 3})   # > replicas
+    assert validate_fleet_record({**rec, "finished": 9})  # > submitted
+    assert validate_fleet_record(
+        {k: v for k, v in rec.items() if k != "shed"})
+    # per-replica labeled gauges exist and carry the final state
+    st = fl.metrics.gauge("fleet_replica_state_code")
+    assert set(st.children()) == {(("replica", "0"),),
+                                  (("replica", "1"),)}
+    assert fl.metrics.gauge("fleet_queue_depth").value == 0.0
+    assert fl.metrics.counter("fleet_finished_total").value == 3.0
+    assert len(rids) == 3
+
+
+# -- real engines: exactness + prefix affinity -----------------------------
+
+def _gpt(seed=0):
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=24,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(seed))
+    return m, params
+
+
+def _solo(m, params, prompt, n):
+    buf = jnp.zeros((1, 24), jnp.int32).at[0, :len(prompt)].set(
+        jnp.asarray(prompt))
+    out, flen = m.generate_cached(params, buf, len(prompt), n)
+    return list(np.asarray(out[0, len(prompt):int(flen[0])]))
+
+
+def test_fleet_of_engines_matches_solo_decoding():
+    m, params = _gpt()
+    fl = Fleet([serving.Engine(m, params, slots=2, buf_len=24)
+                for _ in range(2)], policy="least_loaded")
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, 64, int(rng.randint(3, 9))))
+               for _ in range(5)]
+    rids = [fl.submit(p, max_new_tokens=6) for p in prompts]
+    _drive(fl)
+    for r, p in zip(rids, prompts):
+        assert fl.result(r) == _solo(m, params, p, 6)
+    s = fl.stats()
+    assert s["finished"] == 5 and s["failed"] == 0
+    assert s["healthy"] == 2
+
+
+def test_failover_exactness_replica_killed_mid_decode():
+    """THE acceptance pin: a seeded fault kills replica 0 after its
+    3rd step — mid-decode for whatever it was running.  Every accepted
+    request's final tokens must be identical to an undisturbed
+    single-engine run (same prompts, same seeds)."""
+    m, params = _gpt()
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(0, 64, int(rng.randint(3, 9))))
+               for _ in range(6)]
+
+    # undisturbed single engine, the ground truth
+    single = serving.Engine(m, params, slots=2, buf_len=24)
+    expected = {}
+    srids = [single.submit(p, max_new_tokens=7) for p in prompts]
+    while single.live() or single.queue_depth():
+        single.step()
+    for r, p in zip(srids, prompts):
+        expected[tuple(p)] = single.result(r)
+        assert single.result(r) == _solo(m, params, p, 7)
+
+    bad = FaultyReplica(serving.Engine(m, params, slots=2, buf_len=24),
+                        raise_on_step=(3, None))
+    fl = Fleet([bad, serving.Engine(m, params, slots=2, buf_len=24)],
+               policy="round_robin",
+               health=HealthConfig(dead_consecutive=2, cooldown_steps=50),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0))
+    rids = [fl.submit(p, max_new_tokens=7) for p in prompts]
+    _drive(fl, limit=300)
+    s = fl.stats()
+    assert s["failovers"] >= 1            # the fault actually fired
+    assert s["failed"] == 0               # ...and nobody was lost
+    assert s["dead"] == 1                 # breaker opened, stayed open
+    for r, p in zip(rids, prompts):
+        assert fl.result(r) == expected[tuple(p)]
+
+
+def test_failover_exactness_sampled_with_explicit_seeds():
+    """Same pin through the sampled tick: explicit seeds make the
+    stream request-intrinsic, so a failed-over sampled request
+    re-draws exactly its single-engine tokens."""
+    m, params = _gpt(2)
+    rng = np.random.RandomState(2)
+    prompts = [list(rng.randint(0, 64, 5)) for _ in range(4)]
+
+    def sampled_engine():
+        return serving.Engine(m, params, slots=2, buf_len=24,
+                              temperature=0.8, top_k=8,
+                              rng=jax.random.PRNGKey(7))
+
+    single = sampled_engine()
+    srids = [single.submit(p, max_new_tokens=6, seed=100 + i)
+             for i, p in enumerate(prompts)]
+    while single.live() or single.queue_depth():
+        single.step()
+    expected = [single.result(r) for r in srids]
+
+    bad = FaultyReplica(sampled_engine(), raise_on_step=(2, None))
+    fl = Fleet([bad, sampled_engine()], policy="round_robin",
+               health=HealthConfig(dead_consecutive=2,
+                                   cooldown_steps=50),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0))
+    rids = [fl.submit(p, max_new_tokens=6, seed=100 + i)
+            for i, p in enumerate(prompts)]
+    _drive(fl, limit=300)
+    assert fl.stats()["failovers"] >= 1
+    assert [fl.result(r) for r in rids] == expected
+
+
+def test_prefix_affinity_routes_to_owner_and_splices():
+    m, params = _gpt()
+    rng = np.random.RandomState(3)
+    prefix = list(rng.randint(0, 64, 6))
+
+    def eng():
+        return serving.Engine(m, params, slots=2, buf_len=24,
+                              prefix_pool=1)
+
+    fl = Fleet([eng(), eng()], policy="prefix_affinity")
+    owner = fl.register_prefix(prefix, replica=1)
+    assert owner == 1
+    suffix = list(rng.randint(0, 64, 4))
+    rid = fl.submit(prefix + suffix, max_new_tokens=5)
+    other = fl.submit(list(rng.randint(0, 64, 5)), max_new_tokens=5)
+    _drive(fl)
+    # the matching prompt landed on the owner and admitted by splice
+    assert fl.replicas[1].prefix_hits == 1
+    assert fl.replicas[0].prefix_hits == 0
+    assert fl.result(rid) == _solo(m, params, prefix + suffix, 5)
+    assert fl.result(other) == _solo(
+        m, params, fl._results[other].prompt, 5)
+
+
+def test_engine_queue_bookkeeping_under_shed_drain_reenqueue():
+    """Satellite pin: engine_queue_depth (gauge) and
+    stats()['queue_depth'] stay correct through every fleet-era queue
+    mutation — submit-past-capacity, take_waiting (drain/failover
+    re-enqueue), cancel of a queued request, and re-submission onto
+    another replica."""
+    m, params = _gpt()
+
+    def gauge(e):
+        return e.metrics.gauge("engine_queue_depth").value
+
+    a = serving.Engine(m, params, slots=1, buf_len=24)
+    b = serving.Engine(m, params, slots=1, buf_len=24)
+    rng = np.random.RandomState(4)
+    p = [list(rng.randint(0, 64, 4)) for _ in range(4)]
+    a.submit(p[0], max_new_tokens=3)     # direct admit
+    q1 = a.submit(p[1], max_new_tokens=3)
+    a.submit(p[2], max_new_tokens=3)
+    assert a.stats()["queue_depth"] == 2 and gauge(a) == 2.0
+    # cancel one queued request
+    assert a.cancel(q1)
+    assert a.stats()["queue_depth"] == 1 and gauge(a) == 1.0
+    # drain-style take: the queue empties and the gauge follows
+    taken = a.take_waiting()
+    assert [t[0] for t in taken] == [a._next_rid - 1]
+    assert a.stats()["queue_depth"] == 0 and gauge(a) == 0.0
+    # re-enqueue the taken request onto ANOTHER replica
+    b.submit(p[3], max_new_tokens=3)     # occupy b's slot
+    rb = b.submit(taken[0][1], taken[0][2], taken[0][3])
+    assert b.stats()["queue_depth"] == 1 and gauge(b) == 1.0
+    while b.live() or b.queue_depth():
+        b.step()
+    assert gauge(b) == 0.0
+    assert b.result(rb) == _solo(m, params, taken[0][1], 3)
+    # cancel a LIVE request: slot frees, the engine stays consistent
+    while a.live() or a.queue_depth():   # finish a's original request
+        a.step()
+    live_rid = a.submit(p[0], max_new_tokens=5)
+    assert a.cancel(live_rid) and a.live() == 0
+    assert not a.cancel(live_rid)        # unknown now
+    r2 = a.submit(p[1], max_new_tokens=3)
+    while a.live() or a.queue_depth():
+        a.step()
+    assert a.result(r2) == _solo(m, params, p[1], 3)
+
+
+def test_cancel_frees_slot_and_queued_requests_still_run():
+    """cancel() on a full engine must not strand the waiting queue:
+    step() admits the queued work even though no slot is live."""
+    m, params = _gpt()
+    e = serving.Engine(m, params, slots=1, buf_len=24)
+    rng = np.random.RandomState(5)
+    pa, pb = list(rng.randint(0, 64, 4)), list(rng.randint(0, 64, 5))
+    ra = e.submit(pa, max_new_tokens=4)
+    rb = e.submit(pb, max_new_tokens=4)
+    assert e.cancel(ra)
+    assert e.live() == 0 and e.queue_depth() == 1
+    while e.live() or e.queue_depth():
+        e.step()
+    assert e.result(rb) == _solo(m, params, pb, 4)
+    with pytest.raises(KeyError):
+        e.result(ra)                     # cancelled: no result ever

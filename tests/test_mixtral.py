@@ -76,13 +76,23 @@ def test_mixtral_o2_trains():
     assert float(loss) < first - 0.5, (first, float(loss))
 
 
-# tier-1 budget (PR 2): slowest tests by --durations carry the slow
-# marker so a cold `-m 'not slow'` run fits the 870 s timeout
+# tier-1 budget: the reference half re-traces the full MoE forward per
+# grown length (~22 s warm), so the slow marker stays even though the
+# test passes again
 @pytest.mark.slow
 def test_mixtral_cached_decode_matches_full_forward():
     """Greedy cached generation == recomputing the full prefix each
-    step — the MoE block runs correctly on (B, 1, d) decode slices."""
-    m, params = _model(router_aux_loss_coef=0.02)
+    step — the MoE block runs correctly on (B, 1, d) decode slices.
+
+    DROPLESS capacity only (capacity_factor >= n_experts): per-expert
+    capacity is ceil(cf * tokens / n_experts), so at the fixture's old
+    cf=2.0 the full 22-token forward got capacity 6 while the 2-token
+    decode slice got capacity 1 — a token whose two top experts
+    collide with its batch-mate's was DROPPED in decode but kept in
+    the full forward, flipping a near-tied argmax.  That is exactly
+    the batch-dependence serving.Engine's dropless check exists for;
+    the parity contract is only defined dropless."""
+    m, params = _model(router_aux_loss_coef=0.02, capacity_factor=8.0)
     rng = np.random.RandomState(1)
     prompt = rng.randint(0, 97, (2, 5))
     buf = jnp.zeros((2, 16), jnp.int32).at[:, :5].set(jnp.asarray(prompt))
