@@ -139,7 +139,9 @@ _RESNET_ACT_ELEMS = 4 * 3 * 32 * 32
 
 def _ddp_resnet_graph(ep, opt_level, channels_last=False,
                       input_format="NCHW", stem="conv7",
-                      telemetry=False, B=8, image=32):
+                      telemetry=False, B=8, image=32,
+                      comm_topology="flat", compress=False,
+                      ici_size=None):
     """Trace the REAL DDP train step — shard_map over the 8-device CPU
     mesh with the grad allreduce inside — the same graph bench.py's
     headline and examples/imagenet execute.  ``telemetry=True`` threads
@@ -155,7 +157,9 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
         models.resnet18(num_classes=10, channels_last=channels_last,
                         input_format=input_format, stem=stem),
         optimizers.FusedAdam(1e-3), opt_level=opt_level, verbosity=0)
-    ddp = parallel.DistributedDataParallel(model)
+    ddp = parallel.DistributedDataParallel(
+        model, comm_topology=comm_topology,
+        allreduce_compress_bf16=compress, ici_size=ici_size)
     params, bn = model.init(jax.random.PRNGKey(0))
     ost = opt.init(params)
     rng = np.random.RandomState(0)
@@ -189,7 +193,9 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
             return (params, nb, ost2, tele), jax.lax.pmean(loss, "data")
         return (params, nb, ost2), jax.lax.pmean(loss, "data")
 
-    _fill_ddp_expectations(ep, opt_level, params)
+    _fill_ddp_expectations(ep, opt_level, params,
+                           comm_topology=comm_topology,
+                           compress=compress, ici_size=ici_size)
     state = (params, bn, ost) + ((dm.init(),) if telemetry else ())
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
@@ -204,18 +210,23 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
         pol, lambda: jax.make_jaxpr(mapped)(state, (x, y))))
 
 
-def _fill_ddp_expectations(ep, opt_level, params):
+def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
+                           compress=False, ici_size=None):
     """Derive the amp + collective expectations for a DDP train step.
 
-    Comm accounting: the step's psum population is exactly the grad
-    buckets of ``allreduce_comm_plan`` (one psum eqn per bucket, padded
-    chunks included in the wire bytes) plus two fp32 scalars — the
+    Comm accounting: the step's collective population is exactly the
+    grad buckets of ``allreduce_comm_plan`` under the SAME topology
+    knobs the step's DDP wrapper carries — one psum per bucket for the
+    flat topology; reduce_scatter + DCN reduce + all_gather per bucket
+    for the hierarchical one, per-level payloads included — folded by
+    ``plan_collective_expectations``, plus two fp32 scalars: the
     axis-size psum ``gradient_average`` divides by, and the
     ``pmean(loss)`` the step returns.  Grad dtypes equal the amp-cast
     param dtypes (``scaled_grad`` differentiates wrt the cast tree), so
     the plan over ``params`` IS the plan over the grads.
     """
     from .. import amp, parallel
+    import jax
     dt = str(np.dtype(amp.compute_dtype(opt_level)))
     ep.expect.setdefault("amp", {
         # resnet18 fwd has 20 convs; backward adds dgrad+wgrad per conv
@@ -224,10 +235,14 @@ def _fill_ddp_expectations(ep, opt_level, params):
         # the fc head forward dot; dgrad/wgrad have a (B, 10)-sized
         # operand below the large-dot threshold
         "dot_dtype": dt, "min_dots": 1})
-    plan = parallel.allreduce_comm_plan(params)
-    ep.expect.setdefault("collectives", {
-        "counts": {"psum": len(plan) + 2},
-        "payload_bytes": sum(b["wire_bytes"] for b in plan) + 2 * 4})
+    plan = parallel.allreduce_comm_plan(
+        params, comm_topology=comm_topology,
+        allreduce_compress_bf16=compress, ici_size=ici_size,
+        world=len(jax.devices()), nproc=1)
+    ep.expect.setdefault(
+        "collectives",
+        parallel.plan_collective_expectations(
+            plan, extra_psums=2, extra_psum_bytes=2 * 4))
 
 
 for _lvl in ("O0", "O1", "O2", "O3"):
@@ -250,6 +265,29 @@ register_entry_point(
     description="DDP resnet18 O2 channels-last step — transpose-free")(
     lambda ep: _ddp_resnet_graph(ep, "O2", channels_last=True,
                                  input_format="NHWC"))
+
+# hierarchical two-level gradient communication (ICI/DCN): the same O2
+# step with comm_topology="hierarchical" over a virtual 2-slice mesh
+# (ici_size=4 on the 8-device CPU mesh — jaxpr properties are
+# backend-independent, so the group structure pins what a real
+# 2-host x 4-chip run communicates).  The collective expectations are
+# DERIVED from allreduce_comm_plan under the same knobs: per-bucket
+# reduce_scatter/psum/all_gather counts and the per-primitive payload
+# split, where the bucket psum payload IS the DCN hop — 1/ici_size of
+# the flat payload.
+register_entry_point(
+    "ddp_resnet18_o2_hier", tags=("training", "ddp", "amp", "hier"),
+    description="DDP resnet18 O2 step, hierarchical ICI/DCN allreduce "
+                "(ici_size=4 on the 8-way mesh)")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", comm_topology="hierarchical",
+                                 ici_size=4))
+
+register_entry_point(
+    "ddp_resnet18_o2_hier_bf16", tags=("training", "ddp", "amp", "hier"),
+    description="DDP resnet18 O2 step, hierarchical allreduce with "
+                "bf16-compressed DCN hop")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", comm_topology="hierarchical",
+                                 ici_size=4, compress=True))
 
 register_entry_point(
     "ddp_resnet18_o2_nhwc_s2d", tags=("training", "ddp", "amp", "layout"),
@@ -308,9 +346,10 @@ def _transformer_graph(ep, family):
         # qkv/attention/MLP/fused-head dots, fwd and bwd
         "opt_level": "O2", "dot_dtype": dt, "min_dots": 10})
     plan = parallel.allreduce_comm_plan(params)
-    ep.expect.setdefault("collectives", {
-        "counts": {"psum": len(plan) + 2},
-        "payload_bytes": sum(b["wire_bytes"] for b in plan) + 2 * 4})
+    ep.expect.setdefault(
+        "collectives",
+        parallel.plan_collective_expectations(
+            plan, extra_psums=2, extra_psum_bytes=2 * 4))
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"),)),
@@ -480,10 +519,10 @@ def _tp_train_step_graph(ep):
                                   isinstance(s, P)))]
     plan = parallel.allreduce_comm_plan(local)
     act_bytes = (x.shape[0] // mesh.shape["data"]) * 8 * 4
-    ep.expect.setdefault("collectives", {
-        "counts": {"psum": 1 + len(plan) + 1},
-        "payload_bytes": act_bytes
-        + sum(b["wire_bytes"] for b in plan) + 4})
+    ep.expect.setdefault(
+        "collectives",
+        parallel.plan_collective_expectations(
+            plan, extra_psums=2, extra_psum_bytes=act_bytes + 4))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(specs, P("data"), P("data")),
                            out_specs=specs, check_vma=False)

@@ -37,11 +37,19 @@ runs where its key is present):
 
 ``collectives``::
 
-    {"counts": {"psum": 4}, "payload_bytes": 40038408}
+    {"counts": {"psum": 4}, "payload_bytes": 40038408,
+     "payload_bytes_by_primitive": {"psum": 40038408}}
 
     Exact comm accounting: any collective primitive not named in
     ``counts`` is budgeted at zero, and the total on-wire payload must
     match to the byte (``payload_tolerance`` relaxes it when needed).
+    ``payload_bytes_by_primitive`` (optional) additionally pins the
+    per-primitive split — for the hierarchical DDP topology that is the
+    fabric-level split: the bucket psum (or compressed bf16 all_gather)
+    payload is exactly the DCN hop, so a bucket sneaking a full-size
+    cross-host psum flags even if the total happens to balance.
+    ``parallel.plan_collective_expectations`` derives all three fields
+    from ``allreduce_comm_plan``.
 """
 
 from __future__ import annotations
@@ -272,4 +280,27 @@ class CollectiveRule(Rule):
                         f"wire, expected {w}"
                         + (f" (+/- {tol})" if tol else ""),
                     payload_bytes=total, expected_bytes=w))
+        if "payload_bytes_by_primitive" in want:
+            got_by = Counter()
+            for e in eqns:
+                got_by[e.primitive.name] += G.eqn_payload_bytes(e)
+            want_by = dict(want["payload_bytes_by_primitive"])
+            tol = want.get("payload_tolerance", 0)
+            # only a hierarchical plan (it budgets a reduce_scatter per
+            # bucket) makes the per-primitive split a fabric-level
+            # statement — don't point a flat-plan mismatch at ICI/DCN
+            hier = "reduce_scatter" in want.get("counts", want_by)
+            for prim in sorted(set(got_by) | set(want_by)):
+                g, w = got_by.get(prim, 0), want_by.get(prim, 0)
+                if abs(g - w) > tol:
+                    out.append(self.finding(
+                        ep, f"{prim} payload is {g} bytes on the wire, "
+                            f"expected {w}"
+                            + (f" (+/- {tol})" if tol else "")
+                            + (" — the per-primitive split is the "
+                               "fabric-level split under a "
+                               "hierarchical comm plan (the psum hop "
+                               "is the DCN payload)" if hier else ""),
+                        primitive=prim, payload_bytes=g,
+                        expected_bytes=w))
         return out

@@ -244,6 +244,30 @@ def validate_bench_record(rec: Any) -> List[str]:
         if isinstance(unit, str) and "tokens/sec" not in unit:
             errs.append(f"engine decode records must report a "
                         f"tokens/sec unit, got {unit!r}")
+    # gradient-allreduce comm microbench fields (bench.py --comm): a
+    # record carrying ``comm_topology`` describes one topology variant
+    # of the two-level ICI/DCN reduction and must state the per-level
+    # wire bytes — the flat-vs-hierarchical comparison is meaningless
+    # without them — plus the compression flag and the level widths.
+    if "comm_topology" in rec:
+        ct = rec["comm_topology"]
+        if ct not in ("flat", "hierarchical"):
+            errs.append(f"'comm_topology' must be 'flat' or "
+                        f"'hierarchical', got {ct!r}")
+        _need(rec, errs, "compress", bool)
+        for key in ("ici_size", "dcn_size"):
+            v = _need(rec, errs, key, int)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 1:
+                errs.append(f"{key!r} must be >= 1, got {v}")
+        for key in ("wire_bytes", "ici_wire_bytes", "dcn_wire_bytes"):
+            v = _need(rec, errs, key, int)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                errs.append(f"{key!r} must be >= 0, got {v}")
+    if (isinstance(metric, str) and metric.startswith("grad_allreduce_")
+            and "error" not in rec and not rec.get("stale")
+            and "comm_topology" not in rec):
+        errs.append("grad_allreduce records must carry 'comm_topology' "
+                    "(and the per-level wire-byte fields)")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:

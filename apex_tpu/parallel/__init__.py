@@ -5,10 +5,12 @@
 from typing import List, Optional, Tuple
 
 from . import multiproc
-from .topology import make_mesh, mesh_info
+from .topology import (make_mesh, mesh_info, hierarchical_axis_groups,
+                       default_ici_size, auto_comm_topology)
 from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, allreduce_comm_plan,
-                          flat_dist_call)
+                          plan_collective_expectations,
+                          predivide_factors, flat_dist_call)
 from .sync_batchnorm import SyncBatchNorm
 from .LARC import LARC
 from . import tensor_parallel

@@ -58,16 +58,34 @@ def adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
     return (ca * af + cb * bf).astype(a.dtype)
 
 
-def adasum_grads(grads: Any, axis_name: str = "data") -> Any:
+def adasum_grads(grads: Any, axis_name: str = "data",
+                 ici_size: int = 1) -> Any:
     """Adasum-combine ``grads`` across the mapped ``axis_name``
-    (replacing the plain psum/pmean of a DDP allreduce).  Requires a
-    power-of-two axis size (the fixed XOR reduction tree); call inside
+    (replacing the plain psum/pmean of a DDP allreduce).  Call inside
     ``shard_map``.  Returns the combined tree, identical on every rank.
-    """
+
+    ``ici_size > 1`` is the hierarchical composition (the paper's
+    average-within-node recipe mapped to the ICI/DCN split, matching
+    ``comm_topology='hierarchical'``'s rank layout): gradients are
+    plain-AVERAGED within each consecutive ``ici_size``-rank ICI slice
+    — replicas of one host see near-identical data distributions, where
+    averaging is the right combine and the fast fabric makes it cheap —
+    and the adaptive-summation butterfly runs ACROSS slices only, so
+    each ppermute stage crosses DCN once with the per-slice mean.  The
+    slice mean divides by ``ici_size`` exactly once and the butterfly
+    never divides, so no double-averaging across levels.  The number of
+    slices must be a power of two (the fixed XOR tree); ``ici_size=1``
+    is the flat butterfly over all ranks."""
     n = lax.axis_size(axis_name)
-    if n & (n - 1):
-        raise ValueError(f"adasum needs a power-of-two axis size, got "
-                         f"{n} on axis {axis_name!r}")
+    ici = int(ici_size)
+    if ici < 1 or n % ici:
+        raise ValueError(f"ici_size {ici} must be >= 1 and divide the "
+                         f"axis size {n}")
+    n_slices = n // ici
+    if n_slices & (n_slices - 1):
+        raise ValueError(f"adasum needs a power-of-two number of "
+                         f"slices, got {n_slices} ({n} ranks / "
+                         f"ici_size {ici}) on axis {axis_name!r}")
     idx = lax.axis_index(axis_name)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
@@ -82,10 +100,18 @@ def adasum_grads(grads: Any, axis_name: str = "data") -> Any:
     # stay PER-LEAF on segment views.
     flat = jnp.concatenate(
         [l.astype(jnp.float32).ravel() for l in leaves])
-    stages = n.bit_length() - 1
+    if ici > 1:
+        from .topology import hierarchical_axis_groups
+        ici_groups, _ = hierarchical_axis_groups(n, ici)
+        flat = lax.pmean(flat, axis_name, axis_index_groups=ici_groups)
+    # butterfly over the slice index: rank h*ici + j pairs with its
+    # same-offset peer (h ^ stride)*ici + j in the partner slice
+    sid = idx // ici
+    stages = n_slices.bit_length() - 1
     for s in range(stages):
         stride = 1 << s
-        perm = [(i, i ^ stride) for i in range(n)]
+        perm = [(h * ici + j, (h ^ stride) * ici + j)
+                for h in range(n_slices) for j in range(ici)]
         theirs = lax.ppermute(flat, axis_name, perm)
         # canonical low-block-first operand order: mathematically the
         # pair rule is symmetric, but XLA's FMA fusion is not — in
@@ -94,8 +120,10 @@ def adasum_grads(grads: Any, axis_name: str = "data") -> Any:
         # operand order drift by ulps and the butterfly's
         # consistent-within-block invariant decays stage by stage
         # (observed on the CPU backend; pinned by the cross-rank
-        # bitwise-equality test).
-        low = (idx & stride) == 0
+        # bitwise-equality test).  The block test runs on the SLICE
+        # index, so the hierarchical and flat trees agree rank-for-rank
+        # when ici_size == 1.
+        low = (sid & stride) == 0
         a = jnp.where(low, flat, theirs)
         b = jnp.where(low, theirs, flat)
         flat = jnp.concatenate(

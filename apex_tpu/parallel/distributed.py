@@ -19,6 +19,15 @@ every observable option of the reference wrapper:
                             control (:386-393),
 - ``retain_allreduce_buffers`` — expose the flat reduced buckets.
 
+Beyond the reference, ``comm_topology=`` makes the allreduce
+topology-aware: ``"hierarchical"`` reduce-scatters each bucket within
+the ICI slice, crosses DCN on the 1/ici_size shard, and all_gathers
+back (arXiv:2004.13336's placement applied to the ICI/DCN split), with
+optional bf16 compression of the DCN hop
+(``allreduce_compress_bf16=``); ``"auto"`` engages it when the data
+axis spans processes.  See docs/parallel.md §Topology-aware gradient
+communication.
+
 Usage inside a shard_map/pmap'd step over axis ``data``::
 
     ddp = DistributedDataParallel(model)          # wrapper parity
@@ -33,6 +42,7 @@ over a 1-D mesh for the common data-parallel case.
 from __future__ import annotations
 
 import math
+from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -40,12 +50,159 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import topology as _topology
+
 __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
-           "allreduce_comm_plan", "flat_dist_call"]
+           "allreduce_comm_plan", "plan_collective_expectations",
+           "predivide_factors", "flat_dist_call"]
+
+# where the gradient bytes travel: "flat" is one psum over the whole
+# axis (every byte crosses the slowest link in it), "hierarchical" is
+# psum_scatter within the ICI slice -> cross-slice reduce over DCN on
+# the 1/ici shard -> in-slice all_gather (arXiv:2004.13336's
+# reduce-scatter placement applied to the ICI/DCN split), "auto" picks
+# per topology.auto_comm_topology (hierarchical iff the axis spans
+# processes).
+COMM_TOPOLOGIES = ("flat", "hierarchical", "auto")
 
 
 def _axis_size(axis_name: str) -> jax.Array:
     return lax.psum(jnp.ones((), jnp.float32), axis_name)
+
+
+def predivide_factors(world, gradient_predivide_factor: float = 1.0):
+    """The reference's pre/post division split (distributed.py:386-393)
+    in ONE audited place: gradients are divided by ``pre`` BEFORE the
+    collective (fp16 range control) and by ``post`` after it when
+    ``gradient_average`` is on, with ``pre * post == world`` by
+    construction — the mean is taken exactly once, no matter how the
+    split is chosen, whether the reduction runs over the full axis or
+    ``axis_index_groups`` (``world`` is the *averaging* population:
+    group size when grouped), or how many fabric levels carry the sum
+    (the hierarchical path divides once on the final result, never
+    per level)."""
+    f = float(gradient_predivide_factor)
+    if f == 1.0:
+        return 1.0, world
+    return f, world / f
+
+
+def _validate_topology_knobs(comm_topology: str,
+                             allreduce_compress_bf16: bool):
+    """The one place the knob rules live — shared by the runtime, the
+    static plan, and the DDP constructor (which validates eagerly so a
+    typo fails at construction, not at first trace).  Explicit ``flat``
+    + compression is rejected: there is no inner level to keep full
+    precision, quantizing the only collective would just lose bits."""
+    if comm_topology not in COMM_TOPOLOGIES:
+        raise ValueError(
+            f"comm_topology must be one of {COMM_TOPOLOGIES}, got "
+            f"{comm_topology!r}")
+    if allreduce_compress_bf16 and comm_topology == "flat":
+        raise ValueError(
+            "allreduce_compress_bf16 compresses the DCN hop of the "
+            "hierarchical reduction; comm_topology='flat' has no inner "
+            "level to keep full precision (use 'hierarchical' or "
+            "'auto')")
+
+
+def _resolve_topology(comm_topology: str, allreduce_compress_bf16: bool,
+                      nproc: Optional[int] = None):
+    """Validate the knobs and return ``(topology, compress)`` with
+    ``auto`` resolved.  ``auto`` that resolves to flat drops
+    compression silently, since a single-process axis has no DCN hop
+    to shrink."""
+    _validate_topology_knobs(comm_topology, allreduce_compress_bf16)
+    topo = comm_topology
+    if topo == "auto":
+        topo = _topology.auto_comm_topology(nproc)
+    return topo, (allreduce_compress_bf16 and topo == "hierarchical")
+
+
+def _bucket_wire_accounting(n: int, comm_dt, topo: str, ici: int,
+                            compress: bool, message_size: int,
+                            delay_allreduce: bool, triggered: bool
+                            ) -> Dict[str, Any]:
+    """Per-bucket on-wire accounting, shared by the runtime
+    ``comm_stats`` records and the static :func:`allreduce_comm_plan`
+    so the two can never disagree.  All byte counts are TRUE wire
+    bytes — chunk/shard padding included — and match what
+    ``analysis.eqn_payload_bytes`` reads off the traced collectives:
+
+    - flat: one psum; ``chunked`` pads to ``chunks * message_size``.
+    - hierarchical: one ``reduce_scatter`` (full padded bucket, ICI),
+      the DCN reduce on the 1/ici shard (a psum, or a bf16 all_gather
+      when compressed), and the in-slice ``all_gather`` back.
+
+    ``ici_wire_bytes`` / ``dcn_wire_bytes`` split the total by fabric
+    level; for flat both equal the full payload (a flat psum over a
+    DCN-spanning axis drags every byte across the slow link — the
+    asymmetry the hierarchical path exists to fix)."""
+    isz = jnp.dtype(comm_dt).itemsize
+    if topo == "hierarchical":
+        cause = ("trigger" if triggered else
+                 "delay" if delay_allreduce else "single")
+        n_pad = n + ((-n) % ici)
+        m = n_pad // ici
+        dcn_dt = jnp.dtype(jnp.bfloat16) if compress else jnp.dtype(comm_dt)
+        dcn_bytes = m * dcn_dt.itemsize
+        ici_bytes = n_pad * isz + m * isz        # scatter + gather back
+        eqns = {"reduce_scatter": 1,
+                "all_gather": 2 if compress else 1}
+        payload = {"reduce_scatter": n_pad * isz,
+                   "all_gather": m * isz + (dcn_bytes if compress else 0)}
+        if not compress:
+            eqns["psum"] = 1
+            payload["psum"] = dcn_bytes
+        return {"cause": cause, "chunks": 1, "topology": "hierarchical",
+                "wire_elements": n_pad, "padded_elements": n_pad - n,
+                "bytes": ici_bytes + dcn_bytes,
+                "ici_wire_bytes": ici_bytes, "dcn_wire_bytes": dcn_bytes,
+                "dcn_comm_dtype": str(dcn_dt),
+                "eqns": eqns, "eqn_payload_bytes": payload}
+    if delay_allreduce or triggered or n <= message_size:
+        cause = ("trigger" if triggered
+                 else "delay" if delay_allreduce else "single")
+        chunks, wire = 1, n
+    else:
+        cause = "chunked"
+        chunks = math.ceil(n / message_size)
+        wire = chunks * message_size
+    b = wire * isz
+    return {"cause": cause, "chunks": chunks, "topology": "flat",
+            "wire_elements": wire, "padded_elements": wire - n,
+            "bytes": b, "ici_wire_bytes": b, "dcn_wire_bytes": b,
+            "dcn_comm_dtype": str(jnp.dtype(comm_dt)),
+            "eqns": {"psum": 1}, "eqn_payload_bytes": {"psum": b}}
+
+
+def _hierarchical_reduce(comm: jax.Array, axis_name: str,
+                         ici_groups, dcn_groups,
+                         compress: bool) -> jax.Array:
+    """Two-level sum of one flat bucket: ``psum_scatter`` within the
+    ICI slice (the fast fabric carries the full payload and does the
+    wide accumulation), cross-slice reduce over DCN on the 1/ici
+    shard, in-slice ``all_gather`` back.  ``compress=True`` quantizes
+    ONLY the DCN hop to bf16 and reduces it as all_gather + local sum
+    in the communication dtype — the wire is half, the accumulation
+    is not (the fp32-accumulate contract of allreduce_always_fp32
+    survives compression)."""
+    ici = len(ici_groups[0])
+    n = comm.shape[0]
+    pad = (-n) % ici
+    if pad:
+        comm = jnp.pad(comm, (0, pad))
+    shard = lax.psum_scatter(comm, axis_name, scatter_dimension=0,
+                             axis_index_groups=ici_groups, tiled=True)
+    if compress:
+        wire = lax.all_gather(shard.astype(jnp.bfloat16), axis_name,
+                              axis_index_groups=dcn_groups)
+        shard = jnp.sum(wire.astype(shard.dtype), axis=0)
+    else:
+        shard = lax.psum(shard, axis_name, axis_index_groups=dcn_groups)
+    full = lax.all_gather(shard, axis_name,
+                          axis_index_groups=ici_groups, tiled=True)
+    return full[:n] if pad else full
 
 
 def _path_str(path) -> str:
@@ -70,7 +227,10 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          axis_index_groups: Optional[List[List[int]]] = None,
                          retain_buffers: Optional[list] = None,
                          trigger_paths: Optional[set] = None,
-                         comm_stats: Optional[list] = None) -> Any:
+                         comm_stats: Optional[list] = None,
+                         comm_topology: str = "flat",
+                         allreduce_compress_bf16: bool = False,
+                         ici_size: Optional[int] = None) -> Any:
     """Bucketed gradient allreduce with the reference's semantics
     (allreduce_bucket, distributed.py:378-398).  Must run inside a context
     where ``axis_name`` is a mapped mesh axis.
@@ -83,16 +243,60 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     scheduler can overlap independently.  Paths are '/'-joined key paths
     (e.g. 'layer1/conv/weight'); unknown paths raise.
 
+    ``comm_topology``: where the bytes travel.  ``"flat"`` (default)
+    reduces every bucket with one psum over the whole axis — on a
+    multi-host mesh that drags the full payload across DCN, the slowest
+    link.  ``"hierarchical"`` runs each bucket as psum_scatter within
+    the ICI slice, a cross-slice reduce over DCN on the 1/ici_size
+    shard, and an in-slice all_gather back — DCN carries 1/ici_size of
+    the traffic, the sum is unchanged up to reduction-order round-off
+    (pinned in tests/test_ddp.py like the ZeRO-1 psum_scatter-vs-psum
+    ordering).  ``"auto"`` picks hierarchical iff the axis spans
+    processes (topology.auto_comm_topology).  ``ici_size`` is the
+    inner-level width (consecutive ranks per slice, make_mesh's
+    multi-host ordering); it defaults to axis_size / process_count.
+    Hierarchical within explicit ``axis_index_groups`` is not wired.
+    ``message_size`` does NOT sub-chunk hierarchical buckets: each
+    bucket is one reduce_scatter whose per-member shards XLA already
+    schedules independently — the in-bucket psum chunking is a
+    flat-path overlap device (its ``chunked`` cause never appears
+    under hierarchical; bucket *boundaries* from triggers/dtypes still
+    apply).
+
+    ``allreduce_compress_bf16``: quantize the DCN hop to bf16 — on-wire
+    payload halves; the ICI reduce-scatter and the per-slice
+    accumulation stay in the communication dtype, so it composes with
+    ``allreduce_always_fp32`` (fp32 adds, bf16 wire).  Hierarchical
+    only.
+
     ``comm_stats``: observability out-param — one dict per reduced
-    bucket ({dtype, comm_dtype, leaves, elements, bytes, cause, chunks})
-    appended at TRACE time (like ``retain_buffers``), i.e. once per
-    compiled step, describing what every execution of that step
-    communicates.  ``cause`` records why the bucket flushed: a trigger
-    boundary, ``delay_allreduce``, fitting under ``message_size``
-    (``single``), or the chunked-psum path."""
+    bucket ({dtype, comm_dtype, leaves, elements, bytes, cause, chunks,
+    topology, wire_elements, padded_elements, ici_wire_bytes,
+    dcn_wire_bytes, ...}) appended at TRACE time (like
+    ``retain_buffers``), i.e. once per compiled step, describing what
+    every execution of that step communicates.  ``bytes`` is true
+    on-wire traffic (chunk/shard padding included, all levels summed);
+    ``cause`` records why the bucket flushed: a trigger boundary,
+    ``delay_allreduce``, fitting under ``message_size`` (``single``),
+    or the chunked-psum path."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
+    topo, compress = _resolve_topology(comm_topology,
+                                       allreduce_compress_bf16)
+    ici_groups = dcn_groups = None
+    ici = 1
+    if topo == "hierarchical":
+        if axis_index_groups is not None:
+            raise NotImplementedError(
+                "comm_topology='hierarchical' over explicit "
+                "axis_index_groups is not wired — the hierarchy defines "
+                "its own ICI/DCN groups")
+        world_static = int(lax.axis_size(axis_name))
+        ici = (int(ici_size) if ici_size is not None
+               else _topology.default_ici_size(world_static))
+        ici_groups, dcn_groups = _topology.hierarchical_axis_groups(
+            world_static, ici)
     paths = None
     if trigger_paths:
         flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
@@ -130,22 +334,26 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
         for bucket in buckets:
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
             comm = flat.astype(jnp.float32) if allreduce_always_fp32 else flat
-            if gradient_predivide_factor != 1.0:
-                comm = comm / jnp.asarray(gradient_predivide_factor,
-                                          comm.dtype)
+            pre, post = predivide_factors(world,
+                                          gradient_predivide_factor)
+            if pre != 1.0:
+                comm = comm / jnp.asarray(pre, comm.dtype)
 
             n = comm.shape[0]
-            nchunks = 1
-            if delay_allreduce or trigger_paths or n <= message_size:
-                cause = ("trigger" if trigger_paths
-                         else "delay" if delay_allreduce else "single")
+            acct = _bucket_wire_accounting(
+                n, comm.dtype, topo, ici, compress, message_size,
+                delay_allreduce, bool(trigger_paths))
+            if topo == "hierarchical":
+                reduced = _hierarchical_reduce(comm, axis_name,
+                                               ici_groups, dcn_groups,
+                                               compress)
+            elif acct["chunks"] == 1:
                 reduced = lax.psum(comm, axis_name,
                                    axis_index_groups=axis_index_groups)
             else:
                 # chunked psum: XLA schedules the pieces independently —
                 # the compiler-native form of the reference's bucket overlap
-                cause = "chunked"
-                nchunks = math.ceil(n / message_size)
+                nchunks = acct["chunks"]
                 pad = nchunks * message_size - n
                 padded = jnp.pad(comm, (0, pad))
                 chunks = padded.reshape(nchunks, message_size)
@@ -157,12 +365,10 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                 comm_stats.append({
                     "dtype": str(dt), "comm_dtype": str(comm.dtype),
                     "leaves": len(bucket), "elements": int(n),
-                    "bytes": int(n) * jnp.dtype(comm.dtype).itemsize,
-                    "cause": cause, "chunks": nchunks})
+                    **{k: v for k, v in acct.items()
+                       if k not in ("eqns", "eqn_payload_bytes")}})
 
             if gradient_average:
-                post = world / gradient_predivide_factor if \
-                    gradient_predivide_factor != 1.0 else world
                 reduced = reduced / post.astype(reduced.dtype)
             reduced = reduced.astype(dt)
             if retain_buffers is not None:
@@ -178,27 +384,57 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
 def allreduce_comm_plan(grads: Any, message_size: int = 10_000_000,
                         allreduce_always_fp32: bool = False,
                         delay_allreduce: bool = False,
-                        trigger_paths: Optional[set] = None
-                        ) -> List[dict]:
+                        trigger_paths: Optional[set] = None,
+                        comm_topology: str = "flat",
+                        allreduce_compress_bf16: bool = False,
+                        ici_size: Optional[int] = None,
+                        world: Optional[int] = None,
+                        nproc: Optional[int] = None) -> List[dict]:
     """Static twin of :func:`allreduce_grads_tree`'s bucketing: what the
     comm pattern of one allreduce WILL be, computed from shapes alone
     (no tracing).  One dict per bucket::
 
-        {dtype, comm_dtype, leaves, elements, chunks, cause,
-         wire_elements, wire_bytes}
+        {dtype, comm_dtype, leaves, elements, chunks, cause, topology,
+         ici_size, dcn_size, wire_elements, padded_elements, wire_bytes,
+         ici_wire_bytes, dcn_wire_bytes, dcn_comm_dtype,
+         eqns, eqn_payload_bytes}
 
-    ``wire_elements`` includes chunk padding — the bytes a psum of this
-    bucket actually moves per replica.  Each bucket is exactly one psum
-    eqn in the traced step (the chunked path reshapes into one
-    ``(chunks, message_size)`` psum), so ``len(plan)`` is the expected
-    grad-psum count.  ``apex_tpu.analysis``'s collective-accounting rule
-    derives its DDP expectations from this plan: if the bucketing
+    ``wire_elements`` includes chunk/shard padding — the elements the
+    bucket's first collective actually moves per replica; ``wire_bytes``
+    is the TRUE total on-wire traffic summed over every fabric level
+    (for the flat topology that is the one psum; for the hierarchical
+    topology the ICI reduce_scatter + the DCN reduce + the ICI
+    all_gather), split per level as ``ici_wire_bytes`` /
+    ``dcn_wire_bytes``.  ``eqns`` / ``eqn_payload_bytes`` give the
+    exact per-primitive collective census of the bucket, matching what
+    ``analysis.eqn_payload_bytes`` reads off the traced graph.
+    ``apex_tpu.analysis``'s collective-accounting rule derives its DDP
+    expectations from this plan (see
+    :func:`plan_collective_expectations`): if the bucketing or topology
     algorithm changes, the plan and the traced graph move together,
-    while an accidental extra/missing/fatter collective still flags."""
+    while an accidental extra/missing/fatter collective still flags.
+
+    The topology knobs mirror the runtime: for ``"hierarchical"`` (or
+    ``"auto"`` resolving there — ``nproc`` defaults to
+    ``jax.process_count()``) the static axis size must be supplied as
+    ``world=`` since there is no mapped axis to read it from."""
     leaves = jax.tree_util.tree_leaves(grads)
     plan: List[dict] = []
     if not leaves:
         return plan
+    topo, compress = _resolve_topology(comm_topology,
+                                       allreduce_compress_bf16, nproc)
+    ici = dcn = 1
+    if topo == "hierarchical":
+        if world is None:
+            raise ValueError(
+                "a hierarchical comm plan needs world= (the static "
+                "axis size); the runtime reads it from the mapped axis")
+        ici = (int(ici_size) if ici_size is not None
+               else _topology.default_ici_size(int(world), nproc))
+        # validates divisibility the same way the runtime does
+        _topology.hierarchical_axis_groups(int(world), ici)
+        dcn = int(world) // ici
     paths = None
     if trigger_paths:
         flat_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
@@ -232,20 +468,54 @@ def allreduce_comm_plan(grads: Any, message_size: int = 10_000_000,
             n = sum(int(leaves[i].size) for i in bucket)
             comm_dt = jnp.dtype(jnp.float32) if allreduce_always_fp32 \
                 else dt
-            if delay_allreduce or trigger_paths or n <= message_size:
-                cause = ("trigger" if trigger_paths
-                         else "delay" if delay_allreduce else "single")
-                chunks, wire = 1, n
-            else:
-                cause = "chunked"
-                chunks = math.ceil(n / message_size)
-                wire = chunks * message_size
+            acct = _bucket_wire_accounting(
+                n, comm_dt, topo, ici, compress, message_size,
+                delay_allreduce, bool(trigger_paths))
             plan.append({
                 "dtype": str(dt), "comm_dtype": str(comm_dt),
-                "leaves": len(bucket), "elements": n, "chunks": chunks,
-                "cause": cause, "wire_elements": wire,
-                "wire_bytes": wire * comm_dt.itemsize})
+                "leaves": len(bucket), "elements": n,
+                "chunks": acct["chunks"], "cause": acct["cause"],
+                "topology": acct["topology"],
+                "ici_size": ici, "dcn_size": dcn,
+                "wire_elements": acct["wire_elements"],
+                "padded_elements": acct["padded_elements"],
+                "wire_bytes": acct["bytes"],
+                "ici_wire_bytes": acct["ici_wire_bytes"],
+                "dcn_wire_bytes": acct["dcn_wire_bytes"],
+                "dcn_comm_dtype": acct["dcn_comm_dtype"],
+                "eqns": acct["eqns"],
+                "eqn_payload_bytes": acct["eqn_payload_bytes"]})
     return plan
+
+
+def plan_collective_expectations(plan: List[dict],
+                                 extra_psums: int = 0,
+                                 extra_psum_bytes: int = 0) -> dict:
+    """Fold a :func:`allreduce_comm_plan` into the ``collectives``
+    expectation dict the analysis rule consumes: exact per-primitive
+    eqn counts, the total on-wire payload, and the per-primitive
+    payload split — which IS the ici-vs-dcn distinction at graph level
+    (under the hierarchical topology the bucket's psum — or compressed
+    bf16 all_gather — payload is exactly the DCN hop).
+
+    ``extra_psums`` / ``extra_psum_bytes`` account for the step's
+    scalar psums outside the grad reduction (the axis-size scalar
+    ``gradient_average`` divides by, the loss pmean)."""
+    counts: Counter = Counter()
+    by_prim: Counter = Counter()
+    total = 0
+    for b in plan:
+        for prim, k in b["eqns"].items():
+            counts[prim] += k
+        for prim, by in b["eqn_payload_bytes"].items():
+            by_prim[prim] += by
+        total += b["wire_bytes"]
+    if extra_psums:
+        counts["psum"] += extra_psums
+        by_prim["psum"] += extra_psum_bytes
+    return {"counts": dict(counts),
+            "payload_bytes": total + extra_psum_bytes,
+            "payload_bytes_by_primitive": dict(by_prim)}
 
 
 def _broadcast0(flat: jax.Array, axis_name: str,
@@ -296,7 +566,10 @@ class DistributedDataParallel:
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
                  axis_name: str = "data",
-                 adasum: bool = False):
+                 adasum: bool = False,
+                 comm_topology: str = "flat",
+                 allreduce_compress_bf16: bool = False,
+                 ici_size: Optional[int] = None):
         if shared_param is not None:
             raise ValueError("shared_param is deprecated (reference "
                              "distributed.py:176-180)")
@@ -309,12 +582,22 @@ class DistributedDataParallel:
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.axis_name = axis_name
+        # topology knobs (allreduce_grads_tree): where the gradient
+        # bytes travel — validated eagerly so a typo fails at
+        # construction, not at first trace
+        _validate_topology_knobs(comm_topology, allreduce_compress_bf16)
+        self.comm_topology = comm_topology
+        self.allreduce_compress_bf16 = allreduce_compress_bf16
+        self.ici_size = ici_size
         # adasum=True swaps the psum for the adaptive-summation
         # butterfly (parallel/adasum.py, arXiv:2006.02924) — a
         # beyond-reference combiner for conflict-aware large-batch DP.
         # It REPLACES the sum-then-average pipeline wholesale, so the
         # psum-shaping knobs are meaningless with it: reject loudly
-        # instead of silently ignoring them.
+        # instead of silently ignoring them.  comm_topology DOES
+        # compose: hierarchical adasum averages within the ICI slice
+        # and runs the butterfly across slices (the paper's
+        # average-within-node recipe) — see adasum_grads(ici_size=).
         self.adasum = adasum
         if adasum:
             clashes = [name for name, bad in (
@@ -323,6 +606,7 @@ class DistributedDataParallel:
                  bool(allreduce_trigger_params)),
                 ("retain_allreduce_buffers", retain_allreduce_buffers),
                 ("allreduce_always_fp32", allreduce_always_fp32),
+                ("allreduce_compress_bf16", allreduce_compress_bf16),
                 ("gradient_average=False", not gradient_average),
                 ("gradient_predivide_factor",
                  gradient_predivide_factor != 1.0)) if bad]
@@ -355,15 +639,22 @@ class DistributedDataParallel:
             if axis_index_groups is not None:
                 raise NotImplementedError(
                     "adasum over axis_index_groups is not wired")
+            topo, _ = _resolve_topology(self.comm_topology, False)
+            ici = 1
+            if topo == "hierarchical":
+                world = int(lax.axis_size(self.axis_name))
+                ici = (int(self.ici_size) if self.ici_size is not None
+                       else _topology.default_ici_size(world))
             leaves = jax.tree_util.tree_leaves(grads)
             self.last_comm_stats = [{
                 "dtype": str(jnp.dtype(l.dtype)),
                 "comm_dtype": str(jnp.dtype(l.dtype)),
                 "leaves": 1, "elements": int(l.size),
                 "bytes": int(l.size) * jnp.dtype(l.dtype).itemsize,
-                "cause": "adasum", "chunks": 1} for l in leaves]
+                "cause": "adasum", "chunks": 1,
+                "topology": topo} for l in leaves]
             self._record_comm_stats()
-            return adasum_grads(grads, self.axis_name)
+            return adasum_grads(grads, self.axis_name, ici_size=ici)
         retain = [] if self.retain_allreduce_buffers else None
         triggers = (set(self.allreduce_trigger_params)
                     if self.allreduce_trigger_params else None)
@@ -376,7 +667,10 @@ class DistributedDataParallel:
             delay_allreduce=self.delay_allreduce,
             axis_index_groups=axis_index_groups,
             retain_buffers=retain, trigger_paths=triggers,
-            comm_stats=comm_stats)
+            comm_stats=comm_stats,
+            comm_topology=self.comm_topology,
+            allreduce_compress_bf16=self.allreduce_compress_bf16,
+            ici_size=self.ici_size)
         if retain is not None:
             self.allreduce_buffers = retain
         self.last_comm_stats = comm_stats
@@ -398,9 +692,18 @@ class DistributedDataParallel:
         bts = reg.counter(
             "ddp_allreduce_bytes_total",
             help="one replica's communicated gradient bytes per trace")
+        lvl = reg.counter(
+            "ddp_allreduce_level_bytes_total",
+            help="one replica's gradient bytes per fabric level (ici = "
+                 "fast in-slice interconnect, dcn = cross-host) per "
+                 "trace; flat psums count fully on both levels")
         for b in self.last_comm_stats:
             buckets.labels(dtype=b["comm_dtype"], cause=b["cause"]).inc()
             bts.labels(dtype=b["comm_dtype"]).inc(b["bytes"])
+            lvl.labels(level="ici", dtype=b["comm_dtype"]).inc(
+                b.get("ici_wire_bytes", b["bytes"]))
+            lvl.labels(level="dcn", dtype=b["comm_dtype"]).inc(
+                b.get("dcn_wire_bytes", b["bytes"]))
 
     def broadcast_params(self, params: Any) -> Any:
         """Rank-0 parameter broadcast (reference DDP does this at

@@ -20,14 +20,15 @@ topology-aware device orderings underneath.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "mesh_info"]
+__all__ = ["make_mesh", "mesh_info", "hierarchical_axis_groups",
+           "default_ici_size", "auto_comm_topology"]
 
 
 def make_mesh(devices: Optional[list] = None, **axes: int) -> Mesh:
@@ -76,6 +77,55 @@ def make_mesh(devices: Optional[list] = None, **axes: int) -> Mesh:
         # host-platform CPUs (tests) have no topology; plain reshape
         arr = np.array(devs).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+def default_ici_size(world: int, nproc: Optional[int] = None) -> int:
+    """Devices per process along a host-spanning mesh axis — the size of
+    the ICI (fast-fabric) level of a two-level reduction.  ``make_mesh``
+    lays the outermost axis out with the DCN factor leading, so each
+    process's devices are one *contiguous* block of ``world / nproc``
+    ranks; that block is the inner level."""
+    nproc = jax.process_count() if nproc is None else int(nproc)
+    if nproc < 1 or world % nproc != 0:
+        raise ValueError(
+            f"axis size {world} is not divisible by the process count "
+            f"{nproc}; pass ici_size explicitly")
+    return world // nproc
+
+
+def auto_comm_topology(nproc: Optional[int] = None) -> str:
+    """The ``comm_topology='auto'`` heuristic: a data axis only crosses
+    DCN when it spans more than one process (make_mesh puts the DCN
+    factor on the outermost axis), so multi-process runs get the
+    hierarchical two-level reduction and single-process runs keep the
+    flat psum — there is no slow fabric to economize on."""
+    nproc = jax.process_count() if nproc is None else int(nproc)
+    return "hierarchical" if nproc > 1 else "flat"
+
+
+def hierarchical_axis_groups(world: int, ici_size: int
+                             ) -> Tuple[List[List[int]], List[List[int]]]:
+    """``(ici_groups, dcn_groups)`` for a two-level reduction over an
+    axis laid out like ``make_mesh``'s multi-host ordering: consecutive
+    blocks of ``ici_size`` ranks share the fast fabric (one slice), and
+    ranks at the same offset within their block talk across DCN.
+
+        world=8, ici_size=4 ->  ici: [[0,1,2,3], [4,5,6,7]]
+                                dcn: [[0,4], [1,5], [2,6], [3,7]]
+
+    Used as ``axis_index_groups`` for the in-slice psum_scatter /
+    all_gather (ici) and the cross-slice reduce on the 1/ici shard
+    (dcn)."""
+    if ici_size < 1 or world % ici_size != 0:
+        raise ValueError(
+            f"ici_size {ici_size} must be >= 1 and divide the axis "
+            f"size {world}")
+    n_slices = world // ici_size
+    ici_groups = [list(range(s * ici_size, (s + 1) * ici_size))
+                  for s in range(n_slices)]
+    dcn_groups = [[j + s * ici_size for s in range(n_slices)]
+                  for j in range(ici_size)]
+    return ici_groups, dcn_groups
 
 
 def mesh_info(mesh: Mesh) -> str:

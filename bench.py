@@ -196,6 +196,18 @@ def main():
     # stream stays check_bench_schema clean.  Runs INSTEAD of the job
     # list (it is an explicit opt-in comparison, not a smoke config)
     # but AFTER --graph-lint, which still gates the exit status.
+    # --comm: gradient-allreduce topology microbench — flat vs
+    # hierarchical (ICI/DCN two-level) vs bf16-compressed hierarchical
+    # on the same bucket.  Per-level wire bytes come from
+    # parallel.allreduce_comm_plan (and are ASSERTED against each
+    # other: the hierarchical DCN payload must be exactly 1/ici of the
+    # flat one, the compressed one exactly half again); wall-clock is
+    # reported, never gated — on a CPU smoke host all fabrics are the
+    # same memory bus.  Like --fleet it runs INSTEAD of the job list
+    # but AFTER --graph-lint, which still gates the exit status
+    # (--fleet takes precedence when both are passed).
+    comm_flag = "--comm" in sys.argv
+
     fleet_n = 0
     if "--fleet" in sys.argv:
         idx = sys.argv.index("--fleet")
@@ -394,6 +406,90 @@ def main():
         return jax.jit(jax.shard_map(
             step, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
             out_specs=(P(), P()), check_vma=False))
+
+    def run_comm_bench():
+        ici = (ndev // jax.process_count() if jax.process_count() > 1
+               else max((d for d in range(2, ndev)
+                         if ndev % d == 0), default=1))
+        n = (25_000_000 if on_tpu else 1_000_000) // max(ici, 1) \
+            * max(ici, 1)                 # no shard padding: the plan
+        # relationships below must hold to the byte, not modulo pad
+        buf = jnp.ones((n,), jnp.float32)
+
+        def make_train(topo, compress):
+            def step(state, batch):
+                g = {"g": state[0] + batch[0][0, 0]}
+                out = parallel.allreduce_grads_tree(
+                    g, "data", comm_topology=topo,
+                    allreduce_compress_bf16=compress,
+                    ici_size=ici if topo == "hierarchical" else None)
+                return (out["g"],), jnp.sum(out["g"][:8])
+            return sharded(step)
+
+        variants = [("flat", "flat", False)]
+        if ici >= 2:
+            variants += [("hier", "hierarchical", False),
+                         ("hier_bf16", "hierarchical", True)]
+        else:
+            print(f"bench --comm: {ndev} device(s) admit no 2-level "
+                  f"split; hierarchical variants skipped",
+                  file=sys.stderr)
+        plans = {}
+        for name, topo, compress in variants:
+            (b,) = parallel.allreduce_comm_plan(
+                {"g": jax.ShapeDtypeStruct((n,), jnp.float32)},
+                comm_topology=topo, allreduce_compress_bf16=compress,
+                ici_size=ici if topo == "hierarchical" else None,
+                world=ndev)
+            plans[name] = b
+        if "hier" in plans:
+            # the whole point of the topology: the slow fabric carries
+            # exactly 1/ici of the flat payload, half again compressed
+            # — asserted from the plan, not eyeballed from the output
+            assert (plans["hier"]["dcn_wire_bytes"] * ici
+                    == plans["flat"]["dcn_wire_bytes"]), (
+                "hierarchical DCN payload is not 1/ici of flat:",
+                plans["hier"], plans["flat"])
+            assert (plans["hier_bf16"]["dcn_wire_bytes"] * 2
+                    == plans["hier"]["dcn_wire_bytes"]), (
+                "bf16 compression did not halve the DCN payload:",
+                plans["hier_bf16"], plans["hier"])
+        for name, topo, compress in variants:
+            b = plans[name]
+            dt = timed(make_train(topo, compress), (buf,),
+                       (jnp.ones((ndev, 1)), jnp.zeros((ndev, 1))),
+                       10, 2)
+            emit(metric=f"grad_allreduce_{name}_step_time",
+                 value=round(dt * 1e3, 3), unit="ms",
+                 vs_baseline=None, comm_topology=b["topology"],
+                 compress=compress, ici_size=b["ici_size"],
+                 dcn_size=b["dcn_size"], elements=n,
+                 wire_bytes=b["wire_bytes"],
+                 ici_wire_bytes=b["ici_wire_bytes"],
+                 dcn_wire_bytes=b["dcn_wire_bytes"],
+                 note=f"{n}-element fp32 gradient bucket over the "
+                      f"{ndev}-device data axis; bytes are one "
+                      f"replica's on-wire traffic per step from "
+                      f"allreduce_comm_plan"
+                      + ("; wall-clock on a CPU mesh does not "
+                         "separate fabrics — the byte fields are the "
+                         "portable signal" if not on_tpu else ""))
+        if "hier" in plans:
+            emit(metric="grad_allreduce_dcn_bytes_reduction",
+                 value=float(ici), unit="x", vs_baseline=None,
+                 comm_topology="hierarchical", compress=False,
+                 ici_size=plans["hier"]["ici_size"],
+                 dcn_size=plans["hier"]["dcn_size"],
+                 wire_bytes=plans["hier"]["wire_bytes"],
+                 ici_wire_bytes=plans["hier"]["ici_wire_bytes"],
+                 dcn_wire_bytes=plans["hier"]["dcn_wire_bytes"],
+                 note="flat DCN bytes / hierarchical DCN bytes, "
+                      "asserted == ici_size from the comm plan")
+
+    if comm_flag and not fleet_n:
+        run_comm_bench()
+        # --graph-lint (if also passed) already ran and still gates
+        return 1 if lint_errors else 0
 
     def timed_scan(ddp, step, state, arrays, per_step_shapes, K, iters,
                    warmup):

@@ -7,7 +7,13 @@ Every stdout line bench emits must be a JSON object carrying
 serving decode lines (metric containing ``engine_decode``) must also
 carry the decode-window fields: ``window`` (int >= 1, in-graph decode
 ticks per host sync) and a tokens/sec unit — the w1-vs-wK comparison
-is meaningless without them.  Graph-lint records (``kind:
+is meaningless without them.  Gradient-allreduce comm microbench lines (``bench.py --comm``) carry
+``comm_topology`` and must then state the per-level wire bytes
+(``ici_wire_bytes`` / ``dcn_wire_bytes`` / ``wire_bytes``), the
+``compress`` flag and the ``ici_size`` / ``dcn_size`` level widths —
+the flat-vs-hierarchical comparison is meaningless without them; fresh
+``grad_allreduce_*`` metrics must carry the topology fields at all.
+Graph-lint records (``kind:
 graph_lint`` / ``graph_lint_summary``, from ``python -m
 apex_tpu.analysis``, ``bench.py --graph-lint`` or
 tests/ci/graph_lint.py) are validated against the lint schema
@@ -18,6 +24,8 @@ interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
     python bench.py --fleet 2 | python tests/ci/check_bench_schema.py
+    python bench.py --comm --graph-lint \
+        | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
     python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
 

@@ -89,6 +89,39 @@ def main():
         print(f"world {jax.process_count()} processes {ndev} devices",
               flush=True)
 
+    # hierarchical comm parity: ONE more step from the SAME state,
+    # flat vs comm_topology="hierarchical" (ici = devices per process,
+    # so the single-process run exercises the in-slice level and the
+    # multi-process run the DCN level of the same code path).  Losses
+    # must agree to reduction-order round-off — the cross-process
+    # analogue of tests/test_ddp.py's 8-device pin.
+    ici = ndev // jax.process_count()
+    ddp_h = parallel.DistributedDataParallel(
+        model, comm_topology="hierarchical", ici_size=ici)
+
+    def step_h(state, batch):
+        params, bn_st, opt_st = state
+        xb, yb = batch
+
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn_st, train=True)
+            return F.cross_entropy(out, yb), new_bn
+
+        loss, new_bn, grads = amp.scaled_grad(loss_fn, params, opt_st,
+                                              has_aux=True)
+        grads = ddp_h.allreduce_grads(grads)
+        params, opt_st, _ = optimizer.step(params, opt_st, grads)
+        return (params, new_bn, opt_st), lax.pmean(loss, "data")
+
+    train_h = ddp_h.make_step(step_h, mesh=mesh, donate_state=False)
+    x = jnp.asarray(rng.randn(8, 3, 8, 8), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+    _, loss_f = train(state, (x, y))
+    _, loss_h = train_h(state, (x, y))
+    if jax.process_index() == 0:
+        print(f"hier flat {float(loss_f).hex()} hier "
+              f"{float(loss_h).hex()} ici {ici}", flush=True)
+
 
 if __name__ == "__main__":
     main()

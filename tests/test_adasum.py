@@ -100,6 +100,84 @@ def test_ddp_adasum_rejects_psum_knobs():
         DistributedDataParallel(adasum=True, gradient_average=False)
 
 
+def test_adasum_hierarchical_slice_identical_matches_flat():
+    """Hierarchical adasum (average within the ICI slice, butterfly
+    across slices — the paper's average-within-node recipe) with
+    SLICE-IDENTICAL grads is bitwise the flat butterfly: the in-slice
+    pmean of equal values is exact, the flat tree's first stage
+    combines equal partners (adasum(a, a) == a exactly), and the
+    remaining cross-slice stages are rank-for-rank the same perm."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    rng = np.random.RandomState(7)
+    per_slice = rng.randn(4, 16).astype(np.float32)
+
+    def fn(dummy):
+        sid = jax.lax.axis_index("data") // 2
+        g = {"w": jnp.asarray(per_slice)[sid]}
+        return (adasum_grads(g, "data", ici_size=2)["w"],
+                adasum_grads(g, "data")["w"])
+
+    hier, flat = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("data"),), out_specs=(P(), P()),
+        check_vma=False))(jnp.arange(8.0))
+    np.testing.assert_array_equal(np.asarray(hier), np.asarray(flat))
+
+
+def test_adasum_hierarchical_analytic_levels():
+    """No double-averaging across levels: within-slice values average
+    by ici ONCE, orthogonal slice means then ADD in the butterfly —
+    2 slices x 2 ranks with e1/e2-aligned grads give exactly
+    mean(slice0) + mean(slice1)."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    e = np.zeros((4, 8), np.float32)
+    e[0, 0], e[1, 0] = 2.0, 4.0      # slice 0: along e1, mean 3*e1
+    e[2, 1], e[3, 1] = 2.0, 4.0      # slice 1: along e2, mean 3*e2
+
+    out = jax.jit(jax.shard_map(
+        lambda g: adasum_grads({"w": g[0]}, "data", ici_size=2)["w"][None],
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))(jnp.asarray(e))
+    want = np.zeros(8, np.float32)
+    want[0] = want[1] = 3.0
+    for r in range(4):
+        np.testing.assert_allclose(np.asarray(out[r]), want, rtol=1e-6)
+
+
+def test_ddp_adasum_hierarchical_wrapper_and_errors():
+    """DistributedDataParallel(adasum=True, comm_topology=...) routes
+    ici_size into the butterfly; invalid level splits fail loudly."""
+    from apex_tpu.parallel import DistributedDataParallel
+    ddp = DistributedDataParallel(adasum=True,
+                                  comm_topology="hierarchical",
+                                  ici_size=2)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    g = jnp.asarray(np.random.RandomState(5).randn(6, 2), np.float32)
+    out = jax.jit(jax.shard_map(
+        lambda gg: ddp.allreduce_grads({"w": gg})["w"], mesh=mesh,
+        in_specs=P(), out_specs=P(), check_vma=False))(g)
+    # replicated grads: slice mean == g, adasum of parallel means
+    # averages back to g
+    np.testing.assert_allclose(np.asarray(out), np.asarray(g),
+                               rtol=2e-5)
+    assert all(b["topology"] == "hierarchical"
+               for b in ddp.last_comm_stats)
+
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("data",))
+    with pytest.raises(ValueError, match="divide"):
+        jax.jit(jax.shard_map(
+            lambda gg: adasum_grads({"w": gg}, ici_size=3)["w"],
+            mesh=mesh8, in_specs=P(), out_specs=P(),
+            check_vma=False))(g)
+    # 8 ranks / ici 4 = 2 slices is fine; 6 ranks would not be, but 8/8
+    # leaves ONE slice — a degenerate butterfly with zero stages (pure
+    # in-slice averaging), which must equal pmean
+    outp = jax.jit(jax.shard_map(
+        lambda gg: adasum_grads({"w": gg}, ici_size=8)["w"], mesh=mesh8,
+        in_specs=P(), out_specs=P(), check_vma=False))(g)
+    np.testing.assert_allclose(np.asarray(outp), np.asarray(g),
+                               rtol=2e-5)
+
+
 def test_ddp_train_step_with_adasum():
     """Drop-in for the psum in a DDP step: a linear-regression step
     trains, and with IDENTICAL per-rank batches the result equals the

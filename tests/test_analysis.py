@@ -314,6 +314,130 @@ def test_collective_rule_flags_unbudgeted_collective():
     assert found[0].detail["primitive"] == "all_gather"
 
 
+def _hier_setup(ici=4, world=8):
+    from apex_tpu.parallel import hierarchical_axis_groups
+    mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+    ici_groups, dcn_groups = hierarchical_axis_groups(world, ici)
+    return mesh, ici_groups, dcn_groups
+
+
+def test_collective_rule_flags_full_size_dcn_psum():
+    """The tentpole's seeded mutation: a 'hierarchical' reduction that
+    gathers BEFORE the cross-slice reduce — so a full-size psum sneaks
+    onto DCN instead of the 1/ici shard.  Eqn counts match the honest
+    plan exactly (1 reduce_scatter + 1 psum + 1 all_gather); only the
+    per-primitive payload split — derived from allreduce_comm_plan via
+    plan_collective_expectations — catches it."""
+    from apex_tpu import parallel
+    mesh, ici_groups, dcn_groups = _hier_setup()
+    n = 1024
+
+    def sneaky(x):
+        # the axis-size scalar the real allreduce also traces, so the
+        # mutant's EQN COUNTS match the honest graph exactly
+        jax.lax.psum(jnp.ones((), jnp.float32), "data")
+        shard = jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                     axis_index_groups=ici_groups,
+                                     tiled=True)
+        full = jax.lax.all_gather(shard, "data",
+                                  axis_index_groups=ici_groups,
+                                  tiled=True)
+        return jax.lax.psum(full, "data",        # full n elems on DCN
+                            axis_index_groups=dcn_groups)
+
+    def honest(x):
+        return parallel.allreduce_grads_tree(
+            {"w": x}, "data", comm_topology="hierarchical", ici_size=4,
+            gradient_average=False)["w"]
+
+    plan = parallel.allreduce_comm_plan(
+        {"w": jnp.zeros((n,), jnp.float32)},
+        comm_topology="hierarchical", ici_size=4, world=8)
+    # +1 psum / +4 bytes: the axis-size scalar
+    expect = parallel.plan_collective_expectations(
+        plan, extra_psums=1, extra_psum_bytes=4)
+
+    def _trace(fn):
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=(P(),),
+                               out_specs=P(), check_vma=False)
+        return lambda: jax.make_jaxpr(mapped)(jnp.ones((n,)))
+
+    broken = _ep("mutant_fat_dcn_psum",
+                 expect={"collectives": dict(expect)},
+                 trace=_trace(sneaky))
+    found = _run(broken, "collective")
+    assert found, "full-size DCN psum must flag"
+    # counts are identical by construction — no count finding fires
+    assert not any("eqn(s)" in f.message for f in found)
+    psum_f = [f for f in found if f.detail.get("primitive") == "psum"
+              and "payload" in f.message][0]
+    # the sneak moved ici x the bytes the plan budgeted for DCN
+    assert psum_f.detail["payload_bytes"] == n * 4 + 4
+    assert psum_f.detail["expected_bytes"] == n * 4 // 4 + 4
+
+    fixed = _ep("fixed_hier_reduce",
+                expect={"collectives": dict(expect)},
+                trace=_trace(honest))
+    assert _run(fixed, "collective") == []
+
+
+def test_comm_plan_hierarchical_levels():
+    """The static twin under comm_topology='hierarchical': per-level
+    payloads, shard padding, the exact per-primitive eqn census, and
+    the compressed variant halving ONLY the DCN hop."""
+    from apex_tpu.parallel import (allreduce_comm_plan,
+                                   plan_collective_expectations)
+    grads = {"w": jnp.zeros((1001,), jnp.float32)}
+    (flat,) = allreduce_comm_plan(grads)
+    (h,) = allreduce_comm_plan(grads, comm_topology="hierarchical",
+                               ici_size=4, world=8)
+    assert h["topology"] == "hierarchical"
+    assert (h["ici_size"], h["dcn_size"]) == (4, 2)
+    assert h["wire_elements"] == 1004 and h["padded_elements"] == 3
+    assert h["dcn_wire_bytes"] == (1004 // 4) * 4
+    assert h["ici_wire_bytes"] == 1004 * 4 + (1004 // 4) * 4
+    assert h["wire_bytes"] == h["ici_wire_bytes"] + h["dcn_wire_bytes"]
+    assert h["eqns"] == {"reduce_scatter": 1, "psum": 1,
+                         "all_gather": 1}
+    assert h["eqn_payload_bytes"]["psum"] == h["dcn_wire_bytes"]
+    # the headline relationship the bench asserts: DCN traffic shrinks
+    # by exactly the ICI factor (modulo shard padding)
+    assert h["dcn_wire_bytes"] * 4 == (flat["dcn_wire_bytes"]
+                                       + h["padded_elements"] * 4)
+
+    (c,) = allreduce_comm_plan(grads, comm_topology="hierarchical",
+                               ici_size=4, world=8,
+                               allreduce_compress_bf16=True)
+    assert c["dcn_wire_bytes"] * 2 == h["dcn_wire_bytes"]
+    assert c["dcn_comm_dtype"] == "bfloat16"
+    assert c["eqns"] == {"reduce_scatter": 1, "all_gather": 2}
+    assert c["ici_wire_bytes"] == h["ici_wire_bytes"]
+
+    exp = plan_collective_expectations([h], extra_psums=2,
+                                       extra_psum_bytes=8)
+    assert exp["counts"] == {"reduce_scatter": 1, "psum": 3,
+                             "all_gather": 1}
+    assert exp["payload_bytes"] == h["wire_bytes"] + 8
+    assert exp["payload_bytes_by_primitive"]["psum"] == \
+        h["dcn_wire_bytes"] + 8
+
+    # knob validation mirrors the runtime
+    with pytest.raises(ValueError, match="world"):
+        allreduce_comm_plan(grads, comm_topology="hierarchical",
+                            ici_size=4)
+    with pytest.raises(ValueError, match="divide"):
+        allreduce_comm_plan(grads, comm_topology="hierarchical",
+                            ici_size=3, world=8)
+    with pytest.raises(ValueError, match="no inner level"):
+        allreduce_comm_plan(grads, allreduce_compress_bf16=True)
+    # auto: flat for 1 process, hierarchical across processes
+    (a1,) = allreduce_comm_plan(grads, comm_topology="auto", nproc=1)
+    assert a1["topology"] == "flat"
+    (a2,) = allreduce_comm_plan(grads, comm_topology="auto", nproc=2,
+                                world=8)
+    assert a2["topology"] == "hierarchical" and a2["ici_size"] == 4
+
+
 def test_comm_plan_matches_traced_buckets():
     """allreduce_comm_plan is the static twin of the traced bucketing:
     per-dtype buckets, chunk padding and wire bytes line up with what

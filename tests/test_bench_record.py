@@ -96,6 +96,44 @@ def test_stale_lines_annotate_and_order_headline_last(tmp_path):
     assert out[1]["vs_baseline"] == 11.712
 
 
+def test_comm_bench_record_schema():
+    """The --comm microbench record contract: a record carrying
+    ``comm_topology`` must state the per-level wire bytes, compression
+    flag and level widths, and fresh ``grad_allreduce_*`` metrics must
+    carry the topology fields at all (tests/ci/check_bench_schema.py
+    rides the same validator)."""
+    from apex_tpu.observability import exporters
+    good = exporters.JsonlExporter.enrich({
+        "metric": "grad_allreduce_hier_step_time", "value": 31.0,
+        "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
+        "arch": "cpu", "comm_topology": "hierarchical",
+        "compress": False, "ici_size": 4, "dcn_size": 2,
+        "wire_bytes": 6_000_000, "ici_wire_bytes": 5_000_000,
+        "dcn_wire_bytes": 1_000_000})
+    assert exporters.validate_bench_record(good) == []
+    # a grad_allreduce line with no topology fields is invalid fresh...
+    bare = {k: v for k, v in good.items()
+            if k not in ("comm_topology", "compress", "ici_size",
+                         "dcn_size", "wire_bytes", "ici_wire_bytes",
+                         "dcn_wire_bytes")}
+    assert any("comm_topology" in e
+               for e in exporters.validate_bench_record(bare))
+    # ...but a stale replay of a pre-topology record is exempt
+    assert exporters.validate_bench_record(dict(bare, stale=True)) == []
+    # bad values flag field-by-field
+    assert any("comm_topology" in e for e in
+               exporters.validate_bench_record(
+                   dict(good, comm_topology="diagonal")))
+    assert any("dcn_wire_bytes" in e for e in
+               exporters.validate_bench_record(
+                   dict(good, dcn_wire_bytes=-1)))
+    assert any("compress" in e for e in
+               exporters.validate_bench_record(
+                   dict(good, compress="yes")))
+    assert any("ici_size" in e for e in
+               exporters.validate_bench_record(dict(good, ici_size=0)))
+
+
 def test_committed_record_is_valid():
     """The repo ships a seeded record (r3's manual pre-wedge measurement)
     so even a whole round of wedge leaves a hardware line."""

@@ -11,7 +11,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.parallel import (DistributedDataParallel, Reducer,
-                               allreduce_grads_tree, flat_dist_call)
+                               allreduce_grads_tree, flat_dist_call,
+                               predivide_factors)
 
 
 @pytest.fixture
@@ -280,6 +281,251 @@ def test_make_step_steps_per_call_matches_sequential(mesh):
     for a, b in zip(jax.tree_util.tree_leaves(st),
                     jax.tree_util.tree_leaves(st2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _rank_grads(g_np):
+    rank = lax.axis_index("data").astype(jnp.float32)
+    return {"w": jnp.asarray(g_np) * (rank + 1)}
+
+
+def test_hierarchical_allreduce_matches_flat(mesh):
+    """The tentpole numerics pin: the two-level ICI/DCN reduction
+    (psum_scatter in-slice -> DCN reduce on the 1/ici shard ->
+    all_gather back) must track the flat psum to float round-off —
+    the same reduction-order caveat test_zero.py pins for ZeRO-1's
+    psum_scatter-vs-psum split.  Both ici splits of the 8-device mesh,
+    and a size that forces shard padding."""
+    rng = np.random.RandomState(0)
+    g_np = rng.randn(1001).astype(np.float32)   # 1001 % 4 != 0: pads
+
+    def fn(xs):
+        flat = allreduce_grads_tree(_rank_grads(g_np), "data")
+        h4 = allreduce_grads_tree(_rank_grads(g_np), "data",
+                                  comm_topology="hierarchical",
+                                  ici_size=4)
+        h2 = allreduce_grads_tree(_rank_grads(g_np), "data",
+                                  comm_topology="hierarchical",
+                                  ici_size=2)
+        return flat, h4, h2
+
+    flat, h4, h2 = _run(mesh, fn, jnp.arange(8.0),
+                        in_specs=(P("data"),), out_specs=P())
+    np.testing.assert_allclose(np.asarray(h4["w"]), np.asarray(flat["w"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h2["w"]), np.asarray(flat["w"]),
+                               rtol=1e-6)
+
+
+def test_hierarchical_compressed_matches_flat_at_bf16_tolerance(mesh):
+    """allreduce_compress_bf16 quantizes ONLY the DCN hop: the result
+    tracks the flat mean at bf16 resolution (one quantization of the
+    per-slice partial sums), not at fp32 round-off."""
+    rng = np.random.RandomState(1)
+    g_np = rng.randn(512).astype(np.float32)
+
+    def fn(xs):
+        flat = allreduce_grads_tree(_rank_grads(g_np), "data")
+        comp = allreduce_grads_tree(_rank_grads(g_np), "data",
+                                    comm_topology="hierarchical",
+                                    ici_size=4,
+                                    allreduce_compress_bf16=True)
+        return flat, comp
+
+    flat, comp = _run(mesh, fn, jnp.arange(8.0),
+                      in_specs=(P("data"),), out_specs=P())
+    f, c = np.asarray(flat["w"]), np.asarray(comp["w"])
+    assert np.max(np.abs(c - f) / np.maximum(np.abs(f), 1e-3)) < 2e-2
+    # and it is NOT bitwise flat (the wire really was quantized)
+    assert np.any(c != f)
+
+
+def test_hierarchical_composes_with_fp32_comm_and_dtypes(mesh):
+    """allreduce_always_fp32 + hierarchical: bf16 grads upcast once,
+    the whole two-level reduction runs fp32 (compression would halve
+    only the DCN hop), and the result casts back to bf16."""
+    def fn(xs):
+        g = {"w": jnp.full((6,), 3.0, jnp.bfloat16)}
+        out = allreduce_grads_tree(g, "data",
+                                   comm_topology="hierarchical",
+                                   ici_size=4,
+                                   allreduce_always_fp32=True)
+        outc = allreduce_grads_tree(g, "data",
+                                    comm_topology="hierarchical",
+                                    ici_size=4,
+                                    allreduce_always_fp32=True,
+                                    allreduce_compress_bf16=True)
+        return out, outc
+
+    out, outc = _run(mesh, fn, jnp.arange(8.0), in_specs=(P("data"),),
+                     out_specs=P())
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), 3.0)
+    np.testing.assert_allclose(np.asarray(outc["w"], np.float32), 3.0)
+
+
+def test_hierarchical_predivide_no_double_average(mesh):
+    """gradient_predivide_factor under the hierarchical topology: the
+    pre/post split still divides by world exactly ONCE across both
+    fabric levels (no per-level re-averaging)."""
+    def fn(xs):
+        g = {"w": jnp.full((4,), 8.0)}
+        return allreduce_grads_tree(g, "data",
+                                    comm_topology="hierarchical",
+                                    ici_size=2,
+                                    gradient_predivide_factor=4.0)
+
+    out = _run(mesh, fn, jnp.arange(8.0), in_specs=(P("data"),),
+               out_specs=P())
+    np.testing.assert_allclose(np.asarray(out["w"]), 8.0)
+
+
+def test_predivide_factors_helper_and_groups(mesh):
+    """The audited pre/post division split (satellite): pre * post ==
+    world for any factor, and the grouped + predivide + fp32-comm
+    combination — where ``world`` is the GROUP size — still yields the
+    group mean in the right dtype."""
+    pre, post = predivide_factors(8.0, 4.0)
+    assert pre * post == 8.0
+    pre1, post1 = predivide_factors(8.0)
+    assert (pre1, post1) == (1.0, 8.0)
+
+    groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def fn(xs):
+        rank = lax.axis_index("data").astype(jnp.float32)
+        # group 0 holds 4.0s, group 1 holds 8.0s (bf16 exact values)
+        g = {"w": jnp.full((4,), jnp.where(rank < 4, 4.0, 8.0)
+                           ).astype(jnp.bfloat16)}
+        return allreduce_grads_tree(g, "data", axis_index_groups=groups,
+                                    gradient_predivide_factor=2.0,
+                                    allreduce_always_fp32=True)
+
+    out = _run(mesh, fn, jnp.arange(8.0), in_specs=(P("data"),),
+               out_specs=P("data"))
+    assert out["w"].dtype == jnp.bfloat16
+    # out_specs=P("data"): rank r owns out[4r:4r+4] — ranks 0-3 are
+    # group 0, ranks 4-7 group 1
+    vals = np.asarray(out["w"], np.float32)
+    np.testing.assert_allclose(vals[:16], 4.0)  # group means, not /8
+    np.testing.assert_allclose(vals[16:], 8.0)
+
+
+def test_hierarchical_composes_with_larc(mesh):
+    """LARC composition: the trust-ratio rescale consumes hierarchical
+    grads exactly like flat ones — loss trajectories must agree to
+    round-off step for step."""
+    from apex_tpu import nn, optimizers, parallel
+    model = nn.Sequential([nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2)])
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt = parallel.LARC(optimizers.SGD(lr=0.05), trust_coefficient=0.02)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(3)
+    X = jnp.asarray(rng.randn(16, 4), jnp.float32)
+    Y = jnp.asarray(rng.randn(16, 2), jnp.float32)
+
+    def make(topology):
+        ddp = DistributedDataParallel(
+            model, comm_topology=topology,
+            ici_size=4 if topology == "hierarchical" else None)
+
+        def step(state, batch):
+            p, s = state
+            x, y = batch
+
+            def loss_fn(p):
+                return jnp.mean((model(p, x) - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            grads = ddp.allreduce_grads(grads)
+            p, s = opt.update(grads, s, p)
+            return (p, s), lax.pmean(loss, "data")
+        return ddp.make_step(step, mesh=mesh, donate_state=False)
+
+    state_f = state_h = (params, opt_state)
+    train_f, train_h = make("flat"), make("hierarchical")
+    for _ in range(3):
+        state_f, lf = train_f(state_f, (X, Y))
+        state_h, lh = train_h(state_h, (X, Y))
+        np.testing.assert_allclose(float(lf), float(lh), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_f[0]),
+                    jax.tree_util.tree_leaves(state_h[0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_comm_topology_auto_resolves_flat_single_process(mesh):
+    """The auto heuristic: one process => no DCN => flat (recorded in
+    the trace-time comm stats), and compression silently stays off."""
+    ddp = DistributedDataParallel(comm_topology="auto",
+                                  allreduce_compress_bf16=True)
+
+    def fn(xs):
+        return ddp.allreduce_grads({"w": jnp.ones((4,))})
+
+    out = _run(mesh, fn, jnp.arange(8.0), in_specs=(P("data"),),
+               out_specs=P())
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    assert [b["topology"] for b in ddp.last_comm_stats] == ["flat"]
+
+
+def test_comm_topology_validation_errors(mesh):
+    with pytest.raises(ValueError, match="comm_topology"):
+        DistributedDataParallel(comm_topology="diagonal")
+    with pytest.raises(ValueError, match="no inner level"):
+        DistributedDataParallel(comm_topology="flat",
+                                allreduce_compress_bf16=True)
+    with pytest.raises(ValueError, match="allreduce_compress_bf16"):
+        DistributedDataParallel(adasum=True,
+                                comm_topology="hierarchical",
+                                allreduce_compress_bf16=True)
+    from apex_tpu.parallel import hierarchical_axis_groups
+    with pytest.raises(ValueError, match="divide"):
+        hierarchical_axis_groups(8, 3)
+
+    def bad_ici(xs):
+        return allreduce_grads_tree({"w": jnp.ones((4,))}, "data",
+                                    comm_topology="hierarchical",
+                                    ici_size=3)
+    with pytest.raises(ValueError, match="divide"):
+        _run(mesh, bad_ici, jnp.arange(8.0), in_specs=(P("data"),),
+             out_specs=P())
+
+    def hier_groups(xs):
+        return allreduce_grads_tree(
+            {"w": jnp.ones((4,))}, "data",
+            comm_topology="hierarchical", ici_size=4,
+            axis_index_groups=[[0, 1, 2, 3], [4, 5, 6, 7]])
+    with pytest.raises(NotImplementedError, match="axis_index_groups"):
+        _run(mesh, hier_groups, jnp.arange(8.0), in_specs=(P("data"),),
+             out_specs=P())
+
+
+def test_hierarchical_comm_stats_per_level_bytes(mesh):
+    """comm_stats / ddp.last_comm_stats carry the per-level split: DCN
+    bytes are exactly 1/ici of the (padded) bucket, and the chunked
+    flat path now reports TRUE on-wire bytes (padding included) plus
+    the padded_elements field — the byte-accounting satellite."""
+    ddp_h = DistributedDataParallel(comm_topology="hierarchical",
+                                    ici_size=4)
+    ddp_c = DistributedDataParallel(message_size=100)
+
+    def fn(xs):
+        g = {"w": jnp.ones((310,), jnp.float32)}
+        return ddp_h.allreduce_grads(g), ddp_c.allreduce_grads(g)
+
+    _run(mesh, fn, jnp.arange(8.0), in_specs=(P("data"),),
+         out_specs=P())
+    (h,) = ddp_h.last_comm_stats
+    assert h["topology"] == "hierarchical"
+    assert h["wire_elements"] == 312 and h["padded_elements"] == 2
+    assert h["dcn_wire_bytes"] == (312 // 4) * 4
+    assert h["ici_wire_bytes"] == 312 * 4 + (312 // 4) * 4
+    assert h["bytes"] == h["ici_wire_bytes"] + h["dcn_wire_bytes"]
+    (c,) = ddp_c.last_comm_stats
+    assert c["cause"] == "chunked" and c["chunks"] == 4
+    assert c["wire_elements"] == 400 and c["padded_elements"] == 90
+    assert c["bytes"] == 400 * 4            # true on-wire, not 310*4
+    assert c["ici_wire_bytes"] == c["dcn_wire_bytes"] == 400 * 4
 
 
 def test_make_mesh_axis_inference_and_errors():

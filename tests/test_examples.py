@@ -100,6 +100,18 @@ def test_cross_process_ddp_parity():
     assert "world 1 processes 2 devices" in single.stdout
     assert "world 2 processes 2 devices" in multi.stdout
 
+    # hierarchical comm parity (one extra step, flat vs
+    # comm_topology="hierarchical"): the single-process run exercises
+    # the ICI level (ici=2, dcn=1), the multi-process run the DCN
+    # level (ici=1, dcn=2) of the same code path; each must match its
+    # own flat loss to reduction-order round-off
+    for out, want_ici in ((single.stdout, 2), (multi.stdout, 1)):
+        (hier_ln,) = lines(out, "hier ")
+        toks = hier_ln.split()
+        lf, lh = float.fromhex(toks[2]), float.fromhex(toks[4])
+        assert int(toks[6]) == want_ici, hier_ln
+        assert abs(lh - lf) <= 1e-5 * max(abs(lf), 1.0), hier_ln
+
 
 @pytest.mark.slow
 def test_convergence_digits_o0_vs_o2(tmp_path):

@@ -623,7 +623,12 @@ def test_ddp_comm_stats_recorded():
     by_dtype = {b["dtype"]: b for b in ddp.last_comm_stats}
     assert by_dtype["float32"]["cause"] == "chunked"
     assert by_dtype["float32"]["chunks"] == 3
+    # TRUE on-wire bytes: the chunked path pads to chunks*message_size
+    # (here 300 fits 3x100 exactly — padded_elements pins that)
     assert by_dtype["float32"]["bytes"] == 300 * 4
+    assert by_dtype["float32"]["wire_elements"] == 300
+    assert by_dtype["float32"]["padded_elements"] == 0
+    assert by_dtype["float32"]["topology"] == "flat"
     assert by_dtype["bfloat16"]["cause"] == "single"
     assert by_dtype["bfloat16"]["bytes"] == 10 * 2
     # folded into the process registry under (dtype, cause) labels
@@ -632,3 +637,34 @@ def test_ddp_comm_stats_recorded():
     assert c.labels(dtype="float32", cause="chunked").value >= 1
     assert reg.counter("ddp_allreduce_bytes_total").labels(
         dtype="float32").value >= 1200
+    # per-fabric-level accounting: flat psums count fully on both
+    lvl = reg.counter("ddp_allreduce_level_bytes_total")
+    assert lvl.labels(level="dcn", dtype="float32").value >= 1200
+    assert lvl.labels(level="ici", dtype="float32").value >= 1200
+
+
+def test_ddp_comm_stats_hierarchical_levels():
+    """The hierarchical topology's trace-time stats split the wire
+    bytes per fabric level, and the registry's level counter sees the
+    DCN hop at 1/ici of the bucket."""
+    from apex_tpu import parallel
+    ddp = parallel.DistributedDataParallel(
+        comm_topology="hierarchical", ici_size=4)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    grads = {"a": jnp.ones((400,), jnp.float32)}
+
+    base = obs.get_registry().counter(
+        "ddp_allreduce_level_bytes_total").labels(
+        level="dcn", dtype="float32").value
+    jax.jit(jax.shard_map(
+        lambda g: ddp.allreduce_grads(g), mesh=mesh, in_specs=(P(),),
+        out_specs=P(), check_vma=False))(grads)
+    (b,) = ddp.last_comm_stats
+    assert b["topology"] == "hierarchical"
+    assert b["dcn_wire_bytes"] == 100 * 4          # 1/ici of the bucket
+    assert b["ici_wire_bytes"] == 400 * 4 + 100 * 4
+    assert b["bytes"] == b["ici_wire_bytes"] + b["dcn_wire_bytes"]
+    after = obs.get_registry().counter(
+        "ddp_allreduce_level_bytes_total").labels(
+        level="dcn", dtype="float32").value
+    assert after - base == 400

@@ -110,6 +110,36 @@ def test_ddp_collective_accounting():
     assert total == want["payload_bytes"]
 
 
+def test_hier_ddp_collective_accounting():
+    """The hierarchical O2 step carries the exact two-level pattern the
+    plan derives: per grad bucket one in-slice reduce_scatter, the DCN
+    reduce on the 1/ici shard (a psum, or a second bf16 all_gather in
+    the compressed variant), and the in-slice all_gather back — with
+    the per-primitive payload split pinning that the DCN hop really is
+    1/ici of the flat payload (a bucket sneaking a full-size DCN psum
+    is the mutation tests/test_analysis.py proves the rule catches)."""
+    _assert_clean("ddp_resnet18_o2_hier", rules=["collective"])
+    _assert_clean("ddp_resnet18_o2_hier_bf16", rules=["collective"])
+    want = analysis.get("ddp_resnet18_o2_hier").expect["collectives"]
+    nbuckets = want["counts"]["reduce_scatter"]
+    assert nbuckets >= 1
+    assert want["counts"]["all_gather"] == nbuckets
+    assert want["counts"]["psum"] == nbuckets + 2   # DCN hops + scalars
+    # DCN bytes (the bucket psums minus the two 4-byte scalars) are
+    # EXACTLY 1/ici of the full bucket payload — which is what the
+    # in-slice reduce_scatter carries (what a flat unchunked psum
+    # would put on DCN)
+    dcn = want["payload_bytes_by_primitive"]["psum"] - 8
+    full = want["payload_bytes_by_primitive"]["reduce_scatter"]
+    ici = 4
+    assert dcn * ici == full
+    # compressed: the DCN hop halves again, moving to the bf16 gather
+    wantc = analysis.get(
+        "ddp_resnet18_o2_hier_bf16").expect["collectives"]
+    assert wantc["counts"]["psum"] == 2              # scalars only
+    assert wantc["counts"]["all_gather"] == 2 * nbuckets
+
+
 def test_tp_collective_accounting():
     """The DPxTP ParallelMLP step carries exactly the Megatron comm
     pattern: one row-parallel forward psum over the model axis plus

@@ -129,6 +129,86 @@ def test_zero1_matches_ddp_trajectory():
                                    err_msg=f"step {i}")
 
 
+def test_zero1_tracks_hierarchical_ddp_trajectory():
+    """Composition pin for the hierarchical comm topology: a DDP step
+    whose grads ride the two-level ICI/DCN reduction must (a) produce
+    the SAME grads as the flat psum to round-off inside one traced
+    step — i.e. the hierarchy divides by world exactly once, never per
+    level — and (b) its trajectory must track the ZeRO-1 sharded-state
+    run exactly like the flat DDP reference does (the two differ only
+    by reduction order, Adam-amplified)."""
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x, y = _data()
+    ddp_h = parallel.DistributedDataParallel(
+        model, comm_topology="hierarchical", ici_size=4)
+    ddp_f = parallel.DistributedDataParallel(model)
+
+    def loss_fn_of(xb, yb, bn):
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), new_bn
+        return loss_fn
+
+    # (a) grad-level: hierarchical == flat to round-off, one average
+    def grads_both(p, os, bn, xb, yb):
+        _, _, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                  has_aux=True)
+        return ddp_f.allreduce_grads(g), ddp_h.allreduce_grads(g)
+
+    gf, gh = jax.jit(jax.shard_map(
+        grads_both, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P()), check_vma=False))(
+        params, optimizer.init(params), bn_state, x, y)
+    # O2 grads are bf16: reduction-order differences on
+    # near-cancelling 8-term sums reach a few bf16 ulps in absolute
+    # terms, so the absolute floor is bf16-scaled
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gh)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=1e-3)
+
+    # (b) trajectory-level vs ZeRO-1 (which reduce-scatters inside
+    # optimizer.step and averages once itself)
+    def hier_step(p, os, bn, xb, yb):
+        loss, new_bn, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p,
+                                          os, has_aux=True)
+        g = ddp_h.allreduce_grads(g)
+        p, os, _ = optimizer.step(p, os, g)
+        return p, os, new_bn, lax.pmean(loss, "data")
+
+    run_h = jax.jit(jax.shard_map(
+        hier_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()), check_vma=False))
+
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+
+    def zero_step(p, os, bn, xb, yb):
+        loss, new_bn, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p,
+                                          os, has_aux=True)
+        p, os, _ = optimizer.step(p, os, g)
+        return p, os, new_bn, lax.pmean(loss, "data")
+
+    run_z = jax.jit(jax.shard_map(
+        zero_step, mesh=mesh,
+        in_specs=(P(), ospecs, P(), P("data"), P("data")),
+        out_specs=(P(), ospecs, P(), P()), check_vma=False))
+
+    pa, osa, bna = params, optimizer.init(params), bn_state
+    pb, osb, bnb = params, opt_z, bn_state
+    for i in range(4):
+        pa, osa, bna, la = run_h(pa, osa, bna, x, y)
+        pb, osb, bnb, lb = run_z(pb, osb, bnb, x, y)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-2,
+                                   err_msg=f"step {i}")
+
+
 def test_zero1_overflow_skip_is_global():
     """An inf that reduce-scatters into ONE device's grad window must
     skip the update and halve the scale on EVERY device."""
