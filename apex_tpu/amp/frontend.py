@@ -352,17 +352,41 @@ def record_scaler(opt, registry=None, step: Optional[int] = None,
     ``amp_loss_scale``, counter ``amp_steps_skipped_total``.  With
     ``emit_event=True`` also appends a loss-scale timeline point to the
     default span recorder's JSONL event log (tag it with ``step`` to
-    reconstruct the timeline offline)."""
-    from ..observability import get_registry, event
+    reconstruct the timeline offline).
+
+    One optimizer per (registry, ``prefix``): the gauge/counter are
+    plain totals, so two optimizers recorded through the same pair
+    would overwrite each other (and the counter-delta skip detection
+    below would see phantom transitions) — give each its own
+    ``prefix=`` or registry."""
+    from ..observability import get_registry, event, flightrec
     stats = amp_stats(opt)
     reg = registry if registry is not None else get_registry()
     reg.gauge(prefix + "loss_scale").set(stats["loss_scale"])
-    reg.counter(prefix + "steps_skipped_total").set_total(
-        stats["steps_skipped"])
+    skip_counter = reg.counter(prefix + "steps_skipped_total")
+    prev_skips = skip_counter.value
+    skip_counter.set_total(stats["steps_skipped"])
+    # prefix identifies the optimizer in shared sinks (the docstring's
+    # one-optimizer-per-(registry, prefix) rule) — without it a ring
+    # dump with two optimizers couldn't say WHICH one overflowed
+    ev = {"loss_scale": stats["loss_scale"],
+          "steps_skipped": stats["steps_skipped"],
+          "prefix": prefix}
+    if step is not None:
+        ev["step"] = int(step)
+    if stats["steps_skipped"] > prev_skips:
+        # flight-recorder trail: a scaler skip is a rare, diagnostic
+        # transition (overflow → step dropped, scale halved) — exactly
+        # what a post-mortem ring dump should show next to any
+        # failover/breaker events of the same window.  Dedup is the
+        # per-registry counter delta: recording the same optimizer
+        # against a FRESH registry re-reports its cumulative total
+        # once (a truthful, spurious-timed event) — accepted, because
+        # any process-global gate on the ring's last totals would
+        # silently SUPPRESS a second optimizer's first skips, and a
+        # post-mortem missing real transitions is worse than one
+        # carrying a duplicate.
+        flightrec.record("scaler_skip", **ev)
     if emit_event:
-        ev = {"loss_scale": stats["loss_scale"],
-              "steps_skipped": stats["steps_skipped"]}
-        if step is not None:
-            ev["step"] = int(step)
         event("amp_loss_scale", **ev)
     return stats

@@ -73,7 +73,7 @@ class FaultyReplica:
                  raise_on_step=(), raise_on_prefill=(), stall=(),
                  slow=(), drop_results=(),
                  slow_s: float = 0.05, stall_s: float = 0.0,
-                 p_error: float = 0.0, seed: int = 0):
+                 p_error: float = 0.0, seed: int = 0, ring=None):
         self._inner = replica
         self._raise_on_step = _windows(raise_on_step)
         self._raise_on_prefill = _windows(raise_on_prefill)
@@ -86,33 +86,52 @@ class FaultyReplica:
         self._rng = np.random.RandomState(seed)
         self.steps = 0                  # step() calls observed
         self.faults_fired = 0
+        # flight-recorder trail: every injected fault lands in the ring
+        # (default: the CURRENT process ring, resolved per append so a
+        # set_ring swap moves the whole story together), so a
+        # post-mortem dump shows the injected cause right next to the
+        # breaker/failover transitions it provoked
+        self._ring = ring
+
+    @property
+    def ring(self):
+        from ..observability import flightrec
+        return flightrec.resolve(self._ring)
+
+    def _fired(self, kind: str, step: int):
+        self.faults_fired += 1
+        self.ring.append("fault_injected", fault=kind, step=step)
 
     # -- faulted surface ---------------------------------------------------
     def step(self):
         t = self.steps
         self.steps += 1
         if _in(self._stall, t):
-            self.faults_fired += 1
+            self._fired("stall", t)
             if self.stall_s:
                 time.sleep(self.stall_s)
             return {}
-        if _in(self._raise_on_step, t) or (
-                self.p_error > 0.0
-                and self._rng.uniform() < self.p_error):
-            self.faults_fired += 1
+        if _in(self._raise_on_step, t):
+            self._fired("raise_on_step", t)
+            raise ReplicaFault(f"injected step fault at step {t}")
+        if self.p_error > 0.0 and self._rng.uniform() < self.p_error:
+            # label the probabilistic fault as what it is — a
+            # post-mortem reading the ring must not conclude a
+            # deterministic window was configured at this step
+            self._fired("p_error", t)
             raise ReplicaFault(f"injected step fault at step {t}")
         if _in(self._slow, t):
-            self.faults_fired += 1
+            self._fired("slow", t)
             time.sleep(self.slow_s)
         out = self._inner.step()
         if _in(self._drop_results, t):
-            self.faults_fired += 1
+            self._fired("drop_results", t)
             return {}
         return out
 
     def _check_prefill_fault(self):
         if _in(self._raise_on_prefill, self.steps):
-            self.faults_fired += 1
+            self._fired("raise_on_prefill", self.steps)
             raise ReplicaFault(
                 f"injected prefill fault at step {self.steps}")
 

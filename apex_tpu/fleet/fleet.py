@@ -44,16 +44,29 @@ carries ``fleet_retries_total`` / ``fleet_shed_total`` /
 per-replica labeled gauges; ``stats()`` aggregates the replicas'
 own ``stats()``; ``record()`` is the ``kind: fleet`` JSONL record
 ``observability.exporters.validate_fleet_record`` pins.
+
+Flight recorder (PR 6): every submitted request gets a distributed
+trace ("<fleet_trace>/r<rid>") whose lifecycle events — submit, route,
+dispatch, fault, reclaim, result — chain causally on the process
+:class:`~apex_tpu.observability.SpanRecorder`, with engine-internal
+spans (queue/prefill/window-decode) parenting under the dispatch hop
+even across the step pool's worker threads; rare operational
+transitions (failover/shed/retry/deadline/stall, plus the breaker
+moves ``health.ReplicaHealth`` notes and the faults ``faults.
+FaultyReplica`` injects) land in a bounded
+:class:`~apex_tpu.observability.EventRing`, dumped to
+``flight_dump_path`` the moment a replica fails.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..observability import MetricsRegistry
+from ..observability import MetricsRegistry, flightrec, tracing
 from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                      STATE_CODES, HealthConfig, ReplicaHealth)
 from .router import FleetOverloaded, RetryPolicy, make_policy
@@ -79,6 +92,13 @@ class _FleetRequest:
         self.error: Optional[str] = None
         self.t_submit: Optional[float] = None
         self.t_finish: Optional[float] = None
+        # distributed-trace spine: trace_id is minted at submit
+        # ("<fleet_trace>/r<rid>"); last_span is the causal tail every
+        # later lifecycle event parents on.  Both are touched ONLY on
+        # the fleet thread (submit/dispatch/harvest/failover), so the
+        # chain cannot interleave no matter how the step pool schedules
+        self.trace_id: Optional[str] = None
+        self.last_span: Optional[int] = None
 
 
 class Fleet:
@@ -101,7 +121,10 @@ class Fleet:
                  health: Optional[HealthConfig] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  clock=None,
-                 step_workers: Optional[int] = None):
+                 step_workers: Optional[int] = None,
+                 ring=None,
+                 trace: bool = True,
+                 flight_dump_path: Optional[str] = None):
         if not replicas:
             raise ValueError("Fleet needs at least one replica")
         if max_queue < 1:
@@ -115,8 +138,26 @@ class Fleet:
         self.replica_queue_cap = replica_queue_cap
         self.retry = retry or RetryPolicy()
         self.health_config = health or HealthConfig()
-        self.health = [ReplicaHealth(self.health_config)
-                       for _ in self.replicas]
+        # flight recorder + distributed tracing: the ring holds the
+        # rare operational transitions (failover/shed/retry/deadline/
+        # stall + the breaker transitions ReplicaHealth notes); with
+        # ``trace=True`` every submitted request gets a trace context
+        # ("<fleet_trace>/r<rid>") whose lifecycle events land on the
+        # process SpanRecorder.  ``flight_dump_path`` dumps the ring
+        # there the moment a replica fails — the post-mortem artifact.
+        # explicit ring binds here; None resolves the PROCESS ring
+        # lazily at every append (via the `ring` property), so an
+        # operator swapping obs.set_ring() mid-life moves this fleet's
+        # whole story — failover/breaker/shed/fault AND record_scaler's
+        # skips — to the new ring together instead of splitting it
+        self._ring = ring
+        self.tracing = bool(trace)
+        self.flight_dump_path = flight_dump_path
+        self.trace_id = tracing.new_trace_id("fleet")
+        self.health = [ReplicaHealth(self.health_config,
+                                     ring=ring,
+                                     name=i)
+                       for i in range(len(self.replicas))]
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._clock = clock if clock is not None else time.perf_counter
         # replica step() dispatches can overlap across a thread pool:
@@ -139,6 +180,11 @@ class Fleet:
         self._pending: List[_FleetRequest] = []
         self._inflight: Dict[Tuple[int, int], _FleetRequest] = {}
         self._results: Dict[int, _FleetRequest] = {}
+        # rid -> trace id, retained for the fleet's lifetime like
+        # _results (one short string per request); the span events
+        # themselves live on the BOUNDED process recorder, so an old
+        # request's trace eventually evicts oldest-first
+        self._trace_ids: Dict[int, str] = {}
         self._next_rid = 0
         self._step_no = 0
         self._idle_steps = [0] * len(self.replicas)
@@ -151,6 +197,8 @@ class Fleet:
         self._n_failed = 0
         self._n_tokens = 0
         self._n_shed = 0
+        self._shedding = False      # inside an overload episode?
+        self._tick_retry_logged: set = set()  # replicas ring-logged this tick
         self._n_retries = 0
         self._n_failovers = 0
         self._n_drains = 0
@@ -180,6 +228,13 @@ class Fleet:
             help="submit-to-finish latency per completed request")
         m.gauge("fleet_replicas").set(float(len(self.replicas)))
 
+    @property
+    def ring(self):
+        """The flight ring this fleet appends to: the one passed at
+        construction, else the CURRENT process ring (resolved per
+        access, so ``obs.set_ring`` swaps mid-life take effect)."""
+        return flightrec.resolve(self._ring)
+
     # -- submission --------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_token_id: Optional[int] = None,
@@ -194,6 +249,17 @@ class Fleet:
         if len(self._pending) >= self.max_queue:
             self._n_shed += 1
             self._m_shed.inc()
+            if not self._shedding:
+                # one ring event per overload EPISODE (the transition
+                # into shedding), not per rejected submit: sustained
+                # overload is hundreds of rejections a second, which
+                # would wheel the bounded ring past the breaker/
+                # failover history a post-mortem needs.
+                # fleet_shed_total carries the volume.
+                self._shedding = True
+                self.ring.append("shed",
+                                 queue_depth=len(self._pending),
+                                 max_queue=self.max_queue)
             raise FleetOverloaded(len(self._pending), self.max_queue)
         if deadline is not None and deadline <= 0:
             raise ValueError(f"deadline must be > 0 seconds, got "
@@ -205,10 +271,32 @@ class Fleet:
                             seed, temperature,
                             None if deadline is None else now + deadline)
         req.t_submit = now
+        if self.tracing:
+            # the root of the request's causal chain; every later
+            # lifecycle event (route/dispatch/fault/reclaim/result)
+            # parents on the chain's tail
+            req.trace_id = f"{self.trace_id}/r{rid}"
+            self._trace_ids[rid] = req.trace_id
+            req.last_span = tracing.get_recorder().event(
+                "fleet_submit", trace_id=req.trace_id, rid=rid,
+                prompt_len=len(req.prompt), max_new=max_new_tokens,
+                queue_depth=len(self._pending))
         self._pending.append(req)
+        self._shedding = False      # an admitted submit ends the episode
         self._n_submitted += 1
         self._m_submitted.inc()
         return rid
+
+    def _trace_ev(self, req: "_FleetRequest", name: str,
+                  **attrs) -> Optional[int]:
+        """Append one lifecycle event to the request's trace, chaining
+        it on the previous tail; fleet-thread only."""
+        if not (self.tracing and req.trace_id):
+            return None
+        req.last_span = tracing.get_recorder().event(
+            name, trace_id=req.trace_id, parent_id=req.last_span,
+            rid=req.rid, **attrs)
+        return req.last_span
 
     def register_prefix(self, tokens: Sequence[int],
                         replica: Optional[int] = None) -> int:
@@ -246,6 +334,7 @@ class Fleet:
         from its first token); ``result()`` is the exactly-once
         surface."""
         self._step_no += 1
+        self._tick_retry_logged.clear()
         for h in self.health:
             h.tick()
         self._check_deadlines()
@@ -259,10 +348,23 @@ class Fleet:
                 plan.append((i, rep, mine))
 
         def dispatch(item):
+            # runs on a pool worker: the span carries the FLEET-run
+            # trace and is this thread's ambient parent, so
+            # engine-internal spans (window decode) nest under the
+            # right replica's dispatch even with step_workers > 1 —
+            # pool threads get their own contextvar context and the
+            # span resets it on exit, so reused workers never inherit
+            # a stale parent (the PR 1 interleaving bug)
             i, rep, _ = item
             t0 = self._clock()
+            cm = (tracing.get_recorder().span(
+                      "fleet_replica_step", trace_id=self.trace_id,
+                      replica=i, fleet_step=self._step_no)
+                  if self.tracing else contextlib.nullcontext())
             try:
-                return i, rep.step(), self._clock() - t0, None
+                with cm:
+                    out = rep.step()
+                return i, out, self._clock() - t0, None
             except Exception as e:  # noqa: BLE001 — any replica death
                 return i, None, self._clock() - t0, e
 
@@ -312,6 +414,9 @@ class Fleet:
                 self._idle_steps[i] += 1
                 if self._idle_steps[i] >= self.health_config.stall_steps:
                     self._idle_steps[i] = 0
+                    self.ring.append("stall_watchdog", replica=i,
+                                     stall_steps=self.health_config
+                                     .stall_steps)
                     self._replica_failed(
                         i, f"no progress for "
                            f"{self.health_config.stall_steps} steps "
@@ -363,14 +468,32 @@ class Fleet:
                 continue
             i = self.policy.select(self, cands, req)
             rep = self.replicas[i]
+            # routing decision + dispatch attempt on the request's
+            # trace; activating the dispatch event around rep.submit
+            # parents the engine's own queue/prefill spans under it
+            # (submit runs on the fleet thread — ambient is safe here)
+            decision = getattr(self.policy, "last_decision", None)
+            self._trace_ev(req, "fleet_route", replica=i,
+                           policy=getattr(self.policy, "name",
+                                          type(self.policy).__name__),
+                           attempt=req.attempts,
+                           candidates=list(cands),
+                           **({"decision": decision} if decision
+                              else {}))
+            dspan = self._trace_ev(req, "fleet_dispatch", replica=i)
+            amb = (tracing.get_recorder().activate(req.trace_id, dspan)
+                   if dspan is not None else contextlib.nullcontext())
             try:
-                rrid = rep.submit(req.prompt, req.max_new, req.eos,
-                                  req.seed, req.temperature)
+                with amb:
+                    rrid = rep.submit(req.prompt, req.max_new, req.eos,
+                                      req.seed, req.temperature)
             except ValueError as e:
                 # request-shaped rejection (bad prompt length, seed on
                 # a greedy engine, ...): the replica is fine and no
                 # other replica would take it either — fail, no retry
                 self._pending.remove(req)
+                self._trace_ev(req, "fleet_reject", replica=i,
+                               error=str(e))
                 self._fail(req, f"rejected at dispatch: {e}")
                 continue
             except Exception as e:      # noqa: BLE001 — replica fault
@@ -378,8 +501,19 @@ class Fleet:
                 self._n_retries += 1
                 self._m_retries.inc()
                 req.attempts += 1
+                # one ring event per (replica, tick): a deep backlog
+                # failing dispatch onto one sick replica is a single
+                # transition, not len(backlog) of them — the counter
+                # carries the volume (same rule as shed/deadline)
+                if i not in self._tick_retry_logged:
+                    self._tick_retry_logged.add(i)
+                    self.ring.append("dispatch_retry", replica=i,
+                                     rid=req.rid, attempt=req.attempts,
+                                     error=str(e))
                 if req.attempts >= self.retry.max_attempts:
                     self._pending.remove(req)
+                    self._trace_ev(req, "fleet_retries_exhausted",
+                                   replica=i, attempts=req.attempts)
                     self._fail(req, f"dispatch failed after "
                                     f"{req.attempts} attempts; last: "
                                     f"{e}")
@@ -387,6 +521,10 @@ class Fleet:
                     req.next_attempt_step = (
                         self._step_no
                         + self.retry.delay_steps(req.attempts - 1))
+                    self._trace_ev(req, "fleet_retry_backoff",
+                                   replica=i, attempt=req.attempts,
+                                   next_attempt_step=
+                                   req.next_attempt_step)
                 cands = self._candidates()   # health may have tripped
                 continue
             self._pending.remove(req)
@@ -408,6 +546,8 @@ class Fleet:
         rep = self.replicas[i]
         keys = sorted((k for k in self._inflight if k[0] == i),
                       key=lambda k: self._inflight[k].rid)
+        self.ring.append("failover", replica=i, reason=reason,
+                         reclaimed=len(keys), fleet_step=self._step_no)
         moved = []
         for key in keys:
             req = self._inflight.pop(key)
@@ -421,12 +561,20 @@ class Fleet:
             req.generated = []
             self._n_failovers += 1
             self._m_failover.inc()
+            # the failure hop of the request's causal chain: the fault
+            # on the sick replica, then the reclaim that re-queues it
+            # for the router — the next fleet_route/fleet_dispatch pair
+            # (on a survivor) chains on the reclaim event
+            self._trace_ev(req, "fleet_fault", replica=i, reason=reason)
             if req.attempts >= self.retry.max_attempts:
                 self._fail(req, f"failed over {req.restarts}x "
                                 f"(attempt budget exhausted); replica "
                                 f"{i}: {reason}")
             else:
                 req.next_attempt_step = self._step_no + 1
+                self._trace_ev(req, "fleet_reclaim", replica=i,
+                               restarts=req.restarts,
+                               attempts=req.attempts)
                 moved.append(req)
         # leftovers in the replica's own waiting queue (queued-on-
         # replica dispatches) came back via the keys above; anything
@@ -439,6 +587,13 @@ class Fleet:
         # restarted requests go to the FRONT in submission order: they
         # were admitted before anything still pending
         self._pending[:0] = moved
+        if self.flight_dump_path:
+            # post-mortem artifact the moment something broke — not at
+            # process exit, which a wedged replica may never reach
+            try:
+                self.ring.dump(self.flight_dump_path)
+            except OSError:
+                pass
 
     def _fail(self, req: _FleetRequest, msg: str):
         req.error = msg
@@ -446,6 +601,7 @@ class Fleet:
         self._results[req.rid] = req
         self._n_failed += 1
         self._m_failed.inc()
+        self._trace_ev(req, "fleet_failed", error=msg)
 
     def _finish(self, req: _FleetRequest, tokens: List[int]):
         req.generated = [int(t) for t in tokens]
@@ -457,14 +613,19 @@ class Fleet:
         self._m_tokens.inc(len(req.generated))
         if req.t_submit is not None:
             self._m_latency.observe(req.t_finish - req.t_submit)
+        self._trace_ev(req, "fleet_result", tokens=len(req.generated),
+                       restarts=req.restarts,
+                       latency_s=round(req.t_finish - req.t_submit, 6)
+                       if req.t_submit is not None else None)
 
     def _check_deadlines(self):
         now = self._clock()
+        expired: List[_FleetRequest] = []
         for req in [r for r in self._pending
                     if r.deadline_at is not None
                     and now > r.deadline_at]:
             self._pending.remove(req)
-            self._deadline_fail(req)
+            expired.append(req)
         for key, req in list(self._inflight.items()):
             if req.deadline_at is not None and now > req.deadline_at:
                 del self._inflight[key]
@@ -472,7 +633,18 @@ class Fleet:
                     self.replicas[key[0]].cancel(key[1])
                 except Exception:       # noqa: BLE001
                     pass
-                self._deadline_fail(req)
+                expired.append(req)
+        if expired:
+            # ONE ring event per sweep, like the shed episode: a
+            # shared client deadline can expire the whole queue in a
+            # single tick, and thousands of per-request events would
+            # wheel the bounded ring past the breaker/failover history
+            # a post-mortem needs.  The counter carries the volume.
+            self.ring.append("deadline_exceeded", count=len(expired),
+                             rids=[r.rid for r in expired[:8]],
+                             fleet_step=self._step_no)
+        for req in expired:
+            self._deadline_fail(req)
 
     def _deadline_fail(self, req: _FleetRequest):
         self._n_deadline += 1
@@ -504,6 +676,10 @@ class Fleet:
                 req.next_attempt_step = self._step_no
                 moved.append(req)
         moved.sort(key=lambda r: r.rid)
+        self.ring.append("drain", replica=i, requeued=len(moved),
+                         fleet_step=self._step_no)
+        for req in moved:
+            self._trace_ev(req, "fleet_drain_requeue", replica=i)
         self._pending[:0] = moved
         if not any(k[0] == i for k in self._inflight):
             h.finish_drain()
@@ -522,6 +698,25 @@ class Fleet:
         if req.error is not None:
             raise RuntimeError(f"request {rid} failed: {req.error}")
         return list(req.generated)
+
+    def request_trace_id(self, rid: int) -> Optional[str]:
+        """The distributed-trace id minted for request ``rid`` at
+        submit ("<fleet_trace>/r<rid>"), or None when tracing is off.
+        Feed it to ``observability.get_recorder().trace(...)`` /
+        ``trace_record(...)`` for the request's full causal span chain
+        (submit → route → dispatch → [fault → reclaim → ...] →
+        result)."""
+        return self._trace_ids.get(rid)
+
+    def trace_record(self, rid: int) -> Dict[str, Any]:
+        """The ``kind: trace`` JSONL record of request ``rid``'s
+        flight (``exporters.validate_trace_record`` pins the shape);
+        raises ``KeyError`` when the request was never traced."""
+        tid = self._trace_ids.get(rid)
+        if tid is None:
+            raise KeyError(f"request {rid} has no trace (tracing "
+                           f"disabled or unknown rid)")
+        return tracing.get_recorder().trace_record(tid)
 
     def close(self):
         """Join the step-worker pool (idempotent).  A later ``step()``
@@ -620,7 +815,7 @@ class Fleet:
         through a :class:`~apex_tpu.observability.exporters.JsonlExporter`
         (or ``JsonlExporter.enrich``) to stamp the envelope."""
         s = self.stats()
-        return {"kind": "fleet",
+        return {"kind": "fleet", "trace_id": self.trace_id,
                 "replicas": s["replicas"], "policy": s["policy"],
                 "healthy": s["healthy"], "degraded": s["degraded"],
                 "dead": s["dead"],

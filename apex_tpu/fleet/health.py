@@ -113,10 +113,25 @@ class HealthConfig:
 
 
 class ReplicaHealth:
-    """Per-replica health record the fleet owns and feeds."""
+    """Per-replica health record the fleet owns and feeds.
 
-    def __init__(self, config: Optional[HealthConfig] = None):
+    ``ring`` (an :class:`~apex_tpu.observability.EventRing`; ``None``
+    resolves the CURRENT process ring per note, the same default as
+    every other flight-recorder producer) receives
+    one flight-recorder event per state-machine TRANSITION —
+    ``breaker_open`` / ``breaker_half_open`` / ``breaker_close`` /
+    ``breaker_reopen``, ``drain_start`` / ``drain_finish``,
+    ``health_reset`` — tagged ``replica=name`` (the fleet passes the
+    int replica INDEX, so breaker events join the fleet's own ring
+    events on the same ``ev["replica"]`` key).  Transitions are rare
+    by construction, so the ring holds the breaker's whole recent
+    history at post-mortem time."""
+
+    def __init__(self, config: Optional[HealthConfig] = None,
+                 ring=None, name=None):
         self.config = config or HealthConfig()
+        self.ring = ring
+        self.name = name
         self.error_rate = Ewma(self.config.error_alpha)
         self.latency = Ewma(self.config.latency_alpha)
         self.consecutive_errors = 0
@@ -126,6 +141,11 @@ class ReplicaHealth:
         self.draining = False
         self.drained = False
         self.errors_total = 0
+
+    def _note(self, kind: str, **attrs):
+        from ..observability import flightrec
+        flightrec.resolve(self.ring).append(kind, replica=self.name,
+                                            **attrs)
 
     # -- fleet feed --------------------------------------------------------
     def record_success(self, latency_s: float):
@@ -141,6 +161,7 @@ class ReplicaHealth:
             self._cooldown = self.config.cooldown_steps
             self.error_rate.reset()
             self.latency.reset(latency_s)
+            self._note("breaker_close")
 
     def record_error(self):
         """A step/prefill raised (or the stall watchdog fired)."""
@@ -152,15 +173,18 @@ class ReplicaHealth:
             self._cooldown = min(
                 int(self._cooldown * self.config.cooldown_backoff),
                 self.config.max_cooldown_steps)
-            self._open()
+            self._open("breaker_reopen")
         elif self.circuit == "closed" and (
                 self.consecutive_errors >= self.config.dead_consecutive
                 or self.error_rate.value >= self.config.dead_error_rate):
-            self._open()
+            self._open("breaker_open")
 
-    def _open(self):
+    def _open(self, kind: str = "breaker_open"):
         self.circuit = "open"
         self._cooldown_left = self._cooldown
+        self._note(kind, cooldown_steps=self._cooldown,
+                   consecutive_errors=self.consecutive_errors,
+                   error_rate=round(self.error_rate.value, 4))
 
     def tick(self):
         """Advance one fleet step of breaker time."""
@@ -168,20 +192,24 @@ class ReplicaHealth:
             self._cooldown_left -= 1
             if self._cooldown_left <= 0:
                 self.circuit = "half_open"
+                self._note("breaker_half_open")
 
     # -- drain lifecycle ---------------------------------------------------
     def start_drain(self):
         self.draining = True
         self.drained = False
+        self._note("drain_start")
 
     def finish_drain(self):
         self.draining = False
         self.drained = True
+        self._note("drain_finish")
 
     def reset(self):
         """Re-enlist (post rolling-restart): fresh record, closed
         circuit, admission back on."""
-        self.__init__(self.config)
+        self.__init__(self.config, ring=self.ring, name=self.name)
+        self._note("health_reset")
 
     # -- queries -----------------------------------------------------------
     @property

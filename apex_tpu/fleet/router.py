@@ -6,6 +6,12 @@ before degraded, free slot required, breaker/drain respected), so
 policies stay pure ranking functions over live scheduler stats and are
 trivially testable.
 
+Every ``select`` also leaves ``last_decision`` — a small
+JSON-serializable dict saying *why* that replica won (per-candidate
+loads, the matched prefix owner, the rotation cursor).  The fleet
+copies it onto the request's ``fleet_route`` trace event, so a flight
+record answers "why replica 2?" without re-deriving the ranking.
+
 - :class:`RoundRobin` — cycle through candidates; the baseline.
 - :class:`LeastLoaded` — rank by each replica's ``stats()`` occupancy
   plus its queue depth (normalized by slot count), ties to the lowest
@@ -100,14 +106,18 @@ class RoundRobin:
 
     def __init__(self):
         self._next = 0
+        self.last_decision = None
 
     def select(self, fleet, candidates: Sequence[int], req) -> int:
         # candidates are sorted replica indices; take the first one at
         # or after the cursor so removal of a replica (drain/death)
         # cannot wedge the rotation
-        pick = next((i for i in candidates if i >= self._next),
+        cursor = self._next
+        pick = next((i for i in candidates if i >= cursor),
                     candidates[0])
         self._next = pick + 1
+        self.last_decision = {"cursor": cursor, "wrapped":
+                              pick < cursor}
         return pick
 
 
@@ -116,9 +126,18 @@ class LeastLoaded:
     index."""
     name = "least_loaded"
 
+    def __init__(self):
+        self.last_decision = None
+
     def select(self, fleet, candidates: Sequence[int], req) -> int:
-        return min(candidates,
-                   key=lambda i: (_load(fleet.replicas[i]), i))
+        loads = {i: _load(fleet.replicas[i]) for i in candidates}
+        pick = min(candidates, key=lambda i: (loads[i], i))
+        # JSON object keys are strings; stringify (and round for
+        # display only — selection uses full precision) so the
+        # decision survives the trace record round-trip unchanged
+        self.last_decision = {"load": {str(i): round(loads[i], 4)
+                                       for i in candidates}}
+        return pick
 
 
 class PrefixAffinity:
@@ -135,12 +154,22 @@ class PrefixAffinity:
 
     def __init__(self, fallback=None):
         self.fallback = fallback or LeastLoaded()
+        self.last_decision = None
 
     def select(self, fleet, candidates: Sequence[int], req) -> int:
         owner = fleet.prefix_owner(req.prompt)
         if owner is not None and owner in candidates:
+            self.last_decision = {"prefix_owner": owner}
             return owner
-        return self.fallback.select(fleet, candidates, req)
+        pick = self.fallback.select(fleet, candidates, req)
+        self.last_decision = {
+            # owner set but inadmissible (dead/draining/full) is the
+            # interesting trace distinction vs no registered match
+            "prefix_owner": owner, "fallback":
+            getattr(self.fallback, "name",
+                    type(self.fallback).__name__),
+            **(getattr(self.fallback, "last_decision", None) or {})}
+        return pick
 
 
 _POLICIES = {"round_robin": RoundRobin, "least_loaded": LeastLoaded,

@@ -9,9 +9,17 @@ Three layers (docs/observability.md):
    explicit fetch at ``flush()``).
 2. **Spans/events** — :class:`SpanRecorder` wall-clock ranges layered on
    ``utils.profiler``'s nvtx-parity ranges; exports Chrome-trace JSON
-   and a JSONL event log.
+   and a JSONL event log.  PR 6 added request-scoped distributed
+   tracing (``new_trace_id`` / thread-correct span parentage /
+   ``kind: trace`` records) that the fleet propagates end to end.
 3. **Exporters** — schema-versioned JSONL (what ``bench.py`` emits),
    Prometheus text exposition, Chrome trace.
+
+Plus the **flight recorder** (PR 6): :class:`EventRing`, a bounded
+ring of operational transitions (breaker/failover/drain/stall/scaler
+skips) dumpable on fault, and ``steptime``, the blocked-fetch
+step-time attribution harness (compute vs per-level comm time,
+``overlap_fraction``) behind ``bench.py --comm``.
 
 Wired consumers: ``serving.Engine``/``Seq2SeqEngine`` (enriched
 ``stats()``), ``parallel.distributed`` (comm accounting),
@@ -24,12 +32,17 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DeviceMetrics, get_registry, set_registry,
                       DEFAULT_LATENCY_BUCKETS)
 from .tracing import (SpanRecorder, get_recorder, set_recorder, span,
-                      event, export_chrome_trace, export_jsonl)
+                      event, export_chrome_trace, export_jsonl,
+                      new_trace_id, current_trace, maybe_span,
+                      maybe_event)
+from .flightrec import EventRing, get_ring, set_ring
 from .exporters import (SCHEMA_VERSION, JsonlExporter, prometheus_text,
                         host_info, validate_bench_record,
                         validate_bench_jsonl)
 from . import metrics
 from . import tracing
+from . import flightrec
+from . import steptime
 from . import exporters
 
 __all__ = [
@@ -37,7 +50,9 @@ __all__ = [
     "get_registry", "set_registry", "DEFAULT_LATENCY_BUCKETS",
     "SpanRecorder", "get_recorder", "set_recorder", "span", "event",
     "export_chrome_trace", "export_jsonl",
+    "new_trace_id", "current_trace", "maybe_span", "maybe_event",
+    "EventRing", "get_ring", "set_ring",
     "SCHEMA_VERSION", "JsonlExporter", "prometheus_text", "host_info",
     "validate_bench_record", "validate_bench_jsonl",
-    "metrics", "tracing", "exporters",
+    "metrics", "tracing", "flightrec", "steptime", "exporters",
 ]

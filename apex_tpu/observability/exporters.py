@@ -29,10 +29,14 @@ from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
-           "validate_fleet_record", "validate_telemetry_record",
-           "validate_telemetry_jsonl"]
+           "validate_fleet_record", "validate_trace_record",
+           "validate_telemetry_record", "validate_telemetry_jsonl"]
 
-SCHEMA_VERSION = 1
+# v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
+# <-> request-trace join key) and ``kind: trace`` records exist.
+# Validators gate version-2 requirements on the record's DECLARED
+# version, so archived v1 streams stay valid.
+SCHEMA_VERSION = 2
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -268,6 +272,46 @@ def validate_bench_record(rec: Any) -> List[str]:
             and "comm_topology" not in rec):
         errs.append("grad_allreduce records must carry 'comm_topology' "
                     "(and the per-level wire-byte fields)")
+    # step-time attribution fields (bench.py --comm, PR 6): a record
+    # carrying ``overlap_fraction`` decomposes a train step into
+    # compute vs comm time per fabric level and must be internally
+    # consistent — compute + critical-path comm reassemble the
+    # wall-clock step, the per-level times reassemble the isolated
+    # comm measurement, and the overlap fraction is a fraction.
+    if "overlap_fraction" in rec:
+        for key in ("step_ms", "compute_ms", "comm_ms",
+                    "comm_isolated_ms", "ici_ms", "dcn_ms",
+                    "overlap_fraction"):
+            v = _need(rec, errs, key, numbers.Number)
+            if (isinstance(v, numbers.Number) and not isinstance(v, bool)
+                    and v < 0):
+                errs.append(f"{key!r} must be >= 0, got {v}")
+        vals = {k: rec.get(k) for k in ("step_ms", "compute_ms",
+                                        "comm_ms", "comm_isolated_ms",
+                                        "ici_ms", "dcn_ms",
+                                        "overlap_fraction")}
+        if all(isinstance(v, numbers.Number) and not isinstance(v, bool)
+               for v in vals.values()):
+            if vals["overlap_fraction"] > 1.0:
+                errs.append(f"overlap_fraction must be in [0, 1], got "
+                            f"{vals['overlap_fraction']}")
+            # comm_ms is the CLAMPED step-compute difference, so the
+            # only legitimate residue is measurement noise when the
+            # compute twin times slower than the full step
+            resid = abs(vals["compute_ms"] + vals["comm_ms"]
+                        - vals["step_ms"])
+            if resid > max(0.25 * vals["step_ms"], 0.25):
+                errs.append(
+                    f"compute_ms + comm_ms ({vals['compute_ms']} + "
+                    f"{vals['comm_ms']}) inconsistent with step_ms "
+                    f"({vals['step_ms']})")
+            lvl = abs(vals["ici_ms"] + vals["dcn_ms"]
+                      - vals["comm_isolated_ms"])
+            if lvl > max(0.02 * vals["comm_isolated_ms"], 0.01):
+                errs.append(
+                    f"ici_ms + dcn_ms ({vals['ici_ms']} + "
+                    f"{vals['dcn_ms']}) must reassemble "
+                    f"comm_isolated_ms ({vals['comm_isolated_ms']})")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:
@@ -357,6 +401,19 @@ def validate_fleet_record(rec: Any) -> List[str]:
     _check_envelope(rec, errs)
     if rec.get("kind") != "fleet":
         errs.append(f"kind must be 'fleet', got {rec.get('kind')!r}")
+    # the flight-recorder cross-reference: every fleet snapshot names
+    # the fleet-run trace whose request traces (``kind: trace``,
+    # trace_id "<fleet>/r<rid>") it aggregates — a dashboard can join
+    # the two streams on this id.  Schema v2 requirement: archived v1
+    # fleet records (pre-flight-recorder) predate the field and stay
+    # valid at their declared version.
+    sv = rec.get("schema_version", SCHEMA_VERSION)
+    if isinstance(sv, int) and not isinstance(sv, bool) and sv >= 2:
+        # (a non-int schema_version is already an envelope error — no
+        # crash, no v2 requirements)
+        tid = need("trace_id", str)
+        if isinstance(tid, str) and not tid:
+            errs.append("trace_id must be non-empty")
     pol = need("policy", str)
     if isinstance(pol, str) and not pol:
         errs.append("policy must be non-empty")
@@ -389,22 +446,110 @@ def validate_fleet_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- trace record schema ----------------------------------------------------
+
+def validate_trace_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: trace`` JSONL record
+    (``SpanRecorder.trace_record`` enriched by the exporter): the
+    common envelope, a non-empty ``trace_id``, and a non-empty span
+    list where every span belongs to the record's trace, carries a
+    unique positive ``span_id``, and any ``parent_id`` references an
+    EARLIER span id (span ids are allocated in causal order — a child
+    pointing at a later or unknown parent means the recorder lost the
+    chain, exactly the worker-thread interleaving bug this schema
+    exists to catch)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types):
+        return _need(rec, errs, key, types)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "trace":
+        errs.append(f"kind must be 'trace', got {rec.get('kind')!r}")
+    tid = need("trace_id", str)
+    if isinstance(tid, str) and not tid:
+        errs.append("trace_id must be non-empty")
+    spans = need("spans", list)
+    n = need("span_count", int)
+    if isinstance(spans, list):
+        if not spans:
+            errs.append("spans must be non-empty (an empty trace is "
+                        "not a trace)")
+        if isinstance(n, int) and not isinstance(n, bool) \
+                and n != len(spans):
+            errs.append(f"span_count ({n}) != len(spans) "
+                        f"({len(spans)})")
+        all_ids = {sp.get("span_id") for sp in spans
+                   if isinstance(sp, dict)}
+        seen: set = set()
+        for i, sp in enumerate(spans):
+            if not isinstance(sp, dict):
+                errs.append(f"spans[{i}] is not an object")
+                continue
+            name = sp.get("name")
+            if not isinstance(name, str) or not name:
+                errs.append(f"spans[{i}].name must be a non-empty "
+                            f"string")
+            if sp.get("ph") not in ("X", "i"):
+                errs.append(f"spans[{i}].ph must be 'X' or 'i', got "
+                            f"{sp.get('ph')!r}")
+            if not isinstance(sp.get("ts"), numbers.Number):
+                errs.append(f"spans[{i}].ts must be a number")
+            if isinstance(tid, str) and sp.get("trace_id") != tid:
+                errs.append(f"spans[{i}] belongs to trace "
+                            f"{sp.get('trace_id')!r}, record is {tid!r}")
+            sid = sp.get("span_id")
+            if not isinstance(sid, int) or isinstance(sid, bool) \
+                    or sid < 1:
+                errs.append(f"spans[{i}].span_id must be an int >= 1")
+                continue
+            if sid in seen:
+                errs.append(f"duplicate span_id {sid}")
+            seen.add(sid)
+            pid = sp.get("parent_id")
+            if pid is not None:
+                if not isinstance(pid, int) or isinstance(pid, bool):
+                    errs.append(f"spans[{i}].parent_id must be an int")
+                elif pid >= sid:
+                    errs.append(
+                        f"spans[{i}] (span_id {sid}) parents on "
+                        f"{pid}, which is not causally earlier")
+                elif pid not in all_ids:
+                    # a parent that is not in the record at all means
+                    # the chain's head was lost (e.g. evicted from a
+                    # bounded recorder): not a complete trace
+                    errs.append(
+                        f"spans[{i}] (span_id {sid}) parents on "
+                        f"{pid}, which is not in this record")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 def validate_telemetry_record(rec: Any) -> List[str]:
-    """Dispatching validator: graph-lint and fleet records (by
+    """Dispatching validator: graph-lint, fleet and trace records (by
     ``kind``) go through their own schemas, everything else through
     the bench schema — so one stream may interleave bench
-    measurements, lint findings (``bench.py --graph-lint``) and fleet
-    snapshots (``bench.py --fleet N``)."""
+    measurements, lint findings (``bench.py --graph-lint``), fleet
+    snapshots (``bench.py --fleet N``) and request traces
+    (``kind: trace``)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "fleet":
         return validate_fleet_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "trace":
+        return validate_trace_record(rec)
     return validate_bench_record(rec)
 
 
 def validate_telemetry_jsonl(lines: Iterable[str]) -> List[str]:
-    """Validate a mixed bench + graph-lint + fleet JSONL stream."""
+    """Validate a mixed bench + graph-lint + fleet + trace JSONL
+    stream."""
     return _validate_jsonl(lines, validate_telemetry_record)
 
 

@@ -137,6 +137,13 @@ class Histogram(_Metric):
         self._counts = [0] * (len(edges) + 1)
         self._sum = 0.0
         self._count = 0
+        # summary() memo, invalidated by every write: Engine.stats()
+        # builds five summaries per read and routers/fleets read stats
+        # far more often than engines observe — recomputing the
+        # bucket-walk quantiles per read was the PR 4 fleet-bench drag.
+        # _summary_computes counts actual recomputes (test pin).
+        self._summary_cache: Optional[Dict[str, Any]] = None
+        self._summary_computes = 0
 
     def _new_child(self):
         return Histogram(self.name, self.help, self.edges)
@@ -147,6 +154,7 @@ class Histogram(_Metric):
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            self._summary_cache = None
 
     def _restore(self, counts: Sequence[float], total: float):
         """Overwrite from externally-accumulated totals (DeviceMetrics
@@ -159,6 +167,7 @@ class Histogram(_Metric):
             self._counts = [int(c) for c in counts]
             self._count = sum(self._counts)
             self._sum = float(total)
+            self._summary_cache = None
 
     @property
     def count(self) -> int:
@@ -180,30 +189,42 @@ class Histogram(_Metric):
             out["+Inf"] = acc + self._counts[-1]
             return out
 
+    def _percentile_locked(self, q: float) -> Optional[float]:
+        # caller holds self._lock
+        if self._count == 0:
+            return None
+        target = q * self._count
+        acc, lo = 0.0, 0.0
+        for e, c in zip(self.edges, self._counts):
+            if acc + c >= target and c > 0:
+                frac = (target - acc) / c
+                return lo + frac * (e - lo)
+            acc += c
+            lo = e
+        return self.edges[-1]
+
     def percentile(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile estimate (q in [0, 1]); None when
         empty.  Values past the last edge clamp to it — fixed buckets
         cannot resolve the overflow tail."""
         with self._lock:
-            if self._count == 0:
-                return None
-            target = q * self._count
-            acc, lo = 0.0, 0.0
-            for e, c in zip(self.edges, self._counts):
-                if acc + c >= target and c > 0:
-                    frac = (target - acc) / c
-                    return lo + frac * (e - lo)
-                acc += c
-                lo = e
-            return self.edges[-1]
+            return self._percentile_locked(q)
 
     def summary(self) -> Dict[str, Any]:
+        """{count, sum, mean, p50, p99}.  Memoized between writes: a
+        read-heavy consumer (``Engine.stats()`` under a fleet router)
+        pays the two bucket walks once per observation, not once per
+        read."""
         with self._lock:
-            count, total = self._count, self._sum
-        return {"count": count, "sum": total,
-                "mean": (total / count) if count else None,
-                "p50": self.percentile(0.5),
-                "p99": self.percentile(0.99)}
+            if self._summary_cache is None:
+                count, total = self._count, self._sum
+                self._summary_cache = {
+                    "count": count, "sum": total,
+                    "mean": (total / count) if count else None,
+                    "p50": self._percentile_locked(0.5),
+                    "p99": self._percentile_locked(0.99)}
+                self._summary_computes += 1
+            return dict(self._summary_cache)
 
 
 class MetricsRegistry:

@@ -1,0 +1,194 @@
+"""Step-time attribution: where a distributed train step's wall time
+goes — compute vs gradient communication, per fabric level.
+
+``bench.py --comm`` (PR 5) reports on-wire *bytes* per level; ROADMAP
+item 2 (overlap gradient comm with backward compute) gates on
+*step-time* improving, which needs the decomposition this module
+measures.  The method is **blocked-fetch differential timing**, run
+entirely OFF the jitted hot path:
+
+- three separately-jitted programs are timed with a hard
+  device-to-host fetch as the completion barrier (the same discipline
+  ``bench.timed`` uses — ``block_until_ready`` can return early on
+  tunneled device platforms, a D2H fetch cannot): the **full step**
+  (compute + collectives), its **compute twin** (identical step with
+  the gradient allreduce elided — ``DistributedDataParallel.
+  comm_enabled = False`` builds it from the same step function), and
+  the **isolated comm program** (just the allreduce on grads-shaped
+  buffers);
+- nothing is inserted into any jitted graph — no callbacks, no
+  timers, no extra host transfers — so the pinned zero-host-transfer
+  audit (tests/test_step_graph_audit.py) holds with attribution
+  enabled by construction.
+
+The decomposition::
+
+    comm_ms    = max(step_ms - compute_ms, 0)      # comm on the critical path
+    overlap    = 1 - comm_ms / comm_isolated_ms    # clamped to [0, 1]
+
+``overlap_fraction`` is the share of the isolated comm time the
+compiler hid under compute.  With today's reduce-everything-after-
+backward schedule it measures ~0.0 — the baseline the overlap work
+must beat.  ``compute_ms + comm_ms == step_ms`` by construction (up to
+the clamp), which is the wall-clock consistency
+``exporters.validate_bench_record`` pins on attribution records.
+
+Per-level attribution takes the ICI/DCN labels from
+``parallel.allreduce_comm_plan``: the measured comm time is split
+across buckets by wire bytes and within a bucket by its
+``ici_wire_bytes`` / ``dcn_wire_bytes`` (a flat bucket is one fabric —
+its time reports under ``ici``; the hierarchical topology is what
+makes the ``dcn`` column meaningful).  Pass ``ici_step=`` (a jitted
+program running only the in-slice collectives) to replace the
+byte-proportional level split with a measured one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["blocked_time", "attribute_step", "ATTRIBUTION_FIELDS"]
+
+# the fields every step-attribution bench record must carry
+# (exporters.validate_bench_record keys its checks off
+# ``overlap_fraction``)
+ATTRIBUTION_FIELDS = ("step_ms", "compute_ms", "comm_ms",
+                      "comm_isolated_ms", "overlap_fraction",
+                      "ici_ms", "dcn_ms")
+
+
+def _block(out) -> None:
+    """Hard completion barrier: one D2H fetch of an output leaf.  A
+    fetch cannot complete before the dispatched program finishes; see
+    the module docstring for why ``block_until_ready`` is not used."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        float(jnp.sum(leaves[0]).astype(jnp.float32))
+
+
+def blocked_time(fn: Callable, *args, iters: int = 10,
+                 warmup: int = 2) -> float:
+    """Mean seconds per call of ``fn(*args)`` over ``iters`` timed
+    calls after ``warmup`` untimed ones (compile + cache warm), with
+    the blocked-fetch barrier before starting and after the last
+    call."""
+    if iters < 1 or warmup < 0:
+        raise ValueError(f"need iters >= 1 and warmup >= 0, got "
+                         f"iters={iters}, warmup={warmup}")
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    # barrier BEFORE t0 either way: with warmup=0 there is no output
+    # to fetch yet, so drain in-flight transfers of the inputs instead
+    # — otherwise previously dispatched async work lands inside the
+    # timed window
+    _block(out if warmup else args)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _bucket_level_bytes(bucket: Dict[str, Any]):
+    """(ici_bytes, dcn_bytes) attribution weights for one comm-plan
+    bucket.  Hierarchical buckets split by the plan's per-level wire
+    bytes (which sum to the bucket's total); a flat bucket is a single
+    fabric, so its whole payload weighs on the ``ici`` column."""
+    if bucket.get("topology") == "hierarchical":
+        return (float(bucket["ici_wire_bytes"]),
+                float(bucket["dcn_wire_bytes"]))
+    b = float(bucket.get("wire_bytes", bucket.get("bytes", 0)))
+    return b, 0.0
+
+
+def attribute_step(full_step: Callable, compute_step: Callable,
+                   comm_step: Callable, args: Sequence[Any] = (),
+                   plan: Optional[List[dict]] = None,
+                   iters: int = 10, warmup: int = 2,
+                   ici_step: Optional[Callable] = None
+                   ) -> Dict[str, Any]:
+    """Measure and decompose one train step (see module docstring).
+
+    ``full_step`` / ``compute_step`` / ``comm_step`` (and the optional
+    ``ici_step``) are called as ``fn(*args)``; each should be its own
+    jitted program over the SAME shapes.  ``plan`` is the
+    ``parallel.allreduce_comm_plan`` of the step's gradient reduction;
+    without one the comm time reports as a single unlabeled bucket on
+    the ``ici`` column.
+
+    Returns the attribution dict (all times in ms)::
+
+        {step_ms, compute_ms, comm_ms, comm_isolated_ms,
+         overlap_fraction, ici_ms, dcn_ms, buckets: [...]}
+    """
+    step_ms = blocked_time(full_step, *args, iters=iters,
+                           warmup=warmup) * 1e3
+    compute_ms = blocked_time(compute_step, *args, iters=iters,
+                              warmup=warmup) * 1e3
+    comm_isolated_ms = blocked_time(comm_step, *args, iters=iters,
+                                    warmup=warmup) * 1e3
+    comm_ms = max(step_ms - compute_ms, 0.0)
+    if comm_isolated_ms > 0.0:
+        overlap = 1.0 - comm_ms / comm_isolated_ms
+    else:
+        overlap = 0.0
+    overlap = min(max(overlap, 0.0), 1.0)
+
+    # per-level split of the measured comm time, labeled from the plan
+    buckets = list(plan) if plan else [{"topology": "flat",
+                                        "wire_bytes": 1}]
+    weights = [_bucket_level_bytes(b) for b in buckets]
+    total_w = sum(i + d for i, d in weights)
+    if total_w <= 0.0:
+        # a plan whose buckets carry no recognized byte weight cannot
+        # label the split — fall back to the single-fabric default
+        # (everything on the first bucket's ici column) so ici+dcn
+        # still reassembles comm_isolated_ms and the record passes its
+        # own schema
+        weights = [(1.0, 0.0)] + [(0.0, 0.0)] * (len(weights) - 1)
+        total_w = 1.0
+    if ici_step is not None:
+        ici_total = min(blocked_time(ici_step, *args, iters=iters,
+                                     warmup=warmup) * 1e3,
+                        comm_isolated_ms)
+        dcn_total = comm_isolated_ms - ici_total
+        iw = sum(i for i, _ in weights)
+        dw = sum(d for _, d in weights)
+        # a level with zero byte weight cannot absorb measured time —
+        # fold the residue into the other level instead of dropping it
+        # (a single-fabric plan with a measured ici_step residual
+        # would otherwise emit ici+dcn < comm_isolated and fail the
+        # schema's reassembly check)
+        if dw == 0.0:
+            ici_total, dcn_total = comm_isolated_ms, 0.0
+        elif iw == 0.0:
+            ici_total, dcn_total = 0.0, comm_isolated_ms
+        # distribute each measured level over buckets by that level's
+        # bytes
+        split = [(ici_total * i / (iw or 1.0),
+                  dcn_total * d / (dw or 1.0)) for i, d in weights]
+    else:
+        split = [(comm_isolated_ms * i / total_w,
+                  comm_isolated_ms * d / total_w) for i, d in weights]
+
+    out_buckets = []
+    for b, (ici_ms, dcn_ms) in zip(buckets, split):
+        rec = {"ici_ms": round(ici_ms, 4), "dcn_ms": round(dcn_ms, 4)}
+        for k in ("comm_dtype", "elements", "topology", "cause",
+                  "ici_wire_bytes", "dcn_wire_bytes", "wire_bytes"):
+            if k in b:
+                rec[k] = b[k]
+        out_buckets.append(rec)
+
+    return {"step_ms": round(step_ms, 4),
+            "compute_ms": round(compute_ms, 4),
+            "comm_ms": round(comm_ms, 4),
+            "comm_isolated_ms": round(comm_isolated_ms, 4),
+            "overlap_fraction": round(overlap, 4),
+            "ici_ms": round(sum(i for i, _ in split), 4),
+            "dcn_ms": round(sum(d for _, d in split), 4),
+            "buckets": out_buckets}

@@ -619,6 +619,13 @@ class DistributedDataParallel:
         # bucket of the most recently traced allreduce — see
         # allreduce_grads_tree(comm_stats=...)
         self.last_comm_stats: list = []
+        # comm_enabled=False builds the COMPUTE TWIN of a step for
+        # step-time attribution (observability.steptime): the gradient
+        # collectives are elided while the local average a psum would
+        # have applied stays, so the twin's per-element work matches
+        # the full step minus the wire.  Numerically it trains on
+        # local gradients — a measurement device, not a training mode.
+        self.comm_enabled = True
 
     # -- forward passthrough (wrapper parity) ------------------------------
     def __call__(self, *args, **kwargs):
@@ -634,6 +641,18 @@ class DistributedDataParallel:
     def allreduce_grads(self, grads: Any,
                         axis_index_groups: Optional[List[List[int]]] = None
                         ) -> Any:
+        if not self.comm_enabled:
+            self.last_comm_stats = []
+            if self.gradient_average and not self.adasum:
+                # static axis size, NOT _axis_size (a psum): the twin
+                # must trace to a collective-free graph or the
+                # decomposition measures comm it claims to elide
+                world = int(lax.axis_size(self.axis_name))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / jnp.asarray(world, g.dtype)
+                    if jnp.issubdtype(g.dtype, jnp.floating) else g,
+                    grads)
+            return grads
         if self.adasum:
             from .adasum import adasum_grads
             if axis_index_groups is not None:

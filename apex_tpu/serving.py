@@ -71,6 +71,12 @@ from jax import lax
 
 from .models.speculative import _head_logits
 from .observability import MetricsRegistry
+# ambient-gated spans: these record ONLY when a distributed-trace
+# context is active on the calling thread (a fleet dispatching a traced
+# request), so a standalone engine pays one contextvar read per call
+# and its process recorder never grows — and nothing here touches the
+# jitted graphs, so the zero-host-transfer audit is unaffected.
+from .observability.tracing import maybe_event, maybe_span
 
 __all__ = ["Engine", "Seq2SeqEngine", "DONATION_BLOCKLIST",
            "STEP_K_ARG_NAMES", "PREFILL_SLOT_ARG_NAMES",
@@ -183,7 +189,11 @@ class _SlotScheduler:
         times the prefill/seed, stamps the request's lifecycle
         timestamps, and feeds the admission histograms."""
         t0 = self._clock()
-        self._admit(rid, *rest)
+        # engine_rid, not rid: these spans land inside FLEET request
+        # traces whose rid attrs are fleet ids — the replica-local id
+        # is a different namespace and must not join against them
+        with maybe_span("engine_prefill", engine_rid=rid):
+            self._admit(rid, *rest)
         t1 = self._clock()
         self._m_prefill.observe(t1 - t0)
         self._m_admitted.inc()
@@ -316,6 +326,8 @@ class _SlotScheduler:
         self._waiting.append((rid, list(prompt), max_new_tokens,
                               eos_token_id, seed, temperature))
         self._set_queue_gauge()
+        maybe_event("engine_queue", engine_rid=rid,
+                    queue_depth=len(self._waiting))
         return rid
 
     def _set_queue_gauge(self):
@@ -890,28 +902,33 @@ class Engine(_SlotScheduler):
             return {}
         t0 = self._clock()
         live = list(self._by_slot)
-        if self.draft is not None:
-            old_len = np.asarray(self.cur_len)
-            (self.ids, self.cur_len, self.cache,
-             self.d_cache) = self._sstep(self.ids, self.cur_len,
-                                         self.limit, self.cache,
-                                         self.d_cache)
-            new_len = np.asarray(self.cur_len)
-            rows = np.asarray(self.ids)
-            emitted = {slot: [int(t) for t in
-                              rows[slot, old_len[slot]:new_len[slot]]]
-                       for slot in self._by_slot}
-        else:
-            (self.ids, self.cur_len, self.cache, self._slot_keys,
-             toks, valid) = self._step_k(self.ids, self.cur_len,
-                                         self.cache, self._slot_keys,
-                                         self._slot_temp, self.limit,
-                                         self._eos)
-            # THE host sync: one fetch per window, not per token
-            toks_h, valid_h = jax.device_get((toks, valid))
-            emitted = {slot: [int(t) for t, v
-                              in zip(toks_h[slot], valid_h[slot]) if v]
-                       for slot in live}
+        with maybe_span("engine_window_decode", window=self.window,
+                        live=len(live)):
+            if self.draft is not None:
+                old_len = np.asarray(self.cur_len)
+                (self.ids, self.cur_len, self.cache,
+                 self.d_cache) = self._sstep(self.ids, self.cur_len,
+                                             self.limit, self.cache,
+                                             self.d_cache)
+                new_len = np.asarray(self.cur_len)
+                rows = np.asarray(self.ids)
+                emitted = {slot: [int(t) for t in
+                                  rows[slot,
+                                       old_len[slot]:new_len[slot]]]
+                           for slot in self._by_slot}
+            else:
+                (self.ids, self.cur_len, self.cache, self._slot_keys,
+                 toks, valid) = self._step_k(self.ids, self.cur_len,
+                                             self.cache,
+                                             self._slot_keys,
+                                             self._slot_temp,
+                                             self.limit, self._eos)
+                # THE host sync: one fetch per window, not per token
+                toks_h, valid_h = jax.device_get((toks, valid))
+                emitted = {slot: [int(t) for t, v
+                                  in zip(toks_h[slot], valid_h[slot])
+                                  if v]
+                           for slot in live}
         return self._harvest(emitted, t0)
 
     def _out_of_budget(self, req):
@@ -1055,13 +1072,16 @@ class Seq2SeqEngine(_SlotScheduler):
             return {}
         t0 = self._clock()
         live = list(self._by_slot)
-        (self.state, self.out, self.n_new, toks, valid) = self._step_k(
-            self.state, self.out, self.n_new, self.s_limit, self._eos)
-        # THE host sync: one fetch per window, not per token
-        toks_h, valid_h = jax.device_get((toks, valid))
-        emitted = {slot: [int(t) for t, v
-                          in zip(toks_h[slot], valid_h[slot]) if v]
-                   for slot in live}
+        with maybe_span("engine_window_decode", window=self.window,
+                        live=len(live)):
+            (self.state, self.out, self.n_new, toks,
+             valid) = self._step_k(self.state, self.out, self.n_new,
+                                   self.s_limit, self._eos)
+            # THE host sync: one fetch per window, not per token
+            toks_h, valid_h = jax.device_get((toks, valid))
+            emitted = {slot: [int(t) for t, v
+                              in zip(toks_h[slot], valid_h[slot]) if v]
+                       for slot in live}
         return self._harvest(emitted, t0)
 
     def _out_of_budget(self, req):

@@ -486,6 +486,66 @@ def main():
                  note="flat DCN bytes / hierarchical DCN bytes, "
                       "asserted == ici_size from the comm plan")
 
+        # step-time attribution (observability.steptime): decompose the
+        # same DDP train step into compute vs comm time per fabric
+        # level — ROADMAP item 2 gates on these numbers, not bytes.
+        # Three separately-jitted programs per topology (full step,
+        # compute twin via DistributedDataParallel.comm_enabled=False,
+        # isolated allreduce), all timed OFF the jitted hot path with
+        # the same blocked-fetch barrier as timed() — nothing lands in
+        # any jitted graph, so the zero-host-transfer audit holds.
+        from apex_tpu.observability import steptime
+
+        def make_attr_step(topo, compress, comm_enabled=True):
+            ddp = parallel.DistributedDataParallel(
+                comm_topology=topo,
+                allreduce_compress_bf16=compress,
+                ici_size=ici if topo == "hierarchical" else None)
+            ddp.comm_enabled = comm_enabled
+
+            def step(state, batch):
+                # a real (if small) compute phase, so the twin
+                # subtraction has something to subtract FROM
+                g = {"g": state[0] * batch[0][0, 0]
+                          + jnp.tanh(state[0])}
+                out = ddp.allreduce_grads(g)
+                return (out["g"],), jnp.sum(out["g"][:8])
+            return sharded(step)
+
+        def make_comm_only(topo, compress):
+            def step(state, batch):
+                out = parallel.allreduce_grads_tree(
+                    {"g": state[0]}, "data", comm_topology=topo,
+                    allreduce_compress_bf16=compress,
+                    ici_size=ici if topo == "hierarchical" else None)
+                return (out["g"],), jnp.sum(out["g"][:8])
+            return sharded(step)
+
+        attr_args = ((buf,),
+                     (jnp.ones((ndev, 1)), jnp.zeros((ndev, 1))))
+        for name, topo, compress in variants:
+            b = plans[name]
+            att = steptime.attribute_step(
+                make_attr_step(topo, compress),
+                make_attr_step(topo, compress, comm_enabled=False),
+                make_comm_only(topo, compress),
+                args=attr_args, plan=[b], iters=10, warmup=2)
+            emit(metric=f"train_step_attribution_{name}",
+                 value=att["step_ms"], unit="ms", vs_baseline=None,
+                 comm_topology=b["topology"], compress=compress,
+                 ici_size=b["ici_size"], dcn_size=b["dcn_size"],
+                 wire_bytes=b["wire_bytes"],
+                 ici_wire_bytes=b["ici_wire_bytes"],
+                 dcn_wire_bytes=b["dcn_wire_bytes"],
+                 **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+                 note="blocked-fetch step decomposition; "
+                      "overlap_fraction ~0.0 is today's reduce-after-"
+                      "backward baseline, the number ROADMAP item 2 "
+                      "(comm/compute overlap) must raise"
+                      + ("; CPU mesh: all fabrics share one memory "
+                         "bus, level split is byte-proportional"
+                         if not on_tpu else ""))
+
     if comm_flag and not fleet_n:
         run_comm_bench()
         # --graph-lint (if also passed) already ran and still gates

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""CI gate: machine-check the BENCH_r*.json trajectory.
+
+The per-round bench artifacts wrap a JSONL ``tail`` of schema-versioned
+records (tests/ci/check_bench_schema.py validates each record's shape;
+THIS gate validates the trend ACROSS rounds).  Two failure classes:
+
+1. **Unmarked replay.**  A wedged TPU tunnel makes bench replay the
+   last known hardware record with ``stale: true`` — by design those
+   lines must never read as fresh progress.  A line that carries a
+   definitive replay fingerprint (the ``TPU_TUNNEL_WEDGED...`` flag in
+   the same round, or a "STALE REPLAY" note) but is NOT marked
+   ``stale: true`` is a replay presented as a fresh measurement:
+   error.  A byte-identical accelerator record from an earlier round
+   is only *suspicious* — stable hardware can honestly reproduce a
+   rounded value — so it WARNS instead of gating (and still never
+   counts as an improvement over the earlier line, which it equals).
+2. **Fresh regression.**  Consecutive FRESH measurements of the same
+   (metric, backend) that got worse by more than ``--tol`` (default
+   25%): error on accelerator backends.  CPU-smoke lines live on a
+   shared noisy container where run-to-run swings of several x are
+   routine (fused_lamb_step_time moved 4.7x between r03 and r04 with
+   no code change on that path), so CPU regressions are REPORTED as
+   warnings but do not gate — the byte/plan fields and the tier-1
+   suite are the portable CPU signals, hardware lines are the timing
+   signal.  ``--strict-cpu`` promotes them to errors.
+
+Stale replays are partitioned out of the trend entirely: a replay can
+neither regress nor improve a metric (r04/r05's 1830 img/s replays do
+not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
+measured anything).  Error lines (``value: null`` + ``error``) and
+flag/summary records are likewise excluded.
+
+Usage::
+
+    python tests/ci/check_bench_trend.py                 # repo root
+    python tests/ci/check_bench_trend.py --dir /path     # other history
+    python tests/ci/check_bench_trend.py --tol 0.4
+    python tests/ci/check_bench_trend.py --strict-cpu
+
+Exit 0 = trend clean (warnings allowed), 1 = any error.  Pure stdlib —
+importable from CI without jax.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), os.pardir, os.pardir))
+
+WEDGE_FLAG = "TPU_TUNNEL_WEDGED_NO_FRESH_HARDWARE_NUMBERS"
+REPLAY_NOTE_MARKERS = ("STALE REPLAY", "stale replay", "replayed because")
+# units where a LOWER value is better (times); anything else is a
+# rate/ratio where higher is better
+LOWER_IS_BETTER_UNITS = {"ms", "s", "us", "ns", "seconds"}
+
+
+def load_rounds(directory):
+    """[(round_name, [records])] in round order.  Each BENCH_r*.json is
+    the runbook wrapper {n, cmd, rc, tail, parsed}; ``tail`` holds the
+    run's last stdout bytes, so its FIRST line may be truncated —
+    unparseable lines are skipped, complete JSONL records kept."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trend: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        recs = []
+        for ln in str(doc.get("tail", "")).splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue            # stderr chatter / '# buffered:'
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue            # truncated head of the tail
+            if isinstance(rec, dict):
+                recs.append(rec)
+        rounds.append((os.path.basename(path), recs))
+    return rounds
+
+
+def is_measurement(rec):
+    """Fresh-or-stale numeric metric line (what the trend is made of):
+    excludes error lines, flags, and non-metric kinds (fleet / trace /
+    graph_lint records interleave in the same streams)."""
+    if "kind" in rec and rec.get("kind") not in (None, "bench"):
+        return False
+    v = rec.get("value")
+    return (isinstance(rec.get("metric"), str)
+            and rec["metric"] != WEDGE_FLAG
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            and "error" not in rec
+            and rec.get("unit") != "flag")
+
+
+def is_stale(rec):
+    return rec.get("stale") is True
+
+
+def is_cpu(rec):
+    # pre-envelope records (r01/r02) carry no backend field; they were
+    # fresh measurements on whatever ran — treat unknown as gating
+    # (nothing in the real history compares across the unknown key)
+    return rec.get("backend") == "cpu"
+
+
+def _replay_fingerprint(rec, round_has_wedge_flag, earlier_lines):
+    """(kind, why) when this line looks like a replay, else None.
+    kind "error" = definitive fingerprint (gates); kind "warning" =
+    byte-identical re-emission, which stable hardware can honestly
+    produce at rounded precision, so it only warns."""
+    note = str(rec.get("note", ""))
+    for marker in REPLAY_NOTE_MARKERS:
+        if marker in note:
+            return "error", f"note contains {marker!r}"
+    if round_has_wedge_flag and not is_cpu(rec):
+        return "error", f"round carries the {WEDGE_FLAG} flag"
+    if not is_cpu(rec):
+        # the replay path re-emits the record verbatim; a fresh
+        # re-measurement USUALLY differs in its timed value, but can
+        # legitimately repeat at 1-decimal rounding.  (CPU smoke lines
+        # repeat all the time and are exempt.)
+        key = json.dumps({k: v for k, v in rec.items()
+                          if k not in ("stale", "schema_version",
+                                       "host")}, sort_keys=True)
+        if key in earlier_lines:
+            return "warning", ("byte-identical to an earlier round's "
+                               "record")
+    return None
+
+
+def direction(rec):
+    unit = str(rec.get("unit", ""))
+    if unit in LOWER_IS_BETTER_UNITS:
+        return "lower"
+    return "higher"
+
+
+def check(directory, tol=0.25, strict_cpu=False, out=sys.stderr):
+    rounds = load_rounds(directory)
+    if not rounds:
+        print(f"trend: no BENCH_r*.json under {directory}", file=out)
+        return 1
+    errors, warnings = [], []
+    # (metric, backend) -> (round_name, value, unit) of last FRESH line
+    last_fresh = {}
+    earlier_lines = set()
+    n_fresh = n_stale = 0
+    for rname, recs in rounds:
+        wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
+        for rec in recs:
+            if not is_measurement(rec):
+                continue
+            if is_stale(rec):
+                n_stale += 1
+                continue              # replays never enter the trend
+            fp = _replay_fingerprint(rec, wedged, earlier_lines)
+            if fp is not None:
+                kind, why = fp
+                msg = (f"{rname}: {rec['metric']}={rec['value']} is a "
+                       f"replay presented as fresh ({why}) — replays "
+                       f"must carry stale: true and never count as "
+                       f"progress")
+                if kind == "error":
+                    errors.append(msg)
+                else:
+                    warnings.append(msg + " [suspicious, not "
+                                    "definitive: warning only]")
+                # either way the line never enters the trend — a
+                # byte-identical repeat cannot count as progress (it
+                # equals the earlier line) and must not reset the
+                # fresh baseline if it IS a replay
+                continue
+            n_fresh += 1
+            key = (rec["metric"], rec.get("backend"))
+            prev = last_fresh.get(key)
+            if prev is not None:
+                pname, pval, _ = prev
+                val = float(rec["value"])
+                if pval > 0 and val > 0:
+                    # relative-to-previous in BOTH directions, so the
+                    # printed percent is the actual worsening and the
+                    # effective tolerance doesn't depend on whether
+                    # the metric is a time or a rate
+                    if direction(rec) == "lower":
+                        change = (val - pval) / pval  # + = slower = worse
+                    else:
+                        change = (pval - val) / pval  # + = less = worse
+                    if change > tol:
+                        msg = (f"{rname}: {rec['metric']} "
+                               f"[{rec.get('backend') or '?'}] "
+                               f"regressed {change * 100:.0f}% vs "
+                               f"{pname} ({pval} -> {val} "
+                               f"{rec.get('unit')}, tol "
+                               f"{tol * 100:.0f}%)")
+                        if is_cpu(rec) and not strict_cpu:
+                            warnings.append(msg + " [cpu smoke: "
+                                            "warning only]")
+                        else:
+                            errors.append(msg)
+            last_fresh[key] = (rname, float(rec["value"]),
+                               rec.get("unit"))
+        # rounds are ordered: everything in THIS round is "earlier"
+        # for the next one
+        for rec in recs:
+            if is_measurement(rec):
+                earlier_lines.add(json.dumps(
+                    {k: v for k, v in rec.items()
+                     if k not in ("stale", "schema_version", "host")},
+                    sort_keys=True))
+    for w in warnings:
+        print(f"trend WARNING: {w}", file=out)
+    for e in errors:
+        print(f"trend ERROR: {e}", file=out)
+    print(f"trend: {len(rounds)} rounds, {n_fresh} fresh measurements "
+          f"counted, {n_stale} stale replays partitioned out, "
+          f"{len(warnings)} warnings, {len(errors)} errors", file=out)
+    return 1 if errors else 0
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=_ROOT,
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root)")
+    ap.add_argument("--tol", type=float, default=0.25,
+                    help="fresh-vs-fresh regression tolerance "
+                         "(fraction, default 0.25)")
+    ap.add_argument("--strict-cpu", action="store_true",
+                    help="gate CPU-smoke regressions too (default: "
+                         "warn only — the shared CPU host is noisy)")
+    args = ap.parse_args(argv[1:])
+    if args.tol < 0:
+        ap.error(f"--tol must be >= 0, got {args.tol}")
+    return check(args.dir, tol=args.tol, strict_cpu=args.strict_cpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

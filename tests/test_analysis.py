@@ -496,8 +496,9 @@ def test_lint_summary_schema():
 
 def test_telemetry_jsonl_validates_mixed_stream():
     """One stream may interleave bench records, lint findings
-    (bench.py --graph-lint) and fleet snapshots (bench.py --fleet N);
-    the dispatching validator checks each against its own schema."""
+    (bench.py --graph-lint), fleet snapshots (bench.py --fleet N) and
+    request traces; the dispatching validator checks each against its
+    own schema."""
     import json
     bench_rec = exporters.JsonlExporter.enrich(
         {"metric": "engine_decode", "value": 100.0,
@@ -506,13 +507,27 @@ def test_telemetry_jsonl_validates_mixed_stream():
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
     fleet_rec = exporters.JsonlExporter.enrich(
-        {"kind": "fleet", "replicas": 2, "policy": "least_loaded",
+        {"kind": "fleet", "trace_id": "fleet-1f-1",
+         "replicas": 2, "policy": "least_loaded",
          "healthy": 1, "degraded": 0, "dead": 1, "queue_depth": 0,
          "submitted": 8, "finished": 8, "failed": 0, "shed": 0,
          "retries": 1, "failovers": 3, "drains": 0, "tokens": 64})
+    trace_rec = exporters.JsonlExporter.enrich(
+        {"kind": "trace", "trace_id": "fleet-1f-1/r0", "span_count": 2,
+         "spans": [{"name": "fleet_submit", "ph": "i", "ts": 1.0,
+                    "span_id": 1, "trace_id": "fleet-1f-1/r0"},
+                   {"name": "fleet_result", "ph": "i", "ts": 9.0,
+                    "span_id": 2, "parent_id": 1,
+                    "trace_id": "fleet-1f-1/r0"}]})
     lines = [json.dumps(bench_rec), json.dumps(lint_rec),
-             json.dumps(fleet_rec)]
+             json.dumps(fleet_rec), json.dumps(trace_rec)]
     assert exporters.validate_telemetry_jsonl(lines) == []
+    # a trace violation is kind-dispatched and caught positionally
+    trace_bad = dict(trace_rec, span_count=9)
+    errs = exporters.validate_telemetry_jsonl(
+        [json.dumps(bench_rec), json.dumps(trace_bad)])
+    assert len(errs) == 1 and "line 2" in errs[0] \
+        and "span_count" in errs[0]
     # a lint violation is caught positionally
     lint_rec2 = dict(lint_rec, message="")
     lines = [json.dumps(bench_rec), json.dumps(lint_rec2),

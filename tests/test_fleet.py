@@ -9,6 +9,8 @@ against a jax-free stub replica wrapped by the seeded fault harness —
 every schedule is exact and instant; the exactness and prefix-affinity
 contracts run against real Engines on the tiny GPT config."""
 
+import json
+
 import numpy as np
 import pytest
 import jax
@@ -20,9 +22,11 @@ from apex_tpu.fleet import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                             HealthConfig, LeastLoaded, PrefixAffinity,
                             ReplicaFault, RetryPolicy, RoundRobin,
                             make_policy)
+from apex_tpu import observability as obs
 from apex_tpu.observability.exporters import (JsonlExporter,
                                               validate_fleet_record,
-                                              validate_telemetry_record)
+                                              validate_telemetry_record,
+                                              validate_trace_record)
 
 
 # -- jax-free stub replica: the scheduler surface, deterministic tokens ---
@@ -178,7 +182,8 @@ def test_backpressure_bounded_queue_sheds():
     """The fleet queue is BOUNDED: overflow raises the retriable
     FleetOverloaded instead of growing some _waiting list forever."""
     fl = Fleet([_StubReplica(slots=1)], max_queue=2,
-               replica_queue_cap=0, step_workers=1)
+               replica_queue_cap=0, step_workers=1,
+               ring=obs.EventRing(capacity=64))
     fl.submit([1], max_new_tokens=50)
     fl.step()                            # occupy the only slot
     fl.submit([1, 2], max_new_tokens=1)  # queued (fleet level)
@@ -186,14 +191,52 @@ def test_backpressure_bounded_queue_sheds():
     with pytest.raises(FleetOverloaded) as ei:
         fl.submit([1, 2, 3, 4], max_new_tokens=1)
     assert ei.value.queue_depth == 2 and ei.value.max_queue == 2
+    # sustained overload is ONE ring episode, not one event per
+    # rejected submit — the counter carries the volume while the
+    # bounded ring keeps room for breaker/failover history
+    for _ in range(5):
+        with pytest.raises(FleetOverloaded):
+            fl.submit([9], max_new_tokens=1)
     s = fl.stats()
-    assert s["shed"] == 1 and s["queue_depth"] == 2
-    assert fl.metrics.counter("fleet_shed_total").value == 1.0
+    assert s["shed"] == 6 and s["queue_depth"] == 2
+    assert fl.metrics.counter("fleet_shed_total").value == 6.0
+    assert len(fl.ring.snapshot("shed")) == 1
     # shed is retriable: capacity comes back as requests finish
     _drive(fl)
-    fl.submit([1, 2, 3, 4], max_new_tokens=1)
+    fl.submit([1, 2, 3, 4], max_new_tokens=1)  # admitted: episode ends
     _drive(fl)
     assert fl.stats()["failed"] == 0
+    # a NEW overload after an admitted submit is a NEW episode
+    fl.submit([1], max_new_tokens=50)
+    fl.step()
+    fl.submit([1, 2], max_new_tokens=1)
+    fl.submit([1, 2, 3], max_new_tokens=1)
+    with pytest.raises(FleetOverloaded):
+        fl.submit([7, 7], max_new_tokens=1)
+    assert len(fl.ring.snapshot("shed")) == 2
+
+
+def test_default_ring_resolves_per_append_across_set_ring_swap():
+    """A fleet built WITHOUT an explicit ring follows obs.set_ring
+    swaps: every producer (fleet events, breaker notes, injected
+    faults) resolves the process ring per append, so one swap moves
+    the WHOLE story to the new ring instead of splitting it."""
+    rep = FaultyReplica(_StubReplica(), raise_on_step=(0, 1))
+    fl = Fleet([rep, _StubReplica()], policy="round_robin",
+               health=HealthConfig(dead_consecutive=1,
+                                   cooldown_steps=100),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0),
+               step_workers=1)
+    fresh = obs.EventRing(capacity=64)
+    prev = obs.set_ring(fresh)
+    try:
+        fl.submit([1, 2], max_new_tokens=2)
+        _drive(fl)
+        assert fl.stats()["failovers"] == 1
+        kinds = {e["kind"] for e in fresh.snapshot()}
+        assert {"fault_injected", "failover", "breaker_open"} <= kinds
+    finally:
+        obs.set_ring(prev)
 
 
 def test_dispatch_retry_backoff_then_success():
@@ -418,7 +461,8 @@ def test_deadline_exceeded_fails_pending_and_inflight():
     t = [0.0]
     stub = _StubReplica(slots=2)
     fl = Fleet([stub], clock=lambda: t[0],
-               replica_queue_cap=0, step_workers=1)
+               replica_queue_cap=0, step_workers=1,
+               ring=obs.EventRing(capacity=64))
     slow = fl.submit([1], max_new_tokens=100)
     fl.step()                            # occupies slot 0
     # submission order: `inflight` grabs the last slot, `queued` stays
@@ -441,6 +485,13 @@ def test_deadline_exceeded_fails_pending_and_inflight():
     assert stub.live() == 1              # cancelled off the replica
     assert fl.stats()["deadline_exceeded"] == 2
     assert fl.status(slow) == "inflight"  # no deadline: untouched
+    # ring events aggregate per sweep (one per _check_deadlines pass
+    # that expired anything), with the counter carrying the volume —
+    # a deadline storm must not wheel the ring
+    evs = fl.ring.snapshot("deadline_exceeded")
+    assert len(evs) == 2                 # two sweeps expired something
+    assert [e["count"] for e in evs] == [1, 1]
+    assert evs[0]["rids"] == [queued] and evs[1]["rids"] == [inflight]
     with pytest.raises(KeyError):
         fl.status(12345)
 
@@ -470,6 +521,16 @@ def test_fleet_record_schema_and_gauges():
     assert validate_fleet_record({**rec, "finished": 9})  # > submitted
     assert validate_fleet_record(
         {k: v for k, v in rec.items() if k != "shed"})
+    # trace_id is a schema-v2 requirement: missing at v2 errors, but
+    # an archived v1 record (pre-flight-recorder) re-validates clean
+    assert any("trace_id" in e for e in validate_fleet_record(
+        {k: v for k, v in rec.items() if k != "trace_id"}))
+    assert validate_fleet_record(
+        {k: v for k, v in rec.items()
+         if k != "trace_id"} | {"schema_version": 1}) == []
+    # a malformed schema_version reports, never raises
+    assert validate_fleet_record({**rec, "schema_version": None})
+    assert validate_fleet_record({**rec, "schema_version": "2"})
     # per-replica labeled gauges exist and carry the final state
     st = fl.metrics.gauge("fleet_replica_state_code")
     assert set(st.children()) == {(("replica", "0"),),
@@ -666,3 +727,160 @@ def test_cancel_frees_slot_and_queued_requests_still_run():
     assert e.result(rb) == _solo(m, params, pb, 4)
     with pytest.raises(KeyError):
         e.result(ra)                     # cancelled: no result ever
+
+
+# -- flight recorder: per-request distributed tracing (PR 6) ---------------
+
+def test_failover_trace_reconstructs_causal_chain(tmp_path):
+    """THE flight-recorder acceptance pin: a seeded mid-run replica
+    death (``FaultyReplica.raise_on_step``) produces ONE trace whose
+    spans reconstruct the request's full causal chain — submit, route,
+    dispatch, fault, reclaim, re-dispatch on the survivor, result —
+    each hop parenting on the previous one, schema-valid as a
+    ``kind: trace`` record; the injected fault, the failover, and the
+    breaker transition it provoked sit in causal order in the event
+    ring, and the ring is dumped to ``flight_dump_path`` the moment
+    the replica fails."""
+    ring = obs.EventRing(capacity=64)
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    dump = str(tmp_path / "flight.jsonl")
+    try:
+        bad = FaultyReplica(_StubReplica(), raise_on_step=(2, None),
+                            ring=ring)
+        fl = Fleet([bad, _StubReplica()], policy="round_robin",
+                   health=HealthConfig(dead_consecutive=1,
+                                       cooldown_steps=100),
+                   retry=RetryPolicy(max_attempts=6, jitter=0.0),
+                   step_workers=1, ring=ring, flight_dump_path=dump)
+        r0 = fl.submit([1, 2, 3], max_new_tokens=6)
+        r1 = fl.submit([4, 5], max_new_tokens=3)
+        _drive(fl)
+
+        # failover happened and exactness held regardless
+        assert fl.stats()["failovers"] == 1
+        assert fl.result(r0) == _StubReplica.expected([1, 2, 3], 6)
+        assert fl.result(r1) == _StubReplica.expected([4, 5], 3)
+
+        # the faulted request's trace, span by span
+        evs = rec.trace(fl.request_trace_id(r0))
+        names = [e["name"] for e in evs]
+        assert names == ["fleet_submit", "fleet_route",
+                         "fleet_dispatch", "fleet_fault",
+                         "fleet_reclaim", "fleet_route",
+                         "fleet_dispatch", "fleet_result"]
+        # one unbroken causal chain: every hop parents on the previous
+        assert "parent_id" not in evs[0]          # submit is the root
+        for prev_ev, ev in zip(evs, evs[1:]):
+            assert ev["parent_id"] == prev_ev["span_id"]
+        args = [e.get("args", {}) for e in evs]
+        assert args[1]["replica"] == 0            # routed to the bad one
+        assert args[1]["policy"] == "round_robin"
+        assert "decision" in args[1]              # router said why
+        assert args[2]["replica"] == 0
+        assert args[3]["replica"] == 0            # the fault hop
+        assert "injected step fault" in args[3]["reason"]
+        assert args[4]["restarts"] == 1           # reclaimed once
+        assert args[5]["replica"] == 1            # survivor re-route
+        assert args[6]["replica"] == 1
+        assert args[7]["tokens"] == 6 and args[7]["restarts"] == 1
+
+        # the undisturbed request's trace has no failure hop
+        evs1 = rec.trace(fl.request_trace_id(r1))
+        assert [e["name"] for e in evs1] == [
+            "fleet_submit", "fleet_route", "fleet_dispatch",
+            "fleet_result"]
+        assert evs1[1]["args"]["replica"] == 1
+
+        # schema-valid kind: trace records, kind-dispatched
+        for r in (r0, r1):
+            tr = JsonlExporter.enrich(fl.trace_record(r))
+            assert validate_trace_record(tr) == []
+            assert validate_telemetry_record(tr) == []
+        # fleet record cross-references the fleet-run trace id
+        frec = JsonlExporter.enrich(fl.record())
+        assert validate_fleet_record(frec) == []
+        assert frec["trace_id"] == fl.trace_id
+        assert fl.request_trace_id(r0).startswith(fl.trace_id + "/r")
+
+        # the event ring holds the post-mortem story in causal order:
+        # injected fault -> failover -> breaker open
+        kinds = [e["kind"] for e in ring.snapshot()]
+        for k in ("fault_injected", "failover", "breaker_open"):
+            assert k in kinds, kinds
+        assert kinds.index("fault_injected") < kinds.index("failover")
+        fo = ring.snapshot("failover")[0]
+        assert fo["replica"] == 0 and fo["reclaimed"] == 1
+        assert "injected step fault" in fo["reason"]
+        # breaker events carry the SAME (int) replica join key as the
+        # fleet's own events — a post-mortem groups one replica's
+        # story with ev["replica"] == i across both producers
+        bo = ring.snapshot("breaker_open")[0]
+        assert bo["replica"] == 0
+
+        # ...and was dumped the moment the replica failed
+        with open(dump) as f:
+            lines = [json.loads(ln) for ln in f]
+        assert lines[0]["kind"] == "flight_ring"
+        assert lines[0]["dropped"] == 0
+        assert any(ln["kind"] == "fault_injected" for ln in lines[1:])
+    finally:
+        obs.set_recorder(prev)
+
+
+def test_traced_fleet_step_workers_threads_keep_span_parentage():
+    """Satellite 1 at the fleet level: with ``step_workers=2`` the
+    replica step dispatches overlap on pool workers, and worker-thread
+    spans (window decode) must nest under their OWN replica's
+    ``fleet_replica_step`` span in the fleet trace — never under
+    another worker's span, never inside a request's lifecycle trace
+    (the PR 1 recorder interleaved exactly here)."""
+    m, params = _gpt()
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        with Fleet([serving.Engine(m, params, slots=2, buf_len=24)
+                    for _ in range(2)], policy="least_loaded",
+                   step_workers=2) as fl:
+            rng = np.random.RandomState(7)
+            prompts = [list(rng.randint(0, 64, 5)) for _ in range(4)]
+            rids = [fl.submit(p, max_new_tokens=5) for p in prompts]
+            _drive(fl)
+            for r, p in zip(rids, prompts):
+                assert fl.result(r) == _solo(m, params, p, 5)
+            for r in rids:
+                evs = rec.trace(fl.request_trace_id(r))
+                names = [e["name"] for e in evs]
+                assert names[0] == "fleet_submit"
+                assert names[-1] == "fleet_result"
+                d = evs[names.index("fleet_dispatch")]
+                # the engine admission hop (prefill span or queue
+                # event) recorded under the dispatch activation
+                eng = [e for e in evs if e["name"] in
+                       ("engine_prefill", "engine_queue")]
+                assert eng and all(e["parent_id"] == d["span_id"]
+                                   for e in eng)
+                # closed under parentage: no span adopted a foreign
+                # parent
+                ids = {e["span_id"] for e in evs}
+                assert all(e["parent_id"] in ids for e in evs
+                           if "parent_id" in e)
+                assert validate_trace_record(JsonlExporter.enrich(
+                    fl.trace_record(r))) == []
+            # fleet trace: every window-decode span nests under a
+            # fleet_replica_step span recorded on the SAME worker
+            # thread with the replica label
+            fevs = rec.trace(fl.trace_id)
+            steps = {e["span_id"]: e for e in fevs
+                     if e["name"] == "fleet_replica_step"}
+            decodes = [e for e in fevs
+                       if e["name"] == "engine_window_decode"]
+            assert steps and decodes
+            for e in decodes:
+                assert e["parent_id"] in steps
+                assert e["tid"] == steps[e["parent_id"]]["tid"]
+            # request lifecycle events never leak into the fleet trace
+            assert not [e for e in fevs
+                        if e["name"].startswith("fleet_sub")]
+    finally:
+        obs.set_recorder(prev)

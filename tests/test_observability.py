@@ -240,6 +240,490 @@ def test_span_exception_safe():
     assert [e["name"] for e in rec.events()] == ["boom"]
 
 
+# -- distributed-trace context (PR 6) --------------------------------------
+
+def test_trace_ids_unique_and_span_ids_causal():
+    ids = {obs.new_trace_id() for _ in range(100)}
+    assert len(ids) == 100
+    rec = obs.SpanRecorder()
+    tid = obs.new_trace_id()
+    with rec.span("root", trace_id=tid):
+        with rec.span("child"):              # adopts ambient trace
+            rec.event("leaf")
+    evs = rec.trace(tid)
+    assert [e["name"] for e in evs] == ["root", "child", "leaf"]
+    root, child, leaf = evs
+    # allocation order IS causal order: parent id < child id, and the
+    # parent chain is exactly root <- child <- leaf
+    assert root["span_id"] < child["span_id"] < leaf["span_id"]
+    assert "parent_id" not in root
+    assert child["parent_id"] == root["span_id"]
+    assert leaf["parent_id"] == child["span_id"]
+    assert all(e["trace_id"] == tid for e in evs)
+    # trace() sorts causally even though the recorder appended the
+    # parent's complete event AFTER its children
+    raw = [e["name"] for e in rec.events()]
+    assert raw == ["leaf", "child", "root"]
+
+
+def test_explicit_trace_does_not_adopt_foreign_parent():
+    """A new root with an explicit trace_id opened INSIDE another
+    trace's span must stay parentless — adopting the ambient span
+    would stitch two unrelated traces together."""
+    rec = obs.SpanRecorder()
+    with rec.span("outer", trace_id="trace-a"):
+        with rec.span("rootb", trace_id="trace-b"):
+            pass
+    (b,) = rec.trace("trace-b")
+    assert "parent_id" not in b
+    # and events chained by explicit parent_id override the ambient
+    with rec.span("outer2", trace_id="trace-a"):
+        first = rec.event("e1", trace_id="trace-c")
+        rec.event("e2", trace_id="trace-c", parent_id=first)
+    e1, e2 = rec.trace("trace-c")
+    assert "parent_id" not in e1
+    assert e2["parent_id"] == e1["span_id"]
+
+
+def test_span_parentage_thread_correct_under_pool():
+    """Satellite 1 regression: spans emitted from ThreadPoolExecutor
+    workers must parent on THEIR activated context, never on whatever
+    span another worker has open concurrently (the ambient context is
+    per-thread and reset on exit, so reused pool threads cannot
+    inherit a stale parent)."""
+    from concurrent.futures import ThreadPoolExecutor
+    rec = obs.SpanRecorder()
+    barrier = threading.Barrier(4, timeout=10)
+
+    def work(k):
+        tid = f"trace-{k}"
+        root = rec.event("root", trace_id=tid)
+        with rec.activate(tid, root):
+            barrier.wait()               # all workers inside at once
+            with rec.span("outer", item=k):
+                with rec.span("inner", item=k):
+                    rec.event("mark", item=k)
+        return tid
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        tids = list(pool.map(work, range(4)))
+    for k, tid in enumerate(tids):
+        evs = rec.trace(tid)
+        assert [e["name"] for e in evs] == ["root", "outer", "inner",
+                                            "mark"]
+        ids = {e["span_id"] for e in evs}
+        root, outer, inner, mark = evs
+        # parentage stays inside the trace and follows the nesting
+        assert outer["parent_id"] == root["span_id"]
+        assert inner["parent_id"] == outer["span_id"]
+        assert mark["parent_id"] == inner["span_id"]
+        assert all(e.get("parent_id", root["span_id"]) in ids
+                   for e in evs)
+        assert all(e.get("args", {}).get("item", k) == k for e in evs)
+    # pool threads are reused: after the activations exit, a span on
+    # a reused worker has NO ambient trace
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pool.submit(lambda: None).result()
+        assert pool.submit(obs.current_trace).result() is None
+
+
+def test_maybe_span_gated_by_ambient_context():
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        with obs.maybe_span("hot"):          # no ambient: records nothing
+            pass
+        assert obs.maybe_event("tick") is None
+        assert rec.events() == []
+        with rec.activate("t-1", None):
+            with obs.maybe_span("hot"):
+                pass
+            assert isinstance(obs.maybe_event("tick"), int)
+        assert [e["name"] for e in rec.trace("t-1")] == ["hot", "tick"]
+    finally:
+        obs.set_recorder(prev)
+
+
+def test_maybe_event_records_into_ambient_owner_recorder():
+    """Span ids are PER-RECORDER: an ambient context minted by a
+    private recorder must route maybe_span/maybe_event into THAT
+    recorder — recording them into the default recorder would stamp a
+    foreign parent id into its id space (dangling, or colliding with
+    an unrelated span that happens to hold the same id)."""
+    priv = obs.SpanRecorder()
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        rec.event("noise")                   # default id space advances
+        with priv.span("outer", trace_id="t-priv"):
+            sid = obs.maybe_event("inner")
+        assert rec.events() == [  # default recorder: only its own noise
+            e for e in rec.events() if e["name"] == "noise"]
+        evs = priv.trace("t-priv")
+        assert [e["name"] for e in evs] == ["outer", "inner"]
+        inner = next(e for e in evs if e["name"] == "inner")
+        outer = next(e for e in evs if e["name"] == "outer")
+        assert inner["span_id"] == sid
+        assert inner["parent_id"] == outer["span_id"]
+        from apex_tpu.observability.exporters import (JsonlExporter,
+                                                      validate_trace_record)
+        assert validate_trace_record(
+            JsonlExporter.enrich(priv.trace_record("t-priv"))) == []
+        # and the default recorder's explicit event() never adopts a
+        # foreign recorder's ambient parent
+        with priv.span("outer2", trace_id="t-priv2"):
+            rec.event("standalone")
+        ev = [e for e in rec.events() if e["name"] == "standalone"][0]
+        assert "parent_id" not in ev and "trace_id" not in ev
+    finally:
+        obs.set_recorder(prev)
+
+
+def test_span_recorder_bounded_buffer():
+    rec = obs.SpanRecorder(max_events=3)
+    for i in range(10):
+        rec.event(f"e{i}")
+    assert [e["name"] for e in rec.events()] == ["e7", "e8", "e9"]
+    # the process DEFAULT recorder is bounded too (flight-recorder
+    # discipline: a fleet traces every request by default, and a
+    # weeks-long process must hold the last N spans, not all of them)
+    assert (obs.get_recorder()._events.maxlen
+            == obs.tracing.DEFAULT_MAX_EVENTS)
+
+
+# -- flight-recorder event ring (PR 6) -------------------------------------
+
+def test_event_ring_bounded_seq_and_dump(tmp_path):
+    ring = obs.EventRing(capacity=4)
+    for i in range(7):
+        ring.append("kind_a" if i % 2 == 0 else "kind_b", i=i)
+    assert len(ring) == 4
+    assert ring.total == 7 and ring.dropped == 3
+    evs = ring.snapshot()
+    # oldest-first, seq survives wraparound
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]
+    assert [e["i"] for e in ring.snapshot("kind_a")] == [4, 6]
+    assert all(e["t"] >= 0 for e in evs)
+    path = str(tmp_path / "flight.jsonl")
+    ring.dump(path)
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines[0] == {"kind": "flight_ring", "capacity": 4,
+                        "total": 7, "dropped": 3}
+    assert [ln["seq"] for ln in lines[1:]] == [3, 4, 5, 6]
+    ring.clear()
+    assert len(ring) == 0 and ring.total == 7    # seq keeps counting
+    with pytest.raises(ValueError, match="capacity"):
+        obs.EventRing(capacity=0)
+    # process-default ring plumbing
+    prev = obs.set_ring(obs.EventRing(capacity=2))
+    try:
+        from apex_tpu.observability import flightrec
+        flightrec.record("x", a=1)
+        assert obs.get_ring().snapshot()[0]["kind"] == "x"
+    finally:
+        obs.set_ring(prev)
+
+
+def test_event_ring_thread_safe_appends():
+    ring = obs.EventRing(capacity=10_000)
+    def work():
+        for i in range(500):
+            ring.append("k", i=i)
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ring.total == 4000
+    assert sorted(e["seq"] for e in ring.snapshot()) == list(range(4000))
+
+
+def test_amp_scaler_skip_lands_in_flight_ring():
+    """A scaler skip (overflow -> step dropped) is a flight-recorder
+    transition: record_scaler appends it to the process ring exactly
+    once per newly observed skip."""
+    from apex_tpu import amp, optimizers as opts
+    from apex_tpu import nn
+
+    class Lin(nn.Module):
+        def init(self, key):
+            return {"w": jnp.ones((4,), jnp.float32)}, ()
+
+        def apply(self, p, x, state=(), train=False):
+            return x * p["w"], state
+
+    model, opt = amp.initialize(Lin(), opts.FusedAdam(1e-3),
+                                opt_level="O2", half_dtype="float16",
+                                verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    ring = obs.EventRing()
+    prev = obs.set_ring(ring)
+    try:
+        reg = obs.MetricsRegistry()
+        amp.record_scaler(ost, registry=reg, step=0)
+        assert ring.snapshot("scaler_skip") == []      # no skip yet
+        g = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.inf, jnp.float32), params)
+        _, ost2, _ = opt.step(params, ost, g)
+        amp.record_scaler(ost2, registry=reg, step=1)
+        (ev,) = ring.snapshot("scaler_skip")
+        assert ev["steps_skipped"] == 1 and ev["step"] == 1
+        assert ev["loss_scale"] == 2.0 ** 15
+        # re-recording the SAME skip count appends nothing
+        amp.record_scaler(ost2, registry=reg, step=2)
+        assert len(ring.snapshot("scaler_skip")) == 1
+        # a FRESH registry re-reports the cumulative total once — the
+        # documented tradeoff: dedup is per registry, because any
+        # process-global gate on totals would suppress a SECOND
+        # optimizer's first skips (worse than a duplicate event)
+        amp.record_scaler(ost2, registry=obs.MetricsRegistry(), step=3)
+        evs = ring.snapshot("scaler_skip")
+        assert len(evs) == 2 and evs[-1]["steps_skipped"] == 1
+    finally:
+        obs.set_ring(prev)
+
+
+# -- step-time attribution (PR 6) ------------------------------------------
+
+def test_steptime_attribution_decomposition_and_schema():
+    """attribute_step on deterministic sleepers: the decomposition's
+    internal identities (comm = step - compute, clamped; per-level
+    times reassemble the isolated comm time; overlap in [0, 1]) hold
+    and the resulting bench record passes the validator."""
+    from apex_tpu.observability import steptime
+
+    def sleeper(s):
+        def fn():
+            import time as _t
+            _t.sleep(s)
+            return jnp.ones((4,))
+        return fn
+
+    plan = [{"topology": "hierarchical", "comm_dtype": "float32",
+             "ici_wire_bytes": 3000, "dcn_wire_bytes": 1000,
+             "wire_bytes": 4000},
+            {"topology": "flat", "wire_bytes": 4000}]
+    att = steptime.attribute_step(sleeper(0.03), sleeper(0.018),
+                                  sleeper(0.012), args=(), plan=plan,
+                                  iters=2, warmup=0)
+    for k in steptime.ATTRIBUTION_FIELDS:
+        assert isinstance(att[k], float) and att[k] >= 0.0, k
+    assert 0.0 <= att["overlap_fraction"] <= 1.0
+    assert att["comm_ms"] == pytest.approx(
+        max(att["step_ms"] - att["compute_ms"], 0.0), abs=2e-4)
+    # the per-level split reassembles the isolated measurement and
+    # follows the plan's byte weights (3000+4000 ici vs 1000 dcn);
+    # fields are rounded to 4 decimals, hence the absolute tolerance
+    assert att["ici_ms"] + att["dcn_ms"] == pytest.approx(
+        att["comm_isolated_ms"], abs=2e-4)
+    assert att["dcn_ms"] == pytest.approx(
+        att["comm_isolated_ms"] * 1000 / 8000, abs=2e-4)
+    assert len(att["buckets"]) == 2
+    assert att["buckets"][1]["dcn_ms"] == 0.0    # flat bucket: all ici
+    rec = exporters.JsonlExporter.enrich(
+        {"metric": "train_step_attribution_hier", "value": att["step_ms"],
+         "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
+         "arch": "cpu",
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+    assert exporters.validate_bench_record(rec) == []
+    with pytest.raises(ValueError, match="iters"):
+        steptime.blocked_time(sleeper(0.0), iters=0)
+
+
+def test_attribution_measured_ici_step_zero_weight_level_folds():
+    """A measured ici_step under a plan whose buckets carry no DCN
+    bytes (single-fabric): the measured non-ici residue folds into the
+    ici column instead of silently vanishing (a zero byte weight can't
+    absorb time), so the record still reassembles comm_isolated_ms and
+    passes the validator."""
+    from apex_tpu.observability import steptime
+
+    def sleeper(s):
+        def fn():
+            import time as _t
+            _t.sleep(s)
+            return jnp.ones((4,))
+        return fn
+
+    plan = [{"topology": "flat", "wire_bytes": 100}]
+    att = steptime.attribute_step(sleeper(0.02), sleeper(0.012),
+                                  sleeper(0.008), args=(), plan=plan,
+                                  iters=2, warmup=0,
+                                  ici_step=sleeper(0.003))
+    assert att["dcn_ms"] == 0.0
+    assert att["ici_ms"] == pytest.approx(att["comm_isolated_ms"],
+                                          abs=2e-4)
+    rec = exporters.JsonlExporter.enrich(
+        {"metric": "train_step_attribution_flat", "value": att["step_ms"],
+         "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
+         "arch": "cpu",
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+    assert exporters.validate_bench_record(rec) == []
+
+
+def test_attribution_zero_weight_plan_still_reassembles():
+    """A plan whose buckets carry NO recognized byte weight (no
+    wire_bytes/bytes, or zero) can't label the per-level split — the
+    fallback attributes everything to the ici column so ici+dcn still
+    reassembles comm_isolated_ms and the record passes its own
+    schema, instead of emitting ici=dcn=0 and failing it."""
+    from apex_tpu.observability import steptime
+
+    def sleeper(s):
+        def fn():
+            import time as _t
+            _t.sleep(s)
+            return jnp.ones((4,))
+        return fn
+
+    for plan in ([{"topology": "flat", "payload_bytes": 100}],
+                 [{"topology": "flat", "wire_bytes": 0}]):
+        att = steptime.attribute_step(sleeper(0.02), sleeper(0.012),
+                                      sleeper(0.008), args=(),
+                                      plan=plan, iters=2, warmup=0)
+        assert att["dcn_ms"] == 0.0
+        assert att["ici_ms"] == pytest.approx(att["comm_isolated_ms"],
+                                              abs=2e-4)
+        rec = exporters.JsonlExporter.enrich(
+            {"metric": "train_step_attribution_flat",
+             "value": att["step_ms"], "unit": "ms", "vs_baseline": None,
+             "backend": "cpu", "ndev": 8, "arch": "cpu",
+             **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+        assert exporters.validate_bench_record(rec) == []
+
+
+def test_attribution_record_schema_mutations():
+    """A record carrying overlap_fraction must be internally
+    consistent: compute+comm reassemble the step, the level times
+    reassemble the isolated comm, the fraction is a fraction."""
+    base = exporters.JsonlExporter.enrich(
+        {"metric": "train_step_attribution_flat", "value": 10.0,
+         "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
+         "arch": "cpu", "step_ms": 10.0, "compute_ms": 6.0,
+         "comm_ms": 4.0, "comm_isolated_ms": 5.0,
+         "overlap_fraction": 0.2, "ici_ms": 4.0, "dcn_ms": 1.0})
+    assert exporters.validate_bench_record(base) == []
+    bad = dict(base, overlap_fraction=1.5)
+    assert any("overlap_fraction" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = dict(base, comm_ms=-1.0)
+    assert any(">= 0" in e for e in exporters.validate_bench_record(bad))
+    bad = dict(base, compute_ms=1.0)       # 1 + 4 != 10
+    assert any("inconsistent with step_ms" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = dict(base, ici_ms=1.0)           # 1 + 1 != 5
+    assert any("reassemble" in e
+               for e in exporters.validate_bench_record(bad))
+    missing = {k: v for k, v in base.items() if k != "dcn_ms"}
+    assert any("dcn_ms" in e
+               for e in exporters.validate_bench_record(missing))
+
+
+def test_ddp_comm_enabled_compute_twin_is_collective_free():
+    """comm_enabled=False (the step-time compute twin) elides every
+    gradient collective while keeping the local average, so the twin
+    graph is collective-free and its values are the local mean."""
+    from apex_tpu import parallel
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ddp = parallel.DistributedDataParallel()
+    ddp.comm_enabled = False
+    grads = {"a": jnp.ones((64,), jnp.float32)}
+
+    def step(g):
+        return ddp.allreduce_grads(g)
+
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=(P(),),
+                           out_specs=P(), check_vma=False)
+    txt = str(jax.make_jaxpr(mapped)(grads))
+    assert not any(p in txt for p in ("psum", "all_gather",
+                                      "reduce_scatter", "all_to_all",
+                                      "ppermute")), txt
+    out = jax.jit(mapped)(grads)
+    # local gradient averaged by the axis size, no cross-replica sum
+    assert float(out["a"][0]) == pytest.approx(1.0 / 8)
+    assert ddp.last_comm_stats == []
+
+
+def test_validate_trace_record_pins_causal_shape():
+    """kind: trace records — the per-request flight record — must hold
+    the causal invariants: unique positive span ids, parents strictly
+    earlier, every span in the record's trace.  A violated parent
+    order is exactly the worker-thread interleaving bug the schema
+    exists to catch."""
+    rec = obs.SpanRecorder()
+    tid = obs.new_trace_id()
+    root = rec.event("submit", trace_id=tid)
+    with rec.activate(tid, root):
+        with rec.span("dispatch"):
+            rec.event("tick")
+    good = exporters.JsonlExporter.enrich(rec.trace_record(tid))
+    assert exporters.validate_trace_record(good) == []
+    assert exporters.validate_telemetry_record(good) == []  # dispatch
+    assert good["span_count"] == 3
+
+    def bad(**mut):
+        return exporters.validate_trace_record({**good, **mut})
+
+    assert any("kind" in e for e in bad(kind="bench"))
+    assert any("trace_id" in e for e in bad(trace_id=""))
+    assert any("non-empty" in e for e in bad(spans=[], span_count=0))
+    assert any("span_count" in e for e in bad(span_count=7))
+    # a span whose parent is NOT causally earlier (the lost-chain bug)
+    spans = [dict(s) for s in good["spans"]]
+    spans[1]["parent_id"] = spans[2]["span_id"] + 5
+    assert any("causally earlier" in e for e in bad(spans=spans))
+    # duplicate span ids
+    spans = [dict(s) for s in good["spans"]]
+    spans[2]["span_id"] = spans[0]["span_id"]
+    errs = bad(spans=spans)
+    assert any("duplicate" in e or "causally" in e for e in errs)
+    # a span smuggled in from another trace
+    spans = [dict(s) for s in good["spans"]]
+    spans[1]["trace_id"] = "other-trace"
+    assert any("belongs to trace" in e for e in bad(spans=spans))
+    spans = [dict(s) for s in good["spans"]]
+    spans[0]["ph"] = "Z"
+    assert any("ph" in e for e in bad(spans=spans))
+    # the chain's head evicted (bounded recorder): the orphaned child
+    # parents on a span that is NOT in the record — incomplete trace
+    spans = [dict(s) for s in good["spans"][1:]]
+    assert any("not in this record" in e
+               for e in bad(spans=spans, span_count=len(spans)))
+    assert exporters.validate_trace_record("nope") != []
+
+
+def test_histogram_summary_cached_between_writes():
+    """Satellite 2 pin: summary() memoizes until the next observation —
+    a router reading Engine.stats() every tick pays the bucket-walk
+    quantiles once per write, not once per read."""
+    h = obs.Histogram("lat", buckets=(1.0, 2.0, 5.0))
+    assert h._summary_computes == 0
+    for v in (0.5, 1.5, 3.0):
+        h.observe(v)
+    first = h.summary()
+    for _ in range(50):
+        assert h.summary() == first
+    assert h._summary_computes == 1          # 51 reads, ONE compute
+    h.observe(4.0)                           # write invalidates
+    s2 = h.summary()
+    assert s2["count"] == 4 and s2 != first
+    for _ in range(10):
+        h.summary()
+    assert h._summary_computes == 2
+    # the cache returns copies — mutating a reader's dict is safe
+    s2["p50"] = -1
+    assert h.summary()["p50"] != -1
+    assert h._summary_computes == 2
+    # percentile() still answers directly (uncached path unchanged)
+    assert h.percentile(0.5) == h.summary()["p50"]
+    # _restore (DeviceMetrics flush) also invalidates
+    h._restore([1, 0, 0, 0], 0.5)
+    assert h.summary()["count"] == 1
+    assert h._summary_computes == 3
+
+
 # -- exporters ------------------------------------------------------------
 
 def test_prometheus_text_exposition():
@@ -376,6 +860,119 @@ def test_check_bench_schema_cli(tmp_path):
     r = subprocess.run([sys.executable, script],
                        input='{"metric": "m"}\n',
                        capture_output=True, text=True)
+    assert r.returncode == 1
+
+
+def _trend_round(tmp_path, name, lines):
+    """One BENCH_r*.json runbook wrapper holding ``lines`` as its
+    JSONL tail (what check_bench_trend.py parses)."""
+    doc = {"n": name, "cmd": "python bench.py", "rc": 0,
+           "tail": "\n".join(json.dumps(ln) for ln in lines)}
+    with open(str(tmp_path / name), "w") as f:
+        json.dump(doc, f)
+
+
+def _run_trend(args):
+    import subprocess
+    import sys
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "tests", "ci", "check_bench_trend.py")
+    return subprocess.run([sys.executable, script] + args,
+                          capture_output=True, text=True)
+
+
+def test_check_bench_trend_gate(tmp_path):
+    """The trend gate (acceptance pin): exit 0 on the real BENCH
+    history (stale replays partitioned out, no fresh regression),
+    nonzero on a synthetic history where a stale replay is presented
+    as fresh progress OR a fresh accelerator metric regresses past
+    tolerance — and 0 again when the same replay is properly marked
+    ``stale: true``."""
+    # the real r01-r05 history at the repo root must gate clean
+    r = _run_trend([])
+    assert r.returncode == 0, r.stderr
+    assert "stale replays partitioned out" in r.stderr
+
+    def tpu(value, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "resnet18_fwd_bwd_throughput", "value": value,
+             "unit": "images/sec/chip", "vs_baseline": None,
+             "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite", **kw})
+
+    # replay presented as fresh progress: the wedge flag is in the
+    # round but the replayed line lacks stale: true -> error
+    d1 = tmp_path / "case1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [tpu(500.0)])
+    _trend_round(d1, "BENCH_r02.json",
+                 [exporters.JsonlExporter.enrich(
+                     {"metric": ("TPU_TUNNEL_WEDGED_NO_FRESH_"
+                                 "HARDWARE_NUMBERS"), "value": 1,
+                      "unit": "flag", "vs_baseline": None,
+                      "backend": "cpu", "ndev": 8, "arch": "cpu"}),
+                  tpu(1830.0)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "replay presented as fresh" in r.stderr
+
+    # byte-identical accelerator re-emission from an earlier round is
+    # suspicious but not definitive (stable hardware can honestly
+    # repeat a rounded value): WARNS without gating, and the line
+    # stays out of the trend so it can't count as progress
+    d2 = tmp_path / "case2"
+    d2.mkdir()
+    line = tpu(777.7)
+    _trend_round(d2, "BENCH_r01.json", [line])
+    _trend_round(d2, "BENCH_r02.json", [dict(line)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 0
+    assert "byte-identical" in r.stderr and "WARNING" in r.stderr
+    assert "1 fresh measurements counted" in r.stderr
+
+    # fresh-vs-fresh accelerator regression past tolerance -> error
+    d3 = tmp_path / "case3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [tpu(1000.0)])
+    _trend_round(d3, "BENCH_r02.json", [tpu(600.0)])   # -40%
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 1 and "regressed" in r.stderr
+    # ...within tolerance passes
+    r = _run_trend(["--dir", str(d3), "--tol", "0.8"])
+    assert r.returncode == 0
+    # change is relative to the PREVIOUS value in both directions: a
+    # 21% rate drop is under the 25% default tol and must not gate
+    d3b = tmp_path / "case3b"
+    d3b.mkdir()
+    _trend_round(d3b, "BENCH_r01.json", [tpu(1000.0)])
+    _trend_round(d3b, "BENCH_r02.json", [tpu(790.0)])  # -21%
+    r = _run_trend(["--dir", str(d3b)])
+    assert r.returncode == 0, r.stderr
+
+    # the SAME replay properly marked stale: partitioned out, clean —
+    # and it must NOT count as progress (no fresh line to compare)
+    d4 = tmp_path / "case4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json", [tpu(500.0)])
+    _trend_round(d4, "BENCH_r02.json", [tpu(1830.0, stale=True)])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 0
+    assert "1 stale replays partitioned out" in r.stderr
+
+    # CPU smoke regressions warn but do not gate... unless --strict-cpu
+    d5 = tmp_path / "case5"
+    d5.mkdir()
+
+    def cpu(value):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "fused_lamb_step_time", "value": value,
+             "unit": "ms", "vs_baseline": None, "backend": "cpu",
+             "ndev": 8, "arch": "cpu"})
+    _trend_round(d5, "BENCH_r01.json", [cpu(10.0)])
+    _trend_round(d5, "BENCH_r02.json", [cpu(47.0)])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 0 and "WARNING" in r.stderr
+    r = _run_trend(["--dir", str(d5), "--strict-cpu"])
     assert r.returncode == 1
 
 
