@@ -34,7 +34,8 @@ from .graphs import (HOST_TRANSFER_PRIMS, COLLECTIVE_PRIMS, Graph,
                      aliased_output_count, donated_arg_names,
                      duplicate_donated_leaves)
 from .entry_points import (EntryPoint, ENTRY_POINTS,
-                           register_entry_point, get, select)
+                           register_entry_point, get, select,
+                           entry_point_memory_record)
 from . import rules  # noqa: F401  (registers the core rule set)
 from . import core
 from . import graphs

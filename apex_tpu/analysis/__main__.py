@@ -53,6 +53,12 @@ def main(argv: List[str] = None) -> int:
                    help="comma-separated rule names (default: all)")
     p.add_argument("--list", action="store_true",
                    help="list entry points and rules, run nothing")
+    p.add_argument("--memory", action="store_true",
+                   help="emit one `kind: memory` record per entry "
+                        "point (analytic FLOPs/bytes + the compiled "
+                        "memory plan) instead of linting.  Compiles "
+                        "each selected entry point — combine with "
+                        "--entry-points/--tags to bound the cost")
     p.add_argument("--out", default=None,
                    help="append JSONL findings to this path instead of "
                         "stdout")
@@ -90,6 +96,43 @@ def main(argv: List[str] = None) -> int:
 
     exp = JsonlExporter(path=args.out) if args.out \
         else JsonlExporter(stream=sys.stdout)
+
+    if args.memory:
+        # per-entry-point memory/FLOP dump: the analytic cost model
+        # (free: reuses the cached trace) plus the compiled memory
+        # plan (pays one compile per entry point, cached per process).
+        # Same stdout contract as lint: pure schema-valid JSONL,
+        # check_bench_schema.py validates the stream.
+        from .entry_points import entry_point_memory_record
+        failed = 0
+        with exp:
+            for ep in eps:
+                t0 = time.perf_counter()
+                try:
+                    rec = entry_point_memory_record(ep)
+                except RuntimeError as e:
+                    # only the bare-RuntimeError device-count gate is a
+                    # skip; jaxlib's XlaRuntimeError SUBCLASSES
+                    # RuntimeError, and a real compile failure must
+                    # fail the gate, not read as "skipped"
+                    if type(e) is not RuntimeError:
+                        failed += 1
+                        print(f"{ep.name:32s} FAILED: {e}",
+                              file=sys.stderr)
+                        continue
+                    print(f"{ep.name:32s} skipped: {e}",
+                          file=sys.stderr)
+                    continue
+                except Exception as e:
+                    failed += 1
+                    print(f"{ep.name:32s} FAILED: {e}", file=sys.stderr)
+                    continue
+                exp.emit(rec)
+                print(f"{ep.name:32s} flops={rec['flops']:.4g} "
+                      f"peak_bytes={rec['peak_bytes']:,} "
+                      f"[{time.perf_counter() - t0:.1f}s]",
+                      file=sys.stderr)
+        return 1 if failed else 0
     t0 = time.perf_counter()
     with exp:
         summary = run_lint(entry_points=eps, rules=rules,

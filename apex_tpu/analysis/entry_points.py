@@ -25,7 +25,22 @@ import numpy as np
 from .graphs import Graph
 
 __all__ = ["EntryPoint", "ENTRY_POINTS", "register_entry_point", "get",
-           "select", "names"]
+           "select", "names", "entry_point_memory_record"]
+
+
+def entry_point_memory_record(ep: "EntryPoint") -> Dict[str, Any]:
+    """One ``kind: memory`` JSONL payload for an entry point: the
+    analytic cost (``ep.cost()``) merged with the compiled memory plan
+    (``ep.memory_plan()``).  Shared by ``python -m apex_tpu.analysis
+    --memory`` and tests so the record shape cannot drift from
+    ``exporters.validate_memory_record``."""
+    cost = ep.cost()
+    rec = {"kind": "memory", "entry_point": ep.name,
+           "source": "compiled", **cost.to_record(), **ep.memory_plan()}
+    dt = cost.dominant_matmul_dtype
+    if dt is not None:
+        rec["dominant_matmul_dtype"] = dt
+    return rec
 
 
 class EntryPoint:
@@ -42,6 +57,8 @@ class EntryPoint:
         self.description = description
         self._build = build
         self._graph: Optional[Graph] = None
+        self._cost = None
+        self._memory_plan: Optional[Dict[str, Any]] = None
 
     def graph(self) -> Graph:
         if self._graph is None:
@@ -59,6 +76,32 @@ class EntryPoint:
             finally:
                 amp_policy.set_policy(base)
         return self._graph
+
+    def cost(self):
+        """Analytic :class:`observability.costmodel.Cost` of the traced
+        graph (honest mode: scan bodies times trip count).  Cached per
+        process like ``graph()`` — the FlopAccountingRule, the CLI
+        ``--memory`` dump and tests share one count."""
+        if self._cost is None:
+            from ..observability import costmodel
+            self._cost = costmodel.jaxpr_cost(self.graph().jaxpr)
+        return self._cost
+
+    def memory_plan(self) -> Dict[str, Any]:
+        """Compiled memory plan (``Compiled.memory_analysis()``) plus
+        the analytic liveness estimate.  Unlike ``cost()`` this pays a
+        compile on first call (cached after); the lint rules use only
+        the analytic fields, so plain lint never compiles."""
+        if self._memory_plan is None:
+            from ..observability import memory
+            plan = memory.memory_plan(self.graph().compiled)
+            lb = memory.jaxpr_live_bytes(self.graph().jaxpr)
+            plan["analytic_live_bytes"] = lb["peak_live_bytes"]
+            plan["analytic_temp_bytes"] = lb["peak_temp_bytes"]
+            plan["analytic_temp_bytes_by_dtype"] = \
+                lb["peak_temp_bytes_by_dtype"]
+            self._memory_plan = plan
+        return self._memory_plan
 
     def __repr__(self):
         return f"EntryPoint({self.name!r}, tags={sorted(self.tags)})"
@@ -243,6 +286,18 @@ def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
         "collectives",
         parallel.plan_collective_expectations(
             plan, extra_psums=2, extra_psum_bytes=2 * 4))
+    # cost/memory accounting (PR 8): under a bf16 compute policy no
+    # measurable share of dot/conv FLOPs may run in fp32 (the silent
+    # upcast halves MXU rate exactly where the flops are), and the
+    # step's peak live bytes stay within a fixed multiple of its
+    # argument bytes (~2.6x today: params + fp32 masters/moments +
+    # activations; 4x flags a graph suddenly holding a second copy of
+    # everything).  Resnet18's train step traces ~126 MFLOP of matmul
+    # work — the floor keeps the fraction check non-vacuous.
+    if np.dtype(amp.compute_dtype(opt_level)) != np.dtype(np.float32):
+        ep.expect.setdefault("flops", {"max_fp32_matmul_fraction": 0.02,
+                                       "min_matmul_flops": 1e6})
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
 
 
 for _lvl in ("O0", "O1", "O2", "O3"):
@@ -350,6 +405,9 @@ def _transformer_graph(ep, family):
         "collectives",
         parallel.plan_collective_expectations(
             plan, extra_psums=2, extra_psum_bytes=2 * 4))
+    ep.expect.setdefault("flops", {"max_fp32_matmul_fraction": 0.02,
+                                   "min_matmul_flops": 1e6})
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"),)),
@@ -398,6 +456,9 @@ def _engine_step_k_graph(ep):
         "expect_donated": ("ids", "cache", "keys"),
         "forbid_donated": ("temps", "limit", "eos"),
         "min_aliased": n_cache + 2})
+    # a K-tick decode window mutates in place: live bytes stay O(cache
+    # + params); a second cache copy materializing mid-window flags
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 2.5})
     return Graph(trace=_scoped(
                      _no_policy(),
                      lambda: jax.make_jaxpr(eng._step_k)(*args)),
@@ -424,6 +485,9 @@ def _engine_prefill_graph(ep):
         "expect_donated": ("ids", "cache"),
         "forbid_donated": ("slot", "row"),
         "min_aliased": n_cache + 1})
+    # admission runs a full-buffer forward: activations push live bytes
+    # to ~1.5x (params + cache); 2.5x budgets real headroom, not a leak
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 2.5})
     return Graph(trace=_scoped(
                      _no_policy(),
                      lambda: jax.make_jaxpr(eng._prefill_slot)(*args)),
@@ -455,6 +519,7 @@ def _seq2seq_step_k_graph(ep):
         # the per-slot length vector (global blocklist)
         "expect_donated": ("state", "out"),
         "forbid_donated": ("limit", "eos")})
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 2.5})
     return Graph(trace=_scoped(
                      _no_policy(),
                      lambda: jax.make_jaxpr(eng._step_k)(*args)),
@@ -523,6 +588,7 @@ def _tp_train_step_graph(ep):
         "collectives",
         parallel.plan_collective_expectations(
             plan, extra_psums=2, extra_psum_bytes=act_bytes + 4))
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(specs, P("data"), P("data")),
                            out_specs=specs, check_vma=False)

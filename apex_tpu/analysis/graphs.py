@@ -191,6 +191,7 @@ class Graph:
         self._jaxpr = None
         self._lowered = None
         self._lowered_text = None
+        self._compiled = None
 
     @property
     def jaxpr(self):
@@ -217,3 +218,24 @@ class Graph:
     @property
     def has_lowering(self) -> bool:
         return self._lower is not None or self._lowered is not None
+
+    @property
+    def compiled(self):
+        """The compiled executable for memory/cost analysis.
+
+        Entry points with a real lowering compile it (donation aliasing
+        and all); trace-only entry points compile an ``eval_jaxpr``
+        re-staging of the traced graph — structurally identical compute,
+        but no donation, so alias_bytes reads 0 there.  Cached: the
+        compile is paid once per process like the trace."""
+        if self._compiled is None:
+            if self.has_lowering:
+                self._compiled = self.lowered.compile()
+            else:
+                closed = self.jaxpr
+                args = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                        for v in closed.jaxpr.invars]
+                fn = jax.jit(lambda *xs: jax.core.eval_jaxpr(
+                    closed.jaxpr, closed.consts, *xs))
+                self._compiled = fn.lower(*args).compile()
+        return self._compiled

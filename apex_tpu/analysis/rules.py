@@ -35,6 +35,36 @@ runs where its key is present):
     the 6-D block rearrange inside space_to_depth is the one sanctioned
     exception (budgeted, not open-ended).
 
+``flops``::
+
+    {"expected_flops": 3.8e6, "rtol": 0.05,
+     "max_fp32_matmul_fraction": 0.02, "min_matmul_flops": 1e6}
+
+    Analytic FLOP accounting (``observability.costmodel``):
+    ``expected_flops`` pins the whole-graph count within ``rtol`` (an
+    unexplained delta means the graph grew or lost work nobody
+    budgeted); ``max_fp32_matmul_fraction`` caps the share of dot/conv
+    FLOPs running on fp32 operands — under a bf16 compute policy a
+    silent upcast moves flops into fp32 exactly where the arithmetic
+    is, even when each individual op dodges the amp-dtype rule's
+    element thresholds.  ``min_matmul_flops`` is the vacuity floor.
+
+``memory``::
+
+    {"budget_bytes": 500_000_000,
+     "max_live_to_argument_ratio": 4.0,
+     "temp_budget_bytes_by_dtype": {"float32": 250_000_000}}
+
+    Analytic peak-live-bytes budgets (``observability.memory.
+    jaxpr_live_bytes`` — a static last-use scan, no compile on the
+    lint path).  ``budget_bytes`` caps the absolute peak;
+    ``max_live_to_argument_ratio`` caps peak live bytes relative to
+    the graph's argument+const bytes (portable across model sizes: a
+    train step that suddenly holds a second copy of everything doubles
+    the ratio no matter the model); the per-dtype temp budgets catch
+    an fp32 upcast doubling fp32 temp bytes under O2 while the bf16
+    peak is unchanged.
+
 ``collectives``::
 
     {"counts": {"psum": 4}, "payload_bytes": 40038408,
@@ -61,7 +91,8 @@ from .core import Rule, Finding, register_rule
 from . import graphs as G
 
 __all__ = ["HostTransferRule", "DonationRule", "AmpDtypeRule",
-           "LayoutRule", "CollectiveRule"]
+           "LayoutRule", "CollectiveRule", "FlopAccountingRule",
+           "MemoryBudgetRule"]
 
 
 @register_rule
@@ -245,6 +276,107 @@ class LayoutRule(Rule):
                     f"{budget} (space_to_depth runs forward-only; a "
                     f"second copy means gradient flows through the "
                     f"rearrange)", count=len(six_d), budget=budget))
+        return out
+
+
+@register_rule
+class FlopAccountingRule(Rule):
+    """The analytic FLOP count stays explained: totals within a pinned
+    tolerance, and under a reduced-precision policy no meaningful
+    share of matmul flops runs in fp32.  This is the flops-weighted
+    twin of the amp-dtype rule: that one counts *ops*, this one counts
+    *work* — a single upcast conv carrying half the step's FLOPs flags
+    here even if 39 other convs are clean."""
+
+    name = "flop-accounting"
+    expect_key = "flops"
+
+    def check(self, ep, graph) -> List[Finding]:
+        from ..observability import costmodel
+        want = ep.expect["flops"]
+        out: List[Finding] = []
+        cost = ep.cost() if hasattr(ep, "cost") \
+            else costmodel.jaxpr_cost(graph.jaxpr)
+        expected = want.get("expected_flops")
+        if expected is not None:
+            rtol = want.get("rtol", 0.05)
+            if expected <= 0:
+                out.append(self.finding(
+                    ep, f"expected_flops must be > 0, got {expected}"))
+            elif abs(cost.flops - expected) > rtol * expected:
+                out.append(self.finding(
+                    ep, f"unexplained FLOP delta: analytic count is "
+                        f"{cost.flops:.4g}, expected {expected:.4g} "
+                        f"(+/- {rtol:.0%}) — the graph gained or lost "
+                        f"arithmetic nobody budgeted",
+                    flops=cost.flops, expected_flops=expected,
+                    rtol=rtol))
+        cap = want.get("max_fp32_matmul_fraction")
+        if cap is not None:
+            floor = want.get("min_matmul_flops", 1.0)
+            if cost.matmul_flops < floor:
+                out.append(self.finding(
+                    ep, f"vacuous check: expected >= {floor:.4g} "
+                        f"dot/conv FLOPs, traced {cost.matmul_flops:.4g}",
+                    matmul_flops=cost.matmul_flops, floor=floor))
+            frac = cost.fp32_matmul_fraction()
+            if frac > cap:
+                fp32 = cost.matmul_flops_by_dtype.get("float32", 0.0)
+                out.append(self.finding(
+                    ep, f"{frac:.1%} of dot/conv FLOPs "
+                        f"({fp32:.4g} of {cost.matmul_flops:.4g}) run "
+                        f"on fp32 operands — cap is {cap:.1%} (silent "
+                        f"upcast where the work is)",
+                    fp32_matmul_fraction=frac, cap=cap,
+                    fp32_matmul_flops=fp32,
+                    matmul_flops=cost.matmul_flops))
+        return out
+
+
+@register_rule
+class MemoryBudgetRule(Rule):
+    """Peak live bytes stay within budget — the static early-warning
+    for ROADMAP item 4's "pin peak-memory in bench": a refactor that
+    keeps a dead copy of the cache, un-donates a buffer upstream, or
+    upcasts a temp tree to fp32 moves the analytic liveness peak long
+    before anyone reruns the hardware bench."""
+
+    name = "memory-budget"
+    expect_key = "memory"
+
+    def check(self, ep, graph) -> List[Finding]:
+        from ..observability import memory
+        want = ep.expect["memory"]
+        out: List[Finding] = []
+        lb = memory.jaxpr_live_bytes(graph.jaxpr)
+        peak = lb["peak_live_bytes"]
+        budget = want.get("budget_bytes")
+        if budget is not None and peak > budget:
+            out.append(self.finding(
+                ep, f"analytic peak live bytes {peak:,} exceed the "
+                    f"{budget:,}-byte budget",
+                peak_live_bytes=peak, budget_bytes=budget))
+        ratio_cap = want.get("max_live_to_argument_ratio")
+        if ratio_cap is not None:
+            args = max(lb["argument_bytes"], 1)
+            ratio = peak / args
+            if ratio > ratio_cap:
+                out.append(self.finding(
+                    ep, f"peak live bytes are {ratio:.2f}x the "
+                        f"argument bytes ({peak:,} vs {args:,}); "
+                        f"budget is {ratio_cap}x — the graph is "
+                        f"holding duplicate state",
+                    peak_live_bytes=peak, argument_bytes=args,
+                    ratio=round(ratio, 3), cap=ratio_cap))
+        for dt, cap in sorted(
+                want.get("temp_budget_bytes_by_dtype", {}).items()):
+            got = lb["peak_temp_bytes_by_dtype"].get(dt, 0)
+            if got > cap:
+                out.append(self.finding(
+                    ep, f"peak {dt} temp bytes {got:,} exceed the "
+                        f"{cap:,}-byte budget — e.g. an fp32 upcast "
+                        f"materializing a second activation tree",
+                    dtype=dt, peak_temp_bytes=got, budget_bytes=cap))
         return out
 
 

@@ -21,6 +21,14 @@ skips) dumpable on fault, and ``steptime``, the blocked-fetch
 step-time attribution harness (compute vs per-level comm time,
 ``overlap_fraction``) behind ``bench.py --comm``.
 
+And the **cost model** (PR 8): ``costmodel``, the XLA-calibrated
+analytic FLOPs/bytes model over jaxprs (valid-position conv counting,
+DCE, per-dtype matmul breakdowns, the documented ``PEAK_FLOPS`` table
+and ``mfu()`` fields on every bench train record), and ``memory``,
+the compiled memory plans / static liveness / live-array gauges
+behind ``peak_bytes`` gating, ``kind: memory`` records, and the
+``flop-accounting`` / ``memory-budget`` lint rules.
+
 Wired consumers: ``serving.Engine``/``Seq2SeqEngine`` (enriched
 ``stats()``), ``parallel.distributed`` (comm accounting),
 ``amp`` (loss-scale/skip introspection + ``record_scaler``),
@@ -39,11 +47,16 @@ from .flightrec import EventRing, get_ring, set_ring
 from .exporters import (SCHEMA_VERSION, JsonlExporter, prometheus_text,
                         host_info, validate_bench_record,
                         validate_bench_jsonl)
+from .costmodel import Cost, jaxpr_cost, peak_flops, mfu
+from .memory import (memory_plan, jaxpr_live_bytes, live_array_bytes,
+                     record_live_arrays)
 from . import metrics
 from . import tracing
 from . import flightrec
 from . import steptime
 from . import exporters
+from . import costmodel
+from . import memory
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DeviceMetrics",
@@ -54,5 +67,9 @@ __all__ = [
     "EventRing", "get_ring", "set_ring",
     "SCHEMA_VERSION", "JsonlExporter", "prometheus_text", "host_info",
     "validate_bench_record", "validate_bench_jsonl",
+    "Cost", "jaxpr_cost", "peak_flops", "mfu",
+    "memory_plan", "jaxpr_live_bytes", "live_array_bytes",
+    "record_live_arrays",
     "metrics", "tracing", "flightrec", "steptime", "exporters",
+    "costmodel", "memory",
 ]

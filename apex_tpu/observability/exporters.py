@@ -30,13 +30,18 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
            "validate_fleet_record", "validate_trace_record",
+           "validate_memory_record",
            "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
 # <-> request-trace join key) and ``kind: trace`` records exist.
-# Validators gate version-2 requirements on the record's DECLARED
-# version, so archived v1 streams stay valid.
-SCHEMA_VERSION = 2
+# v3: ``kind: memory`` records exist (cost-model/memory-plan dumps);
+# fresh ``*_train_throughput`` records must carry the MFU fields
+# (``mfu`` / ``achieved_tflops`` / ``flops_per_step`` / ``peak_bytes``)
+# and fresh engine-decode records must carry ``kv_cache_bytes``.
+# Validators gate each version's requirements on the record's DECLARED
+# version, so archived v1/v2 streams stay valid.
+SCHEMA_VERSION = 3
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -239,6 +244,9 @@ def validate_bench_record(rec: Any) -> List[str]:
     if "tokens_per_sync" in rec and not isinstance(
             rec["tokens_per_sync"], numbers.Number):
         errs.append("'tokens_per_sync' must be a number when present")
+    sv_rec = rec.get("schema_version")
+    v3 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 3)
     if (isinstance(metric, str) and "engine_decode" in metric
             and "error" not in rec and not rec.get("stale")):
         if "window" not in rec:
@@ -248,6 +256,41 @@ def validate_bench_record(rec: Any) -> List[str]:
         if isinstance(unit, str) and "tokens/sec" not in unit:
             errs.append(f"engine decode records must report a "
                         f"tokens/sec unit, got {unit!r}")
+        if v3 and "kv_cache_bytes" not in rec:
+            errs.append("fresh engine decode records must carry "
+                        "'kv_cache_bytes' (schema v3)")
+    # MFU / peak-memory fields (PR 8): a fresh train-step throughput
+    # line is only a roofline statement given the model FLOPs behind
+    # it — v3 records must say what they computed (flops_per_step,
+    # per device), how fast (achieved_tflops, mfu vs the costmodel
+    # peak table — null where the table has no entry for the
+    # hardware) and at what memory high-water mark (peak_bytes from
+    # the compiled plan).  Stale replays of older rounds and error
+    # lines stay exempt, as does anything declaring schema_version < 3.
+    if (v3 and isinstance(metric, str)
+            and metric.endswith("_train_throughput")
+            and "error" not in rec and not rec.get("stale")):
+        for key in ("flops_per_step", "achieved_tflops"):
+            v = _need(rec, errs, key, numbers.Number)
+            if (isinstance(v, numbers.Number) and not isinstance(v, bool)
+                    and v < 0):
+                errs.append(f"{key!r} must be >= 0, got {v}")
+        mv = _need(rec, errs, "mfu", numbers.Number, allow_none=True)
+        if (isinstance(mv, numbers.Number) and not isinstance(mv, bool)
+                and mv < 0):
+            errs.append(f"'mfu' must be >= 0 or null, got {mv}")
+        pb = _need(rec, errs, "peak_bytes", int)
+        if isinstance(pb, int) and not isinstance(pb, bool) and pb < 0:
+            errs.append(f"'peak_bytes' must be >= 0, got {pb}")
+    if "kv_cache_bytes" in rec:
+        v = rec["kv_cache_bytes"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"'kv_cache_bytes' must be an int >= 0, "
+                        f"got {v!r}")
+    if "mfu" in rec and rec["mfu"] is not None and (
+            not isinstance(rec["mfu"], numbers.Number)
+            or isinstance(rec["mfu"], bool)):
+        errs.append("'mfu' must be a number or null")
     # gradient-allreduce comm microbench fields (bench.py --comm): a
     # record carrying ``comm_topology`` describes one topology variant
     # of the two-level ICI/DCN reduction and must state the per-level
@@ -446,6 +489,80 @@ def validate_fleet_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- memory record schema ---------------------------------------------------
+
+# Compiled.memory_analysis() components every ``kind: memory`` record
+# must carry; ``peak_bytes`` must reassemble from them exactly.
+# Public: observability.memory builds its plans from THIS tuple, so
+# the producer and the validator cannot drift.  (This module stays
+# import-light — memory.py imports from here, never the reverse, so
+# tests/ci/check_bench_schema.py's jax-free loader keeps working.)
+MEMORY_PLAN_KEYS = ("argument_bytes", "output_bytes", "temp_bytes",
+                    "alias_bytes", "generated_code_bytes")
+_MEMORY_PLAN_KEYS = MEMORY_PLAN_KEYS
+
+
+def validate_memory_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: memory`` JSONL record (the
+    cost-model/memory-plan dump emitted per analysis entry point by
+    ``python -m apex_tpu.analysis --memory`` and per bench config by
+    ``bench.py``): the common envelope, a subject (``entry_point`` or
+    ``metric``), non-negative analytic FLOP/byte totals, the compiled
+    memory-plan components, and the arithmetic cross-check — a
+    ``peak_bytes`` that does not reassemble from its own components is
+    a hand-built record, not a plan."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types):
+        return _need(rec, errs, key, types)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "memory":
+        errs.append(f"kind must be 'memory', got {rec.get('kind')!r}")
+    subject = rec.get("entry_point", rec.get("metric"))
+    if not isinstance(subject, str) or not subject:
+        errs.append("memory records must carry a non-empty "
+                    "'entry_point' or 'metric'")
+    for key in ("flops", "transcendentals", "matmul_flops"):
+        v = need(key, numbers.Number)
+        if (isinstance(v, numbers.Number) and not isinstance(v, bool)
+                and v < 0):
+            errs.append(f"{key!r} must be >= 0, got {v}")
+    parts = {}
+    for key in _MEMORY_PLAN_KEYS + ("peak_bytes", "bytes_accessed"):
+        v = need(key, int)
+        if isinstance(v, int) and not isinstance(v, bool):
+            if v < 0:
+                errs.append(f"{key!r} must be >= 0, got {v}")
+            parts[key] = v
+    if len(parts) == len(_MEMORY_PLAN_KEYS) + 2:
+        expect = (parts["argument_bytes"] + parts["output_bytes"]
+                  + parts["temp_bytes"] + parts["generated_code_bytes"]
+                  - parts["alias_bytes"])
+        if parts["peak_bytes"] != expect:
+            errs.append(
+                f"peak_bytes ({parts['peak_bytes']}) != argument + "
+                f"output + temp + generated_code - alias ({expect})")
+    for opt in ("analytic_live_bytes", "analytic_temp_bytes",
+                "kv_cache_bytes"):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0 when "
+                            f"present, got {v!r}")
+    for opt in ("matmul_flops_by_dtype", "bytes_by_dtype",
+                "analytic_temp_bytes_by_dtype"):
+        if opt in rec and not isinstance(rec[opt], dict):
+            errs.append(f"{opt!r} must be an object when present")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 # -- trace record schema ----------------------------------------------------
 
 def validate_trace_record(rec: Any) -> List[str]:
@@ -535,8 +652,9 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     ``kind``) go through their own schemas, everything else through
     the bench schema — so one stream may interleave bench
     measurements, lint findings (``bench.py --graph-lint``), fleet
-    snapshots (``bench.py --fleet N``) and request traces
-    (``kind: trace``)."""
+    snapshots (``bench.py --fleet N``), request traces
+    (``kind: trace``) and cost-model dumps (``kind: memory``, from
+    ``python -m apex_tpu.analysis --memory`` / ``bench.py``)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -544,6 +662,8 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_fleet_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "trace":
         return validate_trace_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "memory":
+        return validate_memory_record(rec)
     return validate_bench_record(rec)
 
 

@@ -415,6 +415,23 @@ class _SlotScheduler:
     def live(self) -> int:
         return len(self._by_slot)
 
+    def _kv_buffers(self):
+        """Pytrees of device-resident KV state this engine owns —
+        subclasses override; the base scheduler has none."""
+        return []
+
+    def kv_cache_bytes(self) -> int:
+        """Device bytes held by this engine's KV cache buffers (slot
+        caches, draft caches, prefix-pool rows; seq2seq slot state).
+        The paged-KV refactor (ROADMAP item 1) is judged against this
+        number — it is recomputed from the live buffers, so a layout
+        change cannot silently stop being counted."""
+        import jax
+        return int(sum(
+            leaf.nbytes for buf in self._kv_buffers()
+            for leaf in jax.tree_util.tree_leaves(buf)
+            if hasattr(leaf, "nbytes")))
+
     def stats(self) -> Dict[str, Any]:
         """Scheduler + telemetry snapshot.  The four original counters
         (live/waiting/free/finished) keep their flat-int shape; the
@@ -424,8 +441,33 @@ class _SlotScheduler:
         mirrors ``waiting`` under the name the metrics registry uses.
         The scalar totals are engine-LOCAL; the histogram summaries come
         from ``self.metrics``, so with an explicitly shared registry
-        they aggregate every engine sharing it."""
+        they aggregate every engine sharing it.
+
+        Memory fields (PR 8): ``kv_cache_bytes`` (this engine's KV
+        buffers), ``device_live_bytes`` (process-wide
+        ``jax.live_arrays`` census, also folded into the registry's
+        ``device_live_bytes`` gauge), and HBM occupancy where the
+        backend reports real memory stats (``hbm_bytes_in_use`` /
+        ``hbm_bytes_limit`` / ``hbm_occupancy``; None on CPU-style
+        backends — the live census is the portable signal there)."""
+        from .observability import memory as obs_memory
+        kv = self.kv_cache_bytes()
+        self.metrics.gauge(
+            "engine_kv_cache_bytes",
+            help="device bytes held by this engine's KV buffers"
+        ).set(kv)
+        census = obs_memory.record_live_arrays(self.metrics)
+        hw = census.get("memory_stats")
+        # memory_stats() keys are backend-dependent — guard each one
+        occupancy = (hw["bytes_in_use"] / hw["bytes_limit"]
+                     if hw and hw.get("bytes_limit")
+                     and hw.get("bytes_in_use") is not None else None)
         return {"live": len(self._by_slot),
+                "kv_cache_bytes": kv,
+                "device_live_bytes": census["bytes"],
+                "hbm_bytes_in_use": hw.get("bytes_in_use") if hw else None,
+                "hbm_bytes_limit": hw.get("bytes_limit") if hw else None,
+                "hbm_occupancy": occupancy,
                 "waiting": len(self._waiting),
                 "free": len(self._free),
                 "finished": len(self._finished),
@@ -938,6 +980,14 @@ class Engine(_SlotScheduler):
     def _freeze_slot(self, slot):
         self.limit = self.limit.at[slot].set(0)
 
+    def _kv_buffers(self):
+        bufs = [self.cache]
+        for attr in ("d_cache", "_pool_cache", "_pool_d_cache"):
+            buf = getattr(self, attr, None)
+            if buf is not None:
+                bufs.append(buf)
+        return bufs
+
     def stats(self) -> Dict[str, Any]:
         """Base snapshot plus prefix-cache effectiveness: splice
         admissions so far and the hit rate over all admissions (0.0 on
@@ -1038,6 +1088,10 @@ class Seq2SeqEngine(_SlotScheduler):
         # state + out donated; n_new deliberately not (the per-slot
         # length vector — see the donation note on Engine._step_k)
         self._step_k = jax.jit(_step_k, donate_argnums=(0, 1))
+
+    def _kv_buffers(self):
+        # per-slot seq2seq state: cross-attention K/V + decoder cache
+        return [self.state]
 
     def _check_prompt(self, src):
         if len(src) < 1 or len(src) > self.src_len:

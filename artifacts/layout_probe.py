@@ -65,8 +65,21 @@ def conv_stack(fmt):
                 x = x + (out * 1e-9).astype(x.dtype)
         return out
 
-    flops = 4 * sum(2 * B * (h // s) * (h // s) * co * ci * kk * kk
-                    for (ci, co, h, kk, s) in STAGES)
+    # per-stage conv FLOPs from the shared analytic cost model
+    # (observability.costmodel — XLA valid-position counting replaces
+    # this probe's hand-rolled padded-tap formula), x4 for the chained
+    # repeats inside run()
+    from apex_tpu.observability import costmodel
+
+    def one(x, w, s):
+        return lax.conv_general_dilated(
+            x, w, (s, s), "SAME", dimension_numbers=dn,
+            preferred_element_type=jnp.float32)
+
+    flops = 4 * sum(
+        costmodel.jaxpr_cost(jax.make_jaxpr(
+            lambda a, b, s=s: one(a, b, s))(x, w)).flops
+        for x, w, (ci, co, h, kk, s) in zip(xs, ws, STAGES))
     dt = timed(run, *(xs + ws))
     print(f"conv stack {fmt}: {dt*1e3:.2f} ms  "
           f"{flops/dt/1e12:.1f} TFLOP/s")

@@ -196,8 +196,17 @@ def conv_bench(shapes=None, K=8, iters=3):
                     dimension_numbers=(dn_in, dn_k, dn_out))
 
             ct = conv(x, w)  # cotangent template (output shape)
-            hout = ct.shape[2] if layout == "NCHW" else ct.shape[1]
-            flops = 2 * B * hout * hout * cout * cin * kh * kh
+            # conv FLOPs from the shared analytic cost model
+            # (observability.costmodel, XLA valid-position counting) —
+            # this probe's old hand-rolled 2*B*H*W*Cout*Cin*k^2 counted
+            # padding taps as math and, on grad convs, overcounted a
+            # strided dgrad by stride^2.  One source of truth now; the
+            # dgrad/wgrad rows deliberately reuse the FORWARD count
+            # (valid-position makes them equal) so TF/s stays
+            # comparable across the three directions.
+            from apex_tpu.observability import costmodel
+            flops = costmodel.jaxpr_cost(
+                jax.make_jaxpr(conv)(x, w)).flops
 
             def chain_fwd(xx, ww):
                 def body(c, _):

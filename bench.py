@@ -552,7 +552,7 @@ def main():
         return 1 if lint_errors else 0
 
     def timed_scan(ddp, step, state, arrays, per_step_shapes, K, iters,
-                   warmup):
+                   warmup, metric=None):
         """Build the make_step trainer and time one optimizer step.
 
         ``arrays``: flat leaves holding K*B leading elements each;
@@ -560,7 +560,19 @@ def main():
         K real optimizer steps on K distinct micro-batches per dispatch —
         amortizing the ~ms-scale tunnel RTT; K == 1 keeps no micro axis
         but routes through the same builder so all configs share
-        construction coverage.  No buffer donation: see sharded()."""
+        construction coverage.  No buffer donation: see sharded().
+
+        Returns ``(sec_per_step, cost_fields, memory_record)``: the
+        step is AOT-compiled ONCE (lower+compile, reused for the timed
+        loop) so ``Compiled.memory_analysis()`` describes the exact
+        executable that was timed, and the analytic cost model
+        (observability.costmodel) prices one optimizer step per device
+        — the fields every fresh train-throughput record must carry at
+        schema v3 (mfu / achieved_tflops / flops_per_step /
+        peak_bytes), plus the full ``kind: memory`` record emitted
+        alongside."""
+        from apex_tpu.observability import costmodel
+        from apex_tpu.observability import memory as obsmem
         train = ddp.make_step(step, mesh=mesh, donate_state=False,
                               steps_per_call=K)
         if K == 1:
@@ -568,7 +580,29 @@ def main():
         else:
             batch = tuple(a.reshape((K,) + s)
                           for a, s in zip(arrays, per_step_shapes))
-        return timed(train, state, batch, iters, warmup) / K
+        # ONE trace serves everything: the jaxpr for the cost model and
+        # the lowering/compile for the timed loop + memory plan (the
+        # AOT .trace() API; the make_jaxpr fallback re-traces on jax
+        # versions without it)
+        try:
+            traced = train.trace(state, batch)
+            closed, lowered = traced.jaxpr, traced.lower()
+        except AttributeError:
+            closed = jax.make_jaxpr(lambda s, b: train(s, b))(state,
+                                                             batch)
+            lowered = train.lower(state, batch)
+        compiled = lowered.compile()
+        dt = timed(compiled, state, batch, iters, warmup) / K
+        cost = costmodel.jaxpr_cost(closed)
+        plan = obsmem.memory_plan(compiled)
+        flops_step = cost.flops / K            # per device: shard_map body
+        mdtype = cost.dominant_matmul_dtype or "float32"
+        fields = {"flops_per_step": flops_step,
+                  "peak_bytes": plan["peak_bytes"],
+                  **costmodel.mfu(flops_step, dt, base["arch"], mdtype)}
+        mem_rec = {"kind": "memory", "metric": metric or "train_step",
+                   "source": "compiled", **cost.to_record(), **plan}
+        return dt, fields, mem_rec
 
     def resnet_config(metric, opt_level, arch, batch_per_chip, image,
                       iters, warmup, sync_bn=False, vs=None,
@@ -590,13 +624,16 @@ def main():
                         jnp.float32)
         y = jnp.asarray(rng.randint(0, 1000, K * global_batch), jnp.int32)
         step = make_resnet_step(model, optimizer, ddp)
-        dt = timed_scan(ddp, step, (params, bn_state, opt_state), (x, y),
-                        ((global_batch,) + x.shape[1:], (global_batch,)),
-                        K, iters, warmup)
+        dt, cost_fields, mem_rec = timed_scan(
+            ddp, step, (params, bn_state, opt_state), (x, y),
+            ((global_batch,) + x.shape[1:], (global_batch,)),
+            K, iters, warmup, metric=metric)
         ips_chip = global_batch / dt / ndev
+        emit(**mem_rec)
         emit(metric=metric, value=round(ips_chip, 1),
              unit="images/sec/chip", steps_per_call=K,
-             vs_baseline=(round(ips_chip / vs, 3) if vs else None))
+             vs_baseline=(round(ips_chip / vs, 3) if vs else None),
+             **cost_fields)
 
     def bert_config(metric, cfg_name, optimizer, batch_per_chip, seqlen,
                     iters, warmup, steps_per_call=1, tiny=False):
@@ -636,12 +673,14 @@ def main():
             params, opt_state, _ = optimizer.step(params, opt_state, grads)
             return (params, opt_state), lax.pmean(loss, "data")
 
-        dt = timed_scan(ddp, step, (params, opt_state), (ids, mlm, nsp),
-                        ((B, seqlen), (B, seqlen), (B,)), K, iters,
-                        warmup)
+        dt, cost_fields, mem_rec = timed_scan(
+            ddp, step, (params, opt_state), (ids, mlm, nsp),
+            ((B, seqlen), (B, seqlen), (B,)), K, iters, warmup,
+            metric=metric)
+        emit(**mem_rec)
         emit(metric=metric, value=round(B / dt / ndev, 1),
              unit="sequences/sec/chip", steps_per_call=K,
-             vs_baseline=None)
+             vs_baseline=None, **cost_fields)
 
     def gpt_config(metric, cfg, batch_per_chip, seqlen, iters, warmup,
                    steps_per_call=1, model_cls=None):
@@ -671,11 +710,13 @@ def main():
                                                   grads)
             return (params, opt_state), lax.pmean(loss, "data")
 
-        dt = timed_scan(ddp, step, (params, opt_state), (ids,),
-                        ((B, seqlen),), K, iters, warmup)
+        dt, cost_fields, mem_rec = timed_scan(
+            ddp, step, (params, opt_state), (ids,),
+            ((B, seqlen),), K, iters, warmup, metric=metric)
+        emit(**mem_rec)
         emit(metric=metric, value=round(B / dt / ndev, 1),
              unit="sequences/sec/chip", steps_per_call=K,
-             vs_baseline=None)
+             vs_baseline=None, **cost_fields)
 
     def gpt_decode_config(metric, cfg, batch, prompt, new_tokens,
                           int8_weights=False, int8_cache=False,
@@ -767,10 +808,13 @@ def main():
                                                   grads)
             return (params, opt_state), lax.pmean(loss, "data")
 
-        dt = timed_scan(ddp, step, (params, opt_state), (src, tgt),
-                        ((B, src_len), (B, tgt_len)), 1, iters, warmup)
+        dt, cost_fields, mem_rec = timed_scan(
+            ddp, step, (params, opt_state), (src, tgt),
+            ((B, src_len), (B, tgt_len)), 1, iters, warmup,
+            metric=metric)
+        emit(**mem_rec)
         emit(metric=metric, value=round(B / dt / ndev, 1),
-             unit="sequences/sec/chip", vs_baseline=None)
+             unit="sequences/sec/chip", vs_baseline=None, **cost_fields)
 
     def engine_config(metric, cfg, slots, prompt, new_tokens,
                       model_cls=None, rolling=False, window=1):
@@ -812,6 +856,7 @@ def main():
         s = eng.stats()
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
+             kv_cache_bytes=s["kv_cache_bytes"],
              tokens_per_sync=round(s["tokens_per_sync"], 2),
              note=f"continuous batching, {slots} slots, decode window="
                   f"{window} (host syncs 1/{window} per token), prompt="
@@ -857,6 +902,7 @@ def main():
         dt = time.perf_counter() - t0
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
+             kv_cache_bytes=eng.stats()["kv_cache_bytes"],
              note=f"seq2seq continuous batching, {slots} slots, "
                   f"decode window={window}, src<={src_len}, "
                   f"{new_tokens} new/request, encoder pass per "

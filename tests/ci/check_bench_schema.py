@@ -17,10 +17,15 @@ Graph-lint records (``kind:
 graph_lint`` / ``graph_lint_summary``, from ``python -m
 apex_tpu.analysis``, ``bench.py --graph-lint`` or
 tests/ci/graph_lint.py) are validated against the lint schema
-(``validate_lint_record``), and fleet snapshots (``kind: fleet``,
+(``validate_lint_record``), fleet snapshots (``kind: fleet``,
 from ``bench.py --fleet N`` / ``Fleet.record()``) against the fleet
-schema (``validate_fleet_record``); all record families may
-interleave in one stream.  Usage:
+schema (``validate_fleet_record``), and cost-model dumps (``kind:
+memory``, from ``python -m apex_tpu.analysis --memory`` or the
+per-train-config records bench emits) against the memory schema
+(``validate_memory_record``, incl. the peak_bytes reassembly
+arithmetic); at schema v3 fresh train-throughput lines must carry the
+MFU fields and fresh engine-decode lines ``kv_cache_bytes``.  All
+record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
     python bench.py --fleet 2 | python tests/ci/check_bench_schema.py

@@ -24,6 +24,16 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    warnings but do not gate — the byte/plan fields and the tier-1
    suite are the portable CPU signals, hardware lines are the timing
    signal.  ``--strict-cpu`` promotes them to errors.
+3. **Peak-memory / MFU regression** (schema v3 cost-model fields).
+   ``peak_bytes`` — on train-throughput lines and ``kind: memory``
+   records — is a property of the COMPILED executable, deterministic
+   on any backend, so growth past ``--mem-tol`` (default 25%) gates
+   even on CPU: a step that suddenly plans 30% more device memory
+   regressed no matter how noisy the host clock is (ROADMAP item 4's
+   "pin peak-memory in bench").  ``mfu`` is timing-derived, so its
+   regressions follow the same accelerator-gates / CPU-warns policy
+   as throughput.  Stale replays are partitioned out of both trends
+   exactly like throughput lines.
 
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
@@ -36,6 +46,7 @@ Usage::
     python tests/ci/check_bench_trend.py                 # repo root
     python tests/ci/check_bench_trend.py --dir /path     # other history
     python tests/ci/check_bench_trend.py --tol 0.4
+    python tests/ci/check_bench_trend.py --mem-tol 0.1
     python tests/ci/check_bench_trend.py --strict-cpu
 
 Exit 0 = trend clean (warnings allowed), 1 = any error.  Pure stdlib —
@@ -145,7 +156,16 @@ def direction(rec):
     return "higher"
 
 
-def check(directory, tol=0.25, strict_cpu=False, out=sys.stderr):
+def _mem_subject(rec):
+    """Trend key for a peak-bytes / mfu carrier: the bench metric or
+    the analysis entry point (``kind: memory`` records from
+    ``python -m apex_tpu.analysis --memory``)."""
+    s = rec.get("metric") or rec.get("entry_point")
+    return s if isinstance(s, str) and s else None
+
+
+def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
+          out=sys.stderr):
     rounds = load_rounds(directory)
     if not rounds:
         print(f"trend: no BENCH_r*.json under {directory}", file=out)
@@ -153,11 +173,68 @@ def check(directory, tol=0.25, strict_cpu=False, out=sys.stderr):
     errors, warnings = [], []
     # (metric, backend) -> (round_name, value, unit) of last FRESH line
     last_fresh = {}
+    # (subject, backend) -> (round_name, value) of the cost-model trends
+    last_mem = {}
+    last_mfu = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
+
+    def track_cost_fields(rname, rec):
+        """Peak-memory and MFU trends for one fresh record (bench line
+        or ``kind: memory`` dump).  peak_bytes is compiled-plan
+        deterministic -> gates on every backend; mfu is timing ->
+        follows the CPU-warns policy."""
+        subject = _mem_subject(rec)
+        if subject is None:
+            return
+        key = (subject, rec.get("backend"))
+        peak = rec.get("peak_bytes")
+        if isinstance(peak, (int, float)) and not isinstance(peak, bool) \
+                and peak > 0:
+            prev = last_mem.get(key)
+            if prev is not None:
+                pname, pval = prev
+                growth = (peak - pval) / pval
+                if growth > mem_tol:
+                    errors.append(
+                        f"{rname}: {subject} "
+                        f"[{rec.get('backend') or '?'}] peak memory "
+                        f"grew {growth * 100:.0f}% vs {pname} "
+                        f"({pval} -> {peak} bytes, mem-tol "
+                        f"{mem_tol * 100:.0f}%) — the compiled plan "
+                        f"reserves more device memory")
+            last_mem[key] = (rname, float(peak))
+        mfu = rec.get("mfu")
+        if isinstance(mfu, (int, float)) and not isinstance(mfu, bool) \
+                and mfu > 0:
+            prev = last_mfu.get(key)
+            if prev is not None:
+                pname, pval = prev
+                drop = (pval - mfu) / pval
+                if drop > tol:
+                    msg = (f"{rname}: {subject} "
+                           f"[{rec.get('backend') or '?'}] MFU "
+                           f"regressed {drop * 100:.0f}% vs {pname} "
+                           f"({pval:.4g} -> {mfu:.4g}, tol "
+                           f"{tol * 100:.0f}%)")
+                    if is_cpu(rec) and not strict_cpu:
+                        warnings.append(msg + " [cpu smoke: warning "
+                                        "only]")
+                    else:
+                        errors.append(msg)
+            last_mfu[key] = (rname, float(mfu))
+
     for rname, recs in rounds:
         wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
         for rec in recs:
+            # ``kind: memory`` records are not throughput measurements
+            # but carry the peak-bytes trend; stale replays stay out
+            if isinstance(rec, dict) and rec.get("kind") == "memory":
+                if is_stale(rec):
+                    n_stale += 1
+                elif "error" not in rec:
+                    track_cost_fields(rname, rec)
+                continue
             if not is_measurement(rec):
                 continue
             if is_stale(rec):
@@ -181,6 +258,7 @@ def check(directory, tol=0.25, strict_cpu=False, out=sys.stderr):
                 # fresh baseline if it IS a replay
                 continue
             n_fresh += 1
+            track_cost_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:
@@ -238,10 +316,17 @@ def main(argv):
     ap.add_argument("--strict-cpu", action="store_true",
                     help="gate CPU-smoke regressions too (default: "
                          "warn only — the shared CPU host is noisy)")
+    ap.add_argument("--mem-tol", type=float, default=0.25,
+                    help="peak-memory growth tolerance (fraction, "
+                         "default 0.25; gates on every backend — the "
+                         "compiled plan is deterministic)")
     args = ap.parse_args(argv[1:])
     if args.tol < 0:
         ap.error(f"--tol must be >= 0, got {args.tol}")
-    return check(args.dir, tol=args.tol, strict_cpu=args.strict_cpu)
+    if args.mem_tol < 0:
+        ap.error(f"--mem-tol must be >= 0, got {args.mem_tol}")
+    return check(args.dir, tol=args.tol, strict_cpu=args.strict_cpu,
+                 mem_tol=args.mem_tol)
 
 
 if __name__ == "__main__":

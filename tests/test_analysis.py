@@ -263,6 +263,136 @@ def test_layout_rule_6d_budget():
     assert _run(budgeted, "layout") == []
 
 
+# -- flop accounting rule -------------------------------------------------
+
+def test_flop_rule_flags_unexplained_delta():
+    """A graph that traces twice the budgeted FLOPs is work nobody
+    accounted for — the ZeRO/paged-KV refactors must not silently grow
+    the step."""
+    a = jnp.ones((32, 32))
+    one_dot = 2 * 32 * 32 * 32
+
+    broken = _ep("mutant_flop_delta",
+                 expect={"flops": {"expected_flops": one_dot,
+                                   "rtol": 0.05}},
+                 trace=lambda: jax.make_jaxpr(lambda a, b: a @ b @ b)(
+                     a, a))
+    found = _run(broken, "flop-accounting")
+    assert len(found) == 1
+    assert "unexplained FLOP delta" in found[0].message
+    assert found[0].detail["flops"] == 2 * one_dot
+
+    fixed = _ep("fixed_flop_delta",
+                expect={"flops": {"expected_flops": one_dot,
+                                  "rtol": 0.05}},
+                trace=lambda: jax.make_jaxpr(lambda a, b: a @ b)(a, a))
+    assert _run(fixed, "flop-accounting") == []
+
+
+def test_flop_rule_flags_fp32_matmul_fraction():
+    """The flops-weighted upcast check: a forced fp32 conv under a
+    bf16-policy cap carries 100% of the matmul FLOPs in fp32."""
+    expect = {"flops": {"max_fp32_matmul_fraction": 0.02,
+                        "min_matmul_flops": 1}}
+    broken = _ep("mutant_fp32_flops", expect={"flops": dict(expect["flops"])},
+                 trace=_conv_graph(jnp.float32))
+    found = _run(broken, "flop-accounting")
+    assert len(found) == 1
+    assert found[0].detail["fp32_matmul_fraction"] == 1.0
+
+    fixed = _ep("fixed_bf16_flops", expect={"flops": dict(expect["flops"])},
+                trace=_conv_graph(jnp.bfloat16))
+    assert _run(fixed, "flop-accounting") == []
+
+
+def test_flop_rule_vacuity_guard():
+    empty = _ep("mutant_matmulless",
+                expect={"flops": {"max_fp32_matmul_fraction": 0.02,
+                                  "min_matmul_flops": 1}},
+                trace=lambda: jax.make_jaxpr(lambda x: x * 2.0)(
+                    jnp.ones((4,))))
+    found = _run(empty, "flop-accounting")
+    assert len(found) == 1
+    assert "vacuous" in found[0].message
+
+
+# -- memory budget rule ---------------------------------------------------
+
+def test_memory_rule_flags_seeded_over_budget():
+    """A seeded over-budget graph (triple-copy temp) flags; the same
+    graph under an honest budget passes."""
+    def bloated(x):
+        big = jnp.concatenate([x, x, x])
+        return big.sum()
+
+    trace = lambda: jax.make_jaxpr(bloated)(jnp.ones((1024,)))  # noqa: E731
+    # args 4KB + 12KB temp = 16KB peak; budget 8KB flags
+    broken = _ep("mutant_over_budget",
+                 expect={"memory": {"budget_bytes": 8 * 1024}},
+                 trace=trace)
+    found = _run(broken, "memory-budget")
+    assert len(found) == 1
+    assert found[0].detail["peak_live_bytes"] > 8 * 1024
+    assert found[0].severity == "error"
+
+    fixed = _ep("fixed_over_budget",
+                expect={"memory": {"budget_bytes": 32 * 1024}},
+                trace=trace)
+    assert _run(fixed, "memory-budget") == []
+
+
+def test_memory_rule_flags_live_to_argument_ratio():
+    def dup(x):
+        return jnp.concatenate([x, x, x, x]).sum()
+
+    broken = _ep("mutant_ratio",
+                 expect={"memory": {"max_live_to_argument_ratio": 3.0}},
+                 trace=lambda: jax.make_jaxpr(dup)(jnp.ones((1024,))))
+    found = _run(broken, "memory-budget")
+    assert len(found) == 1
+    assert found[0].detail["ratio"] > 3.0
+
+    lean = _ep("fixed_ratio",
+               expect={"memory": {"max_live_to_argument_ratio": 3.0}},
+               trace=lambda: jax.make_jaxpr(lambda x: (x * 2).sum())(
+                   jnp.ones((1024,))))
+    assert _run(lean, "memory-budget") == []
+
+
+def test_memory_rule_flags_fp32_upcast_under_o2():
+    """The fp32-upcast mutation: the same matmul pipeline with operands
+    upcast to fp32 doubles the fp32 temp bytes and fails lint; the
+    bf16 twin passes under the same budget."""
+    w = jnp.ones((256, 256), jnp.bfloat16)
+    x = jnp.ones((64, 256), jnp.bfloat16)
+
+    def clean(x):
+        h = jnp.maximum(x @ w, 0)
+        return (h @ w).astype(jnp.float32).sum()
+
+    def upcast(x):
+        h = jnp.maximum(x.astype(jnp.float32) @ w.astype(jnp.float32),
+                        0)
+        return (h @ w.astype(jnp.float32)).sum()
+
+    from apex_tpu.observability import memory as obsmem
+    clean_f32 = obsmem.jaxpr_live_bytes(jax.make_jaxpr(clean)(x))[
+        "peak_temp_bytes_by_dtype"].get("float32", 0)
+    budget = {"memory": {"temp_budget_bytes_by_dtype":
+                         {"float32": 2 * max(clean_f32, 1)}}}
+    broken = _ep("mutant_fp32_upcast", expect=dict(budget),
+                 trace=lambda: jax.make_jaxpr(upcast)(x))
+    found = _run(broken, "memory-budget")
+    assert len(found) == 1
+    assert found[0].detail["dtype"] == "float32"
+    assert found[0].detail["peak_temp_bytes"] > \
+        found[0].detail["budget_bytes"]
+
+    fixed = _ep("fixed_bf16_pipeline", expect=dict(budget),
+                trace=lambda: jax.make_jaxpr(clean)(x))
+    assert _run(fixed, "memory-budget") == []
+
+
 # -- collective accounting rule -------------------------------------------
 
 def _psum_graph(n_psums):
@@ -503,7 +633,8 @@ def test_telemetry_jsonl_validates_mixed_stream():
     bench_rec = exporters.JsonlExporter.enrich(
         {"metric": "engine_decode", "value": 100.0,
          "unit": "tokens/sec", "backend": "cpu", "ndev": 1,
-         "arch": "gpt", "window": 8, "tokens_per_sync": 8.0})
+         "arch": "gpt", "window": 8, "tokens_per_sync": 8.0,
+         "kv_cache_bytes": 65536})    # required fresh at schema v3
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
     fleet_rec = exporters.JsonlExporter.enrich(
@@ -546,9 +677,47 @@ def test_telemetry_jsonl_validates_mixed_stream():
     assert any("window" in e for e in errs)
 
 
+def test_memory_record_schema_and_dispatch():
+    """``kind: memory`` record contract (satellite): required analytic
+    + plan fields, the peak_bytes reassembly cross-check, and the
+    telemetry dispatcher growing bench|lint|fleet|trace|memory."""
+    good = exporters.JsonlExporter.enrich({
+        "kind": "memory", "entry_point": "engine_step_k",
+        "source": "compiled", "flops": 1.5e6, "transcendentals": 100.0,
+        "matmul_flops": 1.4e6, "bytes_accessed": 2_000_000,
+        "argument_bytes": 1000, "output_bytes": 1000,
+        "temp_bytes": 500, "alias_bytes": 900,
+        "generated_code_bytes": 0, "peak_bytes": 1600,
+        "analytic_live_bytes": 1400})
+    assert exporters.validate_memory_record(good) == []
+    # kind-dispatched, not bench-shaped
+    assert exporters.validate_telemetry_record(good) == []
+    # arithmetic cross-check: a peak that doesn't reassemble flags
+    assert any("peak_bytes" in e for e in
+               exporters.validate_memory_record(
+                   dict(good, peak_bytes=9999)))
+    # a subject is required
+    assert any("entry_point" in e for e in
+               exporters.validate_memory_record(
+                   {k: v for k, v in good.items()
+                    if k != "entry_point"}))
+    assert any("flops" in e for e in
+               exporters.validate_memory_record(
+                   {k: v for k, v in good.items() if k != "flops"}))
+    assert any("temp_bytes" in e for e in
+               exporters.validate_memory_record(
+                   dict(good, temp_bytes=-1)))
+    # positionally caught in a mixed stream
+    import json
+    errs = exporters.validate_telemetry_jsonl(
+        [json.dumps(good), json.dumps(dict(good, peak_bytes=9999))])
+    assert len(errs) == 1 and "line 2" in errs[0]
+
+
 def test_findings_to_records_and_registry_surface():
     assert set(analysis.RULES) == {"host-transfer", "donation",
-                                   "amp-dtype", "layout", "collective"}
+                                   "amp-dtype", "layout", "collective",
+                                   "flop-accounting", "memory-budget"}
     for name in ("ddp_resnet18_o2", "engine_step_k", "seq2seq_step_k",
                  "tp_mlp_train_step"):
         assert name in analysis.ENTRY_POINTS
@@ -602,6 +771,23 @@ def test_cli_list_and_single_entry_point(capsys):
     last = json.loads(out.strip().splitlines()[-1])
     assert last["kind"] == "graph_lint_summary"
     assert last["errors"] == 0
+
+
+def test_cli_memory_flag(capsys):
+    """`python -m apex_tpu.analysis --memory` (satellite): pure
+    schema-valid JSONL, one ``kind: memory`` record per entry point,
+    analytic FLOPs + the compiled plan side by side."""
+    from apex_tpu.analysis.__main__ import main
+    assert main(["--memory",
+                 "--entry-points", "engine_prefill_slot"]) == 0
+    out = capsys.readouterr().out
+    assert exporters.validate_telemetry_jsonl(out.splitlines()) == []
+    import json
+    (rec,) = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert rec["kind"] == "memory"
+    assert rec["entry_point"] == "engine_prefill_slot"
+    assert rec["flops"] > 0 and rec["peak_bytes"] > 0
+    assert rec["alias_bytes"] > 0             # donation plan visible
 
 
 def test_cli_exit_nonzero_on_finding(monkeypatch):

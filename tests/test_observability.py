@@ -790,7 +790,8 @@ def test_bench_record_schema_serving_decode_window_fields():
     valid without them."""
     base = {"metric": "gpt_tiny_engine_decode_throughput", "value": 9.0,
             "unit": "tokens/sec/chip", "vs_baseline": None,
-            "backend": "cpu", "ndev": 8, "arch": "cpu"}
+            "backend": "cpu", "ndev": 8, "arch": "cpu",
+            "kv_cache_bytes": 16384}    # required fresh at schema v3
     good = exporters.JsonlExporter.enrich(
         dict(base, window=8, tokens_per_sync=7.5))
     assert exporters.validate_bench_record(good) == []
@@ -798,6 +799,15 @@ def test_bench_record_schema_serving_decode_window_fields():
     missing = exporters.JsonlExporter.enrich(dict(base))
     assert any("window" in e
                for e in exporters.validate_bench_record(missing))
+    # missing kv_cache_bytes on a fresh v3 decode line too (PR 8)
+    nokv = {k: v for k, v in base.items() if k != "kv_cache_bytes"}
+    assert any("kv_cache_bytes" in e
+               for e in exporters.validate_bench_record(
+                   exporters.JsonlExporter.enrich(dict(nokv, window=8))))
+    # ...but an archived v2 line stays valid at its declared version
+    v2 = exporters.JsonlExporter.enrich(dict(nokv, window=8))
+    v2["schema_version"] = 2
+    assert exporters.validate_bench_record(v2) == []
     # wrong types / values are caught wherever the field appears
     for w in (0, -2, 1.5, True, "8"):
         bad = exporters.JsonlExporter.enrich(dict(base, window=w))
@@ -806,6 +816,10 @@ def test_bench_record_schema_serving_decode_window_fields():
     bad = exporters.JsonlExporter.enrich(
         dict(base, window=8, tokens_per_sync="lots"))
     assert any("tokens_per_sync" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = exporters.JsonlExporter.enrich(
+        dict(base, window=8, kv_cache_bytes=-5))
+    assert any("kv_cache_bytes" in e
                for e in exporters.validate_bench_record(bad))
     # a windowed line must report tokens/sec
     bad = exporters.JsonlExporter.enrich(
@@ -830,8 +844,22 @@ def test_bench_emits_schema_valid_jsonl(tmp_path):
     fresh = exporters.JsonlExporter.enrich(
         {"metric": bench.HEADLINE_METRIC, "value": 1830.0,
          "unit": "images/sec/chip", "vs_baseline": 11.7,
-         "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite"})
+         "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite",
+         # schema-v3 cost-model fields every fresh train line carries
+         "flops_per_step": 3.15e12, "achieved_tflops": 45.0,
+         "mfu": 0.228, "peak_bytes": 9_000_000_000})
     assert exporters.validate_bench_record(fresh) == []
+    # the v3 requirement bites: a fresh train line without them flags
+    bare = {k: v for k, v in fresh.items()
+            if k not in ("flops_per_step", "achieved_tflops", "mfu",
+                         "peak_bytes")}
+    assert any("flops_per_step" in e
+               for e in exporters.validate_bench_record(bare))
+    # archived v2 train lines (and stale replays) stay valid
+    v2 = dict(bare)
+    v2["schema_version"] = 2
+    assert exporters.validate_bench_record(v2) == []
+    assert exporters.validate_bench_record(dict(bare, stale=True)) == []
     p = str(tmp_path / "rec.json")
     bench.save_tpu_record([fresh], path=p, now="2026-07-30T04:55:00Z")
     rec = bench.load_tpu_record(path=p)
@@ -976,6 +1004,87 @@ def test_check_bench_trend_gate(tmp_path):
     assert r.returncode == 1
 
 
+def test_check_bench_trend_memory_and_mfu_gate(tmp_path):
+    """The PR 8 trend columns: peak-memory growth past --mem-tol gates
+    on EVERY backend (the compiled plan is deterministic — CPU noise
+    is no excuse), stale replays stay partitioned out, kind: memory
+    records trend by entry point, and MFU drops follow the same
+    accelerator-gates / CPU-warns policy as throughput."""
+
+    def train(value, peak, mfu=None, backend="cpu", **kw):
+        rec = {"metric": "resnet18_train_throughput", "value": value,
+               "unit": "images/sec/chip", "vs_baseline": None,
+               "backend": backend, "ndev": 8, "arch": backend,
+               "peak_bytes": peak}
+        if mfu is not None:
+            rec["mfu"] = mfu
+        return exporters.JsonlExporter.enrich({**rec, **kw})
+
+    # peak-memory regression on a CPU backend: throughput noise warns,
+    # but the 40% plan growth is an error
+    d1 = tmp_path / "mem1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [train(100.0, 1_000_000)])
+    _trend_round(d1, "BENCH_r02.json", [train(101.0, 1_400_000)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "peak memory grew 40%" in r.stderr
+    # ...within a loosened --mem-tol it passes
+    r = _run_trend(["--dir", str(d1), "--mem-tol", "0.5"])
+    assert r.returncode == 0, r.stderr
+
+    # a stale replay carrying a bigger peak is partitioned out
+    d2 = tmp_path / "mem2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [train(100.0, 1_000_000)])
+    _trend_round(d2, "BENCH_r02.json",
+                 [train(100.0, 9_000_000, stale=True)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 0, r.stderr
+
+    # kind: memory records trend by entry point
+    def memrec(peak):
+        return exporters.JsonlExporter.enrich(
+            {"kind": "memory", "entry_point": "engine_step_k",
+             "source": "compiled", "flops": 1e6, "backend": "cpu",
+             "peak_bytes": peak})
+
+    d3 = tmp_path / "mem3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [memrec(1_000_000)])
+    _trend_round(d3, "BENCH_r02.json", [memrec(1_500_000)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 1 and "engine_step_k" in r.stderr
+    # identical plans across rounds are the normal case: clean
+    d3b = tmp_path / "mem3b"
+    d3b.mkdir()
+    _trend_round(d3b, "BENCH_r01.json", [memrec(1_000_000)])
+    _trend_round(d3b, "BENCH_r02.json", [memrec(1_000_000)])
+    assert _run_trend(["--dir", str(d3b)]).returncode == 0
+
+    # MFU: accelerator drop past tol gates, CPU drop warns
+    d4 = tmp_path / "mfu1"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json",
+                 [train(1000.0, 1_000_000, mfu=0.20, backend="tpu",
+                        arch="TPU v5 lite")])
+    _trend_round(d4, "BENCH_r02.json",
+                 [train(990.0, 1_000_000, mfu=0.10, backend="tpu",
+                        arch="TPU v5 lite")])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 1 and "MFU regressed" in r.stderr
+    d5 = tmp_path / "mfu2"
+    d5.mkdir()
+    _trend_round(d5, "BENCH_r01.json", [train(100.0, 1_000_000,
+                                              mfu=0.02)])
+    _trend_round(d5, "BENCH_r02.json", [train(99.0, 1_000_000,
+                                              mfu=0.01)])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 0 and "MFU regressed" in r.stderr \
+        and "WARNING" in r.stderr
+    assert _run_trend(["--dir", str(d5), "--strict-cpu"]).returncode == 1
+
+
 # -- engine telemetry -----------------------------------------------------
 
 def _gpt(seed=0):
@@ -1009,6 +1118,48 @@ def test_engine_stats_enriched_fields():
     assert s["prefix_hits"] == 0 and s["prefix_hit_rate"] == 0.0
     for rid in rids:
         assert len(eng.result(rid)) == 4
+
+
+def test_engine_stats_memory_fields():
+    """Engine.stats() memory surface (PR 8): kv_cache_bytes recomputed
+    from the live cache buffers, the live-array census, the
+    engine_kv_cache_bytes gauge, and HBM fields None on a CPU-style
+    backend (no fabricated occupancy)."""
+    m, params = _gpt()
+    eng = serving.Engine(m, params, slots=2, buf_len=24)
+    s = eng.stats()
+    expect_kv = sum(leaf.nbytes
+                    for leaf in jax.tree_util.tree_leaves(eng.cache))
+    assert s["kv_cache_bytes"] == expect_kv > 0
+    assert eng.kv_cache_bytes() == expect_kv
+    assert eng.metrics.gauge("engine_kv_cache_bytes").value == expect_kv
+    # the census sees at least this engine's cache + params
+    assert s["device_live_bytes"] >= expect_kv
+    assert eng.metrics.gauge("device_live_bytes").value \
+        == s["device_live_bytes"]
+    # CPU backend reports no hardware memory stats — fields are None,
+    # not a made-up ratio
+    assert s["hbm_bytes_in_use"] is None
+    assert s["hbm_bytes_limit"] is None
+    assert s["hbm_occupancy"] is None
+    # a prefix pool adds its rows to the engine's KV footprint
+    pooled = serving.Engine(m, params, slots=2, buf_len=24,
+                            prefix_pool=1)
+    assert pooled.kv_cache_bytes() > expect_kv
+
+
+def test_seq2seq_engine_stats_memory_fields():
+    model = models.T5(models.T5Config(
+        vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=1,
+        num_heads=4, dropout_rate=0.0, relative_attention_num_buckets=8,
+        relative_attention_max_distance=16))
+    t5p, _ = model.init(jax.random.PRNGKey(0))
+    eng = serving.Seq2SeqEngine(model, t5p, slots=2, src_len=8,
+                                max_new_cap=8)
+    s = eng.stats()
+    expect = sum(leaf.nbytes
+                 for leaf in jax.tree_util.tree_leaves(eng.state))
+    assert s["kv_cache_bytes"] == expect > 0
 
 
 def test_engine_stats_prefix_cache_hit_rate():
