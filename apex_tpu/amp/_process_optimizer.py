@@ -284,7 +284,8 @@ class AmpOptimizer(Optimizer):
     def step(self, params: Any = None, opt_state: AmpOptState = None,
              scaled_grads: Any = None, loss_id: int = 0,
              found_inf_extra: Optional[jax.Array] = None,
-             found_inf_axes: Optional[Sequence[str]] = None
+             found_inf_axes: Optional[Sequence[str]] = None,
+             grad_health: Any = None
              ) -> Tuple[Any, AmpOptState, dict]:
         """Unscale grads, update the scaler, apply-or-skip the inner update.
 
@@ -300,6 +301,16 @@ class AmpOptimizer(Optimizer):
         step code runs inside and outside shard_map.
         Returns (new_params, new_opt_state, info).
 
+        ``grad_health``: an enabled
+        ``observability.numerics.NumericsMonitor`` built over the
+        gradient tree — per-layer nonfinite/abs-max/norm/underflow
+        stats (pure local jnp math on the pre-pack tree, at the
+        scaler's CURRENT loss scale) come back as
+        ``info["grad_health"]`` so a skipped step can name the culprit
+        layer instead of just counting the skip.  ``None`` (or a
+        disabled monitor) computes nothing and leaves the traced graph
+        byte-identical — the key is simply absent from ``info``.
+
         Called with no arguments in eager mode (after amp.stateful.bind +
         scale_loss/backward), it steps the bound state like torch's
         ``optimizer.step()``.
@@ -310,6 +321,14 @@ class AmpOptimizer(Optimizer):
                                    "bound optimizer (amp.stateful.bind)")
             return self._bound.step()
         sstate = opt_state.scalers[loss_id]
+        health_stats = None
+        if grad_health is not None and getattr(grad_health, "enabled",
+                                               True):
+            # on the tree, BEFORE the flat-buffer pack: per-layer
+            # boundaries only exist here, and the stats are what the
+            # overflow attribution and underflow accounting read
+            health_stats = grad_health.leaf_stats(scaled_grads,
+                                                  sstate.loss_scale)
         flat = isinstance(opt_state.masters, FlatMasters)
         zaxis = (opt_state.masters.layout.zero_axis
                  if flat else None)
@@ -421,6 +440,8 @@ class AmpOptimizer(Optimizer):
                 "loss_scale": new_sstate.loss_scale,
                 "steps_skipped": new_sstate.steps_skipped,
                 "grad_norm": grad_norm}
+        if health_stats is not None:
+            info["grad_health"] = health_stats
         return new_params, AmpOptState(inner=new_inner, masters=new_masters,
                                        scalers=scalers), info
 

@@ -347,12 +347,19 @@ def amp_stats(opt) -> dict:
 
 
 def record_scaler(opt, registry=None, step: Optional[int] = None,
-                  emit_event: bool = False, prefix: str = "amp_") -> dict:
+                  emit_event: bool = False, prefix: str = "amp_",
+                  numerics: Optional[dict] = None) -> dict:
     """Fold the scaler snapshot into an observability registry: gauge
     ``amp_loss_scale``, counter ``amp_steps_skipped_total``.  With
     ``emit_event=True`` also appends a loss-scale timeline point to the
     default span recorder's JSONL event log (tag it with ``step`` to
     reconstruct the timeline offline).
+
+    ``numerics``: a flushed ``observability.numerics.NumericsMonitor``
+    summary (``nm.flush(tele)``) for the SAME optimizer's gradients —
+    a detected skip's flight-ring event then carries the culprit
+    bucket/layer (``culprit`` / ``culprit_nonfinite``), not just the
+    skip count (overflow attribution, PR 9).
 
     One optimizer per (registry, ``prefix``): the gauge/counter are
     plain totals, so two optimizers recorded through the same pair
@@ -372,6 +379,9 @@ def record_scaler(opt, registry=None, step: Optional[int] = None,
     ev = {"loss_scale": stats["loss_scale"],
           "steps_skipped": stats["steps_skipped"],
           "prefix": prefix}
+    if numerics is not None and numerics.get("culprit") is not None:
+        ev["culprit"] = numerics["culprit"]
+        ev["culprit_nonfinite"] = numerics.get("culprit_nonfinite")
     if step is not None:
         ev["step"] = int(step)
     if stats["steps_skipped"] > prev_skips:

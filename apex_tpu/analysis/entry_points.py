@@ -184,17 +184,24 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
                       input_format="NCHW", stem="conv7",
                       telemetry=False, B=8, image=32,
                       comm_topology="flat", compress=False,
-                      ici_size=None):
+                      ici_size=None, numerics=None):
     """Trace the REAL DDP train step — shard_map over the 8-device CPU
     mesh with the grad allreduce inside — the same graph bench.py's
     headline and examples/imagenet execute.  ``telemetry=True`` threads
     a DeviceMetrics state through the step carry (the fully
-    instrumented shape of the hot loop)."""
+    instrumented shape of the hot loop).  ``numerics="on"`` threads a
+    NumericsMonitor through the carry — per-layer grad health from
+    ``opt.step(grad_health=...)``, per-bucket stats from
+    ``allreduce_grads(numerics_out=...)``, and the one-psum divergence
+    digest over the updated params; ``numerics="off"`` runs the SAME
+    step code with a disabled monitor, which must trace byte-identical
+    to the uninstrumented baseline (the numerics rule pins both)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
     from .. import amp, observability, optimizers, parallel, models
     from ..nn import functional as F
+    from ..observability import numerics as obs_numerics
 
     model, opt = amp.initialize(
         models.resnet18(num_classes=10, channels_last=channels_last,
@@ -213,10 +220,25 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
     dm = observability.DeviceMetrics(
         counters=("steps", "overflows"),
         gauges=("loss_scale", "grad_norm")) if telemetry else None
+    nm = None
+    digest_plan = []
+    if numerics is not None:
+        grad_plan = parallel.allreduce_comm_plan(
+            params, comm_topology=comm_topology,
+            allreduce_compress_bf16=compress, ici_size=ici_size,
+            world=len(jax.devices()), nproc=1)
+        digest_plan = obs_numerics.digest_comm_plan(params)
+        nm = obs_numerics.NumericsMonitor(
+            params, half_dtype="bfloat16",
+            bucket_labels=obs_numerics.bucket_labels(grad_plan),
+            digest=True, axis_name="data",
+            enabled=(numerics == "on"))
 
     def step(state, batch):
         if telemetry:
             params, bn, ost, tele = state
+        elif nm is not None:
+            params, bn, ost, ntele = state
         else:
             params, bn, ost = state
         xb, yb = batch
@@ -226,6 +248,17 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
             return F.cross_entropy(out, yb), nb
 
         loss, nb, g = amp.scaled_grad(loss_fn, params, ost, has_aux=True)
+        if nm is not None and nm.enabled:
+            nout: list = []
+            g = ddp.allreduce_grads(g, numerics_out=nout)
+            params, ost2, info = opt.step(params, ost, g,
+                                          grad_health=nm)
+            ntele = nm.update(ntele, grad_stats=info["grad_health"],
+                              bucket_stats=nout,
+                              found_inf=info["found_inf"],
+                              loss_scale=info["loss_scale"],
+                              sync_tree=params)
+            return (params, nb, ost2, ntele), jax.lax.pmean(loss, "data")
         g = ddp.allreduce_grads(g)
         params, ost2, info = opt.step(params, ost, g)
         if telemetry:
@@ -234,12 +267,29 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
             tele = dm.set(tele, "loss_scale", info["loss_scale"])
             tele = dm.set(tele, "grad_norm", info["grad_norm"])
             return (params, nb, ost2, tele), jax.lax.pmean(loss, "data")
+        if nm is not None:
+            # disabled monitor: ntele is an empty pytree and update is
+            # an identity — zero extra leaves, zero extra eqns, so the
+            # trace is byte-identical to the uninstrumented baseline
+            ntele = nm.update(ntele)
+            return (params, nb, ost2, ntele), jax.lax.pmean(loss, "data")
         return (params, nb, ost2), jax.lax.pmean(loss, "data")
 
     _fill_ddp_expectations(ep, opt_level, params,
                            comm_topology=comm_topology,
-                           compress=compress, ici_size=ici_size)
-    state = (params, bn, ost) + ((dm.init(),) if telemetry else ())
+                           compress=compress, ici_size=ici_size,
+                           extra_plan=digest_plan if (
+                               numerics == "on") else None)
+    if numerics is not None:
+        ep.expect.setdefault("numerics", {
+            "baseline": "ddp_resnet18_o2",
+            "enabled": numerics == "on",
+            "extra_collectives": {"psum": 1} if numerics == "on" else {},
+            "extra_payload_bytes": (digest_plan[0]["wire_bytes"]
+                                    if numerics == "on" else 0)})
+    state = (params, bn, ost) \
+        + ((dm.init(),) if telemetry else ()) \
+        + ((nm.init(),) if nm is not None else ())
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"), P("data"))),
@@ -254,7 +304,8 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
 
 
 def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
-                           compress=False, ici_size=None):
+                           compress=False, ici_size=None,
+                           extra_plan=None):
     """Derive the amp + collective expectations for a DDP train step.
 
     Comm accounting: the step's collective population is exactly the
@@ -282,10 +333,15 @@ def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
         params, comm_topology=comm_topology,
         allreduce_compress_bf16=compress, ici_size=ici_size,
         world=len(jax.devices()), nproc=1)
+    # ``extra_plan``: additional planned collectives beyond the grad
+    # reduction — the numerics divergence digest's one psum
+    # (numerics.digest_comm_plan) folds in here so the collective
+    # rule's expectations stay exact on instrumented steps
     ep.expect.setdefault(
         "collectives",
         parallel.plan_collective_expectations(
-            plan, extra_psums=2, extra_psum_bytes=2 * 4))
+            plan + list(extra_plan or []),
+            extra_psums=2, extra_psum_bytes=2 * 4))
     # cost/memory accounting (PR 8): under a bf16 compute policy no
     # measurable share of dot/conv FLOPs may run in fp32 (the silent
     # upcast halves MXU rate exactly where the flops are), and the
@@ -312,6 +368,29 @@ register_entry_point(
     description="DDP resnet18 O2 step with DeviceMetrics threaded "
                 "through the carry — must stay host-transfer-free")(
     lambda ep: _ddp_resnet_graph(ep, "O2", telemetry=True))
+
+# numerics observability (PR 9): the SAME O2 step with a
+# NumericsMonitor threaded through the carry — per-layer grad health
+# (amp's grad_health hook), per-bucket stats riding the allreduce
+# bucket structure, and the one-psum cross-replica divergence digest.
+# The numerics rule pins the contract both ways: the "on" variant adds
+# zero host transfers and EXACTLY the digest plan's collective delta
+# over the uninstrumented baseline; the "off" variant (same step code,
+# disabled monitor) must trace to the byte-identical jaxpr.
+register_entry_point(
+    "ddp_resnet18_o2_numerics", tags=("training", "ddp", "amp",
+                                      "numerics", "telemetry"),
+    description="DDP resnet18 O2 step with device-resident numerics "
+                "accounting (grad health + bucket stats + divergence "
+                "digest) — zero host transfers, plan-exact collectives")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", numerics="on"))
+
+register_entry_point(
+    "ddp_resnet18_o2_numerics_off", tags=("training", "ddp",
+                                          "numerics"),
+    description="DDP resnet18 O2 step with numerics DISABLED — must "
+                "lower byte-identical to the uninstrumented step")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", numerics="off"))
 
 register_entry_point(
     "ddp_resnet18_o2_nhwc", tags=("training", "ddp", "amp", "layout"),

@@ -80,6 +80,16 @@ runs where its key is present):
     cross-host psum flags even if the total happens to balance.
     ``parallel.plan_collective_expectations`` derives all three fields
     from ``allreduce_comm_plan``.
+
+``numerics``::
+
+    {"baseline": "ddp_resnet18_o2", "enabled": True,
+     "extra_collectives": {"psum": 1}, "extra_payload_bytes": 520}
+
+    The numerics-instrumentation pin (PR 9): enabled ⇒ zero host
+    transfers + collective census exactly the baseline's plus the
+    digest plan's delta; disabled ⇒ the step traces to the
+    byte-identical jaxpr of the baseline (no residue).
 """
 
 from __future__ import annotations
@@ -92,7 +102,7 @@ from . import graphs as G
 
 __all__ = ["HostTransferRule", "DonationRule", "AmpDtypeRule",
            "LayoutRule", "CollectiveRule", "FlopAccountingRule",
-           "MemoryBudgetRule"]
+           "MemoryBudgetRule", "NumericsRule"]
 
 
 @register_rule
@@ -377,6 +387,100 @@ class MemoryBudgetRule(Rule):
                         f"{cap:,}-byte budget — e.g. an fp32 upcast "
                         f"materializing a second activation tree",
                     dtype=dt, peak_temp_bytes=got, budget_bytes=cap))
+        return out
+
+
+@register_rule
+class NumericsRule(Rule):
+    """Numerics instrumentation is free where enabled and ABSENT where
+    disabled (PR 9's audit pin).  Expectation::
+
+        {"baseline": "ddp_resnet18_o2",      # name or EntryPoint/Graph
+         "enabled": True,
+         "extra_collectives": {"psum": 1},   # the divergence digest
+         "extra_payload_bytes": 520}
+
+    Enabled: the instrumented step must contain ZERO host-transfer
+    primitives (the accounting is device-resident; ``flush()`` is the
+    one fetch, outside the step) and its collective census must be
+    EXACTLY the baseline's plus the planned delta
+    (``numerics.digest_comm_plan`` derives it) — an instrumentation
+    change that sneaks an extra collective or callback into the hot
+    loop flags here before any profiler sees it.  Disabled: the step
+    must trace to the byte-identical jaxpr of the baseline — the
+    off-switch leaves no residue."""
+
+    name = "numerics"
+    expect_key = "numerics"
+
+    @staticmethod
+    def _baseline_graph(want):
+        base = want.get("baseline")
+        if base is None:
+            return None
+        if isinstance(base, str):
+            from .entry_points import get as _get_ep
+            return _get_ep(base).graph()
+        return base.graph() if hasattr(base, "graph") else base
+
+    def check(self, ep, graph) -> List[Finding]:
+        want = ep.expect["numerics"]
+        out: List[Finding] = []
+        base = self._baseline_graph(want)
+        if not want.get("enabled", True):
+            if base is None:
+                return [self.finding(
+                    ep, "a disabled-numerics expectation needs a "
+                        "baseline to compare against")]
+            ours, theirs = str(graph.jaxpr), str(base.jaxpr)
+            if ours != theirs:
+                n_eq = sum(1 for _ in G.walk_jaxpr(graph.jaxpr))
+                n_eq_b = sum(1 for _ in G.walk_jaxpr(base.jaxpr))
+                out.append(self.finding(
+                    ep, f"numerics residue: the disabled-numerics step "
+                        f"traces to a different jaxpr than the "
+                        f"uninstrumented baseline ({n_eq} vs {n_eq_b} "
+                        f"eqns) — the off-switch must be free",
+                    eqns=n_eq, baseline_eqns=n_eq_b))
+            return out
+        hits = Counter(e.primitive.name
+                       for e in G.host_transfer_eqns(graph.jaxpr))
+        for prim, n in sorted(hits.items()):
+            out.append(self.finding(
+                ep, f"numerics-instrumented step contains "
+                    f"host-transfer primitive {prim!r} {n}x — the "
+                    f"accounting must accumulate device-resident "
+                    f"(flush() is the one host fetch, outside the "
+                    f"step)", primitive=prim, count=n))
+        if base is not None:
+            got = Counter(e.primitive.name
+                          for e in G.collective_eqns(graph.jaxpr))
+            base_counts = Counter(
+                e.primitive.name for e in G.collective_eqns(base.jaxpr))
+            extra = dict(want.get("extra_collectives", {}))
+            for prim in sorted(set(got) | set(base_counts) | set(extra)):
+                w = base_counts.get(prim, 0) + extra.get(prim, 0)
+                g = got.get(prim, 0)
+                if g != w:
+                    out.append(self.finding(
+                        ep, f"expected {w} {prim} eqn(s) (baseline "
+                            f"{base_counts.get(prim, 0)} + planned "
+                            f"numerics delta {extra.get(prim, 0)}), "
+                            f"instrumented graph has {g}",
+                        primitive=prim, expected=w, got=g,
+                        baseline=base_counts.get(prim, 0)))
+            if "extra_payload_bytes" in want:
+                ours = sum(G.eqn_payload_bytes(e)
+                           for e in G.collective_eqns(graph.jaxpr))
+                theirs = sum(G.eqn_payload_bytes(e)
+                             for e in G.collective_eqns(base.jaxpr))
+                delta, w = ours - theirs, want["extra_payload_bytes"]
+                if delta != w:
+                    out.append(self.finding(
+                        ep, f"numerics adds {delta} collective payload "
+                            f"bytes over the baseline, the digest plan "
+                            f"budgets exactly {w}",
+                        payload_delta=delta, expected_delta=w))
         return out
 
 

@@ -29,6 +29,15 @@ the compiled memory plans / static liveness / live-array gauges
 behind ``peak_bytes`` gating, ``kind: memory`` records, and the
 ``flop-accounting`` / ``memory-budget`` lint rules.
 
+And **numerics** (PR 9): ``numerics``, device-resident gradient-health
+telemetry (per-layer/per-bucket nonfinite counts, abs-max, grad norm,
+underflow fraction at the current loss scale), overflow attribution
+(a skipped step's flight-ring event names the culprit layer), bf16
+DCN-hop quantization-error accounting, and the one-psum cross-replica
+divergence digest — all in-graph with zero host syncs (the
+``numerics`` lint rule pins it) behind ``kind: numerics`` records and
+``bench.py --numerics``.
+
 Wired consumers: ``serving.Engine``/``Seq2SeqEngine`` (enriched
 ``stats()``), ``parallel.distributed`` (comm accounting),
 ``amp`` (loss-scale/skip introspection + ``record_scaler``),
@@ -50,6 +59,8 @@ from .exporters import (SCHEMA_VERSION, JsonlExporter, prometheus_text,
 from .costmodel import Cost, jaxpr_cost, peak_flops, mfu
 from .memory import (memory_plan, jaxpr_live_bytes, live_array_bytes,
                      record_live_arrays)
+from .numerics import (NumericsMonitor, divergence_check,
+                       divergence_digest, digest_comm_plan)
 from . import metrics
 from . import tracing
 from . import flightrec
@@ -57,6 +68,7 @@ from . import steptime
 from . import exporters
 from . import costmodel
 from . import memory
+from . import numerics
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DeviceMetrics",
@@ -70,6 +82,8 @@ __all__ = [
     "Cost", "jaxpr_cost", "peak_flops", "mfu",
     "memory_plan", "jaxpr_live_bytes", "live_array_bytes",
     "record_live_arrays",
+    "NumericsMonitor", "divergence_check", "divergence_digest",
+    "digest_comm_plan",
     "metrics", "tracing", "flightrec", "steptime", "exporters",
-    "costmodel", "memory",
+    "costmodel", "memory", "numerics",
 ]

@@ -30,7 +30,7 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
            "validate_fleet_record", "validate_trace_record",
-           "validate_memory_record",
+           "validate_memory_record", "validate_numerics_record",
            "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
@@ -39,9 +39,14 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # fresh ``*_train_throughput`` records must carry the MFU fields
 # (``mfu`` / ``achieved_tflops`` / ``flops_per_step`` / ``peak_bytes``)
 # and fresh engine-decode records must carry ``kv_cache_bytes``.
+# v4: ``kind: numerics`` records exist (gradient-health dumps from
+# ``NumericsMonitor.to_record`` / ``bench.py --numerics``) and fresh
+# ``numerics_overhead_*`` bench lines must carry ``step_ms_on`` /
+# ``step_ms_off`` (an overhead claim is meaningless without both
+# sides of the comparison).
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1/v2 streams stay valid.
-SCHEMA_VERSION = 3
+# version, so archived v1/v2/v3 streams stay valid.
+SCHEMA_VERSION = 4
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -315,6 +320,47 @@ def validate_bench_record(rec: Any) -> List[str]:
             and "comm_topology" not in rec):
         errs.append("grad_allreduce records must carry 'comm_topology' "
                     "(and the per-level wire-byte fields)")
+    # numerics-instrumentation overhead fields (bench.py --numerics,
+    # schema v4): an overhead line is the on-vs-off step-time
+    # comparison — both sides must be on the record, non-negative,
+    # and arithmetically consistent with the headline value.
+    for opt in ("step_ms_on", "step_ms_off", "overhead_fraction"):
+        if opt in rec:
+            v = rec[opt]
+            if (not isinstance(v, numbers.Number)
+                    or isinstance(v, bool) or v < 0):
+                errs.append(f"{opt!r} must be a number >= 0 when "
+                            f"present, got {v!r}")
+    v4 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 4)
+    if (v4 and isinstance(metric, str)
+            and metric.startswith("numerics_overhead")
+            and "error" not in rec and not rec.get("stale")):
+        on = _need(rec, errs, "step_ms_on", numbers.Number)
+        off = _need(rec, errs, "step_ms_off", numbers.Number)
+        val = rec.get("value")
+        ok_num = all(isinstance(v, numbers.Number)
+                     and not isinstance(v, bool)
+                     for v in (on, off, val))
+        if ok_num:
+            # the headline must reassemble from its own sides (bench
+            # clamps negative overhead to 0 and rounds to 4 decimals
+            # — 0.01 ms absorbs the rounding, nothing else)
+            expect = max(on - off, 0.0)
+            if abs(val - expect) > max(0.01, 0.01 * expect):
+                errs.append(
+                    f"value ({val}) inconsistent with "
+                    f"step_ms_on - step_ms_off ({on} - {off})")
+            frac = rec.get("overhead_fraction")
+            if (isinstance(frac, numbers.Number)
+                    and not isinstance(frac, bool) and off > 0
+                    and abs(frac - expect / off)
+                    > max(0.01, 0.01 * frac)):
+                errs.append(
+                    f"overhead_fraction ({frac}) inconsistent with "
+                    f"value/step_ms_off ({expect:.4g}/{off})")
+        if "opt_level" in rec and not isinstance(rec["opt_level"], str):
+            errs.append("'opt_level' must be a string when present")
     # step-time attribution fields (bench.py --comm, PR 6): a record
     # carrying ``overlap_fraction`` decomposes a train step into
     # compute vs comm time per fabric level and must be internally
@@ -563,6 +609,156 @@ def validate_memory_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- numerics record schema -------------------------------------------------
+
+def validate_numerics_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: numerics`` JSONL record
+    (``NumericsMonitor.to_record`` enriched by the exporter): the
+    common envelope, a subject (``metric`` or ``entry_point``), the
+    step/overflow tallies, a non-empty per-layer health list
+    (nonfinite counts, abs-max, grad norm, underflow fraction), a
+    ``culprit`` that — when named — must actually be one of the
+    record's layers (an attribution pointing at a layer the record
+    does not describe is a hand-built record, not a flush), plus the
+    optional per-bucket and divergence-digest sections with their own
+    cross-field consistency (``in_sync`` iff zero desync steps)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types, allow_none=False):
+        return _need(rec, errs, key, types, allow_none)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "numerics":
+        errs.append(f"kind must be 'numerics', got {rec.get('kind')!r}")
+    subject = rec.get("entry_point", rec.get("metric"))
+    if not isinstance(subject, str) or not subject:
+        errs.append("numerics records must carry a non-empty "
+                    "'entry_point' or 'metric'")
+    steps = need("steps", int)
+    ov = need("overflow_steps", int)
+    for key, v in (("steps", steps), ("overflow_steps", ov)):
+        if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+            errs.append(f"{key!r} must be >= 0, got {v}")
+    if (isinstance(steps, int) and isinstance(ov, int)
+            and not isinstance(steps, bool) and not isinstance(ov, bool)
+            and ov > steps):
+        errs.append(f"overflow_steps ({ov}) exceeds steps ({steps})")
+    for opt in ("loss_scale", "grad_norm", "tiny"):
+        if opt in rec:
+            v = rec[opt]
+            if (not isinstance(v, numbers.Number)
+                    or isinstance(v, bool) or v < 0):
+                errs.append(f"{opt!r} must be a number >= 0 when "
+                            f"present, got {v!r}")
+    if "half_dtype" in rec and rec["half_dtype"] not in (
+            "float16", "bfloat16"):
+        errs.append(f"'half_dtype' must be float16/bfloat16, got "
+                    f"{rec['half_dtype']!r}")
+    layer_names = set()
+    layers = need("layers", list)
+    if isinstance(layers, list):
+        if not layers:
+            errs.append("layers must be non-empty (a health record "
+                        "with no layers describes nothing)")
+        for i, lyr in enumerate(layers):
+            if not isinstance(lyr, dict):
+                errs.append(f"layers[{i}] is not an object")
+                continue
+            name = lyr.get("name")
+            if not isinstance(name, str) or not name:
+                errs.append(f"layers[{i}].name must be a non-empty "
+                            f"string")
+            else:
+                layer_names.add(name)
+            nf = lyr.get("nonfinite")
+            if not isinstance(nf, int) or isinstance(nf, bool) or nf < 0:
+                errs.append(f"layers[{i}].nonfinite must be an int "
+                            f">= 0, got {nf!r}")
+            for key in ("abs_max", "grad_norm"):
+                v = lyr.get(key)
+                # `not (v >= 0)` also rejects NaN (all NaN
+                # comparisons are false) — a health record carrying
+                # un-numbers is worse than none
+                if (not isinstance(v, numbers.Number)
+                        or isinstance(v, bool) or not (v >= 0)):
+                    errs.append(f"layers[{i}].{key} must be a number "
+                                f">= 0, got {v!r}")
+            uf = lyr.get("underflow_fraction")
+            if (not isinstance(uf, numbers.Number)
+                    or isinstance(uf, bool)
+                    or not (0.0 <= uf <= 1.0)):
+                errs.append(f"layers[{i}].underflow_fraction must be "
+                            f"in [0, 1], got {uf!r}")
+    culprit = rec.get("culprit")
+    if culprit is not None:
+        if not isinstance(culprit, str) or not culprit:
+            errs.append(f"'culprit' must be null or a non-empty "
+                        f"string, got {culprit!r}")
+        elif isinstance(layers, list) and culprit not in layer_names:
+            errs.append(f"culprit {culprit!r} is not one of the "
+                        f"record's layers")
+    if culprit is not None and isinstance(ov, int) \
+            and not isinstance(ov, bool) and ov == 0:
+        errs.append("a culprit with zero overflow_steps attributes an "
+                    "overflow that never happened")
+    if "buckets" in rec:
+        bks = rec["buckets"]
+        if not isinstance(bks, list):
+            errs.append("'buckets' must be a list when present")
+        else:
+            for i, b in enumerate(bks):
+                if not isinstance(b, dict):
+                    errs.append(f"buckets[{i}] is not an object")
+                    continue
+                lbl = b.get("label")
+                if not isinstance(lbl, str) or not lbl:
+                    errs.append(f"buckets[{i}].label must be a "
+                                f"non-empty string")
+                nf = b.get("nonfinite")
+                if not isinstance(nf, int) or isinstance(nf, bool) \
+                        or nf < 0:
+                    errs.append(f"buckets[{i}].nonfinite must be an "
+                                f"int >= 0, got {nf!r}")
+                for key in ("abs_max", "grad_norm",
+                            "compression_sq_error"):
+                    if key in b:
+                        v = b[key]
+                        if (not isinstance(v, numbers.Number)
+                                or isinstance(v, bool)
+                                or not (v >= 0)):
+                            errs.append(f"buckets[{i}].{key} must be "
+                                        f"a number >= 0, got {v!r}")
+    if "divergence" in rec:
+        div = rec["divergence"]
+        if not isinstance(div, dict):
+            errs.append("'divergence' must be an object when present")
+        else:
+            mr = div.get("max_rel_dev")
+            if (not isinstance(mr, numbers.Number)
+                    or isinstance(mr, bool) or not (mr >= 0)):
+                errs.append(f"divergence.max_rel_dev must be a number "
+                            f">= 0, got {mr!r}")
+            ds = div.get("desync_steps")
+            if not isinstance(ds, int) or isinstance(ds, bool) or ds < 0:
+                errs.append(f"divergence.desync_steps must be an int "
+                            f">= 0, got {ds!r}")
+            ins = div.get("in_sync")
+            if not isinstance(ins, bool):
+                errs.append(f"divergence.in_sync must be a bool, got "
+                            f"{ins!r}")
+            elif isinstance(ds, int) and not isinstance(ds, bool) \
+                    and ins != (ds == 0):
+                errs.append(f"divergence.in_sync ({ins}) inconsistent "
+                            f"with desync_steps ({ds})")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 # -- trace record schema ----------------------------------------------------
 
 def validate_trace_record(rec: Any) -> List[str]:
@@ -653,8 +849,10 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     the bench schema — so one stream may interleave bench
     measurements, lint findings (``bench.py --graph-lint``), fleet
     snapshots (``bench.py --fleet N``), request traces
-    (``kind: trace``) and cost-model dumps (``kind: memory``, from
-    ``python -m apex_tpu.analysis --memory`` / ``bench.py``)."""
+    (``kind: trace``), cost-model dumps (``kind: memory``, from
+    ``python -m apex_tpu.analysis --memory`` / ``bench.py``) and
+    gradient-health dumps (``kind: numerics``, from
+    ``bench.py --numerics`` / ``NumericsMonitor.to_record``)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -664,12 +862,14 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_trace_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "memory":
         return validate_memory_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "numerics":
+        return validate_numerics_record(rec)
     return validate_bench_record(rec)
 
 
 def validate_telemetry_jsonl(lines: Iterable[str]) -> List[str]:
-    """Validate a mixed bench + graph-lint + fleet + trace JSONL
-    stream."""
+    """Validate a mixed bench + graph-lint + fleet + trace + memory +
+    numerics JSONL stream."""
     return _validate_jsonl(lines, validate_telemetry_record)
 
 

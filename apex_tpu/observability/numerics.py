@@ -1,0 +1,554 @@
+"""Numerics observability: device-resident gradient-health telemetry,
+overflow attribution, and cross-replica divergence digests.
+
+The dynamic loss scaler (amp/scaler.py) is this repo's identity, yet a
+skipped step used to say only *that* something overflowed — never
+*where*; nothing could detect a silently diverged replica; and the
+PR 5 bf16 DCN-hop compression reported its wire savings but not what
+the quantization actually loses.  This module closes all three gaps
+with the same contract PR 1's :class:`~.metrics.DeviceMetrics`
+established: every per-step quantity is accumulated as jnp arrays
+*inside* the jitted step (zero host syncs — pinned by the ``numerics``
+lint rule and tests/test_step_graph_audit.py), and :meth:`flush` is
+the single explicit ``jax.device_get``.
+
+Three instruments, one monitor:
+
+- **Per-layer gradient health** (:meth:`NumericsMonitor.update` with
+  ``grad_stats`` from ``AmpOptimizer.step(grad_health=...)``):
+  nonfinite counts, abs-max, grad norm, and the *underflow fraction* —
+  the share of nonzero gradient elements whose scaled magnitude falls
+  below the half dtype's smallest normal (``finfo(half).tiny``), i.e.
+  exactly what the **current** loss scale fails to protect.  The layer
+  with the most nonfinite elements on an overflowed step is the
+  **culprit** a skipped step's flight-ring event names.
+- **Per-bucket stats + compression error** (``bucket_stats`` from
+  ``allreduce_grads_tree(numerics_out=...)``): the stats ride the
+  existing DDP bucket structure, and the bf16 DCN hop reports the
+  squared quantization error of each replica's own shard — the cost
+  side of the PR 5 wire savings (arXiv:2004.13336).
+- **Cross-replica divergence digest** (``sync_tree``): a cheap
+  per-leaf checksum ``[sum(x), sum(x^2)]`` whose single ``psum``
+  satisfies ``psum(digest) == axis_size * local`` on every replica iff
+  the replicas hold identical values — a silently desynced replica
+  trips it within one step.  The one extra collective is planned by
+  :func:`digest_comm_plan` so the collective-accounting lint rule
+  stays exact.
+
+``enabled=False`` is a hard off-switch: :meth:`init` returns an empty
+pytree and every mutator is an identity, so a numerics-disabled step
+traces to a **byte-identical** jaxpr (the other half of the ``numerics``
+lint rule).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["NumericsMonitor", "leaf_names", "bucket_labels",
+           "stack_bucket_stats", "divergence_digest", "divergence_check",
+           "digest_comm_plan", "DEFAULT_DIGEST_TOL"]
+
+# relative deviation above which the digest declares a replica desynced.
+# Replicated state is bitwise identical across replicas, so the psum of
+# identical digests differs from ``world * local`` only by the rounding
+# of the reduction order — zero for power-of-two worlds (repeated exact
+# doubling), a few ulps otherwise.  1e-6 is ~100x that noise floor and
+# ~1000x below any real divergence (one perturbed fp32 element moves
+# the digest by its own magnitude).
+DEFAULT_DIGEST_TOL = 1e-6
+
+
+def _path_str(path) -> str:
+    """'/'-joined readable key path (local twin of the helper in
+    parallel/distributed.py — duplicated so observability never imports
+    the parallel package at module scope)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def leaf_names(tree: Any) -> Tuple[str, ...]:
+    """'/'-joined key path per leaf, in tree order — the layer labels
+    the monitor and its flushed records use."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(_path_str(p) for p, _ in flat)
+
+
+def bucket_labels(plan: Sequence[Dict[str, Any]]) -> Tuple[str, ...]:
+    """Stable labels for the buckets of one
+    :func:`parallel.allreduce_comm_plan` — the runtime's
+    ``numerics_out`` entries arrive in the same (dtype-group, bucket)
+    order, so position ``i`` of the plan IS position ``i`` of the
+    stats."""
+    return tuple(f"{b['dtype']}/b{i}" for i, b in enumerate(plan))
+
+
+def stack_bucket_stats(numerics_out: Sequence[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """Stack the per-bucket device scalars of one
+    ``allreduce_grads_tree(numerics_out=...)`` call into ``(B,)``
+    arrays (``compression_sq_error`` defaults to 0 for uncompressed
+    buckets)."""
+    import jax.numpy as jnp
+    zero = jnp.zeros((), jnp.float32)
+    return {
+        "nonfinite": jnp.stack([b["nonfinite"] for b in numerics_out]),
+        "abs_max": jnp.stack([b["abs_max"] for b in numerics_out]),
+        "sq_sum": jnp.stack([b["sq_sum"] for b in numerics_out]),
+        "compression_sq_error": jnp.stack(
+            [b.get("compression_sq_error", zero) for b in numerics_out]),
+    }
+
+
+# -- divergence digest ------------------------------------------------------
+
+def divergence_digest(tree: Any):
+    """Per-leaf ``[sum(x), sum(x*x)]`` checksum, fp32, shape ``(L, 2)``.
+    Replicas computing the same program on the same state produce
+    bitwise-identical digests — any drift (a dropped collective, a
+    corrupted buffer, a rank applying a different update) moves at
+    least one component."""
+    import jax
+    import jax.numpy as jnp
+    rows = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        x = leaf.astype(jnp.float32).reshape(-1)
+        rows.append(jnp.stack([jnp.sum(x), jnp.sum(x * x)]))
+    return jnp.stack(rows)
+
+
+def divergence_check(tree: Any, axis_name: str,
+                     tol: float = DEFAULT_DIGEST_TOL) -> Dict[str, Any]:
+    """One-collective replica-sync check: ``psum`` the per-leaf digest
+    over ``axis_name`` and compare against ``axis_size * local`` —
+    equality (within ``tol`` relative) on every replica means every
+    replica holds the same values.  Must run inside the mapped context.
+
+    Returns device values: ``rel`` ``(L,)`` per-leaf relative
+    deviation, ``max_rel_dev`` scalar, and ``in_sync`` (fp32 0/1).
+    All ops beyond the single ``psum`` are local — the collective
+    census of an instrumented step grows by exactly the one eqn
+    :func:`digest_comm_plan` budgets."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    d = divergence_digest(tree)
+    world = int(lax.axis_size(axis_name))
+    tot = lax.psum(d, axis_name)
+    dev = jnp.abs(tot - world * d)
+    denom = jnp.abs(tot) + world * jnp.abs(d) + 1e-30
+    rel = jnp.max(dev / denom, axis=1)            # (L,)
+    # a nonfinite digest (a replica whose state holds NaN/inf) is
+    # maximal divergence, not un-measurable: clamp to 1.0, the upper
+    # bound of dev/denom for finite inputs, so the flush stays
+    # JSON-clean and the desync counter still trips
+    rel = jnp.where(jnp.isfinite(rel), rel, 1.0)
+    max_rel = jnp.max(rel)
+    return {"rel": rel, "max_rel_dev": max_rel,
+            "in_sync": (max_rel <= tol).astype(jnp.float32)}
+
+
+def digest_comm_plan(tree: Any) -> List[Dict[str, Any]]:
+    """Static plan of :func:`divergence_check`'s collectives — ONE psum
+    of the ``(L, 2)`` fp32 digest.  Shaped like an
+    ``allreduce_comm_plan`` bucket so
+    ``parallel.plan_collective_expectations(plan + digest_comm_plan(t))``
+    folds it into the collective rule's exact expectations."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(tree)
+    n = 2 * len(leaves)
+    b = 4 * n
+    return [{
+        "dtype": "float32", "comm_dtype": "float32",
+        "leaves": len(leaves), "elements": n, "chunks": 1,
+        "cause": "numerics_digest", "topology": "flat",
+        "wire_elements": n, "padded_elements": 0, "wire_bytes": b,
+        "ici_wire_bytes": b, "dcn_wire_bytes": b,
+        "dcn_comm_dtype": "float32",
+        "eqns": {"psum": 1}, "eqn_payload_bytes": {"psum": b}}]
+
+
+# -- the monitor ------------------------------------------------------------
+
+class NumericsMonitor:
+    """Device-resident numerics accounting for jitted training steps.
+
+    Like :class:`~.metrics.DeviceMetrics`, the state returned by
+    :meth:`init` is a flat ``{name: jnp.ndarray}`` pytree that rides
+    the step carry; :meth:`update` is pure (state in, new state out)
+    and lowers to elementwise math plus at most the one digest psum;
+    :meth:`flush` is the single host fetch.
+
+        nm = NumericsMonitor(params, half_dtype="float16",
+                             bucket_labels=numerics.bucket_labels(plan),
+                             axis_name="data")
+        tele = nm.init()
+        # inside the jitted step:
+        nout = []
+        grads = ddp.allreduce_grads(grads, numerics_out=nout)
+        params, ost, info = opt.step(params, ost, grads, grad_health=nm)
+        tele = nm.update(tele, grad_stats=info.get("grad_health"),
+                         bucket_stats=nout,
+                         found_inf=info["found_inf"],
+                         loss_scale=info["loss_scale"],
+                         sync_tree=params)
+        # on the host, every N steps:
+        flushed = nm.flush(tele)          # ONE device_get
+        rec = nm.to_record(flushed, metric="resnet50_o2_ddp")
+
+    ``enabled=False`` turns every method into an identity (``init``
+    returns an empty dict, i.e. a pytree with zero leaves), so the
+    instrumented step traces to the byte-identical jaxpr of the
+    uninstrumented one — the off-switch really is free.
+    """
+
+    def __init__(self, grads_like: Any = None,
+                 names: Optional[Sequence[str]] = None,
+                 half_dtype: Any = "bfloat16",
+                 bucket_labels: Optional[Sequence[str]] = None,
+                 digest: bool = False,
+                 axis_name: Optional[str] = None,
+                 digest_tol: float = DEFAULT_DIGEST_TOL,
+                 enabled: bool = True,
+                 prefix: str = "numerics_",
+                 registry=None, ring=None):
+        import jax
+        import jax.numpy as jnp
+        if (grads_like is None) == (names is None):
+            raise ValueError("exactly one of grads_like/names required")
+        if grads_like is not None:
+            self.names = leaf_names(grads_like)
+            self.sizes = tuple(
+                int(math.prod(l.shape)) if hasattr(l, "shape") else 1
+                for l in jax.tree_util.tree_leaves(grads_like))
+        else:
+            self.names = tuple(names)
+            self.sizes = tuple(1 for _ in self.names)
+        if not self.names:
+            raise ValueError("NumericsMonitor needs at least one layer")
+        dt = jnp.dtype({"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                        "fp16": jnp.float16, "float16": jnp.float16
+                        }.get(half_dtype, half_dtype))
+        if dt not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
+            raise ValueError(f"half_dtype must be fp16/bf16, got {dt}")
+        self.half_dtype = dt.name
+        # smallest normal of the half dtype: a SCALED gradient below it
+        # is what the current loss scale fails to lift into range
+        self.tiny = float(jnp.finfo(dt).tiny)
+        self.bucket_labels = (tuple(bucket_labels)
+                              if bucket_labels else None)
+        self.digest = bool(digest)
+        self.axis_name = axis_name
+        if self.digest and not axis_name:
+            raise ValueError("digest=True needs axis_name= (the mapped "
+                             "data axis the psum runs over)")
+        self.digest_tol = float(digest_tol)
+        self.enabled = bool(enabled)
+        self.prefix = prefix
+        self.registry = registry
+        self.ring = ring
+        # host-side flush memory for the flight-ring deltas
+        self._last_overflow_steps = 0
+        self._last_desync_steps = 0
+
+    # -- device state -------------------------------------------------------
+    def init(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        if not self.enabled:
+            return {}
+        L = len(self.names)
+        z = lambda *s: jnp.zeros(s, jnp.float32)  # noqa: E731
+        state = {
+            "steps": z(), "overflow_steps": z(), "grad_steps": z(),
+            "loss_scale": z(),
+            "nonfinite": z(L), "underflow": z(L), "abs_max": z(L),
+            "sq_sum": z(L),
+            "culprit_idx": jnp.full((), -1.0, jnp.float32),
+            "culprit_nonfinite": z(),
+        }
+        if self.bucket_labels:
+            B = len(self.bucket_labels)
+            state.update(bucket_nonfinite=z(B), bucket_abs_max=z(B),
+                         bucket_sq_sum=z(B), bucket_comp_err=z(B))
+        if self.digest:
+            state.update(div_rel=z(L), div_max=z(), desync_steps=z(),
+                         div_worst_idx=jnp.full((), -1.0, jnp.float32))
+        return state
+
+    def leaf_stats(self, scaled_grads: Any, loss_scale: Any
+                   ) -> Dict[str, Any]:
+        """Per-leaf health of one gradient tree (``(L,)`` arrays), all
+        local elementwise math: ``nonfinite`` counts, ``abs_max`` and
+        ``sq_sum`` of the UNSCALED finite values (nonfinite masked to 0
+        so one inf cannot poison the magnitudes it sits next to), and
+        ``underflow`` — elements whose *scaled* magnitude is a nonzero
+        subnormal of the half dtype.  ``AmpOptimizer.step`` calls this
+        on the pre-pack gradient tree when handed ``grad_health=``."""
+        import jax
+        import jax.numpy as jnp
+        leaves = jax.tree_util.tree_leaves(scaled_grads)
+        if len(leaves) != len(self.names):
+            raise ValueError(
+                f"gradient tree has {len(leaves)} leaves, monitor was "
+                f"built over {len(self.names)}")
+        scale = jnp.asarray(loss_scale, jnp.float32)
+        nonf, amax, sq, under = [], [], [], []
+        for leaf in leaves:
+            x = leaf.astype(jnp.float32).reshape(-1)
+            fin = jnp.isfinite(x)
+            ax = jnp.abs(jnp.where(fin, x, 0.0))
+            nonf.append(jnp.sum(~fin).astype(jnp.float32))
+            amax.append(jnp.max(ax, initial=0.0) / scale)
+            sq.append(jnp.sum(ax * ax) / (scale * scale))
+            under.append(jnp.sum(
+                (ax > 0) & (ax < self.tiny)).astype(jnp.float32))
+        return {"nonfinite": jnp.stack(nonf), "abs_max": jnp.stack(amax),
+                "sq_sum": jnp.stack(sq), "underflow": jnp.stack(under)}
+
+    def update(self, state: Dict[str, Any],
+               grad_stats: Optional[Dict[str, Any]] = None,
+               bucket_stats: Optional[Sequence[Dict[str, Any]]] = None,
+               found_inf: Any = None, loss_scale: Any = None,
+               sync_tree: Any = None) -> Dict[str, Any]:
+        """Fold one step's observations into the device state (pure).
+
+        ``grad_stats``: ``info["grad_health"]`` from
+        ``AmpOptimizer.step(grad_health=self)`` (or :meth:`leaf_stats`
+        run directly).  ``bucket_stats``: the ``numerics_out`` list one
+        ``allreduce_grads_tree`` call filled.  ``found_inf`` decides
+        whether this step counts as an overflow (falls back to the
+        per-layer nonfinite census).  ``sync_tree`` runs the divergence
+        digest — the ONE collective this method may add."""
+        if not self.enabled:
+            return state
+        import jax.numpy as jnp
+        st = dict(state)
+        st["steps"] = st["steps"] + 1.0
+        if loss_scale is not None:
+            st["loss_scale"] = jnp.asarray(loss_scale, jnp.float32)
+        if grad_stats is not None:
+            gs = grad_stats
+            # grad_steps, not steps, is the underflow-fraction
+            # denominator: a caller folding grad health every other
+            # step must not have its fraction diluted by the
+            # health-less updates
+            st["grad_steps"] = st["grad_steps"] + 1.0
+            st["nonfinite"] = st["nonfinite"] + gs["nonfinite"]
+            st["underflow"] = st["underflow"] + gs["underflow"]
+            st["abs_max"] = jnp.maximum(st["abs_max"], gs["abs_max"])
+            st["sq_sum"] = gs["sq_sum"]          # last-step gauge
+            has_nonf = jnp.sum(gs["nonfinite"]) > 0
+            idx = jnp.argmax(gs["nonfinite"]).astype(jnp.float32)
+            st["culprit_idx"] = jnp.where(has_nonf, idx,
+                                          st["culprit_idx"])
+            st["culprit_nonfinite"] = jnp.where(
+                has_nonf, jnp.max(gs["nonfinite"]),
+                st["culprit_nonfinite"])
+            overflow = has_nonf
+        else:
+            overflow = jnp.zeros((), jnp.bool_)
+        if found_inf is not None:
+            overflow = jnp.asarray(found_inf, jnp.float32) > 0
+        st["overflow_steps"] = (st["overflow_steps"]
+                                + overflow.astype(jnp.float32))
+        if bucket_stats is not None:
+            if self.bucket_labels is None:
+                raise ValueError("bucket_stats given but the monitor "
+                                 "was built without bucket_labels")
+            if len(bucket_stats) != len(self.bucket_labels):
+                raise ValueError(
+                    f"{len(bucket_stats)} bucket stats for "
+                    f"{len(self.bucket_labels)} labels — derive labels "
+                    f"from the same allreduce_comm_plan knobs the "
+                    f"runtime uses")
+            bs = stack_bucket_stats(bucket_stats)
+            st["bucket_nonfinite"] = (st["bucket_nonfinite"]
+                                      + bs["nonfinite"])
+            st["bucket_abs_max"] = jnp.maximum(st["bucket_abs_max"],
+                                               bs["abs_max"])
+            st["bucket_sq_sum"] = bs["sq_sum"]   # last-step gauge
+            st["bucket_comp_err"] = (st["bucket_comp_err"]
+                                     + bs["compression_sq_error"])
+        if sync_tree is not None:
+            if not self.digest:
+                raise ValueError("sync_tree given but the monitor was "
+                                 "built with digest=False")
+            chk = divergence_check(sync_tree, self.axis_name,
+                                   self.digest_tol)
+            st["div_rel"] = chk["rel"]
+            # pin the worst leaf AT the step that set the running max:
+            # div_rel is a last-step gauge, so a replica that desyncs
+            # and later re-syncs would otherwise have its flushed
+            # worst_leaf point at the final step's noise floor
+            worse = chk["max_rel_dev"] > st["div_max"]
+            st["div_worst_idx"] = jnp.where(
+                worse, jnp.argmax(chk["rel"]).astype(jnp.float32),
+                st["div_worst_idx"])
+            st["div_max"] = jnp.maximum(st["div_max"],
+                                        chk["max_rel_dev"])
+            st["desync_steps"] = (st["desync_steps"]
+                                  + (1.0 - chk["in_sync"]))
+        return st
+
+    # -- host side ----------------------------------------------------------
+    def flush(self, state: Dict[str, Any], registry=None
+              ) -> Dict[str, Any]:
+        """ONE host fetch of the whole state tree.  Folds totals into
+        the metrics registry, appends flight-ring events for *new*
+        overflow/desync transitions since the previous flush
+        (``overflow_attribution`` names the culprit layer;
+        ``replica_desync`` carries the worst relative deviation), and
+        returns the plain-python summary :meth:`to_record` serializes."""
+        import jax
+        if not self.enabled:
+            return {"enabled": False, "steps": 0, "overflow_steps": 0,
+                    "layers": [], "culprit": None}
+        host = jax.device_get(state)
+        steps = int(host["steps"])
+        overflow_steps = int(host["overflow_steps"])
+        grad_steps = int(host["grad_steps"])
+        layers = []
+        for i, name in enumerate(self.names):
+            # denominator = elements actually observed: grad_steps
+            # counts only the updates that carried grad_stats (a
+            # monitor built from names= has unit sizes, so its
+            # fraction degrades to a per-observation count — use
+            # grads_like for a per-element fraction)
+            denom = max(self.sizes[i] * max(grad_steps, 1), 1)
+            layers.append({
+                "name": name,
+                "nonfinite": int(host["nonfinite"][i]),
+                "abs_max": float(host["abs_max"][i]),
+                "grad_norm": float(host["sq_sum"][i]) ** 0.5,
+                "underflow_fraction": min(
+                    float(host["underflow"][i]) / denom, 1.0)})
+        ci = int(host["culprit_idx"])
+        culprit = self.names[ci] if 0 <= ci < len(self.names) else None
+        out: Dict[str, Any] = {
+            "enabled": True, "steps": steps,
+            "overflow_steps": overflow_steps,
+            "loss_scale": float(host["loss_scale"]),
+            "half_dtype": self.half_dtype, "tiny": self.tiny,
+            "grad_norm": float(sum(float(host["sq_sum"][i])
+                                   for i in range(len(self.names)))
+                               ) ** 0.5,
+            "layers": layers, "culprit": culprit,
+            "culprit_nonfinite": int(host["culprit_nonfinite"]),
+        }
+        if self.bucket_labels:
+            out["buckets"] = [{
+                "label": lbl,
+                "nonfinite": int(host["bucket_nonfinite"][i]),
+                "abs_max": float(host["bucket_abs_max"][i]),
+                "grad_norm": float(host["bucket_sq_sum"][i]) ** 0.5,
+                "compression_sq_error":
+                    float(host["bucket_comp_err"][i]),
+            } for i, lbl in enumerate(self.bucket_labels)]
+        if self.digest:
+            desync = int(host["desync_steps"])
+            wi = int(host["div_worst_idx"])
+            out["divergence"] = {
+                "max_rel_dev": float(host["div_max"]),
+                "desync_steps": desync, "tol": self.digest_tol,
+                "in_sync": desync == 0,
+                # the leaf AT the step that set max_rel_dev — None
+                # until a digest ran (div_rel is only a last-step
+                # gauge and must not name the noise floor)
+                "worst_leaf": (self.names[wi]
+                               if 0 <= wi < len(self.names) else None)}
+        self._fold_registry(out, registry)
+        self._record_transitions(out)
+        return out
+
+    def _fold_registry(self, out, registry):
+        from .metrics import get_registry
+        reg = registry or self.registry or get_registry()
+        p = self.prefix
+        reg.counter(p + "overflow_steps_total").set_total(
+            out["overflow_steps"])
+        reg.gauge(p + "grad_norm").set(out["grad_norm"])
+        reg.gauge(p + "loss_scale").set(out["loss_scale"])
+        nonf = reg.counter(p + "nonfinite_total")
+        amax = reg.gauge(p + "abs_max")
+        under = reg.gauge(p + "underflow_fraction")
+        for lyr in out["layers"]:
+            nonf.labels(layer=lyr["name"]).set_total(lyr["nonfinite"])
+            amax.labels(layer=lyr["name"]).set(lyr["abs_max"])
+            under.labels(layer=lyr["name"]).set(
+                lyr["underflow_fraction"])
+        for b in out.get("buckets", ()):
+            reg.counter(p + "bucket_nonfinite_total").labels(
+                bucket=b["label"]).set_total(b["nonfinite"])
+            reg.gauge(p + "compression_sq_error").labels(
+                bucket=b["label"]).set(b["compression_sq_error"])
+        div = out.get("divergence")
+        if div is not None:
+            reg.counter(p + "desync_steps_total").set_total(
+                div["desync_steps"])
+            reg.gauge(p + "divergence_max_rel_dev").set(
+                div["max_rel_dev"])
+
+    def _record_transitions(self, out):
+        """Flight-ring trail: overflow and desync are the rare,
+        diagnostic transitions a post-mortem dump must show next to
+        the scaler skips / failovers of the same window.  Dedup is the
+        per-monitor flush delta (same truthful-duplicate tradeoff as
+        ``amp.record_scaler``)."""
+        from . import flightrec
+        ring = flightrec.resolve(self.ring)
+        if out["overflow_steps"] > self._last_overflow_steps:
+            ring.append("overflow_attribution", prefix=self.prefix,
+                        culprit=out["culprit"],
+                        culprit_nonfinite=out["culprit_nonfinite"],
+                        overflow_steps=out["overflow_steps"],
+                        loss_scale=out["loss_scale"])
+            self._last_overflow_steps = out["overflow_steps"]
+        div = out.get("divergence")
+        if div is not None and div["desync_steps"] > \
+                self._last_desync_steps:
+            ring.append("replica_desync", prefix=self.prefix,
+                        max_rel_dev=div["max_rel_dev"],
+                        desync_steps=div["desync_steps"],
+                        worst_leaf=div["worst_leaf"])
+            self._last_desync_steps = div["desync_steps"]
+
+    def to_record(self, flushed: Dict[str, Any],
+                  metric: Optional[str] = None,
+                  entry_point: Optional[str] = None,
+                  **extra) -> Dict[str, Any]:
+        """One ``kind: numerics`` JSONL payload (enrich through
+        ``JsonlExporter``; validated by
+        ``exporters.validate_numerics_record``)."""
+        if not (metric or entry_point):
+            raise ValueError("a numerics record needs a metric= or "
+                             "entry_point= subject")
+        rec: Dict[str, Any] = {"kind": "numerics"}
+        if metric:
+            rec["metric"] = metric
+        if entry_point:
+            rec["entry_point"] = entry_point
+        for k in ("steps", "overflow_steps", "loss_scale", "half_dtype",
+                  "tiny", "grad_norm", "layers", "culprit",
+                  "culprit_nonfinite", "buckets", "divergence"):
+            if k in flushed:
+                rec[k] = flushed[k]
+        rec.update(extra)
+        return rec
+
+    def record(self, state: Dict[str, Any],
+               metric: Optional[str] = None,
+               entry_point: Optional[str] = None,
+               registry=None, **extra) -> Dict[str, Any]:
+        """``flush`` + ``to_record`` in one call."""
+        return self.to_record(self.flush(state, registry=registry),
+                              metric=metric, entry_point=entry_point,
+                              **extra)

@@ -18,7 +18,7 @@ from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
                               ParallelMLP, ParallelSelfAttention)
 from . import pipeline
 from . import expert_parallel
-from .adasum import adasum_grads, adasum_pair
+from .adasum import adasum_grads, adasum_pair, adasum_comm_plan
 from .expert_parallel import ExpertParallelMLP
 
 

@@ -31,7 +31,7 @@ conflicts in small ones.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["adasum_pair", "adasum_grads"]
+__all__ = ["adasum_pair", "adasum_grads", "adasum_comm_plan"]
 
 
 def adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -75,7 +75,24 @@ def adasum_grads(grads: Any, axis_name: str = "data",
     slice mean divides by ``ici_size`` exactly once and the butterfly
     never divides, so no double-averaging across levels.  The number of
     slices must be a power of two (the fixed XOR tree); ``ici_size=1``
-    is the flat butterfly over all ranks."""
+    is the flat butterfly over all ranks.
+
+    **Bandwidth cost** (the side the VERDICT "justify Adasum"
+    experiment weighs): every one of the ``log2(S)`` butterfly stages
+    (``S`` = slices) exchanges the FULL fp32 flat buffer — each rank
+    must see its partner's entire gradient to form the per-leaf dot
+    products — so one rank puts ``log2(S) * 4n`` bytes on the wire
+    (plus the in-slice pmean's ``4n`` when ``ici_size > 1``).  The
+    plain psum the butterfly replaces costs ``~2n`` elements (``~8n``
+    bytes fp32) per rank under recursive halving (reduce-scatter +
+    all-gather), and MPI Adasum rides that same recursive-halving
+    shape by combining *half-blocks* per stage; the XOR butterfly
+    trades that bandwidth for one collective per stage and a
+    deterministic tree on the mesh axis.  At ``S = 8`` that is
+    ``12n`` bytes — ``1.5x`` the fp32 psum traffic, ``3x`` a
+    bf16-compressed wire — and the gap widens by ``4n`` bytes per
+    doubling of ``S``.  :func:`adasum_comm_plan` states the exact
+    exchanged bytes so comm accounting can price it."""
     n = lax.axis_size(axis_name)
     ici = int(ici_size)
     if ici < 1 or n % ici:
@@ -133,3 +150,58 @@ def adasum_grads(grads: Any, axis_name: str = "data",
     out = [flat[offs[i]:offs[i + 1]].reshape(shapes[i]).astype(
         dtypes[i]) for i in range(len(leaves))]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def adasum_comm_plan(grads: Any, world: int,
+                     ici_size: int = 1) -> List[Dict[str, Any]]:
+    """Static wire accounting of :func:`adasum_grads` — the Adasum twin
+    of ``parallel.allreduce_comm_plan``, computed from shapes alone.
+
+    One plan bucket for the whole flat fp32 exchange buffer:
+    ``log2(world / ici_size)`` ppermute stages of the full ``4n``-byte
+    buffer (each stage crosses slices, i.e. DCN under the hierarchical
+    layout) plus, when ``ici_size > 1``, the in-slice pmean (one psum
+    eqn, ICI).  ``eqns`` / ``eqn_payload_bytes`` fold through
+    ``plan_collective_expectations`` like any DDP bucket, and
+    ``wire_bytes`` is what ``DistributedDataParallel``'s adasum branch
+    now records — the exchanged-byte cost side of the VERDICT item-5
+    "justify or demote Adasum" experiment."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    n = sum(int(np.prod(getattr(l, "shape", ()) or (1,)))
+            for l in leaves)
+    ici = int(ici_size)
+    world = int(world)
+    if ici < 1 or world % ici:
+        raise ValueError(f"ici_size {ici} must be >= 1 and divide the "
+                         f"axis size {world}")
+    n_slices = world // ici
+    if n_slices & (n_slices - 1):
+        raise ValueError(f"adasum needs a power-of-two number of "
+                         f"slices, got {n_slices}")
+    stages = n_slices.bit_length() - 1
+    buf_bytes = n * 4                       # fp32 exchange buffer
+    dcn_bytes = stages * buf_bytes          # butterfly crosses slices
+    ici_bytes = buf_bytes if ici > 1 else 0  # in-slice pmean
+    eqns: Dict[str, int] = {}
+    payload: Dict[str, int] = {}
+    if stages:
+        eqns["ppermute"] = stages
+        payload["ppermute"] = dcn_bytes
+    if ici > 1:
+        eqns["psum"] = 1                    # pmean traces as psum + div
+        payload["psum"] = ici_bytes
+    total = dcn_bytes + ici_bytes
+    return [{
+        "dtype": "float32", "comm_dtype": "float32",
+        "leaves": len(leaves), "elements": n, "chunks": 1,
+        "cause": "adasum",
+        "topology": "hierarchical" if ici > 1 else "flat",
+        "ici_size": ici, "dcn_size": n_slices, "stages": stages,
+        "wire_elements": n, "padded_elements": 0,
+        "bytes": total, "wire_bytes": total,
+        # flat convention matches _bucket_wire_accounting: with no
+        # level split every byte is charged to both fabrics
+        "ici_wire_bytes": ici_bytes if ici > 1 else total,
+        "dcn_wire_bytes": dcn_bytes if ici > 1 else total,
+        "dcn_comm_dtype": "float32",
+        "eqns": eqns, "eqn_payload_bytes": payload}]

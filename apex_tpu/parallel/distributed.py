@@ -178,7 +178,8 @@ def _bucket_wire_accounting(n: int, comm_dt, topo: str, ici: int,
 
 def _hierarchical_reduce(comm: jax.Array, axis_name: str,
                          ici_groups, dcn_groups,
-                         compress: bool) -> jax.Array:
+                         compress: bool, want_error: bool = False
+                         ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Two-level sum of one flat bucket: ``psum_scatter`` within the
     ICI slice (the fast fabric carries the full payload and does the
     wide accumulation), cross-slice reduce over DCN on the 1/ici
@@ -186,7 +187,13 @@ def _hierarchical_reduce(comm: jax.Array, axis_name: str,
     ONLY the DCN hop to bf16 and reduces it as all_gather + local sum
     in the communication dtype — the wire is half, the accumulation
     is not (the fp32-accumulate contract of allreduce_always_fp32
-    survives compression)."""
+    survives compression).
+
+    Returns ``(reduced, compression_sq_error)``: with ``want_error``
+    (numerics observability, PR 9) the second element is the squared
+    quantization error of THIS replica's own 1/ici shard on the bf16
+    DCN hop — local elementwise math, no extra collectives, and
+    ``None`` otherwise so the uninstrumented graph is unchanged."""
     ici = len(ici_groups[0])
     n = comm.shape[0]
     pad = (-n) % ici
@@ -194,15 +201,21 @@ def _hierarchical_reduce(comm: jax.Array, axis_name: str,
         comm = jnp.pad(comm, (0, pad))
     shard = lax.psum_scatter(comm, axis_name, scatter_dimension=0,
                              axis_index_groups=ici_groups, tiled=True)
+    err = None
     if compress:
-        wire = lax.all_gather(shard.astype(jnp.bfloat16), axis_name,
+        q = shard.astype(jnp.bfloat16)
+        if want_error:
+            d = (shard.astype(jnp.float32)
+                 - q.astype(jnp.float32))
+            err = jnp.sum(d * d)
+        wire = lax.all_gather(q, axis_name,
                               axis_index_groups=dcn_groups)
         shard = jnp.sum(wire.astype(shard.dtype), axis=0)
     else:
         shard = lax.psum(shard, axis_name, axis_index_groups=dcn_groups)
     full = lax.all_gather(shard, axis_name,
                           axis_index_groups=ici_groups, tiled=True)
-    return full[:n] if pad else full
+    return (full[:n] if pad else full), err
 
 
 def _path_str(path) -> str:
@@ -230,7 +243,8 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          comm_stats: Optional[list] = None,
                          comm_topology: str = "flat",
                          allreduce_compress_bf16: bool = False,
-                         ici_size: Optional[int] = None) -> Any:
+                         ici_size: Optional[int] = None,
+                         numerics_out: Optional[list] = None) -> Any:
     """Bucketed gradient allreduce with the reference's semantics
     (allreduce_bucket, distributed.py:378-398).  Must run inside a context
     where ``axis_name`` is a mapped mesh axis.
@@ -278,7 +292,18 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     on-wire traffic (chunk/shard padding included, all levels summed);
     ``cause`` records why the bucket flushed: a trigger boundary,
     ``delay_allreduce``, fitting under ``message_size`` (``single``),
-    or the chunked-psum path."""
+    or the chunked-psum path.
+
+    ``numerics_out``: numerics observability out-param (PR 9) — one
+    dict per bucket, in the same order as the comm plan, carrying the
+    static bucket identity plus DEVICE scalars (``nonfinite`` /
+    ``abs_max`` / ``sq_sum`` of the pre-divide communication buffer,
+    and ``compression_sq_error`` of this replica's shard on the bf16
+    DCN hop when compressed).  Unlike ``comm_stats`` these are traced
+    values: thread them into the step carry in the SAME trace (e.g.
+    ``NumericsMonitor.update(bucket_stats=...)``).  All stats are
+    local elementwise math — the collective census and host-transfer
+    audit of the step are unchanged."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -334,6 +359,22 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
         for bucket in buckets:
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
             comm = flat.astype(jnp.float32) if allreduce_always_fp32 else flat
+            nstat = None
+            if numerics_out is not None:
+                # bucket health on the pre-divide comm buffer: what
+                # actually goes on the wire, before the predivide
+                # shifts magnitudes.  Nonfinite masked out of the
+                # magnitude stats so one inf doesn't erase them.
+                x = comm.astype(jnp.float32)
+                fin = jnp.isfinite(x)
+                ax = jnp.abs(jnp.where(fin, x, 0.0))
+                nstat = {"dtype": str(dt),
+                         "comm_dtype": str(comm.dtype),
+                         "leaves": len(bucket),
+                         "elements": int(flat.shape[0]),
+                         "nonfinite": jnp.sum(~fin).astype(jnp.float32),
+                         "abs_max": jnp.max(ax, initial=0.0),
+                         "sq_sum": jnp.sum(ax * ax)}
             pre, post = predivide_factors(world,
                                           gradient_predivide_factor)
             if pre != 1.0:
@@ -344,9 +385,11 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                 n, comm.dtype, topo, ici, compress, message_size,
                 delay_allreduce, bool(trigger_paths))
             if topo == "hierarchical":
-                reduced = _hierarchical_reduce(comm, axis_name,
-                                               ici_groups, dcn_groups,
-                                               compress)
+                reduced, comp_err = _hierarchical_reduce(
+                    comm, axis_name, ici_groups, dcn_groups, compress,
+                    want_error=numerics_out is not None)
+                if nstat is not None and comp_err is not None:
+                    nstat["compression_sq_error"] = comp_err
             elif acct["chunks"] == 1:
                 reduced = lax.psum(comm, axis_name,
                                    axis_index_groups=axis_index_groups)
@@ -367,6 +410,8 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                     "leaves": len(bucket), "elements": int(n),
                     **{k: v for k, v in acct.items()
                        if k not in ("eqns", "eqn_payload_bytes")}})
+            if nstat is not None:
+                numerics_out.append(nstat)
 
             if gradient_average:
                 reduced = reduced / post.astype(reduced.dtype)
@@ -619,6 +664,11 @@ class DistributedDataParallel:
         # bucket of the most recently traced allreduce — see
         # allreduce_grads_tree(comm_stats=...)
         self.last_comm_stats: list = []
+        # numerics observability (PR 9): the most recently FLUSHED
+        # gradient-health summary — host-side plain python, set by
+        # record_numerics() after the step's NumericsMonitor.flush()
+        # (the in-step device stats ride the carry, never this attr)
+        self.last_numerics: dict = {}
         # comm_enabled=False builds the COMPUTE TWIN of a step for
         # step-time attribution (observability.steptime): the gradient
         # collectives are elided while the local average a psum would
@@ -639,8 +689,8 @@ class DistributedDataParallel:
 
     # -- the hot path ------------------------------------------------------
     def allreduce_grads(self, grads: Any,
-                        axis_index_groups: Optional[List[List[int]]] = None
-                        ) -> Any:
+                        axis_index_groups: Optional[List[List[int]]] = None,
+                        numerics_out: Optional[list] = None) -> Any:
         if not self.comm_enabled:
             self.last_comm_stats = []
             if self.gradient_average and not self.adasum:
@@ -654,24 +704,24 @@ class DistributedDataParallel:
                     grads)
             return grads
         if self.adasum:
-            from .adasum import adasum_grads
+            from .adasum import adasum_grads, adasum_comm_plan
             if axis_index_groups is not None:
                 raise NotImplementedError(
                     "adasum over axis_index_groups is not wired")
             topo, _ = _resolve_topology(self.comm_topology, False)
+            world = int(lax.axis_size(self.axis_name))
             ici = 1
             if topo == "hierarchical":
-                world = int(lax.axis_size(self.axis_name))
                 ici = (int(self.ici_size) if self.ici_size is not None
                        else _topology.default_ici_size(world))
-            leaves = jax.tree_util.tree_leaves(grads)
-            self.last_comm_stats = [{
-                "dtype": str(jnp.dtype(l.dtype)),
-                "comm_dtype": str(jnp.dtype(l.dtype)),
-                "leaves": 1, "elements": int(l.size),
-                "bytes": int(l.size) * jnp.dtype(l.dtype).itemsize,
-                "cause": "adasum", "chunks": 1,
-                "topology": topo} for l in leaves]
+            # TRUE exchanged bytes from the static plan (the cost side
+            # of the VERDICT "justify Adasum" experiment): log2(slices)
+            # full-buffer fp32 ppermute stages + the in-slice pmean —
+            # per-leaf accounting under-reported this by the stage
+            # count before PR 9
+            (plan_b,) = adasum_comm_plan(grads, world=world,
+                                         ici_size=ici)
+            self.last_comm_stats = [{**plan_b, "topology": topo}]
             self._record_comm_stats()
             return adasum_grads(grads, self.axis_name, ici_size=ici)
         retain = [] if self.retain_allreduce_buffers else None
@@ -689,7 +739,8 @@ class DistributedDataParallel:
             comm_stats=comm_stats,
             comm_topology=self.comm_topology,
             allreduce_compress_bf16=self.allreduce_compress_bf16,
-            ici_size=self.ici_size)
+            ici_size=self.ici_size,
+            numerics_out=numerics_out)
         if retain is not None:
             self.allreduce_buffers = retain
         self.last_comm_stats = comm_stats
@@ -723,6 +774,29 @@ class DistributedDataParallel:
                 b.get("ici_wire_bytes", b["bytes"]))
             lvl.labels(level="dcn", dtype=b["comm_dtype"]).inc(
                 b.get("dcn_wire_bytes", b["bytes"]))
+
+    def record_numerics(self, flushed: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold a flushed ``NumericsMonitor`` summary into the wrapper's
+        observability surface: ``ddp.last_numerics`` (the
+        ``Engine.stats()``-style host view) plus the per-bucket
+        compression-error gauges in the process registry — what the
+        PR 5 bf16 DCN hop actually loses on the wire, next to the
+        byte counters that say what it saves."""
+        self.last_numerics = dict(flushed)
+        from ..observability import get_registry
+        reg = get_registry()
+        for b in flushed.get("buckets", ()):
+            reg.gauge(
+                "ddp_allreduce_compression_sq_error",
+                help="squared bf16 quantization error of one replica's "
+                     "DCN-hop shard, accumulated over observed steps"
+            ).labels(bucket=b["label"]).set(
+                b.get("compression_sq_error", 0.0))
+            reg.counter(
+                "ddp_allreduce_bucket_nonfinite_total",
+                help="nonfinite gradient elements seen per comm bucket"
+            ).labels(bucket=b["label"]).set_total(b["nonfinite"])
+        return self.last_numerics
 
     def broadcast_params(self, params: Any) -> Any:
         """Rank-0 parameter broadcast (reference DDP does this at

@@ -857,7 +857,14 @@ class Engine(_SlotScheduler):
                 best, best_len = i, len(pref)
         return best, best_len
 
-    _supports_seed = True
+    @property
+    def _supports_seed(self):
+        # mirrors _supports_temperature: a seed names a per-request
+        # sampling stream, which only exists on the sampled tick — the
+        # greedy tick never draws and the speculative engine pins its
+        # own draft/verify streams, so a seed there would be silently
+        # ignored; reject it at submission instead (ADVICE r5)
+        return self.temperature > 0.0 and self.draft is None
 
     @property
     def _supports_temperature(self):

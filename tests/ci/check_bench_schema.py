@@ -23,8 +23,13 @@ schema (``validate_fleet_record``), and cost-model dumps (``kind:
 memory``, from ``python -m apex_tpu.analysis --memory`` or the
 per-train-config records bench emits) against the memory schema
 (``validate_memory_record``, incl. the peak_bytes reassembly
-arithmetic); at schema v3 fresh train-throughput lines must carry the
-MFU fields and fresh engine-decode lines ``kv_cache_bytes``.  All
+arithmetic), and gradient-health dumps (``kind: numerics``, from
+``bench.py --numerics``) against the numerics schema
+(``validate_numerics_record``: per-layer health fields, culprit
+cross-checks, divergence consistency); at schema v3 fresh
+train-throughput lines must carry the MFU fields and fresh
+engine-decode lines ``kv_cache_bytes``, at v4 fresh
+``numerics_overhead_*`` lines the on/off step times.  All
 record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py

@@ -39,7 +39,9 @@ Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
 measured anything).  Error lines (``value: null`` + ``error``) and
-flag/summary records are likewise excluded.
+flag/summary records are likewise excluded, as are per-run
+``kind: numerics`` gradient-health dumps (schema v4) — their stale
+replays still count toward the partition tally.
 
 Usage::
 
@@ -234,6 +236,13 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                     n_stale += 1
                 elif "error" not in rec:
                     track_cost_fields(rname, rec)
+                continue
+            # ``kind: numerics`` records (gradient-health dumps from
+            # bench --numerics) describe one run's numerics, not a
+            # cross-round trend; stale replays partition out as ever
+            if isinstance(rec, dict) and rec.get("kind") == "numerics":
+                if is_stale(rec):
+                    n_stale += 1
                 continue
             if not is_measurement(rec):
                 continue

@@ -47,8 +47,19 @@ for spec in "${VERSIONS[@]}"; do
     venv=".ci_venv_$name"
     echo "== matrix leg: $spec =="
     python -m venv --system-site-packages "$venv"
+    # Pin jaxlib to the jax spec (ADVICE r5): an UNPINNED jaxlib
+    # resolves to the latest wheel, which a pinned older jax may not
+    # support — the leg would then fail on a jax/jaxlib skew that has
+    # nothing to do with our code.  jax[cpu]==X pulls the exactly
+    # matching jaxlib; a bare "jax" (latest) keeps the extra so both
+    # packages ride the same release.
+    case "$spec" in
+        jax==*) pipspec="jax[cpu]==${spec#jax==}" ;;
+        jax)    pipspec="jax[cpu]" ;;
+        *)      pipspec="$spec" ;;
+    esac
     # --ignore-installed so the venv's jax/jaxlib shadow the system pin
-    "$venv/bin/pip" install -q --ignore-installed "$spec" jaxlib
+    "$venv/bin/pip" install -q --ignore-installed "$pipspec"
     "$venv/bin/python" -c "import jax; print('  jax', jax.__version__)"
     JAX_PLATFORMS=cpu "$venv/bin/python" -m pytest tests/ -q -x \
         || { echo "FAIL on $spec" >&2; exit 1; }

@@ -444,6 +444,100 @@ def test_collective_rule_flags_unbudgeted_collective():
     assert found[0].detail["primitive"] == "all_gather"
 
 
+def test_numerics_rule_flags_host_sync_extra_collective_and_residue():
+    """The PR 9 rule, mutation-proofed in all three directions: an
+    'enabled' instrumentation that smuggles a host callback flags; one
+    whose collective census exceeds baseline + planned digest delta
+    flags; and a 'disabled' step that is NOT byte-identical to its
+    baseline flags as residue.  The honest twins pass."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def base_fn(x):
+        return jax.lax.psum(x * 2.0, "data")
+
+    def instrumented_fn(x):
+        y = x * 2.0
+        digest = jnp.stack([jnp.sum(y), jnp.sum(y * y)])
+        return jax.lax.psum(y, "data") + jax.lax.psum(digest, "data")[0]
+
+    def two_digests_fn(x):
+        y = x * 2.0
+        d = jnp.stack([jnp.sum(y), jnp.sum(y * y)])
+        return (jax.lax.psum(y, "data")
+                + jax.lax.psum(d, "data")[0]
+                + jax.lax.psum(d * 2.0, "data")[1])
+
+    def callback_fn(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        d = jnp.stack([jnp.sum(y), jnp.sum(y * y)])
+        return jax.lax.psum(y, "data") + jax.lax.psum(d, "data")[0]
+
+    def trace(fn):
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=(P("data"),),
+                               out_specs=P(), check_vma=False)
+        return lambda: jax.make_jaxpr(mapped)(jnp.ones((2, 8)))
+
+    baseline = _ep("numerics_baseline", trace=trace(base_fn))
+    enabled_expect = {"baseline": baseline, "enabled": True,
+                      "extra_collectives": {"psum": 1},
+                      "extra_payload_bytes": 2 * 4}
+    ok = _ep("fixed_numerics", expect={"numerics": enabled_expect},
+             trace=trace(instrumented_fn))
+    assert _run(ok, "numerics") == []
+
+    cb = _ep("mutant_numerics_callback",
+             expect={"numerics": enabled_expect},
+             trace=trace(callback_fn))
+    found = _run(cb, "numerics")
+    assert any(f.detail.get("primitive") == "pure_callback"
+               for f in found)
+
+    extra = _ep("mutant_numerics_extra_psum",
+                expect={"numerics": enabled_expect},
+                trace=trace(two_digests_fn))
+    found = _run(extra, "numerics")
+    assert any(f.detail.get("got") == 3 and f.detail.get("expected") == 2
+               for f in found)
+    assert any("payload" in f.message for f in found)
+
+    # disabled: identical trace passes, residue flags
+    off_ok = _ep("fixed_numerics_off",
+                 expect={"numerics": {"baseline": baseline,
+                                      "enabled": False}},
+                 trace=trace(base_fn))
+    assert _run(off_ok, "numerics") == []
+    residue = _ep("mutant_numerics_residue",
+                  expect={"numerics": {"baseline": baseline,
+                                       "enabled": False}},
+                  trace=trace(instrumented_fn))
+    found = _run(residue, "numerics")
+    assert len(found) == 1 and "residue" in found[0].message
+
+
+def test_numerics_record_dispatch_in_mixed_stream():
+    """A kind: numerics record interleaves in the telemetry stream and
+    dispatches to its own validator."""
+    import json
+    from apex_tpu.observability.exporters import (
+        JsonlExporter, validate_telemetry_jsonl)
+    good = JsonlExporter.enrich({
+        "kind": "numerics", "metric": "mix", "steps": 1,
+        "overflow_steps": 0,
+        "layers": [{"name": "w", "nonfinite": 0, "abs_max": 1.0,
+                    "grad_norm": 1.0, "underflow_fraction": 0.0}]})
+    bench = JsonlExporter.enrich({
+        "metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+        "ndev": 1, "arch": "cpu"})
+    assert validate_telemetry_jsonl(
+        [json.dumps(bench), json.dumps(good)]) == []
+    bad = dict(good)
+    bad["overflow_steps"] = 7
+    errs = validate_telemetry_jsonl([json.dumps(bad)])
+    assert errs and any("exceeds steps" in e for e in errs)
+
+
 def _hier_setup(ici=4, world=8):
     from apex_tpu.parallel import hierarchical_axis_groups
     mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
@@ -717,9 +811,11 @@ def test_memory_record_schema_and_dispatch():
 def test_findings_to_records_and_registry_surface():
     assert set(analysis.RULES) == {"host-transfer", "donation",
                                    "amp-dtype", "layout", "collective",
-                                   "flop-accounting", "memory-budget"}
+                                   "flop-accounting", "memory-budget",
+                                   "numerics"}
     for name in ("ddp_resnet18_o2", "engine_step_k", "seq2seq_step_k",
-                 "tp_mlp_train_step"):
+                 "tp_mlp_train_step", "ddp_resnet18_o2_numerics",
+                 "ddp_resnet18_o2_numerics_off"):
         assert name in analysis.ENTRY_POINTS
     f = analysis.Finding(rule="r", entry_point="e", message="m")
     (rec,) = analysis.findings_to_records([f])

@@ -1085,6 +1085,33 @@ def test_check_bench_trend_memory_and_mfu_gate(tmp_path):
     assert _run_trend(["--dir", str(d5), "--strict-cpu"]).returncode == 1
 
 
+def test_check_bench_trend_partitions_numerics_records(tmp_path):
+    """kind: numerics gradient-health dumps (PR 9) are per-run
+    diagnostics, not a cross-round trend: fresh ones pass through
+    without entering the measurement trend, stale replays count
+    toward the partition tally like every other record family."""
+    def numrec(overflow, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"kind": "numerics", "metric": "resnet18_o2_ddp_numerics",
+             "steps": 10, "overflow_steps": overflow,
+             "backend": "cpu",
+             "layers": [{"name": "w", "nonfinite": 0, "abs_max": 1.0,
+                         "grad_norm": 1.0,
+                         "underflow_fraction": 0.0}], **kw})
+
+    d = tmp_path / "num1"
+    d.mkdir()
+    _trend_round(d, "BENCH_r01.json", [numrec(0)])
+    # a later round with MORE overflows must not read as a metric
+    # regression — numerics records carry no trend value
+    _trend_round(d, "BENCH_r02.json", [numrec(5),
+                                       numrec(0, stale=True)])
+    r = _run_trend(["--dir", str(d)])
+    assert r.returncode == 0, r.stderr
+    assert "0 fresh measurements counted" in r.stderr
+    assert "1 stale replays partitioned out" in r.stderr
+
+
 # -- engine telemetry -----------------------------------------------------
 
 def _gpt(seed=0):

@@ -95,6 +95,52 @@ def test_unscale_with_stashed_accumulates():
     assert float(flag) == 0.0
 
 
+def test_growth_at_exactly_scale_window():
+    """The boundary semantics (ADVICE r5 coverage ask): scale_window-1
+    consecutive clean steps leave the scale untouched; the
+    scale_window-th clean step doubles it AND resets the streak, so
+    growth recurs every exactly-scale_window clean steps."""
+    W = 5
+    s = LossScaler("dynamic", scale_window=W)
+    st = s.init_state()
+    for i in range(W - 1):
+        st = s.update(st, jnp.zeros(()))
+        assert float(st.loss_scale) == 2.0 ** 16, f"grew early at {i}"
+        assert int(st.unskipped) == i + 1
+    st = s.update(st, jnp.zeros(()))          # the W-th clean step
+    assert float(st.loss_scale) == 2.0 ** 17
+    assert int(st.unskipped) == 0             # streak reset on growth
+    for _ in range(W - 1):
+        st = s.update(st, jnp.zeros(()))
+        assert float(st.loss_scale) == 2.0 ** 17
+    st = s.update(st, jnp.zeros(()))
+    assert float(st.loss_scale) == 2.0 ** 18
+
+
+def test_cap_behavior_at_max_loss_scale():
+    """At the cap the grow branch still fires (streak keeps
+    resetting), the scale stays clamped, and an overflow halves FROM
+    the cap — no wedge state."""
+    W = 2
+    cap = 2.0 ** 17
+    s = LossScaler("dynamic", scale_window=W, max_loss_scale=cap)
+    st = s.init_state()
+    for _ in range(W):
+        st = s.update(st, jnp.zeros(()))
+    assert float(st.loss_scale) == cap
+    for cycle in range(3):
+        for _ in range(W):
+            st = s.update(st, jnp.zeros(()))
+        assert float(st.loss_scale) == cap
+        assert int(st.unskipped) == 0         # grow branch keeps firing
+    st = s.update(st, jnp.ones(()))           # overflow at the cap
+    assert float(st.loss_scale) == cap / 2
+    assert int(st.steps_skipped) == 1
+    for _ in range(W):
+        st = s.update(st, jnp.zeros(()))
+    assert float(st.loss_scale) == cap        # recovers, re-clamps
+
+
 def test_update_inside_jit():
     s = LossScaler("dynamic", scale_window=2)
 

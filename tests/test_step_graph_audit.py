@@ -94,6 +94,52 @@ def test_telemetry_step_adds_zero_host_transfers():
         len(analysis.conv_eqns(base.jaxpr))
 
 
+# -- numerics instrumentation: free when on, absent when off --------------
+
+def test_numerics_step_zero_host_transfers_and_plan_exact_collectives():
+    """The numerics-instrumented O2 step (per-layer grad health +
+    per-bucket stats + divergence digest threaded through the carry)
+    adds ZERO host-transfer primitives and EXACTLY the plan-derived
+    collective delta: the digest's one psum over the (L, 2) fp32
+    checksum, nothing else.  The collective rule's expectations are
+    folded from allreduce_comm_plan + numerics.digest_comm_plan, so a
+    bucketing change moves plan and graph together while a smuggled
+    collective still flags."""
+    _assert_clean("ddp_resnet18_o2_numerics",
+                  rules=["numerics", "host-transfer", "collective"])
+    from collections import Counter
+    base = analysis.get("ddp_resnet18_o2").graph()
+    inst = analysis.get("ddp_resnet18_o2_numerics").graph()
+    got = Counter(e.primitive.name
+                  for e in analysis.collective_eqns(inst.jaxpr))
+    base_counts = Counter(e.primitive.name
+                          for e in analysis.collective_eqns(base.jaxpr))
+    assert got["psum"] == base_counts["psum"] + 1      # the digest
+    assert analysis.host_transfer_eqns(inst.jaxpr) == []
+    # the payload delta is exactly the digest plan's bytes
+    want = analysis.get("ddp_resnet18_o2_numerics").expect["numerics"]
+    delta = (sum(analysis.eqn_payload_bytes(e)
+                 for e in analysis.collective_eqns(inst.jaxpr))
+             - sum(analysis.eqn_payload_bytes(e)
+                   for e in analysis.collective_eqns(base.jaxpr)))
+    assert delta == want["extra_payload_bytes"]
+    # same conv population: the accounting reads grads, never
+    # perturbs the compute
+    assert len(analysis.conv_eqns(inst.jaxpr)) == \
+        len(analysis.conv_eqns(base.jaxpr))
+
+
+def test_numerics_disabled_step_is_byte_identical():
+    """The SAME step code with a disabled NumericsMonitor must lower
+    to a graph with no numerics residue: the monitor state is an empty
+    pytree and every mutator an identity, so the traced jaxpr is
+    byte-for-byte the uninstrumented step's."""
+    _assert_clean("ddp_resnet18_o2_numerics_off", rules=["numerics"])
+    base = analysis.get("ddp_resnet18_o2").graph()
+    off = analysis.get("ddp_resnet18_o2_numerics_off").graph()
+    assert str(off.jaxpr) == str(base.jaxpr)
+
+
 # -- collective accounting: the comm pattern is what DDP assumes ----------
 
 def test_ddp_collective_accounting():
