@@ -348,7 +348,8 @@ def amp_stats(opt) -> dict:
 
 def record_scaler(opt, registry=None, step: Optional[int] = None,
                   emit_event: bool = False, prefix: str = "amp_",
-                  numerics: Optional[dict] = None) -> dict:
+                  numerics: Optional[dict] = None,
+                  supervisor=None) -> dict:
     """Fold the scaler snapshot into an observability registry: gauge
     ``amp_loss_scale``, counter ``amp_steps_skipped_total``.  With
     ``emit_event=True`` also appends a loss-scale timeline point to the
@@ -360,6 +361,12 @@ def record_scaler(opt, registry=None, step: Optional[int] = None,
     a detected skip's flight-ring event then carries the culprit
     bucket/layer (``culprit`` / ``culprit_nonfinite``), not just the
     skip count (overflow attribution, PR 9).
+
+    ``supervisor``: a running
+    :class:`~apex_tpu.observability.RunSupervisor` — the scaler
+    snapshot lands on its ``/statusz`` page (``observe_scaler``) next
+    to the run verdict, the amp-side supervisor signal tap (the
+    gradient-health side rides ``observe_step(numerics=...)``).
 
     One optimizer per (registry, ``prefix``): the gauge/counter are
     plain totals, so two optimizers recorded through the same pair
@@ -399,4 +406,6 @@ def record_scaler(opt, registry=None, step: Optional[int] = None,
         flightrec.record("scaler_skip", **ev)
     if emit_event:
         event("amp_loss_scale", **ev)
+    if supervisor is not None:
+        supervisor.observe_scaler(stats)
     return stats

@@ -184,7 +184,7 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
                       input_format="NCHW", stem="conv7",
                       telemetry=False, B=8, image=32,
                       comm_topology="flat", compress=False,
-                      ici_size=None, numerics=None):
+                      ici_size=None, numerics=None, supervised=None):
     """Trace the REAL DDP train step — shard_map over the 8-device CPU
     mesh with the grad allreduce inside — the same graph bench.py's
     headline and examples/imagenet execute.  ``telemetry=True`` threads
@@ -195,7 +195,12 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
     ``allreduce_grads(numerics_out=...)``, and the one-psum divergence
     digest over the updated params; ``numerics="off"`` runs the SAME
     step code with a disabled monitor, which must trace byte-identical
-    to the uninstrumented baseline (the numerics rule pins both)."""
+    to the uninstrumented baseline (the numerics rule pins both).
+    ``supervised="on"``/``"off"`` routes the step through
+    ``RunSupervisor.wrap_step`` with an enabled/disabled supervisor —
+    which must be an IDENTITY both ways: the supervisor consumes
+    host-side flush points only, and the supervisor rule pins the
+    wrapped step's jaxpr byte-identical to the baseline's."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
@@ -287,6 +292,18 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
             "extra_collectives": {"psum": 1} if numerics == "on" else {},
             "extra_payload_bytes": (digest_plan[0]["wire_bytes"]
                                     if numerics == "on" else 0)})
+    if supervised is not None:
+        # the operational-plane contract (PR 10): attaching a run
+        # supervisor changes NOTHING in the jitted step — wrap_step is
+        # an identity whether the supervisor is enabled or not, and
+        # the supervisor rule verifies the traced jaxpr stays
+        # byte-identical to the unsupervised baseline
+        sup = observability.RunSupervisor(
+            f"ep_{ep.name}", enabled=(supervised == "on"))
+        step = sup.wrap_step(step)
+        ep.expect.setdefault("supervisor", {
+            "baseline": "ddp_resnet18_o2",
+            "enabled": supervised == "on"})
     state = (params, bn, ost) \
         + ((dm.init(),) if telemetry else ()) \
         + ((nm.init(),) if nm is not None else ())
@@ -391,6 +408,28 @@ register_entry_point(
     description="DDP resnet18 O2 step with numerics DISABLED — must "
                 "lower byte-identical to the uninstrumented step")(
     lambda ep: _ddp_resnet_graph(ep, "O2", numerics="off"))
+
+# operational plane (PR 10): the SAME O2 step routed through
+# RunSupervisor.wrap_step.  The supervisor is host-side by contract —
+# it consumes already-flushed signals — so BOTH the enabled and the
+# disabled variant must trace to the byte-identical jaxpr of the
+# uninstrumented baseline with zero host transfers (the supervisor
+# rule; mutation-tested both ways in tests/test_analysis.py like the
+# numerics rule).
+register_entry_point(
+    "ddp_resnet18_o2_supervised", tags=("training", "ddp", "amp",
+                                        "supervisor", "telemetry"),
+    description="DDP resnet18 O2 step under an ENABLED run supervisor "
+                "— must stay byte-identical to the bare step (the "
+                "supervisor reads host flush points only)")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", supervised="on"))
+
+register_entry_point(
+    "ddp_resnet18_o2_supervised_off", tags=("training", "ddp",
+                                            "supervisor"),
+    description="DDP resnet18 O2 step under a DISABLED run supervisor "
+                "— byte-identical to the bare step")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", supervised="off"))
 
 register_entry_point(
     "ddp_resnet18_o2_nhwc", tags=("training", "ddp", "amp", "layout"),

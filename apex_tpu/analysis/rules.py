@@ -90,6 +90,16 @@ runs where its key is present):
     transfers + collective census exactly the baseline's plus the
     digest plan's delta; disabled ⇒ the step traces to the
     byte-identical jaxpr of the baseline (no residue).
+
+``supervisor``::
+
+    {"baseline": "ddp_resnet18_o2", "enabled": True}
+
+    The operational-plane pin (PR 10): a run-supervised step must
+    trace to the BYTE-IDENTICAL jaxpr of its unsupervised baseline
+    and contain zero host-transfer primitives — enabled or disabled,
+    because the supervisor consumes host-side flush points only and
+    ``RunSupervisor.wrap_step`` is an identity by contract.
 """
 
 from __future__ import annotations
@@ -102,7 +112,7 @@ from . import graphs as G
 
 __all__ = ["HostTransferRule", "DonationRule", "AmpDtypeRule",
            "LayoutRule", "CollectiveRule", "FlopAccountingRule",
-           "MemoryBudgetRule", "NumericsRule"]
+           "MemoryBudgetRule", "NumericsRule", "SupervisorRule"]
 
 
 @register_rule
@@ -481,6 +491,61 @@ class NumericsRule(Rule):
                             f"bytes over the baseline, the digest plan "
                             f"budgets exactly {w}",
                         payload_delta=delta, expected_delta=w))
+        return out
+
+
+@register_rule
+class SupervisorRule(Rule):
+    """A run-supervised step is the UNSUPERVISED step, to the byte
+    (PR 10's operational-plane pin).  Expectation::
+
+        {"baseline": "ddp_resnet18_o2", "enabled": True}
+
+    Unlike the numerics monitor — device-resident state that is free
+    only when *disabled* — the supervisor holds no device state at
+    all: it consumes signals the host already fetched at existing
+    flush points, and ``RunSupervisor.wrap_step`` returns the step
+    function unchanged.  So the pinned property is the same in BOTH
+    directions: the supervised step's jaxpr must be byte-identical to
+    the baseline's and contain zero host-transfer primitives, enabled
+    or disabled.  A supervisor change that instruments the step —
+    smuggles a callback to read the loss per step, adds a collective,
+    threads extra carry state — flags here before any profiler sees
+    the regression (mutation-tested both ways in
+    tests/test_analysis.py)."""
+
+    name = "supervisor"
+    expect_key = "supervisor"
+
+    def check(self, ep, graph) -> List[Finding]:
+        want = ep.expect["supervisor"]
+        out: List[Finding] = []
+        hits = Counter(e.primitive.name
+                       for e in G.host_transfer_eqns(graph.jaxpr))
+        for prim, n in sorted(hits.items()):
+            out.append(self.finding(
+                ep, f"supervised step contains host-transfer "
+                    f"primitive {prim!r} {n}x — the supervisor reads "
+                    f"existing host flush points, it never instruments "
+                    f"the jitted step", primitive=prim, count=n))
+        base = NumericsRule._baseline_graph(want)
+        if base is None:
+            out.append(self.finding(
+                ep, "a supervisor expectation needs a baseline to "
+                    "compare against"))
+            return out
+        ours, theirs = str(graph.jaxpr), str(base.jaxpr)
+        if ours != theirs:
+            n_eq = sum(1 for _ in G.walk_jaxpr(graph.jaxpr))
+            n_eq_b = sum(1 for _ in G.walk_jaxpr(base.jaxpr))
+            state = ("enabled" if want.get("enabled", True)
+                     else "disabled")
+            out.append(self.finding(
+                ep, f"supervisor residue: the {state}-supervisor step "
+                    f"traces to a different jaxpr than the "
+                    f"unsupervised baseline ({n_eq} vs {n_eq_b} eqns) "
+                    f"— wrap_step must be an identity in both "
+                    f"directions", eqns=n_eq, baseline_eqns=n_eq_b))
         return out
 
 

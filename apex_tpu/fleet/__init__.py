@@ -17,9 +17,17 @@ sibling of ``apex.parallel.DistributedDataParallel``'s replica model:
   graceful drain for rolling restarts;
 - faults (faults.py): :class:`FaultyReplica`, the seeded
   deterministic fault-injection harness the tests use to prove the
-  failover story instead of asserting it.
+  failover story instead of asserting it;
+- SLO/goodput (slo.py): :class:`SloTracker`, per-request
+  deadline-attainment, the queue-wait vs service split (fed at the
+  same instants the distributed-trace spans record), and
+  ``goodput_tokens_per_s`` — tokens delivered *within* SLO — on
+  ``Fleet.stats()``/``record()``.
 
-See docs/fleet.md.
+Attach the live introspection server with one call
+(``apex_tpu.observability.server.serve(fleet=fleet)``): ``/statusz``
+serves ``Fleet.stats()``, ``/metricsz`` the fleet registry,
+``/flightz`` the fleet's flight ring.  See docs/fleet.md.
 """
 
 from .fleet import Fleet
@@ -28,9 +36,12 @@ from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
 from .router import (FleetOverloaded, LeastLoaded, PrefixAffinity,
                      RetryPolicy, RoundRobin, make_policy)
 from .faults import FaultyReplica, ReplicaFault
+from .slo import SloTracker, split_from_trace
+from . import slo
 
 __all__ = ["Fleet", "FleetOverloaded", "RetryPolicy", "RoundRobin",
            "LeastLoaded", "PrefixAffinity", "make_policy",
            "HealthConfig", "ReplicaHealth", "Ewma", "HEALTHY",
            "DEGRADED", "DEAD", "DRAINING", "DRAINED", "STATE_CODES",
-           "FaultyReplica", "ReplicaFault"]
+           "FaultyReplica", "ReplicaFault", "SloTracker",
+           "split_from_trace", "slo"]

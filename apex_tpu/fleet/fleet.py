@@ -70,6 +70,7 @@ from ..observability import MetricsRegistry, flightrec, tracing
 from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                      STATE_CODES, HealthConfig, ReplicaHealth)
 from .router import FleetOverloaded, RetryPolicy, make_policy
+from .slo import SloTracker
 
 __all__ = ["Fleet"]
 
@@ -203,7 +204,16 @@ class Fleet:
         self._n_failovers = 0
         self._n_drains = 0
         self._n_deadline = 0
+        # the most recent deadline sweep's aggregate (count + first
+        # rids), previously visible only on the flight ring — exposed
+        # through stats()/record() so a dashboard need not tail the
+        # ring to see WHAT just expired
+        self._last_deadline_sweep: Dict[str, Any] = {
+            "count": 0, "rids": [], "fleet_step": None}
         m = self.metrics
+        # SLO/goodput accounting, fed at the same instants the trace
+        # spans record (submit / first dispatch / finish / fail)
+        self.slo = SloTracker(m, self._clock)
         self._m_submitted = m.counter("fleet_submitted_total")
         self._m_finished = m.counter("fleet_finished_total")
         self._m_failed = m.counter(
@@ -285,6 +295,7 @@ class Fleet:
         self._shedding = False      # an admitted submit ends the episode
         self._n_submitted += 1
         self._m_submitted.inc()
+        self.slo.on_submit(rid, now, req.deadline_at)
         return rid
 
     def _trace_ev(self, req: "_FleetRequest", name: str,
@@ -530,6 +541,10 @@ class Fleet:
             self._pending.remove(req)
             req.assigned = (i, rrid)
             self._inflight[(i, rrid)] = req
+            # first dispatch closes the request's queue-wait window
+            # (a failover's re-dispatch is service time — the tracker
+            # keeps only the first)
+            self.slo.on_dispatch(req.rid, self._clock())
             cands = self._candidates()       # replica i consumed capacity
 
     # -- failure handling --------------------------------------------------
@@ -601,6 +616,7 @@ class Fleet:
         self._results[req.rid] = req
         self._n_failed += 1
         self._m_failed.inc()
+        self.slo.on_fail(req.rid, req.t_finish)
         self._trace_ev(req, "fleet_failed", error=msg)
 
     def _finish(self, req: _FleetRequest, tokens: List[int]):
@@ -611,6 +627,7 @@ class Fleet:
         self._m_finished.inc()
         self._n_tokens += len(req.generated)
         self._m_tokens.inc(len(req.generated))
+        self.slo.on_finish(req.rid, req.t_finish, len(req.generated))
         if req.t_submit is not None:
             self._m_latency.observe(req.t_finish - req.t_submit)
         self._trace_ev(req, "fleet_result", tokens=len(req.generated),
@@ -640,9 +657,11 @@ class Fleet:
             # single tick, and thousands of per-request events would
             # wheel the bounded ring past the breaker/failover history
             # a post-mortem needs.  The counter carries the volume.
-            self.ring.append("deadline_exceeded", count=len(expired),
-                             rids=[r.rid for r in expired[:8]],
-                             fleet_step=self._step_no)
+            sweep = {"count": len(expired),
+                     "rids": [r.rid for r in expired[:8]],
+                     "fleet_step": self._step_no}
+            self._last_deadline_sweep = sweep
+            self.ring.append("deadline_exceeded", **sweep)
         for req in expired:
             self._deadline_fail(req)
 
@@ -784,8 +803,16 @@ class Fleet:
 
     def stats(self) -> Dict[str, Any]:
         """Aggregated snapshot: fleet totals, per-replica health
-        states, and every replica's own ``stats()``."""
+        states (summaries AND full :meth:`health.ReplicaHealth.
+        snapshot` records — the ``/statusz`` view), the SLO/goodput
+        aggregates (``slo`` + top-level ``goodput_tokens_per_s``), the
+        last deadline-sweep aggregate, and every replica's own
+        ``stats()``."""
         states = self.states()
+        # one window for every goodput figure in this snapshot: extend
+        # to now while work is live, freeze at the last finish after
+        slo = self.slo.stats(now=self._clock() if self.live()
+                             else None)
         return {"replicas": len(self.replicas),
                 "policy": getattr(self.policy, "name",
                                   type(self.policy).__name__),
@@ -800,12 +827,16 @@ class Fleet:
                 "failovers": self._n_failovers,
                 "drains": self._n_drains,
                 "deadline_exceeded": self._n_deadline,
+                "deadline_last_sweep": dict(self._last_deadline_sweep),
+                "slo": slo,
+                "goodput_tokens_per_s": slo["goodput_tokens_per_s"],
                 "states": states,
                 "healthy": states.count(HEALTHY),
                 "degraded": states.count(DEGRADED),
                 "dead": states.count(DEAD),
                 "draining": states.count(DRAINING),
                 "drained": states.count(DRAINED),
+                "health": [h.snapshot() for h in self.health],
                 "request_latency": self._m_latency.summary(),
                 "replica_stats": [r.stats() for r in self.replicas]}
 
@@ -813,7 +844,10 @@ class Fleet:
         """The ``kind: fleet`` JSONL record
         (``observability.exporters.validate_fleet_record``); feed it
         through a :class:`~apex_tpu.observability.exporters.JsonlExporter`
-        (or ``JsonlExporter.enrich``) to stamp the envelope."""
+        (or ``JsonlExporter.enrich``) to stamp the envelope.  Schema
+        v5 adds the SLO/goodput fields and the deadline-sweep
+        aggregate (optional in the validator, so archived records
+        stay clean)."""
         s = self.stats()
         return {"kind": "fleet", "trace_id": self.trace_id,
                 "replicas": s["replicas"], "policy": s["policy"],
@@ -824,4 +858,9 @@ class Fleet:
                 "failed": s["failed"], "shed": s["shed"],
                 "retries": s["retries"], "failovers": s["failovers"],
                 "drains": s["drains"],
-                "tokens": s["tokens_generated"]}
+                "tokens": s["tokens_generated"],
+                "deadline_exceeded": s["deadline_exceeded"],
+                "deadline_last_sweep": s["deadline_last_sweep"],
+                "goodput_tokens_per_s": s["goodput_tokens_per_s"],
+                "slo_attainment": s["slo"]["slo_attainment"],
+                "tokens_within_slo": s["slo"]["goodput_tokens"]}

@@ -212,6 +212,27 @@ class ReplicaHealth:
         self._note("health_reset")
 
     # -- queries -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Plain-python view of the whole health record — what
+        ``Fleet.stats()`` lists per replica and the introspection
+        server's ``/statusz`` serves: the derived state plus the
+        breaker internals a 3am triage actually wants (how many
+        consecutive errors, how much cooldown is left, what the EWMAs
+        say)."""
+        return {"replica": self.name,
+                "state": self.state,
+                "circuit": self.circuit,
+                "error_rate": round(self.error_rate.value, 6),
+                "latency_ewma_s": round(self.latency.value, 6),
+                "consecutive_errors": self.consecutive_errors,
+                "errors_total": self.errors_total,
+                "cooldown_steps_left": (self._cooldown_left
+                                        if self.circuit == "open"
+                                        else 0),
+                "next_cooldown_steps": self._cooldown,
+                "draining": self.draining,
+                "drained": self.drained}
+
     @property
     def state(self) -> str:
         if self.drained:

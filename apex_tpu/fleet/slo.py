@@ -1,0 +1,221 @@
+"""SLO / goodput accounting: what the fleet delivered *in time*.
+
+Raw throughput (``fleet_tokens_total / wall``) counts every token the
+same; a serving fleet's users do not.  A token delivered after its
+request's deadline bought nothing — the request already failed its SLO
+— so the number that tracks user-visible capacity is **goodput**:
+tokens from requests that finished within their deadline, per second
+of serving.  The same per-request timeline also answers the first
+triage question of any latency page: did the time go to **queue wait**
+(submit → first dispatch: the fleet had no capacity) or to **service**
+(dispatch → finish: the replica was slow)?
+
+:class:`SloTracker` is fed by the fleet at the exact instants its
+distributed-trace spans already record — submit, first dispatch,
+finish/fail (``tracing``'s ``fleet_submit`` / ``fleet_dispatch`` /
+``fleet_result`` events) — so the split it accounts and the split a
+trace record shows are the same measurement; :func:`split_from_trace`
+derives the latter from a ``kind: trace`` record and the tests pin the
+two against each other.
+
+Conventions:
+
+- a request with **no deadline has no SLO**: it can neither attain nor
+  miss one (it is excluded from ``slo_attainment``'s denominator), but
+  its tokens still count toward goodput — they were delivered within
+  every promise that was made;
+- a request that **failed** (retries exhausted, rejected, deadline
+  exceeded) delivers zero goodput tokens; if it carried a deadline it
+  counts as an SLO miss;
+- queue wait is submit → **first** dispatch: a failover's re-dispatch
+  is service-side reality (the request was being served and had to be
+  rescued), not queue starvation.
+
+Registry metrics: ``fleet_queue_wait_seconds`` /
+``fleet_service_seconds`` histograms, ``fleet_goodput_tokens_total`` /
+``fleet_slo_miss_total`` counters, the ``fleet_slo_attainment`` and
+``fleet_goodput_tokens_per_s`` gauges.  ``Fleet.stats()`` exposes the
+same numbers fleet-locally under ``slo`` (plus top-level
+``goodput_tokens_per_s``), and ``Fleet.record()`` carries them onto
+the ``kind: fleet`` JSONL record
+(``observability.exporters.validate_fleet_record`` pins the optional
+fields).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["SloTracker", "split_from_trace"]
+
+# sub-ms dispatch ticks up to multi-second waits under backlog
+_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class SloTracker:
+    """Per-request deadline-attainment, queue-wait/service split, and
+    goodput, owned and fed by one :class:`~apex_tpu.fleet.Fleet`.
+
+    All numbers are fleet-local (the registry metrics aggregate across
+    fleets sharing a registry; :meth:`stats` must not — the engine-
+    scheduler rule)."""
+
+    def __init__(self, metrics, clock):
+        self._clock = clock
+        self._m_queue_wait = metrics.histogram(
+            "fleet_queue_wait_seconds",
+            help="submit to first dispatch per request (fleet had no "
+                 "capacity)", buckets=_WAIT_BUCKETS)
+        self._m_service = metrics.histogram(
+            "fleet_service_seconds",
+            help="first dispatch to finish per completed request",
+            buckets=_WAIT_BUCKETS)
+        self._m_goodput = metrics.counter(
+            "fleet_goodput_tokens_total",
+            help="tokens from requests that finished within their "
+                 "deadline (no-deadline requests count: no SLO was "
+                 "broken)")
+        self._m_miss = metrics.counter(
+            "fleet_slo_miss_total",
+            help="deadlined requests that failed or finished late")
+        self._m_attainment = metrics.gauge(
+            "fleet_slo_attainment",
+            help="within-deadline fraction of resolved deadlined "
+                 "requests")
+        self._m_goodput_rate = metrics.gauge(
+            "fleet_goodput_tokens_per_s",
+            help="goodput tokens over the submit-to-last-finish window")
+        # rid -> [t_submit, t_first_dispatch|None, deadline_at|None]
+        self._open: Dict[int, list] = {}
+        self._with_deadline = 0         # resolved requests that had one
+        self._within = 0                # ... and finished in time
+        self._goodput_tokens = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- fleet feed (same instants as the trace spans) ---------------------
+    def on_submit(self, rid: int, now: float,
+                  deadline_at: Optional[float]):
+        self._open[rid] = [now, None, deadline_at]
+        if self._t_first is None:
+            self._t_first = now
+
+    def on_dispatch(self, rid: int, now: float):
+        """First dispatch only: queue wait = submit → first dispatch;
+        a failover's re-dispatch is service time, not queue time."""
+        rec = self._open.get(rid)
+        if rec is None or rec[1] is not None:
+            return
+        rec[1] = now
+        self._m_queue_wait.observe(now - rec[0])
+
+    def _resolve(self, rid: int, now: float):
+        rec = self._open.pop(rid, None)
+        if rec is None:
+            return None
+        self._t_last = now
+        return rec
+
+    def on_finish(self, rid: int, now: float, tokens: int):
+        rec = self._resolve(rid, now)
+        if rec is None:
+            return
+        t_submit, t_dispatch, deadline_at = rec
+        self._m_service.observe(now - (t_dispatch
+                                       if t_dispatch is not None
+                                       else t_submit))
+        within = deadline_at is None or now <= deadline_at
+        if deadline_at is not None:
+            self._with_deadline += 1
+            if within:
+                self._within += 1
+            else:
+                self._m_miss.inc()
+        if within:
+            self._goodput_tokens += int(tokens)
+            self._m_goodput.inc(int(tokens))
+        self._fold_gauges()
+
+    def on_fail(self, rid: int, now: float):
+        """Failed requests (retries exhausted, rejected, deadline
+        exceeded) deliver no goodput; a deadlined one is an SLO miss."""
+        rec = self._resolve(rid, now)
+        if rec is None:
+            return
+        if rec[2] is not None:
+            self._with_deadline += 1
+            self._m_miss.inc()
+        self._fold_gauges()
+
+    # -- aggregates ---------------------------------------------------------
+    @property
+    def slo_attainment(self) -> Optional[float]:
+        """Within-deadline fraction over resolved deadlined requests;
+        None while no deadlined request has resolved (an attainment of
+        a promise nobody made would read as a perfect score)."""
+        if self._with_deadline == 0:
+            return None
+        return self._within / self._with_deadline
+
+    def goodput_tokens_per_s(self,
+                             now: Optional[float] = None) -> float:
+        """Goodput tokens over the first-submit → last-finish window
+        (``now`` extends the window for a still-running fleet)."""
+        if self._t_first is None:
+            return 0.0
+        ends = [t for t in (self._t_last, now) if t is not None]
+        if not ends:
+            return 0.0                   # nothing resolved yet
+        dt = max(ends) - self._t_first
+        return self._goodput_tokens / dt if dt > 0 else 0.0
+
+    def _fold_gauges(self):
+        att = self.slo_attainment
+        if att is not None:
+            self._m_attainment.set(att)
+        self._m_goodput_rate.set(self.goodput_tokens_per_s())
+
+    def stats(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """``now`` extends the goodput window for a still-running
+        fleet (``Fleet.stats()`` passes its clock while work is live,
+        so every goodput figure in one snapshot uses ONE window)."""
+        return {
+            "with_deadline": self._with_deadline,
+            "within_deadline": self._within,
+            "slo_attainment": self.slo_attainment,
+            "goodput_tokens": self._goodput_tokens,
+            "goodput_tokens_per_s": round(
+                self.goodput_tokens_per_s(now=now), 4),
+            "queue_wait": self._m_queue_wait.summary(),
+            "service_time": self._m_service.summary(),
+        }
+
+
+def split_from_trace(trace_record: Dict[str, Any]
+                     ) -> Optional[Dict[str, float]]:
+    """Queue-wait / service split of ONE request derived from its
+    ``kind: trace`` record (the spans ``Fleet`` already emits):
+    ``fleet_submit`` → first ``fleet_dispatch`` is queue wait,
+    first dispatch → ``fleet_result``/``fleet_failed`` is service.
+    Returns ``{queue_wait_s, service_s, total_s}`` (seconds; span
+    timestamps are µs) or None when the record lacks the needed hops
+    — the cross-check that pins :class:`SloTracker`'s accounting to
+    the trace timeline."""
+    t_submit = t_dispatch = t_end = None
+    for sp in trace_record.get("spans", ()):
+        name, ts = sp.get("name"), sp.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if name == "fleet_submit" and t_submit is None:
+            t_submit = ts
+        elif name == "fleet_dispatch" and t_dispatch is None:
+            t_dispatch = ts
+        elif name in ("fleet_result", "fleet_failed"):
+            t_end = ts                   # last one wins
+    if t_submit is None or t_end is None:
+        return None
+    anchor = t_dispatch if t_dispatch is not None else t_end
+    return {"queue_wait_s": max(anchor - t_submit, 0.0) / 1e6,
+            "service_s": max(t_end - anchor, 0.0) / 1e6,
+            "total_s": max(t_end - t_submit, 0.0) / 1e6}

@@ -38,11 +38,26 @@ divergence digest — all in-graph with zero host syncs (the
 ``numerics`` lint rule pins it) behind ``kind: numerics`` records and
 ``bench.py --numerics``.
 
+And the **operational plane** (PR 10): ``server``, a stdlib
+``http.server`` introspection endpoint serving ``/healthz`` /
+``/metricsz`` (Prometheus exposition, conformance-tested) /
+``/statusz`` / ``/flightz`` / ``/tracez`` off a live registry / ring /
+recorder, attachable to an Engine, Fleet, or supervisor with one
+``server.serve(...)`` call; and ``supervisor``, the host-side
+training-run supervisor consuming each step's already-flushed signals
+to detect stall / loss spike / NaN / throughput regression / replica
+divergence — zero additions to any jitted step (``wrap_step`` is an
+audit-pinned identity), emitting flight-ring events, schema-v5
+``kind: run`` records, and an end-of-run report artifact.
+
 Wired consumers: ``serving.Engine``/``Seq2SeqEngine`` (enriched
 ``stats()``), ``parallel.distributed`` (comm accounting),
 ``amp`` (loss-scale/skip introspection + ``record_scaler``),
 ``optimizers`` (grad-norm gauge via ``AmpOptimizer.step`` info),
-``data.DataLoader`` (host load/wait times), and ``bench.py``.
+``data.DataLoader`` (host load/wait times),
+``utils.checkpoint``/``checkpoint_orbax`` (save/restore latency +
+``checkpoint_saved`` flight events), ``fleet`` (SLO/goodput
+accounting), and ``bench.py``.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -61,6 +76,8 @@ from .memory import (memory_plan, jaxpr_live_bytes, live_array_bytes,
                      record_live_arrays)
 from .numerics import (NumericsMonitor, divergence_check,
                        divergence_digest, digest_comm_plan)
+from .server import ObservabilityServer
+from .supervisor import RunSupervisor, SupervisorConfig
 from . import metrics
 from . import tracing
 from . import flightrec
@@ -69,6 +86,8 @@ from . import exporters
 from . import costmodel
 from . import memory
 from . import numerics
+from . import server
+from . import supervisor
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DeviceMetrics",
@@ -84,6 +103,7 @@ __all__ = [
     "record_live_arrays",
     "NumericsMonitor", "divergence_check", "divergence_digest",
     "digest_comm_plan",
+    "ObservabilityServer", "RunSupervisor", "SupervisorConfig",
     "metrics", "tracing", "flightrec", "steptime", "exporters",
-    "costmodel", "memory", "numerics",
+    "costmodel", "memory", "numerics", "server", "supervisor",
 ]

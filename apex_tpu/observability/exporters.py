@@ -20,6 +20,7 @@ import json
 import numbers
 import os
 import platform
+import re
 import socket
 import sys
 from typing import Any, Dict, IO, Iterable, List, Optional
@@ -27,11 +28,13 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
-           "prometheus_text", "validate_bench_record",
+           "prometheus_text", "parse_prometheus_text",
+           "validate_prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
            "validate_fleet_record", "validate_trace_record",
            "validate_memory_record", "validate_numerics_record",
-           "validate_telemetry_record", "validate_telemetry_jsonl"]
+           "validate_run_record", "validate_telemetry_record",
+           "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
 # <-> request-trace join key) and ``kind: trace`` records exist.
@@ -44,9 +47,17 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # ``numerics_overhead_*`` bench lines must carry ``step_ms_on`` /
 # ``step_ms_off`` (an overhead claim is meaningless without both
 # sides of the comparison).
+# v5: ``kind: run`` records exist (training-run supervisor verdicts
+# from ``RunSupervisor.record`` / ``bench.py --run``); fresh
+# ``run_supervisor_overhead*`` bench lines must carry ``step_ms_on`` /
+# ``step_ms_off`` (same both-sides rule as the v4 numerics overhead);
+# ``kind: fleet`` records MAY carry the SLO/goodput fields
+# (``goodput_tokens_per_s`` / ``slo_attainment`` /
+# ``tokens_within_slo`` / ``deadline_exceeded`` /
+# ``deadline_last_sweep``), validated whenever present at any version.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1/v2/v3 streams stay valid.
-SCHEMA_VERSION = 4
+# version, so archived v1/v2/v3/v4 streams stay valid.
+SCHEMA_VERSION = 5
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -132,10 +143,40 @@ class JsonlExporter:
 
 # -- Prometheus text exposition ------------------------------------------
 
+def _escape_label_value(v) -> str:
+    """Exposition-format label-value escaping: backslash, double quote
+    and newline must be escaped or a label like ``layer="conv\\1"`` /
+    a path with a quote corrupts every line after it."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _unescape_label_value(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt,
+                                                             c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _escape_help(h: str) -> str:
+    """HELP text escaping (backslash + newline; quotes are legal
+    there)."""
+    return h.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_labels(label_set) -> str:
     if not label_set:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in label_set) + "}"
+    return "{" + ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in label_set) + "}"
 
 
 def _edge_str(e: float) -> str:
@@ -167,7 +208,7 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     lines: List[str] = []
     for m in reg.collect():
         if m.help:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         children = m.children()
         # a parent that only ever fans out to labeled children (bare
@@ -179,6 +220,145 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
         for key, child in sorted(children.items()):
             _expose_one(lines, child, key)
     return "\n".join(lines) + "\n"
+
+
+# a sample line: name, optional {labels}, value.  Label values are
+# double-quoted with \\ \" \n escapes (the regex accepts any escaped
+# char and _unescape_label_value resolves it).
+_PROM_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+    r'\s+(\S+)\s*$')
+_PROM_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# suffixes a histogram family's samples may carry
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse one text exposition into
+    ``{family: {type, help, samples: [(name, labels, value)]}}`` with
+    label values UNESCAPED — the round-trip half of the conformance
+    test.  Raises ``ValueError`` on a malformed line (the validator
+    wrapper reports instead)."""
+    families: Dict[str, Dict[str, Any]] = {}
+
+    def fam(name):
+        return families.setdefault(
+            name, {"type": None, "help": None, "samples": []})
+
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(" ", 1)
+            fam(rest[0])["help"] = (rest[1] if len(rest) > 1 else "")
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split(" ", 1)
+            if len(rest) != 2:
+                raise ValueError(f"line {i}: malformed TYPE: {raw!r}")
+            fam(rest[0])["type"] = rest[1]
+            continue
+        if line.startswith("#"):
+            continue                     # plain comment
+        m = _PROM_SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: not a valid sample: {raw!r}")
+        name, labels_raw, value_raw = m.groups()
+        try:
+            value = float(value_raw.replace("+Inf", "inf")
+                          .replace("-Inf", "-inf"))
+        except ValueError:
+            raise ValueError(f"line {i}: non-numeric value "
+                             f"{value_raw!r}") from None
+        labels = {k: _unescape_label_value(v)
+                  for k, v in _PROM_LABEL_RE.findall(labels_raw or "")}
+        base = name
+        for sfx in _HIST_SUFFIXES:
+            if name.endswith(sfx) and name[:-len(sfx)] in families:
+                base = name[:-len(sfx)]
+                break
+        fam(base)["samples"].append((name, labels, value))
+    return families
+
+
+def validate_prometheus_text(text: str) -> List[str]:
+    """Exposition-format conformance check (the `/metricsz` contract,
+    shared by the pytest round-trip and tests/ci/server_smoke.py):
+    every line parses; every sample belongs to a ``# TYPE``-declared
+    family; counters never go negative; histogram families expose a
+    ``+Inf`` bucket per label set, cumulative bucket counts that are
+    monotone over ascending ``le`` edges, and ``_count`` equal to the
+    ``+Inf`` bucket; label values survive the escape round-trip (the
+    parser has already unescaped them — a raw quote/newline would have
+    failed the parse)."""
+    errs: List[str] = []
+    try:
+        families = parse_prometheus_text(text)
+    except ValueError as e:
+        return [str(e)]
+    for name, f in sorted(families.items()):
+        if f["type"] is None:
+            errs.append(f"{name}: samples with no # TYPE line")
+            continue
+        if f["type"] not in ("counter", "gauge", "histogram",
+                             "summary", "untyped"):
+            errs.append(f"{name}: unknown type {f['type']!r}")
+        if f["type"] == "counter":
+            for sname, labels, value in f["samples"]:
+                if value < 0:
+                    errs.append(f"{name}: counter sample {sname} "
+                                f"{labels} is negative ({value})")
+        if f["type"] != "histogram":
+            for sname, labels, _ in f["samples"]:
+                if sname != name:
+                    errs.append(f"{name}: unexpected sample name "
+                                f"{sname!r} for a {f['type']}")
+            continue
+        # histogram: group buckets by their non-le label set
+        series: Dict[tuple, Dict[str, Any]] = {}
+        for sname, labels, value in f["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = series.setdefault(key, {"buckets": [], "sum": None,
+                                        "count": None})
+            if sname == name + "_bucket":
+                if "le" not in labels:
+                    errs.append(f"{name}: bucket sample missing le "
+                                f"label ({labels})")
+                    continue
+                le = labels["le"]
+                edge = float("inf") if le == "+Inf" else float(le)
+                s["buckets"].append((edge, value))
+            elif sname == name + "_sum":
+                s["sum"] = value
+            elif sname == name + "_count":
+                s["count"] = value
+            else:
+                errs.append(f"{name}: unexpected histogram sample "
+                            f"{sname!r}")
+        for key, s in sorted(series.items()):
+            lbl = dict(key)
+            buckets = sorted(s["buckets"])
+            if not buckets or buckets[-1][0] != float("inf"):
+                errs.append(f"{name}{lbl}: histogram has no +Inf "
+                            f"bucket")
+                continue
+            prev = None
+            for edge, c in buckets:
+                if prev is not None and c < prev:
+                    errs.append(f"{name}{lbl}: cumulative bucket "
+                                f"counts decrease at le={edge}")
+                prev = c
+            if s["count"] is None or s["sum"] is None:
+                errs.append(f"{name}{lbl}: histogram missing _sum or "
+                            f"_count")
+            elif s["count"] != buckets[-1][1]:
+                errs.append(f"{name}{lbl}: _count ({s['count']}) != "
+                            f"+Inf bucket ({buckets[-1][1]})")
+    return errs
 
 
 # -- bench record schema --------------------------------------------------
@@ -333,8 +513,14 @@ def validate_bench_record(rec: Any) -> List[str]:
                             f"present, got {v!r}")
     v4 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
           and sv_rec >= 4)
-    if (v4 and isinstance(metric, str)
-            and metric.startswith("numerics_overhead")
+    v5 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 5)
+    # the v5 supervisor-overhead lines (bench.py --run) follow the
+    # same both-sides contract as the v4 numerics overhead: an
+    # overhead claim must carry the on and off step times it came from
+    if (isinstance(metric, str)
+            and ((v4 and metric.startswith("numerics_overhead"))
+                 or (v5 and metric.startswith("run_supervisor_overhead")))
             and "error" not in rec and not rec.get("stale")):
         on = _need(rec, errs, "step_ms_on", numbers.Number)
         off = _need(rec, errs, "step_ms_off", numbers.Number)
@@ -528,6 +714,55 @@ def validate_fleet_record(rec: Any) -> List[str]:
             and not isinstance(fin, bool) and not isinstance(sub, bool)
             and fin > sub):
         errs.append(f"finished ({fin}) exceeds submitted ({sub})")
+    # SLO / goodput / deadline-sweep fields (schema v5 additions,
+    # OPTIONAL at every version — older records simply predate them,
+    # but whenever present they must be internally consistent: goodput
+    # cannot exceed total tokens, attainment is a fraction or null,
+    # and the deadline-sweep aggregate mirrors what the flight ring's
+    # ``deadline_exceeded`` events carry)
+    for opt in ("deadline_exceeded", "tokens_within_slo"):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0 when "
+                            f"present, got {v!r}")
+    tw, tok = rec.get("tokens_within_slo"), rec.get("tokens")
+    if (isinstance(tw, int) and isinstance(tok, int)
+            and not isinstance(tw, bool) and not isinstance(tok, bool)
+            and tw > tok):
+        errs.append(f"tokens_within_slo ({tw}) exceeds tokens ({tok})")
+    if "goodput_tokens_per_s" in rec:
+        v = rec["goodput_tokens_per_s"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (v >= 0)):
+            errs.append(f"'goodput_tokens_per_s' must be a number "
+                        f">= 0 when present, got {v!r}")
+    if "slo_attainment" in rec and rec["slo_attainment"] is not None:
+        v = rec["slo_attainment"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (0.0 <= v <= 1.0)):
+            errs.append(f"'slo_attainment' must be null or in [0, 1], "
+                        f"got {v!r}")
+    if "deadline_last_sweep" in rec:
+        sweep = rec["deadline_last_sweep"]
+        if not isinstance(sweep, dict):
+            errs.append("'deadline_last_sweep' must be an object when "
+                        "present")
+        else:
+            c = sweep.get("count")
+            if not isinstance(c, int) or isinstance(c, bool) or c < 0:
+                errs.append(f"deadline_last_sweep.count must be an "
+                            f"int >= 0, got {c!r}")
+            rids = sweep.get("rids")
+            if not isinstance(rids, list) or any(
+                    not isinstance(r, int) or isinstance(r, bool)
+                    for r in rids):
+                errs.append("deadline_last_sweep.rids must be a list "
+                            "of ints")
+            elif isinstance(c, int) and not isinstance(c, bool) \
+                    and len(rids) > c:
+                errs.append(f"deadline_last_sweep lists {len(rids)} "
+                            f"rids for a count of {c}")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:
@@ -759,6 +994,128 @@ def validate_numerics_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- run record schema ------------------------------------------------------
+
+# anomaly kinds a supervisor may declare — kept in sync with
+# observability.supervisor.ANOMALY_KINDS (duplicated here so the
+# stdlib-only CI loader never imports the supervisor module; the
+# pytest coverage pins the two tuples equal)
+RUN_ANOMALY_KINDS = ("stall", "loss_spike", "nan",
+                     "throughput_regression", "replica_divergence")
+
+
+def validate_run_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: run`` JSONL record
+    (``RunSupervisor.record`` enriched by the exporter, schema v5):
+    the common envelope, a non-empty ``run`` name, the observation /
+    watermark tallies, per-kind anomaly counts over the KNOWN kinds,
+    a bounded anomaly-detail list whose entries each name a counted
+    kind, and the verdict cross-check — ``ok`` iff zero anomalies
+    (a record claiming health while counting anomalies is lying to
+    the dashboard)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types, allow_none=False):
+        return _need(rec, errs, key, types, allow_none)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "run":
+        errs.append(f"kind must be 'run', got {rec.get('kind')!r}")
+    run = need("run", str)
+    if isinstance(run, str) and not run:
+        errs.append("run must be non-empty")
+    obs = need("observations", int)
+    if isinstance(obs, int) and not isinstance(obs, bool) and obs < 0:
+        errs.append(f"observations must be >= 0, got {obs}")
+    wm = rec.get("watermark")
+    if wm is not None and (not isinstance(wm, int)
+                           or isinstance(wm, bool)):
+        errs.append(f"'watermark' must be null or an int, got {wm!r}")
+    verdict = need("verdict", str)
+    if isinstance(verdict, str) and verdict not in ("ok", "attention"):
+        errs.append(f"verdict must be 'ok' or 'attention', got "
+                    f"{verdict!r}")
+    counts = need("anomaly_counts", dict)
+    total = None
+    if isinstance(counts, dict):
+        total = 0
+        for k, v in sorted(counts.items()):
+            if k not in RUN_ANOMALY_KINDS:
+                errs.append(f"anomaly_counts names unknown kind {k!r} "
+                            f"(known: {RUN_ANOMALY_KINDS})")
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"anomaly_counts[{k!r}] must be an int "
+                            f">= 0, got {v!r}")
+            else:
+                total += v
+    if isinstance(verdict, str) and total is not None \
+            and verdict in ("ok", "attention") \
+            and (verdict == "ok") != (total == 0):
+        errs.append(f"verdict {verdict!r} inconsistent with "
+                    f"{total} counted anomalies")
+    anomalies = need("anomalies", list)
+    if isinstance(anomalies, list):
+        per_kind: Dict[str, int] = {}
+        for i, a in enumerate(anomalies):
+            if not isinstance(a, dict):
+                errs.append(f"anomalies[{i}] is not an object")
+                continue
+            k = a.get("kind")
+            if k not in RUN_ANOMALY_KINDS:
+                errs.append(f"anomalies[{i}].kind must be one of "
+                            f"{RUN_ANOMALY_KINDS}, got {k!r}")
+            else:
+                per_kind[k] = per_kind.get(k, 0) + 1
+            o = a.get("observation")
+            if not isinstance(o, int) or isinstance(o, bool) or o < 1:
+                errs.append(f"anomalies[{i}].observation must be an "
+                            f"int >= 1, got {o!r}")
+        if isinstance(counts, dict):
+            for k, n in sorted(per_kind.items()):
+                c = counts.get(k)
+                if isinstance(c, int) and not isinstance(c, bool) \
+                        and n > c:
+                    errs.append(
+                        f"anomalies lists {n} {k!r} entries but "
+                        f"anomaly_counts[{k!r}] is {c} (the detail "
+                        f"list is bounded, the counts are exact — "
+                        f"details can never exceed the count)")
+    # the loss / step-time summaries, when present, must be objects of
+    # numbers-or-null with NaN rejected (x == x is False only for NaN)
+    for opt in ("loss", "step_time_s"):
+        if opt in rec:
+            d = rec[opt]
+            if not isinstance(d, dict):
+                errs.append(f"{opt!r} must be an object when present")
+                continue
+            for k, v in sorted(d.items()):
+                if v is None:
+                    continue
+                if (not isinstance(v, numbers.Number)
+                        or isinstance(v, bool) or v != v):
+                    errs.append(f"{opt}.{k} must be a finite number "
+                                f"or null, got {v!r}")
+    for opt in ("checkpoints",):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0 when "
+                            f"present, got {v!r}")
+    if "duration_s" in rec:
+        v = rec["duration_s"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (v >= 0)):
+            errs.append(f"'duration_s' must be a number >= 0, got "
+                        f"{v!r}")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 # -- trace record schema ----------------------------------------------------
 
 def validate_trace_record(rec: Any) -> List[str]:
@@ -852,7 +1209,9 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     (``kind: trace``), cost-model dumps (``kind: memory``, from
     ``python -m apex_tpu.analysis --memory`` / ``bench.py``) and
     gradient-health dumps (``kind: numerics``, from
-    ``bench.py --numerics`` / ``NumericsMonitor.to_record``)."""
+    ``bench.py --numerics`` / ``NumericsMonitor.to_record``) and
+    training-run supervisor verdicts (``kind: run``, from
+    ``bench.py --run`` / ``RunSupervisor.record``, schema v5)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -864,12 +1223,14 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_memory_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "numerics":
         return validate_numerics_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "run":
+        return validate_run_record(rec)
     return validate_bench_record(rec)
 
 
 def validate_telemetry_jsonl(lines: Iterable[str]) -> List[str]:
     """Validate a mixed bench + graph-lint + fleet + trace + memory +
-    numerics JSONL stream."""
+    numerics + run JSONL stream."""
     return _validate_jsonl(lines, validate_telemetry_record)
 
 

@@ -89,6 +89,16 @@ class EventRing:
         with self._lock:
             return self._seq - len(self._events)
 
+    def stats(self) -> Dict[str, int]:
+        """One consistent accounting snapshot (capacity / total ever
+        appended / retained / dropped) — the ``/flightz`` header; taken
+        under one lock acquisition so ``total == retained + dropped``
+        holds even mid-append."""
+        with self._lock:
+            n = len(self._events)
+            return {"capacity": self.capacity, "total": self._seq,
+                    "retained": n, "dropped": self._seq - n}
+
     def clear(self):
         with self._lock:
             self._events.clear()

@@ -217,6 +217,17 @@ class SpanRecorder:
             self._events.clear()
 
     # -- trace queries -----------------------------------------------------
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids with at least one retained event, in
+        first-seen order — the ``/tracez`` index (a bounded recorder
+        lists only traces whose events survived eviction)."""
+        seen: Dict[str, None] = {}
+        for e in self.events():
+            tid = e.get("trace_id")
+            if tid is not None and tid not in seen:
+                seen[tid] = None
+        return list(seen)
+
     def trace(self, trace_id: str) -> List[Dict[str, Any]]:
         """All events of one trace, in span-id (causal allocation)
         order — begin-time order would interleave a parent span (whose

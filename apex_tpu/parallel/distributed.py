@@ -775,6 +775,17 @@ class DistributedDataParallel:
             lvl.labels(level="dcn", dtype=b["comm_dtype"]).inc(
                 b.get("dcn_wire_bytes", b["bytes"]))
 
+    def supervisor_signals(self) -> Dict[str, Any]:
+        """The wrapper's host-side signal bundle for a training-run
+        supervisor (``observability.supervisor.RunSupervisor``): the
+        trace-time comm accounting and the last flushed numerics
+        summary.  Everything here is plain python the wrapper already
+        holds — feeding it to ``observe_step(comm_stats=...,
+        numerics=...)`` costs no device traffic, which is the whole
+        supervisor contract."""
+        return {"comm_stats": list(self.last_comm_stats),
+                "numerics": dict(self.last_numerics)}
+
     def record_numerics(self, flushed: Dict[str, Any]) -> Dict[str, Any]:
         """Fold a flushed ``NumericsMonitor`` summary into the wrapper's
         observability surface: ``ddp.last_numerics`` (the

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import re
 import tempfile
+import time
 from typing import Any, Optional
 
 import jax
@@ -33,10 +34,60 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "available_steps"]
+           "available_steps", "tree_bytes", "record_checkpoint_io"]
 
 _FMT = "ckpt_{step:08d}.npz"
 _RE = re.compile(r"ckpt_(\d{8})\.npz$")
+
+# seconds; local-disk npz snapshots up to multi-minute sharded
+# TensorStore writes
+_CKPT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                 30.0, 60.0, 120.0, 300.0)
+
+
+def tree_bytes(tree: Any) -> int:
+    """In-memory bytes of one state tree's leaves (what a snapshot
+    persists, pre-compression) — the ``checkpoint_snapshot_bytes``
+    gauge."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        total += int(n) if n is not None else np.asarray(leaf).nbytes
+    return total
+
+
+def record_checkpoint_io(op: str, seconds: float, step=None,
+                         nbytes: Optional[int] = None,
+                         path: Optional[str] = None,
+                         async_save: bool = False,
+                         registry=None, ring=None) -> None:
+    """Checkpoint telemetry shared by the npz and Orbax paths: fold
+    one save/restore into the metrics registry (latency histogram,
+    op counter, snapshot-bytes gauge) and — for saves — append the
+    ``checkpoint_saved`` flight-ring event the training-run
+    supervisor's progress watermark consumes (a run that is writing
+    checkpoints is making durable progress).  ``op`` is ``"save"`` or
+    ``"restore"``; defaults resolve the process registry/ring per
+    call, the same rule as every other producer."""
+    if op not in ("save", "restore"):
+        raise ValueError(f"op must be 'save' or 'restore', got {op!r}")
+    from ..observability import flightrec
+    from ..observability.metrics import get_registry
+    reg = registry if registry is not None else get_registry()
+    reg.histogram(f"checkpoint_{op}_seconds",
+                  help=f"wall seconds per checkpoint {op}",
+                  buckets=_CKPT_BUCKETS).observe(float(seconds))
+    reg.counter(f"checkpoint_{op}s_total").inc()
+    if nbytes is not None:
+        reg.gauge("checkpoint_snapshot_bytes",
+                  help="leaf bytes of the last checkpointed state tree"
+                  ).set(float(nbytes))
+    if op == "save":
+        flightrec.resolve(ring).append(
+            "checkpoint_saved",
+            step=int(step) if step is not None else None,
+            bytes=nbytes, path=path, async_save=bool(async_save),
+            duration_s=round(float(seconds), 6))
 
 
 def _leaf_dict(tree: Any) -> dict:
@@ -62,6 +113,7 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
     os.makedirs(ckpt_dir, exist_ok=True)
+    t0 = time.perf_counter()
     leaves = _leaf_dict(tree)
     path = os.path.join(ckpt_dir, _FMT.format(step=step))
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
@@ -73,6 +125,10 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    # telemetry only after the rename: a failed write must not emit a
+    # checkpoint_saved event the supervisor would count as progress
+    record_checkpoint_io("save", time.perf_counter() - t0, step=step,
+                         nbytes=tree_bytes(tree), path=path)
     if keep is not None:
         for s in available_steps(ckpt_dir)[:-keep]:
             os.unlink(os.path.join(ckpt_dir, _FMT.format(step=s)))
@@ -106,6 +162,7 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
     path = os.path.join(ckpt_dir, _FMT.format(step=step))
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    t0 = time.perf_counter()
     with np.load(path) as data:
         stored = dict(data)
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
@@ -123,4 +180,8 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
                 f"template {leaf.shape}")
         dtype = getattr(leaf, "dtype", arr.dtype)
         out.append(jnp.asarray(arr, dtype))
-    return jax.tree_util.tree_unflatten(treedef, out)
+    restored = jax.tree_util.tree_unflatten(treedef, out)
+    record_checkpoint_io("restore", time.perf_counter() - t0,
+                         step=step, nbytes=tree_bytes(restored),
+                         path=path)
+    return restored

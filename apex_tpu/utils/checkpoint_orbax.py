@@ -22,15 +22,29 @@ layout restores into that layout.
 
 Falls back cleanly when orbax is unavailable (import guarded); callers
 needing the guaranteed-present path use the npz module.
+
+Telemetry (shared with the npz path via
+:func:`~apex_tpu.utils.checkpoint.record_checkpoint_io`): every save /
+restore lands in the process registry's
+``checkpoint_save_seconds`` / ``checkpoint_restore_seconds``
+histograms and the ``checkpoint_snapshot_bytes`` gauge, and every
+**durable** save appends a ``checkpoint_saved`` flight-ring event —
+for a sync save at return, for an async save at the join (``wait()``
+or the next save), because only then has the write actually succeeded
+and only then may the training-run supervisor's progress watermark
+consume it.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Any, Optional
 
 import jax
+
+from .checkpoint import record_checkpoint_io, tree_bytes
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
            "available_steps"]
@@ -64,19 +78,25 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
         raise ValueError(f"keep must be >= 1, got {keep}")
     wait()                        # join + surface any pending async save
     path = os.path.join(_mgr_dir(ckpt_dir), f"step_{int(step)}")
+    t0 = time.perf_counter()
+    nbytes = tree_bytes(tree)
     ckptr = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
              if async_save
              else ocp.Checkpointer(ocp.StandardCheckpointHandler()))
     ckptr.save(path, tree, force=True)
     if not async_save:
         ckptr.close()
+        record_checkpoint_io("save", time.perf_counter() - t0,
+                             step=int(step), nbytes=nbytes, path=path)
         if keep is not None:
             _prune(ckpt_dir, keep)
     else:
         global _pending
-        # pruning is deferred to the join so a failed background write
-        # can't have already deleted the older good checkpoints
-        _pending = (ckptr, ckpt_dir, keep)
+        # pruning AND the checkpoint_saved telemetry are deferred to
+        # the join: a failed background write can't have already
+        # deleted the older good checkpoints, and must not have
+        # emitted a progress event for a snapshot that never landed
+        _pending = (ckptr, ckpt_dir, keep, int(step), path, nbytes, t0)
     return path
 
 
@@ -84,13 +104,18 @@ _pending = None
 
 
 def wait() -> None:
-    """Join an in-flight async save (then apply its deferred pruning)."""
+    """Join an in-flight async save (then apply its deferred pruning
+    and emit its deferred ``checkpoint_saved`` telemetry — the save is
+    only durable now)."""
     global _pending
     if _pending is not None:
-        ckptr, ckpt_dir, keep = _pending
+        ckptr, ckpt_dir, keep, step, path, nbytes, t0 = _pending
         _pending = None
         ckptr.wait_until_finished()
         ckptr.close()
+        record_checkpoint_io("save", time.perf_counter() - t0,
+                             step=step, nbytes=nbytes, path=path,
+                             async_save=True)
         if keep is not None:
             _prune(ckpt_dir, keep)
 
@@ -133,6 +158,11 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
         return jax.ShapeDtypeStruct(jax.numpy.asarray(leaf).shape,
                                     jax.numpy.asarray(leaf).dtype)
 
+    t0 = time.perf_counter()
     abstract = jax.tree_util.tree_map(to_abstract, template)
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        return ckptr.restore(path, abstract)
+        restored = ckptr.restore(path, abstract)
+    record_checkpoint_io("restore", time.perf_counter() - t0,
+                         step=int(step), nbytes=tree_bytes(restored),
+                         path=path)
+    return restored

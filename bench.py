@@ -210,11 +210,21 @@ def main():
     # the SAME DDP resnet18 train step timed with the NumericsMonitor
     # on vs off (per-layer grad health + per-bucket stats + divergence
     # digest vs nothing), plus one `kind: numerics` gradient-health
-    # record per level from the instrumented run's flush.  Precedence
-    # when combined: --fleet > --comm > --numerics; --graph-lint
-    # composes with all of them and still gates the exit status.
+    # record per level from the instrumented run's flush.
+    # --run: operational-plane bench — (1) training-run supervisor
+    # overhead: the SAME DDP resnet18 O2 loop with the host-side
+    # RunSupervisor observing every step's already-fetched loss vs not
+    # observing (the jitted step is identical by the audit-pinned
+    # wrap_step contract — only the host-side observe cost can differ),
+    # plus the loop's `kind: run` verdict record; (2) fleet SLO/goodput:
+    # a deadline-carrying fleet workload emitting
+    # goodput_tokens_per_s + the `kind: fleet` record with the SLO
+    # fields.  Precedence when combined: --fleet > --comm > --numerics
+    # > --run; --graph-lint composes with all of them and still gates
+    # the exit status.
     comm_flag = "--comm" in sys.argv
     numerics_flag = "--numerics" in sys.argv
+    run_flag = "--run" in sys.argv
 
     fleet_n = 0
     if "--fleet" in sys.argv:
@@ -663,6 +673,169 @@ def main():
 
     if numerics_flag and not fleet_n:
         run_numerics_bench()
+        # --graph-lint (if also passed) already ran and still gates
+        return 1 if lint_errors else 0
+
+    def run_run_bench():
+        """Operational-plane bench: supervisor observe-cost on the
+        training side, SLO/goodput accounting on the serving side —
+        both streams schema-gated (`kind: run` / the v5 fleet fields)
+        and trend-gated like every other record family."""
+        from apex_tpu import observability as obs
+
+        # -- (1) supervisor overhead on the resnet18 O2 DDP loop ------
+        iters, warmup = (30, 5) if on_tpu else (6, 2)
+        Bc, image = (32, 96) if on_tpu else (4, 32)
+        B = Bc * ndev
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(B, 3, image, image), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+        model, opt = amp.initialize(
+            models.resnet18(num_classes=10),
+            optimizers.FusedAdam(1e-3), opt_level="O2", verbosity=0)
+        ddp = parallel.DistributedDataParallel(model)
+        params, bn = model.init(jax.random.PRNGKey(0))
+        ost = opt.init(params)
+
+        def step(state, batch):
+            params, bn_s, ost = state
+            xb, yb = batch
+
+            def loss_fn(p):
+                out, nb = model.apply(p, xb, state=bn_s, train=True)
+                return F.cross_entropy(out, yb), nb
+
+            loss, nb, g = amp.scaled_grad(loss_fn, params, ost,
+                                          has_aux=True)
+            g = ddp.allreduce_grads(g)
+            params, ost2, _ = opt.step(params, ost, g)
+            return (params, nb, ost2), lax.pmean(loss, "data")
+
+        state0 = (params, bn, ost)
+
+        def loop(supervise):
+            """Identical loop both ways — the per-step loss fetch IS
+            an existing flush point and both variants pay it; the on
+            variant additionally feeds the supervisor.  wrap_step is
+            an identity (audit-pinned), so the jitted program is the
+            same object's trace either way."""
+            sup = obs.RunSupervisor("bench_resnet18_o2_ddp",
+                                    enabled=supervise)
+            train = sup.wrap_step(sharded(step))
+            st = state0
+            for _ in range(warmup):
+                st, loss = train(st, (x, y))
+            float(jnp.sum(loss))
+            t0 = time.perf_counter()
+            t_prev = t0
+            for i in range(iters):
+                st, loss = train(st, (x, y))
+                lval = float(jnp.sum(loss))     # existing flush point
+                t_now = time.perf_counter()
+                sup.observe_step(step=i, loss=lval,
+                                 step_time_s=t_now - t_prev,
+                                 comm_stats=ddp.last_comm_stats)
+                t_prev = t_now
+            return (time.perf_counter() - t0) / iters, sup
+
+        t_off, _ = loop(False)
+        t_on, sup = loop(True)
+        overhead = max(t_on - t_off, 0.0)
+        emit(metric="run_supervisor_overhead_o2",
+             value=round(overhead * 1e3, 4), unit="ms",
+             vs_baseline=None, opt_level="O2",
+             step_ms_on=round(t_on * 1e3, 4),
+             step_ms_off=round(t_off * 1e3, 4),
+             overhead_fraction=round(overhead / max(t_off, 1e-9), 4),
+             note=f"resnet18 O2 DDP step, RunSupervisor observing "
+                  f"every step vs disabled ({warmup + iters} steps "
+                  f"each); the jitted step is byte-identical by the "
+                  f"wrap_step contract (supervisor rule), so this "
+                  f"measures pure host-side observe cost"
+                  + ("; CPU smoke: wall-clock is noisy, the "
+                     "audit-pinned jaxpr identity is the portable "
+                     "signal" if not on_tpu else ""))
+        emit(**sup.record(metric="resnet18_o2_ddp_run"))
+
+        # -- (2) fleet SLO/goodput ------------------------------------
+        from apex_tpu import serving
+        from apex_tpu.fleet import Fleet, RetryPolicy
+
+        cfg = models.GPTConfig(vocab_size=128, block_size=32,
+                               n_layer=2, n_head=4, n_embd=32,
+                               dropout=0.0)
+        gmodel = models.GPT(cfg)
+        gparams, _ = gmodel.init(jax.random.PRNGKey(0))
+        gparams = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, gparams)
+        slots, prompt_len, new_tokens = 4, 4, 16
+        n_requests, n_hopeless = 24, 4
+        engines = [serving.Engine(gmodel, gparams, slots=slots,
+                                  buf_len=cfg.block_size)
+                   for _ in range(2)]
+
+        def build_fleet():
+            return Fleet(engines, policy="least_loaded",
+                         max_queue=4 * n_requests,
+                         retry=RetryPolicy(max_attempts=10),
+                         step_workers=1)
+
+        rng = np.random.RandomState(0)
+
+        def submit_all(fl, deadline):
+            rids = [fl.submit(
+                list(rng.randint(0, cfg.vocab_size, prompt_len)),
+                max_new_tokens=new_tokens, deadline=deadline)
+                for _ in range(n_requests)]
+            # a few requests whose deadline has effectively already
+            # passed: the sweep expires them, slo_attainment dips
+            # below 1.0 and the goodput excludes their tokens
+            rids += [fl.submit(
+                list(rng.randint(0, cfg.vocab_size, prompt_len)),
+                max_new_tokens=new_tokens, deadline=1e-6)
+                for _ in range(n_hopeless)]
+            while fl.live():
+                fl.step()
+            return rids
+
+        # warm on a throwaway fleet (pays the engine compiles), then
+        # measure on a FRESH one around the SAME warmed engines: the
+        # SloTracker's goodput window opens at first submit, so a
+        # shared fleet would fold compile seconds into the trended
+        # goodput rate (Fleet is host-side — rebuilding it re-jits
+        # nothing)
+        warm = build_fleet()
+        submit_all(warm, deadline=120.0)
+        warm.close()
+        fl = build_fleet()
+        t0 = time.perf_counter()
+        submit_all(fl, deadline=120.0)
+        dt = time.perf_counter() - t0
+        fl.close()
+        rec = fl.record()
+        s = fl.stats()
+        emit(metric="gpt_tiny_fleet_goodput_tokens_per_s",
+             value=rec["goodput_tokens_per_s"], unit="tokens/sec",
+             vs_baseline=round(
+                 rec["goodput_tokens_per_s"]
+                 / max(s["tokens_generated"] / dt, 1e-9), 3),
+             slo_attainment=rec["slo_attainment"],
+             tokens_within_slo=rec["tokens_within_slo"],
+             deadline_exceeded=rec["deadline_exceeded"],
+             queue_wait_p50_s=s["slo"]["queue_wait"]["p50"],
+             service_p50_s=s["slo"]["service_time"]["p50"],
+             note=f"2-replica fleet, {n_requests} requests at a 120s "
+                  f"deadline + {n_hopeless} pre-expired; goodput "
+                  f"counts only tokens delivered within SLO (the "
+                  f"pre-expired requests' would-be tokens don't), "
+                  f"vs_baseline is goodput over raw throughput; "
+                  f"queue-wait/service split from the same instants "
+                  f"the request traces record")
+        emit(**rec)
+
+    if run_flag and not fleet_n:
+        run_run_bench()
         # --graph-lint (if also passed) already ran and still gates
         return 1 if lint_errors else 0
 

@@ -26,16 +26,23 @@ per-train-config records bench emits) against the memory schema
 arithmetic), and gradient-health dumps (``kind: numerics``, from
 ``bench.py --numerics``) against the numerics schema
 (``validate_numerics_record``: per-layer health fields, culprit
-cross-checks, divergence consistency); at schema v3 fresh
-train-throughput lines must carry the MFU fields and fresh
-engine-decode lines ``kv_cache_bytes``, at v4 fresh
-``numerics_overhead_*`` lines the on/off step times.  All
+cross-checks, divergence consistency), and training-run supervisor
+verdicts (``kind: run``, from ``bench.py --run`` /
+``RunSupervisor.record``) against the run schema
+(``validate_run_record``: known anomaly kinds, verdict-vs-counts
+consistency); at schema v3 fresh train-throughput lines must carry
+the MFU fields and fresh engine-decode lines ``kv_cache_bytes``, at
+v4 fresh ``numerics_overhead_*`` lines the on/off step times, at v5
+fresh ``run_supervisor_overhead*`` lines the same on/off pair, and
+``kind: fleet`` records may carry the SLO/goodput + deadline-sweep
+fields (validated whenever present).  All
 record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
     python bench.py --fleet 2 | python tests/ci/check_bench_schema.py
     python bench.py --comm --graph-lint \
         | python tests/ci/check_bench_schema.py
+    python bench.py --run | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
     python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
 

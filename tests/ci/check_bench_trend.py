@@ -40,8 +40,11 @@ neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
 measured anything).  Error lines (``value: null`` + ``error``) and
 flag/summary records are likewise excluded, as are per-run
-``kind: numerics`` gradient-health dumps (schema v4) — their stale
-replays still count toward the partition tally.
+``kind: numerics`` gradient-health dumps (schema v4) and per-run
+``kind: run`` supervisor verdicts (schema v5) — their stale replays
+still count toward the partition tally.  The ``run_supervisor_overhead``
+and ``fleet_goodput`` *metric* lines from ``bench.py --run`` are
+ordinary measurements and DO trend (accelerator gates, CPU warns).
 
 Usage::
 
@@ -239,8 +242,13 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                 continue
             # ``kind: numerics`` records (gradient-health dumps from
             # bench --numerics) describe one run's numerics, not a
-            # cross-round trend; stale replays partition out as ever
-            if isinstance(rec, dict) and rec.get("kind") == "numerics":
+            # cross-round trend; stale replays partition out as ever.
+            # ``kind: run`` records (supervisor verdicts from bench
+            # --run, schema v5) likewise describe one run — its
+            # anomaly counts are that run's story, not a regression
+            # against an earlier round's run
+            if isinstance(rec, dict) and rec.get("kind") in ("numerics",
+                                                             "run"):
                 if is_stale(rec):
                     n_stale += 1
                 continue

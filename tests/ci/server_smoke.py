@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""CI gate: boot the introspection server on an ephemeral port and
+scrape it end to end.
+
+Loads ``apex_tpu.observability``'s server stack WITHOUT importing the
+apex_tpu package (pure stdlib — same loader discipline as
+check_bench_schema.py: a smoke gate that pulls in jax + the model zoo
+would cost ~15s per CI invocation for nothing), builds a registry /
+flight ring / span recorder / run supervisor with representative
+content — including label values that NEED exposition escaping — then:
+
+1. starts :class:`ObservabilityServer` on ``127.0.0.1:0``;
+2. scrapes ``/healthz`` ``/metricsz`` ``/statusz`` ``/flightz``
+   ``/tracez`` (and ``/tracez?trace_id=``) over real HTTP;
+3. validates ``/metricsz`` against the exposition-format conformance
+   checker (``validate_prometheus_text``: TYPE/HELP lines, label
+   escaping round-trip, +Inf buckets, cumulative monotonicity);
+4. validates the JSON endpoints' shapes — ``/healthz`` status + check
+   map, ``/statusz`` source isolation, ``/flightz`` seq-ordered events
+   with exact drop accounting, ``/tracez?trace_id=`` as a schema-clean
+   ``kind: trace`` record — and that the supervisor's sick verdict
+   flips ``/healthz`` to 503.
+
+Exit 0 = every scrape valid; 1 = any violation (each printed).
+Wired into tier-1 by tests/test_server.py (subprocess), like the
+check_bench_trend gate.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), os.pardir, os.pardir))
+
+
+def _load_obs():
+    """Load the jax-free observability submodules the server needs,
+    without importing the apex_tpu package."""
+    pkg_dir = os.path.join(_ROOT, "apex_tpu", "observability")
+    spec = importlib.util.spec_from_file_location(
+        "_obs_smoke", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules["_obs_smoke"] = pkg
+    mods = {}
+    for sub in ("metrics", "exporters", "flightrec", "tracing",
+                "supervisor", "server"):
+        sspec = importlib.util.spec_from_file_location(
+            f"_obs_smoke.{sub}", os.path.join(pkg_dir, sub + ".py"))
+        mod = importlib.util.module_from_spec(sspec)
+        sys.modules[f"_obs_smoke.{sub}"] = mod
+        sspec.loader.exec_module(mod)
+        mods[sub] = mod
+    return mods
+
+
+def _get(url, want_status=200):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def main(argv):
+    errs = []
+    mods = _load_obs()
+    metrics, exporters = mods["metrics"], mods["exporters"]
+    flightrec, tracing = mods["flightrec"], mods["tracing"]
+    supervisor, server = mods["supervisor"], mods["server"]
+
+    # representative content, incl. escape-needing label values
+    reg = metrics.MetricsRegistry()
+    reg.counter("smoke_requests_total",
+                help="requests with a \\ backslash in help").labels(
+        route='/v1/"generate"\npath', shard="a\\b").inc(5)
+    reg.gauge("smoke_occupancy").set(0.75)
+    h = reg.histogram("smoke_latency_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 5.0):
+        h.observe(v)
+    ring = flightrec.EventRing(capacity=4)
+    for i in range(7):                  # overflow: exact drop accounting
+        ring.append("smoke_event", i=i)
+    rec = tracing.SpanRecorder()
+    tid = tracing.new_trace_id("smoke")
+    root = rec.event("submit", trace_id=tid)
+    rec.event("dispatch", trace_id=tid, parent_id=root)
+    sup = supervisor.RunSupervisor("smoke_run", registry=reg, ring=ring)
+    sup.observe_step(step=0, loss=1.0, step_time_s=0.01)
+
+    srv = server.ObservabilityServer(
+        registry=reg, ring=ring, recorder=rec,
+        status={"run": sup.status,
+                "boom": lambda: (_ for _ in ()).throw(
+                    RuntimeError("seeded source failure"))},
+        health={"run": sup.health_check}).start()
+    base = srv.url
+    print(f"server_smoke: serving on {base}")
+
+    try:
+        # /healthz — healthy run, 200 + check map
+        code, ctype, body = _get(base + "/healthz")
+        hz = json.loads(body)
+        if code != 200 or hz.get("status") != "ok":
+            errs.append(f"/healthz expected 200/ok, got {code}/"
+                        f"{hz.get('status')!r}")
+        if hz.get("checks", {}).get("run", {}).get("ok") is not True:
+            errs.append(f"/healthz run check not ok: {hz.get('checks')}")
+
+        # /metricsz — exposition conformance
+        code, ctype, body = _get(base + "/metricsz")
+        if code != 200 or not ctype.startswith("text/plain"):
+            errs.append(f"/metricsz expected 200 text/plain, got "
+                        f"{code} {ctype!r}")
+        text = body.decode("utf-8")
+        for e in exporters.validate_prometheus_text(text):
+            errs.append(f"/metricsz exposition: {e}")
+        fams = exporters.parse_prometheus_text(text)
+        labels = fams["smoke_requests_total"]["samples"][0][1]
+        if labels.get("route") != '/v1/"generate"\npath' \
+                or labels.get("shard") != "a\\b":
+            errs.append(f"/metricsz label escaping did not round-trip: "
+                        f"{labels}")
+
+        # /statusz — source content + error isolation
+        code, _, body = _get(base + "/statusz")
+        st = json.loads(body)
+        if code != 200 or st.get("run", {}).get("run") != "smoke_run":
+            errs.append(f"/statusz missing run source: {code}")
+        if "error" not in st.get("boom", {}):
+            errs.append("/statusz did not isolate the raising source")
+
+        # /flightz — seq-ordered window, exact drop accounting
+        code, _, body = _get(base + "/flightz")
+        fz = json.loads(body)
+        seqs = [e["seq"] for e in fz.get("events", [])]
+        if code != 200 or seqs != sorted(seqs):
+            errs.append(f"/flightz events not seq-ordered: {seqs}")
+        if fz.get("total", 0) != fz.get("dropped", -1) + len(seqs):
+            errs.append(f"/flightz drop accounting inexact: {fz}")
+
+        # /tracez — index, then one schema-clean kind: trace record
+        code, _, body = _get(base + "/tracez")
+        tz = json.loads(body)
+        if code != 200 or tid not in tz.get("traces", []):
+            errs.append(f"/tracez index missing {tid}: {tz.get('traces')}")
+        code, _, body = _get(base + f"/tracez?trace_id={tid}")
+        trec = json.loads(body)
+        for e in exporters.validate_trace_record(trec):
+            errs.append(f"/tracez record: {e}")
+        code, _, _ = _get(base + "/tracez?trace_id=nope")
+        if code != 404:
+            errs.append(f"/tracez unknown trace expected 404, got {code}")
+
+        # sick supervisor flips /healthz to 503
+        sup.observe_step(step=1, loss=float("nan"))
+        code, _, body = _get(base + "/healthz")
+        hz = json.loads(body)
+        if code != 503 or hz.get("status") != "unhealthy":
+            errs.append(f"/healthz expected 503/unhealthy after NaN, "
+                        f"got {code}/{hz.get('status')!r}")
+    finally:
+        srv.stop()
+
+    for e in errs:
+        print(f"server_smoke: {e}", file=sys.stderr)
+    if errs:
+        return 1
+    print("server_smoke: all 5 endpoints OK (exposition conformant, "
+          "schemas valid, sick-run 503)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

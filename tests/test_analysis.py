@@ -516,6 +516,90 @@ def test_numerics_rule_flags_host_sync_extra_collective_and_residue():
     assert len(found) == 1 and "residue" in found[0].message
 
 
+def test_supervisor_rule_flags_instrumented_step_both_ways():
+    """The PR 10 operational-plane rule, mutation-proofed in both
+    directions like the numerics rule: the honest supervised step (an
+    identity wrap, enabled or disabled) passes; a mutant 'supervisor'
+    that smuggles a host callback into the step flags on BOTH the
+    host-transfer census and the jaxpr identity; a mutant that merely
+    adds eqns (extra collective, threaded state) flags as residue —
+    again whether the expectation says enabled or disabled, because
+    the supervisor contract is identical in both directions."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def base_fn(x):
+        return jax.lax.psum(x * 2.0, "data")
+
+    def callback_fn(x):
+        # a naive supervisor reading the loss per step from inside
+        # the jitted graph — the exact mutation the rule exists for
+        y = jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return jax.lax.psum(y * 2.0, "data")
+
+    def extra_eqn_fn(x):
+        y = x * 2.0
+        return jax.lax.psum(y, "data") + jnp.sum(y) * 0.0
+
+    def trace(fn):
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=(P("data"),),
+                               out_specs=P(), check_vma=False)
+        return lambda: jax.make_jaxpr(mapped)(jnp.ones((2, 8)))
+
+    baseline = _ep("supervisor_baseline", trace=trace(base_fn))
+    for enabled in (True, False):
+        expect = {"supervisor": {"baseline": baseline,
+                                 "enabled": enabled}}
+        ok = _ep(f"fixed_supervised_{enabled}", expect=expect,
+                 trace=trace(base_fn))
+        assert _run(ok, "supervisor") == []
+
+        cb = _ep(f"mutant_supervised_callback_{enabled}",
+                 expect=expect, trace=trace(callback_fn))
+        found = _run(cb, "supervisor")
+        assert any(f.detail.get("primitive") == "pure_callback"
+                   for f in found)
+        assert any("residue" in f.message for f in found)
+
+        extra = _ep(f"mutant_supervised_residue_{enabled}",
+                    expect=expect, trace=trace(extra_eqn_fn))
+        found = _run(extra, "supervisor")
+        assert len(found) == 1 and "residue" in found[0].message
+
+    # a missing baseline cannot silently pass
+    nobase = _ep("mutant_supervised_nobase",
+                 expect={"supervisor": {"enabled": True}},
+                 trace=trace(base_fn))
+    found = _run(nobase, "supervisor")
+    assert len(found) == 1 and "baseline" in found[0].message
+
+
+def test_run_record_dispatch_in_mixed_stream():
+    """A kind: run record interleaves in the telemetry stream and is
+    validated by the run schema; its anomaly kinds stay in lockstep
+    with the supervisor's tuple."""
+    import json
+    from apex_tpu.observability import exporters, supervisor
+    assert exporters.RUN_ANOMALY_KINDS == supervisor.ANOMALY_KINDS
+    good = exporters.JsonlExporter.enrich({
+        "kind": "run", "run": "r", "verdict": "ok",
+        "observations": 3, "watermark": 2,
+        "anomaly_counts": {k: 0 for k in
+                           exporters.RUN_ANOMALY_KINDS},
+        "anomalies": []})
+    bench = exporters.JsonlExporter.enrich({
+        "metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+        "ndev": 8, "arch": "cpu"})
+    errs = exporters.validate_telemetry_jsonl(
+        [json.dumps(good), json.dumps(bench)])
+    assert errs == []
+    bad = dict(good)
+    bad["verdict"] = "attention"       # lies: zero counted anomalies
+    errs = exporters.validate_telemetry_jsonl([json.dumps(bad)])
+    assert any("inconsistent" in e for e in errs)
+
+
 def test_numerics_record_dispatch_in_mixed_stream():
     """A kind: numerics record interleaves in the telemetry stream and
     dispatches to its own validator."""
@@ -812,10 +896,12 @@ def test_findings_to_records_and_registry_surface():
     assert set(analysis.RULES) == {"host-transfer", "donation",
                                    "amp-dtype", "layout", "collective",
                                    "flop-accounting", "memory-budget",
-                                   "numerics"}
+                                   "numerics", "supervisor"}
     for name in ("ddp_resnet18_o2", "engine_step_k", "seq2seq_step_k",
                  "tp_mlp_train_step", "ddp_resnet18_o2_numerics",
-                 "ddp_resnet18_o2_numerics_off"):
+                 "ddp_resnet18_o2_numerics_off",
+                 "ddp_resnet18_o2_supervised",
+                 "ddp_resnet18_o2_supervised_off"):
         assert name in analysis.ENTRY_POINTS
     f = analysis.Finding(rule="r", entry_point="e", message="m")
     (rec,) = analysis.findings_to_records([f])

@@ -191,3 +191,39 @@ class TestOrbaxSharded:
         back = co.restore_checkpoint(str(tmp_path), state, step=1)
         np.testing.assert_array_equal(np.asarray(back["w"]),
                                       np.asarray(state["w"]))
+
+    def test_orbax_telemetry_and_deferred_async_event(self, tmp_path):
+        """Checkpoint telemetry (PR 10 satellite): orbax save/restore
+        land in the latency histograms + snapshot-bytes gauge, a SYNC
+        save emits checkpoint_saved at return, and an ASYNC save
+        defers its event to the join — only a durable snapshot may
+        advance a supervisor's progress watermark."""
+        from apex_tpu.observability import (EventRing, MetricsRegistry,
+                                            flightrec)
+        from apex_tpu.observability import metrics as obs_metrics
+        from apex_tpu.utils import checkpoint_orbax as co
+        _, state = self._sharded_state()
+        nbytes = 8 * 4 * 4 + 4          # w fp32 (8,4) + scalar
+        ring = EventRing(capacity=32)
+        reg = MetricsRegistry()
+        prev_ring = flightrec.set_ring(ring)
+        prev_reg = obs_metrics.set_registry(reg)
+        try:
+            co.save_checkpoint(str(tmp_path), 1, state)
+            (ev,) = ring.snapshot("checkpoint_saved")
+            assert ev["step"] == 1 and ev["bytes"] == nbytes
+            assert ev["async_save"] is False
+            co.save_checkpoint(str(tmp_path), 2, state,
+                               async_save=True)
+            co.wait()
+            evs = ring.snapshot("checkpoint_saved")
+            assert len(evs) == 2
+            assert evs[1]["step"] == 2 and evs[1]["async_save"] is True
+            co.restore_checkpoint(str(tmp_path), state, step=1)
+            assert reg.get("checkpoint_save_seconds").count == 2
+            assert reg.get("checkpoint_restore_seconds").count == 1
+            assert reg.get("checkpoint_saves_total").value == 2
+            assert reg.get("checkpoint_snapshot_bytes").value == nbytes
+        finally:
+            obs_metrics.set_registry(prev_reg)
+            flightrec.set_ring(prev_ring)

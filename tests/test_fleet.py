@@ -884,3 +884,148 @@ def test_traced_fleet_step_workers_threads_keep_span_parentage():
                         if e["name"].startswith("fleet_sub")]
     finally:
         obs.set_recorder(prev)
+
+
+# -- SLO / goodput accounting (PR 10) -------------------------------------
+
+def test_slo_goodput_counts_only_within_deadline_tokens():
+    """Goodput = tokens from requests that finished within their
+    deadline: a pre-expired request's would-be tokens are excluded,
+    attainment reflects the miss, and the deadline-sweep aggregate
+    (count + first rids) surfaces through stats()/record() — not only
+    the flight ring."""
+    t = [0.0]
+    fl = Fleet([_StubReplica(slots=4)], clock=lambda: t[0],
+               step_workers=1, ring=obs.EventRing(capacity=64))
+    ok1 = fl.submit([1, 2], max_new_tokens=3, deadline=100.0)
+    ok2 = fl.submit([1, 2], max_new_tokens=3, deadline=100.0)
+    free = fl.submit([1, 2], max_new_tokens=3)          # no SLO
+    hopeless = fl.submit([1, 2], max_new_tokens=3, deadline=4.0)
+    t[0] = 5.0                         # hopeless expires on first sweep
+    steps = 0
+    while fl.live():
+        fl.step()
+        t[0] += 1.0
+        steps += 1
+        assert steps < 50
+    assert fl.status(hopeless) == "failed"
+    s = fl.stats()
+    # 2 of 3 deadlined requests resolved in time
+    assert s["slo"]["with_deadline"] == 3
+    assert s["slo"]["within_deadline"] == 2
+    assert s["slo"]["slo_attainment"] == pytest.approx(2 / 3)
+    # goodput: the two deadlined finishers + the no-SLO request
+    assert s["slo"]["goodput_tokens"] == 9
+    assert s["tokens_generated"] == 9
+    assert s["goodput_tokens_per_s"] > 0
+    # the sweep aggregate matches the ring event
+    assert s["deadline_exceeded"] == 1
+    assert s["deadline_last_sweep"]["count"] == 1
+    assert s["deadline_last_sweep"]["rids"] == [hopeless]
+    (ev,) = fl.ring.snapshot("deadline_exceeded")
+    assert ev["count"] == 1 and ev["rids"] == [hopeless]
+    # registry metrics mirror the fleet-local numbers
+    assert fl.metrics.get("fleet_goodput_tokens_total").value == 9
+    assert fl.metrics.get("fleet_slo_miss_total").value == 1
+    assert fl.metrics.get("fleet_slo_attainment").value == \
+        pytest.approx(2 / 3)
+    # result() for the winners is unaffected
+    assert fl.result(ok1) == _StubReplica.expected([1, 2], 3)
+    assert fl.result(ok2) == _StubReplica.expected([1, 2], 3)
+    assert fl.result(free) == _StubReplica.expected([1, 2], 3)
+
+
+def test_fleet_record_carries_slo_fields_and_validator_pins_them():
+    t = [0.0]
+    fl = Fleet([_StubReplica(slots=2)], clock=lambda: t[0],
+               step_workers=1, ring=obs.EventRing(capacity=64))
+    fl.submit([1, 2], max_new_tokens=2, deadline=50.0)
+    while fl.live():
+        fl.step()
+        t[0] += 1.0
+    rec = JsonlExporter.enrich(fl.record())
+    assert validate_fleet_record(rec) == []
+    assert rec["goodput_tokens_per_s"] > 0
+    assert rec["slo_attainment"] == 1.0
+    assert rec["tokens_within_slo"] == 2
+    assert rec["deadline_exceeded"] == 0
+    assert rec["deadline_last_sweep"] == {"count": 0, "rids": [],
+                                          "fleet_step": None}
+    # mutations the validator must catch
+    assert validate_fleet_record({**rec, "goodput_tokens_per_s": -1})
+    assert validate_fleet_record({**rec, "slo_attainment": 1.5})
+    assert validate_fleet_record({**rec, "tokens_within_slo": -2})
+    assert validate_fleet_record(
+        {**rec, "tokens_within_slo": rec["tokens"] + 1})
+    assert validate_fleet_record({**rec, "deadline_exceeded": -1})
+    assert validate_fleet_record(
+        {**rec, "deadline_last_sweep": {"count": 0, "rids": [1, 2],
+                                        "fleet_step": None}})
+    assert validate_fleet_record(
+        {**rec, "deadline_last_sweep": "yesterday"})
+    # null attainment (no deadlined request resolved yet) is valid
+    assert validate_fleet_record({**rec, "slo_attainment": None}) == []
+    # archived records WITHOUT the optional fields stay clean
+    stripped = {k: v for k, v in rec.items()
+                if k not in ("goodput_tokens_per_s", "slo_attainment",
+                             "tokens_within_slo", "deadline_exceeded",
+                             "deadline_last_sweep")}
+    assert validate_fleet_record(stripped) == []
+
+
+def test_queue_wait_service_split_matches_trace_spans():
+    """The SLO tracker's queue-wait/service split is fed at the same
+    instants the request's trace spans record — so the split derived
+    from the kind: trace record (fleet.slo.split_from_trace) must
+    agree with the tracker's histograms.  One replica, one slot, two
+    requests: the second genuinely queues behind the first."""
+    from apex_tpu.fleet import slo as fleet_slo
+
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        fl = Fleet([_StubReplica(slots=1)], replica_queue_cap=0,
+                   step_workers=1, ring=obs.EventRing(capacity=64))
+        first = fl.submit([1, 2], max_new_tokens=3)
+        second = fl.submit([1, 2], max_new_tokens=3)
+        _drive(fl)
+        assert fl.result(second) == _StubReplica.expected([1, 2], 3)
+        qw = fl.stats()["slo"]["queue_wait"]
+        sv = fl.stats()["slo"]["service_time"]
+        assert qw["count"] == 2 and sv["count"] == 2
+        for rid in (first, second):
+            split = fleet_slo.split_from_trace(fl.trace_record(rid))
+            assert split is not None
+            assert split["total_s"] == pytest.approx(
+                fl.latency(rid), abs=0.05)
+        # the queued request's span-derived queue wait exceeds the
+        # immediately-dispatched one's (it sat behind a full slot)
+        s1 = fleet_slo.split_from_trace(fl.trace_record(first))
+        s2 = fleet_slo.split_from_trace(fl.trace_record(second))
+        assert s2["queue_wait_s"] > s1["queue_wait_s"]
+        # tracker histogram sum ~ sum of span-derived waits
+        assert qw["sum"] == pytest.approx(
+            s1["queue_wait_s"] + s2["queue_wait_s"], abs=0.1)
+    finally:
+        obs.set_recorder(prev)
+
+
+def test_failed_dispatch_request_counts_as_slo_miss():
+    """A deadlined request that FAILS (rejected at dispatch) is an SLO
+    miss — it delivered nothing within its promise — while a failed
+    no-deadline request is not (no promise existed)."""
+    class _Rejecting(_StubReplica):
+        def submit(self, prompt, *a, **kw):
+            raise ValueError("seeded shape rejection")
+
+    fl = Fleet([_Rejecting()], step_workers=1,
+               ring=obs.EventRing(capacity=64))
+    with_slo = fl.submit([1], max_new_tokens=1, deadline=100.0)
+    without = fl.submit([1], max_new_tokens=1)
+    fl.step()
+    assert fl.status(with_slo) == "failed"
+    assert fl.status(without) == "failed"
+    s = fl.stats()["slo"]
+    assert s["with_deadline"] == 1 and s["within_deadline"] == 0
+    assert s["slo_attainment"] == 0.0
+    assert fl.metrics.get("fleet_slo_miss_total").value == 1

@@ -1443,3 +1443,238 @@ def test_ddp_comm_stats_hierarchical_levels():
         "ddp_allreduce_level_bytes_total").labels(
         level="dcn", dtype="float32").value
     assert after - base == 400
+
+
+# -- Prometheus exposition conformance (PR 10, satellite) ------------------
+
+def test_prometheus_text_escapes_and_roundtrips():
+    """Exposition-format conformance: HELP/TYPE lines, label-value
+    escaping (backslash / quote / newline), the +Inf histogram bucket
+    — and the parser round-trip recovers the registry's exact label
+    values and sample values."""
+    reg = obs.MetricsRegistry()
+    c = reg.counter("esc_total", help="counts with a \\ slash\nnewline")
+    c.labels(path='/v1/"gen"\nx', shard="a\\b").inc(4)
+    g = reg.gauge("esc_gauge")
+    g.set(2.5)
+    h = reg.histogram("esc_seconds", help="latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 9.0):
+        h.observe(v)
+    text = exporters.prometheus_text(reg)
+    # conformance checker: no violations
+    assert exporters.validate_prometheus_text(text) == []
+    # HELP newline is escaped on the wire (single physical line)
+    (help_line,) = [ln for ln in text.splitlines()
+                    if ln.startswith("# HELP esc_total")]
+    assert "\\n" in help_line and "\n" not in help_line[1:]
+    # parser round-trip: the gnarly label values come back EXACTLY
+    fams = exporters.parse_prometheus_text(text)
+    assert fams["esc_total"]["type"] == "counter"
+    (name, labels, value), = fams["esc_total"]["samples"]
+    assert labels == {"path": '/v1/"gen"\nx', "shard": "a\\b"}
+    assert value == 4.0
+    assert fams["esc_total"]["help"].endswith("\\nnewline")
+    # histogram: +Inf bucket present, cumulative counts monotone,
+    # _count == +Inf, _sum == the observed sum
+    hs = {n: (lab, v) for n, lab, v in fams["esc_seconds"]["samples"]}
+    buckets = {lab["le"]: v for n, lab, v
+               in fams["esc_seconds"]["samples"]
+               if n == "esc_seconds_bucket"}
+    assert buckets == {"0.1": 1.0, "1": 2.0, "+Inf": 3.0}
+    assert hs["esc_seconds_count"][1] == 3.0
+    assert hs["esc_seconds_sum"][1] == pytest.approx(9.55)
+
+
+def test_validate_prometheus_text_catches_violations():
+    # missing +Inf bucket
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 2\nh_sum 1.0\nh_count 2\n')
+    assert any("+Inf" in e
+               for e in exporters.validate_prometheus_text(bad))
+    # non-monotone cumulative buckets
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+           "h_sum 1.0\nh_count 3\n")
+    assert any("decrease" in e
+               for e in exporters.validate_prometheus_text(bad))
+    # _count disagreeing with the +Inf bucket
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="+Inf"} 3\nh_sum 1.0\nh_count 4\n')
+    assert any("_count" in e
+               for e in exporters.validate_prometheus_text(bad))
+    # sample with no TYPE declaration
+    assert any("no # TYPE" in e
+               for e in exporters.validate_prometheus_text("x 1.0\n"))
+    # negative counter
+    bad = "# TYPE c counter\nc -1.0\n"
+    assert any("negative" in e
+               for e in exporters.validate_prometheus_text(bad))
+    # unparseable line
+    assert exporters.validate_prometheus_text("{broken 1.0\n")
+
+
+# -- EventRing.dump under concurrent appends (PR 10, satellite) -----------
+
+def test_event_ring_dump_consistent_under_concurrent_appends(tmp_path):
+    """dump() taken WHILE writers hammer the ring must be internally
+    consistent: the header's drop accounting is exact for the snapshot
+    it describes, retained events are a contiguous seq window in order
+    (timestamps non-decreasing with seq — the clock is read under the
+    lock), and no event is torn or duplicated."""
+    ring = obs.EventRing(capacity=64)
+    stop = threading.Event()
+
+    def writer(wid):
+        i = 0
+        while not stop.is_set():
+            ring.append("w", wid=wid, i=i)
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for k in range(20):
+            path = str(tmp_path / f"dump_{k}.jsonl")
+            ring.dump(path)
+            with open(path) as f:
+                lines = [json.loads(ln) for ln in f]
+            header, events = lines[0], lines[1:]
+            assert header["kind"] == "flight_ring"
+            assert header["capacity"] == 64
+            # exact accounting FOR THIS snapshot
+            assert header["dropped"] == header["total"] - len(events)
+            assert len(events) <= 64
+            seqs = [e["seq"] for e in events]
+            # contiguous window ending at total-1, oldest first
+            assert seqs == list(range(header["total"] - len(events),
+                                      header["total"]))
+            # time order can never disagree with seq order
+            ts = [e["t"] for e in events]
+            assert ts == sorted(ts)
+            # no torn event: every record carries its full payload
+            assert all("wid" in e and "i" in e for e in events)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    # quiesced: final dump's total equals appended count exactly
+    final = str(tmp_path / "final.jsonl")
+    ring.dump(final)
+    with open(final) as f:
+        header = json.loads(f.readline())
+    assert header["total"] == ring.total
+    assert header["dropped"] == ring.total - len(ring)
+
+
+# -- kind: run records (PR 10) --------------------------------------------
+
+def test_validate_run_record_edges():
+    def rec(**kw):
+        base = {"kind": "run", "run": "r", "verdict": "ok",
+                "observations": 5, "watermark": 4,
+                "anomaly_counts": {"stall": 0, "nan": 0},
+                "anomalies": [],
+                "loss": {"last": 1.0, "ewma": 1.0},
+                "checkpoints": 0, "duration_s": 1.5}
+        base.update(kw)
+        return exporters.JsonlExporter.enrich(base)
+
+    assert exporters.validate_run_record(rec()) == []
+    # null watermark (nothing observed yet) is legal
+    assert exporters.validate_run_record(rec(watermark=None)) == []
+    # verdict/count consistency both ways
+    assert any("inconsistent" in e for e in exporters.
+               validate_run_record(rec(verdict="attention")))
+    assert any("inconsistent" in e for e in exporters.
+               validate_run_record(rec(anomaly_counts={"nan": 2})))
+    # unknown anomaly kind
+    assert any("unknown kind" in e for e in exporters.
+               validate_run_record(rec(anomaly_counts={"gremlin": 1},
+                                       verdict="attention")))
+    # detail list exceeding its count
+    assert any("can never exceed" in e for e in exporters.
+               validate_run_record(rec(
+                   verdict="attention",
+                   anomaly_counts={"nan": 1},
+                   anomalies=[{"kind": "nan", "observation": 1},
+                              {"kind": "nan", "observation": 2}])))
+    # NaN smuggled into the loss summary
+    assert any("finite" in e for e in exporters.validate_run_record(
+        rec(loss={"last": float("nan")})))
+    # bad verdict / run / observations
+    assert exporters.validate_run_record(rec(verdict="fine"))
+    assert exporters.validate_run_record(rec(run=""))
+    assert exporters.validate_run_record(rec(observations=-1))
+    assert exporters.validate_run_record(rec(duration_s=-2))
+
+
+def test_check_bench_trend_partitions_run_records(tmp_path):
+    """kind: run supervisor verdicts are per-run diagnostics, not a
+    cross-round trend: a later round's anomalous run must not read as
+    a regression, stale replays count toward the partition tally —
+    while the run_supervisor_overhead METRIC lines do trend."""
+    def runrec(n_nan, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"kind": "run", "run": "resnet18_o2_ddp",
+             "verdict": "attention" if n_nan else "ok",
+             "observations": 10, "watermark": 9,
+             "anomaly_counts": {"nan": n_nan}, "anomalies": [],
+             "backend": "cpu", **kw})
+
+    d = tmp_path / "run1"
+    d.mkdir()
+    _trend_round(d, "BENCH_r01.json", [runrec(0)])
+    _trend_round(d, "BENCH_r02.json", [runrec(5),
+                                       runrec(0, stale=True)])
+    r = _run_trend(["--dir", str(d)])
+    assert r.returncode == 0, r.stderr
+    assert "1 stale replays partitioned out" in r.stderr
+
+    # the overhead metric lines DO trend (tpu backend gates)
+    def ov(value, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "run_supervisor_overhead_o2", "value": value,
+             "unit": "ms", "vs_baseline": None, "backend": "tpu",
+             "ndev": 1, "arch": "TPU v5 lite",
+             "step_ms_on": 10.0 + value, "step_ms_off": 10.0, **kw})
+
+    d2 = tmp_path / "run2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [ov(1.0)])
+    _trend_round(d2, "BENCH_r02.json", [ov(2.0)])   # 100% worse (ms)
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 1
+    assert "regressed" in r.stderr
+
+
+def test_v5_requirements_gate_on_declared_version():
+    """Schema v5's run_supervisor_overhead both-sides requirement (and
+    the run-record family itself) gate on the record's DECLARED
+    schema_version — archived v4-and-earlier streams re-validate
+    clean."""
+    line = {"metric": "run_supervisor_overhead_o2", "value": 1.0,
+            "unit": "ms", "vs_baseline": None, "backend": "cpu",
+            "ndev": 8, "arch": "cpu"}
+    # fresh v5 line WITHOUT the on/off pair: error
+    v5 = exporters.JsonlExporter.enrich(dict(line))
+    assert v5["schema_version"] >= 5
+    errs = exporters.validate_bench_record(v5)
+    assert any("step_ms_on" in e for e in errs)
+    # the same line declaring v4 (an archived pre-supervisor stream):
+    # clean — v4 never defined the metric, so no requirement applies
+    v4 = exporters.JsonlExporter.enrich(
+        {**line, "schema_version": 4})
+    assert exporters.validate_bench_record(v4) == []
+    # and the complete v5 line is clean
+    full = exporters.JsonlExporter.enrich(
+        {**line, "step_ms_on": 11.0, "step_ms_off": 10.0})
+    assert exporters.validate_bench_record(full) == []
+    # v4 numerics_overhead contract unchanged by the bump
+    num = exporters.JsonlExporter.enrich(
+        {"metric": "numerics_overhead_o2", "value": 1.0, "unit": "ms",
+         "vs_baseline": None, "backend": "cpu", "ndev": 8,
+         "arch": "cpu", "schema_version": 4})
+    assert any("step_ms_on" in e
+               for e in exporters.validate_bench_record(num))

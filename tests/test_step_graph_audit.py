@@ -140,6 +140,24 @@ def test_numerics_disabled_step_is_byte_identical():
     assert str(off.jaxpr) == str(base.jaxpr)
 
 
+def test_supervised_step_is_byte_identical_both_ways():
+    """The operational-plane contract (PR 10): a run-supervised train
+    step is the UNSUPERVISED step to the byte — the supervisor
+    consumes host-side flush points only, so RunSupervisor.wrap_step
+    must be an identity whether the supervisor is enabled or
+    disabled.  Unlike the numerics monitor there is no planned
+    collective delta: zero host transfers, zero extra eqns, the
+    identical jaxpr string, in BOTH directions."""
+    base = analysis.get("ddp_resnet18_o2").graph()
+    for name in ("ddp_resnet18_o2_supervised",
+                 "ddp_resnet18_o2_supervised_off"):
+        _assert_clean(name, rules=["supervisor", "host-transfer",
+                                   "collective"])
+        g = analysis.get(name).graph()
+        assert str(g.jaxpr) == str(base.jaxpr), name
+        assert analysis.host_transfer_eqns(g.jaxpr) == []
+
+
 # -- collective accounting: the comm pattern is what DDP assumes ----------
 
 def test_ddp_collective_accounting():

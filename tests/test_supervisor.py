@@ -1,0 +1,358 @@
+"""Training-run supervisor: every seeded anomaly detected within a
+bounded observation count, episode-deduped ring events, schema-clean
+``kind: run`` records, the checkpoint-fed progress watermark, and the
+host-side-only contract (the graph side of which is audit-pinned in
+tests/test_step_graph_audit.py).
+
+The supervisor is deterministic over its observation feed, so each
+anomaly scenario seeds exactly one pathology into an otherwise healthy
+signal stream and asserts the detector fires AT the expected
+observation — not just eventually."""
+
+import json
+import math
+
+import pytest
+
+from apex_tpu.observability import (EventRing, MetricsRegistry,
+                                    RunSupervisor, SupervisorConfig,
+                                    exporters)
+from apex_tpu.observability.supervisor import ANOMALY_KINDS
+
+
+def _sup(**kw):
+    kw.setdefault("ring", EventRing(capacity=64))
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("config", SupervisorConfig(stall_observations=4,
+                                             warmup_observations=3))
+    return RunSupervisor("t", **kw)
+
+
+def _healthy(sup, n, start_step=0, loss=1.0, dt=0.01):
+    for i in range(n):
+        assert sup.observe_step(step=start_step + i, loss=loss,
+                                step_time_s=dt) == []
+
+
+# -- the seeded anomalies, each within a bounded count --------------------
+
+def test_stall_detected_within_bound():
+    """A frozen step counter fires the stall after EXACTLY
+    stall_observations observations without progress — and once per
+    episode, with recovery re-arming the detector."""
+    sup = _sup()
+    _healthy(sup, 3)
+    fired_at = None
+    for k in range(1, 10):
+        found = sup.observe_step(step=2, loss=1.0, step_time_s=0.01)
+        if found:
+            assert fired_at is None, "stall must fire once per episode"
+            fired_at = k
+            assert found[0]["kind"] == "stall"
+    assert fired_at == sup.config.stall_observations
+    assert sup.verdict == "attention"
+    ok, detail = sup.health_check()
+    assert not ok and "stalled" in detail
+    # progress ends the episode and restores liveness...
+    assert sup.observe_step(step=3, loss=1.0) == []
+    assert sup.health_check()[0]
+    # ...and a second stall is a fresh episode that fires again
+    for _ in range(sup.config.stall_observations):
+        found = sup.observe_step(step=3, loss=1.0)
+    assert found and found[0]["kind"] == "stall"
+    assert sup._counts["stall"] == 2
+    assert [e["kind"] for e in sup.ring.snapshot(kind="run_stall")] \
+        == ["run_stall", "run_stall"]
+
+
+def test_loss_spike_detected_and_episode_deduped():
+    sup = _sup()
+    _healthy(sup, 6, loss=1.0)
+    found = sup.observe_step(step=6, loss=10.0, step_time_s=0.01)
+    assert [a["kind"] for a in found] == ["loss_spike"]
+    assert found[0]["factor"] > sup.config.loss_spike_factor
+    # still spiked: same episode, no refire; the EWMA must NOT have
+    # chased the spike
+    assert sup.observe_step(step=7, loss=10.0) == []
+    assert sup.status()["loss"]["ewma"] == pytest.approx(1.0)
+    # recovery closes the episode; a later spike is a new anomaly
+    assert sup.observe_step(step=8, loss=1.0) == []
+    found = sup.observe_step(step=9, loss=8.0)
+    assert [a["kind"] for a in found] == ["loss_spike"]
+    assert sup._counts["loss_spike"] == 2
+
+
+def test_seeded_nan_loss_detected_immediately():
+    sup = _sup()
+    _healthy(sup, 2)
+    found = sup.observe_step(step=2, loss=float("nan"))
+    assert [a["kind"] for a in found] == ["nan"]
+    ok, detail = sup.health_check()
+    assert not ok and "nan" in detail
+    evs = sup.ring.snapshot(kind="run_nan")
+    assert len(evs) == 1 and evs[0]["run"] == "t"
+    # a loss that STAYS nonfinite is one episode: no refire, no ring
+    # flood (the shed-episode rule) — but liveness stays unhealthy
+    assert sup.observe_step(step=3, loss=float("inf")) == []
+    assert len(sup.ring.snapshot(kind="run_nan")) == 1
+    assert not sup.health_check()[0]
+    # recovery restores liveness (a past anomaly degrades the verdict,
+    # never the probe — an orchestrator must not kill a healed run)
+    assert sup.observe_step(step=4, loss=1.0) == []
+    assert sup.health_check()[0]
+    assert sup.verdict == "attention"
+    # a SECOND nonfinite excursion is a fresh episode and fires again
+    found = sup.observe_step(step=5, loss=float("inf"))
+    assert [a["kind"] for a in found] == ["nan"]
+    assert sup._counts["nan"] == 2
+
+
+def test_seeded_nan_via_numerics_flush_names_culprit():
+    """The numerics-side NaN path: a flushed NumericsMonitor summary
+    with new overflow steps raises a nan anomaly carrying the culprit
+    layer — the same attribution the flight ring's scaler_skip event
+    names (PR 9), now surfaced as a run verdict."""
+    sup = _sup()
+    _healthy(sup, 2)
+    flushed = {"overflow_steps": 1, "culprit": "layer1/conv/kernel",
+               "culprit_nonfinite": 7, "loss_scale": 32768.0}
+    found = sup.observe_step(step=2, loss=1.0, numerics=flushed)
+    assert [a["kind"] for a in found] == ["nan"]
+    assert found[0]["culprit"] == "layer1/conv/kernel"
+    assert found[0]["culprit_nonfinite"] == 7
+    # the SAME cumulative total does not re-fire (flush-delta dedup)
+    assert sup.observe_step(step=3, loss=1.0, numerics=flushed) == []
+    # a new overflow does
+    flushed2 = dict(flushed, overflow_steps=2)
+    assert [a["kind"] for a in
+            sup.observe_step(step=4, loss=1.0, numerics=flushed2)] \
+        == ["nan"]
+
+
+def test_throughput_regression_detected():
+    sup = _sup()
+    _healthy(sup, 6, dt=0.010)
+    found = sup.observe_step(step=6, loss=1.0, step_time_s=0.05)
+    assert [a["kind"] for a in found] == ["throughput_regression"]
+    assert found[0]["factor"] > sup.config.throughput_regression_factor
+    # sustained slowness: one episode
+    assert sup.observe_step(step=7, loss=1.0, step_time_s=0.05) == []
+    # the EWMA did not absorb the regressed samples
+    assert sup.status()["step_time_s"]["ewma"] == pytest.approx(0.010)
+
+
+def test_one_replica_divergence_detected():
+    """A flushed divergence digest whose desync counter advanced is
+    the one-replica-drifted signal; the anomaly names the worst
+    leaf."""
+    sup = _sup()
+    _healthy(sup, 3)
+    insync = {"divergence": {"max_rel_dev": 1e-9, "desync_steps": 0,
+                             "in_sync": True, "worst_leaf": None}}
+    assert sup.observe_step(step=3, loss=1.0, numerics=insync) == []
+    div = {"divergence": {"max_rel_dev": 0.3, "desync_steps": 2,
+                          "in_sync": False,
+                          "worst_leaf": "blocks/0/w"}}
+    found = sup.observe_step(step=4, loss=1.0, numerics=div)
+    assert [a["kind"] for a in found] == ["replica_divergence"]
+    assert found[0]["worst_leaf"] == "blocks/0/w"
+    assert found[0]["max_rel_dev"] == pytest.approx(0.3)
+    # same cumulative desync count: no refire
+    assert sup.observe_step(step=5, loss=1.0, numerics=div) == []
+    evs = sup.ring.snapshot(kind="run_replica_divergence")
+    assert len(evs) == 1
+
+
+# -- progress watermark consumes checkpoint_saved -------------------------
+
+def test_checkpoint_event_advances_watermark():
+    """A run writing checkpoints is making durable progress: the
+    checkpoint_saved flight event (utils/checkpoint emits it) holds
+    the stall watchdog off even when the caller has no step counter
+    to report."""
+    ring = EventRing(capacity=64)
+    sup = _sup(ring=ring)
+    stall_n = sup.config.stall_observations
+    for i in range(3 * stall_n):
+        if i % 2 == 0:
+            ring.append("checkpoint_saved", step=i, bytes=128,
+                        path="/tmp/x", async_save=False)
+        assert sup.observe_step(loss=1.0) == []   # no step= at all
+    assert sup.status()["checkpoint"]["count"] == 3 * stall_n // 2
+    # checkpoints stop -> the stall fires within the bound (the last
+    # consumed checkpoint re-anchored the watermark one observation
+    # after its append, hence the +1)
+    fired = []
+    for _ in range(stall_n + 1):
+        fired += sup.observe_step(loss=1.0)
+    assert [a["kind"] for a in fired] == ["stall"]
+
+
+def test_real_npz_checkpoint_feeds_watermark(tmp_path):
+    """End to end through utils/checkpoint: save_checkpoint emits the
+    checkpoint_saved event onto the ring the supervisor consumes, and
+    the save/restore telemetry lands in the registry."""
+    import numpy as np
+    from apex_tpu.observability import flightrec
+    from apex_tpu.utils import checkpoint as ckpt
+
+    ring = EventRing(capacity=64)
+    reg = MetricsRegistry()
+    prev_ring = flightrec.set_ring(ring)
+    try:
+        from apex_tpu.observability import metrics as obs_metrics
+        prev_reg = obs_metrics.set_registry(reg)
+        try:
+            sup = _sup(ring=ring, registry=reg)
+            tree = {"w": np.ones((4, 4), np.float32)}
+            ckpt.save_checkpoint(str(tmp_path), 7, tree)
+            assert sup.observe_step(loss=1.0) == []
+            assert sup.status()["checkpoint"] == {"count": 1,
+                                                  "last_step": 7}
+            ckpt.restore_checkpoint(str(tmp_path), tree)
+        finally:
+            obs_metrics.set_registry(prev_reg)
+    finally:
+        flightrec.set_ring(prev_ring)
+    evs = ring.snapshot(kind="checkpoint_saved")
+    assert len(evs) == 1 and evs[0]["step"] == 7
+    assert evs[0]["bytes"] == 64
+    assert reg.get("checkpoint_save_seconds").count == 1
+    assert reg.get("checkpoint_restore_seconds").count == 1
+    assert reg.get("checkpoint_snapshot_bytes").value == 64.0
+    assert reg.get("checkpoint_saves_total").value == 1
+
+
+# -- records / reports / contract ----------------------------------------
+
+def test_run_record_validates_and_reflects_anomalies():
+    sup = _sup()
+    _healthy(sup, 6)
+    sup.observe_step(step=6, loss=50.0)            # spike
+    sup.observe_step(step=7, loss=float("nan"))    # nan
+    rec = exporters.JsonlExporter.enrich(
+        sup.record(metric="unit_run"))
+    assert exporters.validate_run_record(rec) == []
+    assert exporters.validate_telemetry_record(rec) == []
+    assert rec["verdict"] == "attention"
+    assert rec["anomaly_counts"]["loss_spike"] == 1
+    assert rec["anomaly_counts"]["nan"] == 1
+    assert {a["kind"] for a in rec["anomalies"]} == {"loss_spike",
+                                                     "nan"}
+    # every anomaly detail names a known kind and its observation
+    for a in rec["anomalies"]:
+        assert a["kind"] in ANOMALY_KINDS
+        assert a["observation"] >= 1
+
+
+def test_healthy_run_record_is_ok():
+    sup = _sup()
+    _healthy(sup, 10)
+    rec = exporters.JsonlExporter.enrich(sup.record())
+    assert exporters.validate_run_record(rec) == []
+    assert rec["verdict"] == "ok"
+    assert rec["watermark"] == 9
+    assert sum(rec["anomaly_counts"].values()) == 0
+
+
+def test_write_report_artifact(tmp_path):
+    sup = _sup()
+    _healthy(sup, 3)
+    sup.observe_step(step=3, loss=float("nan"))
+    path = sup.write_report(str(tmp_path / "run_report.json"))
+    with open(path) as f:
+        rep = json.load(f)
+    assert rep["record"]["verdict"] == "attention"
+    assert rep["status"]["anomaly_counts"]["nan"] == 1
+    # the persisted record still validates once enriched
+    rec = exporters.JsonlExporter.enrich(rep["record"])
+    assert exporters.validate_run_record(rec) == []
+
+
+def test_disabled_supervisor_is_inert():
+    sup = _sup(enabled=False)
+    assert sup.observe_step(step=0, loss=float("nan")) == []
+    assert sup.verdict == "ok"
+    assert sup.ring.snapshot(kind="run_nan") == []
+    step = object()
+    assert sup.wrap_step(step) is step
+
+
+def test_wrap_step_is_identity_when_enabled():
+    """The graph-side contract (the audit pins the jaxpr identity;
+    this pins the object identity the audit relies on)."""
+    sup = _sup(enabled=True)
+    step = object()
+    assert sup.wrap_step(step) is step
+
+
+def test_registry_and_scaler_tap():
+    reg = MetricsRegistry()
+    sup = _sup(registry=reg)
+    _healthy(sup, 3)
+    sup.observe_step(step=5, loss=2.0, step_time_s=0.02,
+                     comm_stats=[{"wire_bytes": 1024},
+                                 {"wire_bytes": 512}])
+    sup.observe_scaler({"loss_scale": 4096.0, "steps_skipped": 2,
+                        "num_losses": 1, "per_loss": []})
+    st = sup.status()
+    assert st["comm"] == {"buckets": 2, "wire_bytes": 1536}
+    assert st["scaler"]["loss_scale"] == 4096.0
+    assert reg.get("run_progress_watermark") is not None
+    anom = reg.get("run_anomalies_total")
+    assert anom is None or anom.value == 0   # no anomaly fired yet
+
+
+def test_amp_record_scaler_supervisor_kwarg():
+    """amp.record_scaler(supervisor=) is the amp-side tap: the scaler
+    snapshot reaches the supervisor's status page."""
+    import jax
+    from apex_tpu import amp, nn, optimizers
+
+    model, opt = amp.initialize(nn.Linear(4, 2),
+                                optimizers.FusedAdam(1e-3),
+                                opt_level="O2", verbosity=0)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ost = opt.init(params)
+    sup = _sup()
+    stats = amp.record_scaler(ost, registry=MetricsRegistry(),
+                              supervisor=sup)
+    assert sup.status()["scaler"]["loss_scale"] == stats["loss_scale"]
+
+
+def test_supervisor_config_validation():
+    with pytest.raises(ValueError):
+        SupervisorConfig(stall_observations=0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(loss_spike_factor=1.0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(loss_alpha=0.0)
+    with pytest.raises(ValueError):
+        RunSupervisor("")
+
+
+def test_anomaly_detail_list_is_bounded_counts_exact():
+    cfg = SupervisorConfig(stall_observations=2,
+                           warmup_observations=1, max_anomalies=3)
+    sup = _sup(config=cfg)
+    # 8 distinct nan EPISODES (each closed by a finite recovery) —
+    # consecutive nonfinite observations inside one episode would
+    # count once by design
+    for i in range(8):
+        sup.observe_step(step=2 * i, loss=float("nan"))
+        sup.observe_step(step=2 * i + 1, loss=1.0)
+    assert sup._counts["nan"] == 8
+    rec = sup.record()
+    assert len(rec["anomalies"]) == 3           # bounded details
+    assert rec["anomaly_counts"]["nan"] == 8    # exact counts
+    assert exporters.validate_run_record(
+        exporters.JsonlExporter.enrich(rec)) == []
+
+
+def test_nonfinite_ewma_guard():
+    """A nonfinite step time must not poison the EWMA (NaN would make
+    every later comparison silently false)."""
+    sup = _sup()
+    _healthy(sup, 4)
+    sup.observe_step(step=4, loss=1.0, step_time_s=float("nan"))
+    assert math.isfinite(sup.status()["step_time_s"]["ewma"])
