@@ -184,7 +184,8 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
                       input_format="NCHW", stem="conv7",
                       telemetry=False, B=8, image=32,
                       comm_topology="flat", compress=False,
-                      ici_size=None, numerics=None, supervised=None):
+                      ici_size=None, numerics=None, supervised=None,
+                      world=None):
     """Trace the REAL DDP train step — shard_map over the 8-device CPU
     mesh with the grad allreduce inside — the same graph bench.py's
     headline and examples/imagenet execute.  ``telemetry=True`` threads
@@ -200,7 +201,12 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
     ``RunSupervisor.wrap_step`` with an enabled/disabled supervisor —
     which must be an IDENTITY both ways: the supervisor consumes
     host-side flush points only, and the supervisor rule pins the
-    wrapped step's jaxpr byte-identical to the baseline's."""
+    wrapped step's jaxpr byte-identical to the baseline's.
+    ``world=N`` traces over a SUB-mesh of the first N ambient devices
+    — the post-recovery shrunk-world step (fleet.recovery): the
+    collective expectations are re-derived from ``allreduce_comm_plan``
+    at that world, which is exactly the contract the elastic trainer's
+    re-jit relies on."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
@@ -225,13 +231,25 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
     dm = observability.DeviceMetrics(
         counters=("steps", "overflows"),
         gauges=("loss_scale", "grad_norm")) if telemetry else None
+    ndev = world if world is not None else len(jax.devices())
+    if world is not None:
+        _require_devices(world)
+    if ici_size is not None and (ndev < ici_size
+                                 or ndev % ici_size):
+        # bare RuntimeError = the device-count skip gate (run_lint's
+        # skip_runtime_errors): a 1-device smoke host cannot trace a
+        # 2-level mesh, and the old ValueError from the group builder
+        # crashed bench --graph-lint instead of skipping the EP
+        raise RuntimeError(
+            f"this entry point needs an axis of a multiple of "
+            f"ici_size={ici_size} devices; ambient mesh has {ndev}")
     nm = None
     digest_plan = []
     if numerics is not None:
         grad_plan = parallel.allreduce_comm_plan(
             params, comm_topology=comm_topology,
             allreduce_compress_bf16=compress, ici_size=ici_size,
-            world=len(jax.devices()), nproc=1)
+            world=ndev, nproc=1)
         digest_plan = obs_numerics.digest_comm_plan(params)
         nm = obs_numerics.NumericsMonitor(
             params, half_dtype="bfloat16",
@@ -284,7 +302,8 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
                            comm_topology=comm_topology,
                            compress=compress, ici_size=ici_size,
                            extra_plan=digest_plan if (
-                               numerics == "on") else None)
+                               numerics == "on") else None,
+                           world=ndev)
     if numerics is not None:
         ep.expect.setdefault("numerics", {
             "baseline": "ddp_resnet18_o2",
@@ -307,7 +326,7 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
     state = (params, bn, ost) \
         + ((dm.init(),) if telemetry else ()) \
         + ((nm.init(),) if nm is not None else ())
-    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"), P("data"))),
                            out_specs=(P(), P()), check_vma=False)
@@ -322,7 +341,7 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
 
 def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
                            compress=False, ici_size=None,
-                           extra_plan=None):
+                           extra_plan=None, world=None):
     """Derive the amp + collective expectations for a DDP train step.
 
     Comm accounting: the step's collective population is exactly the
@@ -349,7 +368,8 @@ def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
     plan = parallel.allreduce_comm_plan(
         params, comm_topology=comm_topology,
         allreduce_compress_bf16=compress, ici_size=ici_size,
-        world=len(jax.devices()), nproc=1)
+        world=world if world is not None else len(jax.devices()),
+        nproc=1)
     # ``extra_plan``: additional planned collectives beyond the grad
     # reduction — the numerics divergence digest's one psum
     # (numerics.digest_comm_plan) folds in here so the collective
@@ -461,6 +481,25 @@ register_entry_point(
                 "bf16-compressed DCN hop")(
     lambda ep: _ddp_resnet_graph(ep, "O2", comm_topology="hierarchical",
                                  ici_size=4, compress=True))
+
+# elastic recovery (PR 11): the POST-SHRINK step.  When a replica dies
+# mid-run, fleet.recovery.ElasticTrainer re-jits the train step on the
+# surviving world (here 8 → 4, ici_size 4 → 2: losing a host halves
+# the slice, the same placement at half the fabric) — this entry point
+# pins that the shrunk step lints clean with collective expectations
+# RE-DERIVED from allreduce_comm_plan at the new world size: per-
+# bucket reduce_scatter/psum/all_gather counts and per-level payloads
+# all recomputed, the axis-size psum and the loss pmean still exactly
+# two fp32 scalars.  predivide_factors needs no pinning beyond this:
+# it divides by the mapped axis size, which IS the new world.
+register_entry_point(
+    "ddp_resnet18_o2_hier_world4", tags=("training", "ddp", "amp",
+                                         "hier", "recovery"),
+    description="DDP resnet18 O2 step re-jitted on the shrunk 4-device "
+                "world (ici_size=2) — the post-recovery step, "
+                "plan-derived expectations at world 4")(
+    lambda ep: _ddp_resnet_graph(ep, "O2", comm_topology="hierarchical",
+                                 ici_size=2, world=4))
 
 register_entry_point(
     "ddp_resnet18_o2_nhwc_s2d", tags=("training", "ddp", "amp", "layout"),

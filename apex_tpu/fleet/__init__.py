@@ -22,7 +22,18 @@ sibling of ``apex.parallel.DistributedDataParallel``'s replica model:
   deadline-attainment, the queue-wait vs service split (fed at the
   same instants the distributed-trace spans record), and
   ``goodput_tokens_per_s`` — tokens delivered *within* SLO — on
-  ``Fleet.stats()``/``record()``.
+  ``Fleet.stats()``/``record()``;
+- recovery (recovery.py, PR 11): the telemetry→action loop, training
+  side — :class:`ElasticTrainer` shrinks the data axis on a replica
+  death, redistributes ZeRO-1 shards (:func:`reshard_flat_state`),
+  resumes from the last checksum-durable snapshot, and accounts MTTR
+  in ``kind: recovery`` records; :class:`RecoveryLog` is the shared
+  episode/action bookkeeping;
+- autoscale (autoscale.py, PR 11): the loop's serving side —
+  :class:`SloController` reads the SLO tracker's per-tick deltas and
+  actuates the admission bound, decode windows, drain/undrain and the
+  breaker's cooldowns with hysteresis and bounded actuation
+  (``tests/ci/chaos_smoke.py`` gates the no-oscillation contract).
 
 Attach the live introspection server with one call
 (``apex_tpu.observability.server.serve(fleet=fleet)``): ``/statusz``
@@ -35,13 +46,20 @@ from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                      STATE_CODES, Ewma, HealthConfig, ReplicaHealth)
 from .router import (FleetOverloaded, LeastLoaded, PrefixAffinity,
                      RetryPolicy, RoundRobin, make_policy)
-from .faults import FaultyReplica, ReplicaFault
+from .faults import FaultyReplica, ReplicaFault, TrainingFaults
 from .slo import SloTracker, split_from_trace
+from .recovery import (RECOVERY_ACTION_KINDS, RECOVERY_ROLES,
+                       ElasticConfig, ElasticTrainer, RecoveryError,
+                       RecoveryLog, reshard_flat_state)
+from .autoscale import AutoscaleConfig, SloController
 from . import slo
 
 __all__ = ["Fleet", "FleetOverloaded", "RetryPolicy", "RoundRobin",
            "LeastLoaded", "PrefixAffinity", "make_policy",
            "HealthConfig", "ReplicaHealth", "Ewma", "HEALTHY",
            "DEGRADED", "DEAD", "DRAINING", "DRAINED", "STATE_CODES",
-           "FaultyReplica", "ReplicaFault", "SloTracker",
-           "split_from_trace", "slo"]
+           "FaultyReplica", "ReplicaFault", "TrainingFaults",
+           "SloTracker", "split_from_trace", "slo",
+           "RECOVERY_ROLES", "RECOVERY_ACTION_KINDS", "RecoveryError",
+           "RecoveryLog", "ElasticConfig", "ElasticTrainer",
+           "reshard_flat_state", "AutoscaleConfig", "SloController"]

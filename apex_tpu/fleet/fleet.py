@@ -204,6 +204,22 @@ class Fleet:
         self._n_failovers = 0
         self._n_drains = 0
         self._n_deadline = 0
+        # MTTR accounting (PR 11): a failover opens a recovery window;
+        # the first subsequent tick with real progress (tokens emitted
+        # or a finish harvested) closes it — fault injection to first
+        # post-recovery step, the fleet-side number bench --chaos
+        # trends.  ``recovery_in_flight`` is the controllers' flag
+        # (SloController / an operator mid-world-shrink): while set,
+        # the introspection server's no-steppable-replica check
+        # reports the distinct degraded-but-live "recovering" state
+        # instead of 503ing an orchestrator into a restart loop.
+        self._recover_t0: Optional[float] = None
+        self._recovering_rids: set = set()
+        self._recovered_tick = False    # reclaimed work progressed now
+        self._mttr_last: Optional[float] = None
+        self._mttr_sum = 0.0
+        self._mttr_count = 0
+        self.recovery_in_flight = False
         # the most recent deadline sweep's aggregate (count + first
         # rids), previously visible only on the flight ring — exposed
         # through stats()/record() so a dashboard need not tail the
@@ -346,6 +362,7 @@ class Fleet:
         surface."""
         self._step_no += 1
         self._tick_retry_logged.clear()
+        self._recovered_tick = False
         for h in self.health:
             h.tick()
         self._check_deadlines()
@@ -437,6 +454,31 @@ class Fleet:
         for i, h in enumerate(self.health):
             if h.draining and not any(k[0] == i for k in self._inflight):
                 h.finish_drain()
+        if self._recover_t0 is not None:
+            # close the MTTR window at the first tick where reclaimed
+            # work makes progress again — a restarted request emits or
+            # finishes on a survivor (_finish sets the tick flag
+            # before dropping the rid from the watch set).  Windows
+            # with nothing left to rescue were already abandoned
+            # without an MTTR sample (see _abandon_recovery), so they
+            # can never span unrelated idle time.
+            recovered = (self._recovered_tick
+                         or bool(self._recovering_rids & set(out)))
+            if recovered:
+                mttr = self._clock() - self._recover_t0
+                self._recover_t0 = None
+                self._recovering_rids.clear()
+                self._mttr_last = mttr
+                self._mttr_sum += mttr
+                self._mttr_count += 1
+                self.ring.append("recovery_done",
+                                 mttr_s=round(mttr, 6),
+                                 fleet_step=self._step_no)
+                self.metrics.histogram(
+                    "fleet_mttr_seconds",
+                    help="failover to first post-recovery progress of "
+                         "reclaimed work"
+                ).observe(mttr)
         self._update_gauges()
         return out
 
@@ -546,6 +588,10 @@ class Fleet:
             # keeps only the first)
             self.slo.on_dispatch(req.rid, self._clock())
             cands = self._candidates()       # replica i consumed capacity
+        # a reclaimed request can exhaust its budget inside this sweep
+        # (rejection or repeated dispatch failure): if that emptied
+        # the MTTR watch set, close the window sample-free
+        self._abandon_recovery()
 
     # -- failure handling --------------------------------------------------
     def _replica_failed(self, i: int, reason: str):
@@ -561,6 +607,13 @@ class Fleet:
         rep = self.replicas[i]
         keys = sorted((k for k in self._inflight if k[0] == i),
                       key=lambda k: self._inflight[k].rid)
+        if self._recover_t0 is None:
+            # MTTR opens at the FIRST failure of the episode; a second
+            # replica dying mid-recovery extends the same window.  It
+            # closes at the first post-recovery progress OF RECLAIMED
+            # WORK (the rids collected below) — a survivor's unrelated
+            # token does not mean the failed-over requests recovered.
+            self._recover_t0 = self._clock()
         self.ring.append("failover", replica=i, reason=reason,
                          reclaimed=len(keys), fleet_step=self._step_no)
         moved = []
@@ -591,6 +644,7 @@ class Fleet:
                                restarts=req.restarts,
                                attempts=req.attempts)
                 moved.append(req)
+                self._recovering_rids.add(req.rid)
         # leftovers in the replica's own waiting queue (queued-on-
         # replica dispatches) came back via the keys above; anything
         # else there was submitted behind the fleet's back — drop it
@@ -602,6 +656,10 @@ class Fleet:
         # restarted requests go to the FRONT in submission order: they
         # were admitted before anything still pending
         self._pending[:0] = moved
+        # a failover that reclaimed nothing rescuable (idle replica,
+        # or every request's budget already spent) closes its MTTR
+        # window right here, sample-free
+        self._abandon_recovery()
         if self.flight_dump_path:
             # post-mortem artifact the moment something broke — not at
             # process exit, which a wedged replica may never reach
@@ -610,7 +668,28 @@ class Fleet:
             except OSError:
                 pass
 
+    def _abandon_recovery(self):
+        """Nothing left to rescue (the dead replica held no fleet
+        work, or every reclaimed request resolved as a failure): close
+        the MTTR window WITHOUT a sample — letting it wait for
+        unrelated future progress would report idle time as recovery
+        time and absorb the next real failover into a stale window.
+        Called only at the END of a reclaim/deadline/dispatch sweep,
+        never mid-loop: a budget-exhausted request failed early in
+        ``_replica_failed``'s loop must not abandon the window the
+        requests still being reclaimed behind it are about to join."""
+        if self._recover_t0 is not None and not self._recovering_rids \
+                and not self._recovered_tick:
+            self._recover_t0 = None
+            self.ring.append("recovery_abandoned",
+                             fleet_step=self._step_no)
+
     def _fail(self, req: _FleetRequest, msg: str):
+        # a reclaimed request that dies (budget/deadline) is resolved,
+        # not recovered — drop it from the MTTR watch set (the sweep
+        # that called us decides afterwards whether the window is now
+        # empty and must be abandoned)
+        self._recovering_rids.discard(req.rid)
         req.error = msg
         req.t_finish = self._clock()
         self._results[req.rid] = req
@@ -620,6 +699,13 @@ class Fleet:
         self._trace_ev(req, "fleet_failed", error=msg)
 
     def _finish(self, req: _FleetRequest, tokens: List[int]):
+        if self._recover_t0 is not None \
+                and req.rid in self._recovering_rids:
+            # a reclaimed request FINISHING is the strongest form of
+            # post-recovery progress; flag it before dropping the rid
+            # so the end-of-tick close still sees it
+            self._recovered_tick = True
+        self._recovering_rids.discard(req.rid)
         req.generated = [int(t) for t in tokens]
         req.t_finish = self._clock()
         self._results[req.rid] = req
@@ -664,6 +750,8 @@ class Fleet:
             self.ring.append("deadline_exceeded", **sweep)
         for req in expired:
             self._deadline_fail(req)
+        if expired:
+            self._abandon_recovery()
 
     def _deadline_fail(self, req: _FleetRequest):
         self._n_deadline += 1
@@ -775,6 +863,42 @@ class Fleet:
         """Requests still owed an outcome (queued + in-flight)."""
         return len(self._pending) + len(self._inflight)
 
+    def queue_depth(self) -> int:
+        """Fleet-queue depth — the cheap accessor the SLO controller
+        reads every control tick (``stats()`` builds histogram
+        summaries; this is one ``len``)."""
+        return len(self._pending)
+
+    def inflight(self) -> int:
+        """In-flight request count (cheap, controller-facing)."""
+        return len(self._inflight)
+
+    def mttr(self) -> Dict[str, Any]:
+        """Fleet MTTR aggregate: failover → first post-recovery
+        progress, ``{last, mean, count}`` seconds (``None`` until a
+        recovery completed)."""
+        return {"last": self._mttr_last,
+                "mean": (self._mttr_sum / self._mttr_count
+                         if self._mttr_count else None),
+                "count": self._mttr_count}
+
+    def begin_recovery(self, reason: str = ""):
+        """Mark an INTENTIONAL recovery in flight (controller world
+        shrink, operator intervention): while set, the introspection
+        server's no-steppable-replica check reports degraded-but-live
+        ``recovering`` instead of 503 — an orchestrator probe must not
+        restart-loop a fleet that is being handled."""
+        if not self.recovery_in_flight:
+            self.recovery_in_flight = True
+            self.ring.append("fleet_recovery_begin", reason=reason,
+                             fleet_step=self._step_no)
+
+    def end_recovery(self):
+        if self.recovery_in_flight:
+            self.recovery_in_flight = False
+            self.ring.append("fleet_recovery_end",
+                             fleet_step=self._step_no)
+
     def states(self) -> List[str]:
         return [h.state for h in self.health]
 
@@ -828,6 +952,8 @@ class Fleet:
                 "drains": self._n_drains,
                 "deadline_exceeded": self._n_deadline,
                 "deadline_last_sweep": dict(self._last_deadline_sweep),
+                "mttr": self.mttr(),
+                "recovery_in_flight": self.recovery_in_flight,
                 "slo": slo,
                 "goodput_tokens_per_s": slo["goodput_tokens_per_s"],
                 "states": states,
@@ -863,4 +989,5 @@ class Fleet:
                 "deadline_last_sweep": s["deadline_last_sweep"],
                 "goodput_tokens_per_s": s["goodput_tokens_per_s"],
                 "slo_attainment": s["slo"]["slo_attainment"],
-                "tokens_within_slo": s["slo"]["goodput_tokens"]}
+                "tokens_within_slo": s["slo"]["goodput_tokens"],
+                "mttr": s["mttr"]}

@@ -186,6 +186,26 @@ class ReplicaHealth:
                    consecutive_errors=self.consecutive_errors,
                    error_rate=round(self.error_rate.value, 4))
 
+    def set_cooldown(self, steps: int,
+                     remaining: Optional[int] = None):
+        """Actuator surface (PR 11, ``fleet.autoscale``): retune the
+        breaker's step-counted cooldowns.  ``steps`` seeds the NEXT
+        cooldown (capped at ``max_cooldown_steps``); ``remaining``,
+        when the circuit is currently open, rewrites the steps left
+        before the half-open probe — shortening it re-probes a broken
+        replica sooner when the fleet is starved for capacity,
+        lengthening it stops wasting probes on a replica that keeps
+        failing them."""
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        self._cooldown = min(int(steps),
+                             self.config.max_cooldown_steps)
+        if remaining is not None and self.circuit == "open":
+            self._cooldown_left = max(1, int(remaining))
+        self._note("cooldown_set", cooldown_steps=self._cooldown,
+                   remaining=(self._cooldown_left
+                              if self.circuit == "open" else None))
+
     def tick(self):
         """Advance one fleet step of breaker time."""
         if self.circuit == "open":
@@ -232,6 +252,13 @@ class ReplicaHealth:
                 "next_cooldown_steps": self._cooldown,
                 "draining": self.draining,
                 "drained": self.drained}
+
+    @property
+    def cooldown_left(self) -> int:
+        """Steps left before the half-open probe (0 unless open) —
+        the public face of the breaker's clock for the autoscale
+        controller and ``snapshot()``."""
+        return self._cooldown_left if self.circuit == "open" else 0
 
     @property
     def state(self) -> str:

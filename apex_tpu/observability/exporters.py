@@ -33,8 +33,8 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "validate_bench_jsonl", "validate_lint_record",
            "validate_fleet_record", "validate_trace_record",
            "validate_memory_record", "validate_numerics_record",
-           "validate_run_record", "validate_telemetry_record",
-           "validate_telemetry_jsonl"]
+           "validate_run_record", "validate_recovery_record",
+           "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
 # <-> request-trace join key) and ``kind: trace`` records exist.
@@ -55,9 +55,18 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # (``goodput_tokens_per_s`` / ``slo_attainment`` /
 # ``tokens_within_slo`` / ``deadline_exceeded`` /
 # ``deadline_last_sweep``), validated whenever present at any version.
+# v6: ``kind: recovery`` records exist (telemetry→action controller
+# snapshots from ``fleet.recovery.RecoveryLog.record`` — the elastic
+# training controller and the serving SLO-feedback controller — via
+# ``bench.py --chaos`` / ``tests/ci/chaos_smoke.py``); fresh
+# ``chaos_mttr*`` bench lines must carry ``mttr_s`` and fresh
+# ``chaos_spike*`` lines must carry ``slo_attainment`` +
+# ``goodput_tokens_per_s`` (a controller-vs-baseline claim is
+# meaningless without the SLO side of it); ``kind: fleet`` records MAY
+# carry the ``mttr`` aggregate, validated whenever present.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1/v2/v3/v4 streams stay valid.
-SCHEMA_VERSION = 5
+# version, so archived v1..v5 streams stay valid.
+SCHEMA_VERSION = 6
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -547,6 +556,32 @@ def validate_bench_record(rec: Any) -> List[str]:
                     f"value/step_ms_off ({expect:.4g}/{off})")
         if "opt_level" in rec and not isinstance(rec["opt_level"], str):
             errs.append("'opt_level' must be a string when present")
+    # chaos lines (bench.py --chaos, schema v6): the MTTR line must
+    # carry the measurement it claims, and the spike lines must carry
+    # the SLO side of the controller-vs-baseline comparison
+    v6 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 6)
+    if (v6 and isinstance(metric, str)
+            and "error" not in rec and not rec.get("stale")):
+        if metric.startswith("chaos_mttr"):
+            v = _need(rec, errs, "mttr_s", numbers.Number)
+            if (isinstance(v, numbers.Number)
+                    and not isinstance(v, bool) and not (v >= 0)):
+                errs.append(f"'mttr_s' must be >= 0, got {v!r}")
+        if metric.startswith("chaos_spike"):
+            att = _need(rec, errs, "slo_attainment", numbers.Number,
+                        allow_none=True)
+            if (isinstance(att, numbers.Number)
+                    and not isinstance(att, bool)
+                    and not (0.0 <= att <= 1.0)):
+                errs.append(f"'slo_attainment' must be null or in "
+                            f"[0, 1], got {att!r}")
+            gp = _need(rec, errs, "goodput_tokens_per_s",
+                       numbers.Number)
+            if (isinstance(gp, numbers.Number)
+                    and not isinstance(gp, bool) and not (gp >= 0)):
+                errs.append(f"'goodput_tokens_per_s' must be >= 0, "
+                            f"got {gp!r}")
     # step-time attribution fields (bench.py --comm, PR 6): a record
     # carrying ``overlap_fraction`` decomposes a train step into
     # compute vs comm time per fabric level and must be internally
@@ -743,6 +778,27 @@ def validate_fleet_record(rec: Any) -> List[str]:
                 or not (0.0 <= v <= 1.0)):
             errs.append(f"'slo_attainment' must be null or in [0, 1], "
                         f"got {v!r}")
+    if "mttr" in rec:
+        # schema-v6 optional: the fleet's failover→first-progress
+        # aggregate ({last, mean, count}), same nullability contract
+        # as the recovery record's mttr_s
+        mttr = rec["mttr"]
+        if not isinstance(mttr, dict):
+            errs.append("'mttr' must be an object when present")
+        else:
+            c = mttr.get("count")
+            if not isinstance(c, int) or isinstance(c, bool) or c < 0:
+                errs.append(f"mttr.count must be an int >= 0, got "
+                            f"{c!r}")
+            for k in ("last", "mean"):
+                v = mttr.get(k)
+                if v is None:
+                    continue
+                if (not isinstance(v, numbers.Number)
+                        or isinstance(v, bool) or v != v
+                        or not (v >= 0)):
+                    errs.append(f"mttr.{k} must be null or a finite "
+                                f"number >= 0, got {v!r}")
     if "deadline_last_sweep" in rec:
         sweep = rec["deadline_last_sweep"]
         if not isinstance(sweep, dict):
@@ -1116,6 +1172,146 @@ def validate_run_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- recovery record schema -------------------------------------------------
+
+# fleet.recovery.RECOVERY_ROLES / RECOVERY_ACTION_KINDS (duplicated
+# here so the stdlib-side validator needs no jax-adjacent import —
+# tests pin the two pairs equal, the RUN_ANOMALY_KINDS discipline)
+RECOVERY_ROLES = ("training", "serving")
+RECOVERY_ACTION_KINDS = (
+    "world_shrink", "resume", "rollback",
+    "admission_tighten", "admission_relax",
+    "window_shrink", "window_grow",
+    "drain", "undrain",
+    "cooldown_shorten", "cooldown_extend")
+
+
+def validate_recovery_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: recovery`` JSONL record
+    (``fleet.recovery.RecoveryLog.record`` enriched by the exporter,
+    schema v6): the common envelope, a known controller ``role``, the
+    episode/action tallies, a bounded action-detail list whose entries
+    each name a known action kind inside a counted episode, and the
+    MTTR aggregate — internally consistent the way a dashboard
+    assumes (details never exceed the total, the per-episode maximum
+    never exceeds it either, MTTR numbers are finite and
+    non-negative)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types, allow_none=False):
+        return _need(rec, errs, key, types, allow_none)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "recovery":
+        errs.append(f"kind must be 'recovery', got {rec.get('kind')!r}")
+    role = need("role", str)
+    if isinstance(role, str) and role not in RECOVERY_ROLES:
+        errs.append(f"role must be one of {RECOVERY_ROLES}, got "
+                    f"{role!r}")
+    subj = need("subject", str)
+    if isinstance(subj, str) and not subj:
+        errs.append("subject must be non-empty")
+    eps = need("episodes", int)
+    if isinstance(eps, int) and not isinstance(eps, bool) and eps < 0:
+        errs.append(f"episodes must be >= 0, got {eps}")
+    total = need("actions_total", int)
+    if isinstance(total, int) and not isinstance(total, bool) \
+            and total < 0:
+        errs.append(f"actions_total must be >= 0, got {total}")
+    mx = need("max_actions_in_episode", int)
+    if isinstance(mx, int) and not isinstance(mx, bool):
+        if mx < 0:
+            errs.append(f"max_actions_in_episode must be >= 0, got "
+                        f"{mx}")
+        elif isinstance(total, int) and not isinstance(total, bool) \
+                and mx > total:
+            errs.append(f"max_actions_in_episode ({mx}) exceeds "
+                        f"actions_total ({total})")
+        elif (isinstance(eps, int) and not isinstance(eps, bool)
+              and eps == 0 and mx > 0):
+            errs.append(f"max_actions_in_episode ({mx}) with zero "
+                        f"episodes")
+    need("in_flight", bool)
+    actions = need("actions", list)
+    if isinstance(actions, list):
+        if isinstance(total, int) and not isinstance(total, bool) \
+                and len(actions) > total:
+            errs.append(f"actions lists {len(actions)} entries but "
+                        f"actions_total is {total} (the detail list "
+                        f"is bounded, the counts are exact)")
+        for i, a in enumerate(actions):
+            if not isinstance(a, dict):
+                errs.append(f"actions[{i}] is not an object")
+                continue
+            k = a.get("kind")
+            if k not in RECOVERY_ACTION_KINDS:
+                errs.append(f"actions[{i}].kind must be one of "
+                            f"{RECOVERY_ACTION_KINDS}, got {k!r}")
+            ep = a.get("episode")
+            if ep is None:
+                # an action taken before any episode opened (the
+                # unwinding/correction case) carries a null episode
+                pass
+            elif not isinstance(ep, int) or isinstance(ep, bool) \
+                    or ep < 1:
+                errs.append(f"actions[{i}].episode must be null or "
+                            f"an int >= 1, got {ep!r}")
+            elif isinstance(eps, int) and not isinstance(eps, bool) \
+                    and ep > eps:
+                errs.append(f"actions[{i}].episode ({ep}) exceeds "
+                            f"episodes ({eps})")
+            t = a.get("t_s")
+            if (not isinstance(t, numbers.Number)
+                    or isinstance(t, bool) or not (t >= 0)):
+                errs.append(f"actions[{i}].t_s must be a number >= 0, "
+                            f"got {t!r}")
+    mttr = need("mttr_s", dict)
+    if isinstance(mttr, dict):
+        c = mttr.get("count")
+        if not isinstance(c, int) or isinstance(c, bool) or c < 0:
+            errs.append(f"mttr_s.count must be an int >= 0, got {c!r}")
+        for k in ("last", "mean"):
+            v = mttr.get(k)
+            if v is None:
+                if isinstance(c, int) and not isinstance(c, bool) \
+                        and c > 0:
+                    errs.append(f"mttr_s.{k} is null with count {c}")
+                continue
+            if (not isinstance(v, numbers.Number)
+                    or isinstance(v, bool) or v != v or not (v >= 0)):
+                errs.append(f"mttr_s.{k} must be null or a finite "
+                            f"number >= 0, got {v!r}")
+            elif isinstance(c, int) and not isinstance(c, bool) \
+                    and c == 0:
+                errs.append(f"mttr_s.{k} is {v} with zero "
+                            f"measurements")
+    # role extras, validated whenever present
+    if "world" in rec:
+        v = rec["world"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errs.append(f"'world' must be an int >= 1 when present, "
+                        f"got {v!r}")
+    for opt in ("recoveries", "max_queue", "base_max_queue"):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0 when "
+                            f"present, got {v!r}")
+    if "duration_s" in rec:
+        v = rec["duration_s"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (v >= 0)):
+            errs.append(f"'duration_s' must be a number >= 0, got "
+                        f"{v!r}")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 # -- trace record schema ----------------------------------------------------
 
 def validate_trace_record(rec: Any) -> List[str]:
@@ -1211,7 +1407,9 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     gradient-health dumps (``kind: numerics``, from
     ``bench.py --numerics`` / ``NumericsMonitor.to_record``) and
     training-run supervisor verdicts (``kind: run``, from
-    ``bench.py --run`` / ``RunSupervisor.record``, schema v5)."""
+    ``bench.py --run`` / ``RunSupervisor.record``, schema v5) and
+    recovery-controller snapshots (``kind: recovery``, from
+    ``bench.py --chaos`` / ``RecoveryLog.record``, schema v6)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -1225,6 +1423,8 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_numerics_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "run":
         return validate_run_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "recovery":
+        return validate_recovery_record(rec)
     return validate_bench_record(rec)
 
 

@@ -368,6 +368,15 @@ def serve(engine=None, fleet=None, supervisor=None,
             ring = lambda: fleet.ring      # noqa: E731 — per-access
         def _replicas_ok(fl=fleet):
             up = sum(1 for h in fl.health if h.steppable())
+            if up == 0 and getattr(fl, "recovery_in_flight", False):
+                # distinct degraded-but-live state (PR 11): a
+                # controller is mid-recovery (intentional world
+                # shrink, rollback) — 503ing now would flap an
+                # orchestrator into a restart loop on a fleet that is
+                # already being handled
+                return (True,
+                        f"recovering: 0/{len(fl.replicas)} replicas "
+                        f"steppable, recovery in flight")
             return (up > 0,
                     f"{up}/{len(fl.replicas)} replicas steppable")
         hc["replicas"] = _replicas_ok

@@ -200,6 +200,13 @@ class RunSupervisor:
         self._ckpt_step: Optional[int] = None
         self._scaler: Dict[str, Any] = {}
         self._comm: Dict[str, Any] = {}
+        # recovery-in-flight (PR 11): set by the recovery controller
+        # around an intentional rollback / world shrink — /healthz
+        # reports the distinct degraded-but-live "recovering" state
+        # instead of 503ing an orchestrator into a restart loop while
+        # the run is being handled
+        self._recovering: Optional[str] = None
+        self._recoveries = 0
 
     # -- the audit contract -------------------------------------------------
     def wrap_step(self, step_fn):
@@ -441,13 +448,55 @@ class RunSupervisor:
         """``ok`` while no anomaly has fired, ``attention`` after."""
         return "ok" if self.anomaly_total == 0 else "attention"
 
+    def begin_recovery(self, reason: str = ""):
+        """A recovery controller is actively handling the run
+        (rollback-restore, world shrink): ``health_check`` reports the
+        distinct degraded-but-live ``recovering`` state until
+        :meth:`end_recovery` — a /healthz 503 mid-shrink would flap an
+        orchestrator into a restart loop on a run that is already
+        being fixed."""
+        self._recovering = str(reason) or "recovery in flight"
+        self._recoveries += 1
+        self.ring.append("run_recovery_begin", run=self.run,
+                         reason=self._recovering)
+
+    def end_recovery(self):
+        if self._recovering is not None:
+            self.ring.append("run_recovery_end", run=self.run)
+        self._recovering = None
+
+    def rewind(self, step: int):
+        """The run legitimately REWOUND (a recovery controller
+        restored an earlier snapshot): reset the progress watermark to
+        ``step`` and grant a fresh stall grace period.  Without this,
+        a long replay below the old watermark (checkpoint cadence >
+        stall_observations) would fire a spurious stall verdict on a
+        perfectly healthy recovery — and, with stall in the
+        controller's trigger set, a pointless second rollback."""
+        if not self.enabled:
+            return
+        self._watermark = int(step)
+        self._watermark_obs = self._observations
+        self._in_stall = False
+        self.ring.append("run_rewound", run=self.run, step=int(step))
+
+    @property
+    def recovering(self) -> bool:
+        return self._recovering is not None
+
     def health_check(self):
         """``(ok, detail)`` for the introspection server's /healthz:
         unhealthy while the run sits IN a sick episode (stall not yet
         recovered, loss currently nonfinite); a past, RECOVERED
         anomaly degrades the verdict but not liveness — a routine
         amp-scaler overflow must not leave an orchestrator probe
-        failing forever."""
+        failing forever.  A recovery IN FLIGHT is degraded-but-LIVE:
+        the sick state is being handled by a controller, and a 503
+        would invite exactly the restart the recovery exists to
+        avoid."""
+        if self._recovering is not None:
+            return True, (f"recovering: {self._recovering} "
+                          f"(recovery {self._recoveries})")
         sick = []
         if self._in_stall:
             sick.append("stalled")
@@ -470,6 +519,8 @@ class RunSupervisor:
                 self._observations - self._watermark_obs),
             "stalled": self._in_stall,
             "loss_nonfinite": self._in_nan,
+            "recovering": self._recovering,
+            "recoveries": self._recoveries,
             "anomaly_counts": dict(self._counts),
             "anomaly_total": self.anomaly_total,
             "loss": {"last": self._last_loss,

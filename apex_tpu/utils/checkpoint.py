@@ -27,17 +27,31 @@ import os
 import re
 import tempfile
 import time
+import zlib
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "available_steps", "tree_bytes", "record_checkpoint_io"]
+__all__ = ["CheckpointCorrupt", "save_checkpoint", "restore_checkpoint",
+           "latest_step", "available_steps", "latest_durable_step",
+           "verify_checkpoint", "tree_bytes", "tree_checksum",
+           "record_checkpoint_io"]
 
 _FMT = "ckpt_{step:08d}.npz"
 _RE = re.compile(r"ckpt_(\d{8})\.npz$")
+
+# reserved npz key carrying the snapshot's content checksum; never a
+# pytree keypath (keystr always starts with a bracket/quote)
+_CHECKSUM_KEY = "__checksum__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot failed content verification (torn/partial write, bit
+    rot, truncation).  Restore raises this instead of silently loading
+    garbage; the recovery controller catches it and falls back to the
+    previous durable snapshot (``latest_durable_step``)."""
 
 # seconds; local-disk npz snapshots up to multi-minute sharded
 # TensorStore writes
@@ -90,6 +104,22 @@ def record_checkpoint_io(op: str, seconds: float, step=None,
             duration_s=round(float(seconds), 6))
 
 
+def tree_checksum(leaves: dict) -> int:
+    """Order-independent-by-construction content checksum of a leaf
+    dict (``{keypath: np.ndarray}``): crc32 chained over the sorted
+    keys, each leaf's dtype/shape, and its raw bytes.  Shared by the
+    npz path (embedded under ``__checksum__``) and the Orbax path
+    (sidecar file) so one verifier serves both."""
+    crc = 0
+    for key in sorted(leaves):
+        arr = np.asarray(leaves[key])
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(str(arr.dtype).encode(), crc)
+        crc = zlib.crc32(str(tuple(arr.shape)).encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
 def _leaf_dict(tree: Any) -> dict:
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     out = {}
@@ -115,6 +145,15 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
     os.makedirs(ckpt_dir, exist_ok=True)
     t0 = time.perf_counter()
     leaves = _leaf_dict(tree)
+    if _CHECKSUM_KEY in leaves:
+        raise ValueError(f"{_CHECKSUM_KEY!r} is a reserved key")
+    # content checksum over exactly the arrays being written: restore
+    # recomputes it from what it read, so a torn/partial write (or
+    # later bit rot) can never load silently.  Because the checksum is
+    # computed from the data in hand and the file lands by atomic
+    # rename, the checkpoint_saved event below only ever names a
+    # snapshot that verifies.
+    leaves[_CHECKSUM_KEY] = np.uint32(tree_checksum(leaves))
     path = os.path.join(ckpt_dir, _FMT.format(step=step))
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
@@ -150,11 +189,61 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _load_verified(path: str) -> dict:
+    """Read one snapshot and verify its content checksum; raises
+    :class:`CheckpointCorrupt` on a torn/truncated/corrupted file.
+    Pre-checksum snapshots (no ``__checksum__`` entry) load as-is —
+    they predate verification and are trusted like before."""
+    import zipfile
+    try:
+        with np.load(path) as data:
+            stored = dict(data)
+    except (OSError, ValueError, EOFError, KeyError,
+            zipfile.BadZipFile) as e:
+        # a torn npz fails in the zip layer (BadZipFile on a truncated
+        # central directory, KeyError on a missing member) or in the
+        # per-array header parse — all corruption
+        raise CheckpointCorrupt(f"{path}: unreadable snapshot ({e})")
+    want = stored.pop(_CHECKSUM_KEY, None)
+    if want is not None:
+        got = tree_checksum(stored)
+        if int(want) != got:
+            raise CheckpointCorrupt(
+                f"{path}: content checksum mismatch (stored "
+                f"{int(want):#010x}, recomputed {got:#010x}) — torn "
+                f"write or bit rot; fall back to an earlier snapshot")
+    return stored
+
+
+def verify_checkpoint(ckpt_dir: str, step: int) -> None:
+    """Verify one snapshot's content checksum without restoring it;
+    raises :class:`CheckpointCorrupt` (or ``FileNotFoundError``)."""
+    path = os.path.join(ckpt_dir, _FMT.format(step=step))
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    _load_verified(path)
+
+
+def latest_durable_step(ckpt_dir: str) -> Optional[int]:
+    """Newest snapshot step that VERIFIES — the recovery controller's
+    resume-point oracle: torn snapshots are skipped (newest first)
+    until one passes its content check; ``None`` when none do."""
+    for step in reversed(available_steps(ckpt_dir)):
+        try:
+            verify_checkpoint(ckpt_dir, step)
+            return step
+        except CheckpointCorrupt:
+            continue
+    return None
+
+
 def restore_checkpoint(ckpt_dir: str, template: Any,
                        step: Optional[int] = None) -> Any:
     """Return ``template`` with every leaf replaced by the stored value
     (cast to the template leaf's dtype, shapes must match).  ``step=None``
-    loads the newest checkpoint; raises FileNotFoundError if none."""
+    loads the newest checkpoint; raises FileNotFoundError if none and
+    :class:`CheckpointCorrupt` when the snapshot fails its content
+    checksum (torn write)."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
@@ -163,8 +252,7 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
     if not os.path.exists(path):
         raise FileNotFoundError(path)
     t0 = time.perf_counter()
-    with np.load(path) as data:
-        stored = dict(data)
+    stored = _load_verified(path)
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     out = []
     for kp, leaf in flat:

@@ -37,19 +37,54 @@ consume it.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import time
 from typing import Any, Optional
 
 import jax
+import numpy as np
 
-from .checkpoint import record_checkpoint_io, tree_bytes
+from .checkpoint import (CheckpointCorrupt, record_checkpoint_io,
+                         tree_bytes, tree_checksum)
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "available_steps"]
+__all__ = ["CheckpointCorrupt", "save_checkpoint", "restore_checkpoint",
+           "latest_step", "available_steps"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+# content-checksum sidecar inside each step dir (Orbax owns the tree
+# layout, so the checksum rides alongside rather than inside): written
+# only once the save is DURABLE (sync: at return; async: at the join).
+# A torn background write leaves no sidecar — but so does a genuinely
+# old (pre-checksum) snapshot, so every save ALSO drops a pending
+# marker NEXT TO the step dir (Orbax's force=True clears the target
+# dir itself) before the write starts and removes it at the join:
+# marker-without-sidecar = a save that never joined = corruption;
+# neither file = legacy = trusted like before.
+_CHECKSUM_FILE = "_apex_checksum.json"
+_PENDING_FMT = "_apex_pending_step_{step}.json"
+
+
+def _keyed_leaves(tree: Any) -> dict:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def _write_checksum(path: str, crc: int, nbytes: int,
+                    dtypes: dict) -> None:
+    side = os.path.join(path, _CHECKSUM_FILE)
+    tmp = side + ".tmp"
+    with open(tmp, "w") as f:
+        # the per-leaf dtypes the crc was computed over: a restore
+        # into a template with DIFFERENT dtypes casts the leaves
+        # (supported by contract), and a checksum over the cast bytes
+        # cannot match — the verifier uses this map to know when
+        # content verification is possible at all
+        json.dump({"crc32": int(crc), "tree_bytes": int(nbytes),
+                   "dtypes": dtypes}, f)
+    os.replace(tmp, side)
 
 
 def _mgr_dir(ckpt_dir: str) -> str:
@@ -80,12 +115,29 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
     path = os.path.join(_mgr_dir(ckpt_dir), f"step_{int(step)}")
     t0 = time.perf_counter()
     nbytes = tree_bytes(tree)
+    # content checksum of the tree being written (host gather — the
+    # price of verifiable snapshots; restore recomputes it from what
+    # it read back).  Computed BEFORE the background write starts so
+    # it describes exactly the intended content.
+    leaves = _keyed_leaves(tree)
+    crc = tree_checksum(leaves)
+    dtypes = {k: str(np.asarray(v).dtype) for k, v in leaves.items()}
+    # pending marker BEFORE the write starts: a process dying mid-save
+    # leaves marker-without-sidecar, which restore distinguishes from
+    # a legacy (pre-checksum) snapshot and flags as corrupt
+    os.makedirs(_mgr_dir(ckpt_dir), exist_ok=True)
+    pending = os.path.join(_mgr_dir(ckpt_dir),
+                           _PENDING_FMT.format(step=int(step)))
+    with open(pending, "w") as f:
+        json.dump({"step": int(step)}, f)
     ckptr = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
              if async_save
              else ocp.Checkpointer(ocp.StandardCheckpointHandler()))
     ckptr.save(path, tree, force=True)
     if not async_save:
         ckptr.close()
+        _write_checksum(path, crc, nbytes, dtypes)
+        os.unlink(pending)
         record_checkpoint_io("save", time.perf_counter() - t0,
                              step=int(step), nbytes=nbytes, path=path)
         if keep is not None:
@@ -95,8 +147,12 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
         # pruning AND the checkpoint_saved telemetry are deferred to
         # the join: a failed background write can't have already
         # deleted the older good checkpoints, and must not have
-        # emitted a progress event for a snapshot that never landed
-        _pending = (ckptr, ckpt_dir, keep, int(step), path, nbytes, t0)
+        # emitted a progress event for a snapshot that never landed.
+        # The checksum sidecar is deferred the same way: only a
+        # JOINED (durable) save gets one, so a torn background write
+        # is visibly unverified.
+        _pending = (ckptr, ckpt_dir, keep, int(step), path, nbytes,
+                    crc, dtypes, t0)
     return path
 
 
@@ -109,10 +165,17 @@ def wait() -> None:
     only durable now)."""
     global _pending
     if _pending is not None:
-        ckptr, ckpt_dir, keep, step, path, nbytes, t0 = _pending
+        (ckptr, ckpt_dir, keep, step, path, nbytes, crc, dtypes,
+         t0) = _pending
         _pending = None
         ckptr.wait_until_finished()
         ckptr.close()
+        _write_checksum(path, crc, nbytes, dtypes)
+        try:
+            os.unlink(os.path.join(
+                _mgr_dir(ckpt_dir), _PENDING_FMT.format(step=step)))
+        except OSError:
+            pass
         record_checkpoint_io("save", time.perf_counter() - t0,
                              step=step, nbytes=nbytes, path=path,
                              async_save=True)
@@ -160,8 +223,51 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
 
     t0 = time.perf_counter()
     abstract = jax.tree_util.tree_map(to_abstract, template)
-    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        restored = ckptr.restore(path, abstract)
+    try:
+        with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+            restored = ckptr.restore(path, abstract)
+    except (FileNotFoundError, ValueError, KeyError) as e:
+        # a torn step dir (interrupted write, missing TensorStore
+        # files) fails inside Orbax's own readers — surface it as the
+        # corruption it is so the recovery controller's fallback loop
+        # treats both backends the same way
+        raise CheckpointCorrupt(f"{path}: unreadable snapshot ({e})")
+    # content verification against the durability sidecar.  A pending
+    # marker WITHOUT a sidecar means the save never joined (process
+    # died mid-async-write): the step dir may be readable yet stale or
+    # partial, and must not restore silently — this is what makes a
+    # torn write distinguishable from a genuinely pre-checksum legacy
+    # snapshot (neither file), which loads as-is.
+    side = os.path.join(path, _CHECKSUM_FILE)
+    pending = os.path.join(_mgr_dir(ckpt_dir),
+                           _PENDING_FMT.format(step=int(step)))
+    if not os.path.exists(side) and os.path.exists(pending):
+        raise CheckpointCorrupt(
+            f"{path}: save was never joined (pending marker present, "
+            f"no durability sidecar) — torn async write")
+    if os.path.exists(side):
+        try:
+            with open(side) as f:
+                meta = json.load(f)
+            want = meta["crc32"]
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorrupt(f"{side}: unreadable checksum "
+                                    f"sidecar ({e})")
+        leaves = _keyed_leaves(restored)
+        # the sidecar crc was computed over the SAVED dtypes; a
+        # template with different dtypes casts the restore (supported
+        # by contract), and bytes after a cast cannot match — only
+        # verify when every leaf came back at its recorded dtype
+        saved_dt = meta.get("dtypes")
+        comparable = saved_dt is None or all(
+            str(np.asarray(v).dtype) == saved_dt.get(k)
+            for k, v in leaves.items())
+        if comparable:
+            got = tree_checksum(leaves)
+            if int(want) != got:
+                raise CheckpointCorrupt(
+                    f"{path}: content checksum mismatch (sidecar "
+                    f"{int(want):#010x}, recomputed {got:#010x})")
     record_checkpoint_io("restore", time.perf_counter() - t0,
                          step=int(step), nbytes=tree_bytes(restored),
                          path=path)

@@ -246,9 +246,14 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             # ``kind: run`` records (supervisor verdicts from bench
             # --run, schema v5) likewise describe one run — its
             # anomaly counts are that run's story, not a regression
-            # against an earlier round's run
+            # against an earlier round's run.  ``kind: recovery``
+            # records (controller snapshots from bench --chaos,
+            # schema v6) are the same shape of story: the METRIC
+            # lines next to them (chaos_mttr*, chaos_spike*) carry
+            # the cross-round trend.
             if isinstance(rec, dict) and rec.get("kind") in ("numerics",
-                                                             "run"):
+                                                             "run",
+                                                             "recovery"):
                 if is_stale(rec):
                     n_stale += 1
                 continue

@@ -356,3 +356,41 @@ def test_nonfinite_ewma_guard():
     _healthy(sup, 4)
     sup.observe_step(step=4, loss=1.0, step_time_s=float("nan"))
     assert math.isfinite(sup.status()["step_time_s"]["ewma"])
+
+
+def test_recovering_is_degraded_but_live(tmp_path):
+    """PR 11: while a recovery controller is handling the run, the
+    supervisor reports the distinct degraded-but-live 'recovering'
+    state — /healthz must not 503 an orchestrator into a restart loop
+    on a run that is already being fixed — and returns to honest
+    sickness reporting the moment the recovery ends."""
+    sup = _sup()
+    # drive the run into a NaN episode: health goes 503-worthy
+    sup.observe_step(step=0, loss=1.0)
+    sup.observe_step(step=1, loss=float("nan"))
+    ok, detail = sup.health_check()
+    assert not ok and "nan" in detail
+    # a recovery in flight supersedes the sickness: live, distinct
+    sup.begin_recovery("rollback to step 0")
+    assert sup.recovering
+    ok, detail = sup.health_check()
+    assert ok and detail.startswith("recovering:")
+    assert "rollback to step 0" in detail
+    st = sup.status()
+    assert st["recovering"] == "rollback to step 0"
+    assert st["recoveries"] == 1
+    # recovery ends with the run still sick -> 503 again (honesty)
+    sup.end_recovery()
+    assert not sup.recovering
+    ok, _ = sup.health_check()
+    assert not ok
+    # ... and a clean post-recovery observation recovers liveness
+    sup.observe_step(step=2, loss=1.0)
+    ok, _ = sup.health_check()
+    assert ok
+    kinds = [ev["kind"] for ev in sup.ring.snapshot()]
+    assert "run_recovery_begin" in kinds
+    assert "run_recovery_end" in kinds
+    # the record still validates with the recovery fields around
+    rec = exporters.JsonlExporter.enrich(sup.record())
+    assert exporters.validate_run_record(rec) == []
