@@ -48,9 +48,10 @@ from .router import (FleetOverloaded, LeastLoaded, PrefixAffinity,
                      RetryPolicy, RoundRobin, make_policy)
 from .faults import FaultyReplica, ReplicaFault, TrainingFaults
 from .slo import SloTracker, split_from_trace
-from .recovery import (RECOVERY_ACTION_KINDS, RECOVERY_ROLES,
-                       ElasticConfig, ElasticTrainer, RecoveryError,
-                       RecoveryLog, reshard_flat_state)
+from .recovery import (RECOVERY_ACTION_KINDS, RECOVERY_CAUSES,
+                       RECOVERY_ROLES, ElasticConfig, ElasticTrainer,
+                       PreemptionGuard, RecoveryError, RecoveryLog,
+                       reshard_flat_state)
 from .autoscale import AutoscaleConfig, SloController
 from . import slo
 
@@ -60,6 +61,7 @@ __all__ = ["Fleet", "FleetOverloaded", "RetryPolicy", "RoundRobin",
            "DEGRADED", "DEAD", "DRAINING", "DRAINED", "STATE_CODES",
            "FaultyReplica", "ReplicaFault", "TrainingFaults",
            "SloTracker", "split_from_trace", "slo",
-           "RECOVERY_ROLES", "RECOVERY_ACTION_KINDS", "RecoveryError",
-           "RecoveryLog", "ElasticConfig", "ElasticTrainer",
+           "RECOVERY_ROLES", "RECOVERY_ACTION_KINDS",
+           "RECOVERY_CAUSES", "RecoveryError", "RecoveryLog",
+           "PreemptionGuard", "ElasticConfig", "ElasticTrainer",
            "reshard_flat_state", "AutoscaleConfig", "SloController"]

@@ -220,6 +220,14 @@ class TrainingFaults:
     - ``straggler`` — :meth:`check_step` sleeps ``straggle_s`` (the
       slow window that degrades throughput without failing anything —
       supervisor ``throughput_regression`` territory);
+    - ``preemption`` — the PLANNED failure real TPU fleets see most:
+      a maintenance/preemption notice (SIGTERM with a grace window).
+      :meth:`check_step` does not raise — it calls
+      ``guard.preempt(...)`` on the attached
+      :class:`~apex_tpu.fleet.recovery.PreemptionGuard` (the same
+      entry point the real SIGTERM handler uses), and the run exits
+      with a ``preempted`` verdict at the next step boundary after a
+      coordinated emergency snapshot;
     - ``p_death`` — seeded random deaths per observed step, on top of
       any windows (soak-style, deterministic per seed).
 
@@ -229,15 +237,22 @@ class TrainingFaults:
     """
 
     def __init__(self, *, replica_death=(), torn_checkpoint=(),
-                 straggler=(), straggle_s: float = 0.01,
+                 straggler=(), preemption=(),
+                 straggle_s: float = 0.01,
                  torn_fraction: float = 0.6,
-                 p_death: float = 0.0, seed: int = 0, ring=None):
+                 p_death: float = 0.0, seed: int = 0, ring=None,
+                 guard=None):
         if not (0.0 < torn_fraction < 1.0):
             raise ValueError(f"torn_fraction must be in (0, 1), got "
                              f"{torn_fraction}")
         self._replica_death = _windows(replica_death)
         self._torn_checkpoint = _windows(torn_checkpoint)
         self._straggler = _windows(straggler)
+        self._preemption = _windows(preemption)
+        # the PreemptionGuard the preemption fault notifies (the
+        # ElasticTrainer auto-wires its own guard here when the
+        # harness left it unset)
+        self.guard = guard
         self.straggle_s = straggle_s
         self.torn_fraction = torn_fraction
         self.p_death = p_death
@@ -269,6 +284,14 @@ class TrainingFaults:
                         straggle_s=self.straggle_s)
             if self.straggle_s:
                 time.sleep(self.straggle_s)
+        if _in(self._preemption, t):
+            # a planned preemption notice, not a crash: notify the
+            # guard (idempotent) and keep stepping — the run exits at
+            # its next step boundary after an emergency snapshot
+            self._fired("preemption", t, run_step=run_step)
+            if self.guard is not None:
+                self.guard.preempt(
+                    f"injected preemption at observed step {t}")
         if _in(self._replica_death, t):
             self._fired("replica_death", t, run_step=run_step)
             raise ReplicaFault(
@@ -304,5 +327,5 @@ class TrainingFaults:
         semantics: with ``relative=True`` offsets count from the
         current observed step; ``()`` clears a kind."""
         _arm_windows(self, ("replica_death", "torn_checkpoint",
-                            "straggler"),
+                            "straggler", "preemption"),
                      self.steps, relative, kinds)

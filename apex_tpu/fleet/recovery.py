@@ -38,6 +38,20 @@ orchestrator into a restart loop mid-shrink.
 :class:`RecoveryLog` is the shared episode/action/MTTR bookkeeping —
 the serving-side controller (:mod:`apex_tpu.fleet.autoscale`) uses the
 same log, so both directions of the loop emit one record shape.
+
+Preemption (PR 12).  The most common failure on real TPU fleets is not
+a crash but a PLANNED maintenance/preemption event: SIGTERM with a
+grace window.  :class:`PreemptionGuard` turns that signal (or a
+programmatic :meth:`~PreemptionGuard.preempt` — what the
+``TrainingFaults.preemption`` window calls) into a request the trainer
+honors at its next STEP BOUNDARY: a coordinated emergency snapshot —
+model/optimizer tree plus the data pipeline's exported cursor
+(``data_state``) under one content checksum — then a clean exit with
+``verdict == "preempted"`` instead of dying mid-write.  A new trainer
+built with ``resume=True`` restores the latest durable snapshot AND
+the data cursor, so the resumed run's loss trajectory and consumed
+sample-index sequence are bitwise-identical to an undisturbed run
+(the acceptance pin in tests/test_recovery.py).
 """
 
 from __future__ import annotations
@@ -50,9 +64,9 @@ import numpy as np
 
 from .faults import ReplicaFault
 
-__all__ = ["RECOVERY_ROLES", "RECOVERY_ACTION_KINDS", "RecoveryError",
-           "RecoveryLog", "ElasticConfig", "ElasticTrainer",
-           "reshard_flat_state"]
+__all__ = ["RECOVERY_ROLES", "RECOVERY_ACTION_KINDS", "RECOVERY_CAUSES",
+           "RecoveryError", "RecoveryLog", "PreemptionGuard",
+           "ElasticConfig", "ElasticTrainer", "reshard_flat_state"]
 
 # both directions of the telemetry→action loop emit the same
 # ``kind: recovery`` record; ``role`` says which controller wrote it
@@ -63,17 +77,26 @@ RECOVERY_ROLES = ("training", "serving")
 # equal, the RUN_ANOMALY_KINDS discipline):
 # training — world_shrink (drop dead replicas from the data axis),
 #   resume (restore the last durable snapshot + re-jit), rollback
-#   (verdict-triggered restore at the SAME world);
+#   (verdict-triggered restore at the SAME world), preempt_snapshot
+#   (the coordinated emergency snapshot a preemption notice triggers
+#   at the next step boundary, within the grace budget);
 # serving — admission_tighten/relax (the fleet's bounded-queue knob),
 #   window_shrink/grow (decode window on replicas that support it),
 #   drain/undrain (capacity out/in), cooldown_shorten/extend (the
 #   breaker's step-counted cooldowns).
 RECOVERY_ACTION_KINDS = (
-    "world_shrink", "resume", "rollback",
+    "world_shrink", "resume", "rollback", "preempt_snapshot",
     "admission_tighten", "admission_relax",
     "window_shrink", "window_grow",
     "drain", "undrain",
     "cooldown_shorten", "cooldown_extend")
+
+# why a recovery/exit happened, when a record says (schema v7):
+# fault = an injected/real replica death, verdict = a supervisor
+# anomaly triggered the rollback, preemption = a planned SIGTERM /
+# maintenance notice honored at a step boundary.  Duplicated
+# stdlib-side in observability.exporters (tuple-pinned by a test).
+RECOVERY_CAUSES = ("fault", "verdict", "preemption")
 
 
 class RecoveryError(RuntimeError):
@@ -159,13 +182,28 @@ class RecoveryLog:
         if kind not in RECOVERY_ACTION_KINDS:
             raise ValueError(f"unknown recovery action {kind!r} "
                              f"(known: {RECOVERY_ACTION_KINDS})")
+        t = self._clock() - self._t0
+        if t < 0:
+            # catch the PR 11 gotcha AT THE SOURCE: a negative offset
+            # means this log's t0 predates the current clock reading —
+            # the fleet/controller/trainer was constructed BEFORE an
+            # injected tick clock was reset.  Failing here, with the
+            # remedy, beats the validator rejecting the finished
+            # record later in validate_recovery_record.
+            raise ValueError(
+                f"RecoveryLog t_s went negative ({t:.6f}s): the log "
+                f"was constructed before its clock was reset (an "
+                f"injected tick clock rewound past the log's t0). "
+                f"Reset the clock FIRST, then build the fleet and "
+                f"controller/trainer — the bench --chaos drive() "
+                f"precondition.")
         # an action before ANY episode (e.g. a relax correcting a
         # mis-tuned construction) carries episode=None — stamping a
         # phantom episode 1 into a record declaring zero episodes
         # would fail its own validator
         ev = {"kind": kind,
               "episode": self.episodes if self.episodes else None,
-              "t_s": round(self._clock() - self._t0, 6)}
+              "t_s": round(t, 6)}
         ev.update({k: v for k, v in detail.items() if v is not None})
         self.actions_total += 1
         if self._episode_open:
@@ -233,6 +271,123 @@ class RecoveryLog:
         }
         rec.update(extra)
         return rec
+
+
+class PreemptionGuard:
+    """Turn a preemption notice into a step-boundary snapshot request.
+
+    Real TPU fleets preempt with SIGTERM plus a grace window;
+    :meth:`install` registers a handler for it (restoring the previous
+    handler on :meth:`uninstall` / context exit), and
+    :meth:`preempt` is the programmatic entry point — what the handler
+    calls, and what ``TrainingFaults(preemption=...)`` calls in tests.
+    The guard never acts on its own: it records the request (first one
+    wins, later ones are no-ops), stamps the grace clock, appends a
+    ``preemption_requested`` flight-ring event and bumps
+    ``preemptions_total``; the :class:`ElasticTrainer` polls
+    :attr:`requested` at every step boundary and, with grace left,
+    writes the coordinated emergency snapshot (tree + ``data_state``)
+    before exiting with a ``preempted`` verdict — with the grace
+    budget already exhausted it exits WITHOUT starting a write a
+    torn-snapshot cleanup would have to mop up."""
+
+    def __init__(self, grace_s: float = 30.0,
+                 clock: Callable[[], float] = time.perf_counter,
+                 ring=None, registry=None):
+        if grace_s < 0:
+            raise ValueError(f"grace_s must be >= 0, got {grace_s}")
+        self.grace_s = float(grace_s)
+        self._clock = clock
+        self._ring = ring
+        self.registry = registry
+        self._reason: Optional[str] = None
+        self._t0: Optional[float] = None
+        self._installed: Dict[int, Any] = {}
+
+    @property
+    def ring(self):
+        from ..observability import flightrec
+        return flightrec.resolve(self._ring)
+
+    def _reg(self):
+        from ..observability.metrics import get_registry
+        return self.registry if self.registry is not None \
+            else get_registry()
+
+    # -- the request --------------------------------------------------------
+    def preempt(self, reason: str = "programmatic") -> None:
+        """Request a coordinated shutdown (idempotent: the FIRST
+        request starts the grace clock; repeats are no-ops)."""
+        if self._reason is not None:
+            return
+        self._reason = str(reason) or "programmatic"
+        self._t0 = self._clock()
+        self.ring.append("preemption_requested", reason=self._reason,
+                         grace_s=self.grace_s)
+        self._reg().counter(
+            "preemptions_total",
+            help="preemption notices received (signal or programmatic)"
+        ).inc()
+
+    @property
+    def requested(self) -> bool:
+        return self._reason is not None
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    @property
+    def requested_at(self) -> Optional[float]:
+        """Clock reading of the first :meth:`preempt` call (the MTTR
+        window's left edge), ``None`` before any request."""
+        return self._t0
+
+    def grace_remaining(self) -> float:
+        """Seconds of grace budget left (the full budget before any
+        request; clamped at 0)."""
+        if self._t0 is None:
+            return self.grace_s
+        return max(0.0, self.grace_s - (self._clock() - self._t0))
+
+    def reset(self) -> None:
+        """Clear the request (a resumed test harness reusing one
+        guard; production resumes build a fresh process anyway)."""
+        self._reason = None
+        self._t0 = None
+
+    # -- the signal surface -------------------------------------------------
+    def _handle(self, signum, frame):
+        self.preempt(f"signal {signum}")
+
+    def install(self, signals=None) -> "PreemptionGuard":
+        """Register the handler (default: SIGTERM — what TPU
+        maintenance/preemption sends); previous handlers are kept and
+        restored by :meth:`uninstall`.  Main-thread only, per the
+        stdlib signal contract."""
+        import signal as _signal
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+        for s in signals:
+            if s in self._installed:
+                # already ours: re-installing would record OUR handler
+                # as "previous" and uninstall could never restore the
+                # original one
+                continue
+            self._installed[s] = _signal.signal(s, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        import signal as _signal
+        for s, prev in self._installed.items():
+            _signal.signal(s, prev)
+        self._installed = {}
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 def reshard_flat_state(tree: Any, total: int, old_world: int,
@@ -357,7 +512,9 @@ class ElasticTrainer:
                  config: Optional[ElasticConfig] = None,
                  checkpointer=None, run: str = "elastic",
                  clock: Callable[[], float] = time.perf_counter,
-                 ring=None, registry=None):
+                 ring=None, registry=None,
+                 data=None, guard: Optional[PreemptionGuard] = None,
+                 resume: bool = False):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         self.build_step = build_step
@@ -384,12 +541,46 @@ class ElasticTrainer:
                                ring=ring, registry=registry)
         self._registry = registry
         self._mttr_t0: Optional[float] = None
+        # data pipeline with the state protocol (state_dict /
+        # load_state_dict, e.g. apex_tpu.data.DataLoader): its cursor
+        # is folded into every snapshot/restore so the sample stream
+        # resumes bitwise-identically
+        self.data = data
+        self.guard = guard
+        # the trainer's exit verdict: None while running, "completed"
+        # after a full run() call, "preempted" after a guard-honoring
+        # exit; cause names why the LAST recovery/exit happened
+        self.verdict: Optional[str] = None
+        self.cause: Optional[str] = None
+        # resume accounting (the bench --chaos preempt leg's line):
+        # wall cost of the resume=True restore, and the clock reading
+        # of the first COMMITTED step of this trainer — with the
+        # guard's requested_at, the preempt→first-good-step MTTR
+        self.resume_overhead_s: Optional[float] = None
+        self.first_commit_at: Optional[float] = None
+        self._last_saved_step: Optional[int] = None
+        if (guard is not None and faults is not None
+                and getattr(faults, "guard", None) is None):
+            # auto-wire: a TrainingFaults preemption window fires into
+            # THIS run's guard unless the harness bound its own
+            faults.guard = guard
+        if resume:
+            self._resume_from_disk()
 
     # -- snapshots ----------------------------------------------------------
     def _save(self):
+        self._last_saved_step = self._step
         tree = self._to_host(self._state)
-        path = self._ckpt.save_checkpoint(self.ckpt_dir, self._step,
-                                          tree)
+        if self.data is not None:
+            # the snapshot names its exact data cursor, under the same
+            # content checksum as the tree — tree and stream can never
+            # restore out of step with each other
+            path = self._ckpt.save_checkpoint(
+                self.ckpt_dir, self._step, tree,
+                data_state=self.data.state_dict())
+        else:
+            path = self._ckpt.save_checkpoint(self.ckpt_dir,
+                                              self._step, tree)
         if self.faults is not None:
             # torn-write injection happens AFTER the atomic rename —
             # the save-time checkpoint_saved event truthfully named a
@@ -400,25 +591,65 @@ class ElasticTrainer:
 
     def _restore_latest_durable(self):
         """Newest snapshot that verifies, restored into the canonical
-        host template; torn snapshots are skipped with a ring note."""
+        host template (plus its data_state when a pipeline is
+        attached); torn snapshots are skipped with a ring note."""
         template = self._to_host(self._state)
         from ..utils.checkpoint import CheckpointCorrupt
         for step in reversed(self._ckpt.available_steps(self.ckpt_dir)):
             try:
                 tree = self._ckpt.restore_checkpoint(
                     self.ckpt_dir, template, step=step)
-                return step, tree
             except CheckpointCorrupt as e:
                 self.log.ring.append("snapshot_skipped", step=step,
                                      reason=str(e))
                 continue
+            ds = None
+            if self.data is not None:
+                loader = getattr(self._ckpt, "load_data_state", None)
+                ds = loader(self.ckpt_dir, step=step) \
+                    if loader is not None else None
+                if ds is None:
+                    # LOUD, not a silent divergence: a pipeline is
+                    # attached but this snapshot cannot say where its
+                    # sample stream stood
+                    raise RecoveryError(
+                        f"snapshot step {step} in {self.ckpt_dir!r} "
+                        f"carries no data_state but a data pipeline "
+                        f"is attached — the sample stream cannot "
+                        f"resume deterministically (save through this "
+                        f"trainer, or detach the pipeline)")
+            return step, tree, ds
         raise RecoveryError(
             f"no durable snapshot in {self.ckpt_dir!r} — every "
             f"candidate failed content verification")
 
+    def _apply_restore(self, step: int, tree: Any, ds) -> None:
+        self._state = self._from_host(tree, self.world)
+        self._step = step
+        self.resumed_step = step
+        if ds is not None:
+            self.data.load_state_dict(ds)
+
+    def _resume_from_disk(self) -> bool:
+        """``resume=True`` construction: continue from the newest
+        durable snapshot (tree + data cursor) when one exists; a fresh
+        directory is just a fresh run."""
+        if not self._ckpt.available_steps(self.ckpt_dir):
+            return False
+        t0 = self._clock()
+        step, tree, ds = self._restore_latest_durable()
+        self._apply_restore(step, tree, ds)
+        self.resume_overhead_s = self._clock() - t0
+        self.log.action("resume", step=step, world=self.world,
+                        resumed_from="disk")
+        self._reg_world()
+        return True
+
     # -- recovery -----------------------------------------------------------
-    def _recover(self, reason: str, shrink: bool):
+    def _recover(self, reason: str, shrink: bool,
+                 cause: str = "fault"):
         cfg = self.config
+        self.cause = cause
         if self.recoveries >= cfg.max_recoveries:
             raise RecoveryError(
                 f"recovery budget exhausted ({cfg.max_recoveries}); "
@@ -441,14 +672,12 @@ class ElasticTrainer:
                 self.world = new_world
                 self.log.action("world_shrink", world_from=old_world,
                                 world_to=new_world)
-            step, tree = self._restore_latest_durable()
+            step, tree, ds = self._restore_latest_durable()
             if shrink:
                 # the mesh changed: re-jit the step on the survivors
                 # (predivide factors + comm plan rescale at trace time)
                 self._step_fn = self.build_step(self.world)
-            self._state = self._from_host(tree, self.world)
-            self._step = step
-            self.resumed_step = step
+            self._apply_restore(step, tree, ds)
             self.log.action("resume" if shrink else "rollback",
                             step=step, world=self.world)
             if self.supervisor is not None:
@@ -470,17 +699,71 @@ class ElasticTrainer:
                   help="current data-parallel world of the elastic run"
                   ).labels(run=self.log.subject).set(float(self.world))
 
+    # -- preemption ---------------------------------------------------------
+    def _preempt_exit(self):
+        """Honor a preemption request at the step boundary: with grace
+        budget left, write the coordinated emergency snapshot (tree +
+        data cursor, one checksum) and exit ``preempted``; with the
+        budget already gone, exit WITHOUT starting a write — the last
+        durable snapshot stays the resume point, and nobody has to
+        mop up a torn one."""
+        g = self.guard
+        left = g.grace_remaining()
+        snapshotted = False
+        if left > 0:
+            # the cadence save at the end of the last iteration may
+            # already cover this exact step — don't burn grace-window
+            # time re-serializing identical content
+            reused = self._last_saved_step == self._step
+            if not reused:
+                self._save()
+            snapshotted = True
+            self.log.action("preempt_snapshot", step=self._step,
+                            world=self.world,
+                            grace_left_s=round(left, 6),
+                            reused_cadence_save=reused)
+        else:
+            self.log.ring.append("preemption_grace_exhausted",
+                                 step=self._step, reason=g.reason)
+        self.cause = "preemption"
+        self.verdict = "preempted"
+        if self.supervisor is not None:
+            self.supervisor.mark_preempted(step=self._step,
+                                           reason=g.reason)
+        self.log.ring.append("preempted", step=self._step,
+                             world=self.world, reason=g.reason,
+                             snapshot=snapshotted)
+
     # -- the loop -----------------------------------------------------------
     def run(self, num_steps: int,
-            data_fn: Callable[[int], Any]) -> List[tuple]:
+            data_fn: Optional[Callable[[int], Any]] = None
+            ) -> List[tuple]:
         """Drive the run to ``num_steps`` committed steps, recovering
         through any scheduled faults; returns the history rows
-        ``(step, loss, world)`` committed by THIS call."""
+        ``(step, loss, world)`` committed by THIS call.
+
+        ``data_fn(i) -> batch`` produces the batch for run-step ``i``;
+        when omitted, the attached ``data=`` pipeline feeds the run
+        (``next_batch()``; its checkpointed cursor — not the step
+        index — is then what makes the stream deterministic across
+        preemption, rollback, and elastic world changes).  A
+        ``PreemptionGuard`` request is honored at the next step
+        boundary: emergency snapshot within the grace budget, then a
+        clean exit with ``verdict == "preempted"``."""
         cfg = self.config
+        if data_fn is None:
+            if self.data is None:
+                raise ValueError(
+                    "run() needs data_fn or a data= pipeline")
+            data_fn = lambda i: self.data.next_batch()[:2]  # noqa: E731
+        self.verdict = None
         out: List[tuple] = []
         if not self._ckpt.available_steps(self.ckpt_dir):
             self._save()                  # step-0 fallback snapshot
         while self._step < num_steps:
+            if self.guard is not None and self.guard.requested:
+                self._preempt_exit()
+                return out
             batch = data_fn(self._step)
             t0 = self._clock()
             try:
@@ -494,10 +777,13 @@ class ElasticTrainer:
                     # post-recovery step EXTENDS the same MTTR window
                     # (the fleet-side contract) — never restart it
                     self._mttr_t0 = self._clock()
-                self._recover(f"replica death: {e}", shrink=True)
+                self._recover(f"replica death: {e}", shrink=True,
+                              cause="fault")
                 continue
             dt = self._clock() - t0
             self._state = new_state
+            if self.first_commit_at is None:
+                self.first_commit_at = self._clock()
             row = (self._step, loss, self.world)
             self.history.append(row)
             out.append(row)
@@ -525,18 +811,28 @@ class ElasticTrainer:
                 self._recover(
                     f"supervisor verdict: "
                     f"{trigger[0].get('kind')}",
-                    shrink=cfg.shrink_on_verdict)
+                    shrink=cfg.shrink_on_verdict, cause="verdict")
                 continue
             if self._step % cfg.checkpoint_every == 0:
                 self._save()
+        self.verdict = "completed"
         return out
 
     def record(self, **extra) -> Dict[str, Any]:
-        """The training-side ``kind: recovery`` record."""
-        return self.log.record(world=self.world,
-                               recoveries=self.recoveries,
-                               resumed_step=self.resumed_step,
-                               **extra)
+        """The training-side ``kind: recovery`` record (schema v7:
+        plus ``cause``/``preempted`` and — when a pipeline is
+        attached — its ``data_state`` census, so the record names the
+        exact sample-stream position the run stood at)."""
+        fields: Dict[str, Any] = dict(
+            world=self.world, recoveries=self.recoveries,
+            resumed_step=self.resumed_step,
+            preempted=(self.verdict == "preempted"))
+        if self.cause is not None:
+            fields["cause"] = self.cause
+        if self.data is not None:
+            fields["data_state"] = self.data.state_dict()
+        fields.update(extra)
+        return self.log.record(**fields)
 
 
 def _np_tree(tree: Any) -> Any:

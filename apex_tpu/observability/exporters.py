@@ -64,9 +64,20 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # ``goodput_tokens_per_s`` (a controller-vs-baseline claim is
 # meaningless without the SLO side of it); ``kind: fleet`` records MAY
 # carry the ``mttr`` aggregate, validated whenever present.
+# v7: preemption-safe deterministic resume.  ``kind: recovery``
+# records gain ``cause`` (one of RECOVERY_CAUSES — ``preemption`` is
+# the planned-SIGTERM exit), ``preempted`` (bool) and ``data_state``
+# (the checkpointed sample-stream census:
+# samples_consumed/epoch/cursor plus the shard identity), all
+# validated whenever present; RECOVERY_ACTION_KINDS grows
+# ``preempt_snapshot`` (the coordinated emergency snapshot at the
+# step boundary); fresh ``chaos_preempt*`` bench lines must carry
+# ``mttr_s`` (preempt request → first committed post-resume step),
+# ``resume_overhead_s`` and ``resumed_step`` — a resume-overhead claim
+# is meaningless without the resume it measured.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v5 streams stay valid.
-SCHEMA_VERSION = 6
+# version, so archived v1..v6 streams stay valid.
+SCHEMA_VERSION = 7
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -582,6 +593,23 @@ def validate_bench_record(rec: Any) -> List[str]:
                     and not isinstance(gp, bool) and not (gp >= 0)):
                 errs.append(f"'goodput_tokens_per_s' must be >= 0, "
                             f"got {gp!r}")
+    # preemption resume lines (bench.py --chaos, schema v7): the
+    # trend-gated resume-overhead claim must carry the resume it
+    # measured — the MTTR window (preempt request → first committed
+    # post-resume step), the restore overhead, and where it resumed
+    v7 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 7)
+    if (v7 and isinstance(metric, str)
+            and metric.startswith("chaos_preempt")
+            and "error" not in rec and not rec.get("stale")):
+        for key in ("mttr_s", "resume_overhead_s"):
+            v = _need(rec, errs, key, numbers.Number)
+            if (isinstance(v, numbers.Number)
+                    and not isinstance(v, bool) and not (v >= 0)):
+                errs.append(f"{key!r} must be >= 0, got {v!r}")
+        rs = _need(rec, errs, "resumed_step", int)
+        if isinstance(rs, int) and not isinstance(rs, bool) and rs < 0:
+            errs.append(f"'resumed_step' must be >= 0, got {rs}")
     # step-time attribution fields (bench.py --comm, PR 6): a record
     # carrying ``overlap_fraction`` decomposes a train step into
     # compute vs comm time per fabric level and must be internally
@@ -1174,16 +1202,18 @@ def validate_run_record(rec: Any) -> List[str]:
 
 # -- recovery record schema -------------------------------------------------
 
-# fleet.recovery.RECOVERY_ROLES / RECOVERY_ACTION_KINDS (duplicated
-# here so the stdlib-side validator needs no jax-adjacent import —
-# tests pin the two pairs equal, the RUN_ANOMALY_KINDS discipline)
+# fleet.recovery.RECOVERY_ROLES / RECOVERY_ACTION_KINDS /
+# RECOVERY_CAUSES (duplicated here so the stdlib-side validator needs
+# no jax-adjacent import — tests pin the pairs equal, the
+# RUN_ANOMALY_KINDS discipline)
 RECOVERY_ROLES = ("training", "serving")
 RECOVERY_ACTION_KINDS = (
-    "world_shrink", "resume", "rollback",
+    "world_shrink", "resume", "rollback", "preempt_snapshot",
     "admission_tighten", "admission_relax",
     "window_shrink", "window_grow",
     "drain", "undrain",
     "cooldown_shorten", "cooldown_extend")
+RECOVERY_CAUSES = ("fault", "verdict", "preemption")
 
 
 def validate_recovery_record(rec: Any) -> List[str]:
@@ -1293,6 +1323,39 @@ def validate_recovery_record(rec: Any) -> List[str]:
         if not isinstance(v, int) or isinstance(v, bool) or v < 1:
             errs.append(f"'world' must be an int >= 1 when present, "
                         f"got {v!r}")
+    # schema-v7 preemption fields, validated whenever present (older
+    # records simply predate them)
+    if "cause" in rec and rec["cause"] is not None:
+        if rec["cause"] not in RECOVERY_CAUSES:
+            errs.append(f"'cause' must be null or one of "
+                        f"{RECOVERY_CAUSES}, got {rec['cause']!r}")
+    if "preempted" in rec and not isinstance(rec["preempted"], bool):
+        errs.append(f"'preempted' must be a bool when present, got "
+                    f"{rec['preempted']!r}")
+    if "data_state" in rec and rec["data_state"] is not None:
+        ds = rec["data_state"]
+        if not isinstance(ds, dict):
+            errs.append("'data_state' must be an object when present")
+        else:
+            for key in ("samples_consumed", "epoch", "cursor"):
+                if key in ds:
+                    v = ds[key]
+                    if (not isinstance(v, int) or isinstance(v, bool)
+                            or v < 0):
+                        errs.append(f"data_state.{key} must be an int "
+                                    f">= 0, got {v!r}")
+            sid, ns = ds.get("shard_id"), ds.get("num_shards")
+            for key, v in (("shard_id", sid), ("num_shards", ns)):
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool) or v < 0):
+                    errs.append(f"data_state.{key} must be an int "
+                                f">= 0, got {v!r}")
+            if (isinstance(sid, int) and isinstance(ns, int)
+                    and not isinstance(sid, bool)
+                    and not isinstance(ns, bool) and ns >= 1
+                    and not 0 <= sid < ns):
+                errs.append(f"data_state.shard_id ({sid}) out of "
+                            f"range for num_shards ({ns})")
     for opt in ("recoveries", "max_queue", "base_max_queue"):
         if opt in rec:
             v = rec[opt]

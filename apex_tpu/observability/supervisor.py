@@ -207,6 +207,11 @@ class RunSupervisor:
         # the run is being handled
         self._recovering: Optional[str] = None
         self._recoveries = 0
+        # preemption (PR 12): set by the elastic trainer when a
+        # PreemptionGuard request was honored — a CLEAN, live exit
+        # (snapshot written, resume point named), not a sick state
+        self._preempted: Optional[str] = None
+        self._preempted_step: Optional[int] = None
 
     # -- the audit contract -------------------------------------------------
     def wrap_step(self, step_fn):
@@ -484,6 +489,27 @@ class RunSupervisor:
     def recovering(self) -> bool:
         return self._recovering is not None
 
+    def mark_preempted(self, step: Optional[int] = None,
+                       reason: str = ""):
+        """The run exited on a PREEMPTION notice after its coordinated
+        emergency snapshot — a planned, clean exit whose resume point
+        is the last durable snapshot.  ``/healthz`` stays live (the
+        orchestrator is about to reschedule the job anyway; a 503
+        would just add a restart-loop to the preemption) and
+        ``/statusz`` says where the run stopped and why."""
+        if not self.enabled:
+            return
+        self._preempted = str(reason) or "preempted"
+        self._preempted_step = (int(step) if step is not None
+                                else self._watermark)
+        self.ring.append("run_preempted", run=self.run,
+                         step=self._preempted_step,
+                         reason=self._preempted)
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted is not None
+
     def health_check(self):
         """``(ok, detail)`` for the introspection server's /healthz:
         unhealthy while the run sits IN a sick episode (stall not yet
@@ -494,6 +520,10 @@ class RunSupervisor:
         the sick state is being handled by a controller, and a 503
         would invite exactly the restart the recovery exists to
         avoid."""
+        if self._preempted is not None:
+            return True, (f"preempted: {self._preempted} (stopped at "
+                          f"step {self._preempted_step}; resume from "
+                          f"the last durable snapshot)")
         if self._recovering is not None:
             return True, (f"recovering: {self._recovering} "
                           f"(recovery {self._recoveries})")
@@ -521,6 +551,8 @@ class RunSupervisor:
             "loss_nonfinite": self._in_nan,
             "recovering": self._recovering,
             "recoveries": self._recoveries,
+            "preempted": self._preempted,
+            "preempted_step": self._preempted_step,
             "anomaly_counts": dict(self._counts),
             "anomaly_total": self.anomaly_total,
             "loss": {"last": self._last_loss,

@@ -23,6 +23,7 @@ and the format is stable across sessions and jax versions.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import tempfile
@@ -36,15 +37,22 @@ import numpy as np
 
 __all__ = ["CheckpointCorrupt", "save_checkpoint", "restore_checkpoint",
            "latest_step", "available_steps", "latest_durable_step",
-           "verify_checkpoint", "tree_bytes", "tree_checksum",
-           "record_checkpoint_io"]
+           "verify_checkpoint", "load_data_state", "tree_bytes",
+           "tree_checksum", "record_checkpoint_io"]
 
 _FMT = "ckpt_{step:08d}.npz"
 _RE = re.compile(r"ckpt_(\d{8})\.npz$")
 
-# reserved npz key carrying the snapshot's content checksum; never a
-# pytree keypath (keystr always starts with a bracket/quote)
+# reserved npz keys; never pytree keypaths (keystr always starts with a
+# bracket/quote).  __checksum__ carries the snapshot's content
+# checksum; __data_state__ carries the optional data-pipeline cursor
+# blob (a JSON dict stored as uint8 bytes) so a snapshot names its
+# exact sample-stream position — the preemption-safe resume contract.
+# The data-state blob sits UNDER the checksum: it is part of the leaf
+# dict the crc covers, so a torn or tampered cursor fails verification
+# like any other leaf.
 _CHECKSUM_KEY = "__checksum__"
+_DATA_STATE_KEY = "__data_state__"
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -137,16 +145,25 @@ def _leaf_dict(tree: Any) -> dict:
 
 
 def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
-                    keep: Optional[int] = None) -> str:
+                    keep: Optional[int] = None,
+                    data_state: Optional[dict] = None) -> str:
     """Write ``tree`` for ``step``; atomic (write-temp + rename).  With
-    ``keep``, retain only the newest ``keep`` checkpoints."""
+    ``keep``, retain only the newest ``keep`` checkpoints.
+    ``data_state`` is an optional JSON-serializable dict (e.g.
+    ``DataLoader.state_dict()``) persisted alongside the tree under the
+    content checksum, so the snapshot names its exact data cursor;
+    read it back with :func:`load_data_state`."""
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
     os.makedirs(ckpt_dir, exist_ok=True)
     t0 = time.perf_counter()
     leaves = _leaf_dict(tree)
-    if _CHECKSUM_KEY in leaves:
-        raise ValueError(f"{_CHECKSUM_KEY!r} is a reserved key")
+    for reserved in (_CHECKSUM_KEY, _DATA_STATE_KEY):
+        if reserved in leaves:
+            raise ValueError(f"{reserved!r} is a reserved key")
+    if data_state is not None:
+        blob = json.dumps(data_state, sort_keys=True).encode()
+        leaves[_DATA_STATE_KEY] = np.frombuffer(blob, np.uint8)
     # content checksum over exactly the arrays being written: restore
     # recomputes it from what it read, so a torn/partial write (or
     # later bit rot) can never load silently.  Because the checksum is
@@ -224,6 +241,27 @@ def verify_checkpoint(ckpt_dir: str, step: int) -> None:
     _load_verified(path)
 
 
+def load_data_state(ckpt_dir: str,
+                    step: Optional[int] = None) -> Optional[dict]:
+    """Read the snapshot's data-pipeline cursor blob (what
+    ``save_checkpoint(..., data_state=...)`` persisted), verified under
+    the same content checksum as the tree.  ``None`` when the snapshot
+    carries no data state (it predates the field, or the run had no
+    checkpointable pipeline)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir!r}")
+    path = os.path.join(ckpt_dir, _FMT.format(step=step))
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    stored = _load_verified(path)
+    blob = stored.get(_DATA_STATE_KEY)
+    if blob is None:
+        return None
+    return json.loads(np.asarray(blob, np.uint8).tobytes().decode())
+
+
 def latest_durable_step(ckpt_dir: str) -> Optional[int]:
     """Newest snapshot step that VERIFIES — the recovery controller's
     resume-point oracle: torn snapshots are skipped (newest first)
@@ -253,6 +291,7 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
         raise FileNotFoundError(path)
     t0 = time.perf_counter()
     stored = _load_verified(path)
+    stored.pop(_DATA_STATE_KEY, None)   # read via load_data_state
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     out = []
     for kp, leaf in flat:

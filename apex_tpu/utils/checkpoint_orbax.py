@@ -41,6 +41,7 @@ import json
 import os
 import re
 import time
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -50,7 +51,7 @@ from .checkpoint import (CheckpointCorrupt, record_checkpoint_io,
                          tree_bytes, tree_checksum)
 
 __all__ = ["CheckpointCorrupt", "save_checkpoint", "restore_checkpoint",
-           "latest_step", "available_steps"]
+           "latest_step", "available_steps", "load_data_state"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
@@ -72,8 +73,18 @@ def _keyed_leaves(tree: Any) -> dict:
     return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
 
 
-def _write_checksum(path: str, crc: int, nbytes: int,
-                    dtypes: dict) -> None:
+def _chain_data_state(crc: int, data_state: Optional[dict]) -> int:
+    """Fold the data-state blob into the content crc (the npz path
+    gets this for free by storing the blob as a checksummed leaf):
+    a tampered or torn cursor fails verification like any leaf."""
+    if data_state is None:
+        return crc
+    blob = json.dumps(data_state, sort_keys=True).encode()
+    return zlib.crc32(blob, crc) & 0xFFFFFFFF
+
+
+def _write_checksum(path: str, crc: int, nbytes: int, dtypes: dict,
+                    data_state: Optional[dict] = None) -> None:
     side = os.path.join(path, _CHECKSUM_FILE)
     tmp = side + ".tmp"
     with open(tmp, "w") as f:
@@ -81,9 +92,19 @@ def _write_checksum(path: str, crc: int, nbytes: int,
         # into a template with DIFFERENT dtypes casts the leaves
         # (supported by contract), and a checksum over the cast bytes
         # cannot match — the verifier uses this map to know when
-        # content verification is possible at all
-        json.dump({"crc32": int(crc), "tree_bytes": int(nbytes),
-                   "dtypes": dtypes}, f)
+        # content verification is possible at all.  data_state (the
+        # optional pipeline cursor) rides in the sidecar and is
+        # chained into the crc, so it shares the durability story:
+        # written only at the join, verified on read.
+        meta = {"crc32": int(crc), "tree_bytes": int(nbytes),
+                "dtypes": dtypes}
+        if data_state is not None:
+            meta["data_state"] = data_state
+            # a crc over the blob ALONE, so load_data_state can verify
+            # the cursor without restoring (and re-checksumming) the
+            # whole tree the chained crc32 above binds it to
+            meta["data_state_crc32"] = _chain_data_state(0, data_state)
+        json.dump(meta, f)
     os.replace(tmp, side)
 
 
@@ -100,7 +121,8 @@ def _prune(ckpt_dir: str, keep: int) -> None:
 
 def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
                     keep: Optional[int] = None,
-                    async_save: bool = False) -> str:
+                    async_save: bool = False,
+                    data_state: Optional[dict] = None) -> str:
     """Write ``tree`` under ``ckpt_dir/step_N`` (sharded, per-process).
 
     ``async_save=True`` returns while the write completes in the
@@ -120,7 +142,7 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
     # it read back).  Computed BEFORE the background write starts so
     # it describes exactly the intended content.
     leaves = _keyed_leaves(tree)
-    crc = tree_checksum(leaves)
+    crc = _chain_data_state(tree_checksum(leaves), data_state)
     dtypes = {k: str(np.asarray(v).dtype) for k, v in leaves.items()}
     # pending marker BEFORE the write starts: a process dying mid-save
     # leaves marker-without-sidecar, which restore distinguishes from
@@ -136,7 +158,7 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
     ckptr.save(path, tree, force=True)
     if not async_save:
         ckptr.close()
-        _write_checksum(path, crc, nbytes, dtypes)
+        _write_checksum(path, crc, nbytes, dtypes, data_state)
         os.unlink(pending)
         record_checkpoint_io("save", time.perf_counter() - t0,
                              step=int(step), nbytes=nbytes, path=path)
@@ -152,7 +174,7 @@ def save_checkpoint(ckpt_dir: str, step: int, tree: Any,
         # JOINED (durable) save gets one, so a torn background write
         # is visibly unverified.
         _pending = (ckptr, ckpt_dir, keep, int(step), path, nbytes,
-                    crc, dtypes, t0)
+                    crc, dtypes, data_state, t0)
     return path
 
 
@@ -166,11 +188,11 @@ def wait() -> None:
     global _pending
     if _pending is not None:
         (ckptr, ckpt_dir, keep, step, path, nbytes, crc, dtypes,
-         t0) = _pending
+         data_state, t0) = _pending
         _pending = None
         ckptr.wait_until_finished()
         ckptr.close()
-        _write_checksum(path, crc, nbytes, dtypes)
+        _write_checksum(path, crc, nbytes, dtypes, data_state)
         try:
             os.unlink(os.path.join(
                 _mgr_dir(ckpt_dir), _PENDING_FMT.format(step=step)))
@@ -263,7 +285,8 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
             str(np.asarray(v).dtype) == saved_dt.get(k)
             for k, v in leaves.items())
         if comparable:
-            got = tree_checksum(leaves)
+            got = _chain_data_state(tree_checksum(leaves),
+                                    meta.get("data_state"))
             if int(want) != got:
                 raise CheckpointCorrupt(
                     f"{path}: content checksum mismatch (sidecar "
@@ -272,3 +295,38 @@ def restore_checkpoint(ckpt_dir: str, template: Any,
                          step=int(step), nbytes=tree_bytes(restored),
                          path=path)
     return restored
+
+
+def load_data_state(ckpt_dir: str,
+                    step: Optional[int] = None) -> Optional[dict]:
+    """Read the snapshot's data-pipeline cursor blob from the
+    durability sidecar (written only at the join, crc-chained — same
+    contract as the npz path's :func:`~.checkpoint.load_data_state`).
+    ``None`` when the snapshot carries none."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    path = os.path.join(_mgr_dir(ckpt_dir), f"step_{int(step)}")
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    side = os.path.join(path, _CHECKSUM_FILE)
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"{side}: unreadable checksum "
+                                f"sidecar ({e})")
+    ds = meta.get("data_state")
+    if ds is not None:
+        want = meta.get("data_state_crc32")
+        got = _chain_data_state(0, ds)
+        if want is not None and int(want) != got:
+            raise CheckpointCorrupt(
+                f"{side}: data_state checksum mismatch (stored "
+                f"{int(want):#010x}, recomputed {got:#010x}) — torn "
+                f"sidecar or tampered cursor; resuming it would "
+                f"silently diverge the sample stream")
+    return ds

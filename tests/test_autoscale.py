@@ -20,7 +20,7 @@ from apex_tpu.fleet import (DEAD, AutoscaleConfig, FaultyReplica,
                             Fleet, FleetOverloaded, HealthConfig,
                             RecoveryLog, RetryPolicy, SloController)
 from apex_tpu.fleet.recovery import (RECOVERY_ACTION_KINDS,
-                                     RECOVERY_ROLES)
+                                     RECOVERY_CAUSES, RECOVERY_ROLES)
 from apex_tpu import observability as obs
 from apex_tpu.observability import exporters
 from apex_tpu.observability.exporters import (JsonlExporter,
@@ -175,6 +175,23 @@ def _drive(fl, ctrl, clock, *, waves, ticks, deadline=None,
 def test_action_kinds_pinned_to_exporters():
     assert RECOVERY_ACTION_KINDS == exporters.RECOVERY_ACTION_KINDS
     assert RECOVERY_ROLES == exporters.RECOVERY_ROLES
+    assert RECOVERY_CAUSES == exporters.RECOVERY_CAUSES
+
+
+def test_recovery_log_rejects_negative_t_s_at_append():
+    """The PR 11 gotcha guarded AT THE SOURCE: a log whose t0 predates
+    the current clock (fleet/controller built before an injected tick
+    clock was reset) fails at action() time with the remedy, instead
+    of the finished record failing validate_recovery_record later."""
+    t = {"v": 100.0}
+    log = RecoveryLog("serving", "clockskew", clock=lambda: t["v"])
+    t["v"] = 10.0                       # clock reset AFTER construction
+    with pytest.raises(ValueError, match="[Rr]eset the clock"):
+        log.action("undrain")
+    # a healthy clock still appends
+    t["v"] = 101.0
+    ev = log.action("undrain")
+    assert ev["t_s"] == pytest.approx(1.0)
 
 
 # -- RecoveryLog bookkeeping ---------------------------------------------

@@ -381,6 +381,102 @@ class TestChecksumDurability:
         # corrupted sidecar)
         co.restore_checkpoint(str(tmp_path), tree, step=1)
 
+    def test_empty_tree_roundtrips_and_verifies(self, tmp_path):
+        # checksum edge case: a snapshot of an EMPTY tree (zero
+        # leaves) must still write, verify, and restore
+        utils.save_checkpoint(str(tmp_path), 1, {})
+        utils.checkpoint.verify_checkpoint(str(tmp_path), 1)
+        assert utils.checkpoint.latest_durable_step(str(tmp_path)) == 1
+        assert utils.restore_checkpoint(str(tmp_path), {}, step=1) == {}
+
+    def test_zero_length_arrays_roundtrip_and_verify(self, tmp_path):
+        tree = {"empty": jnp.zeros((0,), jnp.float32),
+                "also_empty": jnp.zeros((4, 0), jnp.int32),
+                "w": jnp.ones((3,), jnp.float32)}
+        utils.save_checkpoint(str(tmp_path), 2, tree)
+        utils.checkpoint.verify_checkpoint(str(tmp_path), 2)
+        restored = utils.restore_checkpoint(str(tmp_path), tree,
+                                            step=2)
+        assert restored["empty"].shape == (0,)
+        assert restored["also_empty"].shape == (4, 0)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      [1.0, 1.0, 1.0])
+
+    def test_data_state_only_change_roundtrips_and_verifies(
+            self, tmp_path):
+        # two snapshots of the SAME tree differing only in their data
+        # cursor: both verify (the blob sits under the checksum), both
+        # round-trip their own cursor, and the tree restore is
+        # unaffected by the blob
+        tree = self._tree()
+        ds1 = {"seed": 3, "epoch": 0, "cursor": 16,
+               "samples_consumed": 16, "shard_id": 0, "num_shards": 1}
+        ds2 = {**ds1, "cursor": 32, "samples_consumed": 32}
+        utils.save_checkpoint(str(tmp_path), 1, tree, data_state=ds1)
+        utils.save_checkpoint(str(tmp_path), 2, tree, data_state=ds2)
+        utils.checkpoint.verify_checkpoint(str(tmp_path), 1)
+        utils.checkpoint.verify_checkpoint(str(tmp_path), 2)
+        assert utils.checkpoint.load_data_state(
+            str(tmp_path), step=1) == ds1
+        assert utils.checkpoint.load_data_state(
+            str(tmp_path), step=2) == ds2
+        assert utils.checkpoint.load_data_state(str(tmp_path)) == ds2
+        restored = utils.restore_checkpoint(str(tmp_path), tree,
+                                            step=2)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"]))
+
+    def test_data_state_tamper_detected(self, tmp_path):
+        # the cursor blob is UNDER the checksum: restamping it without
+        # recomputing the crc is corruption, not a quiet rewind
+        from apex_tpu.utils.checkpoint import CheckpointCorrupt
+        tree = self._tree()
+        ds = {"cursor": 8, "seed": 0, "epoch": 0,
+              "samples_consumed": 8}
+        path = utils.save_checkpoint(str(tmp_path), 1, tree,
+                                     data_state=ds)
+        with np.load(path) as f:
+            stored = dict(f)
+        blob = np.frombuffer(
+            b'{"cursor": 999, "epoch": 0, "samples_consumed": 8, '
+            b'"seed": 0}', np.uint8)
+        stored["__data_state__"] = blob
+        with open(path, "wb") as f:
+            np.savez(f, **stored)
+        with pytest.raises(CheckpointCorrupt):
+            utils.restore_checkpoint(str(tmp_path), tree, step=1)
+        with pytest.raises(CheckpointCorrupt):
+            utils.checkpoint.load_data_state(str(tmp_path), step=1)
+
+    def test_snapshot_without_data_state_reads_none(self, tmp_path):
+        utils.save_checkpoint(str(tmp_path), 4, self._tree())
+        assert utils.checkpoint.load_data_state(
+            str(tmp_path), step=4) is None
+
+    def test_orbax_data_state_in_sidecar(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        import json as _json
+        import os as _os
+        from apex_tpu.utils import checkpoint_orbax as co
+        tree = self._tree()
+        ds = {"seed": 1, "epoch": 2, "cursor": 48,
+              "samples_consumed": 240}
+        path = co.save_checkpoint(str(tmp_path), 1, tree,
+                                  data_state=ds)
+        assert co.load_data_state(str(tmp_path), step=1) == ds
+        co.restore_checkpoint(str(tmp_path), tree, step=1)  # verifies
+        # the blob is crc-chained: a tampered cursor fails the restore
+        # AND the standalone cursor read (load_data_state must not
+        # hand back a cursor it cannot vouch for)
+        side = _os.path.join(path, "_apex_checksum.json")
+        meta = _json.load(open(side))
+        meta["data_state"]["cursor"] = 999
+        _json.dump(meta, open(side, "w"))
+        with pytest.raises(co.CheckpointCorrupt):
+            co.restore_checkpoint(str(tmp_path), tree, step=1)
+        with pytest.raises(co.CheckpointCorrupt):
+            co.load_data_state(str(tmp_path), step=1)
+
     def test_orbax_unjoined_async_save_flagged_not_legacy(self,
                                                           tmp_path):
         # a process dying between the async save's start and its join

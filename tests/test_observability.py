@@ -1678,3 +1678,63 @@ def test_v5_requirements_gate_on_declared_version():
          "arch": "cpu", "schema_version": 4})
     assert any("step_ms_on" in e
                for e in exporters.validate_bench_record(num))
+
+
+def test_v7_requirements_gate_on_declared_version():
+    """Schema v7: fresh chaos_preempt* lines must carry the resume
+    they measured (mttr_s / resume_overhead_s / resumed_step);
+    recovery records validate cause/preempted/data_state whenever
+    present.  Archived v6-and-earlier streams re-validate clean."""
+    line = {"metric": "chaos_preempt_resume", "value": 0.01,
+            "unit": "s", "vs_baseline": None, "backend": "cpu",
+            "ndev": 1, "arch": "cpu"}
+    v7 = exporters.JsonlExporter.enrich(dict(line))
+    assert v7["schema_version"] >= 7
+    errs = exporters.validate_bench_record(v7)
+    assert any("mttr_s" in e for e in errs)
+    assert any("resumed_step" in e for e in errs)
+    # the same line declaring v6 (an archived pre-preemption stream):
+    # clean — v6 never defined the metric
+    v6 = exporters.JsonlExporter.enrich({**line, "schema_version": 6})
+    assert exporters.validate_bench_record(v6) == []
+    # and the complete v7 line is clean
+    full = exporters.JsonlExporter.enrich(
+        {**line, "mttr_s": 0.02, "resume_overhead_s": 0.01,
+         "resumed_step": 7})
+    assert exporters.validate_bench_record(full) == []
+
+    # recovery-record preemption fields, validated whenever present
+    base = {"kind": "recovery", "role": "training", "subject": "run",
+            "episodes": 0, "actions_total": 0,
+            "max_actions_in_episode": 0, "actions": [],
+            "mttr_s": {"last": None, "mean": None, "count": 0},
+            "in_flight": False, "duration_s": 1.0}
+    ok = exporters.JsonlExporter.enrich(
+        {**base, "cause": "preemption", "preempted": True,
+         "data_state": {"samples_consumed": 80, "epoch": 1,
+                        "cursor": 16, "shard_id": 0,
+                        "num_shards": 4}})
+    assert exporters.validate_recovery_record(ok) == []
+    bad_cause = exporters.JsonlExporter.enrich(
+        {**base, "cause": "cosmic_rays"})
+    assert any("cause" in e for e in
+               exporters.validate_recovery_record(bad_cause))
+    bad_ds = exporters.JsonlExporter.enrich(
+        {**base, "data_state": {"samples_consumed": -1}})
+    assert any("samples_consumed" in e for e in
+               exporters.validate_recovery_record(bad_ds))
+    bad_shard = exporters.JsonlExporter.enrich(
+        {**base, "data_state": {"shard_id": 5, "num_shards": 4}})
+    assert any("shard_id" in e for e in
+               exporters.validate_recovery_record(bad_shard))
+    bad_pre = exporters.JsonlExporter.enrich(
+        {**base, "preempted": "yes"})
+    assert any("preempted" in e for e in
+               exporters.validate_recovery_record(bad_pre))
+    # the new action kind is known to the validator
+    act = exporters.JsonlExporter.enrich(
+        {**base, "episodes": 1, "actions_total": 1,
+         "max_actions_in_episode": 1,
+         "actions": [{"kind": "preempt_snapshot", "episode": 1,
+                      "t_s": 0.5}]})
+    assert exporters.validate_recovery_record(act) == []

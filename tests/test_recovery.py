@@ -11,6 +11,10 @@ bitwise on the CPU mesh).  Fault timelines use the seeded
 half-open-window harness (fleet/faults.py TrainingFaults), so every
 death/tear lands at an exact observed step."""
 
+import os
+import signal
+import time
+
 import numpy as np
 import pytest
 import jax
@@ -20,9 +24,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp, nn, optimizers, parallel
 from apex_tpu import observability as obs
+from apex_tpu.data import DataLoader
 from apex_tpu.fleet import (ElasticConfig, ElasticTrainer,
-                            RecoveryError, TrainingFaults,
-                            reshard_flat_state)
+                            PreemptionGuard, RecoveryError,
+                            TrainingFaults, reshard_flat_state)
 from apex_tpu.nn import functional as F
 from apex_tpu.observability.exporters import (JsonlExporter,
                                               validate_recovery_record)
@@ -340,6 +345,233 @@ def test_reshard_flat_state_pads_and_slices_exactly():
     assert out["other"].shape == (3, 3)       # non-flat untouched
     with pytest.raises(ValueError):
         reshard_flat_state(tree, total, 0, 4)
+
+
+# -- preemption-safe deterministic resume (PR 12) ------------------------
+
+def _uint8_dataset(n=64):
+    rng = np.random.RandomState(5)
+    images = rng.randint(0, 256, (n, 4, 4, 3), np.uint8)
+    labels = np.arange(n, dtype=np.int32)   # label == sample index
+    return images, labels
+
+
+def test_preempt_resume_matches_undisturbed(tmp_path):
+    """THE acceptance pin: preempt a run mid-training (coordinated
+    emergency snapshot at the step boundary, clean ``preempted``
+    exit), resume in a fresh trainer with a fresh loader — the loss
+    trajectory AND the consumed-sample-index sequence are identical
+    to an undisturbed run."""
+    images, labels = _uint8_dataset()
+    net = nn.Sequential([nn.Flatten(), nn.Linear(48, 32), nn.ReLU(),
+                         nn.Linear(32, 64)])
+    params, _ = net.init(jax.random.PRNGKey(0))
+    ddp = parallel.DistributedDataParallel(net)
+    build = _ddp_build_step(net, ddp)
+    state0 = (params, jnp.zeros((), jnp.int32))
+
+    def make_loader():
+        # the portable (checkpointable) stream; batch 16 splits over
+        # the world-8 data axis
+        return DataLoader(images, labels, batch_size=16, shuffle=True,
+                          seed=7, native=False)
+
+    def run_trainer(d, loader, log, **kw):
+        def data_fn(i):
+            imgs, lbls, _ = loader.next_batch()
+            log.append(tuple(int(v) for v in lbls))
+            return jnp.asarray(imgs), jnp.asarray(lbls)
+        tr = ElasticTrainer(
+            build, state0, world=8, ckpt_dir=str(d),
+            to_host=_np_tree, data=loader,
+            config=ElasticConfig(checkpoint_every=3, min_world=1),
+            registry=obs.MetricsRegistry(), **kw)
+        tr.run(10, data_fn)
+        return tr
+
+    und_log = []
+    und = run_trainer(tmp_path / "und", make_loader(), und_log,
+                      run="preempt_und")
+    assert und.verdict == "completed"
+    und_losses = [loss for _, loss, _ in und.history]
+
+    ring = obs.EventRing(256)
+    sup = obs.RunSupervisor("preempt_test", ring=ring,
+                            registry=obs.MetricsRegistry())
+    guard = PreemptionGuard(grace_s=60.0, ring=ring,
+                            registry=obs.MetricsRegistry())
+    faults = TrainingFaults(preemption=(4, 5), seed=0, ring=ring)
+    pre_log = []
+    pre = run_trainer(tmp_path / "pre", make_loader(), pre_log,
+                      guard=guard, faults=faults, supervisor=sup,
+                      ring=ring, run="preempt_run")
+    # the notice was honored at the NEXT step boundary: step 4 (where
+    # the fault fired) still committed, then snapshot + clean exit
+    assert pre.verdict == "preempted" and pre.cause == "preemption"
+    assert [s for s, _, _ in pre.history] == list(range(5))
+    assert faults.guard is guard          # auto-wired by the trainer
+    kinds = [ev["kind"] for ev in ring.snapshot()]
+    for k in ("preemption_requested", "preempted", "run_preempted"):
+        assert k in kinds, k
+    acts = [a["kind"] for a in pre.record()["actions"]]
+    assert acts == ["preempt_snapshot"]
+    # the supervisor reports the clean, LIVE preempted state
+    assert sup.preempted
+    ok, detail = sup.health_check()
+    assert ok and "preempted" in detail
+    assert sup.status()["preempted_step"] == 5
+
+    rec = JsonlExporter.enrich(pre.record())
+    assert validate_recovery_record(rec) == []
+    assert rec["cause"] == "preemption" and rec["preempted"] is True
+    assert rec["data_state"]["samples_consumed"] == 5 * 16
+
+    # resume: fresh trainer, fresh loader — the snapshot's data_state
+    # positions the stream, resume_overhead is accounted
+    res = run_trainer(tmp_path / "pre", make_loader(), pre_log,
+                      resume=True, run="preempt_resumed")
+    assert res.resumed_step == 5 and res.verdict == "completed"
+    assert res.resume_overhead_s is not None \
+        and res.resume_overhead_s >= 0
+    assert [s for s, _, _ in res.history] == list(range(5, 10))
+
+    res_losses = [loss for _, loss, _ in pre.history + res.history]
+    np.testing.assert_allclose(res_losses, und_losses, rtol=1e-6)
+    assert pre_log == und_log             # exact index sequence
+
+
+def test_replica_death_with_loader_rewinds_data_exactly_once(
+        tmp_path):
+    """The kill half of the pin, with a real data pipeline: a replica
+    death mid-step abandons a drawn batch; recovery restores the
+    snapshot's data_state alongside the tree, so the loader rewinds
+    WITH the model and every committed step consumes its sample slice
+    exactly once — no drift from the abandoned draw, across the 8→4
+    shrink."""
+    images, labels = _uint8_dataset()
+    net = nn.Sequential([nn.Flatten(), nn.Linear(48, 32), nn.ReLU(),
+                         nn.Linear(32, 64)])
+    params, _ = net.init(jax.random.PRNGKey(0))
+    ddp = parallel.DistributedDataParallel(net)
+    build = _ddp_build_step(net, ddp)
+    state0 = (params, jnp.zeros((), jnp.int32))
+
+    loader = DataLoader(images, labels, batch_size=16, shuffle=True,
+                        seed=7, native=False)
+    faults = TrainingFaults(replica_death=(5, 6), seed=0)
+    trainer = ElasticTrainer(
+        build, state0, world=8, ckpt_dir=str(tmp_path),
+        to_host=_np_tree, data=loader, faults=faults,
+        config=ElasticConfig(checkpoint_every=2, min_world=1),
+        registry=obs.MetricsRegistry(), run="death_loader")
+    trainer.run(10)                      # data= feeds the run
+    assert trainer.world == 4 and trainer.resumed_step == 4
+
+    # exactly-once: 10 committed steps = 10 global batches, despite
+    # the abandoned draw at the death (its consumption was rewound
+    # with the snapshot's data_state)
+    assert loader.stats()["samples_consumed"] == 10 * 16
+
+    # the post-shrink trajectory matches an undisturbed world-4 run
+    # resumed from the SAME snapshot with a FRESH loader positioned
+    # by the snapshot's data_state
+    template = _np_tree(state0)
+    restored = ckpt.restore_checkpoint(str(tmp_path), template, step=4)
+    ds = ckpt.load_data_state(str(tmp_path), step=4)
+    assert ds["samples_consumed"] == 4 * 16
+    loader2 = DataLoader(images, labels, batch_size=16, shuffle=True,
+                         seed=7, native=False)
+    loader2.load_state_dict(ds)
+    step4 = build(4)
+    st, undisturbed = restored, []
+    for i in range(4, 10):
+        imgs, lbls, _ = loader2.next_batch()
+        st, loss = step4(st, (jnp.asarray(imgs), jnp.asarray(lbls)))
+        undisturbed.append(float(loss))
+    post = [loss for s, loss, w in trainer.history if w == 4]
+    np.testing.assert_allclose(post, undisturbed, rtol=1e-6)
+
+
+def test_preemption_guard_sigterm_handler():
+    """The real entry point: SIGTERM lands in the installed guard's
+    handler; uninstall restores the previous handler."""
+    ring = obs.EventRing(16)
+    guard = PreemptionGuard(grace_s=5.0, ring=ring,
+                            registry=obs.MetricsRegistry())
+    prev = signal.getsignal(signal.SIGTERM)
+    with guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(400):              # delivery is async-ish
+            if guard.requested:
+                break
+            time.sleep(0.005)
+        assert guard.requested
+        assert "signal" in guard.reason
+    assert signal.getsignal(signal.SIGTERM) is prev
+    # double install is idempotent: uninstall still restores the
+    # ORIGINAL handler, not the guard's own
+    guard2 = PreemptionGuard(registry=obs.MetricsRegistry())
+    guard2.install()
+    guard2.install()
+    guard2.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    (ev,) = ring.snapshot("preemption_requested")
+    assert ev["grace_s"] == 5.0
+    # idempotent: a second notice does not restart the grace clock
+    t0 = guard.requested_at
+    guard.preempt("again")
+    assert guard.requested_at == t0 and "signal" in guard.reason
+
+
+def test_preemption_with_exhausted_grace_skips_snapshot(tmp_path):
+    """Grace already gone when the boundary arrives: exit WITHOUT
+    starting a write — the last durable snapshot stays the resume
+    point, and nothing is torn."""
+    def build(world):
+        return lambda st, b: ({"w": st["w"] + 1}, 1.0)
+
+    ring = obs.EventRing(64)
+    guard = PreemptionGuard(grace_s=0.0, ring=ring,
+                            registry=obs.MetricsRegistry())
+    faults = TrainingFaults(preemption=(2, 3), seed=0, ring=ring)
+    trainer = ElasticTrainer(
+        build, {"w": np.zeros(2, np.float32)}, world=4,
+        ckpt_dir=str(tmp_path), guard=guard, faults=faults,
+        config=ElasticConfig(checkpoint_every=5, min_world=1),
+        ring=ring, registry=obs.MetricsRegistry(), run="nograce")
+    trainer.run(6, lambda i: None)
+    assert trainer.verdict == "preempted"
+    # only the step-0 fallback snapshot exists — no emergency write
+    assert ckpt.available_steps(str(tmp_path)) == [0]
+    kinds = [ev["kind"] for ev in ring.snapshot()]
+    assert "preemption_grace_exhausted" in kinds
+    assert "preempt_snapshot" not in [
+        a["kind"] for a in trainer.record()["actions"]]
+    # a resumed trainer falls back to the durable step-0 snapshot
+    res = ElasticTrainer(
+        build, {"w": np.zeros(2, np.float32)}, world=4,
+        ckpt_dir=str(tmp_path), resume=True,
+        registry=obs.MetricsRegistry(), run="nograce_res")
+    assert res.resumed_step == 0
+
+
+def test_legacy_snapshot_without_data_state_is_loud(tmp_path):
+    """A pipeline is attached but the snapshot cannot say where the
+    stream stood: RecoveryError, not a silent divergence."""
+    images, labels = _uint8_dataset()
+    ckpt.save_checkpoint(str(tmp_path), 0,
+                         {"w": np.zeros(2, np.float32)})
+
+    def build(world):
+        return lambda st, b: ({"w": st["w"] + 1}, 1.0)
+
+    loader = DataLoader(images, labels, batch_size=16, shuffle=True,
+                        native=False)
+    with pytest.raises(RecoveryError, match="data_state"):
+        ElasticTrainer(
+            build, {"w": np.zeros(2, np.float32)}, world=4,
+            ckpt_dir=str(tmp_path), data=loader, resume=True,
+            registry=obs.MetricsRegistry(), run="legacy")
 
 
 # -- recovery failure paths (loud, not loops) ----------------------------
