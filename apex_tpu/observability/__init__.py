@@ -38,6 +38,18 @@ divergence digest — all in-graph with zero host syncs (the
 ``numerics`` lint rule pins it) behind ``kind: numerics`` records and
 ``bench.py --numerics``.
 
+And **device-time truth** (PR 13): ``timeline``, the stdlib-only
+Chrome-trace parser over what ``jax.profiler.start_trace`` already
+writes — per-step device busy time, per-kernel top-k, compute vs
+collective vs gap split, and a *measured* ``overlap_fraction`` from
+actual kernel-interval overlap (the device-timeline counterpart of
+``steptime``'s host differencing, cross-checked by
+``steptime.timeline_consistency``); ``kind: profile`` records (schema
+v8) behind ``bench.py --profile`` and the server's on-demand
+``/profilez`` capture; plus the serving KV fragmentation ledger
+(``Engine.kv_fragmentation`` / ``kv_waste_bytes`` — ROADMAP item 1's
+needle).
+
 And the **operational plane** (PR 10): ``server``, a stdlib
 ``http.server`` introspection endpoint serving ``/healthz`` /
 ``/metricsz`` (Prometheus exposition, conformance-tested) /
@@ -82,6 +94,7 @@ from . import metrics
 from . import tracing
 from . import flightrec
 from . import steptime
+from . import timeline
 from . import exporters
 from . import costmodel
 from . import memory
@@ -104,6 +117,7 @@ __all__ = [
     "NumericsMonitor", "divergence_check", "divergence_digest",
     "digest_comm_plan",
     "ObservabilityServer", "RunSupervisor", "SupervisorConfig",
-    "metrics", "tracing", "flightrec", "steptime", "exporters",
-    "costmodel", "memory", "numerics", "server", "supervisor",
+    "metrics", "tracing", "flightrec", "steptime", "timeline",
+    "exporters", "costmodel", "memory", "numerics", "server",
+    "supervisor",
 ]

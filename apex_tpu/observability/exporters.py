@@ -34,6 +34,7 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
            "validate_fleet_record", "validate_trace_record",
            "validate_memory_record", "validate_numerics_record",
            "validate_run_record", "validate_recovery_record",
+           "validate_profile_record",
            "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
@@ -75,9 +76,22 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # ``mttr_s`` (preempt request → first committed post-resume step),
 # ``resume_overhead_s`` and ``resumed_step`` — a resume-overhead claim
 # is meaningless without the resume it measured.
+# v8: device-time truth.  ``kind: profile`` records exist (the
+# Chrome-trace device-timeline attribution from
+# ``observability.timeline``, via ``bench.py --profile`` and the
+# ``/profilez`` endpoint): span/busy/compute/collective/gap/overlap
+# split in ms plus a MEASURED ``measured_overlap_fraction`` from
+# actual kernel-interval overlap — the timeline-backed counterpart of
+# steptime's differenced estimate, internally cross-checked by
+# ``validate_profile_record``.  Fresh engine-decode bench lines must
+# now carry the KV fragmentation pair ``kv_waste_bytes`` +
+# ``kv_utilization`` next to v3's ``kv_cache_bytes`` (allocated bytes
+# without the wasted bytes is exactly the blind spot ROADMAP item 1's
+# paged allocator must drive down); both fields are validated whenever
+# present at any version.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v6 streams stay valid.
-SCHEMA_VERSION = 7
+# version, so archived v1..v7 streams stay valid.
+SCHEMA_VERSION = 8
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -396,6 +410,31 @@ def _need(rec, errs, key, types, allow_none=False):
     return v
 
 
+def _check_kv_fields(rec, errs):
+    """The KV fragmentation field contract, shared by bench and
+    profile records (one implementation so the two schemas cannot
+    drift): byte fields are non-negative ints, waste is a subset of
+    the allocation, utilization is a fraction — all validated
+    whenever present."""
+    for opt in ("kv_cache_bytes", "kv_waste_bytes"):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0, got {v!r}")
+    kvw, kvc = rec.get("kv_waste_bytes"), rec.get("kv_cache_bytes")
+    if (isinstance(kvw, int) and isinstance(kvc, int)
+            and not isinstance(kvw, bool) and not isinstance(kvc, bool)
+            and kvw > kvc):
+        errs.append(f"kv_waste_bytes ({kvw}) exceeds kv_cache_bytes "
+                    f"({kvc}) — waste is a subset of the allocation")
+    if "kv_utilization" in rec:
+        v = rec["kv_utilization"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (0.0 <= v <= 1.0)):
+            errs.append(f"'kv_utilization' must be in [0, 1], got "
+                        f"{v!r}")
+
+
 def _check_envelope(rec, errs):
     """The common record envelope every exported line carries
     (schema_version / capture host / first-class ``stale``) — one
@@ -452,6 +491,8 @@ def validate_bench_record(rec: Any) -> List[str]:
     sv_rec = rec.get("schema_version")
     v3 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
           and sv_rec >= 3)
+    v8 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 8)
     if (isinstance(metric, str) and "engine_decode" in metric
             and "error" not in rec and not rec.get("stale")):
         if "window" not in rec:
@@ -464,6 +505,13 @@ def validate_bench_record(rec: Any) -> List[str]:
         if v3 and "kv_cache_bytes" not in rec:
             errs.append("fresh engine decode records must carry "
                         "'kv_cache_bytes' (schema v3)")
+        # v8: allocated bytes without the wasted bytes is exactly the
+        # fragmentation blind spot — fresh decode lines carry the pair
+        if v8:
+            for key in ("kv_waste_bytes", "kv_utilization"):
+                if key not in rec:
+                    errs.append(f"fresh engine decode records must "
+                                f"carry {key!r} (schema v8)")
     # MFU / peak-memory fields (PR 8): a fresh train-step throughput
     # line is only a roofline statement given the model FLOPs behind
     # it — v3 records must say what they computed (flops_per_step,
@@ -487,11 +535,7 @@ def validate_bench_record(rec: Any) -> List[str]:
         pb = _need(rec, errs, "peak_bytes", int)
         if isinstance(pb, int) and not isinstance(pb, bool) and pb < 0:
             errs.append(f"'peak_bytes' must be >= 0, got {pb}")
-    if "kv_cache_bytes" in rec:
-        v = rec["kv_cache_bytes"]
-        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
-            errs.append(f"'kv_cache_bytes' must be an int >= 0, "
-                        f"got {v!r}")
+    _check_kv_fields(rec, errs)
     if "mfu" in rec and rec["mfu"] is not None and (
             not isinstance(rec["mfu"], numbers.Number)
             or isinstance(rec["mfu"], bool)):
@@ -1459,6 +1503,152 @@ def validate_trace_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- profile record schema --------------------------------------------------
+
+# observability.timeline.PROFILE_FIELDS (duplicated here so the
+# stdlib-only CI loader never imports the timeline module; the pytest
+# coverage pins the two tuples equal — the RUN_ANOMALY_KINDS
+# discipline)
+PROFILE_TIME_FIELDS = ("span_ms", "device_busy_ms", "compute_ms",
+                       "collective_ms", "gap_ms", "overlap_ms")
+_PROFILE_KERNEL_KINDS = ("compute", "collective")
+
+
+def validate_profile_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: profile`` JSONL record (the
+    device-timeline attribution from ``observability.timeline`` via
+    ``bench.py --profile`` or ``/profilez``, schema v8): the common
+    envelope, a subject (``metric`` or ``entry_point``), the six
+    non-negative timing fields, and the interval arithmetic a
+    hand-built record gets wrong — busy never exceeds the span, gap
+    reassembles span minus busy, the class unions bound the busy
+    union from both sides, overlap fits inside BOTH classes, and the
+    measured fraction is overlap over collective time.  ``top_kernels``
+    entries must each name a known class; the optional KV fragmentation
+    fields follow the bench-record rules (waste is a subset of the
+    allocation, utilization is a fraction)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types, allow_none=False):
+        return _need(rec, errs, key, types, allow_none)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "profile":
+        errs.append(f"kind must be 'profile', got {rec.get('kind')!r}")
+    subject = rec.get("entry_point", rec.get("metric"))
+    if not isinstance(subject, str) or not subject:
+        errs.append("profile records must carry a non-empty "
+                    "'entry_point' or 'metric'")
+    vals = {}
+    for key in PROFILE_TIME_FIELDS:
+        v = need(key, numbers.Number)
+        if isinstance(v, numbers.Number) and not isinstance(v, bool):
+            if not (v >= 0):           # also rejects NaN
+                errs.append(f"{key!r} must be >= 0, got {v!r}")
+            else:
+                vals[key] = float(v)
+    frac = need("measured_overlap_fraction", numbers.Number)
+    if (isinstance(frac, numbers.Number) and not isinstance(frac, bool)
+            and not (0.0 <= frac <= 1.0)):
+        errs.append(f"'measured_overlap_fraction' must be in [0, 1], "
+                    f"got {frac!r}")
+
+    def tol(x):
+        # the producer rounds every field to 4 decimals independently;
+        # merged-interval arithmetic is exact before rounding
+        return max(0.01, 0.01 * x)
+
+    if len(vals) == len(PROFILE_TIME_FIELDS):
+        span, busy = vals["span_ms"], vals["device_busy_ms"]
+        comp, coll = vals["compute_ms"], vals["collective_ms"]
+        gap, ovl = vals["gap_ms"], vals["overlap_ms"]
+        if busy > span + tol(span):
+            errs.append(f"device_busy_ms ({busy}) exceeds span_ms "
+                        f"({span})")
+        if abs(gap - max(span - busy, 0.0)) > tol(span):
+            errs.append(f"gap_ms ({gap}) != span_ms - device_busy_ms "
+                        f"({span} - {busy})")
+        if busy > comp + coll + tol(busy):
+            errs.append(f"device_busy_ms ({busy}) exceeds compute_ms "
+                        f"+ collective_ms ({comp} + {coll}) — the "
+                        f"busy union is covered by the class unions")
+        if busy + tol(busy) < max(comp, coll):
+            errs.append(f"device_busy_ms ({busy}) below "
+                        f"max(compute_ms, collective_ms) "
+                        f"({comp}, {coll})")
+        if ovl > min(comp, coll) + tol(ovl):
+            errs.append(f"overlap_ms ({ovl}) exceeds a class union it "
+                        f"is an intersection of ({comp}, {coll})")
+        if (isinstance(frac, numbers.Number)
+                and not isinstance(frac, bool)):
+            if coll > 0:
+                expect = min(max(ovl / coll, 0.0), 1.0)
+                if abs(frac - expect) > max(0.01, 0.02 * expect):
+                    errs.append(
+                        f"measured_overlap_fraction ({frac}) "
+                        f"inconsistent with overlap_ms/collective_ms "
+                        f"({ovl}/{coll})")
+            elif frac != 0.0:
+                errs.append(f"measured_overlap_fraction ({frac}) with "
+                            f"zero collective_ms")
+    for opt in ("kernel_count", "lane_count"):
+        if opt in rec:
+            v = rec[opt]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{opt!r} must be an int >= 0 when "
+                            f"present, got {v!r}")
+    if "steps" in rec:
+        v = rec["steps"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errs.append(f"'steps' must be an int >= 1 when present, "
+                        f"got {v!r}")
+    if "duration_ms" in rec:
+        v = rec["duration_ms"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (v >= 0)):
+            errs.append(f"'duration_ms' must be a number >= 0 when "
+                        f"present, got {v!r}")
+    if "trace_path" in rec and not isinstance(rec["trace_path"], str):
+        errs.append("'trace_path' must be a string when present")
+    if "top_kernels" in rec:
+        top = rec["top_kernels"]
+        if not isinstance(top, list):
+            errs.append("'top_kernels' must be a list when present")
+        else:
+            for i, k in enumerate(top):
+                if not isinstance(k, dict):
+                    errs.append(f"top_kernels[{i}] is not an object")
+                    continue
+                name = k.get("name")
+                if not isinstance(name, str) or not name:
+                    errs.append(f"top_kernels[{i}].name must be a "
+                                f"non-empty string")
+                if k.get("kind") not in _PROFILE_KERNEL_KINDS:
+                    errs.append(f"top_kernels[{i}].kind must be one "
+                                f"of {_PROFILE_KERNEL_KINDS}, got "
+                                f"{k.get('kind')!r}")
+                c = k.get("count")
+                if not isinstance(c, int) or isinstance(c, bool) \
+                        or c < 1:
+                    errs.append(f"top_kernels[{i}].count must be an "
+                                f"int >= 1, got {c!r}")
+                t = k.get("total_ms")
+                if (not isinstance(t, numbers.Number)
+                        or isinstance(t, bool) or not (t >= 0)):
+                    errs.append(f"top_kernels[{i}].total_ms must be a "
+                                f"number >= 0, got {t!r}")
+    # KV fragmentation fields on serving profiles: the same shared
+    # contract as the bench-record fields
+    _check_kv_fields(rec, errs)
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 def validate_telemetry_record(rec: Any) -> List[str]:
     """Dispatching validator: graph-lint, fleet and trace records (by
     ``kind``) go through their own schemas, everything else through
@@ -1472,7 +1662,9 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     training-run supervisor verdicts (``kind: run``, from
     ``bench.py --run`` / ``RunSupervisor.record``, schema v5) and
     recovery-controller snapshots (``kind: recovery``, from
-    ``bench.py --chaos`` / ``RecoveryLog.record``, schema v6)."""
+    ``bench.py --chaos`` / ``RecoveryLog.record``, schema v6) and
+    device-timeline attributions (``kind: profile``, from
+    ``bench.py --profile`` / ``/profilez``, schema v8)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -1488,6 +1680,8 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_run_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "recovery":
         return validate_recovery_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "profile":
+        return validate_profile_record(rec)
     return validate_bench_record(rec)
 
 

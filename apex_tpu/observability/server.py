@@ -21,6 +21,15 @@ any hot path, no dependencies:
 - ``/tracez`` — :class:`~apex_tpu.observability.SpanRecorder` records:
   the trace-id index by default, one schema-valid ``kind: trace``
   record with ``?trace_id=``.
+- ``/profilez`` — on-demand device-timeline capture (PR 13): triggers
+  the attached profiler hook (``observability.timeline.make_profiler``
+  builds the standard one — a bounded ``jax.profiler`` window over the
+  live process, parsed into a schema-versioned ``kind: profile``
+  record).  ``?duration_ms=`` bounds the window (the hook clamps);
+  404 when no profiler hook is attached (the jax-free deployment
+  shape, pinned by tests/ci/server_smoke.py), 409 when a capture is
+  already in flight — ``jax.profiler.start_trace`` is a process-wide
+  singleton, so concurrent captures cannot be honored.
 
 Attachment is one call::
 
@@ -53,9 +62,18 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 
-__all__ = ["ObservabilityServer", "serve", "ENDPOINTS"]
+__all__ = ["ObservabilityServer", "serve", "ENDPOINTS",
+           "ProfileInFlight"]
 
-ENDPOINTS = ("/healthz", "/metricsz", "/statusz", "/flightz", "/tracez")
+ENDPOINTS = ("/healthz", "/metricsz", "/statusz", "/flightz", "/tracez",
+             "/profilez")
+
+
+class ProfileInFlight(RuntimeError):
+    """A profiler capture is already running in this process —
+    ``/profilez`` maps it to HTTP 409 (the device profiler is a
+    process-wide singleton; two overlapping captures would corrupt
+    each other's windows)."""
 
 
 def _json_default(obj):
@@ -98,6 +116,7 @@ class ObservabilityServer:
                  status: Optional[Dict[str, Callable[[], Any]]] = None,
                  health: Optional[Dict[str, Callable[[], Tuple[bool, str]]]]
                  = None,
+                 profiler: Optional[Callable] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = 512):
         self._registry = registry
@@ -106,6 +125,8 @@ class ObservabilityServer:
         self._status: Dict[str, Callable[[], Any]] = dict(status or {})
         self._health: Dict[str, Callable[[], Tuple[bool, str]]] = \
             dict(health or {})
+        self._profiler = profiler
+        self._profile_lock = threading.Lock()
         self.host = host
         self._want_port = port
         self.tracez_limit = int(tracez_limit)
@@ -123,6 +144,15 @@ class ObservabilityServer:
     def add_health_check(self, name: str,
                          fn: Callable[[], Tuple[bool, str]]):
         self._health[str(name)] = fn
+        return self
+
+    def attach_profiler(self, fn: Callable):
+        """Attach the ``/profilez`` capture hook: a callable taking one
+        optional ``duration_ms`` (possibly None) and returning the
+        ``kind: profile`` record body —
+        ``observability.timeline.make_profiler()`` builds the standard
+        one."""
+        self._profiler = fn
         return self
 
     # -- default resolution (per request) ----------------------------------
@@ -221,6 +251,34 @@ class ObservabilityServer:
         from .exporters import prometheus_text
         return prometheus_text(self.registry())
 
+    def profilez(self, duration_ms: Optional[float] = None
+                 ) -> Dict[str, Any]:
+        """Trigger one bounded capture through the attached profiler
+        hook and return the enriched ``kind: profile`` record.  Raises
+        ``KeyError`` with no hook attached (handler → 404) and
+        :class:`ProfileInFlight` when a capture is already running —
+        either detected here (two concurrent ``/profilez`` scrapes) or
+        raised by the hook itself (a foreign trace window is open);
+        handler → 409."""
+        fn = self._profiler
+        if fn is None:
+            raise KeyError("no profiler hook attached (serve with "
+                           "profiler=timeline.make_profiler())")
+        if not self._profile_lock.acquire(blocking=False):
+            raise ProfileInFlight("a /profilez capture is already in "
+                                  "flight")
+        try:
+            rec = fn(duration_ms)
+        finally:
+            self._profile_lock.release()
+        if not isinstance(rec, dict):
+            raise TypeError(f"profiler hook returned "
+                            f"{type(rec).__name__}, not a record dict")
+        from .exporters import JsonlExporter
+        out = dict(rec)
+        out.setdefault("kind", "profile")
+        return JsonlExporter.enrich(out)
+
     # -- the HTTP plumbing --------------------------------------------------
     def _make_handler(self):
         srv = self
@@ -269,6 +327,31 @@ class ObservabilityServer:
                         except KeyError:
                             self._send_json(404, {
                                 "error": f"unknown trace_id {tid!r}"})
+                    elif route == "/profilez":
+                        raw = q.get("duration_ms", [None])[0]
+                        try:
+                            dur = (float(raw) if raw is not None
+                                   else None)
+                            # float() accepts nan/inf, which would
+                            # sail through the hook's min/max clamp
+                            # (NaN compares false) into time.sleep
+                            if dur is not None and not (
+                                    0 <= dur < float("inf")):
+                                raise ValueError
+                        except ValueError:
+                            self._send_json(400, {
+                                "error": f"duration_ms must be a "
+                                         f"finite number >= 0, got "
+                                         f"{raw!r}"})
+                            return
+                        try:
+                            self._send_json(200, srv.profilez(
+                                duration_ms=dur))
+                        except KeyError as e:
+                            self._send_json(404, {
+                                "error": f"no capture available: {e}"})
+                        except ProfileInFlight as e:
+                            self._send_json(409, {"error": str(e)})
                     elif route == "/":
                         self._send_json(200, {
                             "endpoints": list(ENDPOINTS)})
@@ -335,6 +418,7 @@ def serve(engine=None, fleet=None, supervisor=None,
           registry=None, ring=None, recorder=None,
           status: Optional[Dict[str, Callable[[], Any]]] = None,
           health: Optional[Dict[str, Callable[[], Tuple[bool, str]]]] = None,
+          profiler: Optional[Callable] = None,
           host: str = "127.0.0.1", port: int = 0,
           start: bool = True) -> ObservabilityServer:
     """One-call attachment: build (and start) an
@@ -352,7 +436,11 @@ def serve(engine=None, fleet=None, supervisor=None,
       declared sick.
 
     Explicit ``registry``/``ring``/``recorder``/``status``/``health``
-    compose with (and win over) the attachment defaults.
+    compose with (and win over) the attachment defaults.  ``profiler``
+    arms ``/profilez`` (``timeline.make_profiler()`` builds the
+    standard hook); without one the endpoint answers 404 — on-demand
+    device captures are an explicit opt-in, never a surprise cost on a
+    serving process.
     """
     st: Dict[str, Callable[[], Any]] = {}
     hc: Dict[str, Callable[[], Tuple[bool, str]]] = {}
@@ -387,5 +475,5 @@ def serve(engine=None, fleet=None, supervisor=None,
     hc.update(health or {})
     srv = ObservabilityServer(registry=registry, ring=ring,
                               recorder=recorder, status=st, health=hc,
-                              host=host, port=port)
+                              profiler=profiler, host=host, port=port)
     return srv.start() if start else srv

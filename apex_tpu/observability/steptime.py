@@ -33,6 +33,18 @@ must beat.  ``compute_ms + comm_ms == step_ms`` by construction (up to
 the clamp), which is the wall-clock consistency
 ``exporters.validate_bench_record`` pins on attribution records.
 
+Differencing is an *inference*; the device timeline is a
+*measurement*.  ``attribute_step(..., capture_timeline=True)`` runs
+one extra pass of the full step under a fresh profiler window, parses
+the Chrome trace with ``observability.timeline``, and attaches the
+measured split — per-kernel device busy time, the compute vs
+collective unions, and a ``measured_overlap_fraction`` from actual
+kernel-interval overlap — plus a :func:`timeline_consistency` verdict
+pinning the differenced comm share against the measured one within a
+stated tolerance.  When the two disagree beyond it, trust the
+timeline: differencing assumes the compute twin and the full step
+schedule identically, which the compiler does not promise.
+
 Per-level attribution takes the ICI/DCN labels from
 ``parallel.allreduce_comm_plan``: the measured comm time is split
 across buckets by wire bytes and within a bucket by its
@@ -48,7 +60,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-__all__ = ["blocked_time", "attribute_step", "ATTRIBUTION_FIELDS"]
+__all__ = ["blocked_time", "attribute_step", "timeline_consistency",
+           "ATTRIBUTION_FIELDS"]
 
 # the fields every step-attribution bench record must carry
 # (exporters.validate_bench_record keys its checks off
@@ -105,11 +118,46 @@ def _bucket_level_bytes(bucket: Dict[str, Any]):
     return b, 0.0
 
 
+def timeline_consistency(attribution: Dict[str, Any],
+                         tl: Dict[str, Any],
+                         tol: float = 0.35) -> Dict[str, Any]:
+    """Pin the differencing estimate against the measured split.
+
+    Compares the comm share of a step the two ways: differenced —
+    critical-path ``comm_ms / step_ms`` (host wall clock) — vs
+    measured — the collective time NOT hidden under compute over the
+    capture span (``(collective_ms - overlap_ms) / span_ms``, device
+    timeline).  ``tol`` is an ABSOLUTE tolerance on the fraction
+    difference: both methods see the same schedule, but differencing
+    folds dispatch gaps and compiler-schedule drift between the twin
+    programs into its estimate, so the stated tolerance is loose by
+    design — the check catches the methodology being *wrong* (a twin
+    that elides more than the collectives), not timer noise."""
+    step_ms = float(attribution.get("step_ms", 0.0) or 0.0)
+    diff_frac = (float(attribution.get("comm_ms", 0.0)) / step_ms
+                 if step_ms > 0 else 0.0)
+    span_ms = float(tl.get("span_ms", 0.0) or 0.0)
+    vis = max(float(tl.get("collective_ms", 0.0))
+              - float(tl.get("overlap_ms", 0.0)), 0.0)
+    meas_frac = (vis / span_ms) if span_ms > 0 else 0.0
+    delta = abs(diff_frac - meas_frac)
+    return {"differenced_comm_fraction": round(diff_frac, 4),
+            "measured_comm_fraction": round(meas_frac, 4),
+            "abs_diff": round(delta, 4),
+            "tol": float(tol),
+            "consistent": bool(delta <= tol)}
+
+
 def attribute_step(full_step: Callable, compute_step: Callable,
                    comm_step: Callable, args: Sequence[Any] = (),
                    plan: Optional[List[dict]] = None,
                    iters: int = 10, warmup: int = 2,
-                   ici_step: Optional[Callable] = None
+                   ici_step: Optional[Callable] = None,
+                   capture_timeline: bool = False,
+                   capture_dir: Optional[str] = None,
+                   capture_iters: Optional[int] = None,
+                   timeline_modules: Optional[Sequence[str]] = None,
+                   consistency_tol: float = 0.35
                    ) -> Dict[str, Any]:
     """Measure and decompose one train step (see module docstring).
 
@@ -120,10 +168,24 @@ def attribute_step(full_step: Callable, compute_step: Callable,
     without one the comm time reports as a single unlabeled bucket on
     the ``ici`` column.
 
+    ``capture_timeline=True`` additionally runs ``capture_iters``
+    (default ``iters``) warm passes of the FULL step under a fresh
+    profiler window — after the timed loops, so the capture never
+    contaminates the differencing measurements — and attaches the
+    parsed device-timeline attribution under ``timeline`` (per-step,
+    ``observability.timeline.analyze_capture``), the headline
+    ``measured_overlap_fraction``, and the
+    :func:`timeline_consistency` verdict under ``consistency``.
+    ``timeline_modules`` restricts parsing to the step's own HLO
+    module(s) (e.g. ``("jit_step",)``) so the blocked-fetch plumbing
+    does not attribute as step time.
+
     Returns the attribution dict (all times in ms)::
 
         {step_ms, compute_ms, comm_ms, comm_isolated_ms,
-         overlap_fraction, ici_ms, dcn_ms, buckets: [...]}
+         overlap_fraction, ici_ms, dcn_ms, buckets: [...],
+         timeline?: {...}, measured_overlap_fraction?,
+         consistency?: {...}}
     """
     step_ms = blocked_time(full_step, *args, iters=iters,
                            warmup=warmup) * 1e3
@@ -184,11 +246,24 @@ def attribute_step(full_step: Callable, compute_step: Callable,
                 rec[k] = b[k]
         out_buckets.append(rec)
 
-    return {"step_ms": round(step_ms, 4),
-            "compute_ms": round(compute_ms, 4),
-            "comm_ms": round(comm_ms, 4),
-            "comm_isolated_ms": round(comm_isolated_ms, 4),
-            "overlap_fraction": round(overlap, 4),
-            "ici_ms": round(sum(i for i, _ in split), 4),
-            "dcn_ms": round(sum(d for _, d in split), 4),
-            "buckets": out_buckets}
+    out = {"step_ms": round(step_ms, 4),
+           "compute_ms": round(compute_ms, 4),
+           "comm_ms": round(comm_ms, 4),
+           "comm_isolated_ms": round(comm_isolated_ms, 4),
+           "overlap_fraction": round(overlap, 4),
+           "ici_ms": round(sum(i for i, _ in split), 4),
+           "dcn_ms": round(sum(d for _, d in split), 4),
+           "buckets": out_buckets}
+
+    if capture_timeline:
+        from . import timeline as tlmod
+        n = capture_iters if capture_iters is not None else iters
+        tl = tlmod.capture(full_step, *args, iters=max(n, 1),
+                           logdir=capture_dir,
+                           modules=timeline_modules)
+        out["timeline"] = tl
+        out["measured_overlap_fraction"] = \
+            tl["measured_overlap_fraction"]
+        out["consistency"] = timeline_consistency(
+            out, tl, tol=consistency_tol)
+    return out

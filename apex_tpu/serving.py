@@ -105,6 +105,15 @@ _TPS_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
                 1000.0, 2000.0, 5000.0, 10000.0, 20000.0)
 
 
+def _tree_nbytes(tree) -> int:
+    """Device bytes across a pytree's array leaves — the one leaf-
+    accounting rule `kv_cache_bytes` and both engines' fragmentation
+    ledgers share (so they can never drift)."""
+    return int(sum(leaf.nbytes
+                   for leaf in jax.tree_util.tree_leaves(tree)
+                   if hasattr(leaf, "nbytes")))
+
+
 class _Request:
     def __init__(self, rid, slot, prompt_len, max_new, eos):
         self.rid = rid
@@ -183,11 +192,18 @@ class _SlotScheduler:
             "engine_window_size",
             help="in-graph decode ticks per host round trip").set(
             float(self.window))
+        # the fragmentation gauges start honest: everything allocated,
+        # nothing used (subclasses call _init_scheduler after their KV
+        # buffers exist)
+        self._set_kv_gauges()
 
-    def _admit_timed(self, rid, *rest):
+    def _admit_timed(self, rid, *rest, refresh_kv=True):
         """All admissions (direct and queue-drained) route through here:
         times the prefill/seed, stamps the request's lifecycle
-        timestamps, and feeds the admission histograms."""
+        timestamps, and feeds the admission histograms.
+        ``refresh_kv=False`` lets a batch drain defer the fragmentation
+        ledger rebuild to ONE refresh at its end instead of one full
+        KV-tree scan per admitted request."""
         t0 = self._clock()
         # engine_rid, not rid: these spans land inside FLEET request
         # traces whose rid attrs are fleet ids — the replica-local id
@@ -204,6 +220,8 @@ class _SlotScheduler:
             req.t_submit = self._submit_ts.pop(rid, t0)
             req.t_admit = t1
             self._m_queue_wait.observe(max(t0 - req.t_submit, 0.0))
+        if refresh_kv:
+            self._set_kv_gauges()   # admission filled a slot's prefix
 
     def _record_step(self, t0: float, tokens: int = 1,
                      capacity: int = 0) -> float:
@@ -262,6 +280,11 @@ class _SlotScheduler:
                 # what marks it inactive for the next window's scan)
                 self._freeze_slot(slot)
         self._drain_queue()
+        # after the window's growth/finishes and the re-admissions:
+        # the per-window fragmentation sample the ISSUE's ledger asks
+        # for (admissions inside _drain_queue already refreshed, but a
+        # window with only finishes/growth would otherwise go stale)
+        self._set_kv_gauges()
         return out
 
     def _check_request(self, prompt, max_new_tokens, seed,
@@ -338,9 +361,13 @@ class _SlotScheduler:
         self.metrics.gauge("engine_queue_depth").set(len(self._waiting))
 
     def _drain_queue(self):
+        admitted = False
         while self._free and self._waiting:
-            self._admit_timed(*self._waiting.pop(0))
+            self._admit_timed(*self._waiting.pop(0), refresh_kv=False)
+            admitted = True
         self._set_queue_gauge()
+        if admitted:
+            self._set_kv_gauges()   # one ledger rebuild per drain
 
     def take_waiting(self) -> List[tuple]:
         """Pop and return the whole waiting queue (FIFO order) as
@@ -391,6 +418,7 @@ class _SlotScheduler:
                 self.metrics.gauge("engine_live").set(len(self._by_slot))
                 self.metrics.gauge("engine_occupancy").set(
                     len(self._by_slot) / self.slots)
+                self._set_kv_gauges()   # the slot's KV row is waste now
                 return True
         return False
 
@@ -426,11 +454,67 @@ class _SlotScheduler:
         The paged-KV refactor (ROADMAP item 1) is judged against this
         number — it is recomputed from the live buffers, so a layout
         change cannot silently stop being counted."""
-        import jax
-        return int(sum(
-            leaf.nbytes for buf in self._kv_buffers()
-            for leaf in jax.tree_util.tree_leaves(buf)
-            if hasattr(leaf, "nbytes")))
+        return sum(_tree_nbytes(buf) for buf in self._kv_buffers())
+
+    # -- KV fragmentation ledger (PR 13) -------------------------------
+    # ``kv_cache_bytes`` says what the engine ALLOCATED; the paged-KV
+    # refactor is really judged on what it WASTES — capacity positions
+    # reserved for a slot beyond what its request's cur_len occupies
+    # (plus whole rows held by free slots and unregistered pool rows).
+    # Everything here is computed from host-side mirrors (the request
+    # records' prompt_len + generated, which track the device cur_len
+    # exactly) and leaf .nbytes — zero device syncs, zero new prims in
+    # any jitted graph.
+
+    def _kv_usage(self):
+        """(slot_entries, pool_entries) — subclass hook; each entry
+        carries at least ``used_bytes`` / ``kv_waste_bytes`` ints."""
+        return [], []
+
+    def kv_fragmentation(self) -> Dict[str, Any]:
+        """The full per-slot ledger: allocated / used / wasted bytes,
+        the utilization fraction, and one entry per slot (and prefix
+        pool row) naming what occupies it — the number ROADMAP item
+        1's paged allocator must drive down, per slot so the dashboard
+        can see WHERE the waste sits."""
+        total = self.kv_cache_bytes()
+        slots, pools = self._kv_usage()
+        used = min(int(sum(e["used_bytes"] for e in slots)
+                       + sum(e["used_bytes"] for e in pools)), total)
+        return {"kv_cache_bytes": total,
+                "kv_used_bytes": used,
+                "kv_waste_bytes": total - used,
+                "kv_utilization": (used / total if total else 0.0),
+                "slots": slots, "pools": pools}
+
+    def kv_waste_bytes(self) -> int:
+        """Allocated-but-unused KV bytes right now (see
+        :meth:`kv_fragmentation`)."""
+        return self.kv_fragmentation()["kv_waste_bytes"]
+
+    def kv_utilization(self) -> float:
+        """Used / allocated KV bytes in [0, 1] (0.0 on an engine with
+        no KV state)."""
+        return self.kv_fragmentation()["kv_utilization"]
+
+    def _set_kv_gauges(self) -> Dict[str, Any]:
+        """Refresh the fragmentation gauges from one ledger snapshot;
+        wired at the same mutation points as ``engine_queue_depth``
+        (admission, window harvest, cancel), so gauge == stats()
+        through submit/step/cancel/eos — the fleet tests pin queue
+        depth that way and the serving tests pin these the same way."""
+        frag = self.kv_fragmentation()
+        self.metrics.gauge(
+            "engine_kv_waste_bytes",
+            help="allocated-but-unused KV bytes (slot capacity beyond "
+                 "cur_len, free slots, empty pool rows) — ROADMAP "
+                 "item 1's fragmentation needle").set(
+            frag["kv_waste_bytes"])
+        self.metrics.gauge(
+            "engine_kv_utilization",
+            help="used / allocated KV bytes of this engine's "
+                 "buffers").set(frag["kv_utilization"])
+        return frag
 
     def stats(self) -> Dict[str, Any]:
         """Scheduler + telemetry snapshot.  The four original counters
@@ -449,9 +533,16 @@ class _SlotScheduler:
         ``device_live_bytes`` gauge), and HBM occupancy where the
         backend reports real memory stats (``hbm_bytes_in_use`` /
         ``hbm_bytes_limit`` / ``hbm_occupancy``; None on CPU-style
-        backends — the live census is the portable signal there)."""
+        backends — the live census is the portable signal there).
+
+        Fragmentation fields (PR 13): ``kv_waste_bytes`` /
+        ``kv_utilization`` from the same ledger snapshot the
+        ``engine_kv_waste_bytes`` / ``engine_kv_utilization`` gauges
+        are set from — gauge == stats() by construction (the
+        queue-depth pinning discipline)."""
         from .observability import memory as obs_memory
-        kv = self.kv_cache_bytes()
+        frag = self._set_kv_gauges()
+        kv = frag["kv_cache_bytes"]
         self.metrics.gauge(
             "engine_kv_cache_bytes",
             help="device bytes held by this engine's KV buffers"
@@ -464,6 +555,8 @@ class _SlotScheduler:
                      and hw.get("bytes_in_use") is not None else None)
         return {"live": len(self._by_slot),
                 "kv_cache_bytes": kv,
+                "kv_waste_bytes": frag["kv_waste_bytes"],
+                "kv_utilization": frag["kv_utilization"],
                 "device_live_bytes": census["bytes"],
                 "hbm_bytes_in_use": hw.get("bytes_in_use") if hw else None,
                 "hbm_bytes_limit": hw.get("bytes_limit") if hw else None,
@@ -824,6 +917,10 @@ class Engine(_SlotScheduler):
             jnp.arange(slots))
         self._slot_temp = jnp.full((slots,), float(temperature),
                                    jnp.float32)
+        # the prefix-pool/draft allocations above postdate
+        # _init_scheduler's first ledger snapshot — refresh so the
+        # gauges cover the full allocation from birth
+        self._set_kv_gauges()
 
     # -- request lifecycle -------------------------------------------------
     def register_prefix(self, tokens: Sequence[int]) -> int:
@@ -844,6 +941,7 @@ class Engine(_SlotScheduler):
             self._pool_cache, self._pool_d_cache, idx,
             jnp.asarray(row))
         self._prefixes.append(tuple(int(t) for t in tokens))
+        self._set_kv_gauges()           # the pool row is occupied now
         return idx
 
     def _match_prefix(self, prompt):
@@ -995,6 +1093,50 @@ class Engine(_SlotScheduler):
                 bufs.append(buf)
         return bufs
 
+    def _kv_usage(self):
+        """Per-slot / per-pool-row KV occupancy, from host mirrors
+        only: a live request's used positions are ``prompt_len +
+        len(generated)`` (the exact host twin of the device
+        ``cur_len``), capped at the slot's position capacity —
+        ``buf_len``, or the ring width for a rolling engine (the ring
+        never holds more than W positions, so a long request *fully*
+        uses its O(window) row).  Slot and draft caches share the same
+        position axis, so one per-position byte price covers both."""
+        cap = self._window if self.rolling else self.buf_len
+        slot_bytes = _tree_nbytes(self.cache)
+        if getattr(self, "d_cache", None) is not None:
+            slot_bytes += _tree_nbytes(self.d_cache)
+        per_pos = slot_bytes / (self.slots * cap) if self.slots else 0.0
+        row_bytes = int(round(per_pos * cap))
+        slots = []
+        for slot in range(self.slots):
+            req = self._by_slot.get(slot)
+            used_pos = (min(req.prompt_len + len(req.generated), cap)
+                        if req is not None else 0)
+            used_b = int(round(per_pos * used_pos))
+            slots.append({"slot": slot,
+                          "rid": req.rid if req is not None else None,
+                          "used_positions": used_pos,
+                          "capacity_positions": cap,
+                          "used_bytes": used_b,
+                          "kv_waste_bytes": row_bytes - used_b})
+        pools = []
+        if getattr(self, "prefix_pool", 0):
+            pool_bytes = _tree_nbytes(self._pool_cache)
+            if self._pool_d_cache is not None:
+                pool_bytes += _tree_nbytes(self._pool_d_cache)
+            per_pool_pos = pool_bytes / (self.prefix_pool * self.buf_len)
+            pool_row = int(round(per_pool_pos * self.buf_len))
+            for i in range(self.prefix_pool):
+                used_pos = (min(len(self._prefixes[i]), self.buf_len)
+                            if i < len(self._prefixes) else 0)
+                used_b = int(round(per_pool_pos * used_pos))
+                pools.append({"row": i, "used_positions": used_pos,
+                              "capacity_positions": self.buf_len,
+                              "used_bytes": used_b,
+                              "kv_waste_bytes": pool_row - used_b})
+        return slots, pools
+
     def stats(self) -> Dict[str, Any]:
         """Base snapshot plus prefix-cache effectiveness: splice
         admissions so far and the hit rate over all admissions (0.0 on
@@ -1099,6 +1241,57 @@ class Seq2SeqEngine(_SlotScheduler):
     def _kv_buffers(self):
         # per-slot seq2seq state: cross-attention K/V + decoder cache
         return [self.state]
+
+    def _kv_usage(self):
+        """Per-slot occupancy over the two seq2seq residents: the
+        ``cross`` subtree is cross-attention K/V (used up to the
+        request's source length), the ``dec`` subtree is the decoder
+        self-attention cache (used up to its generated count);
+        remaining per-slot state (e.g. the source mask) counts as used
+        while the slot is live.  Classified by the state's own subtree
+        keys (``init_seq2seq_state``'s contract) — an axis-value
+        heuristic would misclassify whenever ``src_len ==
+        max_new_cap`` — with a shape-based fallback for state pytrees
+        that don't follow the key convention."""
+        if isinstance(self.state, dict) and "cross" in self.state \
+                and "dec" in self.state:
+            cross = _tree_nbytes(self.state["cross"])
+            dec = _tree_nbytes(self.state["dec"])
+            other = _tree_nbytes(self.state) - cross - dec
+        else:
+            cross = dec = other = 0
+            for leaf in jax.tree_util.tree_leaves(self.state):
+                shape = getattr(leaf, "shape", ())
+                nb = getattr(leaf, "nbytes", 0)
+                if len(shape) >= 2 and self.src_len in shape[1:]:
+                    cross += nb
+                elif len(shape) >= 2 and self.max_new_cap in shape[1:]:
+                    dec += nb
+                else:
+                    other += nb
+        slots = []
+        for slot in range(self.slots):
+            req = self._by_slot.get(slot)
+            if req is not None:
+                src_pos = min(req.prompt_len, self.src_len)
+                dec_pos = min(len(req.generated), self.max_new_cap)
+                live = 1.0
+            else:
+                src_pos = dec_pos = 0
+                live = 0.0
+            used_b = int(round(
+                cross * src_pos / (self.slots * self.src_len)
+                + dec * dec_pos / (self.slots * self.max_new_cap)
+                + other * live / self.slots))
+            cap_b = int(round((cross + dec + other) / self.slots))
+            slots.append({"slot": slot,
+                          "rid": req.rid if req is not None else None,
+                          "used_positions": src_pos + dec_pos,
+                          "capacity_positions": (self.src_len
+                                                 + self.max_new_cap),
+                          "used_bytes": used_b,
+                          "kv_waste_bytes": cap_b - used_b})
+        return slots, []
 
     def _check_prompt(self, src):
         if len(src) < 1 or len(src) > self.src_len:

@@ -10,12 +10,14 @@ ranges); this module provides both behind the reference's push/pop shape.
 
 from .profiler import (range_push, range_pop, nvtx_range, annotate,
                        start_profile, stop_profile, profile,
-                       profiling_active, AverageMeter)
+                       profiling_active, current_capture_dir,
+                       last_capture_dir, AverageMeter)
 from .checkpoint import (save_checkpoint, restore_checkpoint, latest_step,
                          available_steps)
 from . import ema
 
 __all__ = ["ema", "range_push", "range_pop", "nvtx_range", "annotate",
            "start_profile", "stop_profile", "profile", "profiling_active",
+           "current_capture_dir", "last_capture_dir",
            "AverageMeter", "save_checkpoint", "restore_checkpoint",
            "latest_step", "available_steps"]

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import itertools
+import os
 import threading
 from typing import Optional
 
@@ -25,7 +27,7 @@ import jax
 
 __all__ = ["range_push", "range_pop", "nvtx_range", "annotate",
            "start_profile", "stop_profile", "profile", "profiling_active",
-           "AverageMeter"]
+           "current_capture_dir", "last_capture_dir", "AverageMeter"]
 
 _tls = threading.local()
 
@@ -89,33 +91,65 @@ def annotate(name: Optional[str] = None):
 # OUTER window on inner exit.
 _trace_lock = threading.Lock()
 _trace_depth = 0
+# Every outermost window captures into a UNIQUE subdirectory of the
+# requested logdir: start_trace names its session dir by wall-clock
+# SECOND, so repeated captures into one shared logdir used to land in
+# the same session dir and overwrite each other's trace files — the
+# timeline parser (observability.timeline) needs unambiguous capture
+# dirs.  pid + a process-local counter keeps the names unique across
+# forks and across captures.
+_capture_dir: Optional[str] = None
+_capture_seq = itertools.count()
 
 
-def start_profile(logdir: str = "/tmp/apex_tpu_profile") -> None:
+def start_profile(logdir: str = "/tmp/apex_tpu_profile") -> str:
     """Begin an xprof trace window (cudaProfilerStart parity,
     main_amp.py:329).  Reentrant: only the outermost call starts the
-    trace; nested calls increment the window refcount and no-op."""
-    global _trace_depth
+    trace; nested calls increment the window refcount and no-op.
+    Returns the window's unique capture directory (a fresh
+    ``capture_<pid>_<n>`` subdirectory of ``logdir`` per outermost
+    window); a nested call joins the outer window and returns ITS
+    directory — the nested ``logdir`` argument is ignored, exactly as
+    its start/stop always was."""
+    global _trace_depth, _capture_dir
     with _trace_lock:
         if _trace_depth == 0:
+            cap = os.path.join(
+                logdir, f"capture_{os.getpid()}_{next(_capture_seq):04d}")
+            os.makedirs(cap, exist_ok=True)
             # start first, increment after: a failed start_trace (e.g. a
             # foreign trace already active) must not leave a phantom
-            # refcount that makes every later call a silent no-op
-            jax.profiler.start_trace(logdir)
+            # refcount that makes every later call a silent no-op —
+            # nor an orphaned empty capture dir (a monitor retrying
+            # /profilez against a long-lived foreign trace would grow
+            # one per attempt)
+            try:
+                jax.profiler.start_trace(cap)
+            except BaseException:
+                try:
+                    os.rmdir(cap)       # still empty: nothing traced
+                except OSError:
+                    pass
+                raise
+            _capture_dir = cap
         _trace_depth += 1
+        return _capture_dir
 
 
-def stop_profile() -> None:
+def stop_profile() -> Optional[str]:
     """End the trace window (cudaProfilerStop parity, main_amp.py:351).
-    Only the outermost matching call stops the trace; an unmatched stop
-    is a no-op."""
+    Only the outermost matching call stops the trace (and returns the
+    finished window's capture directory); an inner or unmatched stop is
+    a no-op returning None."""
     global _trace_depth
     with _trace_lock:
         if _trace_depth == 0:
-            return
+            return None
         _trace_depth -= 1
         if _trace_depth == 0:
             jax.profiler.stop_trace()
+            return _capture_dir
+        return None
 
 
 def profiling_active() -> bool:
@@ -124,14 +158,32 @@ def profiling_active() -> bool:
         return _trace_depth > 0
 
 
+def current_capture_dir() -> Optional[str]:
+    """The ACTIVE window's unique capture directory (None when no
+    window is open)."""
+    with _trace_lock:
+        return _capture_dir if _trace_depth > 0 else None
+
+
+def last_capture_dir() -> Optional[str]:
+    """The most recent window's capture directory — still set after
+    ``stop_profile``, which is when the trace file exists and the
+    timeline parser wants it.  None before the first window."""
+    with _trace_lock:
+        return _capture_dir
+
+
 @contextlib.contextmanager
 def profile(logdir: str = "/tmp/apex_tpu_profile"):
     """Context-manager trace window; nesting-safe — an inner profile()
     joins the outer window instead of racing jax.profiler.start_trace
-    or closing the outer window early."""
-    start_profile(logdir)
+    or closing the outer window early.  Yields the window's unique
+    capture directory (parse it with
+    ``observability.timeline.analyze_capture`` AFTER the block exits —
+    the trace file is written at stop)."""
+    cap = start_profile(logdir)
     try:
-        yield
+        yield cap
     finally:
         stop_profile()
 

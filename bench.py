@@ -239,13 +239,23 @@ def main():
     # sequence are identical to an undisturbed run before emitting the
     # trend-gated chaos_preempt_resume overhead/MTTR line; plus the
     # `kind: recovery` and `kind: fleet` records, all schema-v7 gated.
+    # --profile: device-time truth (PR 13) — capture the O2 DDP train
+    # step (flat vs hierarchical gradient comm) and the windowed
+    # decode engine under jax.profiler, parse the Chrome trace with
+    # observability.timeline, and emit `kind: profile` records whose
+    # overlap_fraction is MEASURED from kernel-interval overlap on the
+    # device timeline (not host-differenced): the comm-visible ms per
+    # topology is ROADMAP item 2's baseline line, and the engine
+    # record carries the KV fragmentation pair (kv_waste_bytes +
+    # kv_utilization) item 1's paged allocator must drive down.
     # Precedence when combined: --fleet > --comm > --numerics
-    # > --run > --chaos; --graph-lint composes with all of them and
-    # still gates the exit status.
+    # > --run > --chaos > --profile; --graph-lint composes with all of
+    # them and still gates the exit status.
     comm_flag = "--comm" in sys.argv
     numerics_flag = "--numerics" in sys.argv
     run_flag = "--run" in sys.argv
     chaos_flag = "--chaos" in sys.argv
+    profile_flag = "--profile" in sys.argv
 
     fleet_n = 0
     if "--fleet" in sys.argv:
@@ -1149,6 +1159,132 @@ def main():
         # --graph-lint (if also passed) already ran and still gates
         return 1 if lint_errors else 0
 
+    def run_profile_bench():
+        """Device-timeline bench: everything here is parsed out of the
+        Chrome trace jax.profiler writes — measured device time, not
+        host differencing.  Warmup (compile) happens OUTSIDE the
+        capture window so the trace holds only warm steps; the blocked
+        fetch rides INSIDE it so every dispatched kernel lands before
+        stop_trace.  Module-filtered to the step's own HLO module so
+        the fetch plumbing never attributes as step time."""
+        from apex_tpu.observability import timeline
+        from apex_tpu.utils import profiler as prof
+
+        iters, warmup = (10, 3) if on_tpu else (3, 1)
+
+        # -- (1) O2 DDP train step, flat vs hierarchical comm ---------
+        ici = (ndev // jax.process_count() if jax.process_count() > 1
+               else max((d for d in range(2, ndev)
+                         if ndev % d == 0), default=1))
+        Bc, image = (32, 96) if on_tpu else (4, 32)
+        B = Bc * ndev
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(B, 3, image, image), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, B), jnp.int32)
+        variants = [("flat", {})]
+        if ici >= 2:
+            variants.append(("hier", {"comm_topology": "hierarchical",
+                                      "ici_size": ici}))
+        else:
+            print(f"bench --profile: {ndev} device(s) admit no "
+                  f"2-level split; hierarchical variant skipped",
+                  file=sys.stderr)
+        for name, ddp_kw in variants:
+            model, opt = amp.initialize(
+                models.resnet18(num_classes=10),
+                optimizers.FusedAdam(1e-3), opt_level="O2",
+                verbosity=0)
+            ddp = parallel.DistributedDataParallel(model, **ddp_kw)
+            params, bn = model.init(jax.random.PRNGKey(0))
+            ost = opt.init(params)
+            step = make_resnet_step(model, opt, ddp)
+            train = sharded(step)
+            state = (params, bn, ost)
+            for _ in range(warmup):
+                state, out = train(state, (x, y))
+            float(jnp.sum(out))
+            att = timeline.capture(
+                lambda s: train(s, (x, y)), state, iters=iters,
+                modules=("jit_step",))
+            comm_visible = round(
+                max(att["collective_ms"] - att["overlap_ms"], 0.0), 4)
+            emit(**timeline.profile_record(
+                att, metric=f"resnet18_o2_ddp_{name}_profile",
+                comm_visible_ms=comm_visible, opt_level="O2",
+                note=f"resnet18 O2 DDP step ({name} gradient comm), "
+                     f"{iters} warm steps captured under "
+                     f"jax.profiler; overlap measured from kernel-"
+                     f"interval overlap on the device timeline — the "
+                     f"trustworthy ROADMAP-item-2 needle"
+                     + ("; CPU mesh: virtual devices share one host, "
+                        "so the measured overlap reflects thread "
+                        "scheduling, not fabric concurrency"
+                        if not on_tpu else "")))
+            emit(metric=f"profile_ddp_o2_{name}_comm_visible_ms",
+                 value=comm_visible, unit="ms", vs_baseline=None,
+                 measured_overlap_fraction=att[
+                     "measured_overlap_fraction"],
+                 device_busy_ms=att["device_busy_ms"],
+                 note=f"collective time NOT hidden under compute on "
+                      f"the measured device timeline ({name}); the "
+                      f"item-2 overlap work must drive this toward 0 "
+                      f"while step time holds")
+
+        # -- (2) windowed decode engine: timeline + KV fragmentation --
+        from apex_tpu import serving
+        cfg = models.GPTConfig(vocab_size=128, block_size=32,
+                               n_layer=2, n_head=4, n_embd=32,
+                               dropout=0.0)
+        gmodel = models.GPT(cfg)
+        gparams, _ = gmodel.init(jax.random.PRNGKey(0))
+        gparams = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, gparams)
+        window, slots = 8, 4
+        eng = serving.Engine(gmodel, gparams, slots=slots,
+                             buf_len=cfg.block_size, window=window)
+        # HALF the slots occupied with short prompts: the partially-
+        # filled shape whose nonzero kv_waste_bytes the acceptance
+        # criteria pin — free slots waste whole rows, live slots waste
+        # the capacity beyond their cur_len.  The token budget outlasts
+        # the 3 captured+warm windows (24 ticks < 26) so the requests
+        # are still LIVE when the ledger is read.
+        for _ in range(slots // 2):
+            eng.add_request([1, 2, 3, 4], max_new_tokens=26)
+        eng.step()                          # warm/compile
+        with prof.profile() as cap:
+            for _ in range(2):
+                eng.step()
+        att = timeline.analyze_capture(cap, modules=("_step_k",),
+                                       steps=2)
+        s = eng.stats()
+        emit(**timeline.profile_record(
+            att, metric="gpt_tiny_engine_w8_profile",
+            window=window,
+            kv_cache_bytes=s["kv_cache_bytes"],
+            kv_waste_bytes=s["kv_waste_bytes"],
+            kv_utilization=round(s["kv_utilization"], 4),
+            note=f"windowed decode engine ({slots // 2}/{slots} slots "
+                 f"live, window={window}): device timeline of 2 decode "
+                 f"windows + the KV fragmentation ledger — "
+                 f"kv_waste_bytes is what ROADMAP item 1's paged "
+                 f"allocator must drive down"))
+        emit(metric="gpt_tiny_engine_w8_kv_waste_bytes",
+             value=s["kv_waste_bytes"], unit="bytes",
+             vs_baseline=None, window=window,
+             kv_cache_bytes=s["kv_cache_bytes"],
+             kv_waste_bytes=s["kv_waste_bytes"],
+             kv_utilization=round(s["kv_utilization"], 4),
+             note=f"allocated-but-unused KV bytes on the half-filled "
+                  f"windowed engine (utilization "
+                  f"{s['kv_utilization']:.3f}); the fixed-slot "
+                  f"baseline the paged allocator is judged against")
+
+    if profile_flag and not fleet_n:
+        run_profile_bench()
+        # --graph-lint (if also passed) already ran and still gates
+        return 1 if lint_errors else 0
+
     def timed_scan(ddp, step, state, arrays, per_step_shapes, K, iters,
                    warmup, metric=None):
         """Build the make_step trainer and time one optimizer step.
@@ -1455,6 +1591,8 @@ def main():
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
              kv_cache_bytes=s["kv_cache_bytes"],
+             kv_waste_bytes=s["kv_waste_bytes"],
+             kv_utilization=round(s["kv_utilization"], 4),
              tokens_per_sync=round(s["tokens_per_sync"], 2),
              note=f"continuous batching, {slots} slots, decode window="
                   f"{window} (host syncs 1/{window} per token), prompt="
@@ -1498,9 +1636,12 @@ def main():
             while eng._free:
                 admit()
         dt = time.perf_counter() - t0
+        s = eng.stats()
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
-             kv_cache_bytes=eng.stats()["kv_cache_bytes"],
+             kv_cache_bytes=s["kv_cache_bytes"],
+             kv_waste_bytes=s["kv_waste_bytes"],
+             kv_utilization=round(s["kv_utilization"], 4),
              note=f"seq2seq continuous batching, {slots} slots, "
                   f"decode window={window}, src<={src_len}, "
                   f"{new_tokens} new/request, encoder pass per "

@@ -30,12 +30,18 @@ cross-checks, divergence consistency), and training-run supervisor
 verdicts (``kind: run``, from ``bench.py --run`` /
 ``RunSupervisor.record``) against the run schema
 (``validate_run_record``: known anomaly kinds, verdict-vs-counts
-consistency); at schema v3 fresh train-throughput lines must carry
-the MFU fields and fresh engine-decode lines ``kv_cache_bytes``, at
-v4 fresh ``numerics_overhead_*`` lines the on/off step times, at v5
-fresh ``run_supervisor_overhead*`` lines the same on/off pair, and
-``kind: fleet`` records may carry the SLO/goodput + deadline-sweep
-fields (validated whenever present).  All
+consistency), and device-timeline attributions (``kind: profile``,
+from ``bench.py --profile`` / ``/profilez``) against the profile
+schema (``validate_profile_record``: interval arithmetic — busy
+within span, overlap inside both class unions, the measured fraction
+equal to its own sides); at schema v3 fresh train-throughput lines
+must carry the MFU fields and fresh engine-decode lines
+``kv_cache_bytes``, at v4 fresh ``numerics_overhead_*`` lines the
+on/off step times, at v5 fresh ``run_supervisor_overhead*`` lines the
+same on/off pair, ``kind: fleet`` records may carry the SLO/goodput +
+deadline-sweep fields (validated whenever present), and at v8 fresh
+engine-decode lines the KV fragmentation pair (``kv_waste_bytes`` /
+``kv_utilization``).  All
 record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
@@ -43,6 +49,7 @@ record families may interleave in one stream.  Usage:
     python bench.py --comm --graph-lint \
         | python tests/ci/check_bench_schema.py
     python bench.py --run | python tests/ci/check_bench_schema.py
+    python bench.py --profile | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
     python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
 

@@ -40,9 +40,11 @@ neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
 measured anything).  Error lines (``value: null`` + ``error``) and
 flag/summary records are likewise excluded, as are per-run
-``kind: numerics`` gradient-health dumps (schema v4) and per-run
-``kind: run`` supervisor verdicts (schema v5) — their stale replays
-still count toward the partition tally.  The ``run_supervisor_overhead``
+``kind: numerics`` gradient-health dumps (schema v4), per-run
+``kind: run`` supervisor verdicts (schema v5), per-run
+``kind: recovery`` controller snapshots (schema v6) and per-capture
+``kind: profile`` device-timeline attributions (schema v8) — their
+stale replays still count toward the partition tally.  The ``run_supervisor_overhead``
 and ``fleet_goodput`` *metric* lines from ``bench.py --run`` are
 ordinary measurements and DO trend (accelerator gates, CPU warns).
 
@@ -250,10 +252,14 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             # records (controller snapshots from bench --chaos,
             # schema v6) are the same shape of story: the METRIC
             # lines next to them (chaos_mttr*, chaos_spike*) carry
-            # the cross-round trend.
+            # the cross-round trend.  ``kind: profile`` records
+            # (device-timeline attributions from bench --profile /
+            # /profilez, schema v8) likewise describe one capture —
+            # the profile_* metric lines next to them trend.
             if isinstance(rec, dict) and rec.get("kind") in ("numerics",
                                                              "run",
-                                                             "recovery"):
+                                                             "recovery",
+                                                             "profile"):
                 if is_stale(rec):
                     n_stale += 1
                 continue

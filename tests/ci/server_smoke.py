@@ -11,7 +11,9 @@ content — including label values that NEED exposition escaping — then:
 
 1. starts :class:`ObservabilityServer` on ``127.0.0.1:0``;
 2. scrapes ``/healthz`` ``/metricsz`` ``/statusz`` ``/flightz``
-   ``/tracez`` (and ``/tracez?trace_id=``) over real HTTP;
+   ``/tracez`` (and ``/tracez?trace_id=``) over real HTTP, plus the
+   ``/profilez`` no-capture shape — with no profiler hook attached
+   (the jax-free deployment) the endpoint must answer 404, never 500;
 3. validates ``/metricsz`` against the exposition-format conformance
    checker (``validate_prometheus_text``: TYPE/HELP lines, label
    escaping round-trip, +Inf buckets, cumulative monotonicity);
@@ -156,6 +158,22 @@ def main(argv):
         if code != 404:
             errs.append(f"/tracez unknown trace expected 404, got {code}")
 
+        # /profilez — no profiler hook attached (this loader is
+        # jax-free by design): 404 with a JSON error, not a 500
+        code, _, body = _get(base + "/profilez")
+        if code != 404:
+            errs.append(f"/profilez with no hook expected 404, got "
+                        f"{code}")
+        else:
+            pz = json.loads(body)
+            if "error" not in pz:
+                errs.append(f"/profilez 404 body carries no error: "
+                            f"{pz}")
+        code, _, _ = _get(base + "/profilez?duration_ms=bogus")
+        if code != 400:
+            errs.append(f"/profilez with bad duration expected 400, "
+                        f"got {code}")
+
         # sick supervisor flips /healthz to 503
         sup.observe_step(step=1, loss=float("nan"))
         code, _, body = _get(base + "/healthz")
@@ -170,8 +188,8 @@ def main(argv):
         print(f"server_smoke: {e}", file=sys.stderr)
     if errs:
         return 1
-    print("server_smoke: all 5 endpoints OK (exposition conformant, "
-          "schemas valid, sick-run 503)")
+    print("server_smoke: all 6 endpoints OK (exposition conformant, "
+          "schemas valid, profilez no-capture 404, sick-run 503)")
     return 0
 
 

@@ -812,7 +812,9 @@ def test_telemetry_jsonl_validates_mixed_stream():
         {"metric": "engine_decode", "value": 100.0,
          "unit": "tokens/sec", "backend": "cpu", "ndev": 1,
          "arch": "gpt", "window": 8, "tokens_per_sync": 8.0,
-         "kv_cache_bytes": 65536})    # required fresh at schema v3
+         "kv_cache_bytes": 65536,     # required fresh at schema v3
+         # the kv fragmentation pair, required fresh at schema v8
+         "kv_waste_bytes": 16384, "kv_utilization": 0.75})
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
     fleet_rec = exporters.JsonlExporter.enrich(

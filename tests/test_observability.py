@@ -791,7 +791,9 @@ def test_bench_record_schema_serving_decode_window_fields():
     base = {"metric": "gpt_tiny_engine_decode_throughput", "value": 9.0,
             "unit": "tokens/sec/chip", "vs_baseline": None,
             "backend": "cpu", "ndev": 8, "arch": "cpu",
-            "kv_cache_bytes": 16384}    # required fresh at schema v3
+            "kv_cache_bytes": 16384,    # required fresh at schema v3
+            # required fresh at schema v8 (KV fragmentation pair)
+            "kv_waste_bytes": 4096, "kv_utilization": 0.75}
     good = exporters.JsonlExporter.enrich(
         dict(base, window=8, tokens_per_sync=7.5))
     assert exporters.validate_bench_record(good) == []
@@ -804,7 +806,21 @@ def test_bench_record_schema_serving_decode_window_fields():
     assert any("kv_cache_bytes" in e
                for e in exporters.validate_bench_record(
                    exporters.JsonlExporter.enrich(dict(nokv, window=8))))
-    # ...but an archived v2 line stays valid at its declared version
+    # missing the fragmentation pair on a fresh v8 decode line (PR 13)
+    for key in ("kv_waste_bytes", "kv_utilization"):
+        nofrag = {k: v for k, v in base.items() if k != key}
+        assert any(key in e
+                   for e in exporters.validate_bench_record(
+                       exporters.JsonlExporter.enrich(
+                           dict(nofrag, window=8)))), key
+    # ...but an archived v7 line without the pair stays valid at its
+    # declared version, as does an archived v2 line without any of it
+    v7 = exporters.JsonlExporter.enrich(
+        dict({k: v for k, v in base.items()
+              if k not in ("kv_waste_bytes", "kv_utilization")},
+             window=8))
+    v7["schema_version"] = 7
+    assert exporters.validate_bench_record(v7) == []
     v2 = exporters.JsonlExporter.enrich(dict(nokv, window=8))
     v2["schema_version"] = 2
     assert exporters.validate_bench_record(v2) == []
@@ -820,6 +836,14 @@ def test_bench_record_schema_serving_decode_window_fields():
     bad = exporters.JsonlExporter.enrich(
         dict(base, window=8, kv_cache_bytes=-5))
     assert any("kv_cache_bytes" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = exporters.JsonlExporter.enrich(
+        dict(base, window=8, kv_waste_bytes=999_999))   # > cache
+    assert any("kv_waste_bytes" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = exporters.JsonlExporter.enrich(
+        dict(base, window=8, kv_utilization=1.2))
+    assert any("kv_utilization" in e
                for e in exporters.validate_bench_record(bad))
     # a windowed line must report tokens/sec
     bad = exporters.JsonlExporter.enrich(
@@ -1738,3 +1762,57 @@ def test_v7_requirements_gate_on_declared_version():
          "actions": [{"kind": "preempt_snapshot", "episode": 1,
                       "t_s": 0.5}]})
     assert exporters.validate_recovery_record(act) == []
+
+
+def test_v8_profile_records_and_version_gating():
+    """Schema v8: ``kind: profile`` records dispatch to their own
+    validator, and the engine-decode kv-fragmentation requirement
+    gates on the DECLARED version — archived v7-and-earlier streams
+    re-validate clean (the full archived-stream sweep rides
+    test_check_bench_trend_gate's real BENCH_r*.json files through
+    check_bench_schema)."""
+    prof = exporters.JsonlExporter.enrich(
+        {"kind": "profile", "metric": "resnet18_o2_ddp_flat_profile",
+         "span_ms": 10.0, "device_busy_ms": 8.0, "compute_ms": 7.0,
+         "collective_ms": 3.0, "gap_ms": 2.0, "overlap_ms": 2.0,
+         "measured_overlap_fraction": 0.6667, "kernel_count": 42,
+         "lane_count": 8, "steps": 3,
+         "top_kernels": [{"name": "all-reduce", "kind": "collective",
+                          "count": 24, "total_ms": 3.0}]})
+    assert prof["schema_version"] >= 8
+    assert exporters.validate_profile_record(prof) == []
+    # the dispatcher routes on kind — the same record through the
+    # telemetry validator hits the profile schema, not the bench one
+    assert exporters.validate_telemetry_record(prof) == []
+    broken = dict(prof, device_busy_ms=99.0)
+    assert exporters.validate_telemetry_record(broken) != []
+    # a mixed stream with a profile line stays check_bench_schema clean
+    bench_line = exporters.JsonlExporter.enrich(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": None,
+         "backend": "cpu", "ndev": 8, "arch": "cpu"})
+    assert exporters.validate_telemetry_jsonl(
+        [json.dumps(prof), json.dumps(bench_line)]) == []
+
+
+def test_check_bench_trend_partitions_profile_records(tmp_path):
+    """kind: profile device-timeline attributions are per-capture
+    stories, not a cross-round trend: a later round's worse split
+    must not read as a metric regression, and stale replays count
+    toward the partition tally (the numerics/run/recovery rule)."""
+    def profrec(busy, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"kind": "profile", "metric": "resnet18_o2_ddp_profile",
+             "backend": "cpu", "span_ms": busy + 1.0,
+             "device_busy_ms": busy, "compute_ms": busy,
+             "collective_ms": 0.0, "gap_ms": 1.0, "overlap_ms": 0.0,
+             "measured_overlap_fraction": 0.0, **kw})
+
+    d = tmp_path / "prof1"
+    d.mkdir()
+    _trend_round(d, "BENCH_r01.json", [profrec(5.0)])
+    _trend_round(d, "BENCH_r02.json", [profrec(50.0),
+                                       profrec(5.0, stale=True)])
+    r = _run_trend(["--dir", str(d)])
+    assert r.returncode == 0, r.stderr
+    assert "0 fresh measurements counted" in r.stderr
+    assert "1 stale replays partitioned out" in r.stderr

@@ -784,3 +784,190 @@ def test_seq2seq_windowed_matches_solo_and_k1():
     while eng.live():
         eng.step()
     assert eng.result(r5) == solo(pb, 5)
+
+
+# -- KV fragmentation ledger (PR 13) ---------------------------------------
+# ``kv_cache_bytes`` said what the engine allocated; these pin what it
+# WASTES — the number ROADMAP item 1's paged allocator must drive down
+# — and that the ``engine_kv_waste_bytes`` / ``engine_kv_utilization``
+# gauges track stats() through submit/step/cancel/eos exactly the way
+# the fleet tests pin ``engine_queue_depth``.
+
+def _kv_gauges(eng):
+    return (eng.metrics.gauge("engine_kv_waste_bytes").value,
+            eng.metrics.gauge("engine_kv_utilization").value)
+
+
+def _assert_kv_pinned(eng):
+    """Gauges (set at the last mutation) must equal a fresh ledger AND
+    the stats() fields — read the gauges FIRST so a lazy stats()-only
+    refresh would be caught."""
+    g_waste, g_util = _kv_gauges(eng)
+    frag = eng.kv_fragmentation()
+    assert g_waste == frag["kv_waste_bytes"]
+    assert g_util == frag["kv_utilization"]
+    s = eng.stats()
+    assert s["kv_waste_bytes"] == frag["kv_waste_bytes"]
+    assert s["kv_utilization"] == frag["kv_utilization"]
+    assert 0.0 <= s["kv_utilization"] <= 1.0
+    assert 0 <= s["kv_waste_bytes"] <= s["kv_cache_bytes"]
+    # the ledger reassembles: used + waste == allocated, and the
+    # per-slot entries sum to the used side (up to the total clamp)
+    assert frag["kv_used_bytes"] + frag["kv_waste_bytes"] \
+        == frag["kv_cache_bytes"]
+    return frag
+
+
+def test_kv_fragmentation_through_lifecycle():
+    """Empty engine: all waste.  Admission: waste drops by the prompt's
+    KV rows.  Decode: waste shrinks token by token.  EOS/cancel: the
+    slot's rows return to waste.  Gauge == stats() at every stage."""
+    m, params = _gpt(7)
+    eng = serving.Engine(m, params, slots=2, buf_len=24)
+    frag = _assert_kv_pinned(eng)
+    total = frag["kv_cache_bytes"]
+    assert total > 0
+    # nothing admitted: the whole allocation is waste
+    assert frag["kv_waste_bytes"] == total
+    assert frag["kv_utilization"] == 0.0
+    assert [e["used_positions"] for e in frag["slots"]] == [0, 0]
+
+    rng = np.random.RandomState(7)
+    pa = list(rng.randint(0, 64, 6))
+    ra = eng.add_request(pa, max_new_tokens=4)
+    frag = _assert_kv_pinned(eng)
+    waste_after_admit = frag["kv_waste_bytes"]
+    assert waste_after_admit < total            # the prompt occupies rows
+    by_slot = {e["rid"]: e for e in frag["slots"]}
+    assert by_slot[ra]["used_positions"] == 6
+    assert by_slot[None]["used_positions"] == 0  # the free slot
+    # per-slot waste: capacity minus used, and the free slot wastes
+    # its whole row
+    assert by_slot[ra]["kv_waste_bytes"] < by_slot[None]["kv_waste_bytes"]
+
+    eng.step()                                   # one decode token
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] < waste_after_admit
+
+    while eng.live():
+        eng.step()                               # budget exhausts (eos-
+    frag = _assert_kv_pinned(eng)                # equivalent finish)
+    assert frag["kv_waste_bytes"] == total       # rows back to waste
+    assert frag["kv_utilization"] == 0.0
+
+    # cancel of a live request returns its rows to waste immediately
+    rb = eng.add_request(pa, max_new_tokens=4)
+    assert _assert_kv_pinned(eng)["kv_waste_bytes"] < total
+    assert eng.cancel(rb)
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] == total
+
+
+def test_kv_fragmentation_counts_prefix_pool_and_draft():
+    """The pool rows and draft cache are allocation too: a registered
+    prefix occupies its pool row's positions, an empty pool row is all
+    waste; a speculative engine's draft cache doubles the per-slot
+    bytes and the used fraction tracks both caches."""
+    m, params = _gpt(8)
+    eng = serving.Engine(m, params, slots=1, buf_len=24, prefix_pool=2)
+    frag = _assert_kv_pinned(eng)
+    total = frag["kv_cache_bytes"]
+    assert len(frag["pools"]) == 2
+    assert all(p["used_positions"] == 0 for p in frag["pools"])
+    assert frag["kv_waste_bytes"] == total
+
+    pref = [1, 2, 3, 4, 5]
+    eng.register_prefix(pref)
+    frag = eng.kv_fragmentation()
+    assert frag["pools"][0]["used_positions"] == len(pref)
+    assert frag["pools"][1]["used_positions"] == 0
+    assert frag["kv_waste_bytes"] < total
+
+    # draft engine: two cache trees share the position axis
+    draft = models.GPT(models.GPTConfig(vocab_size=64, block_size=24,
+                                        n_layer=1, n_head=2, n_embd=16,
+                                        dropout=0.0))
+    dparams, _ = draft.init(jax.random.PRNGKey(9))
+    spec = serving.Engine(m, params, slots=2, buf_len=24, draft=draft,
+                          draft_params=dparams)
+    frag0 = _assert_kv_pinned(spec)
+    spec.add_request([1, 2, 3, 4], max_new_tokens=3)
+    frag1 = _assert_kv_pinned(spec)
+    assert frag1["kv_used_bytes"] > 0
+    assert frag1["kv_waste_bytes"] < frag0["kv_waste_bytes"]
+    while spec.live():
+        spec.step()
+        _assert_kv_pinned(spec)
+
+
+def test_kv_fragmentation_windowed_partial_fill_nonzero():
+    """The acceptance shape: a partially-filled windowed engine has
+    NONZERO waste (free slots + capacity beyond cur_len), utilization
+    strictly between 0 and 1, and the gauges stay pinned across whole
+    windows."""
+    m, params = _gpt(10)
+    eng = serving.Engine(m, params, slots=4, buf_len=24, window=4)
+    eng.add_request([1, 2, 3], max_new_tokens=8)
+    eng.add_request([4, 5, 6, 7], max_new_tokens=8)
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] > 0
+    assert 0.0 < frag["kv_utilization"] < 1.0
+    eng.step()
+    frag2 = _assert_kv_pinned(eng)
+    assert frag2["kv_waste_bytes"] < frag["kv_waste_bytes"]
+
+
+def test_kv_fragmentation_rolling_ring_capacity():
+    """A rolling engine's slot capacity is the RING (W positions), not
+    buf_len: a prompt longer than W fully uses its row — utilization
+    1.0 on a single fully-live slot, never >1."""
+    from apex_tpu.models import Llama, LlamaConfig
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=24, sliding_window=6,
+                      tie_word_embeddings=True)
+    m = Llama(cfg)
+    params, _ = m.init(jax.random.PRNGKey(11))
+    eng = serving.Engine(m, params, slots=1, buf_len=24, rolling=True)
+    rng = np.random.RandomState(11)
+    eng.add_request(list(rng.randint(0, 64, 10)), max_new_tokens=2)
+    frag = _assert_kv_pinned(eng)
+    # prompt (10) exceeds the ring (6): the row is fully used
+    assert frag["slots"][0]["capacity_positions"] == 6
+    assert frag["slots"][0]["used_positions"] == 6
+    assert frag["kv_utilization"] == 1.0
+    assert frag["kv_waste_bytes"] == 0
+
+
+def test_kv_fragmentation_seq2seq_two_residents():
+    """Seq2seq slots hold two residents (cross K/V over src_len, a
+    decoder cache over max_new_cap): admission uses the source share,
+    decode grows the decoder share, finish returns both to waste."""
+    from apex_tpu.models import T5, T5Config
+    cfg = T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4, dropout_rate=0.0,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=16)
+    m = T5(cfg)
+    params, _ = m.init(jax.random.PRNGKey(12))
+    eng = serving.Seq2SeqEngine(m, params, slots=2, src_len=12,
+                                max_new_cap=10)
+    frag = _assert_kv_pinned(eng)
+    total = frag["kv_cache_bytes"]
+    assert frag["kv_waste_bytes"] == total
+    rng = np.random.RandomState(12)
+    eng.add_request(list(rng.randint(2, 64, 9)), max_new_tokens=4)
+    frag = _assert_kv_pinned(eng)
+    after_admit = frag["kv_waste_bytes"]
+    assert after_admit < total
+    by_slot = {e["rid"]: e for e in frag["slots"]}
+    live = next(e for rid, e in by_slot.items() if rid is not None)
+    assert live["used_positions"] == 9           # source only so far
+    eng.step()
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] < after_admit  # decoder share grew
+    while eng.live():
+        eng.step()
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] == total
