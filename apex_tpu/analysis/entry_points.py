@@ -514,6 +514,104 @@ register_entry_point(
                                  stem="space_to_depth"))
 
 
+# -- overlapped gradient communication (PR 14) ----------------------------
+
+def _staged_mlp_graph(ep, overlap=True, comm_topology="hierarchical",
+                      compress=False, ici_size=4, stages=4, hidden=32,
+                      B=8):
+    """The overlapped DDP train step (ROADMAP item 2): a sequential
+    ``stages``-deep MLP whose backward runs stage-by-stage through
+    ``DistributedDataParallel.staged_allreduce_grads`` — with
+    ``overlap=True`` each stage's bucket reduction is ISSUED while the
+    earlier stages' gradients are still being computed, which is a
+    *position* property of the jaxpr: the collective census and
+    payloads are byte-identical to the reduce-after-backward schedule,
+    and only the interleaving check (derived from
+    ``overlap_comm_schedule`` like every other expectation here) can
+    tell them apart.  ``overlap=False`` builds that baseline schedule
+    from the SAME staged step — the mutation tests lint it under the
+    overlap expectations and require the position check to flag."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .. import parallel
+
+    ndev = len(jax.devices())
+    if ici_size is not None and (ndev < ici_size or ndev % ici_size):
+        # bare RuntimeError = the device-count skip gate (see
+        # _ddp_resnet_graph): a 1-device smoke host cannot trace the
+        # 2-level mesh
+        raise RuntimeError(
+            f"this entry point needs an axis of a multiple of "
+            f"ici_size={ici_size} devices; ambient mesh has {ndev}")
+    rng = np.random.RandomState(14)
+    stage_params = [
+        {"w": jnp.asarray(rng.randn(hidden, hidden) * 0.1, jnp.float32),
+         "b": jnp.zeros((hidden,), jnp.float32)}
+        for _ in range(stages)]
+    x = jnp.asarray(rng.randn(B, hidden), jnp.float32)
+    y = jnp.asarray(rng.randn(B, hidden), jnp.float32)
+    stage_fns = [lambda p, a: jnp.tanh(a @ p["w"] + p["b"])] * stages
+    ddp = parallel.DistributedDataParallel(
+        comm_topology=comm_topology, allreduce_compress_bf16=compress,
+        ici_size=ici_size, overlap=overlap)
+
+    def step(params_list, batch):
+        xb, yb = batch
+        loss, grads = ddp.staged_allreduce_grads(
+            stage_fns, lambda a: jnp.mean((a - yb) ** 2), params_list,
+            xb)
+        new = [jax.tree_util.tree_map(lambda w, g: w - 0.1 * g, p, g)
+               for p, g in zip(params_list, grads)]
+        return new, lax.pmean(loss, "data")
+
+    schedule = parallel.overlap_comm_schedule(
+        stage_params, comm_topology=comm_topology,
+        allreduce_compress_bf16=compress, ici_size=ici_size,
+        world=ndev, nproc=1, overlap=overlap)
+    # census/payloads from the schedule (the same per-bucket accounting
+    # allreduce_comm_plan uses) + 2 fp32 scalars: the ONE shared
+    # axis-size psum (world_scalar=) and the loss pmean; overlapped
+    # mode additionally pins the interleaving position property
+    ep.expect.setdefault(
+        "collectives",
+        parallel.overlap_collective_expectations(
+            schedule, extra_psums=2, extra_psum_bytes=2 * 4))
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), (P("data"), P("data"))),
+                           out_specs=(P(), P()), check_vma=False)
+    return Graph(trace=_scoped(
+        _no_policy(),
+        lambda: jax.make_jaxpr(mapped)(stage_params, (x, y))))
+
+
+register_entry_point(
+    "ddp_mlp_overlap_flat", tags=("training", "ddp", "overlap"),
+    description="staged 4-stage MLP DDP step, OVERLAPPED flat "
+                "allreduce — per-stage psums interleaved with the "
+                "backward, position-pinned")(
+    lambda ep: _staged_mlp_graph(ep, comm_topology="flat",
+                                 ici_size=None))
+
+register_entry_point(
+    "ddp_mlp_overlap_hier", tags=("training", "ddp", "overlap", "hier"),
+    description="staged 4-stage MLP DDP step, OVERLAPPED hierarchical "
+                "ICI/DCN allreduce (ici_size=4) — bucket i's "
+                "reduce_scatter/DCN-psum/all_gather chain issued while "
+                "bucket i-1's grads are still in backward")(
+    lambda ep: _staged_mlp_graph(ep))
+
+register_entry_point(
+    "ddp_mlp_overlap_hier_bf16", tags=("training", "ddp", "overlap",
+                                       "hier"),
+    description="staged 4-stage MLP DDP step, overlapped hierarchical "
+                "allreduce with bf16-compressed DCN hop")(
+    lambda ep: _staged_mlp_graph(ep, compress=True))
+
+
 # -- transformer-family O2 train steps ------------------------------------
 
 def _transformer_graph(ep, family):

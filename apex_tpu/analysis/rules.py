@@ -68,7 +68,9 @@ runs where its key is present):
 ``collectives``::
 
     {"counts": {"psum": 4}, "payload_bytes": 40038408,
-     "payload_bytes_by_primitive": {"psum": 40038408}}
+     "payload_bytes_by_primitive": {"psum": 40038408},
+     "interleaving": {"min_payload_bytes": 1056,
+                      "min_matmuls_after": 1}}
 
     Exact comm accounting: any collective primitive not named in
     ``counts`` is budgeted at zero, and the total on-wire payload must
@@ -80,6 +82,18 @@ runs where its key is present):
     cross-host psum flags even if the total happens to balance.
     ``parallel.plan_collective_expectations`` derives all three fields
     from ``allreduce_comm_plan``.
+
+    ``interleaving`` (optional) is the overlapped-schedule pin (PR 14):
+    in jaxpr program order, the FIRST gradient-bucket collective (the
+    first collective eqn moving at least ``min_payload_bytes`` — which
+    separates grad buckets from the step's 4-byte scalar psums) must
+    appear BEFORE the last conv/dot eqn, with at least
+    ``min_matmuls_after`` matmul eqns after it.  A reduce-after-
+    backward schedule has identical counts and payloads — only eqn
+    POSITIONS distinguish it — so this is the one check that can tell
+    the two apart statically.
+    ``parallel.overlap_collective_expectations`` derives it (and the
+    census) from ``overlap_comm_schedule``.
 
 ``numerics``::
 
@@ -604,4 +618,63 @@ class CollectiveRule(Rule):
                                "is the DCN payload)" if hier else ""),
                         primitive=prim, payload_bytes=g,
                         expected_bytes=w))
+        inter = want.get("interleaving")
+        if inter:
+            out.extend(self._check_interleaving(ep, graph, inter))
+        return out
+
+    def _check_interleaving(self, ep, graph, inter) -> List[Finding]:
+        """The overlapped-schedule position pin: the first issued
+        gradient bucket's reduction must sit AHEAD of the tail of the
+        backward in jaxpr program order — a reduce-after-backward
+        graph (every collective trailing every matmul) has the exact
+        same census and payloads, so only the eqn positions can flag
+        it.  Scalar psums (axis size, loss pmean) are excluded by the
+        ``min_payload_bytes`` threshold, which
+        ``parallel.overlap_collective_expectations`` derives as the
+        smallest per-level hop any planned bucket puts on the wire."""
+        out: List[Finding] = []
+        thresh = int(inter.get("min_payload_bytes", 16))
+        ordered = list(G.walk_jaxpr(graph.jaxpr))
+        first_coll = None
+        matmul_pos: List[int] = []
+        for i, e in enumerate(ordered):
+            name = e.primitive.name
+            if (first_coll is None and name in G.COLLECTIVE_PRIMS
+                    and G.eqn_payload_bytes(e) >= thresh):
+                first_coll = i
+            if name in ("dot_general", "conv_general_dilated"):
+                matmul_pos.append(i)
+        if first_coll is None:
+            return [self.finding(
+                ep, f"vacuous interleaving check: no collective eqn "
+                    f"moves >= {thresh} bytes — there is no gradient "
+                    f"bucket reduction to position",
+                min_payload_bytes=thresh)]
+        if not matmul_pos:
+            return [self.finding(
+                ep, "vacuous interleaving check: the graph has no "
+                    "conv/dot eqns to interleave the reduction with")]
+        last_mm = matmul_pos[-1]
+        if first_coll > last_mm:
+            out.append(self.finding(
+                ep, f"reduce-after-backward schedule: the first "
+                    f"gradient-bucket collective (eqn #{first_coll}) "
+                    f"trails the last matmul (eqn #{last_mm}) — the "
+                    f"overlapped schedule must issue the first "
+                    f"bucket's reduction while later stages' backward "
+                    f"is still being emitted",
+                first_collective_eqn=first_coll,
+                last_matmul_eqn=last_mm))
+            return out
+        after = sum(1 for i in matmul_pos if i > first_coll)
+        floor = int(inter.get("min_matmuls_after", 1))
+        if after < floor:
+            out.append(self.finding(
+                ep, f"only {after} matmul eqn(s) follow the first "
+                    f"gradient-bucket collective (eqn #{first_coll}); "
+                    f"the overlap schedule budgets >= {floor} — "
+                    f"nothing is left for the reduction to overlap "
+                    f"with", matmuls_after=after, floor=floor,
+                first_collective_eqn=first_coll))
         return out

@@ -27,7 +27,8 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
-__all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
+__all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
+           "host_info", "JsonlExporter",
            "prometheus_text", "parse_prometheus_text",
            "validate_prometheus_text", "validate_bench_record",
            "validate_bench_jsonl", "validate_lint_record",
@@ -89,9 +90,27 @@ __all__ = ["SCHEMA_VERSION", "host_info", "JsonlExporter",
 # without the wasted bytes is exactly the blind spot ROADMAP item 1's
 # paged allocator must drive down); both fields are validated whenever
 # present at any version.
+# v9: overlapped gradient communication.  Step-time attribution
+# records (``train_step_attribution_*`` from ``bench.py --comm``) must
+# say WHICH bucket-issue schedule they measured: ``overlap_mode``
+# (one of OVERLAP_MODES — ``overlapped`` interleaves per-stage bucket
+# reductions with the backward, ``reduce_after_backward`` is the
+# classic baseline), ``n_stages`` and the stage-level ``issue_order``
+# permutation (OVERLAP_SCHEDULE_FIELDS, duplicated from
+# ``observability.steptime`` and pinned equal in tests) — a
+# comm-hidden claim is meaningless without the schedule that hid it.
+# The fields are validated whenever present at any version; fresh
+# v9 attribution lines must carry them.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v7 streams stay valid.
-SCHEMA_VERSION = 8
+# version, so archived v1..v8 streams stay valid.
+SCHEMA_VERSION = 9
+
+# which bucket-issue schedule an attribution record measured — the
+# stdlib-side duplicate of parallel.distributed.OVERLAP_MODES /
+# observability.steptime.OVERLAP_SCHEDULE_FIELDS (this module must
+# stay importable without jax; tests pin the tuples equal)
+OVERLAP_MODES = ("overlapped", "reduce_after_backward")
+OVERLAP_SCHEDULE_FIELDS = ("overlap_mode", "n_stages", "issue_order")
 
 _host_info_cache: Optional[Dict[str, Any]] = None
 
@@ -694,6 +713,50 @@ def validate_bench_record(rec: Any) -> List[str]:
                     f"ici_ms + dcn_ms ({vals['ici_ms']} + "
                     f"{vals['dcn_ms']}) must reassemble "
                     f"comm_isolated_ms ({vals['comm_isolated_ms']})")
+    # overlap schedule fields (PR 14, schema v9): a record saying WHICH
+    # bucket-issue schedule it measured must say it coherently — a
+    # known mode, a positive stage count, and a stage-level issue order
+    # that is a permutation of the stages.  Validated whenever present;
+    # REQUIRED on fresh v9 train_step_attribution_* lines (a
+    # comm-hidden claim without its schedule is not comparable).
+    if "overlap_mode" in rec:
+        om = rec["overlap_mode"]
+        if om not in OVERLAP_MODES:
+            errs.append(f"'overlap_mode' must be one of "
+                        f"{OVERLAP_MODES}, got {om!r}")
+        # a mode claim needs its schedule shape alongside it
+        _need(rec, errs, "n_stages", int)
+        _need(rec, errs, "issue_order", list)
+    # the shape fields are coherence-checked WHENEVER present — a
+    # record carrying n_stages=0 or a non-permutation issue_order is
+    # incoherent whether or not it also names its mode
+    ns = rec.get("n_stages")
+    ns_ok = isinstance(ns, int) and not isinstance(ns, bool)
+    if "n_stages" in rec:
+        if not ns_ok:
+            errs.append(f"'n_stages' must be an int, got {ns!r}")
+        elif ns < 1:
+            errs.append(f"'n_stages' must be >= 1, got {ns}")
+    if "issue_order" in rec:
+        io = rec["issue_order"]
+        if not isinstance(io, list) or not all(
+                isinstance(s, int) and not isinstance(s, bool)
+                for s in io):
+            errs.append("'issue_order' must be a list of ints")
+        elif ns_ok and ns >= 1 and sorted(io) != list(range(ns)):
+            errs.append(
+                f"'issue_order' must be a permutation of the "
+                f"{ns} stage ids, got {io}")
+    v9 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+          and sv_rec >= 9)
+    if (v9 and isinstance(metric, str)
+            and metric.startswith("train_step_attribution")
+            and "error" not in rec and not rec.get("stale")):
+        for key in OVERLAP_SCHEDULE_FIELDS:
+            if key not in rec:
+                errs.append(f"fresh step-attribution records must "
+                            f"carry {key!r} (schema v9: which "
+                            f"bucket-issue schedule was measured)")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:

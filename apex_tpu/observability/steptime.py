@@ -61,7 +61,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["blocked_time", "attribute_step", "timeline_consistency",
-           "ATTRIBUTION_FIELDS"]
+           "ATTRIBUTION_FIELDS", "OVERLAP_SCHEDULE_FIELDS"]
 
 # the fields every step-attribution bench record must carry
 # (exporters.validate_bench_record keys its checks off
@@ -69,6 +69,17 @@ __all__ = ["blocked_time", "attribute_step", "timeline_consistency",
 ATTRIBUTION_FIELDS = ("step_ms", "compute_ms", "comm_ms",
                       "comm_isolated_ms", "overlap_fraction",
                       "ici_ms", "dcn_ms")
+
+# the schedule an attribution record measured (PR 14): which
+# bucket-issue schedule the timed step ran — "overlapped" (per-stage
+# reductions interleaved with backward) or "reduce_after_backward"
+# (the classic baseline) — plus the stage count and stage-level issue
+# order.  Duplicated stdlib-side as exporters.OVERLAP_SCHEDULE_FIELDS
+# (pinned equal in tests); at schema v9 every fresh
+# train_step_attribution_* record carries them, so a dashboard can
+# split the overlap trend by schedule instead of guessing from metric
+# names.
+OVERLAP_SCHEDULE_FIELDS = ("overlap_mode", "n_stages", "issue_order")
 
 
 def _block(out) -> None:
@@ -153,6 +164,7 @@ def attribute_step(full_step: Callable, compute_step: Callable,
                    plan: Optional[List[dict]] = None,
                    iters: int = 10, warmup: int = 2,
                    ici_step: Optional[Callable] = None,
+                   schedule: Optional[Dict[str, Any]] = None,
                    capture_timeline: bool = False,
                    capture_dir: Optional[str] = None,
                    capture_iters: Optional[int] = None,
@@ -164,9 +176,19 @@ def attribute_step(full_step: Callable, compute_step: Callable,
     ``full_step`` / ``compute_step`` / ``comm_step`` (and the optional
     ``ici_step``) are called as ``fn(*args)``; each should be its own
     jitted program over the SAME shapes.  ``plan`` is the
-    ``parallel.allreduce_comm_plan`` of the step's gradient reduction;
+    ``parallel.allreduce_comm_plan`` of the step's gradient reduction
+    (or the ``buckets`` of an ``overlap_comm_schedule``, whose
+    ``stage``/``issue_order`` labels ride into the output buckets);
     without one the comm time reports as a single unlabeled bucket on
     the ``ici`` column.
+
+    ``schedule`` is the step's ``parallel.overlap_comm_schedule`` (or
+    ``DistributedDataParallel.last_overlap_schedule``): its
+    ``OVERLAP_SCHEDULE_FIELDS`` are folded onto the attribution dict
+    so the emitted record says WHICH bucket-issue schedule it
+    measured.  ``None`` stamps the classic single-stage
+    reduce-after-backward shape — every attribution record carries
+    the fields either way (schema v9).
 
     ``capture_timeline=True`` additionally runs ``capture_iters``
     (default ``iters``) warm passes of the FULL step under a fresh
@@ -193,6 +215,16 @@ def attribute_step(full_step: Callable, compute_step: Callable,
                               warmup=warmup) * 1e3
     comm_isolated_ms = blocked_time(comm_step, *args, iters=iters,
                                     warmup=warmup) * 1e3
+    # the decomposition model says compute <= step (the twin is the
+    # step minus its collectives); a twin that times SLOWER than the
+    # full step — routine on the oversubscribed CPU smoke mesh, where
+    # the collectives' rendezvous accidentally staggers the device
+    # threads — would otherwise publish a record violating its own
+    # compute+comm==step identity.  Clamp to the model and surface the
+    # excess as ``compute_twin_excess_ms`` so the record stays
+    # schema-consistent while the anomaly stays visible.
+    twin_excess = max(compute_ms - step_ms, 0.0)
+    compute_ms = min(compute_ms, step_ms)
     comm_ms = max(step_ms - compute_ms, 0.0)
     if comm_isolated_ms > 0.0:
         overlap = 1.0 - comm_ms / comm_isolated_ms
@@ -241,11 +273,16 @@ def attribute_step(full_step: Callable, compute_step: Callable,
     for b, (ici_ms, dcn_ms) in zip(buckets, split):
         rec = {"ici_ms": round(ici_ms, 4), "dcn_ms": round(dcn_ms, 4)}
         for k in ("comm_dtype", "elements", "topology", "cause",
-                  "ici_wire_bytes", "dcn_wire_bytes", "wire_bytes"):
+                  "ici_wire_bytes", "dcn_wire_bytes", "wire_bytes",
+                  "stage", "issue_order"):
             if k in b:
                 rec[k] = b[k]
         out_buckets.append(rec)
 
+    # which bucket-issue schedule the timed step ran — lazily through
+    # parallel (the owner of the schedule shape) so this module stays
+    # jax-free at import
+    from ..parallel import distributed as _dist
     out = {"step_ms": round(step_ms, 4),
            "compute_ms": round(compute_ms, 4),
            "comm_ms": round(comm_ms, 4),
@@ -253,7 +290,10 @@ def attribute_step(full_step: Callable, compute_step: Callable,
            "overlap_fraction": round(overlap, 4),
            "ici_ms": round(sum(i for i, _ in split), 4),
            "dcn_ms": round(sum(d for _, d in split), 4),
+           **_dist.overlap_schedule_fields(schedule),
            "buckets": out_buckets}
+    if twin_excess > 0.0:
+        out["compute_twin_excess_ms"] = round(twin_excess, 4)
 
     if capture_timeline:
         from . import timeline as tlmod

@@ -6,11 +6,15 @@ from typing import List, Optional, Tuple
 
 from . import multiproc
 from .topology import (make_mesh, mesh_info, hierarchical_axis_groups,
-                       default_ici_size, auto_comm_topology)
+                       default_ici_size, auto_comm_topology,
+                       overlap_issue_order)
 from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, allreduce_comm_plan,
                           plan_collective_expectations,
-                          predivide_factors, flat_dist_call)
+                          predivide_factors, flat_dist_call,
+                          staged_grads, overlap_comm_schedule,
+                          overlap_schedule_fields,
+                          overlap_collective_expectations, OVERLAP_MODES)
 from .sync_batchnorm import SyncBatchNorm
 from .LARC import LARC
 from . import tensor_parallel

@@ -54,7 +54,9 @@ from . import topology as _topology
 
 __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
            "allreduce_comm_plan", "plan_collective_expectations",
-           "predivide_factors", "flat_dist_call"]
+           "predivide_factors", "flat_dist_call", "staged_grads",
+           "overlap_comm_schedule", "overlap_schedule_fields",
+           "overlap_collective_expectations", "OVERLAP_MODES"]
 
 # where the gradient bytes travel: "flat" is one psum over the whole
 # axis (every byte crosses the slowest link in it), "hierarchical" is
@@ -64,6 +66,16 @@ __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
 # per topology.auto_comm_topology (hierarchical iff the axis spans
 # processes).
 COMM_TOPOLOGIES = ("flat", "hierarchical", "auto")
+
+# when the gradient bytes travel, relative to the backward that makes
+# them: "reduce_after_backward" is the classic schedule (every bucket's
+# collective trails the whole backward — today's measured
+# overlap_fraction ~ 0.0 baseline), "overlapped" is the staged schedule
+# where bucket i's reduction is ISSUED while bucket i-1's gradients are
+# still being computed (the reference DDP's arrival-order bucket drain,
+# expressed as jaxpr program order so XLA's latency-hiding scheduler —
+# and the collective lint rule — can see it).
+OVERLAP_MODES = ("overlapped", "reduce_after_backward")
 
 
 def _axis_size(axis_name: str) -> jax.Array:
@@ -244,7 +256,8 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
                          comm_topology: str = "flat",
                          allreduce_compress_bf16: bool = False,
                          ici_size: Optional[int] = None,
-                         numerics_out: Optional[list] = None) -> Any:
+                         numerics_out: Optional[list] = None,
+                         world_scalar: Optional[jax.Array] = None) -> Any:
     """Bucketed gradient allreduce with the reference's semantics
     (allreduce_bucket, distributed.py:378-398).  Must run inside a context
     where ``axis_name`` is a mapped mesh axis.
@@ -303,7 +316,15 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     values: thread them into the step carry in the SAME trace (e.g.
     ``NumericsMonitor.update(bucket_stats=...)``).  All stats are
     local elementwise math — the collective census and host-transfer
-    audit of the step are unchanged."""
+    audit of the step are unchanged.
+
+    ``world_scalar``: the traced axis-size scalar to average by,
+    computed ONCE by a caller that reduces several stage subtrees in
+    one step (``DistributedDataParallel.staged_allreduce_grads``) —
+    without it every per-stage call would psum its own 4-byte scalar
+    and the step's collective census would grow by the stage count.
+    ``None`` (the default) keeps the classic behavior: this call psums
+    the scalar itself."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -337,7 +358,8 @@ def allreduce_grads_tree(grads: Any, axis_name: str = "data",
     for i, g in enumerate(leaves):
         groups.setdefault(jnp.dtype(g.dtype), []).append(i)
 
-    world = _axis_size(axis_name)
+    world = world_scalar if world_scalar is not None \
+        else _axis_size(axis_name)
     if axis_index_groups is not None:
         world = jnp.asarray(float(len(axis_index_groups[0])), jnp.float32)
 
@@ -563,6 +585,166 @@ def plan_collective_expectations(plan: List[dict],
             "payload_bytes_by_primitive": dict(by_prim)}
 
 
+def _stamp_stage_labels(records: List[dict], stage: int,
+                        issue_start: int) -> int:
+    """Stamp one stage's bucket records (plan buckets OR runtime
+    ``comm_stats``/``numerics_out`` dicts) with their place in the
+    overlap schedule: ``stage`` (which forward stage owns the bucket)
+    and ``issue_order`` (global position in the issue sequence).  ONE
+    implementation shared by :func:`overlap_comm_schedule` and the
+    runtime path, so a schedule change cannot relabel one side only.
+    Returns the next free issue index."""
+    for i, rec in enumerate(records):
+        rec["stage"] = int(stage)
+        rec["issue_order"] = issue_start + i
+    return issue_start + len(records)
+
+
+def staged_grads(stage_fns: Sequence[Callable], loss_head: Callable,
+                 stage_params: Sequence[Any], x: Any,
+                 reduce_stage: Optional[Callable] = None,
+                 overlap: bool = True) -> Tuple[jax.Array, List[Any]]:
+    """Manual chain rule over a sequential stage decomposition — the
+    comm/compute-overlap engine (ROADMAP item 2; reference DDP's
+    arrival-order bucket drain, distributed.py:378-398, expressed as
+    program order).
+
+    ``stage_fns[i](stage_params[i], act) -> act`` compose the forward;
+    ``loss_head(act) -> scalar`` closes over labels.  The forward runs
+    every stage under :func:`jax.vjp`; the backward then walks stages
+    in :func:`topology.overlap_issue_order` (back-to-front — reverse
+    AD makes the LAST stage's gradients first).  With ``overlap=True``
+    each stage's ``reduce_stage(stage, issue_idx, grads)`` is called
+    the moment that stage's gradients exist, BEFORE the next stage's
+    VJP runs — so in the traced jaxpr the first bucket's
+    psum_scatter/DCN-reduce/all_gather chain sits ahead of the earlier
+    layers' grad eqns and a latency-hiding scheduler can run them
+    concurrently (statically pinned by the collective lint rule's
+    interleaving check).  With ``overlap=False`` the same reductions
+    are issued in the same order but only AFTER the whole backward —
+    the reduce-after-backward baseline the overlapped schedule is
+    numerically pinned against (identical buckets, identical
+    collectives, only the issue positions differ; grads match at fp32
+    rtol 1e-6 in tests/test_overlap.py).
+
+    Returns ``(loss, [per-stage grads])`` with grads in STAGE order
+    (``grads[i]`` matches ``stage_params[i]``), reduced when
+    ``reduce_stage`` is given."""
+    n = len(stage_fns)
+    if n != len(stage_params):
+        raise ValueError(f"{n} stage fns vs {len(stage_params)} stage "
+                         f"param trees")
+    order = _topology.overlap_issue_order(n)
+    act = x
+    vjps = []
+    for fn, p in zip(stage_fns, stage_params):
+        act, vjp = jax.vjp(fn, p, act)
+        vjps.append(vjp)
+    loss, loss_vjp = jax.vjp(loss_head, act)
+    (ct,) = loss_vjp(jnp.ones_like(loss))
+    grads: List[Any] = [None] * n
+    for issue, s in enumerate(order):
+        g, ct = vjps[s](ct)
+        if overlap and reduce_stage is not None:
+            g = reduce_stage(s, issue, g)
+        grads[s] = g
+    if not overlap and reduce_stage is not None:
+        # reduce-after-backward: SAME buckets, SAME issue order, issued
+        # only once the full backward has been emitted
+        for issue, s in enumerate(order):
+            grads[s] = reduce_stage(s, issue, grads[s])
+    return loss, grads
+
+
+def overlap_comm_schedule(stage_trees: Sequence[Any],
+                          message_size: int = 10_000_000,
+                          allreduce_always_fp32: bool = False,
+                          comm_topology: str = "flat",
+                          allreduce_compress_bf16: bool = False,
+                          ici_size: Optional[int] = None,
+                          world: Optional[int] = None,
+                          nproc: Optional[int] = None,
+                          overlap: bool = True) -> Dict[str, Any]:
+    """The static overlap schedule: :func:`allreduce_comm_plan`
+    extended with WHEN each bucket's reduction is issued, computed from
+    shapes alone.  Returns::
+
+        {"overlap_mode": "overlapped" | "reduce_after_backward",
+         "n_stages": S,
+         "issue_order": [S-1, ..., 0],        # stage-level issue order
+         "buckets": [...]}                    # plan buckets + stage/
+                                              #   issue_order labels
+
+    Every bucket dict is an :func:`allreduce_comm_plan` bucket — same
+    shared :func:`_bucket_wire_accounting`, so per-level wire bytes are
+    UNCHANGED by overlapping (the schedule moves issue positions, not
+    payloads) — stamped by the same :func:`_stamp_stage_labels` the
+    runtime uses.  Bucket order in ``buckets`` IS issue order, which is
+    also the order ``comm_stats``/``numerics_out`` records arrive in at
+    trace time; ``tests/test_overlap.py`` pins the two sides equal.
+    The collective lint rule derives its expectations (census, per-
+    primitive payloads, AND the static interleaving property) from this
+    schedule via :func:`overlap_collective_expectations`."""
+    order = _topology.overlap_issue_order(len(stage_trees))
+    buckets: List[dict] = []
+    issue = 0
+    for s in order:
+        stage_buckets = allreduce_comm_plan(
+            stage_trees[s], message_size=message_size,
+            allreduce_always_fp32=allreduce_always_fp32,
+            comm_topology=comm_topology,
+            allreduce_compress_bf16=allreduce_compress_bf16,
+            ici_size=ici_size, world=world, nproc=nproc)
+        issue = _stamp_stage_labels(stage_buckets, s, issue)
+        buckets.extend(stage_buckets)
+    return {"overlap_mode": ("overlapped" if overlap
+                             else "reduce_after_backward"),
+            "n_stages": len(stage_trees),
+            "issue_order": order,
+            "buckets": buckets}
+
+
+def overlap_schedule_fields(schedule: Optional[Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """The schedule fields a bench/attribution record carries
+    (``exporters.OVERLAP_SCHEDULE_FIELDS``): mode, stage count, and
+    stage-level issue order.  ``None`` describes a classic
+    un-staged step — one stage, reduced after backward — so every
+    attribution record can say which schedule it measured."""
+    if schedule is None:
+        return {"overlap_mode": "reduce_after_backward",
+                "n_stages": 1, "issue_order": [0]}
+    return {"overlap_mode": schedule["overlap_mode"],
+            "n_stages": int(schedule["n_stages"]),
+            "issue_order": [int(s) for s in schedule["issue_order"]]}
+
+
+def overlap_collective_expectations(schedule: Dict[str, Any],
+                                    extra_psums: int = 0,
+                                    extra_psum_bytes: int = 0) -> dict:
+    """Fold an :func:`overlap_comm_schedule` into the collective rule's
+    expectation dict: the exact census/payloads of
+    :func:`plan_collective_expectations` over the schedule's buckets,
+    PLUS — for the overlapped mode — the static interleaving pin: the
+    first issued bucket's reduction eqns must appear in the jaxpr
+    BEFORE the last layers' grad (conv/dot) eqns, not trail the whole
+    backward.  ``min_payload_bytes`` separates grad-bucket collectives
+    from the step's scalar psums (axis size, loss pmean): it is the
+    smallest per-level hop any bucket puts on the wire, which a real
+    gradient bucket always clears and a 4-byte scalar never does."""
+    exp = plan_collective_expectations(schedule["buckets"],
+                                       extra_psums=extra_psums,
+                                       extra_psum_bytes=extra_psum_bytes)
+    if schedule["overlap_mode"] == "overlapped" and schedule["buckets"]:
+        min_hop = min(
+            min(b["dcn_wire_bytes"], b["ici_wire_bytes"])
+            for b in schedule["buckets"])
+        exp["interleaving"] = {
+            "min_payload_bytes": max(int(min_hop), 16),
+            "min_matmuls_after": 1}
+    return exp
+
+
 def _broadcast0(flat: jax.Array, axis_name: str,
                 axis_index_groups=None) -> jax.Array:
     """Broadcast from rank 0 expressed as a masked psum (XLA lowers this
@@ -614,7 +796,8 @@ class DistributedDataParallel:
                  adasum: bool = False,
                  comm_topology: str = "flat",
                  allreduce_compress_bf16: bool = False,
-                 ici_size: Optional[int] = None):
+                 ici_size: Optional[int] = None,
+                 overlap: bool = False):
         if shared_param is not None:
             raise ValueError("shared_param is deprecated (reference "
                              "distributed.py:176-180)")
@@ -659,11 +842,39 @@ class DistributedDataParallel:
                 raise ValueError(
                     f"adasum=True replaces the psum pipeline; these "
                     f"options have no effect with it: {clashes}")
+        # overlap=True selects the overlapped bucket schedule for
+        # staged_allreduce_grads: each stage's reduction is issued
+        # while earlier stages' gradients are still being computed.
+        # It contradicts delay_allreduce (ONE fused reduce after
+        # backward is the opposite schedule) and allreduce_trigger_
+        # params (stage boundaries ARE the bucket boundaries in the
+        # staged world); adasum's butterfly replaces the bucket
+        # pipeline wholesale, so staging it is not wired.  Topology /
+        # compression / predivide all compose — the per-bucket
+        # reduction is the unchanged hierarchical chain, only its
+        # issue position moves.
+        self.overlap = bool(overlap)
+        if self.overlap:
+            clashes = [name for name, bad in (
+                ("delay_allreduce", delay_allreduce),
+                ("allreduce_trigger_params",
+                 bool(allreduce_trigger_params)),
+                ("adasum", adasum)) if bad]
+            if clashes:
+                raise ValueError(
+                    f"overlap=True issues per-stage bucket reductions "
+                    f"inside the backward; these options contradict "
+                    f"that schedule: {clashes}")
         self.allreduce_buffers: list = []
         # trace-time comm accounting (observability): one record per
         # bucket of the most recently traced allreduce — see
         # allreduce_grads_tree(comm_stats=...)
         self.last_comm_stats: list = []
+        # the most recently traced overlap schedule
+        # (staged_allreduce_grads): overlap_mode / n_stages /
+        # issue_order / stage-stamped bucket records — None until a
+        # staged step traces, or when the compute twin elides comm
+        self.last_overlap_schedule: Optional[dict] = None
         # numerics observability (PR 9): the most recently FLUSHED
         # gradient-health summary — host-side plain python, set by
         # record_numerics() after the step's NumericsMonitor.flush()
@@ -746,6 +957,106 @@ class DistributedDataParallel:
         self.last_comm_stats = comm_stats
         self._record_comm_stats()
         return out
+
+    def staged_allreduce_grads(self, stage_fns: Sequence[Callable],
+                               loss_head: Callable,
+                               stage_params: Sequence[Any], x: Any,
+                               numerics_out: Optional[list] = None
+                               ) -> Tuple[jax.Array, List[Any]]:
+        """The overlapped train-step hot path: forward + backward over
+        a sequential stage decomposition with each stage's gradient
+        bucket reduced on arrival (``self.overlap=True``) or after the
+        full backward (``False`` — the pinned baseline schedule).  See
+        :func:`staged_grads`; the per-stage reduction is
+        :func:`allreduce_grads_tree` under this wrapper's knobs, so
+        topology / compression / predivide / fp32-comm all behave
+        exactly as in :meth:`allreduce_grads` — the schedule moves
+        WHEN buckets are issued, never what they carry.
+
+        The axis-size scalar is psum'd ONCE and shared across stages
+        (``world_scalar=``), keeping the census at one scalar psum +
+        whatever the plan budgets per bucket.  ``comm_stats`` /
+        ``numerics_out`` records arrive stamped with
+        ``stage``/``issue_order`` in exactly
+        :func:`overlap_comm_schedule` bucket order (the plan-order
+        contract PR 9's per-bucket scalars ride on), and
+        ``self.last_overlap_schedule`` keeps the traced schedule.
+
+        ``comm_enabled=False`` builds the compute twin: the SAME staged
+        backward with every collective elided and the local 1/world
+        average kept (static axis size), for step-time attribution."""
+        if self.adasum:
+            raise ValueError("staged_allreduce_grads does not compose "
+                             "with adasum (the butterfly replaces the "
+                             "bucket pipeline)")
+        if self.delay_allreduce or self.allreduce_trigger_params:
+            raise ValueError(
+                "staged_allreduce_grads: stage boundaries define the "
+                "buckets; delay_allreduce / allreduce_trigger_params "
+                "contradict the staged schedule")
+        if not self.comm_enabled:
+            self.last_comm_stats = []
+            self.last_overlap_schedule = None
+            loss, grads = staged_grads(stage_fns, loss_head,
+                                       stage_params, x,
+                                       reduce_stage=None,
+                                       overlap=self.overlap)
+            if self.gradient_average:
+                # static axis size, like allreduce_grads: the twin
+                # must trace collective-free
+                world = int(lax.axis_size(self.axis_name))
+                grads = [jax.tree_util.tree_map(
+                    lambda g: g / jnp.asarray(world, g.dtype)
+                    if jnp.issubdtype(g.dtype, jnp.floating) else g,
+                    gs) for gs in grads]
+            return loss, grads
+        world_static = int(lax.axis_size(self.axis_name))
+        world_scalar = _axis_size(self.axis_name)
+        retain = [] if self.retain_allreduce_buffers else None
+        comm_stats: list = []
+        issue_state = {"comm": 0, "num": 0}
+
+        def reduce_stage(stage, issue, grads_s):
+            cs: list = []
+            nout: Optional[list] = \
+                [] if numerics_out is not None else None
+            out = allreduce_grads_tree(
+                grads_s, axis_name=self.axis_name,
+                message_size=self.message_size,
+                allreduce_always_fp32=self.allreduce_always_fp32,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                retain_buffers=retain,
+                comm_stats=cs,
+                comm_topology=self.comm_topology,
+                allreduce_compress_bf16=self.allreduce_compress_bf16,
+                ici_size=self.ici_size,
+                numerics_out=nout,
+                world_scalar=world_scalar)
+            issue_state["comm"] = _stamp_stage_labels(
+                cs, stage, issue_state["comm"])
+            comm_stats.extend(cs)
+            if nout is not None:
+                issue_state["num"] = _stamp_stage_labels(
+                    nout, stage, issue_state["num"])
+                numerics_out.extend(nout)
+            return out
+
+        loss, grads = staged_grads(stage_fns, loss_head, stage_params,
+                                   x, reduce_stage=reduce_stage,
+                                   overlap=self.overlap)
+        if retain is not None:
+            self.allreduce_buffers = retain
+        self.last_comm_stats = comm_stats
+        self.last_overlap_schedule = {
+            "overlap_mode": ("overlapped" if self.overlap
+                             else "reduce_after_backward"),
+            "n_stages": len(stage_fns),
+            "issue_order": _topology.overlap_issue_order(len(stage_fns)),
+            "buckets": comm_stats,
+            "world": world_static}
+        self._record_comm_stats()
+        return loss, grads
 
     def _record_comm_stats(self):
         """Fold the per-bucket accounting into the process observability

@@ -28,7 +28,8 @@ import jax
 from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "mesh_info", "hierarchical_axis_groups",
-           "default_ici_size", "auto_comm_topology"]
+           "default_ici_size", "auto_comm_topology",
+           "overlap_issue_order"]
 
 
 def make_mesh(devices: Optional[list] = None, **axes: int) -> Mesh:
@@ -126,6 +127,21 @@ def hierarchical_axis_groups(world: int, ici_size: int
     dcn_groups = [[j + s * ici_size for s in range(n_slices)]
                   for j in range(ici_size)]
     return ici_groups, dcn_groups
+
+
+def overlap_issue_order(n_stages: int) -> List[int]:
+    """Stage issue order for the overlapped gradient-communication
+    schedule: reverse-mode AD produces gradients back-to-front, so the
+    LAST forward stage's bucket is ready first and its reduction is the
+    first one issued — ``[S-1, ..., 1, 0]``.  This is the ONE place the
+    ordering lives: ``distributed.staged_grads`` walks stages in this
+    order at trace time and ``distributed.overlap_comm_schedule``
+    stamps plan buckets in the same order, so the runtime graph and the
+    static schedule cannot disagree about who goes first."""
+    n = int(n_stages)
+    if n < 1:
+        raise ValueError(f"need at least one stage, got {n_stages}")
+    return list(range(n - 1, -1, -1))
 
 
 def mesh_info(mesh: Mesh) -> str:

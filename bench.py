@@ -203,9 +203,15 @@ def main():
     # other: the hierarchical DCN payload must be exactly 1/ici of the
     # flat one, the compressed one exactly half again); wall-clock is
     # reported, never gated — on a CPU smoke host all fabrics are the
-    # same memory bus.  Like --fleet it runs INSTEAD of the job list
-    # but AFTER --graph-lint, which still gates the exit status
-    # (--fleet takes precedence when both are passed).
+    # same memory bus.  PR 14 adds the overlapped-schedule comparison:
+    # the same gradient bytes through a staged backward with per-stage
+    # bucket reductions issued INSIDE the backward (overlap) vs after
+    # it (overlap_off), schedule fields on every attribution record
+    # and the comm-hidden delta asserted positive on accelerator
+    # backends.  Like --fleet it runs INSTEAD of the job list but
+    # AFTER --graph-lint, which still gates the exit status (--fleet
+    # takes precedence when both are passed; --profile COMPOSES — see
+    # below).
     # --numerics: numerics-instrumentation overhead per opt-level —
     # the SAME DDP resnet18 train step timed with the NumericsMonitor
     # on vs off (per-layer grad health + per-bucket stats + divergence
@@ -250,7 +256,12 @@ def main():
     # kv_utilization) item 1's paged allocator must drive down.
     # Precedence when combined: --fleet > --comm > --numerics
     # > --run > --chaos > --profile; --graph-lint composes with all of
-    # them and still gates the exit status.
+    # them and still gates the exit status.  EXCEPTION (PR 14):
+    # --comm --profile COMPOSE — the comm bench additionally captures
+    # the flat and overlapped train steps under jax.profiler and emits
+    # kind: profile records, so comm_visible_ms is MEASURED on the
+    # same executables the attribution differenced (the mode-
+    # precedence chain used to silently drop --profile there).
     comm_flag = "--comm" in sys.argv
     numerics_flag = "--numerics" in sys.argv
     run_flag = "--run" in sys.argv
@@ -456,12 +467,16 @@ def main():
             step, mesh=mesh, in_specs=(P(), (P("data"), P("data"))),
             out_specs=(P(), P()), check_vma=False))
 
-    def run_comm_bench():
+    def run_comm_bench(profile=False):
         ici = (ndev // jax.process_count() if jax.process_count() > 1
                else max((d for d in range(2, ndev)
                          if ndev % d == 0), default=1))
-        n = (25_000_000 if on_tpu else 1_000_000) // max(ici, 1) \
-            * max(ici, 1)                 # no shard padding: the plan
+        n_stages = 4                      # the overlapped variant's
+        # stage count: buffer divisible by stages*ici so neither the
+        # stage split nor the shard split pads
+        align = n_stages * max(ici, 1)
+        n = (25_000_000 if on_tpu else 1_000_000) // align \
+            * align                       # no shard padding: the plan
         # relationships below must hold to the byte, not modulo pad
         buf = jnp.ones((n,), jnp.float32)
 
@@ -572,10 +587,12 @@ def main():
 
         attr_args = ((buf,),
                      (jnp.ones((ndev, 1)), jnp.zeros((ndev, 1))))
+        full_steps = {}
         for name, topo, compress in variants:
             b = plans[name]
+            full_steps[name] = make_attr_step(topo, compress)
             att = steptime.attribute_step(
-                make_attr_step(topo, compress),
+                full_steps[name],
                 make_attr_step(topo, compress, comm_enabled=False),
                 make_comm_only(topo, compress),
                 args=attr_args, plan=[b], iters=10, warmup=2)
@@ -586,7 +603,10 @@ def main():
                  wire_bytes=b["wire_bytes"],
                  ici_wire_bytes=b["ici_wire_bytes"],
                  dcn_wire_bytes=b["dcn_wire_bytes"],
+                 comm_visible_ms=att["comm_ms"],
                  **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+                 **{k: att[k]
+                    for k in steptime.OVERLAP_SCHEDULE_FIELDS},
                  note="blocked-fetch step decomposition; "
                       "overlap_fraction ~0.0 is today's reduce-after-"
                       "backward baseline, the number ROADMAP item 2 "
@@ -595,8 +615,169 @@ def main():
                          "bus, level split is byte-proportional"
                          if not on_tpu else ""))
 
+        # -- overlapped schedule (PR 14, ROADMAP item 2): the SAME
+        # gradient bytes through a staged backward, reduce-after-
+        # backward vs per-stage reductions interleaved with the
+        # backward.  Both variants share one stage decomposition and
+        # one comm schedule shape, so the only difference the
+        # attribution can see is WHEN the buckets are issued — the
+        # comm-hidden comparison below is schedule-vs-schedule on the
+        # same host, not model-vs-model.
+        topo_ov = "hierarchical" if ici >= 2 else "flat"
+        m = n // n_stages
+        stage_tree = [{"w": jax.ShapeDtypeStruct((m,), jnp.float32)}
+                      for _ in range(n_stages)]
+        schedules = {
+            mode: parallel.overlap_comm_schedule(
+                stage_tree, comm_topology=topo_ov,
+                ici_size=ici if topo_ov == "hierarchical" else None,
+                world=ndev, nproc=1, overlap=(mode == "overlap"))
+            for mode in ("overlap", "overlap_off")}
+        # the schedule moves issue positions, never payloads: the
+        # staged buckets' total wire bytes must equal the monolithic
+        # flat/hier bucket's (same elements, no padding by
+        # construction)
+        ref = plans["hier" if topo_ov == "hierarchical" else "flat"]
+        sched_bytes = {k: sum(b[k]
+                              for b in schedules["overlap"]["buckets"])
+                       for k in ("wire_bytes", "ici_wire_bytes",
+                                 "dcn_wire_bytes")}
+        assert sched_bytes["wire_bytes"] == ref["wire_bytes"], (
+            "staging changed the on-wire payload:", sched_bytes, ref)
+
+        def make_staged(overlap, comm_enabled=True):
+            ddp = parallel.DistributedDataParallel(
+                comm_topology=topo_ov,
+                ici_size=ici if topo_ov == "hierarchical" else None,
+                overlap=overlap)
+            ddp.comm_enabled = comm_enabled
+
+            def stage_fn(p, a):
+                return a * p["w"] + jnp.tanh(a)
+
+            stage_fns = [stage_fn] * n_stages
+
+            def step(state, batch):
+                a0 = jnp.full((m,), batch[0][0, 0], jnp.float32)
+                loss, grads = ddp.staged_allreduce_grads(
+                    stage_fns, lambda a: jnp.sum(a[:8]), state[0], a0)
+                return (tuple(grads),), loss
+            return sharded(step)
+
+        def staged_comm_only(state, batch):
+            # share ONE axis-size scalar across the per-stage calls,
+            # exactly like staged_allreduce_grads (world_scalar=) —
+            # otherwise the isolated-comm program would time S-1
+            # scalar rendezvous the measured step never runs,
+            # inflating comm_isolated_ms and with it overlap_fraction
+            ws = lax.psum(jnp.ones((), jnp.float32), "data")
+            outs = []
+            for sp in state[0]:
+                outs.append(parallel.allreduce_grads_tree(
+                    sp, "data", comm_topology=topo_ov,
+                    ici_size=ici if topo_ov == "hierarchical"
+                    else None, world_scalar=ws))
+            return (tuple(outs),), jnp.sum(outs[0]["w"][:8])
+
+        staged_args = ((tuple({"w": jnp.ones((m,), jnp.float32)}
+                              for _ in range(n_stages)),),
+                       (jnp.ones((ndev, 1)), jnp.zeros((ndev, 1))))
+        staged_comm = sharded(staged_comm_only)
+        staged_atts = {}
+        staged_fulls = {}
+        for mode in ("overlap_off", "overlap"):
+            sched = schedules[mode]
+            staged_fulls[mode] = make_staged(mode == "overlap")
+            att = steptime.attribute_step(
+                staged_fulls[mode],
+                make_staged(mode == "overlap", comm_enabled=False),
+                staged_comm, args=staged_args,
+                plan=sched["buckets"], schedule=sched,
+                iters=10, warmup=2)
+            staged_atts[mode] = att
+            emit(metric=f"train_step_attribution_{mode}",
+                 value=att["step_ms"], unit="ms", vs_baseline=None,
+                 comm_topology=topo_ov,
+                 compress=False,
+                 ici_size=sched["buckets"][0]["ici_size"],
+                 dcn_size=sched["buckets"][0]["dcn_size"],
+                 comm_visible_ms=att["comm_ms"],
+                 **sched_bytes,
+                 **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+                 **{k: att[k]
+                    for k in steptime.OVERLAP_SCHEDULE_FIELDS},
+                 note=f"staged {n_stages}-stage backward, "
+                      + ("per-stage bucket reductions ISSUED inside "
+                         "the backward (the overlapped schedule)"
+                         if mode == "overlap" else
+                         "same stages reduced after the full backward "
+                         "(the baseline schedule)")
+                      + "; identical buckets and wire bytes — only "
+                        "the issue positions differ"
+                      + ("; CPU mesh executes collectives "
+                         "synchronously, so the schedule win shows "
+                         "on async-collective backends" if not on_tpu
+                         else ""))
+        hidden = (staged_atts["overlap_off"]["comm_ms"]
+                  - staged_atts["overlap"]["comm_ms"])
+        if on_tpu:
+            # the dynamic gate: on hardware with async collectives the
+            # overlapped schedule must hide comm (step ~ compute)
+            assert staged_atts["overlap"]["comm_ms"] \
+                < staged_atts["overlap_off"]["comm_ms"], (
+                "overlapped schedule did not reduce visible comm:",
+                staged_atts)
+        emit(metric="overlap_comm_hidden_delta",
+             value=round(hidden, 4), unit="ms", vs_baseline=None,
+             comm_visible_overlap_ms=staged_atts["overlap"]["comm_ms"],
+             comm_visible_baseline_ms=staged_atts["overlap_off"][
+                 "comm_ms"],
+             note="reduce-after-backward comm_ms minus overlapped "
+                  "comm_ms on the same staged step (positive = the "
+                  "schedule hid comm under backward compute); "
+                  "asserted positive on accelerator backends, "
+                  "reported on CPU smoke where the virtual mesh "
+                  "executes collectives synchronously")
+
+        if profile:
+            # --comm --profile: capture the SAME executables the
+            # attribution just timed, so the measured comm-visible ms
+            # and overlap fraction describe the programs whose
+            # differenced split was emitted above
+            from apex_tpu.observability import timeline
+            citers = 10 if on_tpu else 3
+            for pname, fullfn, fargs in (
+                    ("flat", full_steps["flat"], attr_args),
+                    ("overlap", staged_fulls["overlap"], staged_args),
+                    ("overlap_off", staged_fulls["overlap_off"],
+                     staged_args)):
+                att = timeline.capture(fullfn, *fargs, iters=citers,
+                                       modules=("jit_step",))
+                comm_visible = round(
+                    max(att["collective_ms"] - att["overlap_ms"],
+                        0.0), 4)
+                emit(**timeline.profile_record(
+                    att, metric=f"comm_profile_{pname}",
+                    comm_visible_ms=comm_visible,
+                    note=f"device timeline of the {pname} comm-bench "
+                         f"step ({citers} warm steps) — the same "
+                         f"executable train_step_attribution_{pname} "
+                         f"differenced; measured_overlap_fraction is "
+                         f"the kernel-interval overlap needle"))
+                emit(metric=f"comm_profile_{pname}_comm_visible_ms",
+                     value=comm_visible, unit="ms", vs_baseline=None,
+                     measured_overlap_fraction=att[
+                         "measured_overlap_fraction"],
+                     device_busy_ms=att["device_busy_ms"],
+                     note=f"collective time NOT hidden under compute "
+                          f"on the measured device timeline "
+                          f"({pname} comm-bench step)")
+
     if comm_flag and not fleet_n:
-        run_comm_bench()
+        # --profile composes here instead of being dropped by the
+        # precedence chain: kind: profile records for the same
+        # executables the attribution times
+        run_comm_bench(profile=profile_flag)
         # --graph-lint (if also passed) already ran and still gates
         return 1 if lint_errors else 0
 

@@ -24,7 +24,22 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    warnings but do not gate — the byte/plan fields and the tier-1
    suite are the portable CPU signals, hardware lines are the timing
    signal.  ``--strict-cpu`` promotes them to errors.
-3. **Peak-memory / MFU regression** (schema v3 cost-model fields).
+3. **Comm-overlap regression** (schema v9 overlap fields).  Fresh
+   metric lines carrying ``overlap_fraction`` /
+   ``measured_overlap_fraction`` (step-time attribution and profile
+   lines from ``bench.py --comm`` / ``--profile``) trend per
+   (metric, backend, field): a fraction that DROPS past ``--tol``
+   after the overlap work drove it off zero is comm sliding back onto
+   the critical path — error on accelerator backends, warning on CPU
+   smoke (virtual devices share one host; measured overlap there
+   reflects thread scheduling).  A ``comm_visible_ms`` field that
+   GROWS past ``--tol`` follows the same policy.  A zero baseline cuts
+   both ways: a FRACTION at 0 (the reduce-after-backward world) never
+   trends — there is no overlap to lose yet — but a
+   ``comm_visible_ms`` of 0 is the success state, and comm returning
+   from fully hidden to measurably visible gates as the worst
+   regression the column exists for.
+4. **Peak-memory / MFU regression** (schema v3 cost-model fields).
    ``peak_bytes`` — on train-throughput lines and ``kind: memory``
    records — is a property of the COMPILED executable, deterministic
    on any backend, so growth past ``--mem-tol`` (default 25%) gates
@@ -183,6 +198,10 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # (subject, backend) -> (round_name, value) of the cost-model trends
     last_mem = {}
     last_mfu = {}
+    # (subject, backend, field) -> (round_name, value) of the
+    # comm-overlap trends (schema v9 fields on attribution/profile
+    # metric lines)
+    last_overlap = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
 
@@ -230,6 +249,67 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                     else:
                         errors.append(msg)
             last_mfu[key] = (rname, float(mfu))
+
+    def track_overlap_fields(rname, rec):
+        """Comm-overlap trends for one fresh metric line: the overlap
+        fractions (higher is better — the whole point of ROADMAP
+        item 2) and the visible-comm time (lower is better).  Both are
+        timing-derived, so they follow the accelerator-gates /
+        CPU-warns policy like MFU; a zero baseline never trends (no
+        overlap yet = nothing to lose)."""
+        subject = rec.get("metric")
+        if not isinstance(subject, str) or not subject:
+            return
+        for field, better in (("overlap_fraction", "higher"),
+                              ("measured_overlap_fraction", "higher"),
+                              ("comm_visible_ms", "lower")):
+            val = rec.get(field)
+            if (not isinstance(val, (int, float))
+                    or isinstance(val, bool) or val < 0):
+                continue
+            key = (subject, rec.get("backend"), field)
+            prev = last_overlap.get(key)
+            last_overlap[key] = (rname, float(val))
+            if prev is None:
+                continue
+            pname, pval = prev
+            if pval <= 0:
+                # a zero baseline means opposite things per direction:
+                # a FRACTION at 0 is today's no-overlap world — nothing
+                # to lose, never trends.  A lower-is-better TIME at 0
+                # is the success state, and comm returning from fully
+                # hidden to visibly on the critical path is the WORST
+                # regression this column exists for — gate it (0.05 ms
+                # absorbs the 4-decimal rounding noise of a true zero).
+                if better == "lower" and val > 0.05:
+                    msg = (f"{rname}: {subject} "
+                           f"[{rec.get('backend') or '?'}] {field} "
+                           f"returned from a zero baseline to "
+                           f"{val:.4g} vs {pname} — comm is back on "
+                           f"the critical path")
+                    if is_cpu(rec) and not strict_cpu:
+                        warnings.append(msg + " [cpu smoke: warning "
+                                        "only]")
+                    else:
+                        errors.append(msg)
+                continue
+            if better == "higher":
+                change = (pval - val) / pval   # + = less overlap
+                verb = "dropped"
+            else:
+                change = (val - pval) / pval   # + = more visible comm
+                verb = "grew"
+            if change > tol:
+                msg = (f"{rname}: {subject} "
+                       f"[{rec.get('backend') or '?'}] {field} {verb} "
+                       f"{change * 100:.0f}% vs {pname} "
+                       f"({pval:.4g} -> {val:.4g}, tol "
+                       f"{tol * 100:.0f}%) — comm is sliding back "
+                       f"onto the critical path")
+                if is_cpu(rec) and not strict_cpu:
+                    warnings.append(msg + " [cpu smoke: warning only]")
+                else:
+                    errors.append(msg)
 
     for rname, recs in rounds:
         wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
@@ -287,6 +367,7 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                 continue
             n_fresh += 1
             track_cost_fields(rname, rec)
+            track_overlap_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:

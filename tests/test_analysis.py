@@ -444,6 +444,64 @@ def test_collective_rule_flags_unbudgeted_collective():
     assert found[0].detail["primitive"] == "all_gather"
 
 
+def test_collective_rule_interleaving_mutation_both_ways():
+    """The PR 14 overlap pin, mutation-proofed in both directions: the
+    REAL staged step traced with overlap=False (reduce-after-backward
+    — identical census, identical payloads, only eqn positions differ)
+    must flag under the overlap-derived expectations, and the real
+    overlapped step must lint clean under the same expectations."""
+    from apex_tpu import parallel
+    from apex_tpu.analysis.entry_points import _staged_mlp_graph
+
+    sched = parallel.overlap_comm_schedule(
+        [{"w": jax.ShapeDtypeStruct((32, 32), jnp.float32),
+          "b": jax.ShapeDtypeStruct((32,), jnp.float32)}] * 4,
+        comm_topology="hierarchical", ici_size=4, world=8, nproc=1,
+        overlap=True)
+    overlap_expect = {"collectives":
+                      parallel.overlap_collective_expectations(
+                          sched, extra_psums=2, extra_psum_bytes=8)}
+
+    broken = EntryPoint("mutant_reduce_after_backward",
+                        lambda ep: _staged_mlp_graph(ep, overlap=False),
+                        expect=dict(overlap_expect))
+    found = _run(broken, "collective")
+    assert len(found) == 1, found
+    assert "reduce-after-backward schedule" in found[0].message
+    assert found[0].detail["first_collective_eqn"] > \
+        found[0].detail["last_matmul_eqn"]
+
+    fixed = EntryPoint("fixed_overlapped",
+                       lambda ep: _staged_mlp_graph(ep, overlap=True),
+                       expect=dict(overlap_expect))
+    assert _run(fixed, "collective") == []
+
+
+def test_collective_rule_interleaving_vacuity_guards():
+    """An interleaving expectation over a graph with no gradient-sized
+    collective (or no matmuls at all) is a finding, not a silent pass
+    — the pin must not evaporate when the graph changes shape."""
+    no_coll = _ep(
+        "mutant_interleave_no_collective",
+        expect={"collectives": {"counts": {},
+                                "interleaving":
+                                {"min_payload_bytes": 64}}},
+        trace=lambda: jax.make_jaxpr(
+            lambda x: jnp.tanh(x @ x))(jnp.ones((8, 8))))
+    found = _run(no_coll, "collective")
+    assert any("vacuous interleaving" in f.message for f in found)
+
+    no_mm = _ep(
+        "mutant_interleave_no_matmul",
+        expect={"collectives": {"counts": {"psum": 1},
+                                "payload_bytes": 2 * 8 * 4,
+                                "interleaving":
+                                {"min_payload_bytes": 16}}},
+        trace=_psum_graph(1))
+    found = _run(no_mm, "collective")
+    assert any("no conv/dot" in f.message for f in found)
+
+
 def test_numerics_rule_flags_host_sync_extra_collective_and_residue():
     """The PR 9 rule, mutation-proofed in all three directions: an
     'enabled' instrumentation that smuggles a host callback flags; one

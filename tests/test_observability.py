@@ -526,7 +526,8 @@ def test_steptime_attribution_decomposition_and_schema():
         {"metric": "train_step_attribution_hier", "value": att["step_ms"],
          "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
          "arch": "cpu",
-         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+         **{k: att[k] for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
     assert exporters.validate_bench_record(rec) == []
     with pytest.raises(ValueError, match="iters"):
         steptime.blocked_time(sleeper(0.0), iters=0)
@@ -559,7 +560,8 @@ def test_attribution_measured_ici_step_zero_weight_level_folds():
         {"metric": "train_step_attribution_flat", "value": att["step_ms"],
          "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
          "arch": "cpu",
-         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+         **{k: att[k] for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
     assert exporters.validate_bench_record(rec) == []
 
 
@@ -590,7 +592,9 @@ def test_attribution_zero_weight_plan_still_reassembles():
             {"metric": "train_step_attribution_flat",
              "value": att["step_ms"], "unit": "ms", "vs_baseline": None,
              "backend": "cpu", "ndev": 8, "arch": "cpu",
-             **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS}})
+             **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+             **{k: att[k]
+                for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
         assert exporters.validate_bench_record(rec) == []
 
 
@@ -603,7 +607,9 @@ def test_attribution_record_schema_mutations():
          "unit": "ms", "vs_baseline": None, "backend": "cpu", "ndev": 8,
          "arch": "cpu", "step_ms": 10.0, "compute_ms": 6.0,
          "comm_ms": 4.0, "comm_isolated_ms": 5.0,
-         "overlap_fraction": 0.2, "ici_ms": 4.0, "dcn_ms": 1.0})
+         "overlap_fraction": 0.2, "ici_ms": 4.0, "dcn_ms": 1.0,
+         "overlap_mode": "reduce_after_backward", "n_stages": 1,
+         "issue_order": [0]})
     assert exporters.validate_bench_record(base) == []
     bad = dict(base, overlap_fraction=1.5)
     assert any("overlap_fraction" in e
@@ -1026,6 +1032,98 @@ def test_check_bench_trend_gate(tmp_path):
     assert r.returncode == 0 and "WARNING" in r.stderr
     r = _run_trend(["--dir", str(d5), "--strict-cpu"])
     assert r.returncode == 1
+
+
+def test_check_bench_trend_overlap_fields_gate(tmp_path):
+    """The PR 14 trend columns: a fresh accelerator line whose
+    overlap_fraction / measured_overlap_fraction DROPS past --tol (or
+    whose comm_visible_ms GROWS past it) gates; CPU smoke warns; and a
+    zero baseline — the reduce-after-backward world — never trends (no
+    overlap yet means nothing to lose)."""
+    def attr(backend, value, frac, visible):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "train_step_attribution_overlap",
+             "value": value, "unit": "ms", "vs_baseline": None,
+             "backend": backend, "ndev": 8,
+             "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
+             "overlap_fraction": frac, "comm_visible_ms": visible,
+             "overlap_mode": "overlapped", "n_stages": 4,
+             "issue_order": [3, 2, 1, 0]})
+
+    # accelerator overlap_fraction drop past tol -> error
+    d1 = tmp_path / "ovl1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [attr("tpu", 10.0, 0.8, 1.0)])
+    _trend_round(d1, "BENCH_r02.json", [attr("tpu", 10.1, 0.3, 1.0)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "overlap_fraction dropped" in r.stderr
+    # ...within tolerance passes
+    r = _run_trend(["--dir", str(d1), "--tol", "0.7"])
+    assert r.returncode == 0, r.stderr
+
+    # accelerator comm_visible_ms growth past tol -> error
+    d2 = tmp_path / "ovl2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [attr("tpu", 10.0, 0.8, 1.0)])
+    _trend_round(d2, "BENCH_r02.json", [attr("tpu", 10.1, 0.8, 2.0)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 1
+    assert "comm_visible_ms grew" in r.stderr
+
+    # CPU smoke: warns only, unless --strict-cpu
+    d3 = tmp_path / "ovl3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [attr("cpu", 10.0, 0.8, 1.0)])
+    _trend_round(d3, "BENCH_r02.json", [attr("cpu", 10.1, 0.3, 1.0)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 0 and "WARNING" in r.stderr
+    r = _run_trend(["--dir", str(d3), "--strict-cpu"])
+    assert r.returncode == 1
+
+    # zero baseline never trends: 0.0 -> 0.0 is today's world, and a
+    # fraction appearing off zero is progress, not regression
+    d4 = tmp_path / "ovl4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json", [attr("tpu", 10.0, 0.0, 1.0)])
+    _trend_round(d4, "BENCH_r02.json", [attr("tpu", 10.1, 0.0, 1.0)])
+    _trend_round(d4, "BENCH_r03.json", [attr("tpu", 10.0, 0.6, 1.0)])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 0, r.stderr
+
+    # ...but a LOWER-is-better time at 0 is the success state: comm
+    # returning from fully hidden to measurably visible is the worst
+    # regression the column exists for — gates even from a zero
+    # baseline (rounding-noise returns under 0.05 ms do not)
+    d4b = tmp_path / "ovl4b"
+    d4b.mkdir()
+    _trend_round(d4b, "BENCH_r01.json", [attr("tpu", 10.0, 0.9, 0.0)])
+    _trend_round(d4b, "BENCH_r02.json", [attr("tpu", 10.1, 0.9, 4.0)])
+    r = _run_trend(["--dir", str(d4b)])
+    assert r.returncode == 1
+    assert "returned from a zero baseline" in r.stderr
+    d4c = tmp_path / "ovl4c"
+    d4c.mkdir()
+    _trend_round(d4c, "BENCH_r01.json", [attr("tpu", 10.0, 0.9, 0.0)])
+    _trend_round(d4c, "BENCH_r02.json", [attr("tpu", 10.1, 0.9, 0.01)])
+    r = _run_trend(["--dir", str(d4c)])
+    assert r.returncode == 0, r.stderr
+
+    # measured_overlap_fraction (profile metric lines) follows the
+    # same policy
+    def prof(value, frac):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "comm_profile_overlap_comm_visible_ms",
+             "value": value, "unit": "ms", "vs_baseline": None,
+             "backend": "tpu", "ndev": 8, "arch": "TPU v5 lite",
+             "measured_overlap_fraction": frac})
+    d5 = tmp_path / "ovl5"
+    d5.mkdir()
+    _trend_round(d5, "BENCH_r01.json", [prof(1.0, 0.9)])
+    _trend_round(d5, "BENCH_r02.json", [prof(1.05, 0.2)])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 1
+    assert "measured_overlap_fraction dropped" in r.stderr
 
 
 def test_check_bench_trend_memory_and_mfu_gate(tmp_path):

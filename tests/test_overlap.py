@@ -1,0 +1,422 @@
+"""Overlapped gradient communication (PR 14, ROADMAP item 2).
+
+The staged DDP backward issues bucket *i*'s reduction while bucket
+*i-1*'s gradients are still being computed.  Pinned here:
+
+- **numerics**: the overlapped schedule computes the SAME gradients as
+  the reduce-after-backward schedule (rtol 1e-6) and as the classic
+  monolithic ``allreduce_grads_tree`` step — the schedule moves issue
+  positions, never math; the bf16-compressed variant matches its own
+  baseline at 1e-6 and the uncompressed one at bf16 tolerance;
+- **static interleaving**: in the traced jaxpr the first bucket's
+  reduction eqns precede the last stage's grad eqns under
+  ``overlap=True`` and trail the whole backward under ``False`` (the
+  property the collective lint rule's ``interleaving`` check pins);
+- **plan/runtime consistency**: ``overlap_comm_schedule`` buckets and
+  the traced ``comm_stats`` agree bucket-for-bucket (stage,
+  issue_order, wire bytes) — the shared-helper contract that keeps a
+  schedule change from desyncing plan from graph;
+- **observability contracts** survive the new schedule: the
+  ``comm_enabled=False`` compute twin traces collective-free, and
+  ``numerics_out=`` per-bucket scalars arrive in schedule order.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.analysis import graphs as G
+from apex_tpu.observability import exporters, steptime
+
+S, H, B = 4, 32, 8
+_rng = np.random.RandomState(14)
+STAGE_PARAMS = [
+    {"w": jnp.asarray(_rng.randn(H, H) * 0.1, jnp.float32),
+     "b": jnp.asarray(_rng.randn(H) * 0.01, jnp.float32)}
+    for _ in range(S)]
+X = jnp.asarray(_rng.randn(B, H), jnp.float32)
+Y = jnp.asarray(_rng.randn(B, H), jnp.float32)
+STAGE_FNS = [lambda p, a: jnp.tanh(a @ p["w"] + p["b"])] * S
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def make_staged_step(overlap, compress=False, comm_enabled=True,
+                     numerics=False, topo="hierarchical", ici=4):
+    """(ddp, mapped_fn) for the staged train step; the mapped fn
+    returns (per-stage grads, loss)."""
+    ddp = parallel.DistributedDataParallel(
+        comm_topology=topo, allreduce_compress_bf16=compress,
+        ici_size=ici, overlap=overlap)
+    ddp.comm_enabled = comm_enabled
+
+    def step(params_list, batch):
+        xb, yb = batch
+        nout = [] if numerics else None
+        loss, grads = ddp.staged_allreduce_grads(
+            STAGE_FNS, lambda a: jnp.mean((a - yb) ** 2), params_list,
+            xb, numerics_out=nout)
+        return list(grads), loss
+
+    mapped = jax.shard_map(step, mesh=_mesh(),
+                           in_specs=(P(), (P("data"), P("data"))),
+                           out_specs=(P(), P()), check_vma=False)
+    return ddp, mapped
+
+
+def _grads(fn):
+    g, _ = jax.jit(fn)(STAGE_PARAMS, (X, Y))
+    return jax.tree_util.tree_leaves(g)
+
+
+def test_overlap_matches_reduce_after_backward_and_monolithic():
+    """The acceptance pin: overlapped grads == reduce-after-backward
+    grads at 1e-6 rtol, and both == the monolithic hierarchical step
+    (one allreduce_grads_tree over the whole tree after jax.grad)."""
+    _, f_ov = make_staged_step(True)
+    _, f_ba = make_staged_step(False)
+    g_ov, g_ba = _grads(f_ov), _grads(f_ba)
+    for a, b in zip(g_ov, g_ba):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+    def mono_step(params_list, batch):
+        xb, yb = batch
+
+        def loss_fn(ps):
+            a = xb
+            for fn, p in zip(STAGE_FNS, ps):
+                a = fn(p, a)
+            return jnp.mean((a - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(list(params_list))
+        grads = parallel.allreduce_grads_tree(
+            grads, "data", comm_topology="hierarchical", ici_size=4)
+        return grads, loss
+
+    mono = jax.shard_map(mono_step, mesh=_mesh(),
+                         in_specs=(P(), (P("data"), P("data"))),
+                         out_specs=(P(), P()), check_vma=False)
+    for a, b in zip(g_ov, _grads(mono)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+
+def test_overlap_bf16_compressed_tolerances():
+    """The compressed overlapped schedule matches its own
+    reduce-after-backward baseline at 1e-6 (identical per-bucket ops,
+    only issue positions differ) and the uncompressed schedule at bf16
+    tolerance (the DCN hop quantizes either way)."""
+    _, f_cov = make_staged_step(True, compress=True)
+    _, f_cba = make_staged_step(False, compress=True)
+    _, f_ov = make_staged_step(True)
+    g_cov, g_cba, g_ov = _grads(f_cov), _grads(f_cba), _grads(f_ov)
+    for a, b in zip(g_cov, g_cba):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+    for a, b in zip(g_cov, g_ov):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def _positions(jaxpr, min_payload=64):
+    """(first big-collective index, last matmul index) in program
+    order — the property the lint rule's interleaving check reads."""
+    first_coll = last_mm = None
+    for i, e in enumerate(G.walk_jaxpr(jaxpr)):
+        if (first_coll is None
+                and e.primitive.name in G.COLLECTIVE_PRIMS
+                and G.eqn_payload_bytes(e) >= min_payload):
+            first_coll = i
+        if e.primitive.name in ("dot_general", "conv_general_dilated"):
+            last_mm = i
+    return first_coll, last_mm
+
+
+def test_overlap_static_interleaving_both_ways():
+    """overlap=True: the first bucket's reduction sits AHEAD of the
+    last stage's grad matmuls in the jaxpr; overlap=False: every
+    bucket reduction trails the whole backward.  Same census, same
+    payloads — position is the only difference, which is exactly what
+    the collective rule's interleaving expectation pins."""
+    _, f_ov = make_staged_step(True)
+    _, f_ba = make_staged_step(False)
+    jx_ov = jax.make_jaxpr(f_ov)(STAGE_PARAMS, (X, Y))
+    jx_ba = jax.make_jaxpr(f_ba)(STAGE_PARAMS, (X, Y))
+    fc, lm = _positions(jx_ov)
+    assert fc is not None and lm is not None and fc < lm, (fc, lm)
+    fc_b, lm_b = _positions(jx_ba)
+    assert fc_b is not None and fc_b > lm_b, (fc_b, lm_b)
+    # identical collective census either way (the interleaving is not
+    # bought with extra collectives)
+    from collections import Counter
+    census = lambda jx: Counter(  # noqa: E731
+        e.primitive.name for e in G.collective_eqns(jx))
+    assert census(jx_ov) == census(jx_ba)
+
+
+def test_overlap_shares_one_axis_size_scalar():
+    """staged_allreduce_grads psums the axis-size scalar ONCE
+    (world_scalar=) — the census carries exactly one 4-byte scalar
+    psum for the average no matter how many stages reduce."""
+    _, f_ov = make_staged_step(True)
+    jx = jax.make_jaxpr(f_ov)(STAGE_PARAMS, (X, Y))
+    scalars = [e for e in G.collective_eqns(jx)
+               if G.eqn_payload_bytes(e) <= 8]
+    # the shared axis-size psum only — the step above returns grads,
+    # no loss pmean inside the mapped fn
+    assert len(scalars) == 1, [
+        (e.primitive.name, G.eqn_payload_bytes(e)) for e in scalars]
+
+
+def test_overlap_compute_twin_is_collective_free():
+    """ddp.comm_enabled=False under the staged schedule: the twin
+    traces ZERO collective eqns and computes the local 1/world mean —
+    the step-time attribution contract survives overlapping."""
+    ddp, f_twin = make_staged_step(True, comm_enabled=False)
+    jx = jax.make_jaxpr(f_twin)(STAGE_PARAMS, (X, Y))
+    assert G.collective_eqns(jx) == []
+    assert ddp.last_comm_stats == []
+    assert ddp.last_overlap_schedule is None
+
+    # numerics: twin grads == unreduced local grads / world
+    def local_step(params_list, batch):
+        xb, yb = batch
+        loss, grads = parallel.staged_grads(
+            STAGE_FNS, lambda a: jnp.mean((a - yb) ** 2), params_list,
+            xb)
+        return [jax.tree_util.tree_map(lambda g: g / 8.0, gs)
+                for gs in grads], loss
+
+    local = jax.shard_map(local_step, mesh=_mesh(),
+                          in_specs=(P(), (P("data"), P("data"))),
+                          out_specs=(P(), P()), check_vma=False)
+    for a, b in zip(_grads(f_twin), _grads(local)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+
+def test_overlap_schedule_matches_runtime_comm_stats():
+    """The shared-helper contract: overlap_comm_schedule (static, from
+    shapes) and the traced comm_stats agree bucket-for-bucket on
+    stage, issue order, cause, topology and wire bytes — a schedule
+    change cannot silently desync plan from graph."""
+    ddp, f_ov = make_staged_step(True)
+    jax.make_jaxpr(f_ov)(STAGE_PARAMS, (X, Y))
+    sched = parallel.overlap_comm_schedule(
+        STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+        world=8, nproc=1)
+    assert sched["overlap_mode"] == "overlapped"
+    assert sched["issue_order"] == \
+        parallel.overlap_issue_order(S) == [3, 2, 1, 0]
+    assert len(sched["buckets"]) == len(ddp.last_comm_stats) == S
+    for pb, rb in zip(sched["buckets"], ddp.last_comm_stats):
+        assert pb["stage"] == rb["stage"]
+        assert pb["issue_order"] == rb["issue_order"]
+        assert pb["cause"] == rb["cause"]
+        assert pb["topology"] == rb["topology"]
+        assert pb["wire_bytes"] == rb["bytes"]
+        assert pb["ici_wire_bytes"] == rb["ici_wire_bytes"]
+        assert pb["dcn_wire_bytes"] == rb["dcn_wire_bytes"]
+    ls = ddp.last_overlap_schedule
+    assert ls["overlap_mode"] == "overlapped" and ls["n_stages"] == S
+    assert ls["issue_order"] == sched["issue_order"]
+    fields = parallel.overlap_schedule_fields(ls)
+    assert fields == {"overlap_mode": "overlapped", "n_stages": S,
+                      "issue_order": [3, 2, 1, 0]}
+    assert parallel.overlap_schedule_fields(None) == {
+        "overlap_mode": "reduce_after_backward", "n_stages": 1,
+        "issue_order": [0]}
+
+
+def test_overlap_numerics_out_arrives_in_schedule_order():
+    """numerics_out per-bucket scalars under the overlapped schedule:
+    one record per bucket, stamped with the SAME stage/issue_order the
+    schedule stamps, traced scalars present — the PR 9 plan-order
+    contract holds when the buckets are issued inside the backward."""
+    for compress in (False, True):
+        ddp, f_n = make_staged_step(True, compress=compress,
+                                    numerics=True)
+        nout_probe = []
+
+        def step(params_list, batch):
+            xb, yb = batch
+            loss, grads = ddp.staged_allreduce_grads(
+                STAGE_FNS, lambda a: jnp.mean((a - yb) ** 2),
+                params_list, xb, numerics_out=nout_probe)
+            return list(grads), loss
+
+        mapped = jax.shard_map(step, mesh=_mesh(),
+                               in_specs=(P(), (P("data"), P("data"))),
+                               out_specs=(P(), P()), check_vma=False)
+        jax.make_jaxpr(mapped)(STAGE_PARAMS, (X, Y))
+        sched = parallel.overlap_comm_schedule(
+            STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+            allreduce_compress_bf16=compress, world=8, nproc=1)
+        assert len(nout_probe) == len(sched["buckets"]) == S
+        for ns, pb in zip(nout_probe, sched["buckets"]):
+            assert ns["stage"] == pb["stage"]
+            assert ns["issue_order"] == pb["issue_order"]
+            assert ns["elements"] == pb["elements"]
+            for key in ("nonfinite", "abs_max", "sq_sum"):
+                assert key in ns
+            assert ("compression_sq_error" in ns) == compress
+
+
+def test_overlap_knob_clashes():
+    for kw in ({"delay_allreduce": True}, {"adasum": True},
+               {"allreduce_trigger_params": ["w"]}):
+        with pytest.raises(ValueError, match="overlap"):
+            parallel.DistributedDataParallel(overlap=True, **kw)
+    # the staged method itself refuses the clashing knobs even when
+    # overlap=False (the baseline schedule still stages the buckets)
+    ddp = parallel.DistributedDataParallel(delay_allreduce=True)
+    with pytest.raises(ValueError, match="staged"):
+        ddp.staged_allreduce_grads(STAGE_FNS, lambda a: jnp.sum(a),
+                                   STAGE_PARAMS, X)
+
+
+def test_overlap_issue_order_helper():
+    assert parallel.overlap_issue_order(1) == [0]
+    assert parallel.overlap_issue_order(3) == [2, 1, 0]
+    with pytest.raises(ValueError):
+        parallel.overlap_issue_order(0)
+
+
+def test_overlap_collective_expectations_derivation():
+    """The lint expectations derive from the schedule: census +
+    payloads via plan_collective_expectations, and the interleaving
+    pin ONLY for the overlapped mode, with a threshold that clears
+    every scalar psum but no gradient bucket hop."""
+    for overlap in (True, False):
+        sched = parallel.overlap_comm_schedule(
+            STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+            world=8, nproc=1, overlap=overlap)
+        exp = parallel.overlap_collective_expectations(
+            sched, extra_psums=2, extra_psum_bytes=8)
+        assert exp["counts"]["reduce_scatter"] == S
+        assert exp["counts"]["psum"] == S + 2
+        if overlap:
+            inter = exp["interleaving"]
+            assert inter["min_payload_bytes"] > 8
+            assert inter["min_payload_bytes"] <= min(
+                b["dcn_wire_bytes"] for b in sched["buckets"])
+            assert inter["min_matmuls_after"] >= 1
+        else:
+            assert "interleaving" not in exp
+
+
+def test_attribute_step_schedule_fields_and_v9_schema():
+    """attribute_step stamps OVERLAP_SCHEDULE_FIELDS on every
+    attribution (defaulting to the classic single-stage
+    reduce-after-backward shape), and the v9 schema requires them on
+    fresh attribution records while rejecting incoherent ones."""
+
+    def sleeper(s):
+        def fn():
+            import time as _t
+            _t.sleep(s)
+            return jnp.ones((4,))
+        return fn
+
+    sched = parallel.overlap_comm_schedule(
+        STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+        world=8, nproc=1)
+    att = steptime.attribute_step(sleeper(0.02), sleeper(0.012),
+                                  sleeper(0.008), args=(),
+                                  plan=sched["buckets"],
+                                  schedule=sched, iters=2, warmup=0)
+    assert att["overlap_mode"] == "overlapped"
+    assert att["n_stages"] == S
+    assert att["issue_order"] == [3, 2, 1, 0]
+    # bucket stage labels ride into the output buckets
+    assert [b["stage"] for b in att["buckets"]] == [3, 2, 1, 0]
+    rec = exporters.JsonlExporter.enrich(
+        {"metric": "train_step_attribution_overlap",
+         "value": att["step_ms"], "unit": "ms", "vs_baseline": None,
+         "backend": "cpu", "ndev": 8, "arch": "cpu",
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+         **{k: att[k] for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
+    assert exporters.validate_bench_record(rec) == []
+
+    # defaulted schedule: classic shape, still v9-valid
+    att0 = steptime.attribute_step(sleeper(0.02), sleeper(0.012),
+                                   sleeper(0.008), args=(), iters=2,
+                                   warmup=0)
+    assert att0["overlap_mode"] == "reduce_after_backward"
+    assert att0["n_stages"] == 1 and att0["issue_order"] == [0]
+
+    # v9 gating: a fresh attribution record without the schedule
+    # fields fails; archived records at a declared older version pass
+    naked = {k: v for k, v in rec.items()
+             if k not in exporters.OVERLAP_SCHEDULE_FIELDS}
+    assert any("schema v9" in e
+               for e in exporters.validate_bench_record(naked))
+    archived = dict(naked, schema_version=8)
+    assert exporters.validate_bench_record(archived) == []
+    stale = dict(naked, stale=True)
+    assert exporters.validate_bench_record(stale) == []
+    # incoherent schedule fields flag at any version
+    bad = dict(rec, overlap_mode="sometimes")
+    assert any("overlap_mode" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = dict(rec, issue_order=[0, 1, 1, 2])
+    assert any("permutation" in e
+               for e in exporters.validate_bench_record(bad))
+    bad = dict(rec, n_stages=0)
+    assert any("n_stages" in e
+               for e in exporters.validate_bench_record(bad))
+    # the shape fields are coherence-checked whenever PRESENT — even
+    # on a record that never names its overlap_mode
+    bad = {k: v for k, v in rec.items() if k != "overlap_mode"}
+    bad.update(schema_version=8, n_stages=0)
+    assert any("n_stages" in e
+               for e in exporters.validate_bench_record(bad)), bad
+    bad = {k: v for k, v in rec.items() if k != "overlap_mode"}
+    bad.update(schema_version=8, n_stages=2, issue_order=[5, 5])
+    assert any("permutation" in e
+               for e in exporters.validate_bench_record(bad)), bad
+
+
+def test_overlap_schedule_fields_pinned_across_modules():
+    """The stdlib-side duplicates (exporters must import without jax)
+    stay equal to the owning modules' tuples."""
+    assert exporters.OVERLAP_SCHEDULE_FIELDS == \
+        steptime.OVERLAP_SCHEDULE_FIELDS
+    assert exporters.OVERLAP_MODES == parallel.OVERLAP_MODES
+
+
+def test_attribute_step_clamps_slow_compute_twin():
+    """A compute twin that times slower than the full step (routine on
+    the oversubscribed CPU mesh) clamps to the decomposition model —
+    compute+comm still reassemble step — and surfaces the excess as
+    compute_twin_excess_ms instead of publishing a record that fails
+    its own schema."""
+
+    def sleeper(s):
+        def fn():
+            import time as _t
+            _t.sleep(s)
+            return jnp.ones((4,))
+        return fn
+
+    att = steptime.attribute_step(sleeper(0.01), sleeper(0.02),
+                                  sleeper(0.005), args=(), iters=2,
+                                  warmup=0)
+    assert att["compute_ms"] == att["step_ms"]
+    assert att["comm_ms"] == 0.0
+    assert att["compute_twin_excess_ms"] > 0.0
+    rec = exporters.JsonlExporter.enrich(
+        {"metric": "train_step_attribution_flat",
+         "value": att["step_ms"], "unit": "ms", "vs_baseline": None,
+         "backend": "cpu", "ndev": 8, "arch": "cpu",
+         **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
+         **{k: att[k] for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
+    assert exporters.validate_bench_record(rec) == []
