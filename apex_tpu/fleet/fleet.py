@@ -343,6 +343,28 @@ class Fleet:
         self._prefix_map[tuple(int(t) for t in tokens)] = replica
         return replica
 
+    def warmup(self) -> "Fleet":
+        """Pre-compile EVERY replica's step closures before traffic
+        (one throwaway request through each replica's ``warmup()``).
+        Each ``Engine`` instance jits its own closures, so a cold
+        N-replica fleet pays N compiles spread across its first timed
+        windows — the PR 4 bench gotcha ("cold timed runs measure N
+        compiles"), fixed here at the source instead of in a bench
+        comment.  After ``warmup()`` the compilation ledger's
+        zero-retrace contract applies: steady-state traffic AND a
+        failover restarting reclaimed requests on survivors add zero
+        traces (pinned in tests/test_fleet.py).  Replicas without a
+        ``warmup`` method (stubs, remote proxies) are skipped; a
+        fault-harness wrapper delegates to its inner engine without
+        advancing its fault windows.  Returns ``self``."""
+        for rep in self.replicas:
+            fn = getattr(rep, "warmup", None)
+            if callable(fn):
+                fn()
+        self.ring.append("fleet_warmup",
+                         replicas=len(self.replicas))
+        return self
+
     def prefix_owner(self, prompt: Sequence[int]) -> Optional[int]:
         """Replica owning the longest registered prefix of ``prompt``,
         or None."""

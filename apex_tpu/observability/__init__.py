@@ -50,6 +50,19 @@ v8) behind ``bench.py --profile`` and the server's on-demand
 (``Engine.kv_fragmentation`` / ``kv_waste_bytes`` — ROADMAP item 1's
 needle).
 
+And the **compilation plane** (PR 15): ``compilation``, the
+in-process trace/compile ledger over every instrumented jit entry —
+abstract argument signatures, wall durations, persistent-cache
+hit/miss attribution via ``jax.monitoring``, and a retrace-cause
+differ that names *which argument's* shape/dtype/static value changed
+between two traces of one entry.  Serving engines and the fleet route
+their jits through it, giving the zero-retrace steady-state contract
+(warmed engines / failover survivors add exactly 0 traces,
+tier-1-pinned), ``Engine.compile_census`` / ``Fleet.warmup``, the
+supervisor's ``recompilation_storm`` verdict, the ``/compilez``
+endpoint, and bench's schema-v10 ``cold_compile_ms`` /
+``compiles_total`` / ``steady_state_retraces`` fields.
+
 And the **operational plane** (PR 10): ``server``, a stdlib
 ``http.server`` introspection endpoint serving ``/healthz`` /
 ``/metricsz`` (Prometheus exposition, conformance-tested) /
@@ -88,6 +101,8 @@ from .memory import (memory_plan, jaxpr_live_bytes, live_array_bytes,
                      record_live_arrays)
 from .numerics import (NumericsMonitor, divergence_check,
                        divergence_digest, digest_comm_plan)
+from .compilation import (CompilationLedger, instrumented_jit,
+                          diff_signatures, get_ledger, set_ledger)
 from .server import ObservabilityServer
 from .supervisor import RunSupervisor, SupervisorConfig
 from . import metrics
@@ -99,6 +114,7 @@ from . import exporters
 from . import costmodel
 from . import memory
 from . import numerics
+from . import compilation
 from . import server
 from . import supervisor
 
@@ -116,8 +132,10 @@ __all__ = [
     "record_live_arrays",
     "NumericsMonitor", "divergence_check", "divergence_digest",
     "digest_comm_plan",
+    "CompilationLedger", "instrumented_jit", "diff_signatures",
+    "get_ledger", "set_ledger",
     "ObservabilityServer", "RunSupervisor", "SupervisorConfig",
     "metrics", "tracing", "flightrec", "steptime", "timeline",
     "exporters", "costmodel", "memory", "numerics", "server",
-    "supervisor",
+    "supervisor", "compilation",
 ]

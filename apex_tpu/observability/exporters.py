@@ -28,6 +28,7 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
+           "COMPILE_FIELDS",
            "host_info", "JsonlExporter",
            "prometheus_text", "parse_prometheus_text",
            "validate_prometheus_text", "validate_bench_record",
@@ -101,9 +102,28 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # comm-hidden claim is meaningless without the schedule that hid it.
 # The fields are validated whenever present at any version; fresh
 # v9 attribution lines must carry them.
+# v10: the compilation plane.  Fresh train-throughput and engine-decode
+# lines must say what their warmup COMPILED — ``cold_compile_ms``
+# (trace+lower+compile wall time, separated from every timed rate: the
+# PR 4/PR 10 gotcha class of compile seconds folded into a trended
+# number), ``compiles_total`` (tracing dispatches during warmup — a
+# cold fleet measuring N replica re-jits shows N here, not a mystery
+# slowdown) and ``steady_state_retraces`` (compilation-ledger trace
+# DELTA across the timed loop, which must be 0: a steady-state retrace
+# means the measured rate included a recompile).  All three validated
+# whenever present (COMPILE_FIELDS, duplicated from
+# observability.compilation.BENCH_COMPILE_FIELDS and pinned equal in
+# tests); required on fresh v10 lines; ``supervisor`` anomaly kinds
+# grow ``recompilation_storm``.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v8 streams stay valid.
-SCHEMA_VERSION = 9
+# version, so archived v1..v9 streams stay valid.
+SCHEMA_VERSION = 10
+
+# the compile-plane bench fields (stdlib-side duplicate of
+# observability.compilation.BENCH_COMPILE_FIELDS — this module must
+# stay importable without jax; tests pin the tuples equal)
+COMPILE_FIELDS = ("cold_compile_ms", "compiles_total",
+                  "steady_state_retraces")
 
 # which bucket-issue schedule an attribution record measured — the
 # stdlib-side duplicate of parallel.distributed.OVERLAP_MODES /
@@ -454,6 +474,25 @@ def _check_kv_fields(rec, errs):
                         f"{v!r}")
 
 
+def _check_compile_fields(rec, errs):
+    """The compilation-plane field contract (schema v10), validated
+    whenever present: ``cold_compile_ms`` is a non-negative number,
+    ``compiles_total`` / ``steady_state_retraces`` non-negative ints.
+    (Whether a nonzero steady-state retrace count GATES is the trend
+    checker's job — schema-wise the record is honest about it.)"""
+    if "cold_compile_ms" in rec:
+        v = rec["cold_compile_ms"]
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool)
+                or not (v >= 0)):
+            errs.append(f"'cold_compile_ms' must be a number >= 0, "
+                        f"got {v!r}")
+    for key in ("compiles_total", "steady_state_retraces"):
+        if key in rec:
+            v = rec[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{key!r} must be an int >= 0, got {v!r}")
+
+
 def _check_envelope(rec, errs):
     """The common record envelope every exported line carries
     (schema_version / capture host / first-class ``stale``) — one
@@ -512,6 +551,8 @@ def validate_bench_record(rec: Any) -> List[str]:
           and sv_rec >= 3)
     v8 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
           and sv_rec >= 8)
+    v10 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+           and sv_rec >= 10)
     if (isinstance(metric, str) and "engine_decode" in metric
             and "error" not in rec and not rec.get("stale")):
         if "window" not in rec:
@@ -531,6 +572,14 @@ def validate_bench_record(rec: Any) -> List[str]:
                 if key not in rec:
                     errs.append(f"fresh engine decode records must "
                                 f"carry {key!r} (schema v8)")
+        # v10: a decode rate is only a steady-state claim if it says
+        # what warmup compiled and that the timed loop re-traced
+        # nothing — the compile-plane triple
+        if v10:
+            for key in COMPILE_FIELDS:
+                if key not in rec:
+                    errs.append(f"fresh engine decode records must "
+                                f"carry {key!r} (schema v10)")
     # MFU / peak-memory fields (PR 8): a fresh train-step throughput
     # line is only a roofline statement given the model FLOPs behind
     # it — v3 records must say what they computed (flops_per_step,
@@ -554,7 +603,19 @@ def validate_bench_record(rec: Any) -> List[str]:
         pb = _need(rec, errs, "peak_bytes", int)
         if isinstance(pb, int) and not isinstance(pb, bool) and pb < 0:
             errs.append(f"'peak_bytes' must be >= 0, got {pb}")
+    # v10: fresh train-throughput lines carry the compile-plane triple
+    # next to the v3 cost-model fields — a timed rate that cannot say
+    # its compile time was separated out is the gotcha class bench
+    # exists to prevent (cold compiles folded into trended numbers)
+    if (v10 and isinstance(metric, str)
+            and metric.endswith("_train_throughput")
+            and "error" not in rec and not rec.get("stale")):
+        for key in COMPILE_FIELDS:
+            if key not in rec:
+                errs.append(f"fresh train-throughput records must "
+                            f"carry {key!r} (schema v10)")
     _check_kv_fields(rec, errs)
+    _check_compile_fields(rec, errs)
     if "mfu" in rec and rec["mfu"] is not None and (
             not isinstance(rec["mfu"], numbers.Number)
             or isinstance(rec["mfu"], bool)):
@@ -1192,7 +1253,8 @@ def validate_numerics_record(rec: Any) -> List[str]:
 # stdlib-only CI loader never imports the supervisor module; the
 # pytest coverage pins the two tuples equal)
 RUN_ANOMALY_KINDS = ("stall", "loss_spike", "nan",
-                     "throughput_regression", "replica_divergence")
+                     "throughput_regression", "replica_divergence",
+                     "recompilation_storm")
 
 
 def validate_run_record(rec: Any) -> List[str]:

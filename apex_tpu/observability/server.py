@@ -30,6 +30,14 @@ any hot path, no dependencies:
   shape, pinned by tests/ci/server_smoke.py), 409 when a capture is
   already in flight — ``jax.profiler.start_trace`` is a process-wide
   singleton, so concurrent captures cannot be honored.
+- ``/compilez`` — the compilation-plane ledger
+  (:mod:`~apex_tpu.observability.compilation`): per-entry jit
+  trace/retrace/compile counts, persistent-cache hit/miss attribution,
+  compile wall seconds, and each entry's last signature-change retrace
+  with the differ's culprit argument (which argument's
+  shape/dtype/static value changed).  ``?entry=`` narrows to one entry
+  (404 when unknown); an empty ledger serves an empty snapshot, not an
+  error — a jax-free process legitimately has nothing compiled.
 
 Attachment is one call::
 
@@ -66,7 +74,7 @@ __all__ = ["ObservabilityServer", "serve", "ENDPOINTS",
            "ProfileInFlight"]
 
 ENDPOINTS = ("/healthz", "/metricsz", "/statusz", "/flightz", "/tracez",
-             "/profilez")
+             "/profilez", "/compilez")
 
 
 class ProfileInFlight(RuntimeError):
@@ -117,11 +125,13 @@ class ObservabilityServer:
                  health: Optional[Dict[str, Callable[[], Tuple[bool, str]]]]
                  = None,
                  profiler: Optional[Callable] = None,
+                 ledger=None,
                  host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = 512):
         self._registry = registry
         self._ring = ring
         self._recorder = recorder
+        self._ledger = ledger
         self._status: Dict[str, Callable[[], Any]] = dict(status or {})
         self._health: Dict[str, Callable[[], Tuple[bool, str]]] = \
             dict(health or {})
@@ -173,6 +183,10 @@ class ObservabilityServer:
     def recorder(self):
         from .tracing import get_recorder
         return self._resolve(self._recorder, get_recorder)
+
+    def ledger(self):
+        from .compilation import get_ledger
+        return self._resolve(self._ledger, get_ledger)
 
     # -- payload builders (also the in-process test surface) ----------------
     def healthz(self) -> Tuple[int, Dict[str, Any]]:
@@ -250,6 +264,22 @@ class ObservabilityServer:
     def metricsz(self) -> str:
         from .exporters import prometheus_text
         return prometheus_text(self.registry())
+
+    def compilez(self, entry: Optional[str] = None) -> Dict[str, Any]:
+        """The compilation ledger's snapshot (``kind: compilation``):
+        per-entry trace/retrace/compile/cache counts plus the last
+        signature-change retrace's differ verdict.  ``entry=`` narrows
+        the entries map to one entry; unknown raises ``KeyError``
+        (handler → 404).  An empty ledger is a valid, empty snapshot —
+        this endpoint stays jax-free (the server_smoke deployment
+        shape)."""
+        snap = self.ledger().snapshot()
+        if entry is not None:
+            if entry not in snap["entries"]:
+                raise KeyError(entry)
+            snap["entries"] = {entry: snap["entries"][entry]}
+            snap["filter"] = entry
+        return snap
 
     def profilez(self, duration_ms: Optional[float] = None
                  ) -> Dict[str, Any]:
@@ -352,6 +382,14 @@ class ObservabilityServer:
                                 "error": f"no capture available: {e}"})
                         except ProfileInFlight as e:
                             self._send_json(409, {"error": str(e)})
+                    elif route == "/compilez":
+                        ent = q.get("entry", [None])[0]
+                        try:
+                            self._send_json(200,
+                                            srv.compilez(entry=ent))
+                        except KeyError:
+                            self._send_json(404, {
+                                "error": f"unknown entry {ent!r}"})
                     elif route == "/":
                         self._send_json(200, {
                             "endpoints": list(ENDPOINTS)})
@@ -418,7 +456,7 @@ def serve(engine=None, fleet=None, supervisor=None,
           registry=None, ring=None, recorder=None,
           status: Optional[Dict[str, Callable[[], Any]]] = None,
           health: Optional[Dict[str, Callable[[], Tuple[bool, str]]]] = None,
-          profiler: Optional[Callable] = None,
+          profiler: Optional[Callable] = None, ledger=None,
           host: str = "127.0.0.1", port: int = 0,
           start: bool = True) -> ObservabilityServer:
     """One-call attachment: build (and start) an
@@ -440,7 +478,9 @@ def serve(engine=None, fleet=None, supervisor=None,
     arms ``/profilez`` (``timeline.make_profiler()`` builds the
     standard hook); without one the endpoint answers 404 — on-demand
     device captures are an explicit opt-in, never a surprise cost on a
-    serving process.
+    serving process.  ``ledger`` overrides the ``/compilez`` source
+    (default: the process compilation ledger, resolved per request —
+    compilation is process-wide, so engines and fleets share one).
     """
     st: Dict[str, Callable[[], Any]] = {}
     hc: Dict[str, Callable[[], Tuple[bool, str]]] = {}
@@ -475,5 +515,6 @@ def serve(engine=None, fleet=None, supervisor=None,
     hc.update(health or {})
     srv = ObservabilityServer(registry=registry, ring=ring,
                               recorder=recorder, status=st, health=hc,
-                              profiler=profiler, host=host, port=port)
+                              profiler=profiler, ledger=ledger,
+                              host=host, port=port)
     return srv.start() if start else srv

@@ -35,7 +35,15 @@ counter carrying the volume):
   ``throughput_regression_factor`` × the warm step-time EWMA;
 - **replica_divergence** — a flushed numerics divergence digest whose
   ``desync_steps`` advanced (the anomaly carries ``worst_leaf`` and
-  ``max_rel_dev``).
+  ``max_rel_dev``);
+- **recompilation_storm** — repeated *signature-change* retraces of
+  one jit entry within a bounded observation window, fed by the
+  compilation ledger's ``xla_retrace`` flight events
+  (``observability.compilation``): a hot path that was compiled once
+  is now re-tracing per call — shape-polymorphic inputs, a dtype
+  flapping, a static arg churning.  The anomaly names the entry and
+  carries the retrace-cause differ's verdict (the culprit argument
+  plus its before/after signatures), so the fix is one hop away.
 
 Outputs: flight-ring events (``run_stall`` / ``run_loss_spike`` /
 ``run_nan`` / ``run_throughput_regression`` /
@@ -62,7 +70,7 @@ __all__ = ["ANOMALY_KINDS", "SupervisorConfig", "RunSupervisor"]
 # every anomaly kind the supervisor can declare; validate_run_record
 # rejects records naming anything else
 ANOMALY_KINDS = ("stall", "loss_spike", "nan", "throughput_regression",
-                 "replica_divergence")
+                 "replica_divergence", "recompilation_storm")
 
 
 class SupervisorConfig:
@@ -80,6 +88,11 @@ class SupervisorConfig:
     - ``throughput_regression_factor`` / ``step_time_alpha``: same
       shape for the per-observation step time (higher = slower =
       regressed);
+    - ``storm_retraces`` / ``storm_window_observations``: at least
+      ``storm_retraces`` signature-change retraces of ONE jit entry
+      (``xla_retrace`` flight events from the compilation ledger)
+      within the last ``storm_window_observations`` observations
+      declare a recompilation storm for that entry;
     - ``max_anomalies``: bound on the retained anomaly *detail* list
       (the counts are exact forever; a weeks-long sick run keeps the
       most recent details, flight-ring discipline).
@@ -91,6 +104,8 @@ class SupervisorConfig:
                  loss_alpha: float = 0.2,
                  throughput_regression_factor: float = 1.5,
                  step_time_alpha: float = 0.2,
+                 storm_retraces: int = 3,
+                 storm_window_observations: int = 20,
                  max_anomalies: int = 256):
         if stall_observations < 1:
             raise ValueError(f"stall_observations must be >= 1, got "
@@ -108,6 +123,12 @@ class SupervisorConfig:
                         ("step_time_alpha", step_time_alpha)):
             if not (0.0 < a <= 1.0):
                 raise ValueError(f"{name} must be in (0, 1], got {a}")
+        if storm_retraces < 1:
+            raise ValueError(f"storm_retraces must be >= 1, got "
+                             f"{storm_retraces}")
+        if storm_window_observations < 1:
+            raise ValueError(f"storm_window_observations must be >= 1, "
+                             f"got {storm_window_observations}")
         if max_anomalies < 1:
             raise ValueError(f"max_anomalies must be >= 1, got "
                              f"{max_anomalies}")
@@ -117,6 +138,8 @@ class SupervisorConfig:
         self.loss_alpha = loss_alpha
         self.throughput_regression_factor = throughput_regression_factor
         self.step_time_alpha = step_time_alpha
+        self.storm_retraces = storm_retraces
+        self.storm_window_observations = storm_window_observations
         self.max_anomalies = max_anomalies
 
 
@@ -198,6 +221,13 @@ class RunSupervisor:
         self._ring_seq_seen = self.ring.total
         self._ckpt_count = 0
         self._ckpt_step: Optional[int] = None
+        # recompilation-storm feed: per-entry log of consumed
+        # ``xla_retrace`` flight events, stamped with the observation
+        # that consumed them so the window is observation-counted like
+        # every other detector (bounded per entry, ring discipline)
+        self._retrace_log: Dict[str, deque] = {}
+        self._retrace_total = 0
+        self._in_storm: set = set()
         self._scaler: Dict[str, Any] = {}
         self._comm: Dict[str, Any] = {}
         # recovery-in-flight (PR 11): set by the recovery controller
@@ -251,12 +281,16 @@ class RunSupervisor:
         return ev
 
     def _consume_ring(self) -> bool:
-        """Consume new ``checkpoint_saved`` flight events (the
-        supervisor's other progress feeder): a run that is writing
-        checkpoints is making durable progress even when the caller
-        has no step counter to report.  The cheap total==seen guard
-        skips the snapshot copy on the (typical) quiet step, and the
-        watermark advances only past what the snapshot actually
+        """Consume the supervisor's flight-ring feeds in one snapshot:
+        ``checkpoint_saved`` events (the other progress feeder — a run
+        writing checkpoints is making durable progress even when the
+        caller has no step counter to report; only these affect the
+        returned ``progressed`` bool) and ``xla_retrace`` events (the
+        compilation ledger's signature-change retraces, stamped with
+        the consuming observation into the per-entry log the
+        recompilation-storm detector reads).  The cheap total==seen
+        guard skips the snapshot copy on the (typical) quiet step, and
+        the watermark advances only past what the snapshot actually
         contained — an event appended concurrently with the scan is
         consumed on the next one, never skipped."""
         ring = self.ring
@@ -266,9 +300,30 @@ class RunSupervisor:
         snap = ring.snapshot()
         if snap:
             self._ring_seq_seen = snap[-1]["seq"] + 1
-        new = [ev for ev in snap
-               if ev["seq"] >= seen
-               and ev["kind"] == "checkpoint_saved"]
+        fresh = [ev for ev in snap if ev["seq"] >= seen]
+        # the compilation ledger's signature-change retraces feed the
+        # recompilation-storm detector; stamped with THIS observation
+        # so the storm window stays observation-counted
+        for ev in fresh:
+            if ev["kind"] != "xla_retrace":
+                continue
+            entry = str(ev.get("entry") or "?")
+            log = self._retrace_log.get(entry)
+            if log is None:
+                # retained bound sized to the threshold: a config with
+                # storm_retraces > 64 must still be able to accumulate
+                # enough events to fire (the count would otherwise cap
+                # below the threshold and the detector silently never
+                # trip)
+                log = self._retrace_log[entry] = deque(
+                    maxlen=max(64, self.config.storm_retraces))
+            log.append({"observation": self._observations,
+                        "cause": ev.get("cause"),
+                        "culprit": ev.get("culprit"),
+                        "before": ev.get("before"),
+                        "after": ev.get("after")})
+            self._retrace_total += 1
+        new = [ev for ev in fresh if ev["kind"] == "checkpoint_saved"]
         if not new:
             return False
         self._ckpt_count += len(new)
@@ -323,6 +378,30 @@ class RunSupervisor:
                 observations_without_progress=(
                     self._observations - self._watermark_obs),
                 watermark=self._watermark))
+
+        # recompilation storm: >= storm_retraces signature-change
+        # retraces of ONE entry inside the observation window.  Fires
+        # on the transition per entry (episode rule); the verdict
+        # detail carries the retrace-cause differ's culprit signature
+        # so /statusz names WHICH argument keeps changing.
+        floor = self._observations - cfg.storm_window_observations
+        for entry, log in self._retrace_log.items():
+            recent = [ev for ev in log if ev["observation"] > floor]
+            if len(recent) >= cfg.storm_retraces:
+                if entry not in self._in_storm:
+                    self._in_storm.add(entry)
+                    last = recent[-1]
+                    found.append(self._anomaly(
+                        "recompilation_storm", entry=entry,
+                        retraces_in_window=len(recent),
+                        window_observations=(
+                            cfg.storm_window_observations),
+                        cause=last.get("cause"),
+                        culprit=last.get("culprit"),
+                        before=last.get("before"),
+                        after=last.get("after")))
+            else:
+                self._in_storm.discard(entry)
 
         # loss: NaN/inf is an immediate anomaly — fired on the
         # TRANSITION into nonfinite (a loss that stays NaN is one
@@ -555,6 +634,9 @@ class RunSupervisor:
             "preempted_step": self._preempted_step,
             "anomaly_counts": dict(self._counts),
             "anomaly_total": self.anomaly_total,
+            "recompilation": {
+                "retrace_events": self._retrace_total,
+                "entries_in_storm": sorted(self._in_storm)},
             "loss": {"last": self._last_loss,
                      "ewma": self._loss_ewma},
             "step_time_s": {"last": self._last_step_time,

@@ -71,6 +71,13 @@ from jax import lax
 
 from .models.speculative import _head_logits
 from .observability import MetricsRegistry
+# every engine jit routes through the compilation ledger: the entry
+# label + abstract-signature record is what the zero-retrace
+# steady-state contract (tests/test_serving.py) and the fleet's
+# survivors-recompile-nothing pin measure deltas over.  The wrapper's
+# bookkeeping is host-side python — the traced graphs are unchanged,
+# so the donation/host-transfer audits hold as before.
+from .observability.compilation import instrumented_jit
 # ambient-gated spans: these record ONLY when a distributed-trace
 # context is active on the calling thread (a fleet dispatching a traced
 # request), so a standalone engine pays one contextvar read per call
@@ -443,6 +450,40 @@ class _SlotScheduler:
     def live(self) -> int:
         return len(self._by_slot)
 
+    def compile_census(self) -> Dict[str, str]:
+        """The expected-closure compile census: every compilation-
+        ledger entry THIS engine's configuration will trace, mapped to
+        the lifecycle stage that first traces it (``admission`` /
+        ``decode`` trace during :meth:`warmup`; ``register_prefix`` /
+        ``prefix_admission`` trace when the prefix pool is actually
+        used).  The zero-retrace contract tests compare the ledger's
+        observed entries against this — a closure compiling that the
+        census does not name is a compile-plane surprise."""
+        return {}
+
+    def warmup(self):
+        """Pre-compile the engine's admission + decode closures before
+        traffic by running ONE throwaway request (1-token prompt, one
+        window) end to end.  Every ``Engine`` instance re-jits its own
+        closures, so a cold fleet pays N compiles on its first timed
+        window unless each replica is warmed first — the PR 4 bench
+        gotcha, fixed at the source here (``Fleet.warmup`` fans this
+        out over its replicas).  Requires an idle engine; the warmup
+        request is scrubbed from ``result()`` but does consume one
+        request id and feeds the admission/decode histograms (a
+        sampled engine's default rid-keyed streams shift by one —
+        pass explicit seeds where exactness against an unwarmed twin
+        matters).  Returns ``self``."""
+        if self._by_slot or self._waiting:
+            raise RuntimeError(
+                "warmup() needs an idle engine (no live or queued "
+                "requests); warm before traffic")
+        rid = self.add_request([0], max_new_tokens=1)
+        while not self.is_finished(rid):
+            self.step()
+        self._finished.pop(rid, None)
+        return self
+
     def _kv_buffers(self):
         """Pytrees of device-resident KV state this engine owns —
         subclasses override; the base scheduler has none."""
@@ -734,8 +775,9 @@ class Engine(_SlotScheduler):
         # donate_argnums on every cache mutator: the KV buffers are
         # scattered/updated in place instead of XLA holding the old
         # multi-GB cache alive next to the new one per dispatch
-        self._prefill_slot = jax.jit(_prefill_slot,
-                                     donate_argnums=(0, 1, 2))
+        self._prefill_slot = instrumented_jit(
+            _prefill_slot, "engine._prefill_slot",
+            arg_names=PREFILL_SLOT_ARG_NAMES, donate_argnums=(0, 1, 2))
 
         if rolling:
             W = self._window
@@ -764,8 +806,10 @@ class Engine(_SlotScheduler):
                                                       axis=0)
                 return ids, cache
 
-            self._prefill_slot_rolling = jax.jit(
-                _prefill_slot_rolling, donate_argnums=(0, 1))
+            self._prefill_slot_rolling = instrumented_jit(
+                _prefill_slot_rolling, "engine._prefill_slot_rolling",
+                arg_names=("ids", "cache", "slot", "row", "plen"),
+                donate_argnums=(0, 1))
 
         # -- prefix-sharing pool ------------------------------------------
         if prefix_chunk < 1:
@@ -789,8 +833,10 @@ class Engine(_SlotScheduler):
                                    row)
                 return pool_cache, d_pool
 
-            self._seed_pool = jax.jit(_seed_pool,
-                                      donate_argnums=(0, 1))
+            self._seed_pool = instrumented_jit(
+                _seed_pool, "engine._seed_pool",
+                arg_names=("pool_cache", "d_pool", "idx", "row"),
+                donate_argnums=(0, 1))
 
             # splice = one row gather from the pool, K suffix chunks on
             # the (1, ...) ROW cache (not the whole multi-slot tree —
@@ -809,16 +855,26 @@ class Engine(_SlotScheduler):
 
             # _take_row must NOT donate: the pool rows are the shared
             # prefix capital, reused by every later matching admission
-            self._take_row = jax.jit(_take_row)
-            self._put_row = jax.jit(_put_row, donate_argnums=(0,))
+            self._take_row = instrumented_jit(
+                _take_row, "engine._take_row",
+                arg_names=("cache", "idx"))
+            self._put_row = instrumented_jit(
+                _put_row, "engine._put_row",
+                arg_names=("cache", "rc", "slot"), donate_argnums=(0,))
             self._chunk_row = {
-                "cache": jax.jit(lambda rc, t, o: model.decode_chunk(
-                    params, t, jnp.full((1,), o, jnp.int32), rc)[1])}
+                "cache": instrumented_jit(
+                    lambda rc, t, o: model.decode_chunk(
+                        params, t, jnp.full((1,), o, jnp.int32),
+                        rc)[1],
+                    "engine._chunk_row",
+                    arg_names=("rc", "toks", "off"))}
             if draft is not None:
-                self._chunk_row["d_cache"] = jax.jit(
+                self._chunk_row["d_cache"] = instrumented_jit(
                     lambda rc, t, o: draft.decode_chunk(
                         draft_params, t, jnp.full((1,), o, jnp.int32),
-                        rc)[1])
+                        rc)[1],
+                    "engine._chunk_row_draft",
+                    arg_names=("rc", "toks", "off"))
 
         if draft is not None:
             from .models.speculative import spec_iteration
@@ -835,7 +891,11 @@ class Engine(_SlotScheduler):
             # are fine, cache loads decode garbage; pinned by running
             # the serving suite twice against one cache dir).  The
             # multi-GB wins are the two cache trees; ids rides along.
-            self._sstep = jax.jit(_sstep, donate_argnums=(0, 3, 4))
+            self._sstep = instrumented_jit(
+                _sstep, "engine._sstep",
+                arg_names=("ids", "cur_len", "limit", "t_cache",
+                           "d_cache"),
+                donate_argnums=(0, 3, 4))
 
         K = self.window
 
@@ -911,7 +971,9 @@ class Engine(_SlotScheduler):
         # corrupts executables reloaded from the persistent XLA:CPU
         # compilation cache (see _sstep below), and donating a
         # (slots,)-int32 buys nothing anyway
-        self._step_k = jax.jit(_step_k, donate_argnums=(0, 2, 3))
+        self._step_k = instrumented_jit(
+            _step_k, "engine._step_k", arg_names=STEP_K_ARG_NAMES,
+            donate_argnums=(0, 2, 3))
         self._slot_keys = jax.vmap(
             lambda i: jax.random.fold_in(self._key, i))(
             jnp.arange(slots))
@@ -1137,6 +1199,21 @@ class Engine(_SlotScheduler):
                               "kv_waste_bytes": pool_row - used_b})
         return slots, pools
 
+    def compile_census(self) -> Dict[str, str]:
+        census: Dict[str, str] = {}
+        census["engine._prefill_slot_rolling" if self.rolling
+               else "engine._prefill_slot"] = "admission"
+        census["engine._sstep" if self.draft is not None
+               else "engine._step_k"] = "decode"
+        if self.prefix_pool > 0:
+            census["engine._seed_pool"] = "register_prefix"
+            census["engine._take_row"] = "prefix_admission"
+            census["engine._put_row"] = "prefix_admission"
+            census["engine._chunk_row"] = "prefix_admission"
+            if self.draft is not None:
+                census["engine._chunk_row_draft"] = "prefix_admission"
+        return census
+
     def stats(self) -> Dict[str, Any]:
         """Base snapshot plus prefix-cache effectiveness: splice
         admissions so far and the hit rate over all admissions (0.0 on
@@ -1197,9 +1274,11 @@ class Seq2SeqEngine(_SlotScheduler):
 
         # donate the slot state: the encoder scatter updates the cross
         # K/V + decoder cache in place instead of duplicating them
-        self._seed = jax.jit(
+        self._seed = instrumented_jit(
             lambda st, slot, row, n: model.seed_slot_seq2seq(
-                params, st, slot, row, n), donate_argnums=(0,))
+                params, st, slot, row, n),
+            "seq2seq._seed", arg_names=("state", "slot", "row", "n"),
+            donate_argnums=(0,))
 
         def _step_k(state, out, n_new, limit, eos):
             """K decoder ticks in-graph; same freeze/validity contract
@@ -1236,7 +1315,13 @@ class Seq2SeqEngine(_SlotScheduler):
 
         # state + out donated; n_new deliberately not (the per-slot
         # length vector — see the donation note on Engine._step_k)
-        self._step_k = jax.jit(_step_k, donate_argnums=(0, 1))
+        self._step_k = instrumented_jit(
+            _step_k, "seq2seq._step_k",
+            arg_names=SEQ2SEQ_STEP_K_ARG_NAMES, donate_argnums=(0, 1))
+
+    def compile_census(self) -> Dict[str, str]:
+        return {"seq2seq._seed": "admission",
+                "seq2seq._step_k": "decode"}
 
     def _kv_buffers(self):
         # per-slot seq2seq state: cross-attention K/V + decoder cache

@@ -7,7 +7,13 @@ Every stdout line bench emits must be a JSON object carrying
 serving decode lines (metric containing ``engine_decode``) must also
 carry the decode-window fields: ``window`` (int >= 1, in-graph decode
 ticks per host sync) and a tokens/sec unit — the w1-vs-wK comparison
-is meaningless without them.  Gradient-allreduce comm microbench lines (``bench.py --comm``) carry
+is meaningless without them — and, at schema v10, the compile-plane
+triple (``cold_compile_ms`` / ``compiles_total`` /
+``steady_state_retraces``), which fresh ``*_train_throughput`` lines
+must carry too: a timed rate is only a steady-state claim if its
+compile time was separated out and the timed loop provably re-traced
+nothing.
+Gradient-allreduce comm microbench lines (``bench.py --comm``) carry
 ``comm_topology`` and must then state the per-level wire bytes
 (``ici_wire_bytes`` / ``dcn_wire_bytes`` / ``wire_bytes``), the
 ``compress`` flag and the ``ici_size`` / ``dcn_size`` level widths —

@@ -50,6 +50,18 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    as throughput.  Stale replays are partitioned out of both trends
    exactly like throughput lines.
 
+5. **Compile-plane regression** (schema v10 compile fields).  A fresh
+   line carrying ``steady_state_retraces`` > 0 is an ERROR on every
+   backend: the compilation ledger saw a jit re-trace DURING the timed
+   loop, so the trended rate includes a recompile — that is a
+   deterministic contract violation, not timing noise (the zero-
+   retrace steady state is tier-1-pinned; a bench line breaking it
+   means the measured configuration regressed the contract).
+   ``cold_compile_ms`` growth past ``--tol`` follows the
+   accelerator-gates / CPU-warns policy like MFU — compile time is
+   wall-clock, but a 2x jump on hardware is a real compile-plane
+   regression (a new shape family, a cache stopped hitting).
+
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
@@ -202,6 +214,9 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # comm-overlap trends (schema v9 fields on attribution/profile
     # metric lines)
     last_overlap = {}
+    # (metric, backend) -> (round_name, cold_compile_ms) of the
+    # compile-plane trend (schema v10)
+    last_compile = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
 
@@ -260,6 +275,16 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
         subject = rec.get("metric")
         if not isinstance(subject, str) or not subject:
             return
+        ctx = rec.get("compute_twin_excess_ms")
+        if isinstance(ctx, (int, float)) and not isinstance(ctx, bool) \
+                and ctx > 0:
+            # the attribution flagged its own compute twin as slower
+            # than the full step (oversubscribed-host rendezvous
+            # staggering): the clamp forces comm_ms=0 /
+            # overlap_fraction=1.0 on that record, and seeding the
+            # baseline with those perfect-overlap numbers would gate
+            # the NEXT healthy round as a phantom regression
+            return
         for field, better in (("overlap_fraction", "higher"),
                               ("measured_overlap_fraction", "higher"),
                               ("comm_visible_ms", "lower")):
@@ -310,6 +335,51 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                     warnings.append(msg + " [cpu smoke: warning only]")
                 else:
                     errors.append(msg)
+
+    def track_compile_fields(rname, rec):
+        """Compile-plane gates for one fresh metric line (schema v10).
+        A nonzero steady-state retrace count gates on EVERY backend —
+        the ledger counting traces during the timed loop is
+        deterministic, so there is no noise excuse; cold_compile_ms
+        growth is wall-clock and follows the accelerator-gates /
+        CPU-warns policy."""
+        subject = rec.get("metric")
+        if not isinstance(subject, str) or not subject:
+            return
+        ssr = rec.get("steady_state_retraces")
+        if isinstance(ssr, int) and not isinstance(ssr, bool) and ssr > 0:
+            errors.append(
+                f"{rname}: {subject} [{rec.get('backend') or '?'}] "
+                f"measured {ssr} steady-state retrace(s) — the timed "
+                f"loop re-traced a jit entry, so the trended rate "
+                f"includes a recompile (the zero-retrace contract "
+                f"this line must hold; see /compilez for the culprit "
+                f"signature)")
+        cc = rec.get("cold_compile_ms")
+        if (not isinstance(cc, (int, float)) or isinstance(cc, bool)
+                or cc <= 0):
+            return
+        key = (subject, rec.get("backend"))
+        prev = last_compile.get(key)
+        last_compile[key] = (rname, float(cc))
+        if prev is None:
+            return
+        pname, pval = prev
+        if pval <= 0:
+            return
+        growth = (cc - pval) / pval
+        if growth > tol:
+            msg = (f"{rname}: {subject} "
+                   f"[{rec.get('backend') or '?'}] cold_compile_ms "
+                   f"grew {growth * 100:.0f}% vs {pname} "
+                   f"({pval:.4g} -> {cc:.4g} ms, tol "
+                   f"{tol * 100:.0f}%) — the compile plane regressed "
+                   f"(new shape family, persistent cache stopped "
+                   f"hitting, or a slower lowering)")
+            if is_cpu(rec) and not strict_cpu:
+                warnings.append(msg + " [cpu smoke: warning only]")
+            else:
+                errors.append(msg)
 
     for rname, recs in rounds:
         wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
@@ -368,6 +438,7 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             n_fresh += 1
             track_cost_fields(rname, rec)
             track_overlap_fields(rname, rec)
+            track_compile_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:

@@ -11,9 +11,12 @@ content — including label values that NEED exposition escaping — then:
 
 1. starts :class:`ObservabilityServer` on ``127.0.0.1:0``;
 2. scrapes ``/healthz`` ``/metricsz`` ``/statusz`` ``/flightz``
-   ``/tracez`` (and ``/tracez?trace_id=``) over real HTTP, plus the
+   ``/tracez`` (and ``/tracez?trace_id=``) over real HTTP, the
    ``/profilez`` no-capture shape — with no profiler hook attached
-   (the jax-free deployment) the endpoint must answer 404, never 500;
+   (the jax-free deployment) the endpoint must answer 404, never 500 —
+   and ``/compilez`` against a jax-free compilation ledger seeded with
+   a shape retrace, whose differ verdict (culprit argument) must be on
+   the snapshot;
 3. validates ``/metricsz`` against the exposition-format conformance
    checker (``validate_prometheus_text``: TYPE/HELP lines, label
    escaping round-trip, +Inf buckets, cumulative monotonicity);
@@ -50,7 +53,7 @@ def _load_obs():
     sys.modules["_obs_smoke"] = pkg
     mods = {}
     for sub in ("metrics", "exporters", "flightrec", "tracing",
-                "supervisor", "server"):
+                "supervisor", "compilation", "server"):
         sspec = importlib.util.spec_from_file_location(
             f"_obs_smoke.{sub}", os.path.join(pkg_dir, sub + ".py"))
         mod = importlib.util.module_from_spec(sspec)
@@ -74,6 +77,7 @@ def main(argv):
     metrics, exporters = mods["metrics"], mods["exporters"]
     flightrec, tracing = mods["flightrec"], mods["tracing"]
     supervisor, server = mods["supervisor"], mods["server"]
+    compilation = mods["compilation"]
 
     # representative content, incl. escape-needing label values
     reg = metrics.MetricsRegistry()
@@ -94,8 +98,21 @@ def main(argv):
     sup = supervisor.RunSupervisor("smoke_run", registry=reg, ring=ring)
     sup.observe_step(step=0, loss=1.0, step_time_s=0.01)
 
+    # a jax-free compilation ledger with a seeded retrace: one entry
+    # traced twice at different shapes, so /compilez must show the
+    # differ's culprit argument (the endpoint's whole point)
+    led = compilation.CompilationLedger(registry=reg, ring=ring)
+    led.record_trace("engine._step_k",
+                     {"ids": {"leaves": [["int32", [4, 32]]]},
+                      "cur_len": {"leaves": [["int32", [4]]]}},
+                     closure_id=0)
+    led.record_trace("engine._step_k",
+                     {"ids": {"leaves": [["int32", [4, 48]]]},
+                      "cur_len": {"leaves": [["int32", [4]]]}},
+                     closure_id=0)
+
     srv = server.ObservabilityServer(
-        registry=reg, ring=ring, recorder=rec,
+        registry=reg, ring=ring, recorder=rec, ledger=led,
         status={"run": sup.status,
                 "boom": lambda: (_ for _ in ()).throw(
                     RuntimeError("seeded source failure"))},
@@ -174,6 +191,32 @@ def main(argv):
             errs.append(f"/profilez with bad duration expected 400, "
                         f"got {code}")
 
+        # /compilez — the ledger snapshot with the seeded retrace's
+        # differ verdict (jax-free: record_trace is pure host python)
+        code, _, body = _get(base + "/compilez")
+        cz = json.loads(body)
+        if code != 200 or cz.get("kind") != "compilation":
+            errs.append(f"/compilez expected 200 kind=compilation, "
+                        f"got {code} {cz.get('kind')!r}")
+        ent = cz.get("entries", {}).get("engine._step_k", {})
+        if ent.get("traces") != 2 or ent.get("retraces") != 1:
+            errs.append(f"/compilez entry counts wrong: {ent}")
+        lr = ent.get("last_retrace") or {}
+        if lr.get("cause") != "shape" or lr.get("culprit") != "ids":
+            errs.append(f"/compilez last_retrace must name the shape "
+                        f"culprit 'ids', got {lr}")
+        if cz.get("totals", {}).get("traces") != 2:
+            errs.append(f"/compilez totals wrong: {cz.get('totals')}")
+        code, _, body = _get(base + "/compilez?entry=engine._step_k")
+        fz1 = json.loads(body)
+        if code != 200 or list(fz1.get("entries", {})) != \
+                ["engine._step_k"]:
+            errs.append(f"/compilez ?entry= filter broken: {code}")
+        code, _, _ = _get(base + "/compilez?entry=nope")
+        if code != 404:
+            errs.append(f"/compilez unknown entry expected 404, got "
+                        f"{code}")
+
         # sick supervisor flips /healthz to 503
         sup.observe_step(step=1, loss=float("nan"))
         code, _, body = _get(base + "/healthz")
@@ -188,8 +231,9 @@ def main(argv):
         print(f"server_smoke: {e}", file=sys.stderr)
     if errs:
         return 1
-    print("server_smoke: all 6 endpoints OK (exposition conformant, "
-          "schemas valid, profilez no-capture 404, sick-run 503)")
+    print("server_smoke: all 7 endpoints OK (exposition conformant, "
+          "schemas valid, profilez no-capture 404, compilez retrace "
+          "differ verdict served, sick-run 503)")
     return 0
 
 

@@ -97,7 +97,25 @@ if not os.environ.get("APEX_TPU_NO_COMPILE_CACHE"):
                      ".jax_compile_cache"))
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(_cache_dir))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # APEX_TPU_COMPILE_CACHE_MIN_S=0 makes EVERY compile cacheable —
+    # tests/ci/double_run.py needs that so its run-2 cache-HIT
+    # measurement (the compilation ledger's positive gate) isn't
+    # spoiled by sub-threshold toy compiles that were never written
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("APEX_TPU_COMPILE_CACHE_MIN_S", "0.5")))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Dump the compilation ledger at session end when asked
+    (APEX_TPU_COMPILATION_LEDGER_DUMP=path): tests/ci/double_run.py
+    reads the two runs' dumps to assert the warm run's serving
+    compiles were persistent-cache HITS — a positive measurement of
+    the AOT reload actually happening, on top of the runs passing."""
+    path = os.environ.get("APEX_TPU_COMPILATION_LEDGER_DUMP")
+    if path:
+        from apex_tpu.observability import compilation
+        compilation.get_ledger().dump(path)
 
 
 def assert_trees_close(a, b, atol):

@@ -872,7 +872,10 @@ def test_telemetry_jsonl_validates_mixed_stream():
          "arch": "gpt", "window": 8, "tokens_per_sync": 8.0,
          "kv_cache_bytes": 65536,     # required fresh at schema v3
          # the kv fragmentation pair, required fresh at schema v8
-         "kv_waste_bytes": 16384, "kv_utilization": 0.75})
+         "kv_waste_bytes": 16384, "kv_utilization": 0.75,
+         # the compile-plane triple, required fresh at schema v10
+         "cold_compile_ms": 120.5, "compiles_total": 2,
+         "steady_state_retraces": 0})
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
     fleet_rec = exporters.JsonlExporter.enrich(

@@ -1029,3 +1029,62 @@ def test_failed_dispatch_request_counts_as_slo_miss():
     assert s["with_deadline"] == 1 and s["within_deadline"] == 0
     assert s["slo_attainment"] == 0.0
     assert fl.metrics.get("fleet_slo_miss_total").value == 1
+
+
+# -- PR 15: the compilation plane ------------------------------------------
+
+def test_fleet_warmup_precompiles_every_replica():
+    """Fleet.warmup() pays each replica's per-instance re-jit up
+    front (the PR 4 cold-fleet-measures-N-compiles gotcha, fixed at
+    the source): after warmup, a full traffic pass adds ZERO traces.
+    Stub replicas without a warmup() method are skipped, so the stub
+    suites keep working unchanged."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    led = compilation.get_ledger()
+    t0 = led.total_traces()
+    fl = Fleet([serving.Engine(m, params, slots=2, buf_len=24)
+                for _ in range(2)], policy="least_loaded")
+    fl.warmup()
+    # 2 replicas x (prefill + step) — each instance re-jits its own
+    assert led.total_traces() - t0 == 4
+    t1 = led.total_traces()
+    rng = np.random.RandomState(0)
+    rids = [fl.submit(list(rng.randint(0, 64, int(rng.randint(3, 9)))),
+                      max_new_tokens=5) for _ in range(6)]
+    _drive(fl)
+    assert all(fl.status(r) == "finished" for r in rids)
+    assert led.total_traces() - t1 == 0
+    # duck-typing: a stub fleet warms to a no-op instead of crashing
+    Fleet([_StubReplica(), _StubReplica()]).warmup()
+
+
+def test_failover_survivors_recompile_nothing():
+    """The fleet-level zero-retrace pin: a warmed fleet loses a
+    replica mid-run; the reclaimed requests RESTART from their
+    prompts on the survivor with ledger delta == 0 — failover rides
+    entirely on executables the survivor already owns (restarted
+    prompts are new buffer values, not new signatures)."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    bad = FaultyReplica(serving.Engine(m, params, slots=2, buf_len=24),
+                        raise_on_step=(3, None))
+    fl = Fleet([bad, serving.Engine(m, params, slots=2, buf_len=24)],
+               policy="round_robin",
+               health=HealthConfig(dead_consecutive=2,
+                                   cooldown_steps=50),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0))
+    fl.warmup()                       # incl. the wrapped replica
+    led = compilation.get_ledger()
+    t0 = led.total_traces()
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(0, 64, int(rng.randint(3, 9))))
+               for _ in range(6)]
+    rids = [fl.submit(p, max_new_tokens=7) for p in prompts]
+    _drive(fl, limit=300)
+    s = fl.stats()
+    assert s["failovers"] >= 1        # the death actually fired
+    assert s["failed"] == 0           # every request survived
+    for r in rids:
+        assert fl.status(r) == "finished"
+    assert led.total_traces() - t0 == 0   # survivors compiled NOTHING

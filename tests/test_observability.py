@@ -799,7 +799,10 @@ def test_bench_record_schema_serving_decode_window_fields():
             "backend": "cpu", "ndev": 8, "arch": "cpu",
             "kv_cache_bytes": 16384,    # required fresh at schema v3
             # required fresh at schema v8 (KV fragmentation pair)
-            "kv_waste_bytes": 4096, "kv_utilization": 0.75}
+            "kv_waste_bytes": 4096, "kv_utilization": 0.75,
+            # required fresh at schema v10 (compile-plane triple)
+            "cold_compile_ms": 350.0, "compiles_total": 2,
+            "steady_state_retraces": 0}
     good = exporters.JsonlExporter.enrich(
         dict(base, window=8, tokens_per_sync=7.5))
     assert exporters.validate_bench_record(good) == []
@@ -877,7 +880,10 @@ def test_bench_emits_schema_valid_jsonl(tmp_path):
          "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite",
          # schema-v3 cost-model fields every fresh train line carries
          "flops_per_step": 3.15e12, "achieved_tflops": 45.0,
-         "mfu": 0.228, "peak_bytes": 9_000_000_000})
+         "mfu": 0.228, "peak_bytes": 9_000_000_000,
+         # schema-v10 compile-plane triple (fresh train lines)
+         "cold_compile_ms": 5400.0, "compiles_total": 1,
+         "steady_state_retraces": 0})
     assert exporters.validate_bench_record(fresh) == []
     # the v3 requirement bites: a fresh train line without them flags
     bare = {k: v for k, v in fresh.items()
@@ -1914,3 +1920,168 @@ def test_check_bench_trend_partitions_profile_records(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "0 fresh measurements counted" in r.stderr
     assert "1 stale replays partitioned out" in r.stderr
+
+
+# -- PR 15: the compilation plane ------------------------------------------
+
+def test_v10_compile_fields_and_version_gating():
+    """Schema v10 (the compilation plane): fresh train-throughput and
+    engine-decode lines must carry the compile-plane triple
+    (cold_compile_ms / compiles_total / steady_state_retraces); the
+    fields are value-checked wherever they appear; archived v1-v9
+    streams re-validate clean at their declared versions."""
+    assert exporters.SCHEMA_VERSION >= 10
+    base = {"metric": "resnet18_o2_train_throughput", "value": 100.0,
+            "unit": "images/sec/chip", "vs_baseline": None,
+            "backend": "tpu", "ndev": 1, "arch": "TPU v5 lite",
+            "flops_per_step": 1e12, "achieved_tflops": 10.0,
+            "mfu": 0.1, "peak_bytes": 1_000_000,
+            "cold_compile_ms": 1234.5, "compiles_total": 1,
+            "steady_state_retraces": 0}
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(dict(base))) == []
+    # fresh v10 train line missing any of the triple flags
+    for key in exporters.COMPILE_FIELDS:
+        rec = exporters.JsonlExporter.enrich(
+            {k: v for k, v in base.items() if k != key})
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), key
+    # ...but the same line DECLARING v9 (an archived stream) is valid
+    v9 = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items()
+         if k not in exporters.COMPILE_FIELDS})
+    v9["schema_version"] = 9
+    assert exporters.validate_bench_record(v9) == []
+    # stale replays and error lines stay exempt
+    stale = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items()
+         if k not in exporters.COMPILE_FIELDS}, stale=True)
+    assert exporters.validate_bench_record(stale) == []
+    err = exporters.JsonlExporter.enrich(
+        {"metric": "resnet18_o2_train_throughput", "value": None,
+         "unit": None, "vs_baseline": None, "backend": "tpu",
+         "ndev": 1, "arch": "TPU v5 lite", "error": "hung"})
+    assert exporters.validate_bench_record(err) == []
+    # field VALUES are checked wherever the fields appear (any metric)
+    plain = {"metric": "m", "value": 1.0, "unit": "x",
+             "vs_baseline": None, "backend": "cpu", "ndev": 8,
+             "arch": "cpu"}
+    for key, bad in (("cold_compile_ms", -1.0),
+                     ("cold_compile_ms", "slow"),
+                     ("compiles_total", -1),
+                     ("compiles_total", 1.5),
+                     ("compiles_total", True),
+                     ("steady_state_retraces", -2),
+                     ("steady_state_retraces", "none")):
+        rec = exporters.JsonlExporter.enrich(dict(plain, **{key: bad}))
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), \
+            (key, bad)
+    # a nonzero steady-state retrace count is schema-VALID (the record
+    # is honest about it) — gating it is the trend checker's job
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(
+            dict(plain, steady_state_retraces=3))) == []
+
+
+def test_compile_fields_pinned_to_compilation_module():
+    """exporters.COMPILE_FIELDS is the stdlib-side duplicate of
+    compilation.BENCH_COMPILE_FIELDS (both modules must stay
+    importable without jax) — pinned equal so the two cannot drift."""
+    from apex_tpu.observability import compilation
+    assert exporters.COMPILE_FIELDS == compilation.BENCH_COMPILE_FIELDS
+
+
+def test_check_bench_trend_compile_gate(tmp_path):
+    """The compile-plane trend gates: a fresh line with a nonzero
+    steady_state_retraces errors on EVERY backend (the ledger count is
+    deterministic — the timed loop included a recompile), and
+    cold_compile_ms growth past --tol gates on accelerators / warns on
+    CPU smoke like every timing-derived column."""
+    def line(backend, value, cold_ms, retraces=0):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "gpt_tiny_engine_decode_throughput",
+             "value": value, "unit": "tokens/sec/chip",
+             "vs_baseline": None, "backend": backend, "ndev": 8,
+             "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
+             "window": 8, "tokens_per_sync": 7.5,
+             "kv_cache_bytes": 16384, "kv_waste_bytes": 4096,
+             "kv_utilization": 0.75,
+             "cold_compile_ms": cold_ms, "compiles_total": 2,
+             "steady_state_retraces": retraces})
+
+    # nonzero steady-state retraces: error even on CPU smoke
+    d1 = tmp_path / "comp1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [line("cpu", 100.0, 300.0,
+                                             retraces=2)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "steady-state retrace" in r.stderr
+    # accelerator cold_compile_ms growth past tol: error
+    d2 = tmp_path / "comp2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [line("tpu", 100.0, 1000.0)])
+    _trend_round(d2, "BENCH_r02.json", [line("tpu", 100.0, 2000.0)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 1
+    assert "cold_compile_ms" in r.stderr
+    # the same growth on CPU smoke: warning only (strict-cpu gates)
+    d3 = tmp_path / "comp3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [line("cpu", 100.0, 1000.0)])
+    _trend_round(d3, "BENCH_r02.json", [line("cpu", 100.0, 2000.0)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 0 and "cold_compile_ms" in r.stderr
+    r = _run_trend(["--dir", str(d3), "--strict-cpu"])
+    assert r.returncode == 1
+    # growth inside tol, zero retraces: clean
+    d4 = tmp_path / "comp4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json", [line("tpu", 100.0, 1000.0)])
+    _trend_round(d4, "BENCH_r02.json", [line("tpu", 101.0, 1100.0)])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 0, r.stderr
+    # a STALE replay carrying old compile fields never trends
+    d5 = tmp_path / "comp5"
+    d5.mkdir()
+    _trend_round(d5, "BENCH_r01.json", [line("tpu", 100.0, 1000.0)])
+    _trend_round(d5, "BENCH_r02.json",
+                 [dict(line("tpu", 100.0, 9000.0, retraces=5),
+                       stale=True)])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 0, r.stderr
+
+
+def test_check_bench_trend_skips_twin_anomaly_overlap_records(tmp_path):
+    """A record whose attribution flagged its own compute twin as
+    slower than the step (compute_twin_excess_ms > 0) carries CLAMPED
+    perfect-overlap numbers (comm_ms=0, overlap_fraction=1.0) — it
+    must not seed the overlap trend, or the next HEALTHY round gates
+    as a phantom regression."""
+    def attr(frac, visible, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "train_step_attribution_overlap",
+             "value": 5.0, "unit": "ms", "vs_baseline": None,
+             "backend": "tpu", "ndev": 8, "arch": "TPU v5 lite",
+             "overlap_fraction": frac, "comm_visible_ms": visible,
+             "overlap_mode": "overlapped", "n_stages": 4,
+             "issue_order": [3, 2, 1, 0], **kw})
+
+    d = tmp_path / "twin1"
+    d.mkdir()
+    # round 1: the twin anomaly (clamped to perfect overlap)
+    _trend_round(d, "BENCH_r01.json",
+                 [attr(1.0, 0.0, compute_twin_excess_ms=2.5)])
+    # round 2: a healthy real measurement — must NOT gate against the
+    # clamped 1.0/0.0 baseline
+    _trend_round(d, "BENCH_r02.json", [attr(0.5, 1.2)])
+    r = _run_trend(["--dir", str(d)])
+    assert r.returncode == 0, r.stderr
+    # sanity: without the anomaly marker the same pair DOES gate
+    d2 = tmp_path / "twin2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [attr(1.0, 0.0)])
+    _trend_round(d2, "BENCH_r02.json", [attr(0.5, 1.2)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 1

@@ -440,8 +440,9 @@ def test_profilez_live_capture_real_engine():
 def test_ci_server_smoke_gate():
     """The tier-1 wiring of tests/ci/server_smoke.py (like the trend
     gate): the jax-free smoke script boots the server, scrapes all
-    six endpoints (incl. the /profilez no-capture 404), and validates
-    exposition + JSON schemas."""
+    seven endpoints (incl. the /profilez no-capture 404 and the
+    /compilez ledger snapshot with a seeded retrace verdict), and
+    validates exposition + JSON schemas."""
     import os
     import subprocess
     import sys
@@ -450,4 +451,35 @@ def test_ci_server_smoke_gate():
     r = subprocess.run([sys.executable, script], capture_output=True,
                        text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "all 6 endpoints OK" in r.stdout
+    assert "all 7 endpoints OK" in r.stdout
+
+
+def test_compilez_live_ledger():
+    """/compilez against the live process ledger: an instrumented jit
+    call lands in the snapshot (entry, trace count, cache attribution
+    column) and the ?entry= filter narrows/404s."""
+    import jax.numpy as jnp
+    from apex_tpu.observability import compilation
+
+    led = compilation.CompilationLedger()
+    f = compilation.instrumented_jit(
+        lambda x: x * 2, "smoke.double", ledger=led,
+        arg_names=("x",))
+    f(jnp.ones((3,), jnp.float32))
+    f(jnp.ones((4,), jnp.float32))       # shape retrace
+    srv = server.ObservabilityServer(ledger=led).start()
+    try:
+        code, body = _get_json(srv.url + "/compilez")
+        assert code == 200 and body["kind"] == "compilation"
+        ent = body["entries"]["smoke.double"]
+        assert ent["traces"] == 2 and ent["retraces"] == 1
+        assert ent["last_retrace"]["culprit"] == "x"
+        assert ent["compiles"] == 2
+        assert ent["cache"]  # hit/miss/uncached tallies present
+        code, body = _get_json(srv.url
+                               + "/compilez?entry=smoke.double")
+        assert code == 200 and list(body["entries"]) == ["smoke.double"]
+        code, body = _get_json(srv.url + "/compilez?entry=nope")
+        assert code == 404
+    finally:
+        srv.stop()

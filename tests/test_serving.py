@@ -971,3 +971,137 @@ def test_kv_fragmentation_seq2seq_two_residents():
         eng.step()
     frag = _assert_kv_pinned(eng)
     assert frag["kv_waste_bytes"] == total
+
+
+# -- PR 15: the compilation plane ------------------------------------------
+# The zero-retrace steady-state contract, pinned like the host-transfer
+# audit: a warmed engine's decode loop never re-traces.  All deltas are
+# against the PROCESS ledger (compilation is process-wide), so the pins
+# are order-independent under the suite.
+
+
+def test_engine_warmup_compiles_exactly_the_census():
+    """warmup() traces exactly the admission+decode entries of the
+    expected-closure census, and a second warmup adds zero traces —
+    the per-instance re-jit is paid once, up front."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    led = compilation.get_ledger()
+    eng = serving.Engine(m, params, slots=2, buf_len=24, window=2)
+    census = eng.compile_census()
+    assert census == {"engine._prefill_slot": "admission",
+                      "engine._step_k": "decode"}
+    warm_entries = {e for e, stage in census.items()
+                    if stage in ("admission", "decode")}
+    before = led.counts()
+    t0 = led.total_traces()
+    eng.warmup()
+    after = led.counts()
+    # every warm-stage census entry traced exactly once, nothing else
+    assert led.total_traces() - t0 == len(warm_entries)
+    for e in warm_entries:
+        assert after.get(e, 0) - before.get(e, 0) == 1, e
+    # idempotent: the closures are warm, a second pass traces nothing
+    t1 = led.total_traces()
+    eng.warmup()
+    assert led.total_traces() == t1
+
+
+def test_engine_zero_retrace_steady_state():
+    """THE acceptance pin: after warmup, N decode windows with MIXED
+    eos / admission / queue-drain traffic add exactly 0 traces to the
+    compilation ledger.  Everything that varies between requests
+    (prompt length, budget, eos id, arrival timing) is buffer VALUES,
+    never abstract signatures — the static-shape serving contract,
+    now machine-checked instead of assumed."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    eng = serving.Engine(m, params, slots=3, buf_len=24, window=2)
+    eng.warmup()
+    rng = np.random.RandomState(0)
+    pa = list(rng.randint(0, 64, 5))
+    eos_a = _solo(m, params, pa, 1)[0]     # an EOS that actually fires
+    led = compilation.get_ledger()
+    t0 = led.total_traces()
+    ra = eng.submit(pa, max_new_tokens=6, eos_token_id=eos_a)
+    rb = eng.submit(list(rng.randint(0, 64, 9)), max_new_tokens=4)
+    rc = eng.submit(list(rng.randint(0, 64, 3)), max_new_tokens=8)
+    rd = eng.submit(list(rng.randint(0, 64, 7)), max_new_tokens=3)
+    windows = 0
+    while eng.live() or eng.queue_depth():
+        eng.step()
+        windows += 1
+        assert windows < 50
+    assert windows >= 3                    # a real steady-state run
+    for r in (ra, rb, rc, rd):
+        assert eng.is_finished(r)
+    assert eng.result(ra) == [eos_a]       # the eos path really ran
+    assert led.total_traces() - t0 == 0    # zero retraces, pinned
+
+
+def test_engine_zero_retrace_covers_prefix_splice():
+    """The prefix-sharing admission path compiles at its census stages
+    (register_prefix + first splice), then goes zero-retrace too: a
+    second spliced admission with a different prompt adds nothing."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt(3)
+    eng = serving.Engine(m, params, slots=2, buf_len=24,
+                         prefix_pool=1, prefix_chunk=4)
+    eng.warmup()
+    rng = np.random.RandomState(3)
+    pref = list(rng.randint(0, 64, 6))
+    eng.register_prefix(pref)
+    # first spliced admission compiles the splice closures...
+    r1 = eng.add_request(pref + list(rng.randint(0, 64, 3)),
+                         max_new_tokens=2)
+    led = compilation.get_ledger()
+    assert set(eng.compile_census()) <= set(led.counts())
+    # ...and from here the whole engine is steady-state
+    t0 = led.total_traces()
+    r2 = eng.add_request(pref + list(rng.randint(0, 64, 5)),
+                         max_new_tokens=3)
+    while eng.live():
+        eng.step()
+    assert eng.is_finished(r1) and eng.is_finished(r2)
+    assert eng.stats()["prefix_hits"] == 2
+    assert led.total_traces() - t0 == 0
+
+
+def test_seq2seq_zero_retrace_steady_state():
+    """The same pin for the encoder-decoder engine: warmed
+    Seq2SeqEngine runs mixed decode windows (staggered sources, eos,
+    queue admissions) with ledger delta == 0."""
+    from apex_tpu.models import T5, T5Config
+    from apex_tpu.observability import compilation
+    t5 = T5(T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                     num_layers=1, num_heads=4, dropout_rate=0.0,
+                     relative_attention_num_buckets=8,
+                     relative_attention_max_distance=16))
+    t5p, _ = t5.init(jax.random.PRNGKey(0))
+    eng = serving.Seq2SeqEngine(t5, t5p, slots=2, src_len=8,
+                                max_new_cap=6, window=2)
+    eng.warmup()
+    assert set(eng.compile_census()) == {"seq2seq._seed",
+                                         "seq2seq._step_k"}
+    led = compilation.get_ledger()
+    t0 = led.total_traces()
+    rng = np.random.RandomState(0)
+    rids = [eng.submit(list(rng.randint(2, 64, n)),
+                       max_new_tokens=b, eos_token_id=e)
+            for n, b, e in ((3, 4, None), (8, 2, None), (5, 6, 1))]
+    windows = 0
+    while eng.live() or eng.queue_depth():
+        eng.step()
+        windows += 1
+        assert windows < 50
+    for r in rids:
+        assert eng.is_finished(r)
+    assert led.total_traces() - t0 == 0
+
+
+def test_warmup_requires_idle_engine():
+    m, params = _gpt()
+    eng = serving.Engine(m, params, slots=1, buf_len=24)
+    eng.add_request([1, 2], max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="idle"):
+        eng.warmup()

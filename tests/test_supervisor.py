@@ -394,3 +394,120 @@ def test_recovering_is_degraded_but_live(tmp_path):
     # the record still validates with the recovery fields around
     rec = exporters.JsonlExporter.enrich(sup.record())
     assert exporters.validate_run_record(rec) == []
+
+
+# -- PR 15: recompilation storm -------------------------------------------
+
+def _retrace(ring, entry="engine._step_k", cause="shape",
+             culprit="ids", before="i32[4,32]", after="i32[4,48]"):
+    ring.append("xla_retrace", entry=entry, cause=cause,
+                culprit=culprit, before=before, after=after)
+
+
+def test_recompilation_storm_detected_with_culprit():
+    """storm_retraces signature-change retraces of ONE entry within
+    the observation window fire EXACTLY one recompilation_storm whose
+    detail carries the differ's verdict (entry, cause, culprit arg,
+    before/after signatures) — episode-latched, re-arming once the
+    window drains."""
+    ring = EventRing(capacity=128)
+    sup = _sup(ring=ring,
+               config=SupervisorConfig(storm_retraces=3,
+                                       storm_window_observations=6))
+    _healthy(sup, 3)
+    # two retraces: below threshold, no anomaly
+    _retrace(ring)
+    _retrace(ring)
+    assert sup.observe_step(step=3, loss=1.0) == []
+    # the third within the window fires the storm, naming the culprit
+    _retrace(ring, after="i32[4,64]")
+    found = sup.observe_step(step=4, loss=1.0)
+    assert len(found) == 1
+    ev = found[0]
+    assert ev["kind"] == "recompilation_storm"
+    assert ev["entry"] == "engine._step_k"
+    assert ev["retraces_in_window"] == 3
+    assert ev["cause"] == "shape"
+    assert ev["culprit"] == "ids"
+    assert ev["before"] == "i32[4,32]" and ev["after"] == "i32[4,64]"
+    # episode latch: staying in the storm does not re-fire
+    _retrace(ring)
+    assert sup.observe_step(step=5, loss=1.0) == []
+    assert sup.status()["recompilation"]["entries_in_storm"] == \
+        ["engine._step_k"]
+    # a storm degrades the verdict but never liveness (it is a
+    # performance pathology, not a dead run)
+    ok, _ = sup.health_check()
+    assert ok and sup.verdict == "attention"
+    # the window drains -> episode closes -> a fresh burst re-fires
+    for i in range(8):
+        assert sup.observe_step(step=6 + i, loss=1.0) == []
+    assert sup.status()["recompilation"]["entries_in_storm"] == []
+    for _ in range(3):
+        _retrace(ring, cause="dtype", culprit="cache",
+                 before="bf16[4,8]", after="f32[4,8]")
+    found = sup.observe_step(step=20, loss=1.0)
+    assert len(found) == 1 and found[0]["culprit"] == "cache"
+    assert sup._counts["recompilation_storm"] == 2
+    # the ring carries the run_* event and the record validates
+    assert any(e["kind"] == "run_recompilation_storm"
+               for e in ring.snapshot())
+    rec = exporters.JsonlExporter.enrich(sup.record())
+    assert exporters.validate_run_record(rec) == []
+
+
+def test_storm_counts_per_entry_not_globally():
+    """Retraces spread across DIFFERENT entries never pool into one
+    storm — three entries retracing once each is churn, not a storm
+    of any one of them."""
+    ring = EventRing(capacity=64)
+    sup = _sup(ring=ring,
+               config=SupervisorConfig(storm_retraces=3,
+                                       storm_window_observations=10))
+    _healthy(sup, 2)
+    for entry in ("a", "b", "c"):
+        _retrace(ring, entry=entry)
+    assert sup.observe_step(step=2, loss=1.0) == []
+    assert sup._counts["recompilation_storm"] == 0
+
+
+def test_storm_window_is_observation_counted():
+    """Retraces older than storm_window_observations fall out of the
+    window: a slow drip below the rate never fires."""
+    ring = EventRing(capacity=64)
+    sup = _sup(ring=ring,
+               config=SupervisorConfig(storm_retraces=3,
+                                       storm_window_observations=4))
+    _healthy(sup, 2)
+    for i in range(6):
+        _retrace(ring)
+        # 5 observations between retraces: each falls out before the
+        # next arrives
+        for j in range(5):
+            assert sup.observe_step(step=2 + i * 5 + j,
+                                    loss=1.0) == []
+    assert sup._counts["recompilation_storm"] == 0
+
+
+def test_storm_config_validation():
+    with pytest.raises(ValueError, match="storm_retraces"):
+        SupervisorConfig(storm_retraces=0)
+    with pytest.raises(ValueError, match="storm_window"):
+        SupervisorConfig(storm_window_observations=0)
+    assert "recompilation_storm" in ANOMALY_KINDS
+
+
+def test_storm_threshold_above_default_log_bound():
+    """A threshold past the default 64-event retention still fires:
+    the per-entry log is sized from the config, so a high-threshold
+    detector cannot be silently capped below its own trigger."""
+    ring = EventRing(capacity=256)
+    sup = _sup(ring=ring,
+               config=SupervisorConfig(storm_retraces=100,
+                                       storm_window_observations=500))
+    _healthy(sup, 2)
+    for _ in range(100):
+        _retrace(ring)
+    found = sup.observe_step(step=2, loss=1.0)
+    assert [a["kind"] for a in found] == ["recompilation_storm"]
+    assert found[0]["retraces_in_window"] == 100
