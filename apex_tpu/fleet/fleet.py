@@ -77,7 +77,7 @@ __all__ = ["Fleet"]
 
 class _FleetRequest:
     def __init__(self, rid, prompt, max_new, eos, seed, temperature,
-                 deadline_at):
+                 deadline_at, tenant=None, priority=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new = max_new
@@ -85,6 +85,12 @@ class _FleetRequest:
         self.seed = seed
         self.temperature = temperature
         self.deadline_at = deadline_at      # absolute clock time or None
+        # tenant is the FOLDED bucket name (SloTracker.tenant_name):
+        # every surface that stamps it — spans, ring events, metric
+        # labels, per-tenant stats — agrees on the same string even
+        # past the cardinality cap
+        self.tenant = tenant
+        self.priority = priority
         self.assigned: Optional[Tuple[int, int]] = None  # (replica, rrid)
         self.attempts = 0                   # failed dispatches + failovers
         self.next_attempt_step = 0
@@ -215,6 +221,7 @@ class Fleet:
         # instead of 503ing an orchestrator into a restart loop.
         self._recover_t0: Optional[float] = None
         self._recovering_rids: set = set()
+        self._recovering_tenants: set = set()
         self._recovered_tick = False    # reclaimed work progressed now
         self._mttr_last: Optional[float] = None
         self._mttr_sum = 0.0
@@ -266,15 +273,31 @@ class Fleet:
                eos_token_id: Optional[int] = None,
                seed: Optional[int] = None,
                temperature: Optional[float] = None,
-               deadline: Optional[float] = None) -> int:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None) -> int:
         """Queue a request; returns the fleet request id.  Raises
         :class:`FleetOverloaded` (retriable) when the bounded fleet
         queue is full.  ``deadline`` is seconds from now: a request
         not finished in time fails with a deadline error instead of
-        occupying capacity forever."""
+        occupying capacity forever.
+
+        ``tenant`` tags the request for per-tenant accounting: SLO /
+        goodput tallies, tenant-labeled registry metrics, and the
+        tenant stamp on every trace span and ring event the request
+        touches (shed / deadline / failover events say WHOSE request
+        suffered).  Tenant ids are user-supplied strings — past the
+        tracker's cardinality cap new ids fold into the shared
+        ``other`` bucket.  ``priority`` rides along as an opaque tag
+        on the same surfaces (this plane measures; the QoS actuation
+        that CONSUMES the priority is ROADMAP item 4's follow-up)."""
         if len(self._pending) >= self.max_queue:
             self._n_shed += 1
             self._m_shed.inc()
+            # a shed happens before a rid exists; feed the tenant
+            # straight to the tracker (folded name comes back for the
+            # ring stamp)
+            shed_tenant = self.slo.on_shed(tenant)
             if not self._shedding:
                 # one ring event per overload EPISODE (the transition
                 # into shedding), not per rejected submit: sustained
@@ -285,7 +308,9 @@ class Fleet:
                 self._shedding = True
                 self.ring.append("shed",
                                  queue_depth=len(self._pending),
-                                 max_queue=self.max_queue)
+                                 max_queue=self.max_queue,
+                                 **({"tenant": shed_tenant}
+                                    if shed_tenant is not None else {}))
             raise FleetOverloaded(len(self._pending), self.max_queue)
         if deadline is not None and deadline <= 0:
             raise ValueError(f"deadline must be > 0 seconds, got "
@@ -295,7 +320,9 @@ class Fleet:
         now = self._clock()
         req = _FleetRequest(rid, prompt, max_new_tokens, eos_token_id,
                             seed, temperature,
-                            None if deadline is None else now + deadline)
+                            None if deadline is None else now + deadline,
+                            tenant=self.slo.tenant_name(tenant),
+                            priority=priority)
         req.t_submit = now
         if self.tracing:
             # the root of the request's causal chain; every later
@@ -306,23 +333,41 @@ class Fleet:
             req.last_span = tracing.get_recorder().event(
                 "fleet_submit", trace_id=req.trace_id, rid=rid,
                 prompt_len=len(req.prompt), max_new=max_new_tokens,
-                queue_depth=len(self._pending))
+                queue_depth=len(self._pending),
+                **self._tenant_attrs(req))
         self._pending.append(req)
         self._shedding = False      # an admitted submit ends the episode
         self._n_submitted += 1
         self._m_submitted.inc()
-        self.slo.on_submit(rid, now, req.deadline_at)
+        # feed the ALREADY-folded name (req.tenant): folding twice
+        # would double-count tenants_dropped for over-cap ids
+        self.slo.on_submit(rid, now, req.deadline_at,
+                           tenant=req.tenant)
         return rid
+
+    @staticmethod
+    def _tenant_attrs(req: "_FleetRequest") -> Dict[str, Any]:
+        """The tenant/priority stamp for spans and ring events; empty
+        for untagged requests so their events keep the pre-tenant
+        shape."""
+        attrs: Dict[str, Any] = {}
+        if req.tenant is not None:
+            attrs["tenant"] = req.tenant
+        if req.priority is not None:
+            attrs["priority"] = req.priority
+        return attrs
 
     def _trace_ev(self, req: "_FleetRequest", name: str,
                   **attrs) -> Optional[int]:
         """Append one lifecycle event to the request's trace, chaining
-        it on the previous tail; fleet-thread only."""
+        it on the previous tail; fleet-thread only.  Tagged requests
+        carry their tenant/priority on EVERY hop — including the
+        fault/reclaim/re-dispatch chain across a failover."""
         if not (self.tracing and req.trace_id):
             return None
         req.last_span = tracing.get_recorder().event(
             name, trace_id=req.trace_id, parent_id=req.last_span,
-            rid=req.rid, **attrs)
+            rid=req.rid, **{**self._tenant_attrs(req), **attrs})
         return req.last_span
 
     def register_prefix(self, tokens: Sequence[int],
@@ -490,12 +535,18 @@ class Fleet:
                 mttr = self._clock() - self._recover_t0
                 self._recover_t0 = None
                 self._recovering_rids.clear()
+                # whose work just recovered — the aggregate carries the
+                # window's tenant membership (list, like "failover")
+                tenants = sorted(self._recovering_tenants)
+                self._recovering_tenants.clear()
                 self._mttr_last = mttr
                 self._mttr_sum += mttr
                 self._mttr_count += 1
                 self.ring.append("recovery_done",
                                  mttr_s=round(mttr, 6),
-                                 fleet_step=self._step_no)
+                                 fleet_step=self._step_no,
+                                 **({"tenants": tenants}
+                                    if tenants else {}))
                 self.metrics.histogram(
                     "fleet_mttr_seconds",
                     help="failover to first post-recovery progress of "
@@ -558,10 +609,16 @@ class Fleet:
             dspan = self._trace_ev(req, "fleet_dispatch", replica=i)
             amb = (tracing.get_recorder().activate(req.trace_id, dspan)
                    if dspan is not None else contextlib.nullcontext())
+            # replicas advertising accepts_tenant get the tag so their
+            # engine-side spans (queue/prefill) carry it too; stubs and
+            # proxies without the flag keep the pre-tenant signature
+            tkw = ({"tenant": req.tenant}
+                   if req.tenant is not None
+                   and getattr(rep, "accepts_tenant", False) else {})
             try:
                 with amb:
                     rrid = rep.submit(req.prompt, req.max_new, req.eos,
-                                      req.seed, req.temperature)
+                                      req.seed, req.temperature, **tkw)
             except ValueError as e:
                 # request-shaped rejection (bad prompt length, seed on
                 # a greedy engine, ...): the replica is fine and no
@@ -636,8 +693,14 @@ class Fleet:
             # WORK (the rids collected below) — a survivor's unrelated
             # token does not mean the failed-over requests recovered.
             self._recover_t0 = self._clock()
+        # whose requests suffered: the distinct tenants among the
+        # reclaimed work (aggregate event, so a list — /flightz's
+        # ?tenant= filter matches membership)
+        tenants = sorted({self._inflight[k].tenant for k in keys
+                          if self._inflight[k].tenant is not None})
         self.ring.append("failover", replica=i, reason=reason,
-                         reclaimed=len(keys), fleet_step=self._step_no)
+                         reclaimed=len(keys), fleet_step=self._step_no,
+                         **({"tenants": tenants} if tenants else {}))
         moved = []
         for key in keys:
             req = self._inflight.pop(key)
@@ -667,6 +730,8 @@ class Fleet:
                                attempts=req.attempts)
                 moved.append(req)
                 self._recovering_rids.add(req.rid)
+                if req.tenant is not None:
+                    self._recovering_tenants.add(req.tenant)
         # leftovers in the replica's own waiting queue (queued-on-
         # replica dispatches) came back via the keys above; anything
         # else there was submitted behind the fleet's back — drop it
@@ -703,10 +768,12 @@ class Fleet:
         if self._recover_t0 is not None and not self._recovering_rids \
                 and not self._recovered_tick:
             self._recover_t0 = None
+            self._recovering_tenants.clear()
             self.ring.append("recovery_abandoned",
                              fleet_step=self._step_no)
 
-    def _fail(self, req: _FleetRequest, msg: str):
+    def _fail(self, req: _FleetRequest, msg: str,
+              deadline_exceeded: bool = False):
         # a reclaimed request that dies (budget/deadline) is resolved,
         # not recovered — drop it from the MTTR watch set (the sweep
         # that called us decides afterwards whether the window is now
@@ -717,7 +784,8 @@ class Fleet:
         self._results[req.rid] = req
         self._n_failed += 1
         self._m_failed.inc()
-        self.slo.on_fail(req.rid, req.t_finish)
+        self.slo.on_fail(req.rid, req.t_finish,
+                         deadline_exceeded=deadline_exceeded)
         self._trace_ev(req, "fleet_failed", error=msg)
 
     def _finish(self, req: _FleetRequest, tokens: List[int]):
@@ -768,6 +836,10 @@ class Fleet:
             sweep = {"count": len(expired),
                      "rids": [r.rid for r in expired[:8]],
                      "fleet_step": self._step_no}
+            tenants = sorted({r.tenant for r in expired
+                              if r.tenant is not None})
+            if tenants:
+                sweep["tenants"] = tenants
             self._last_deadline_sweep = sweep
             self.ring.append("deadline_exceeded", **sweep)
         for req in expired:
@@ -779,7 +851,8 @@ class Fleet:
         self._n_deadline += 1
         self._m_deadline.inc()
         self._fail(req, f"deadline exceeded after "
-                        f"{self._clock() - req.t_submit:.3f}s")
+                        f"{self._clock() - req.t_submit:.3f}s",
+                   deadline_exceeded=True)
 
     # -- drain / rolling restart -------------------------------------------
     def drain(self, i: int):
@@ -924,6 +997,19 @@ class Fleet:
     def states(self) -> List[str]:
         return [h.state for h in self.health]
 
+    def tenant_stats(self) -> Dict[str, Any]:
+        """The per-tenant rollup (``/tenantz``'s fleet source): every
+        tenant's SLO/goodput tallies under one goodput window (the
+        ``stats()`` discipline: extended to now while work is live),
+        the tracker's overflow-fold count, and the per-metric label
+        drop accounting from the registry cardinality cap."""
+        now = self._clock() if self.live() else None
+        drops = {m.name: m.labels_dropped
+                 for m in self.metrics.collect() if m.labels_dropped}
+        return {"tenants": self.slo.tenant_stats(now=now),
+                "tenants_dropped": self.slo.tenants_dropped,
+                "label_sets_dropped": drops}
+
     def _update_gauges(self):
         m = self.metrics
         m.gauge("fleet_queue_depth").set(float(len(self._pending)))
@@ -978,6 +1064,8 @@ class Fleet:
                 "recovery_in_flight": self.recovery_in_flight,
                 "slo": slo,
                 "goodput_tokens_per_s": slo["goodput_tokens_per_s"],
+                "tenants": slo["tenants"],
+                "tenants_dropped": slo["tenants_dropped"],
                 "states": states,
                 "healthy": states.count(HEALTHY),
                 "degraded": states.count(DEGRADED),
@@ -995,9 +1083,16 @@ class Fleet:
         (or ``JsonlExporter.enrich``) to stamp the envelope.  Schema
         v5 adds the SLO/goodput fields and the deadline-sweep
         aggregate (optional in the validator, so archived records
-        stay clean)."""
+        stay clean); v11 adds the per-tenant block — one compact
+        tally per tenant (no histogram summaries; ``/tenantz`` has
+        those) plus the overflow-fold count."""
         s = self.stats()
+        tenants = {t: {k: v for k, v in b.items()
+                       if k not in ("queue_wait", "service_time")}
+                   for t, b in s["tenants"].items()}
         return {"kind": "fleet", "trace_id": self.trace_id,
+                "tenants": tenants,
+                "tenants_dropped": s["tenants_dropped"],
                 "replicas": s["replicas"], "policy": s["policy"],
                 "healthy": s["healthy"], "degraded": s["degraded"],
                 "dead": s["dead"],

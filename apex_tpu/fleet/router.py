@@ -92,6 +92,23 @@ class RetryPolicy:
 
 # -- policies --------------------------------------------------------------
 
+def _req_tags(req) -> dict:
+    """Tenant/priority tags of a request, for ``last_decision``: the
+    routing record of a tagged request says WHOSE request was ranked
+    (the fleet copies the decision onto the ``fleet_route`` trace
+    event, and the future QoS actuation will rank ON these tags —
+    recording them now keeps the decision schema stable across that
+    change).  Untagged requests keep the pre-tenant decision shape."""
+    tags = {}
+    tenant = getattr(req, "tenant", None)
+    if tenant is not None:
+        tags["tenant"] = tenant
+    priority = getattr(req, "priority", None)
+    if priority is not None:
+        tags["priority"] = priority
+    return tags
+
+
 def _load(replica) -> float:
     """Occupancy + queued work, both normalized per slot — one scalar
     'how busy' from the scheduler's cheap accessors (``stats()`` is
@@ -117,7 +134,7 @@ class RoundRobin:
                     candidates[0])
         self._next = pick + 1
         self.last_decision = {"cursor": cursor, "wrapped":
-                              pick < cursor}
+                              pick < cursor, **_req_tags(req)}
         return pick
 
 
@@ -136,7 +153,8 @@ class LeastLoaded:
         # display only — selection uses full precision) so the
         # decision survives the trace record round-trip unchanged
         self.last_decision = {"load": {str(i): round(loads[i], 4)
-                                       for i in candidates}}
+                                       for i in candidates},
+                              **_req_tags(req)}
         return pick
 
 
@@ -159,7 +177,8 @@ class PrefixAffinity:
     def select(self, fleet, candidates: Sequence[int], req) -> int:
         owner = fleet.prefix_owner(req.prompt)
         if owner is not None and owner in candidates:
-            self.last_decision = {"prefix_owner": owner}
+            self.last_decision = {"prefix_owner": owner,
+                                  **_req_tags(req)}
             return owner
         pick = self.fallback.select(fleet, candidates, req)
         self.last_decision = {

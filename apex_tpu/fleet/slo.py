@@ -46,11 +46,24 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from ..observability.metrics import (DEFAULT_MAX_LABEL_SETS,
+                                     OVERFLOW_LABEL_VALUE)
+
 __all__ = ["SloTracker", "split_from_trace"]
 
 # sub-ms dispatch ticks up to multi-second waits under backlog
 _WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                  1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _new_tenant_bucket() -> Dict[str, Any]:
+    # per-tenant cumulative tallies; t_first/t_last bound the tenant's
+    # own goodput window the way the tracker-wide pair bounds the
+    # fleet's
+    return {"submitted": 0, "finished": 0, "failed": 0, "shed": 0,
+            "deadline_exceeded": 0, "slo_misses": 0,
+            "goodput_tokens": 0, "with_deadline": 0,
+            "within_deadline": 0, "t_first": None, "t_last": None}
 
 
 class SloTracker:
@@ -59,10 +72,25 @@ class SloTracker:
 
     All numbers are fleet-local (the registry metrics aggregate across
     fleets sharing a registry; :meth:`stats` must not — the engine-
-    scheduler rule)."""
+    scheduler rule).
 
-    def __init__(self, metrics, clock):
+    Requests may carry a ``tenant`` tag (``Fleet.submit(tenant=...)``):
+    every tally above is then ALSO accounted per tenant — goodput
+    tokens, attainment, shed and deadline-miss counts, and
+    tenant-labeled children of the registry metrics
+    (``fleet_goodput_tokens_total{tenant=...}``, the queue-wait /
+    service histograms).  Tenant ids are user-supplied strings, so
+    distinct tenants are capped at ``max_tenants``: past the cap a new
+    tenant folds into the shared ``other`` bucket and
+    ``tenants_dropped`` counts the fold — the same bound (and the same
+    overflow value) the metrics registry applies to label sets.
+    Untagged requests stay out of the per-tenant map; their numbers
+    live only in the fleet-wide tallies."""
+
+    def __init__(self, metrics, clock,
+                 max_tenants: int = DEFAULT_MAX_LABEL_SETS):
         self._clock = clock
+        self.max_tenants = max_tenants
         self._m_queue_wait = metrics.histogram(
             "fleet_queue_wait_seconds",
             help="submit to first dispatch per request (fleet had no "
@@ -86,20 +114,70 @@ class SloTracker:
         self._m_goodput_rate = metrics.gauge(
             "fleet_goodput_tokens_per_s",
             help="goodput tokens over the submit-to-last-finish window")
-        # rid -> [t_submit, t_first_dispatch|None, deadline_at|None]
+        # rid -> [t_submit, t_first_dispatch|None, deadline_at|None,
+        #         tenant-bucket-name|None]
         self._open: Dict[int, list] = {}
         self._with_deadline = 0         # resolved requests that had one
         self._within = 0                # ... and finished in time
         self._goodput_tokens = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        self._tenants_dropped = 0
+
+    # -- per-tenant plumbing ------------------------------------------------
+    def _tenant_bucket(self, tenant: Optional[str]
+                       ) -> Optional[Dict[str, Any]]:
+        """Resolve (and lazily create) a tenant's tally bucket; past
+        ``max_tenants`` distinct ids the shared overflow bucket absorbs
+        the newcomer and the fold is counted — mirrors
+        ``metrics._Metric.labels``."""
+        if tenant is None:
+            return None
+        t = str(tenant)
+        bucket = self._tenants.get(t)
+        if bucket is None:
+            if len(self._tenants) >= self.max_tenants:
+                self._tenants_dropped += 1
+                t = OVERFLOW_LABEL_VALUE
+                bucket = self._tenants.get(t)
+            if bucket is None:
+                bucket = _new_tenant_bucket()
+                bucket["tenant"] = t
+                self._tenants[t] = bucket
+        return bucket
+
+    def tenant_name(self, tenant: Optional[str]) -> Optional[str]:
+        """The bucket name a tenant id folds to (the id itself below
+        the cap, ``other`` past it) — what the fleet stamps on spans,
+        ring events and metric labels so every surface agrees."""
+        b = self._tenant_bucket(tenant)
+        return None if b is None else b["tenant"]
 
     # -- fleet feed (same instants as the trace spans) ---------------------
     def on_submit(self, rid: int, now: float,
-                  deadline_at: Optional[float]):
-        self._open[rid] = [now, None, deadline_at]
+                  deadline_at: Optional[float],
+                  tenant: Optional[str] = None):
+        b = self._tenant_bucket(tenant)
+        self._open[rid] = [now, None, deadline_at,
+                           None if b is None else b["tenant"]]
         if self._t_first is None:
             self._t_first = now
+        if b is not None:
+            b["submitted"] += 1
+            if b["t_first"] is None:
+                b["t_first"] = now
+
+    def on_shed(self, tenant: Optional[str] = None) -> Optional[str]:
+        """A shed happens before a rid exists, so the fleet feeds the
+        tenant directly; untagged sheds live only in the fleet-wide
+        counter the fleet already keeps.  Returns the folded bucket
+        name (for the ring-event stamp) or None."""
+        b = self._tenant_bucket(tenant)
+        if b is None:
+            return None
+        b["shed"] += 1
+        return b["tenant"]
 
     def on_dispatch(self, rid: int, now: float):
         """First dispatch only: queue wait = submit → first dispatch;
@@ -108,7 +186,10 @@ class SloTracker:
         if rec is None or rec[1] is not None:
             return
         rec[1] = now
-        self._m_queue_wait.observe(now - rec[0])
+        wait = now - rec[0]
+        self._m_queue_wait.observe(wait)
+        if rec[3] is not None:
+            self._m_queue_wait.labels(tenant=rec[3]).observe(wait)
 
     def _resolve(self, rid: int, now: float):
         rec = self._open.pop(rid, None)
@@ -121,31 +202,61 @@ class SloTracker:
         rec = self._resolve(rid, now)
         if rec is None:
             return
-        t_submit, t_dispatch, deadline_at = rec
-        self._m_service.observe(now - (t_dispatch
-                                       if t_dispatch is not None
-                                       else t_submit))
+        t_submit, t_dispatch, deadline_at, tenant = rec
+        b = None if tenant is None else self._tenants.get(tenant)
+        service = now - (t_dispatch if t_dispatch is not None
+                         else t_submit)
+        self._m_service.observe(service)
+        if tenant is not None:
+            self._m_service.labels(tenant=tenant).observe(service)
         within = deadline_at is None or now <= deadline_at
         if deadline_at is not None:
             self._with_deadline += 1
+            if b is not None:
+                b["with_deadline"] += 1
             if within:
                 self._within += 1
+                if b is not None:
+                    b["within_deadline"] += 1
             else:
                 self._m_miss.inc()
+                if b is not None:
+                    b["slo_misses"] += 1
+                    self._m_miss.labels(tenant=tenant).inc()
         if within:
             self._goodput_tokens += int(tokens)
             self._m_goodput.inc(int(tokens))
+            if b is not None:
+                b["goodput_tokens"] += int(tokens)
+                self._m_goodput.labels(tenant=tenant).inc(int(tokens))
+        if b is not None:
+            b["finished"] += 1
+            b["t_last"] = now
         self._fold_gauges()
 
-    def on_fail(self, rid: int, now: float):
+    def on_fail(self, rid: int, now: float,
+                deadline_exceeded: bool = False):
         """Failed requests (retries exhausted, rejected, deadline
-        exceeded) deliver no goodput; a deadlined one is an SLO miss."""
+        exceeded) deliver no goodput; a deadlined one is an SLO miss.
+        ``deadline_exceeded`` marks the sweep-kill case so the tenant's
+        miss is attributed to the deadline, not a replica fault."""
         rec = self._resolve(rid, now)
         if rec is None:
             return
+        tenant = rec[3]
+        b = None if tenant is None else self._tenants.get(tenant)
         if rec[2] is not None:
             self._with_deadline += 1
             self._m_miss.inc()
+            if b is not None:
+                b["with_deadline"] += 1
+                b["slo_misses"] += 1
+                self._m_miss.labels(tenant=tenant).inc()
+        if b is not None:
+            b["failed"] += 1
+            if deadline_exceeded:
+                b["deadline_exceeded"] += 1
+            b["t_last"] = now
         self._fold_gauges()
 
     # -- aggregates ---------------------------------------------------------
@@ -170,11 +281,62 @@ class SloTracker:
         dt = max(ends) - self._t_first
         return self._goodput_tokens / dt if dt > 0 else 0.0
 
+    @staticmethod
+    def _tenant_attainment(b: Dict[str, Any]) -> Optional[float]:
+        if b["with_deadline"] == 0:
+            return None
+        return b["within_deadline"] / b["with_deadline"]
+
+    @staticmethod
+    def _tenant_rate(b: Dict[str, Any],
+                     now: Optional[float] = None) -> float:
+        if b["t_first"] is None:
+            return 0.0
+        ends = [t for t in (b["t_last"], now) if t is not None]
+        if not ends:
+            return 0.0
+        dt = max(ends) - b["t_first"]
+        return b["goodput_tokens"] / dt if dt > 0 else 0.0
+
     def _fold_gauges(self):
         att = self.slo_attainment
         if att is not None:
             self._m_attainment.set(att)
         self._m_goodput_rate.set(self.goodput_tokens_per_s())
+        for t, b in self._tenants.items():
+            ta = self._tenant_attainment(b)
+            if ta is not None:
+                self._m_attainment.labels(tenant=t).set(ta)
+            self._m_goodput_rate.labels(tenant=t).set(
+                self._tenant_rate(b))
+
+    @property
+    def tenants_dropped(self) -> int:
+        """Fold events: submissions/sheds whose over-cap tenant id was
+        absorbed by the ``other`` bucket (mirrors the per-call
+        semantics of ``metrics._Metric.labels_dropped``)."""
+        return self._tenants_dropped
+
+    def tenant_stats(self, now: Optional[float] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant rollup: the tally bucket plus derived attainment
+        / goodput rate and the tenant-labeled queue-wait / service
+        summaries (labeled children of the registry histograms — the
+        one per-tenant number that is registry- rather than
+        fleet-scoped when fleets share a registry)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for t, b in sorted(self._tenants.items()):
+            entry = {k: v for k, v in b.items()
+                     if k not in ("t_first", "t_last", "tenant")}
+            entry["slo_attainment"] = self._tenant_attainment(b)
+            entry["goodput_tokens_per_s"] = round(
+                self._tenant_rate(b, now=now), 4)
+            entry["queue_wait"] = self._m_queue_wait.labels(
+                tenant=t).summary()
+            entry["service_time"] = self._m_service.labels(
+                tenant=t).summary()
+            out[t] = entry
+        return out
 
     def stats(self, now: Optional[float] = None) -> Dict[str, Any]:
         """``now`` extends the goodput window for a still-running
@@ -189,6 +351,8 @@ class SloTracker:
                 self.goodput_tokens_per_s(now=now), 4),
             "queue_wait": self._m_queue_wait.summary(),
             "service_time": self._m_service.summary(),
+            "tenants": self.tenant_stats(now=now),
+            "tenants_dropped": self._tenants_dropped,
         }
 
 

@@ -28,7 +28,7 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
-           "COMPILE_FIELDS",
+           "COMPILE_FIELDS", "TENANT_COUNTS",
            "host_info", "JsonlExporter",
            "prometheus_text", "parse_prometheus_text",
            "validate_prometheus_text", "validate_bench_record",
@@ -115,9 +115,24 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # observability.compilation.BENCH_COMPILE_FIELDS and pinned equal in
 # tests); required on fresh v10 lines; ``supervisor`` anomaly kinds
 # grow ``recompilation_storm``.
+# v11: the tenant plane.  ``kind: fleet`` records carry the per-tenant
+# SLO rollup — a ``tenants`` object keyed by tenant name whose buckets
+# hold the TENANT_COUNTS tallies plus ``slo_attainment`` /
+# ``goodput_tokens_per_s`` (same nullability/range contract as the
+# fleet-level pair), and ``tenants_dropped`` (tenant ids folded into
+# the overflow bucket by the label-cardinality cap).  Validated
+# whenever present; REQUIRED on fresh v11 fleet records — a fleet
+# snapshot that cannot say whose requests it served cannot answer
+# "which tenant's p99 regressed".  Untagged requests stay out of the
+# map, so per-tenant sums are <= the fleet totals, never ==.  Bench
+# grows the two-tenant open-loop leg: fresh ``*_tenant_*_goodput``
+# lines must carry ``tenant`` + ``slo_attainment``, and the
+# ``*_tenant_parity`` line must carry the token counts its ratio came
+# from (``tenants_goodput_tokens`` / ``tokens_within_slo``) and
+# reassemble from them.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v9 streams stay valid.
-SCHEMA_VERSION = 10
+# version, so archived v1..v10 streams stay valid.
+SCHEMA_VERSION = 11
 
 # the compile-plane bench fields (stdlib-side duplicate of
 # observability.compilation.BENCH_COMPILE_FIELDS — this module must
@@ -818,6 +833,52 @@ def validate_bench_record(rec: Any) -> List[str]:
                 errs.append(f"fresh step-attribution records must "
                             f"carry {key!r} (schema v9: which "
                             f"bucket-issue schedule was measured)")
+    # tenant-tagged bench lines (bench.py --fleet two-tenant leg,
+    # schema v11): whenever a line names a tenant it must name it
+    # coherently, and the fresh v11 per-tenant goodput/parity lines
+    # must carry the SLO side of the claim — a per-tenant throughput
+    # without attainment cannot say whether that tenant's deadlines
+    # held, and a parity ratio without its token counts cannot be
+    # re-derived.
+    if "tenant" in rec and (not isinstance(rec["tenant"], str)
+                            or not rec["tenant"]):
+        errs.append(f"'tenant' must be a non-empty string when "
+                    f"present, got {rec['tenant']!r}")
+    v11 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+           and sv_rec >= 11)
+    if (v11 and isinstance(metric, str)
+            and "error" not in rec and not rec.get("stale")):
+        if "_tenant_" in metric and metric.endswith("_goodput"):
+            if "tenant" not in rec:
+                errs.append("fresh per-tenant goodput records must "
+                            "carry 'tenant' (schema v11)")
+            att = _need(rec, errs, "slo_attainment", numbers.Number,
+                        allow_none=True)
+            if (isinstance(att, numbers.Number)
+                    and not isinstance(att, bool)
+                    and not (0.0 <= att <= 1.0)):
+                errs.append(f"'slo_attainment' must be null or in "
+                            f"[0, 1], got {att!r}")
+        if metric.endswith("_tenant_parity"):
+            counts = {}
+            for key in ("tenants_goodput_tokens", "tokens_within_slo"):
+                v = _need(rec, errs, key, int)
+                if isinstance(v, int) and not isinstance(v, bool):
+                    if v < 0:
+                        errs.append(f"{key!r} must be >= 0, got {v}")
+                    else:
+                        counts[key] = v
+            val = rec.get("value")
+            if (len(counts) == 2 and counts["tokens_within_slo"] > 0
+                    and isinstance(val, numbers.Number)
+                    and not isinstance(val, bool)):
+                expect = (counts["tenants_goodput_tokens"]
+                          / counts["tokens_within_slo"])
+                if abs(val - expect) > 0.005:
+                    errs.append(
+                        f"value ({val}) inconsistent with "
+                        f"tenants_goodput_tokens/tokens_within_slo "
+                        f"({expect:.4g})")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:
@@ -887,6 +948,90 @@ def validate_lint_record(rec: Any) -> List[str]:
 # Fleet.record() emits exactly these (plus replicas/policy/state tallies)
 _FLEET_COUNTS = ("queue_depth", "submitted", "finished", "failed",
                  "shed", "retries", "failovers", "drains", "tokens")
+
+# the per-tenant bucket tallies a v11 ``tenants`` block carries —
+# the stdlib-side duplicate of fleet.slo's tenant bucket (this module
+# must stay importable without jax; tests pin the shapes equal).
+# Every field is a non-negative int; ``slo_attainment`` /
+# ``goodput_tokens_per_s`` ride alongside with the fleet-level
+# contract (null-or-fraction / non-negative number).
+TENANT_COUNTS = ("submitted", "finished", "failed", "shed",
+                 "deadline_exceeded", "slo_misses", "goodput_tokens",
+                 "with_deadline", "within_deadline")
+
+
+def _check_tenants_block(rec, errs):
+    """The v11 per-tenant rollup contract, validated whenever present:
+    ``tenants`` maps non-empty tenant names to buckets of TENANT_COUNTS
+    tallies (ints >= 0, internally consistent — finishes cannot exceed
+    submissions, within-deadline is a subset of with-deadline), and the
+    per-tenant sums stay within the fleet totals (untagged requests are
+    counted fleet-wide but deliberately kept OUT of the tenant map, so
+    the sums are <=, never ==)."""
+    if "tenants_dropped" in rec:
+        v = rec["tenants_dropped"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"'tenants_dropped' must be an int >= 0, "
+                        f"got {v!r}")
+    if "tenants" not in rec:
+        return
+    tenants = rec["tenants"]
+    if not isinstance(tenants, dict):
+        errs.append("'tenants' must be an object when present")
+        return
+    sums = {k: 0 for k in ("shed", "deadline_exceeded",
+                           "goodput_tokens")}
+    for name, b in tenants.items():
+        if not isinstance(name, str) or not name:
+            errs.append(f"tenant names must be non-empty strings, "
+                        f"got {name!r}")
+        if not isinstance(b, dict):
+            errs.append(f"tenants[{name!r}] must be an object")
+            continue
+        for key in TENANT_COUNTS:
+            v = b.get(key)
+            if key not in b:
+                errs.append(f"tenants[{name!r}] missing {key!r}")
+            elif not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"tenants[{name!r}].{key} must be an int "
+                            f">= 0, got {v!r}")
+            elif key in sums:
+                sums[key] += v
+        fin, sub = b.get("finished"), b.get("submitted")
+        if (isinstance(fin, int) and isinstance(sub, int)
+                and not isinstance(fin, bool)
+                and not isinstance(sub, bool) and fin > sub):
+            errs.append(f"tenants[{name!r}]: finished ({fin}) exceeds "
+                        f"submitted ({sub})")
+        wi, wd = b.get("within_deadline"), b.get("with_deadline")
+        if (isinstance(wi, int) and isinstance(wd, int)
+                and not isinstance(wi, bool)
+                and not isinstance(wd, bool) and wi > wd):
+            errs.append(f"tenants[{name!r}]: within_deadline ({wi}) "
+                        f"exceeds with_deadline ({wd})")
+        att = b.get("slo_attainment")
+        if att is not None and (
+                not isinstance(att, numbers.Number)
+                or isinstance(att, bool)
+                or not (0.0 <= att <= 1.0)):
+            errs.append(f"tenants[{name!r}].slo_attainment must be "
+                        f"null or in [0, 1], got {att!r}")
+        gp = b.get("goodput_tokens_per_s")
+        if gp is not None and (
+                not isinstance(gp, numbers.Number)
+                or isinstance(gp, bool) or not (gp >= 0)):
+            errs.append(f"tenants[{name!r}].goodput_tokens_per_s must "
+                        f"be null or a number >= 0, got {gp!r}")
+    # untagged traffic keeps the tenant sums strictly within the fleet
+    # totals; a sum EXCEEDING its total is double-counting
+    for key, total_key in (("shed", "shed"),
+                           ("deadline_exceeded", "deadline_exceeded"),
+                           ("goodput_tokens", "tokens_within_slo")):
+        total = rec.get(total_key)
+        if (isinstance(total, int) and not isinstance(total, bool)
+                and sums[key] > total):
+            errs.append(f"sum of per-tenant {key} ({sums[key]}) "
+                        f"exceeds fleet {total_key} ({total})")
 
 
 def validate_fleet_record(rec: Any) -> List[str]:
@@ -995,6 +1140,18 @@ def validate_fleet_record(rec: Any) -> List[str]:
                         or not (v >= 0)):
                     errs.append(f"mttr.{k} must be null or a finite "
                                 f"number >= 0, got {v!r}")
+    # the v11 tenant plane: validated whenever present, required on
+    # records declaring v11 — Fleet.record() always emits the block
+    # (empty object when no request was tagged), so a fresh record
+    # missing it was hand-built
+    if isinstance(sv, int) and not isinstance(sv, bool) and sv >= 11:
+        if "tenants" not in rec:
+            errs.append("fresh fleet records must carry 'tenants' "
+                        "(schema v11: the per-tenant SLO rollup)")
+        if "tenants_dropped" not in rec:
+            errs.append("fresh fleet records must carry "
+                        "'tenants_dropped' (schema v11)")
+    _check_tenants_block(rec, errs)
     if "deadline_last_sweep" in rec:
         sweep = rec["deadline_last_sweep"]
         if not isinstance(sweep, dict):

@@ -19,7 +19,12 @@ deque append), safe from the fleet's worker threads.
 Producers in-tree: ``fleet.Fleet`` (failover / shed / retry / deadline
 / stall-watchdog / drain), ``fleet.health.ReplicaHealth`` (breaker
 transitions), ``fleet.faults.FaultyReplica`` (injected faults),
-``amp.record_scaler`` (scaler skips).  All default to the process ring
+``amp.record_scaler`` (scaler skips).  Fleet events for tagged
+requests carry the request's ``tenant`` (shed and deadline events say
+WHOSE request suffered); aggregate transitions touching several
+requests (failover reclaim, deadline sweep) carry the affected
+``tenants`` list — ``snapshot(tenant=...)`` / ``/flightz?tenant=``
+filter on both.  All default to the process ring
 (:func:`get_ring`) so one dump shows the interleaved story; pass an
 explicit ring to isolate a fleet (tests do).
 """
@@ -66,12 +71,23 @@ class EventRing:
             self._events.append(ev)
         return ev
 
-    def snapshot(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
-        """Retained events oldest-first (optionally one kind)."""
+    def snapshot(self, kind: Optional[str] = None,
+                 tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Retained events oldest-first (optionally one kind and/or
+        one tenant's story).  The tenant filter matches both the
+        per-request events stamped ``tenant: <name>`` and the
+        aggregate transitions (failover reclaim, deadline sweep) that
+        list every affected tenant in ``tenants`` — the same rule
+        ``/flightz?tenant=`` serves, so a post-mortem and a live
+        scrape answer "whose request suffered" identically."""
         with self._lock:
             evs = [dict(e) for e in self._events]
         if kind is not None:
             evs = [e for e in evs if e["kind"] == kind]
+        if tenant is not None:
+            evs = [e for e in evs
+                   if e.get("tenant") == tenant
+                   or tenant in (e.get("tenants") or ())]
         return evs
 
     def __len__(self) -> int:

@@ -26,12 +26,22 @@ from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DeviceMetrics", "get_registry", "set_registry",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "DEFAULT_LATENCY_BUCKETS", "DEFAULT_MAX_LABEL_SETS",
+           "OVERFLOW_LABEL_VALUE"]
 
 # seconds; spans sub-ms kernel dispatches to multi-second compiles
 DEFAULT_LATENCY_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# cardinality cap: at most this many distinct label sets per metric.
+# Label values can be user-supplied strings (tenant ids on the fleet
+# serving path) — an unbounded child dict is an OOM with extra steps.
+# Past the cap, new label sets fold into a shared overflow child whose
+# values are all OVERFLOW_LABEL_VALUE, and the fold is counted on
+# ``labels_dropped`` so the totals stay conserved AND accounted.
+DEFAULT_MAX_LABEL_SETS = 64
+OVERFLOW_LABEL_VALUE = "other"
 
 
 def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
@@ -46,21 +56,42 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._children: Dict[Tuple, "_Metric"] = {}
+        self.max_label_sets = DEFAULT_MAX_LABEL_SETS
+        self._labels_dropped = 0
 
     def _new_child(self):
         return type(self)(self.name, self.help)
 
     def labels(self, **labels):
         """Child metric for a label set (e.g. per-dtype comm counters);
-        children are exported under the parent's name with the labels."""
+        children are exported under the parent's name with the labels.
+
+        Distinct label sets are capped at ``max_label_sets``: once full,
+        an unseen set folds into the shared overflow child (every value
+        replaced by ``OVERFLOW_LABEL_VALUE``) and ``labels_dropped``
+        counts the fold — the increments still land somewhere exported,
+        but a flood of user-supplied values (tenant ids) cannot grow
+        the registry without bound."""
         key = _label_key(labels)
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._new_child()
-                child._label_set = key
-                self._children[key] = child
+                if len(self._children) >= self.max_label_sets:
+                    self._labels_dropped += 1
+                    key = tuple((k, OVERFLOW_LABEL_VALUE)
+                                for k, _ in key)
+                    child = self._children.get(key)
+                if child is None:
+                    child = self._new_child()
+                    child._label_set = key
+                    self._children[key] = child
             return child
+
+    @property
+    def labels_dropped(self) -> int:
+        """Label sets folded into the overflow child so far."""
+        with self._lock:
+            return self._labels_dropped
 
     def children(self):
         with self._lock:

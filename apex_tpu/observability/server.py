@@ -17,7 +17,10 @@ any hot path, no dependencies:
 - ``/statusz`` — the attached status sources' ``stats()`` JSON
   (engine / fleet / ddp / supervisor — anything callable);
 - ``/flightz`` — the :class:`~apex_tpu.observability.EventRing`
-  contents with the drop accounting header (``?kind=`` filters);
+  contents with the drop accounting header (``?kind=`` filters;
+  ``?tenant=`` keeps only a tenant's events — both the per-request
+  ones stamped ``tenant: <name>`` and the aggregate failover /
+  deadline-sweep events listing the tenant in their ``tenants``);
 - ``/tracez`` — :class:`~apex_tpu.observability.SpanRecorder` records:
   the trace-id index by default, one schema-valid ``kind: trace``
   record with ``?trace_id=``.
@@ -38,6 +41,15 @@ any hot path, no dependencies:
   shape/dtype/static value changed).  ``?entry=`` narrows to one entry
   (404 when unknown); an empty ledger serves an empty snapshot, not an
   error — a jax-free process legitimately has nothing compiled.
+- ``/tenantz`` — the tenant plane (PR 16): every attached tenant
+  source's per-tenant SLO rollup (``fleet.tenant_stats()`` — goodput,
+  attainment, queue-wait vs service split, shed / deadline-miss
+  counts per tenant, plus the cardinality-cap drop accounting), with
+  the same per-source error isolation as ``/statusz``.  ``?tenant=``
+  narrows to one tenant (404 when no source knows it); a process with
+  no tenant source serves the empty shape, not an error — "which
+  tenant's p99 regressed" must be answerable by scrape even before
+  the first tagged request.
 
 Attachment is one call::
 
@@ -74,7 +86,7 @@ __all__ = ["ObservabilityServer", "serve", "ENDPOINTS",
            "ProfileInFlight"]
 
 ENDPOINTS = ("/healthz", "/metricsz", "/statusz", "/flightz", "/tracez",
-             "/profilez", "/compilez")
+             "/profilez", "/compilez", "/tenantz")
 
 
 class ProfileInFlight(RuntimeError):
@@ -126,12 +138,14 @@ class ObservabilityServer:
                  = None,
                  profiler: Optional[Callable] = None,
                  ledger=None,
+                 tenants: Optional[Dict[str, Callable[[], Any]]] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = 512):
         self._registry = registry
         self._ring = ring
         self._recorder = recorder
         self._ledger = ledger
+        self._tenants: Dict[str, Callable[[], Any]] = dict(tenants or {})
         self._status: Dict[str, Callable[[], Any]] = dict(status or {})
         self._health: Dict[str, Callable[[], Tuple[bool, str]]] = \
             dict(health or {})
@@ -154,6 +168,13 @@ class ObservabilityServer:
     def add_health_check(self, name: str,
                          fn: Callable[[], Tuple[bool, str]]):
         self._health[str(name)] = fn
+        return self
+
+    def add_tenant_source(self, name: str, fn: Callable[[], Any]):
+        """Attach a ``/tenantz`` source: a zero-arg callable returning
+        a per-tenant rollup dict with a ``tenants`` map
+        (``Fleet.tenant_stats`` is the standard one)."""
+        self._tenants[str(name)] = fn
         return self
 
     def attach_profiler(self, fn: Callable):
@@ -226,7 +247,8 @@ class ObservabilityServer:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
-    def flightz(self, kind: Optional[str] = None) -> Dict[str, Any]:
+    def flightz(self, kind: Optional[str] = None,
+                tenant: Optional[str] = None) -> Dict[str, Any]:
         ring = self.ring()
         # ONE snapshot feeds both the events and the drop-accounting
         # header (derived from the snapshot's own seqs, the dump()
@@ -242,10 +264,18 @@ class ObservabilityServer:
             total, retained = st["total"], 0
         if kind is not None:
             events = [e for e in events if e["kind"] == kind]
+        if tenant is not None:
+            # per-request events carry ``tenant``; aggregate ones
+            # (failover reclaim, deadline sweep) list every affected
+            # tenant in ``tenants`` — a tenant's view includes both
+            events = [e for e in events
+                      if e.get("tenant") == tenant
+                      or tenant in (e.get("tenants") or ())]
         return {"kind": "flight_ring", "capacity": ring.capacity,
                 "total": total, "retained": retained,
                 "dropped": total - retained,
-                "filter": kind, "events": events}
+                "filter": kind, "tenant_filter": tenant,
+                "events": events}
 
     def tracez(self, trace_id: Optional[str] = None) -> Dict[str, Any]:
         rec = self.recorder()
@@ -280,6 +310,42 @@ class ObservabilityServer:
             snap["entries"] = {entry: snap["entries"][entry]}
             snap["filter"] = entry
         return snap
+
+    def tenantz(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """Every attached tenant source's per-tenant SLO rollup, with
+        the ``/statusz`` error-isolation rule (a raising source reports
+        its error under its own key — one sick fleet must not blank the
+        page).  ``tenant=`` narrows every source's ``tenants`` map to
+        that tenant; a tenant no source knows raises ``KeyError``
+        (handler → 404).  No sources attached is the valid empty
+        shape, not an error."""
+        by_source: Dict[str, Any] = {}
+        names: set = set()
+        for name, fn in sorted(self._tenants.items()):
+            try:
+                snap = dict(fn())
+            except Exception as e:      # noqa: BLE001
+                by_source[name] = {"error": f"{type(e).__name__}: {e}"}
+                continue
+            tenants = snap.get("tenants")
+            if not isinstance(tenants, dict):
+                tenants = {}
+            snap["tenants"] = tenants
+            names.update(tenants)
+            by_source[name] = snap
+        if tenant is not None:
+            if tenant not in names:
+                raise KeyError(tenant)
+            for snap in by_source.values():
+                t = snap.get("tenants")
+                if isinstance(t, dict):
+                    snap["tenants"] = {k: v for k, v in t.items()
+                                       if k == tenant}
+        return {"kind": "tenants", "filter": tenant,
+                "sources": sorted(self._tenants),
+                "tenant_names": ([tenant] if tenant is not None
+                                 else sorted(names)),
+                "by_source": by_source}
 
     def profilez(self, duration_ms: Optional[float] = None
                  ) -> Dict[str, Any]:
@@ -349,7 +415,9 @@ class ObservabilityServer:
                         self._send_json(200, srv.statusz())
                     elif route == "/flightz":
                         kind = q.get("kind", [None])[0]
-                        self._send_json(200, srv.flightz(kind=kind))
+                        ten = q.get("tenant", [None])[0]
+                        self._send_json(200, srv.flightz(kind=kind,
+                                                         tenant=ten))
                     elif route == "/tracez":
                         tid = q.get("trace_id", [None])[0]
                         try:
@@ -390,6 +458,14 @@ class ObservabilityServer:
                         except KeyError:
                             self._send_json(404, {
                                 "error": f"unknown entry {ent!r}"})
+                    elif route == "/tenantz":
+                        ten = q.get("tenant", [None])[0]
+                        try:
+                            self._send_json(200,
+                                            srv.tenantz(tenant=ten))
+                        except KeyError:
+                            self._send_json(404, {
+                                "error": f"unknown tenant {ten!r}"})
                     elif route == "/":
                         self._send_json(200, {
                             "endpoints": list(ENDPOINTS)})
@@ -466,9 +542,10 @@ def serve(engine=None, fleet=None, supervisor=None,
     - ``engine`` → ``/statusz`` source ``engine`` (its ``stats()``) and,
       unless overridden, ``/metricsz`` serves the engine's registry;
     - ``fleet`` → source ``fleet``, the fleet's registry, the fleet's
-      flight ring (per-access, so ``set_ring`` swaps follow), and a
+      flight ring (per-access, so ``set_ring`` swaps follow), a
       ``replicas`` health check that fails when no replica is
-      steppable;
+      steppable, and the ``/tenantz`` tenant source
+      (``fleet.tenant_stats``);
     - ``supervisor`` → source ``run`` (its ``status()``) plus its
       ``health_check`` — ``/healthz`` turns 503 the moment the run is
       declared sick.
@@ -484,12 +561,15 @@ def serve(engine=None, fleet=None, supervisor=None,
     """
     st: Dict[str, Callable[[], Any]] = {}
     hc: Dict[str, Callable[[], Tuple[bool, str]]] = {}
+    tn: Dict[str, Callable[[], Any]] = {}
     if engine is not None:
         st["engine"] = engine.stats
         if registry is None:
             registry = getattr(engine, "metrics", None)
     if fleet is not None:
         st["fleet"] = fleet.stats
+        if hasattr(fleet, "tenant_stats"):
+            tn["fleet"] = fleet.tenant_stats
         if registry is None:
             registry = getattr(fleet, "metrics", None)
         if ring is None:
@@ -516,5 +596,5 @@ def serve(engine=None, fleet=None, supervisor=None,
     srv = ObservabilityServer(registry=registry, ring=ring,
                               recorder=recorder, status=st, health=hc,
                               profiler=profiler, ledger=ledger,
-                              host=host, port=port)
+                              tenants=tn, host=host, port=port)
     return srv.start() if start else srv

@@ -31,6 +31,16 @@ causal order: a child's id is always greater than its parent's).  A
   context and are reused, so the context must be scoped; the token
   reset in ``finally`` guarantees no leakage between pool tasks).
 
+**Tenant attribution.**  Attrs ride into each event's ``args``
+verbatim, and the fleet uses exactly that: a tagged request's
+``tenant`` / ``priority`` are stamped on EVERY span and event of its
+trace (submit, route, dispatch, engine queue/prefill, finish — and
+the failure hops: fault, reclaim, re-dispatch after failover), so
+filtering a Chrome trace or a ``trace_record`` by ``args.tenant``
+yields one tenant's complete story with no joins.  The recorder adds
+no tenant-specific machinery — the contract is the *stamping
+discipline* in ``fleet.Fleet._trace_ev``, pinned by tests.
+
 Exports:
 
 - **Chrome trace JSON** (``chrome://tracing`` / Perfetto): complete

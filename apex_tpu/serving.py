@@ -158,6 +158,11 @@ class _SlotScheduler:
         self._clock = time.perf_counter
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._submit_ts: Dict[int, float] = {}
+        # rid -> tenant tag (observability only: stamped on the
+        # engine's queue/prefill spans so engine-internal hops inside
+        # a fleet trace say whose request they served); dropped with
+        # the request (finish/cancel/take_waiting)
+        self._tenant_tags: Dict[int, str] = {}
         # engine-LOCAL totals for stats(): registry counters are shared
         # when several engines share a registry, and per-engine fields
         # (notably prefix_hit_rate's denominator) must not conflate
@@ -215,7 +220,10 @@ class _SlotScheduler:
         # engine_rid, not rid: these spans land inside FLEET request
         # traces whose rid attrs are fleet ids — the replica-local id
         # is a different namespace and must not join against them
-        with maybe_span("engine_prefill", engine_rid=rid):
+        tenant = self._tenant_tags.get(rid)
+        with maybe_span("engine_prefill", engine_rid=rid,
+                        **({"tenant": tenant} if tenant is not None
+                           else {})):
             self._admit(rid, *rest)
         t1 = self._clock()
         self._m_prefill.observe(t1 - t0)
@@ -315,18 +323,25 @@ class _SlotScheduler:
 
     _supports_seed = False
     _supports_temperature = False
+    # duck-typed capability flag: a fleet passes its request's tenant
+    # tag through to replicas that advertise it (stub/proxy replicas
+    # without the flag keep the pre-tenant dispatch signature)
+    accepts_tenant = True
 
     def add_request(self, prompt: Sequence[int],
                     max_new_tokens: int,
                     eos_token_id: Optional[int] = None,
                     seed: Optional[int] = None,
-                    temperature: Optional[float] = None) -> int:
+                    temperature: Optional[float] = None,
+                    tenant: Optional[str] = None) -> int:
         """Claim a slot, seed it, return the request id.  Raises if no
         slot is free (``submit`` queues instead).  ``seed`` names a
         request-intrinsic sampling stream and ``temperature`` overrides
         the engine default for THIS request (0.0 = greedy row) — both
         Engine-sampled-mode only; validated HERE so a bad request fails
-        at submission, not mid-harvest in a later ``step()``."""
+        at submission, not mid-harvest in a later ``step()``.
+        ``tenant`` is an opaque observability tag stamped on the
+        request's engine-side spans (queue/prefill)."""
         if not self._free:
             raise RuntimeError("no free slot; harvest finished "
                                "requests, use submit(), or add "
@@ -334,6 +349,8 @@ class _SlotScheduler:
         self._check_request(prompt, max_new_tokens, seed, temperature)
         rid = self._next_rid
         self._next_rid += 1
+        if tenant is not None:
+            self._tenant_tags[rid] = str(tenant)
         self._submit_ts.setdefault(rid, self._clock())
         self._admit_timed(rid, prompt, max_new_tokens, eos_token_id, seed,
                           temperature)
@@ -342,22 +359,28 @@ class _SlotScheduler:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_token_id: Optional[int] = None,
                seed: Optional[int] = None,
-               temperature: Optional[float] = None) -> int:
+               temperature: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """``add_request`` that QUEUES when the engine is full; queued
         requests are admitted automatically as slots free at the end
         of each ``step()`` (arrival order)."""
         self._check_request(prompt, max_new_tokens, seed, temperature)
         if self._free and not self._waiting:
             return self.add_request(prompt, max_new_tokens,
-                                    eos_token_id, seed, temperature)
+                                    eos_token_id, seed, temperature,
+                                    tenant=tenant)
         rid = self._next_rid
         self._next_rid += 1
+        if tenant is not None:
+            self._tenant_tags[rid] = str(tenant)
         self._submit_ts[rid] = self._clock()
         self._waiting.append((rid, list(prompt), max_new_tokens,
                               eos_token_id, seed, temperature))
         self._set_queue_gauge()
         maybe_event("engine_queue", engine_rid=rid,
-                    queue_depth=len(self._waiting))
+                    queue_depth=len(self._waiting),
+                    **({"tenant": str(tenant)} if tenant is not None
+                       else {}))
         return rid
 
     def _set_queue_gauge(self):
@@ -386,6 +409,7 @@ class _SlotScheduler:
         taken, self._waiting = self._waiting, []
         for rid, *_ in taken:
             self._submit_ts.pop(rid, None)
+            self._tenant_tags.pop(rid, None)
         self._set_queue_gauge()
         return taken
 
@@ -415,10 +439,12 @@ class _SlotScheduler:
             if item[0] == rid:
                 del self._waiting[i]
                 self._submit_ts.pop(rid, None)
+                self._tenant_tags.pop(rid, None)
                 self._set_queue_gauge()
                 return True
         for slot, req in list(self._by_slot.items()):
             if req.rid == rid:
+                self._tenant_tags.pop(rid, None)
                 del self._by_slot[slot]
                 self._free.append(slot)
                 self._freeze_slot(slot)
@@ -432,6 +458,7 @@ class _SlotScheduler:
     def _finish(self, slot, req):
         req.done = True
         req.t_finish = self._clock()
+        self._tenant_tags.pop(req.rid, None)
         del self._by_slot[slot]
         self._free.append(slot)
         self._finished[req.rid] = req

@@ -443,6 +443,81 @@ def main():
                   f"NOTHING (steady_state_retraces)")
         emit(**fl_d.record())
 
+        # two-tenant open-loop leg (schema v11): tenant "batch" floods
+        # the queue up front at low priority while tenant
+        # "interactive" trickles high-priority requests in as the
+        # fleet drains — the per-tenant plane must attribute goodput /
+        # attainment / queue-wait to each side of exactly this mix.
+        # Every request is tagged and deadlined (generously: this leg
+        # trends the ACCOUNTING, not CPU latency), so the sum of
+        # per-tenant goodput tokens must equal the fleet total — the
+        # parity line says the tenant split loses nothing.
+        fl_t, _, t_cold_ms, t_compiles = build_fleet(fleet_n)
+        deadline_s = 300.0
+        n_batch = requests
+        n_inter = max(8, requests // 4)
+        rng_t = np.random.RandomState(2)
+
+        def _tprompt():
+            return list(rng_t.randint(0, cfg.vocab_size, prompt_len))
+
+        traces_t = ledger.total_traces()
+        t0 = time.perf_counter()
+        for _ in range(n_batch):
+            fl_t.submit(_tprompt(), max_new_tokens=new_tokens,
+                        deadline=deadline_s, tenant="batch",
+                        priority=1)
+        sent = 0
+        step_i = 0
+        while fl_t.live() or sent < n_inter:
+            if sent < n_inter and step_i % 4 == 0:
+                fl_t.submit(_tprompt(), max_new_tokens=new_tokens,
+                            deadline=deadline_s, tenant="interactive",
+                            priority=0)
+                sent += 1
+            fl_t.step()
+            step_i += 1
+        dt_t = time.perf_counter() - t0
+        rec_t = fl_t.record()
+        ts_t = fl_t.tenant_stats()["tenants"]
+        fl_t.close()
+        tenant_tok = sum(b["goodput_tokens"]
+                         for b in rec_t["tenants"].values())
+        total_tok = rec_t["tokens_within_slo"]
+        parity = (tenant_tok / total_tok) if total_tok else None
+        t_note = (f"two-tenant open loop: {n_batch} batch requests "
+                  f"flood the queue up front, {n_inter} interactive "
+                  f"ones trickle in every 4 steps; every request "
+                  f"tagged + deadlined ({deadline_s:.0f}s — this leg "
+                  f"trends the tenant accounting, not CPU latency); "
+                  f"drained in {dt_t:.1f}s")
+        for tname in ("interactive", "batch"):
+            b = ts_t[tname]
+            emit(metric=f"gpt_tiny_fleet{fleet_n}_tenant_{tname}"
+                        f"_goodput",
+                 value=b["goodput_tokens_per_s"], unit="tokens/sec",
+                 vs_baseline=None, tenant=tname,
+                 slo_attainment=b["slo_attainment"],
+                 goodput_tokens=b["goodput_tokens"],
+                 submitted=b["submitted"], shed=b["shed"],
+                 deadline_exceeded=b["deadline_exceeded"],
+                 queue_wait_p99_s=b["queue_wait"].get("p99"),
+                 cold_compile_ms=round(t_cold_ms, 2),
+                 compiles_total=t_compiles,
+                 steady_state_retraces=(ledger.total_traces()
+                                        - traces_t),
+                 note=f"tenant {tname!r}; {t_note}")
+        emit(metric=f"gpt_tiny_fleet{fleet_n}_tenant_parity",
+             value=None if parity is None else round(parity, 4),
+             unit="ratio", vs_baseline=None,
+             tenants_goodput_tokens=tenant_tok,
+             tokens_within_slo=total_tok,
+             note=f"sum over tenants of goodput tokens / fleet "
+                  f"tokens_within_slo — every request is tagged, so "
+                  f"anything but 1.0 means the tenant split lost or "
+                  f"double-counted tokens; {t_note}")
+        emit(**rec_t)
+
     lint_errors = 0
     if "--graph-lint" in sys.argv:
         # prepend static graph-lint findings to the telemetry stream

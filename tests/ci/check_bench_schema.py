@@ -45,9 +45,16 @@ must carry the MFU fields and fresh engine-decode lines
 ``kv_cache_bytes``, at v4 fresh ``numerics_overhead_*`` lines the
 on/off step times, at v5 fresh ``run_supervisor_overhead*`` lines the
 same on/off pair, ``kind: fleet`` records may carry the SLO/goodput +
-deadline-sweep fields (validated whenever present), and at v8 fresh
+deadline-sweep fields (validated whenever present), at v8 fresh
 engine-decode lines the KV fragmentation pair (``kv_waste_bytes`` /
-``kv_utilization``).  All
+``kv_utilization``), and at v11 the tenant plane: fresh ``kind:
+fleet`` records must carry the per-tenant rollup (``tenants`` — the
+TENANT_COUNTS tallies per tenant, internally consistent and summing
+within the fleet totals — plus ``tenants_dropped``), fresh
+``*_tenant_*_goodput`` lines from the two-tenant leg must carry
+``tenant`` + ``slo_attainment``, and the ``*_tenant_parity`` line
+must carry (and arithmetically match) the token counts its ratio
+came from.  All
 record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py

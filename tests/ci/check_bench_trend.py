@@ -62,6 +62,19 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    wall-clock, but a 2x jump on hardware is a real compile-plane
    regression (a new shape family, a cache stopped hitting).
 
+6. **Tenant-plane regression** (schema v11 tenant fields).  Per-tenant
+   goodput lines from the ``bench.py --fleet`` two-tenant leg trend
+   through the ordinary (metric, backend) path — the tenant is part of
+   the metric name — and their ``slo_attainment`` field trends as its
+   own column: an attainment drop past ``--tol`` follows the
+   accelerator-gates / CPU-warns policy (attainment is timing-derived
+   on a noisy host).  The ``*_tenant_parity`` line is NOT timing: the
+   leg tags every request, so the sum of per-tenant goodput tokens
+   over the fleet total must be 1.0 — a fresh parity off 1.0 by more
+   than 1% means the tenant split lost or double-counted tokens, a
+   deterministic accounting bug that gates on every backend (the
+   steady-state-retrace rule, not the MFU rule).
+
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
@@ -69,8 +82,10 @@ measured anything).  Error lines (``value: null`` + ``error``) and
 flag/summary records are likewise excluded, as are per-run
 ``kind: numerics`` gradient-health dumps (schema v4), per-run
 ``kind: run`` supervisor verdicts (schema v5), per-run
-``kind: recovery`` controller snapshots (schema v6) and per-capture
-``kind: profile`` device-timeline attributions (schema v8) — their
+``kind: recovery`` controller snapshots (schema v6), per-capture
+``kind: profile`` device-timeline attributions (schema v8) and
+per-run ``kind: fleet`` snapshots (whose v11 per-tenant blocks
+describe one run's traffic mix, not a cross-round trend) — their
 stale replays still count toward the partition tally.  The ``run_supervisor_overhead``
 and ``fleet_goodput`` *metric* lines from ``bench.py --run`` are
 ordinary measurements and DO trend (accelerator gates, CPU warns).
@@ -217,6 +232,9 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # (metric, backend) -> (round_name, cold_compile_ms) of the
     # compile-plane trend (schema v10)
     last_compile = {}
+    # (metric, backend) -> (round_name, slo_attainment) of the
+    # per-tenant attainment trend (schema v11)
+    last_attain = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
 
@@ -381,6 +399,59 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             else:
                 errors.append(msg)
 
+    def track_tenant_fields(rname, rec):
+        """Tenant-plane gates for one fresh metric line (schema v11).
+        Per-tenant goodput trends through the ordinary (metric,
+        backend) path — the tenant is in the metric name — so this
+        adds the two tenant-specific columns: ``slo_attainment``
+        (timing-derived, so a drop past ``--tol`` follows the
+        accelerator-gates / CPU-warns policy) and the parity check
+        (exact token accounting: the two-tenant leg tags every
+        request, so a parity off 1.0 is a deterministic split bug —
+        gates on every backend, the steady-state-retrace rule)."""
+        subject = rec.get("metric")
+        if not isinstance(subject, str) or not subject:
+            return
+        if subject.endswith("_tenant_parity"):
+            val = rec.get("value")
+            if (isinstance(val, (int, float))
+                    and not isinstance(val, bool)
+                    and abs(val - 1.0) > 0.01):
+                errors.append(
+                    f"{rname}: {subject} "
+                    f"[{rec.get('backend') or '?'}] tenant parity is "
+                    f"{val:.4g}, not 1.0 — the per-tenant split lost "
+                    f"or double-counted goodput tokens (every request "
+                    f"in the leg is tagged, so the sums must agree "
+                    f"exactly)")
+            return
+        if "tenant" not in rec:
+            return
+        att = rec.get("slo_attainment")
+        if (not isinstance(att, (int, float)) or isinstance(att, bool)
+                or not (0.0 <= att <= 1.0)):
+            return
+        key = (subject, rec.get("backend"))
+        prev = last_attain.get(key)
+        last_attain[key] = (rname, float(att))
+        if prev is None:
+            return
+        pname, pval = prev
+        if pval <= 0:
+            return
+        drop = (pval - att) / pval
+        if drop > tol:
+            msg = (f"{rname}: {subject} "
+                   f"[{rec.get('backend') or '?'}] slo_attainment "
+                   f"dropped {drop * 100:.0f}% vs {pname} "
+                   f"({pval:.4g} -> {att:.4g}, tol "
+                   f"{tol * 100:.0f}%) — this tenant's deadlines "
+                   f"stopped holding")
+            if is_cpu(rec) and not strict_cpu:
+                warnings.append(msg + " [cpu smoke: warning only]")
+            else:
+                errors.append(msg)
+
     for rname, recs in rounds:
         wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
         for rec in recs:
@@ -406,10 +477,14 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             # (device-timeline attributions from bench --profile /
             # /profilez, schema v8) likewise describe one capture —
             # the profile_* metric lines next to them trend.
+            # ``kind: fleet`` snapshots (and their v11 per-tenant
+            # blocks) describe one run's traffic mix — the tenant
+            # metric lines next to them trend.
             if isinstance(rec, dict) and rec.get("kind") in ("numerics",
                                                              "run",
                                                              "recovery",
-                                                             "profile"):
+                                                             "profile",
+                                                             "fleet"):
                 if is_stale(rec):
                     n_stale += 1
                 continue
@@ -439,6 +514,7 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             track_cost_fields(rname, rec)
             track_overlap_fields(rname, rec)
             track_compile_fields(rname, rec)
+            track_tenant_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:

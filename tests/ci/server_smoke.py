@@ -16,7 +16,11 @@ content — including label values that NEED exposition escaping — then:
    (the jax-free deployment) the endpoint must answer 404, never 500 —
    and ``/compilez`` against a jax-free compilation ledger seeded with
    a shape retrace, whose differ verdict (culprit argument) must be on
-   the snapshot;
+   the snapshot, and ``/tenantz`` in both deployment shapes — with no
+   tenant source attached it must serve the valid empty rollup (200,
+   never an error: the jax-free process has no fleet), with a seeded
+   tenant source it must serve the per-tenant block, isolate a raising
+   source, filter with ``?tenant=`` and 404 an unknown tenant;
 3. validates ``/metricsz`` against the exposition-format conformance
    checker (``validate_prometheus_text``: TYPE/HELP lines, label
    escaping round-trip, +Inf buckets, cumulative monotonicity);
@@ -89,8 +93,12 @@ def main(argv):
     for v in (0.005, 0.05, 5.0):
         h.observe(v)
     ring = flightrec.EventRing(capacity=4)
-    for i in range(7):                  # overflow: exact drop accounting
+    for i in range(5):                  # overflow: exact drop accounting
         ring.append("smoke_event", i=i)
+    # tenant-stamped events for the ?tenant= filter: one per-request
+    # stamp, one aggregate tenants list (both must match)
+    ring.append("shed", queue_depth=4, max_queue=4, tenant="acme")
+    ring.append("failover", replica=0, tenants=["acme", "zeta"])
     rec = tracing.SpanRecorder()
     tid = tracing.new_trace_id("smoke")
     root = rec.event("submit", trace_id=tid)
@@ -162,6 +170,19 @@ def main(argv):
         if fz.get("total", 0) != fz.get("dropped", -1) + len(seqs):
             errs.append(f"/flightz drop accounting inexact: {fz}")
 
+        # /flightz?tenant= — one tenant's story: the per-request
+        # ``tenant`` stamp AND the aggregate ``tenants`` list match
+        code, _, body = _get(base + "/flightz?tenant=acme")
+        fzt = json.loads(body)
+        kinds = sorted(e["kind"] for e in fzt.get("events", []))
+        if code != 200 or kinds != ["failover", "shed"]:
+            errs.append(f"/flightz?tenant=acme expected the shed + "
+                        f"failover events, got {kinds}")
+        code, _, body = _get(base + "/flightz?tenant=nobody")
+        if json.loads(body).get("events"):
+            errs.append("/flightz?tenant=nobody returned events for "
+                        "an unknown tenant")
+
         # /tracez — index, then one schema-clean kind: trace record
         code, _, body = _get(base + "/tracez")
         tz = json.loads(body)
@@ -217,6 +238,57 @@ def main(argv):
             errs.append(f"/compilez unknown entry expected 404, got "
                         f"{code}")
 
+        # /tenantz — no tenant source attached: the valid empty shape
+        # (200, never an error — this loader is the jax-free
+        # deployment, exactly the process with no fleet)
+        code, _, body = _get(base + "/tenantz")
+        tz0 = json.loads(body)
+        if (code != 200 or tz0.get("kind") != "tenants"
+                or tz0.get("tenant_names") != []
+                or tz0.get("by_source") != {}):
+            errs.append(f"/tenantz empty shape wrong: {code} {tz0}")
+        code, _, _ = _get(base + "/tenantz?tenant=acme")
+        if code != 404:
+            errs.append(f"/tenantz?tenant= with no source expected "
+                        f"404, got {code}")
+
+        # /tenantz — seeded tenant source + a raising one: per-tenant
+        # block served, per-source error isolation, ?tenant= filter
+        bucket = {"submitted": 3, "finished": 2, "failed": 0,
+                  "shed": 1, "deadline_exceeded": 0, "slo_misses": 0,
+                  "goodput_tokens": 32, "with_deadline": 2,
+                  "within_deadline": 2, "slo_attainment": 1.0,
+                  "goodput_tokens_per_s": 12.5}
+        srv.add_tenant_source("fleet", lambda: {
+            "tenants": {"acme": dict(bucket), "zeta": dict(bucket)},
+            "tenants_dropped": 0, "label_sets_dropped": {}})
+        srv.add_tenant_source("boomfleet", lambda: (
+            _ for _ in ()).throw(RuntimeError("seeded tenant source "
+                                              "failure")))
+        code, _, body = _get(base + "/tenantz")
+        tz = json.loads(body)
+        if code != 200 or tz.get("tenant_names") != ["acme", "zeta"]:
+            errs.append(f"/tenantz tenant_names wrong: {code} "
+                        f"{tz.get('tenant_names')}")
+        acme = tz.get("by_source", {}).get("fleet", {}) \
+                 .get("tenants", {}).get("acme")
+        if acme != bucket:
+            errs.append(f"/tenantz fleet source bucket wrong: {acme}")
+        if "error" not in tz.get("by_source", {}).get("boomfleet", {}):
+            errs.append("/tenantz did not isolate the raising tenant "
+                        "source")
+        code, _, body = _get(base + "/tenantz?tenant=acme")
+        tzf = json.loads(body)
+        fl_t = tzf.get("by_source", {}).get("fleet", {})
+        if (code != 200 or tzf.get("filter") != "acme"
+                or list(fl_t.get("tenants", {})) != ["acme"]):
+            errs.append(f"/tenantz?tenant=acme filter broken: {code} "
+                        f"{fl_t.get('tenants')}")
+        code, _, _ = _get(base + "/tenantz?tenant=nope")
+        if code != 404:
+            errs.append(f"/tenantz unknown tenant expected 404, got "
+                        f"{code}")
+
         # sick supervisor flips /healthz to 503
         sup.observe_step(step=1, loss=float("nan"))
         code, _, body = _get(base + "/healthz")
@@ -231,9 +303,10 @@ def main(argv):
         print(f"server_smoke: {e}", file=sys.stderr)
     if errs:
         return 1
-    print("server_smoke: all 7 endpoints OK (exposition conformant, "
+    print("server_smoke: all 8 endpoints OK (exposition conformant, "
           "schemas valid, profilez no-capture 404, compilez retrace "
-          "differ verdict served, sick-run 503)")
+          "differ verdict served, tenantz empty shape + per-tenant "
+          "rollup + 404, sick-run 503)")
     return 0
 
 

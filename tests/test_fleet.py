@@ -1031,6 +1031,189 @@ def test_failed_dispatch_request_counts_as_slo_miss():
     assert fl.metrics.get("fleet_slo_miss_total").value == 1
 
 
+# -- PR 16: the tenant plane ----------------------------------------------
+
+def test_tenant_tag_survives_failover():
+    """Satellite 4: a tagged request reclaimed from a dead replica and
+    restarted on the survivor keeps its tenant on EVERY surface — each
+    span of the fault/reclaim/re-dispatch chain, the failover and
+    recovery_done aggregates on the flight ring (list membership, the
+    ``?tenant=`` filter rule), and the per-tenant SLO tallies."""
+    ring = obs.EventRing(capacity=64)
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        bad = FaultyReplica(_StubReplica(), raise_on_step=(2, None),
+                            ring=ring)
+        fl = Fleet([bad, _StubReplica()], policy="round_robin",
+                   health=HealthConfig(dead_consecutive=1,
+                                       cooldown_steps=100),
+                   retry=RetryPolicy(max_attempts=6, jitter=0.0),
+                   step_workers=1, ring=ring)
+        r0 = fl.submit([1, 2, 3], max_new_tokens=6,
+                       tenant="interactive", priority=0)
+        r1 = fl.submit([4, 5], max_new_tokens=3,
+                       tenant="batch", priority=1)
+        _drive(fl)
+        assert fl.stats()["failovers"] == 1
+        assert fl.result(r0) == _StubReplica.expected([1, 2, 3], 6)
+
+        # the reclaimed request's FULL chain is tenant-stamped — the
+        # hops after the fault (reclaim, survivor re-route/re-dispatch,
+        # result) included, not only the pre-fault ones
+        evs = rec.trace(fl.request_trace_id(r0))
+        assert [e["name"] for e in evs] == [
+            "fleet_submit", "fleet_route", "fleet_dispatch",
+            "fleet_fault", "fleet_reclaim", "fleet_route",
+            "fleet_dispatch", "fleet_result"]
+        for e in evs:
+            assert e["args"]["tenant"] == "interactive", e["name"]
+            assert e["args"]["priority"] == 0, e["name"]
+        # the undisturbed request's spans carry ITS tag
+        for e in rec.trace(fl.request_trace_id(r1)):
+            assert e["args"]["tenant"] == "batch"
+
+        # ring aggregates name the suffering tenant (lists — only the
+        # reclaimed request's tenant, not every tenant in flight)
+        (fo,) = ring.snapshot("failover")
+        assert fo["tenants"] == ["interactive"]
+        (rd,) = ring.snapshot("recovery_done")
+        assert rd["tenants"] == ["interactive"]
+        # the /flightz?tenant= membership rule finds both aggregates
+        kinds = {e["kind"] for e in
+                 ring.snapshot(tenant="interactive")}
+        assert {"failover", "recovery_done"} <= kinds
+        assert not {"failover", "recovery_done"} & {
+            e["kind"] for e in ring.snapshot(tenant="batch")}
+
+        # SLO accounting followed the request across the failover
+        ts = fl.slo.tenant_stats()
+        assert ts["interactive"]["submitted"] == 1
+        assert ts["interactive"]["finished"] == 1
+        assert ts["interactive"]["goodput_tokens"] == 6
+        assert ts["batch"]["goodput_tokens"] == 3
+        # ...and so did the tenant-labeled registry child
+        assert fl.metrics.get("fleet_goodput_tokens_total").labels(
+            tenant="interactive").value == 6
+    finally:
+        obs.set_recorder(prev)
+
+
+def test_tenant_sums_equal_untagged_totals_under_concurrency():
+    """THE exactness pin: with every request tagged, the sum over
+    tenants of goodput tokens / sheds / deadline misses / finishes
+    equals the untagged fleet totals EXACTLY — per-tenant accounting
+    is a partition of the same counters, not a parallel estimate —
+    including with threaded replica steps (``step_workers=2``)."""
+    t = [0.0]
+    fl = Fleet([_StubReplica(slots=1), _StubReplica(slots=1)],
+               max_queue=2, replica_queue_cap=0, step_workers=2,
+               clock=lambda: t[0], ring=obs.EventRing(capacity=64))
+    # occupy both slots with long decodes, one tenant each
+    fl.submit([1], max_new_tokens=6, tenant="acme")
+    fl.submit([1, 2], max_new_tokens=6, tenant="zeta")
+    fl.step()
+    t[0] += 1.0
+    # fill the fleet queue with deadlined requests that will expire
+    d1 = fl.submit([1], max_new_tokens=1, deadline=2.0, tenant="acme")
+    d2 = fl.submit([1, 2], max_new_tokens=1, deadline=2.0,
+                   tenant="zeta")
+    # overload: sheds are tenant-attributed BEFORE rid allocation
+    for tn in ("acme", "acme", "zeta"):
+        with pytest.raises(FleetOverloaded):
+            fl.submit([9], max_new_tokens=1, tenant=tn)
+    t[0] = 5.0                    # both queued deadlines now hopeless
+    steps = 0
+    while fl.live():
+        fl.step()
+        t[0] += 1.0
+        steps += 1
+        assert steps < 50
+    assert fl.status(d1) == "failed" and fl.status(d2) == "failed"
+
+    s = fl.stats()
+    ts = s["tenants"]
+    assert sorted(ts) == ["acme", "zeta"]
+    for key, total in (("shed", s["shed"]),
+                       ("deadline_exceeded", s["deadline_exceeded"]),
+                       ("goodput_tokens", s["slo"]["goodput_tokens"]),
+                       ("submitted", s["submitted"]),
+                       ("finished", s["finished"]),
+                       ("failed", s["failed"])):
+        assert sum(b[key] for b in ts.values()) == total, key
+    assert s["shed"] == 3 and ts["acme"]["shed"] == 2
+    assert s["deadline_exceeded"] == 2
+    assert s["slo"]["goodput_tokens"] == 12    # the two occupiers
+    # both tenants missed their one deadlined request
+    assert ts["acme"]["slo_attainment"] == 0.0
+    assert ts["zeta"]["slo_attainment"] == 0.0
+    # the v11 record carries the same partition and validates
+    rec = JsonlExporter.enrich(fl.record())
+    assert rec["schema_version"] >= 11
+    assert validate_fleet_record(rec) == []
+    assert sum(b["goodput_tokens"] for b in rec["tenants"].values()) \
+        == rec["tokens_within_slo"]
+    # ...and the validator catches a partition that over-counts
+    broken = {**rec, "tenants": {
+        **rec["tenants"],
+        "acme": {**rec["tenants"]["acme"],
+                 "goodput_tokens": rec["tokens_within_slo"] + 1}}}
+    assert validate_fleet_record(broken)
+    # v11 gating: a fresh record WITHOUT the tenant block is rejected;
+    # the same record declaring v10 (an archived stream) stays clean
+    stripped = {k: v for k, v in rec.items()
+                if k not in ("tenants", "tenants_dropped")}
+    assert any("tenants" in e
+               for e in validate_fleet_record(stripped))
+    assert validate_fleet_record(
+        {**stripped, "schema_version": 10}) == []
+
+
+def test_tenant_cardinality_flood_stays_bounded_and_conserved():
+    """A flood of distinct tenant ids must not grow unbounded state:
+    past ``max_tenants`` new ids fold into the shared ``other`` bucket
+    on EVERY surface (SLO buckets, span stamps, registry label
+    children), the fold is counted on ``tenants_dropped``, and the
+    totals stay conserved — folding loses attribution, never tokens."""
+    fl = Fleet([_StubReplica(slots=4)], step_workers=1,
+               ring=obs.EventRing(capacity=64))
+    fl.slo.max_tenants = 3
+    rec = obs.SpanRecorder()
+    prev = obs.set_recorder(rec)
+    try:
+        rids = [fl.submit([1, 2], max_new_tokens=2, tenant=f"t{i}")
+                for i in range(8)]
+        _drive(fl)
+        s = fl.stats()
+        ts = s["tenants"]
+        # bounded: 3 real buckets + the overflow, 5 folds accounted
+        assert sorted(ts) == ["other", "t0", "t1", "t2"]
+        assert fl.slo.tenants_dropped == 5
+        assert s["tenants_dropped"] == 5
+        assert ts["other"]["submitted"] == 5
+        # conserved: the fold moved tokens, it didn't drop them
+        assert sum(b["goodput_tokens"] for b in ts.values()) == 16
+        assert s["slo"]["goodput_tokens"] == 16
+        # the fold happens ONCE at submit, so spans agree with stats
+        for e in rec.trace(fl.request_trace_id(rids[7])):
+            assert e["args"]["tenant"] == "other"
+        # registry children bounded to the same fold
+        goodput = fl.metrics.get("fleet_goodput_tokens_total")
+        vals = {dict(k)["tenant"] for k in goodput.children()}
+        assert vals == {"other", "t0", "t1", "t2"}
+        assert goodput.labels(tenant="other").value == 10
+        # slo folds BEFORE the registry sees the label, so no metric
+        # hit its own cap — the fleet surface reports no label drops
+        assert fl.tenant_stats()["label_sets_dropped"] == {}
+        # the v11 record stays schema-valid mid-fold
+        out = JsonlExporter.enrich(fl.record())
+        assert validate_fleet_record(out) == []
+        assert out["tenants_dropped"] == 5
+        assert sorted(out["tenants"]) == ["other", "t0", "t1", "t2"]
+    finally:
+        obs.set_recorder(prev)
+
+
 # -- PR 15: the compilation plane ------------------------------------------
 
 def test_fleet_warmup_precompiles_every_replica():

@@ -1613,6 +1613,82 @@ def test_prometheus_text_escapes_and_roundtrips():
     assert hs["esc_seconds_sum"][1] == pytest.approx(9.55)
 
 
+def test_prometheus_labeled_histogram_exposition_roundtrips():
+    """PR 16 satellite: labeled HISTOGRAM children expose correctly —
+    each label set's buckets merge the child labels with ``le=``, keep
+    their own cumulative +Inf/_count invariants, and user-supplied
+    tenant label values (quotes, backslashes, newlines) survive the
+    escape round-trip on every bucket line."""
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("tenant_wait_seconds", help="queue wait",
+                      buckets=(0.1, 1.0))
+    nasty = 'acme "prod"\nv\\2'
+    h.labels(tenant="batch").observe(0.05)
+    h.labels(tenant="batch").observe(0.5)
+    h.labels(tenant=nasty).observe(9.0)
+    text = exporters.prometheus_text(reg)
+    assert exporters.validate_prometheus_text(text) == []
+    fams = exporters.parse_prometheus_text(text)
+    f = fams["tenant_wait_seconds"]
+    assert f["type"] == "histogram"
+    # untouched parent suppressed: every sample carries the tenant
+    assert f["samples"] and all("tenant" in lab
+                                for _, lab, _ in f["samples"])
+    per = {}
+    for name, lab, value in f["samples"]:
+        s = per.setdefault(lab["tenant"], {})
+        if name.endswith("_bucket"):
+            s[lab["le"]] = value
+        else:
+            s[name.rsplit("_", 1)[-1]] = value
+    # per-label-set cumulative buckets, each with its own +Inf==_count
+    assert per["batch"] == {"0.1": 1.0, "1": 2.0, "+Inf": 2.0,
+                            "sum": pytest.approx(0.55), "count": 2.0}
+    # the gnarly tenant value came back EXACTLY, buckets intact
+    assert per[nasty]["+Inf"] == 1.0 and per[nasty]["count"] == 1.0
+    assert per[nasty]["sum"] == 9.0
+    # a parent observed DIRECTLY as well exposes both series
+    h.observe(0.05)
+    fams = exporters.parse_prometheus_text(
+        exporters.prometheus_text(reg))
+    bare = [lab for n, lab, _ in fams["tenant_wait_seconds"]["samples"]
+            if n.endswith("_count") and "tenant" not in lab]
+    assert bare == [{}]
+    assert exporters.validate_prometheus_text(
+        exporters.prometheus_text(reg)) == []
+
+
+def test_registry_label_cardinality_cap_folds_and_counts():
+    """PR 16 tentpole guard: a metric flooded with more distinct label
+    values than ``max_label_sets`` stays bounded — overflow folds into
+    the shared ``other`` child, the fold is counted on
+    ``labels_dropped``, totals are conserved, and the exposition stays
+    conformant mid-fold."""
+    from apex_tpu.observability.metrics import (DEFAULT_MAX_LABEL_SETS,
+                                                OVERFLOW_LABEL_VALUE)
+    reg = obs.MetricsRegistry()
+    c = reg.counter("flood_total")
+    assert c.max_label_sets == DEFAULT_MAX_LABEL_SETS
+    c.max_label_sets = 3
+    for i in range(8):
+        c.labels(tenant=f"t{i}").inc()
+    kids = c.children()
+    assert {dict(k)["tenant"] for k in kids} == \
+        {"t0", "t1", "t2", OVERFLOW_LABEL_VALUE}
+    assert c.labels_dropped == 5
+    # conserved: the folded increments landed on the overflow child
+    assert c.labels(tenant=OVERFLOW_LABEL_VALUE).value == 5
+    assert sum(ch.value for ch in kids.values()) == 8
+    # a REPEATED over-cap id keeps folding (per-call drop accounting)
+    c.labels(tenant="t7").inc()
+    assert c.labels_dropped == 6
+    assert c.labels(tenant=OVERFLOW_LABEL_VALUE).value == 6
+    # an id that got under the cap is unaffected
+    assert c.labels(tenant="t1").value == 1
+    assert exporters.validate_prometheus_text(
+        exporters.prometheus_text(reg)) == []
+
+
 def test_validate_prometheus_text_catches_violations():
     # missing +Inf bucket
     bad = ("# TYPE h histogram\n"
@@ -1639,6 +1715,22 @@ def test_validate_prometheus_text_catches_violations():
                for e in exporters.validate_prometheus_text(bad))
     # unparseable line
     assert exporters.validate_prometheus_text("{broken 1.0\n")
+    # labeled-histogram invariants hold PER label set: one tenant's
+    # series missing its +Inf (or disagreeing with _count) is caught
+    # even when a sibling series is clean
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{tenant="ok",le="+Inf"} 2\n'
+           'h_sum{tenant="ok"} 1.0\nh_count{tenant="ok"} 2\n'
+           'h_bucket{tenant="sick",le="1"} 1\n'
+           'h_sum{tenant="sick"} 0.5\nh_count{tenant="sick"} 1\n')
+    errs = exporters.validate_prometheus_text(bad)
+    assert any("+Inf" in e and "sick" in e for e in errs)
+    assert not any("'ok'" in e for e in errs)
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{tenant="a",le="+Inf"} 3\n'
+           'h_sum{tenant="a"} 1.0\nh_count{tenant="a"} 4\n')
+    assert any("_count" in e
+               for e in exporters.validate_prometheus_text(bad))
 
 
 # -- EventRing.dump under concurrent appends (PR 10, satellite) -----------
@@ -2049,6 +2141,140 @@ def test_check_bench_trend_compile_gate(tmp_path):
     _trend_round(d5, "BENCH_r02.json",
                  [dict(line("tpu", 100.0, 9000.0, retraces=5),
                        stale=True)])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 0, r.stderr
+
+
+def test_v11_tenant_fields_and_version_gating():
+    """Schema v11 (the tenant plane): fresh per-tenant goodput lines
+    must carry ``tenant`` + ``slo_attainment``, the parity line its
+    token counts (arithmetically consistent); archived v10 streams
+    re-validate clean at their declared version; TENANT_COUNTS is
+    pinned to the SLO tracker's actual bucket keys so the validator
+    and the producer cannot drift."""
+    assert exporters.SCHEMA_VERSION >= 11
+    from apex_tpu.fleet import slo as fleet_slo
+    assert exporters.TENANT_COUNTS == tuple(
+        k for k in fleet_slo._new_tenant_bucket()
+        if k not in ("t_first", "t_last", "tenant"))
+
+    tline = {"metric": "gpt_tiny_fleet2_tenant_interactive_goodput",
+             "value": 42.0, "unit": "tokens/sec", "vs_baseline": None,
+             "backend": "cpu", "ndev": 1, "arch": "cpu",
+             "tenant": "interactive", "slo_attainment": 1.0}
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(dict(tline))) == []
+    # fresh v11 tenant-goodput line missing either required field
+    for key in ("tenant", "slo_attainment"):
+        rec = exporters.JsonlExporter.enrich(
+            {k: v for k, v in tline.items() if k != key})
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), key
+    # ...but the same line DECLARING v10 (archived) is valid
+    v10 = exporters.JsonlExporter.enrich(
+        {k: v for k, v in tline.items()
+         if k not in ("tenant", "slo_attainment")})
+    v10["schema_version"] = 10
+    assert exporters.validate_bench_record(v10) == []
+    # null attainment (no deadlined request resolved) is valid
+    assert exporters.validate_bench_record(exporters.JsonlExporter
+        .enrich(dict(tline, slo_attainment=None))) == []
+    # field VALUES checked wherever they appear
+    for key, bad in (("slo_attainment", 1.5),
+                     ("slo_attainment", -0.1),
+                     ("tenant", ""), ("tenant", 7)):
+        rec = exporters.JsonlExporter.enrich(dict(tline, **{key: bad}))
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), \
+            (key, bad)
+
+    pline = {"metric": "gpt_tiny_fleet2_tenant_parity", "value": 1.0,
+             "unit": "ratio", "vs_baseline": None, "backend": "cpu",
+             "ndev": 1, "arch": "cpu",
+             "tenants_goodput_tokens": 120, "tokens_within_slo": 120}
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(dict(pline))) == []
+    # the ratio must reassemble from its own counts
+    assert any("tenants_goodput_tokens" in e or "reassemble" in e
+               for e in exporters.validate_bench_record(
+                   exporters.JsonlExporter.enrich(
+                       dict(pline, value=0.9))))
+    # fresh v11 parity line missing its counts
+    for key in ("tenants_goodput_tokens", "tokens_within_slo"):
+        rec = exporters.JsonlExporter.enrich(
+            {k: v for k, v in pline.items() if k != key})
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), key
+    # archived v10 parity-free streams unaffected; stale exempt
+    stale = exporters.JsonlExporter.enrich(
+        {k: v for k, v in pline.items()
+         if k not in ("tenants_goodput_tokens", "tokens_within_slo")},
+        stale=True)
+    assert exporters.validate_bench_record(stale) == []
+
+
+def test_check_bench_trend_tenant_gate(tmp_path):
+    """The tenant-plane trend gates: a fresh parity line off 1.0 by
+    more than 1% errors on EVERY backend (exact token accounting — the
+    leg tags every request), while a per-tenant slo_attainment drop
+    past --tol follows the accelerator-gates / CPU-warns policy like
+    every timing-derived column; stale replays never trend."""
+    def tline(backend, attain):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "gpt_tiny_fleet2_tenant_interactive_goodput",
+             "value": 50.0, "unit": "tokens/sec", "vs_baseline": None,
+             "backend": backend, "ndev": 1,
+             "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
+             "tenant": "interactive", "slo_attainment": attain})
+
+    def parity(backend, value, tg, tw):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "gpt_tiny_fleet2_tenant_parity", "value": value,
+             "unit": "ratio", "vs_baseline": None, "backend": backend,
+             "ndev": 1,
+             "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
+             "tenants_goodput_tokens": tg, "tokens_within_slo": tw})
+
+    # parity off 1.0: error even on CPU smoke, first round
+    d1 = tmp_path / "ten1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [parity("cpu", 0.9, 90, 100)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "parity" in r.stderr
+    # accelerator attainment drop past tol: error
+    d2 = tmp_path / "ten2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [tline("tpu", 1.0)])
+    _trend_round(d2, "BENCH_r02.json", [tline("tpu", 0.5)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 1
+    assert "slo_attainment" in r.stderr
+    # same drop on CPU smoke: warning only (strict-cpu gates)
+    d3 = tmp_path / "ten3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [tline("cpu", 1.0)])
+    _trend_round(d3, "BENCH_r02.json", [tline("cpu", 0.5)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 0 and "slo_attainment" in r.stderr
+    r = _run_trend(["--dir", str(d3), "--strict-cpu"])
+    assert r.returncode == 1
+    # steady attainment + exact parity: clean
+    d4 = tmp_path / "ten4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json",
+                 [tline("tpu", 1.0), parity("tpu", 1.0, 100, 100)])
+    _trend_round(d4, "BENCH_r02.json",
+                 [tline("tpu", 1.0), parity("tpu", 1.0, 120, 120)])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 0, r.stderr
+    # a STALE replay with broken parity / cratered attainment: ignored
+    d5 = tmp_path / "ten5"
+    d5.mkdir()
+    _trend_round(d5, "BENCH_r01.json", [tline("tpu", 1.0)])
+    _trend_round(d5, "BENCH_r02.json",
+                 [dict(tline("tpu", 0.1), stale=True),
+                  dict(parity("tpu", 0.5, 50, 100), stale=True)])
     r = _run_trend(["--dir", str(d5)])
     assert r.returncode == 0, r.stderr
 

@@ -1,8 +1,9 @@
-"""Introspection server: all five endpoints live, exposition
-conformance on /metricsz, error isolation on /statusz, 503 on a sick
-run, and the tentpole acceptance pin — a server attached to a RUNNING
-fleet serves every endpoint while traffic is in flight, with the
-scraped numbers agreeing with the fleet's own stats.
+"""Introspection server: every endpoint live, exposition conformance
+on /metricsz, error isolation on /statusz (and /tenantz), 503 on a
+sick run, and the tentpole acceptance pin — a server attached to a
+RUNNING fleet serves every endpoint while traffic is in flight, with
+the scraped numbers (including the per-tenant rollup) agreeing with
+the fleet's own stats.
 
 The HTTP layer is exercised for real (ephemeral ports, urllib), never
 mocked: the contract is that an operator can point curl at a live
@@ -241,8 +242,9 @@ def test_server_restarts_on_fresh_port(basic_server):
 
 def test_live_scrape_of_running_fleet_during_traffic():
     """server.serve(fleet=...) attached to a Fleet actively stepping
-    traffic: all five endpoints serve concurrently with the step loop,
-    /metricsz stays exposition-conformant mid-flight, /statusz's
+    tenant-tagged traffic: every endpoint serves concurrently with the
+    step loop, /metricsz stays exposition-conformant mid-flight,
+    /tenantz serves a schema-shaped rollup mid-flight, /statusz's
     fleet numbers agree with Fleet.stats(), /flightz shows the fleet's
     ring, and /tracez returns a schema-clean kind: trace record for a
     real request."""
@@ -258,8 +260,11 @@ def test_live_scrape_of_running_fleet_during_traffic():
         try:
             for wave in range(6):
                 rids = [fleet.submit([1, 2, 3], max_new_tokens=6,
-                                     deadline=30.0)
-                        for _ in range(6)]
+                                     deadline=30.0,
+                                     tenant=("interactive" if i % 2
+                                             else "batch"),
+                                     priority=0 if i % 2 else 1)
+                        for i in range(6)]
                 while fleet.live():
                     fleet.step()
                 for r in rids:
@@ -285,6 +290,12 @@ def test_live_scrape_of_running_fleet_during_traffic():
                 if ep == "/metricsz":
                     assert exporters.validate_prometheus_text(
                         body.decode()) == []
+                if ep == "/tenantz":
+                    # a schema-shaped rollup MID-FLIGHT, not only
+                    # after the traffic drains
+                    tz = json.loads(body)
+                    assert tz["kind"] == "tenants"
+                    assert "fleet" in tz["by_source"]
                 scrapes += 1
             if stop.is_set():
                 break
@@ -316,9 +327,34 @@ def test_live_scrape_of_running_fleet_during_traffic():
         names = [sp["name"] for sp in trec["spans"]]
         assert names[0] == "fleet_submit"
         assert "fleet_dispatch" in names and "fleet_result" in names
+        # rid 0 was tagged tenant "batch": EVERY hop of its trace
+        # carries the stamp (filtering by args.tenant yields the
+        # tenant's complete story)
+        assert all(sp.get("args", {}).get("tenant") == "batch"
+                   for sp in trec["spans"])
         # /healthz: replicas check wired by serve(fleet=)
         code, hz = _get_json(srv2.url + "/healthz")
         assert code == 200 and hz["checks"]["replicas"]["ok"]
+        # /tenantz: the per-tenant rollup of the tagged traffic,
+        # exact under the sum-over-tenants rule (every request tagged)
+        code, tz = _get_json(srv2.url + "/tenantz")
+        assert code == 200
+        assert tz["tenant_names"] == ["batch", "interactive"]
+        tb = tz["by_source"]["fleet"]["tenants"]
+        assert (tb["batch"]["submitted"]
+                + tb["interactive"]["submitted"]) == 36
+        assert tb["interactive"]["slo_attainment"] == 1.0
+        assert tb["batch"]["finished"] == tb["batch"]["submitted"]
+        code, tzf = _get_json(srv2.url + "/tenantz?tenant=batch")
+        assert code == 200
+        assert list(tzf["by_source"]["fleet"]["tenants"]) == ["batch"]
+        code, _ = _get_json(srv2.url + "/tenantz?tenant=nope")
+        assert code == 404
+        # the fleet's v11 record (per-tenant block included) is
+        # schema-clean end to end
+        rec = exporters.JsonlExporter.enrich(fleet.record())
+        assert rec["schema_version"] >= 11
+        assert exporters.validate_fleet_record(rec) == []
     finally:
         srv2.stop()
 
@@ -440,9 +476,10 @@ def test_profilez_live_capture_real_engine():
 def test_ci_server_smoke_gate():
     """The tier-1 wiring of tests/ci/server_smoke.py (like the trend
     gate): the jax-free smoke script boots the server, scrapes all
-    seven endpoints (incl. the /profilez no-capture 404 and the
-    /compilez ledger snapshot with a seeded retrace verdict), and
-    validates exposition + JSON schemas."""
+    eight endpoints (incl. the /profilez no-capture 404, the /compilez
+    ledger snapshot with a seeded retrace verdict, and the /tenantz
+    empty shape + seeded per-tenant rollup), and validates exposition
+    + JSON schemas."""
     import os
     import subprocess
     import sys
@@ -451,7 +488,7 @@ def test_ci_server_smoke_gate():
     r = subprocess.run([sys.executable, script], capture_output=True,
                        text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "all 7 endpoints OK" in r.stdout
+    assert "all 8 endpoints OK" in r.stdout
 
 
 def test_compilez_live_ledger():
