@@ -758,6 +758,97 @@ register_entry_point(
     _engine_prefill_graph)
 
 
+def _tiny_paged_engine():
+    import jax
+    from .. import models, serving
+    m = models.GPT(models.GPTConfig(vocab_size=64, block_size=32,
+                                    n_layer=2, n_head=4, n_embd=32,
+                                    dropout=0.0, n_kv_head=2))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    return serving.PagedEngine(m, params, slots=2, buf_len=32,
+                               block_size=8, prefill_chunk=8, window=8)
+
+
+def _paged_step_k_graph(ep):
+    import jax
+    from .. import serving
+    eng = _tiny_paged_engine()
+    pending = eng._stage_pending()
+    args = (eng.ids, eng.cur_len, eng.kv_len, eng.pool,
+            eng._slot_keys, eng._slot_temp, eng.limit, eng._eos,
+            eng.tables, eng.n_blk, eng.free_stack, eng.free_top,
+            pending)
+    n_pool = len(jax.tree_util.tree_leaves(eng.pool))
+    ep.expect.setdefault("donation", {
+        # the block pool is THE multi-GB resident and must alias in
+        # place through the whole K-tick scan (gather/compute/scatter
+        # per tick); ids and the RNG keys ride along.  cur_len /
+        # kv_len / n_blk are per-slot length vectors covered by
+        # serving.DONATION_BLOCKLIST (PR 2 compile-cache corruption
+        # class), and the scheduler vectors (tables, free stack,
+        # pending pack) are read-mostly
+        "expect_donated": ("ids", "pool", "keys"),
+        "forbid_donated": ("temps", "limit", "eos", "tables",
+                           "free_stack", "free_top", "pending"),
+        "min_aliased": n_pool + 2})
+    # the dense per-slot gather materializes a pool-sized temporary
+    # per tick next to the donated pool itself — ~2x pool + params is
+    # the honest working set; 4x budgets headroom, not a leak
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    return Graph(trace=_scoped(
+                     _no_policy(),
+                     lambda: jax.make_jaxpr(eng._paged_step_k)(*args)),
+                 lower=_scoped(_no_policy(),
+                               lambda: eng._paged_step_k.lower(*args)),
+                 arg_names=serving.PAGED_STEP_K_ARG_NAMES,
+                 example_args=args)
+
+
+register_entry_point(
+    "paged_step_k", tags=("serving", "donation", "paged"),
+    description="PagedEngine._paged_step_k: K continuous-batching "
+                "ticks (chunked prefill + decode + in-graph block "
+                "recycling + iteration-boundary admission)")(
+    _paged_step_k_graph)
+
+
+def _paged_admit_graph(ep):
+    import jax
+    import jax.numpy as jnp
+    from .. import serving
+    eng = _tiny_paged_engine()
+    args = (eng.ids, eng.cur_len, eng.kv_len, eng.limit, eng._eos,
+            eng._slot_keys, eng._slot_temp, eng.tables, eng.n_blk,
+            eng.free_stack, eng.free_top, jnp.int32(0),
+            jnp.zeros((32,), jnp.int32), jnp.int32(3), jnp.int32(8),
+            jnp.int32(-1), jax.random.PRNGKey(1), jnp.float32(0.0),
+            jnp.int32(1))
+    ep.expect.setdefault("donation", {
+        # admission is a scheduler-row seed, NOT a prefill: it writes
+        # the ids row + key and pops block ids — there is no KV
+        # argument to donate, and the blocklisted length vectors
+        # (cur_len/kv_len/n_blk) must never alias
+        "expect_donated": ("ids", "keys"),
+        "forbid_donated": ("limit", "eos", "temps", "tables",
+                           "free_stack", "free_top", "slot", "row"),
+        "min_aliased": 2})
+    ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 2.5})
+    return Graph(trace=_scoped(
+                     _no_policy(),
+                     lambda: jax.make_jaxpr(eng._paged_admit)(*args)),
+                 lower=_scoped(_no_policy(),
+                               lambda: eng._paged_admit.lower(*args)),
+                 arg_names=serving.PAGED_ADMIT_ARG_NAMES,
+                 example_args=args)
+
+
+register_entry_point(
+    "paged_admit", tags=("serving", "donation", "paged"),
+    description="PagedEngine._paged_admit: window-boundary block "
+                "reservation + scheduler-row seed (no prefill)")(
+    _paged_admit_graph)
+
+
 def _seq2seq_step_k_graph(ep):
     import jax
     from .. import models, serving

@@ -28,7 +28,7 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
-           "COMPILE_FIELDS", "TENANT_COUNTS",
+           "COMPILE_FIELDS", "TENANT_COUNTS", "ADMISSION_MODES",
            "host_info", "JsonlExporter",
            "prometheus_text", "parse_prometheus_text",
            "validate_prometheus_text", "validate_bench_record",
@@ -130,9 +130,27 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # ``*_tenant_parity`` line must carry the token counts its ratio came
 # from (``tenants_goodput_tokens`` / ``tokens_within_slo``) and
 # reassemble from them.
+# v12: the paged serving plane.  Fresh engine-decode lines must say
+# HOW their engine admits and holds KV: ``admission_mode`` (one of
+# ADMISSION_MODES — ``fixed_slot`` reserves a whole buf_len row per
+# request, ``paged`` reserves fixed-size blocks off a shared pool and
+# admits at iteration boundaries), so trend tooling never compares a
+# paged line against a fixed-slot baseline unknowingly.  Lines from a
+# paged engine must additionally carry the pool geometry —
+# ``block_size``, ``blocks_total``, ``blocks_free`` (ints,
+# blocks_free <= blocks_total) — next to the v8 fragmentation pair
+# those fields explain: a falling ``kv_waste_bytes`` claim is
+# meaningless without the block size that produced it.  All four are
+# validated whenever present at any version; required on fresh v12
+# engine-decode lines.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v10 streams stay valid.
-SCHEMA_VERSION = 11
+# version, so archived v1..v11 streams stay valid.
+SCHEMA_VERSION = 12
+
+# how a serving engine admits requests and holds KV (stdlib-side
+# duplicate of the serving engines' ``admission_mode`` class attrs —
+# this module must stay importable without jax; tests pin them in sync)
+ADMISSION_MODES = ("fixed_slot", "paged")
 
 # the compile-plane bench fields (stdlib-side duplicate of
 # observability.compilation.BENCH_COMPILE_FIELDS — this module must
@@ -489,6 +507,34 @@ def _check_kv_fields(rec, errs):
                         f"{v!r}")
 
 
+def _check_block_pool_fields(rec, errs):
+    """The paged-KV field contract (schema v12), validated whenever
+    present at any version: ``admission_mode`` names a known mode;
+    ``block_size`` is a positive int; ``blocks_total`` /
+    ``blocks_free`` are non-negative ints with free <= total (free
+    blocks beyond the pool would mean the allocator double-freed)."""
+    if "admission_mode" in rec:
+        am = rec["admission_mode"]
+        if am not in ADMISSION_MODES:
+            errs.append(f"'admission_mode' must be one of "
+                        f"{ADMISSION_MODES}, got {am!r}")
+    if "block_size" in rec:
+        v = rec["block_size"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errs.append(f"'block_size' must be an int >= 1, got {v!r}")
+    for key in ("blocks_total", "blocks_free"):
+        if key in rec:
+            v = rec[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{key!r} must be an int >= 0, got {v!r}")
+    bf, bt = rec.get("blocks_free"), rec.get("blocks_total")
+    if (isinstance(bf, int) and isinstance(bt, int)
+            and not isinstance(bf, bool) and not isinstance(bt, bool)
+            and bf > bt):
+        errs.append(f"blocks_free ({bf}) exceeds blocks_total ({bt}) "
+                    f"— free blocks are a subset of the pool")
+
+
 def _check_compile_fields(rec, errs):
     """The compilation-plane field contract (schema v10), validated
     whenever present: ``cold_compile_ms`` is a non-negative number,
@@ -568,6 +614,8 @@ def validate_bench_record(rec: Any) -> List[str]:
           and sv_rec >= 8)
     v10 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
            and sv_rec >= 10)
+    v12 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+           and sv_rec >= 12)
     if (isinstance(metric, str) and "engine_decode" in metric
             and "error" not in rec and not rec.get("stale")):
         if "window" not in rec:
@@ -595,6 +643,22 @@ def validate_bench_record(rec: Any) -> List[str]:
                 if key not in rec:
                     errs.append(f"fresh engine decode records must "
                                 f"carry {key!r} (schema v10)")
+        # v12: the paged serving plane — a decode line must say HOW
+        # its engine admits and holds KV (a paged line compared
+        # against a fixed-slot baseline unknowingly is the trend
+        # checker's blind spot), and a paged line must carry the pool
+        # geometry its fragmentation numbers are denominated in
+        if v12:
+            if "admission_mode" not in rec:
+                errs.append("fresh engine decode records must carry "
+                            "'admission_mode' (schema v12)")
+            elif rec.get("admission_mode") == "paged":
+                for key in ("block_size", "blocks_total",
+                            "blocks_free"):
+                    if key not in rec:
+                        errs.append(f"fresh paged engine decode "
+                                    f"records must carry {key!r} "
+                                    f"(schema v12)")
     # MFU / peak-memory fields (PR 8): a fresh train-step throughput
     # line is only a roofline statement given the model FLOPs behind
     # it — v3 records must say what they computed (flops_per_step,
@@ -631,6 +695,7 @@ def validate_bench_record(rec: Any) -> List[str]:
                             f"carry {key!r} (schema v10)")
     _check_kv_fields(rec, errs)
     _check_compile_fields(rec, errs)
+    _check_block_pool_fields(rec, errs)
     if "mfu" in rec and rec["mfu"] is not None and (
             not isinstance(rec["mfu"], numbers.Number)
             or isinstance(rec["mfu"], bool)):

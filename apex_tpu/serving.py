@@ -85,9 +85,10 @@ from .observability.compilation import instrumented_jit
 # jitted graphs, so the zero-host-transfer audit is unaffected.
 from .observability.tracing import maybe_event, maybe_span
 
-__all__ = ["Engine", "Seq2SeqEngine", "DONATION_BLOCKLIST",
-           "STEP_K_ARG_NAMES", "PREFILL_SLOT_ARG_NAMES",
-           "SEQ2SEQ_STEP_K_ARG_NAMES"]
+__all__ = ["Engine", "PagedEngine", "Seq2SeqEngine",
+           "DONATION_BLOCKLIST", "STEP_K_ARG_NAMES",
+           "PREFILL_SLOT_ARG_NAMES", "SEQ2SEQ_STEP_K_ARG_NAMES",
+           "PAGED_STEP_K_ARG_NAMES", "PAGED_ADMIT_ARG_NAMES"]
 
 # Argument names the engine jits must NEVER donate: per-slot length
 # vectors.  Donating `_sstep`'s cur_len made executables RELOADED from
@@ -95,8 +96,10 @@ __all__ = ["Engine", "Seq2SeqEngine", "DONATION_BLOCKLIST",
 # fine — single runs pass, the next warm run hangs; jax 0.4.37 AOT
 # quirk, PR 2).  apex_tpu.analysis's donation rule enforces this
 # blocklist over every registered serving entry point, so the gotcha
-# stays pinned even if the inline comments rot.
-DONATION_BLOCKLIST = ("cur_len", "n_new")
+# stays pinned even if the inline comments rot.  kv_len (positions
+# prefilled so far) and n_blk (blocks held) are the paged engine's
+# members of the same per-slot-length-vector class.
+DONATION_BLOCKLIST = ("cur_len", "n_new", "kv_len", "n_blk")
 
 # Positional parameter names of the jitted hot mutators, in signature
 # order — the analysis donation rule maps `Lowered.args_info` donation
@@ -105,6 +108,14 @@ STEP_K_ARG_NAMES = ("ids", "cur_len", "cache", "keys", "temps",
                     "limit", "eos")
 PREFILL_SLOT_ARG_NAMES = ("ids", "cache", "d_cache", "slot", "row")
 SEQ2SEQ_STEP_K_ARG_NAMES = ("state", "out", "n_new", "limit", "eos")
+PAGED_STEP_K_ARG_NAMES = ("ids", "cur_len", "kv_len", "pool", "keys",
+                          "temps", "limit", "eos", "tables", "n_blk",
+                          "free_stack", "free_top", "pending")
+PAGED_ADMIT_ARG_NAMES = ("ids", "cur_len", "kv_len", "limit", "eos",
+                         "keys", "temps", "tables", "n_blk",
+                         "free_stack", "free_top", "slot", "row",
+                         "plen", "lim", "eos_id", "key", "temp",
+                         "n_need")
 
 # generated tokens/sec per request spans toy CPU engines (~1/s) to
 # hardware batch decode (~10k/s)
@@ -327,6 +338,19 @@ class _SlotScheduler:
     # tag through to replicas that advertise it (stub/proxy replicas
     # without the flag keep the pre-tenant dispatch signature)
     accepts_tenant = True
+    # how this engine admits requests and holds KV: "fixed_slot" (one
+    # contiguous buf_len row per slot, admission when a slot frees) or
+    # "paged" (block-pool KV + iteration-boundary admission).  Exported
+    # on bench lines (schema v12) so trend tooling never compares a
+    # paged line against a fixed-slot baseline unknowingly.
+    admission_mode = "fixed_slot"
+
+    def _can_admit_direct(self, prompt, max_new_tokens) -> bool:
+        """Admission-control hook for :meth:`submit`: True when the
+        engine can admit THIS request right now rather than queue it.
+        The fixed-slot engines only need a free slot; the paged engine
+        also needs block headroom."""
+        return bool(self._free)
 
     def add_request(self, prompt: Sequence[int],
                     max_new_tokens: int,
@@ -365,7 +389,8 @@ class _SlotScheduler:
         requests are admitted automatically as slots free at the end
         of each ``step()`` (arrival order)."""
         self._check_request(prompt, max_new_tokens, seed, temperature)
-        if self._free and not self._waiting:
+        if not self._waiting and self._can_admit_direct(prompt,
+                                                        max_new_tokens):
             return self.add_request(prompt, max_new_tokens,
                                     eos_token_id, seed, temperature,
                                     tenant=tenant)
@@ -622,6 +647,7 @@ class _SlotScheduler:
                      if hw and hw.get("bytes_limit")
                      and hw.get("bytes_in_use") is not None else None)
         return {"live": len(self._by_slot),
+                "admission_mode": self.admission_mode,
                 "kv_cache_bytes": kv,
                 "kv_waste_bytes": frag["kv_waste_bytes"],
                 "kv_utilization": frag["kv_utilization"],
@@ -1249,6 +1275,742 @@ class Engine(_SlotScheduler):
         s["prefix_hits"] = self.prefix_hits
         s["prefix_hit_rate"] = (self.prefix_hits / s["admitted"]
                                 if s["admitted"] else 0.0)
+        return s
+
+
+class PagedEngine(_SlotScheduler):
+    """Paged-KV continuous-batching engine (ROADMAP item 1): the
+    fixed-slot ``Engine``'s admission/KV architecture replaced by a
+    BLOCK-POOL cache plus iteration-level scheduling, in the
+    PagedAttention (arXiv:2309.06180) / ORCA shape adapted to XLA's
+    static-shape world.
+
+    - KV lives in ONE pool of ``num_blocks`` fixed-size blocks per
+      cache leaf (``(num_blocks, Hkv, block_size, D)``); each slot owns
+      a per-request BLOCK TABLE — a static-shape ``(max_blocks,)``
+      int32 row of physical block ids (padded; ``n_blk`` says how many
+      are real).  A request reserves ``ceil(min(prompt+max_new,
+      buf_len) / block_size)`` blocks at admission (so an admitted
+      request can never deadlock mid-decode) and the device RECYCLES
+      them in-graph the tick it hits eos/max-tokens — not at the
+      window boundary, not at the next host sync.
+    - Prefill is CHUNKED and interleaved with decode inside the same
+      ``lax.scan`` window: an admitted slot advances ``kv_len`` by
+      ``prefill_chunk`` positions per tick (under a ``lax.cond`` so a
+      decode-only steady state never pays the chunk-width forward)
+      until it is decode-ready, while other slots keep decoding.
+    - Admission happens at the ITERATION boundary: ``step()`` stages
+      the waiting queue's head-of-line requests into a static-shape
+      ``pending`` pack, and each scan tick admits at most one of them
+      into a free slot the moment the block budget allows — a request
+      freed at tick t can hand its blocks to the next request at tick
+      t+1 of the SAME window.
+
+    Everything stays in-graph with static shapes: the gather
+    (``pool[tables]`` -> a dense per-slot view fed to the models'
+    unmodified ``decode_chunk``), the column scatter back into the
+    pool, the free-stack push/pop, and the admission writes — so the
+    zero-retrace steady-state contract holds exactly as for the fixed
+    engine (one trace per entry at warmup, delta == 0 forever after).
+    Causality makes the dense view exact: positions a slot has not
+    written (or stale junk from a previous tenant of a recycled block)
+    sit at indices > its current position and the models' causal mask
+    zeroes them out of every softmax, so when ``block_size`` divides
+    ``buf_len`` the attention computation is bit-identical to the
+    fixed-slot engine's and the token-for-token exactness contract
+    (vs ``generate_cached`` and vs ``Engine``) carries over — greedy
+    AND explicit-seed sampled (same per-request fold_in streams,
+    advanced once per own decode tick).
+
+    Donation: ``ids``, the block pool and the RNG key table are
+    donated; ``cur_len``/``kv_len``/``n_blk`` are per-slot length
+    vectors on ``DONATION_BLOCKLIST`` (the PR 2 compile-cache
+    corruption class) and the scheduler vectors (tables, free stack,
+    limits) are cheap enough that donating them buys nothing.
+
+    Not wired (use ``Engine``): speculative drafts, rolling windows,
+    prefix pools — the splice/ring relayouts are row-granular and the
+    paged pool is block-granular."""
+
+    admission_mode = "paged"
+
+    def __init__(self, model, params, slots: int, buf_len: int,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 16, cache_dtype=None,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 rng=None, window: int = 1,
+                 metrics: Optional[MetricsRegistry] = None):
+        """``block_size`` is the KV positions per block (pick it so it
+        divides ``buf_len``: the dense gather width is then exactly
+        ``buf_len`` and the attention math is bit-identical to the
+        fixed-slot engine; any size stays exact via the causal mask,
+        but a non-divisor pads the gather).  ``num_blocks`` is the pool
+        capacity (default ``slots * ceil(buf_len / block_size)`` — the
+        fixed-slot worst case; the paged win comes from setting it
+        LOWER than that and admitting more slots, since real mixed
+        traffic rarely reserves full buffers).  ``prefill_chunk`` is
+        the positions one prefill tick advances."""
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.buf_len = buf_len
+        self.temperature = temperature
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got "
+                             f"{block_size}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.block_size = int(block_size)
+        self.prefill_chunk = int(min(prefill_chunk, buf_len))
+        # static max-blocks padding: every block table is this wide
+        self.max_blocks = -(-buf_len // self.block_size)
+        self.num_blocks = (int(num_blocks) if num_blocks is not None
+                           else slots * self.max_blocks)
+        if self.num_blocks < self.max_blocks:
+            raise ValueError(
+                f"num_blocks={self.num_blocks} cannot hold even one "
+                f"full-length request ({self.max_blocks} blocks of "
+                f"{self.block_size})")
+        self._key = (rng if rng is not None
+                     else jax.random.PRNGKey(0))
+        # same dropless-MoE batch-independence requirement as Engine
+        from .parallel.expert_parallel import ExpertParallelMLP
+        for mod in model.modules():
+            if (isinstance(mod, ExpertParallelMLP)
+                    and mod.capacity_factor < mod.n_experts):
+                raise ValueError(
+                    f"MoE layer with capacity_factor="
+                    f"{mod.capacity_factor} < n_experts="
+                    f"{mod.n_experts} can drop tokens depending on "
+                    f"batch contents; serve dropless "
+                    f"(capacity_factor >= n_experts) to keep requests "
+                    f"batch-independent")
+        if cache_dtype is None:
+            cache_dtype = (model._table(params).dtype
+                           if hasattr(model, "_table")
+                           else params["wte"]["weight"].dtype)
+        # the pool: re-leaf the model's own (1, H, S, D) cache template
+        # as (num_blocks, H, block_size, D) — one tree_map, so int8
+        # scale sidecars and any future leaf page identically (every
+        # leaf's position axis is axis 2 by the models/_cache contract)
+        template = model.init_cache(1, dtype=cache_dtype)
+        NB, bs = self.num_blocks, self.block_size
+
+        def _pool_leaf(leaf):
+            if leaf.ndim != 4:
+                raise NotImplementedError(
+                    "paged KV needs (B, H, S, D)-shaped cache leaves")
+            return jnp.zeros((NB, leaf.shape[1], bs) + leaf.shape[3:],
+                             leaf.dtype)
+
+        self.pool = jax.tree_util.tree_map(_pool_leaf, template)
+        MB = self.max_blocks
+        self.ids = jnp.zeros((slots, buf_len), jnp.int32)
+        self.cur_len = jnp.zeros((slots,), jnp.int32)
+        # prompt positions whose KV is already written; a slot is
+        # decode-ready when kv_len == cur_len - 1 (the decode tick
+        # itself computes position cur_len - 1)
+        self.kv_len = jnp.zeros((slots,), jnp.int32)
+        self.limit = jnp.zeros((slots,), jnp.int32)
+        self._eos = jnp.full((slots,), -1, jnp.int32)
+        self.tables = jnp.zeros((slots, MB), jnp.int32)
+        self.n_blk = jnp.zeros((slots,), jnp.int32)
+        # LIFO free stack: free_stack[:free_top] are the free block ids
+        self.free_stack = jnp.arange(NB, dtype=jnp.int32)
+        self.free_top = jnp.int32(NB)
+        # host mirrors (refreshed from the one per-window fetch /
+        # mutated by the host-side admission paths): block headroom for
+        # admission control and per-slot holdings for the ledger
+        self._free_top_h = NB
+        self._slot_nblk_h: Dict[int, int] = {}
+        self._stream_keys_memo: Dict[int, Any] = {}
+        self._n_midwindow = 0
+        self._slot_keys = jax.vmap(
+            lambda i: jax.random.fold_in(self._key, i))(
+            jnp.arange(slots))
+        self._slot_temp = jnp.full((slots,), float(temperature),
+                                   jnp.float32)
+        self._init_scheduler(slots, metrics)
+        self.metrics.gauge(
+            "engine_kv_blocks_total",
+            help="KV pool capacity in blocks").set(float(NB))
+
+        S_d = MB * bs            # dense gather width per slot
+        C = self.prefill_chunk
+        K = self.window
+        n_slots = slots
+
+        def _gather_dense(pool, tables):
+            """pool leaves -> per-slot dense (slots, H, MB*bs, D)
+            views through the block tables (stale/padded table entries
+            gather junk that the causal mask drops)."""
+            def g(leaf):
+                d = leaf[tables]                # (slots, MB, H, bs, D)
+                d = d.transpose(0, 2, 1, 3, 4)
+                return d.reshape(n_slots, leaf.shape[1], S_d,
+                                 leaf.shape[3])
+            return jax.tree_util.tree_map(g, pool)
+
+        def _scatter_cols(pool, dense, tables, q, gate):
+            """Write the freshly computed columns ``q`` (slots, L) of
+            the dense views back into their physical blocks.  Gated:
+            lanes with ``gate`` False scatter to index num_blocks and
+            ``mode='drop'`` discards them — a freed block that was
+            already re-handed to another request must never see a
+            stale write."""
+            blk = jnp.clip(q // bs, 0, MB - 1)
+            phys = jnp.take_along_axis(tables, blk, axis=1)
+            phys = jnp.where(gate, phys, NB).reshape(-1)
+            off = (q % bs).reshape(-1)
+            qc = jnp.clip(q, 0, S_d - 1)
+
+            def s(pl, dl):
+                H, Dp = pl.shape[1], pl.shape[3]
+                idx = jnp.broadcast_to(
+                    qc[:, None, :, None],
+                    (n_slots, H, qc.shape[1], Dp))
+                cols = jnp.take_along_axis(dl, idx, axis=2)
+                vals = cols.transpose(0, 2, 1, 3).reshape(-1, H, Dp)
+                return pl.at[phys, :, off, :].set(vals, mode="drop")
+
+            return jax.tree_util.tree_map(s, pool, dense)
+
+        def _pop_blocks(free_stack, free_top, n_need):
+            """Top n_need entries of the free stack as a padded
+            (max_blocks,) table row (static shape; unpopped lanes 0)."""
+            j = jnp.arange(MB)
+            src = jnp.clip(free_top - 1 - j, 0, NB - 1)
+            return jnp.where(j < n_need, free_stack[src], 0)
+
+        def _paged_step_k(ids, cur_len, kv_len, pool, keys, temps,
+                          limit, eos, tables, n_blk, free_stack,
+                          free_top, pending):
+            """K continuous-batching ticks in-graph: each tick runs
+            admission (at most one staged request into a freed slot,
+            block budget permitting), one chunked-prefill advance for
+            every not-yet-ready slot (under a cond — decode-only
+            steady state skips it), one decode tick for every ready
+            slot, and the in-graph block recycling of slots that died
+            this tick.  Emits the (slots, K) token/validity buffers
+            plus a (K,) admitted-slot vector the host replays."""
+            p_count = pending["count"]
+
+            def tick(carry, _):
+                (ids, cur_len, kv_len, pool, keys, temps, limit, eos,
+                 tables, n_blk, free_stack, free_top, p_next) = carry
+                # -- admission at the iteration boundary --------------
+                i = jnp.clip(p_next, 0, n_slots - 1)
+                n_need = pending["n_need"][i]
+                free_slot = limit == 0
+                can = ((p_next < p_count) & jnp.any(free_slot)
+                       & (free_top >= n_need))
+                slot = jnp.argmax(free_slot).astype(jnp.int32)
+                onehot = (jnp.arange(n_slots) == slot) & can
+                trow = _pop_blocks(free_stack, free_top, n_need)
+                tables = jnp.where(onehot[:, None], trow[None, :],
+                                   tables)
+                free_top = free_top - jnp.where(can, n_need, 0)
+                ids = jnp.where(onehot[:, None],
+                                pending["ids"][i][None, :], ids)
+                cur_len = jnp.where(onehot, pending["len"][i], cur_len)
+                kv_len = jnp.where(onehot, 0, kv_len)
+                limit = jnp.where(onehot, pending["limit"][i], limit)
+                eos = jnp.where(onehot, pending["eos"][i], eos)
+                temps = jnp.where(onehot, pending["temps"][i], temps)
+                keys = jnp.where(onehot[:, None],
+                                 pending["keys"][i][None, :], keys)
+                n_blk = jnp.where(onehot, n_need, n_blk)
+                p_next = p_next + can.astype(jnp.int32)
+                adm = jnp.where(can, slot, -1)
+
+                # -- chunked prefill, interleaved with decode ---------
+                alive = cur_len < limit
+                needs_pf = alive & (kv_len < cur_len - 1)
+
+                def do_prefill(pool, kv_len):
+                    pos0 = jnp.clip(kv_len, 0, buf_len - 1)
+                    qs = pos0[:, None] + jnp.arange(C)[None, :]
+                    toks = jnp.take_along_axis(
+                        ids, jnp.clip(qs, 0, buf_len - 1), axis=1)
+                    dense = _gather_dense(pool, tables)
+                    _, dense = model.decode_chunk(params, toks, pos0,
+                                                  dense)
+                    gate = (needs_pf[:, None]
+                            & (qs < (cur_len - 1)[:, None]))
+                    pool2 = _scatter_cols(pool, dense, tables, qs,
+                                          gate)
+                    kv2 = jnp.where(
+                        needs_pf,
+                        jnp.minimum(kv_len + C, cur_len - 1), kv_len)
+                    return pool2, kv2
+
+                pool, kv_len = lax.cond(
+                    jnp.any(needs_pf), do_prefill,
+                    lambda pool, kv_len: (pool, kv_len), pool, kv_len)
+
+                # -- decode tick for every decode-ready slot ----------
+                # re-check against the POST-prefill kv_len: a slot
+                # whose last prefill chunk landed this tick decodes in
+                # the same tick (the gather below re-reads the freshly
+                # scattered pool), so prefill->decode costs no bubble
+                dec_ok = alive & (kv_len >= cur_len - 1)
+                pos = jnp.maximum(cur_len - 1, 0)
+                tok_in = jnp.take_along_axis(
+                    ids, jnp.clip(pos, 0, buf_len - 1)[:, None],
+                    axis=1)
+                dense = _gather_dense(pool, tables)
+                h, dense = model.decode_chunk(params, tok_in, pos,
+                                              dense)
+                pool = _scatter_cols(pool, dense, tables, pos[:, None],
+                                     dec_ok[:, None])
+                logits = _head_logits(model, params, h)[:, 0]
+                if temperature > 0.0:
+                    from .models import sampling as smp
+                    # identical stream discipline to Engine._step_k:
+                    # per-request keys advance once per OWN decode tick
+                    # (not while prefilling, not after death), so the
+                    # sampled output is batch-independent and equal to
+                    # the fixed-slot engine's token for token
+                    split = jax.vmap(
+                        lambda k: jax.random.split(k, 2))(keys)
+                    new_keys, subs = split[:, 0], split[:, 1]
+                    safe_t = jnp.where(temps > 0, temps, 1.0)
+                    scaled = (logits.astype(jnp.float32)
+                              / safe_t[:, None])
+                    sampled = jax.vmap(
+                        lambda k, l: smp.sample_token(
+                            k, l, 1.0, top_k=top_k,
+                            top_p=top_p))(subs,
+                                          scaled).astype(jnp.int32)
+                    greedy = jnp.argmax(logits,
+                                        axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(temps > 0, sampled, greedy)
+                    keys = jnp.where(dec_ok[:, None], new_keys, keys)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # alive => cur_len < limit <= buf_len, so the write is
+                # never out of row (unlike Engine there is no separate
+                # can mask — limit already caps at buf_len)
+                ids = jax.vmap(
+                    lambda row, p, t, c: row.at[p].set(
+                        jnp.where(c, t, row[p])))(
+                    ids, jnp.minimum(cur_len, buf_len - 1), nxt,
+                    dec_ok)
+                new_len = jnp.where(dec_ok, cur_len + 1, cur_len)
+                # the decode scatter just wrote KV at cur_len-1, so
+                # the coverage counter advances with it — without this
+                # the next tick would re-"prefill" an already-written
+                # position and decode only every other tick
+                kv_len = jnp.where(dec_ok, cur_len, kv_len)
+
+                # -- in-graph block recycling on eos/limit ------------
+                hit_eos = dec_ok & (eos >= 0) & (nxt == eos)
+                died = dec_ok & (hit_eos | (new_len >= limit))
+                freed = jnp.where(died, n_blk, 0)
+                offs = jnp.cumsum(freed) - freed     # exclusive scan
+                jj = jnp.arange(MB)[None, :]
+                push = died[:, None] & (jj < n_blk[:, None])
+                dest = jnp.where(push,
+                                 free_top + offs[:, None] + jj, NB)
+                free_stack = free_stack.at[dest.reshape(-1)].set(
+                    tables.reshape(-1), mode="drop")
+                free_top = free_top + jnp.sum(freed)
+                limit = jnp.where(died, 0, limit)
+                n_blk = jnp.where(died, 0, n_blk)
+
+                return ((ids, new_len, kv_len, pool, keys, temps,
+                         limit, eos, tables, n_blk, free_stack,
+                         free_top, p_next),
+                        (nxt, dec_ok, adm))
+
+            carry = (ids, cur_len, kv_len, pool, keys, temps, limit,
+                     eos, tables, n_blk, free_stack, free_top,
+                     jnp.int32(0))
+            carry, (toks, valid, adm) = lax.scan(tick, carry, None,
+                                                 length=K)
+            (ids, cur_len, kv_len, pool, keys, temps, limit, eos,
+             tables, n_blk, free_stack, free_top, _) = carry
+            return (ids, cur_len, kv_len, pool, keys, temps, limit,
+                    eos, tables, n_blk, free_stack, free_top,
+                    toks.T, valid.T, adm)
+
+        # donate ids + the pool + the key table; cur_len/kv_len/n_blk
+        # are DONATION_BLOCKLIST length vectors (PR 2 compile-cache
+        # corruption class) and the rest is read-mostly scheduler state
+        self._paged_step_k = instrumented_jit(
+            _paged_step_k, "engine._paged_step_k",
+            arg_names=PAGED_STEP_K_ARG_NAMES, donate_argnums=(0, 3, 4))
+
+        def _paged_admit(ids, cur_len, kv_len, limit, eos, keys, temps,
+                         tables, n_blk, free_stack, free_top, slot,
+                         row, plen, lim, eos_id, key, temp, n_need):
+            """Window-boundary admission: reserve blocks off the free
+            stack and seed the slot's scheduler row.  No prefill here
+            — the prompt's KV is written lazily by the chunked-prefill
+            ticks inside the next window (that is what lets admission
+            cost O(scheduler row) instead of O(full forward))."""
+            trow = _pop_blocks(free_stack, free_top, n_need)
+            tables = lax.dynamic_update_index_in_dim(tables, trow,
+                                                     slot, axis=0)
+            free_top = free_top - n_need
+            ids = lax.dynamic_update_index_in_dim(ids, row, slot,
+                                                  axis=0)
+            cur_len = cur_len.at[slot].set(plen)
+            kv_len = kv_len.at[slot].set(0)
+            limit = limit.at[slot].set(lim)
+            eos = eos.at[slot].set(eos_id)
+            keys = keys.at[slot].set(key)
+            temps = temps.at[slot].set(temp)
+            n_blk = n_blk.at[slot].set(n_need)
+            return (ids, cur_len, kv_len, limit, eos, keys, temps,
+                    tables, n_blk, free_top)
+
+        self._paged_admit = instrumented_jit(
+            _paged_admit, "engine._paged_admit",
+            arg_names=PAGED_ADMIT_ARG_NAMES, donate_argnums=(0, 5))
+        self._set_kv_gauges()
+
+    # -- admission ---------------------------------------------------------
+    def _blocks_for(self, prompt, max_new_tokens) -> int:
+        """Blocks a request reserves at admission: its FULL budget
+        up front (positions through min(prompt+max_new, buf_len)), so
+        an admitted request can always run to completion — admission
+        control is the only backpressure point, and the engine can
+        never deadlock with every slot mid-request and no block to
+        grow into."""
+        need = min(len(prompt) + max_new_tokens, self.buf_len)
+        return -(-need // self.block_size)
+
+    def _stream_key(self, rid, seed):
+        """The per-request sampling key — same domain-separated
+        fold_in chain as Engine (exactness contract).  Memoized per
+        rid so staging the same waiting request across several windows
+        hands the device bit-identical key bytes."""
+        k = self._stream_keys_memo.get(rid)
+        if k is None:
+            base = jax.random.fold_in(self._key,
+                                      0 if seed is None else 1)
+            k = jax.random.fold_in(base, rid if seed is None else seed)
+            self._stream_keys_memo[rid] = k
+        return k
+
+    @property
+    def _supports_seed(self):
+        return self.temperature > 0.0
+
+    @property
+    def _supports_temperature(self):
+        return self.temperature > 0.0
+
+    def _check_prompt(self, prompt):
+        if len(prompt) < 1 or len(prompt) >= self.buf_len:
+            raise ValueError(f"prompt length {len(prompt)} not in "
+                             f"[1, {self.buf_len})")
+
+    def _can_admit_direct(self, prompt, max_new_tokens) -> bool:
+        return (bool(self._free) and self._free_top_h
+                >= self._blocks_for(prompt, max_new_tokens))
+
+    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
+                    seed=None, temperature=None, tenant=None):
+        if self._free and self._free_top_h < self._blocks_for(
+                prompt, max_new_tokens):
+            raise RuntimeError(
+                f"no free KV blocks for this request (needs "
+                f"{self._blocks_for(prompt, max_new_tokens)}, "
+                f"{self._free_top_h} free); use submit() to queue "
+                f"until blocks recycle, or grow num_blocks")
+        return super().add_request(prompt, max_new_tokens,
+                                   eos_token_id, seed, temperature,
+                                   tenant=tenant)
+
+    def _admit(self, rid, prompt, max_new_tokens, eos_token_id,
+               seed=None, temperature=None):
+        n_need = self._blocks_for(prompt, max_new_tokens)
+        if self._free_top_h < n_need:
+            raise RuntimeError(
+                f"no free KV blocks (need {n_need}, have "
+                f"{self._free_top_h}); use submit() to queue until "
+                f"blocks recycle")
+        slot = self._free.pop()
+        row = np.zeros((self.buf_len,), np.int32)
+        row[:len(prompt)] = prompt
+        lim = min(len(prompt) + max_new_tokens, self.buf_len)
+        key = self._stream_key(rid, seed)
+        self._stream_keys_memo.pop(rid, None)
+        (self.ids, self.cur_len, self.kv_len, self.limit, self._eos,
+         self._slot_keys, self._slot_temp, self.tables, self.n_blk,
+         self.free_top) = self._paged_admit(
+            self.ids, self.cur_len, self.kv_len, self.limit,
+            self._eos, self._slot_keys, self._slot_temp, self.tables,
+            self.n_blk, self.free_stack, self.free_top,
+            jnp.int32(slot), jnp.asarray(row), jnp.int32(len(prompt)),
+            jnp.int32(lim),
+            jnp.int32(-1 if eos_token_id is None else eos_token_id),
+            key,
+            jnp.float32(self.temperature if temperature is None
+                        else temperature),
+            jnp.int32(n_need))
+        self._free_top_h -= n_need
+        self._slot_nblk_h[slot] = n_need
+        self._by_slot[slot] = _Request(rid, slot, len(prompt),
+                                       max_new_tokens, eos_token_id)
+
+    def _drain_queue(self):
+        # FIFO head-of-line semantics (no reordering — a small request
+        # must not starve a big one forever): stop at the first queued
+        # request that does not fit the current slot/block headroom
+        admitted = False
+        while (self._free and self._waiting
+               and self._free_top_h >= self._blocks_for(
+                   self._waiting[0][1], self._waiting[0][2])):
+            self._admit_timed(*self._waiting.pop(0), refresh_kv=False)
+            admitted = True
+        self._set_queue_gauge()
+        if admitted:
+            self._set_kv_gauges()
+
+    # -- the window --------------------------------------------------------
+    def _stage_pending(self):
+        """Static-shape pack of the waiting queue's first ``slots``
+        requests for in-window admission.  Items STAY in ``_waiting``
+        until the device confirms their admission (the ``adm`` replay)
+        — so ``take_waiting`` / failover / cancel keep their exact
+        semantics for requests the device has not started."""
+        wait_rids = {item[0] for item in self._waiting}
+        self._stream_keys_memo = {
+            r: k for r, k in self._stream_keys_memo.items()
+            if r in wait_rids}
+        P = self.slots
+        n = min(len(self._waiting), P)
+        ids = np.zeros((P, self.buf_len), np.int32)
+        lens = np.zeros((P,), np.int32)
+        lims = np.zeros((P,), np.int32)
+        eoss = np.full((P,), -1, np.int32)
+        temps = np.zeros((P,), np.float32)
+        needs = np.zeros((P,), np.int32)
+        keys = jnp.zeros((P, 2), jnp.uint32)
+        for i in range(n):
+            (rid, prompt, max_new, eos_id, seed,
+             temp) = self._waiting[i]
+            ids[i, :len(prompt)] = prompt
+            lens[i] = len(prompt)
+            lims[i] = min(len(prompt) + max_new, self.buf_len)
+            eoss[i] = -1 if eos_id is None else int(eos_id)
+            temps[i] = float(self.temperature if temp is None
+                             else temp)
+            needs[i] = self._blocks_for(prompt, max_new)
+            keys = keys.at[i].set(self._stream_key(rid, seed))
+        return {"count": jnp.int32(n), "ids": jnp.asarray(ids),
+                "len": jnp.asarray(lens), "limit": jnp.asarray(lims),
+                "eos": jnp.asarray(eoss), "temps": jnp.asarray(temps),
+                "keys": keys, "n_need": jnp.asarray(needs)}
+
+    def step(self) -> Dict[int, Any]:
+        """One decode window: stage the queue head, run the K
+        continuous-batching ticks, fetch tokens + validity + the
+        admission trace in ONE host sync, then replay the device's
+        tick-by-tick decisions into the host bookkeeping."""
+        if not self._by_slot and not self._waiting:
+            return {}
+        t0 = self._clock()
+        live0 = len(self._by_slot)
+        pending = self._stage_pending()
+        with maybe_span("engine_window_decode", window=self.window,
+                        live=live0):
+            (self.ids, self.cur_len, self.kv_len, self.pool,
+             self._slot_keys, self._slot_temp, self.limit, self._eos,
+             self.tables, self.n_blk, self.free_stack, self.free_top,
+             toks, valid, adm) = self._paged_step_k(
+                self.ids, self.cur_len, self.kv_len, self.pool,
+                self._slot_keys, self._slot_temp, self.limit,
+                self._eos, self.tables, self.n_blk, self.free_stack,
+                self.free_top, pending)
+            # THE host sync: tokens, validity, in-window admissions
+            # and the block headroom, fetched once per window
+            toks_h, valid_h, adm_h, ft_h = jax.device_get(
+                (toks, valid, adm, self.free_top))
+        self._free_top_h = int(ft_h)
+        return self._harvest_paged(toks_h, valid_h, adm_h, t0, live0)
+
+    def _harvest_paged(self, toks_h, valid_h, adm_h, t0, live0):
+        """Replay the window's device decisions in tick order: an
+        admission at tick t binds the queue head to its slot BEFORE
+        that slot's later tokens are harvested, and a death at tick t
+        frees the slot before a tick-t' > t admission reuses it — the
+        same order the scan applied on device."""
+        n_tok = int(valid_h.sum())
+        now = self._record_step(t0, tokens=n_tok,
+                                capacity=max(live0, 1) * self.window)
+        out: Dict[int, Any] = {}
+        for t in range(self.window):
+            s = int(adm_h[t])
+            if s >= 0:
+                (rid, prompt, max_new, eos_id, seed,
+                 temp) = self._waiting.pop(0)
+                req = _Request(rid, s, len(prompt), max_new, eos_id)
+                req.t_submit = self._submit_ts.pop(rid, None)
+                req.t_admit = now
+                if req.t_submit is not None:
+                    self._m_queue_wait.observe(
+                        max(now - req.t_submit, 0.0))
+                self._by_slot[s] = req
+                if s in self._free:
+                    self._free.remove(s)
+                self._slot_nblk_h[s] = self._blocks_for(prompt,
+                                                        max_new)
+                self._stream_keys_memo.pop(rid, None)
+                self._m_admitted.inc()
+                self._n_admitted += 1
+                self._n_midwindow += 1
+                self.metrics.counter(
+                    "engine_midwindow_admissions_total",
+                    help="requests admitted INSIDE a decode window at "
+                         "an iteration boundary (blocks freed by a "
+                         "death earlier in the same window, reused "
+                         "before it ends)").inc()
+                self._set_queue_gauge()
+            for s2 in range(self.slots):
+                if not valid_h[s2][t]:
+                    continue
+                req = self._by_slot.get(s2)
+                if req is None:
+                    continue
+                tok = int(toks_h[s2][t])
+                req.generated.append(tok)
+                out.setdefault(req.rid, []).append(tok)
+                if req.t_first is None:
+                    req.t_first = now
+                self._m_tokens.inc()
+                self._n_tokens += 1
+                hit = req.eos is not None and tok == req.eos
+                if hit or self._out_of_budget(req):
+                    # the device already recycled this request's
+                    # blocks IN-GRAPH the tick it died; the host only
+                    # mirrors the bookkeeping (no _freeze_slot — limit
+                    # is zeroed on device too)
+                    self._slot_nblk_h.pop(s2, None)
+                    self._finish(s2, req)
+        self._drain_queue()
+        self._set_kv_gauges()
+        return out
+
+    def _out_of_budget(self, req):
+        return (len(req.generated) >= req.max_new
+                or req.prompt_len + len(req.generated) >= self.buf_len)
+
+    def _freeze_slot(self, slot):
+        """cancel() of a LIVE request: the device never saw it die, so
+        the host releases its blocks eagerly (plain device ops, not a
+        jitted entry — cancel is a rare between-windows host API and
+        eager ops never touch the compilation ledger)."""
+        n = self._slot_nblk_h.pop(slot, 0)
+        if n:
+            j = jnp.arange(self.max_blocks)
+            dest = jnp.where(j < n, self.free_top + j,
+                             self.num_blocks)
+            self.free_stack = self.free_stack.at[dest].set(
+                self.tables[slot], mode="drop")
+            self.free_top = self.free_top + jnp.int32(n)
+            self._free_top_h += n
+        self.limit = self.limit.at[slot].set(0)
+        self.n_blk = self.n_blk.at[slot].set(0)
+
+    # -- observability -----------------------------------------------------
+    def _kv_buffers(self):
+        return [self.pool]
+
+    def _kv_usage(self):
+        """PER-BLOCK accounting: a live request's waste is only the
+        unfilled tail of its LAST reserved block-set (held blocks *
+        block_size minus the positions its cur_len twin occupies);
+        unreserved pool blocks surface as one free-pool entry.  This
+        is the ledger line the ISSUE gates on: versus the fixed-slot
+        engine's whole-row reservations, `kv_waste_bytes` collapses to
+        sub-block granularity on mixed-length traffic."""
+        pool_bytes = _tree_nbytes(self.pool)
+        per_block = (pool_bytes / self.num_blocks
+                     if self.num_blocks else 0.0)
+        per_pos = per_block / self.block_size
+        slots = []
+        for slot in range(self.slots):
+            req = self._by_slot.get(slot)
+            held = (self._slot_nblk_h.get(slot, 0)
+                    if req is not None else 0)
+            used_pos = (min(req.prompt_len + len(req.generated),
+                            held * self.block_size)
+                        if req is not None else 0)
+            used_b = int(round(per_pos * used_pos))
+            held_b = int(round(per_block * held))
+            slots.append({"slot": slot,
+                          "rid": req.rid if req is not None else None,
+                          "blocks_held": held,
+                          "used_positions": used_pos,
+                          "capacity_positions": held * self.block_size,
+                          "used_bytes": used_b,
+                          "kv_waste_bytes": held_b - used_b})
+        free_blocks = max(self.num_blocks
+                          - sum(self._slot_nblk_h.values()), 0)
+        pools = [{"row": "free_blocks", "blocks": free_blocks,
+                  "used_positions": 0,
+                  "capacity_positions": free_blocks * self.block_size,
+                  "used_bytes": 0,
+                  "kv_waste_bytes": int(round(per_block
+                                              * free_blocks))}]
+        return slots, pools
+
+    def _set_kv_gauges(self):
+        frag = super()._set_kv_gauges()
+        self.metrics.gauge(
+            "engine_kv_blocks_free",
+            help="KV pool blocks not reserved by any live request "
+                 "(admission headroom)").set(float(self._free_top_h))
+        return frag
+
+    def compile_census(self) -> Dict[str, str]:
+        # ONE decode-window graph covers chunked prefill, decode, the
+        # in-window admission and the block recycling (they are cond
+        # branches / masked lanes of the same scan, all traced at the
+        # first call), plus the window-boundary admission entry
+        return {"engine._paged_admit": "admission",
+                "engine._paged_step_k": "decode"}
+
+    def warmup(self):
+        """Pre-compile the full paged census before traffic: one
+        request whose prompt spans a chunk boundary (so the
+        chunked-prefill + decode + recycling paths of the scan trace)
+        plus a second 1-token request (exercising admission again —
+        same graphs, and on a 1-slot engine it rides the in-window
+        admission path).  Both are scrubbed from ``result()``; see
+        ``Engine.warmup`` for the rid/stream caveats."""
+        if self._by_slot or self._waiting:
+            raise RuntimeError(
+                "warmup() needs an idle engine (no live or queued "
+                "requests); warm before traffic")
+        plen = max(1, min(self.prefill_chunk + 1, self.buf_len - 1))
+        r1 = self.add_request([0] * plen, max_new_tokens=1)
+        r2 = self.submit([0], max_new_tokens=1)
+        while not (self.is_finished(r1) and self.is_finished(r2)):
+            self.step()
+        self._finished.pop(r1, None)
+        self._finished.pop(r2, None)
+        return self
+
+    def stats(self) -> Dict[str, Any]:
+        """Base snapshot plus the block-pool fields the v12 bench
+        schema exports: pool geometry, live headroom, and how many
+        admissions happened INSIDE a window (the continuous-batching
+        win made visible)."""
+        s = super().stats()
+        s["block_size"] = self.block_size
+        s["blocks_total"] = self.num_blocks
+        s["blocks_free"] = self._free_top_h
+        s["max_blocks_per_request"] = self.max_blocks
+        s["midwindow_admissions"] = self._n_midwindow
         return s
 
 

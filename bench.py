@@ -518,6 +518,142 @@ def main():
                   f"double-counted tokens; {t_note}")
         emit(**rec_t)
 
+        # paged-vs-fixed open-loop mixed-length leg (schema v12, the
+        # ROADMAP item 1 gate): SAME KV pool bytes on both sides —
+        # fixed reserves `slots` whole buf_len rows, paged carves the
+        # identical byte pool into blocks and admits 2x the slots —
+        # under an open-loop mixed-length arrival stream (lengths the
+        # scheduler cannot pick, arrivals it cannot defer), every
+        # request deadlined through fleet/slo.py.  The paged engine
+        # must win on goodput_tokens_per_s with p99 deadline
+        # attainment no worse and TIME-AVERAGED kv_waste_bytes lower;
+        # check_bench_trend gates all three on accelerators.
+        mixed_n = 48
+        deadline_mx = 300.0
+        mx_window = 4
+
+        def _mixed_reqs(seed):
+            r = np.random.RandomState(seed)
+            out = []
+            for _ in range(mixed_n):
+                plen = int(r.randint(2, cfg.block_size - 4))
+                nnew = int(r.randint(2, min(17, cfg.block_size - plen
+                                            + 1)))
+                out.append((list(r.randint(0, cfg.vocab_size, plen)),
+                            nnew))
+            return out
+
+        def _mixed_leg(make_engine):
+            traces0 = ledger.total_traces()
+            wall0 = ledger.compile_wall_s()
+            eng = make_engine()
+            fl = Fleet([eng], max_queue=4 * mixed_n,
+                       retry=RetryPolicy(max_attempts=10),
+                       step_workers=1)
+            fl.warmup()
+            cold_ms = (ledger.compile_wall_s() - wall0) * 1e3
+            compiles = ledger.total_traces() - traces0
+            reqs = _mixed_reqs(7)
+            # settle pass (host caches), then the timed open loop
+            for p, nn in reqs[:8]:
+                fl.submit(p, max_new_tokens=nn)
+            while fl.live():
+                fl.step()
+            traces_ss = ledger.total_traces()
+            waste_samples = []
+            sent = 0
+            t0 = time.perf_counter()
+            while fl.live() or sent < len(reqs):
+                # open loop: 2 arrivals per step regardless of
+                # completions — mixed lengths hit mid-stream
+                for _ in range(2):
+                    if sent < len(reqs):
+                        p, nn = reqs[sent]
+                        fl.submit(p, max_new_tokens=nn,
+                                  deadline=deadline_mx, tenant="mixed")
+                        sent += 1
+                fl.step()
+                waste_samples.append(eng.kv_waste_bytes())
+            dt = time.perf_counter() - t0
+            rec = fl.record()
+            st = eng.stats()
+            fl.close()
+            mean_waste = int(sum(waste_samples)
+                             / max(len(waste_samples), 1))
+            return {"goodput": rec["goodput_tokens_per_s"],
+                    "attainment": rec["slo_attainment"],
+                    "mean_waste": mean_waste, "stats": st,
+                    "cold_ms": cold_ms, "compiles": compiles,
+                    "retraces": ledger.total_traces() - traces_ss,
+                    "dt": dt}
+
+        # fixed: 4 slots x 32 positions = 128 pooled KV positions;
+        # paged: the SAME 128 positions as 16 blocks of 8, spread over
+        # 8 slots — concurrency doubles at identical KV bytes
+        fixed_mx = _mixed_leg(
+            lambda: serving.Engine(model, params, slots=slots,
+                                   buf_len=cfg.block_size,
+                                   window=mx_window))
+        paged_mx = _mixed_leg(
+            lambda: serving.PagedEngine(
+                model, params, slots=2 * slots,
+                buf_len=cfg.block_size,
+                block_size=cfg.block_size // 4,
+                num_blocks=slots * 4, prefill_chunk=8,
+                window=mx_window))
+        assert (fixed_mx["stats"]["kv_cache_bytes"]
+                == paged_mx["stats"]["kv_cache_bytes"]), \
+            "paged-vs-fixed leg must compare EQUAL KV pool bytes"
+        mx_note = (f"open-loop mixed-length leg: {mixed_n} deadlined "
+                   f"requests (prompt 2..{cfg.block_size - 5}, "
+                   f"2..16 new), 2 arrivals/step, window={mx_window}, "
+                   f"EQUAL KV bytes both sides "
+                   f"({fixed_mx['stats']['kv_cache_bytes']}B); "
+                   f"deadline {deadline_mx:.0f}s trends the SLO "
+                   f"accounting, not CPU latency; kv_waste_bytes is "
+                   f"the TIME-AVERAGED ledger sample over the loop")
+        emit(metric="gpt_tiny_engine_decode_fixed_mixed_goodput",
+             value=_round(fixed_mx["goodput"], 1), unit="tokens/sec",
+             vs_baseline=None, window=mx_window,
+             admission_mode="fixed_slot",
+             slo_attainment=_round(fixed_mx["attainment"]),
+             kv_cache_bytes=fixed_mx["stats"]["kv_cache_bytes"],
+             kv_waste_bytes=fixed_mx["mean_waste"],
+             kv_utilization=round(
+                 1.0 - fixed_mx["mean_waste"]
+                 / max(fixed_mx["stats"]["kv_cache_bytes"], 1), 4),
+             cold_compile_ms=round(fixed_mx["cold_ms"], 2),
+             compiles_total=fixed_mx["compiles"],
+             steady_state_retraces=fixed_mx["retraces"],
+             note=f"fixed-slot baseline, {slots} slots x "
+                  f"{cfg.block_size}-row reservations; {mx_note}")
+        pst = paged_mx["stats"]
+        emit(metric="gpt_tiny_engine_decode_paged_mixed_goodput",
+             value=_round(paged_mx["goodput"], 1), unit="tokens/sec",
+             vs_baseline=(None if not fixed_mx["goodput"] else
+                          round(paged_mx["goodput"]
+                                / fixed_mx["goodput"], 3)),
+             window=mx_window, admission_mode="paged",
+             block_size=pst["block_size"],
+             blocks_total=pst["blocks_total"],
+             blocks_free=pst["blocks_free"],
+             midwindow_admissions=pst["midwindow_admissions"],
+             slo_attainment=_round(paged_mx["attainment"]),
+             kv_cache_bytes=pst["kv_cache_bytes"],
+             kv_waste_bytes=paged_mx["mean_waste"],
+             kv_utilization=round(
+                 1.0 - paged_mx["mean_waste"]
+                 / max(pst["kv_cache_bytes"], 1), 4),
+             cold_compile_ms=round(paged_mx["cold_ms"], 2),
+             compiles_total=paged_mx["compiles"],
+             steady_state_retraces=paged_mx["retraces"],
+             note=f"paged block pool, {2 * slots} slots over "
+                  f"{pst['blocks_total']} blocks of "
+                  f"{pst['block_size']} (same bytes as the fixed "
+                  f"side's {slots} rows), blocks recycled in-graph at "
+                  f"eos + iteration-boundary admission; vs_baseline "
+                  f"is paged/fixed goodput; {mx_note}")
+
     lint_errors = 0
     if "--graph-lint" in sys.argv:
         # prepend static graph-lint findings to the telemetry stream
@@ -1861,14 +1997,17 @@ def main():
              unit="sequences/sec/chip", vs_baseline=None, **cost_fields)
 
     def engine_config(metric, cfg, slots, prompt, new_tokens,
-                      model_cls=None, rolling=False, window=1):
+                      model_cls=None, rolling=False, window=1,
+                      paged=False, block_size=8, num_blocks=None):
         """Continuous-batching engine throughput: keep every slot busy
         (re-admit a fresh request the moment one finishes) and measure
         steady-state generated TOKENS (not step() calls — a windowed
         step emits up to ``window`` per slot) per second.  ``window=1``
         pays the per-token host sync; ``window=K`` fetches once per K
         in-graph ticks, so the w1-vs-wK line pair is the decode-window
-        speedup measured on the same shapes."""
+        speedup measured on the same shapes.  ``paged=True`` serves
+        the same shapes through the PagedEngine's block pool instead
+        of fixed rows (admission_mode says which on every line)."""
         from apex_tpu import serving
         from apex_tpu.observability import compilation as obscomp
         model = (model_cls or models.GPT)(cfg)
@@ -1885,16 +2024,28 @@ def main():
         # includes a recompile
         ledger = obscomp.get_ledger()
         traces0, wall0 = ledger.total_traces(), ledger.compile_wall_s()
-        eng = serving.Engine(model, params, slots=slots, buf_len=ctx,
-                             rolling=rolling, window=window)
+        if paged:
+            eng = serving.PagedEngine(model, params, slots=slots,
+                                      buf_len=ctx,
+                                      block_size=block_size,
+                                      num_blocks=num_blocks,
+                                      window=window)
+        else:
+            eng = serving.Engine(model, params, slots=slots,
+                                 buf_len=ctx, rolling=rolling,
+                                 window=window)
         rng = np.random.RandomState(0)
 
         def admit():
-            eng.add_request(list(rng.randint(0, cfg.vocab_size, prompt)),
-                            max_new_tokens=new_tokens)
+            p = list(rng.randint(0, cfg.vocab_size, prompt))
+            if not eng._can_admit_direct(p, new_tokens):
+                return False        # paged pool out of block headroom
+            eng.add_request(p, max_new_tokens=new_tokens)
+            return True
 
         for _ in range(slots):
-            admit()
+            if not admit():
+                break
         for _ in range(5):                      # warmup + compile
             eng.step()
         compiles = ledger.total_traces() - traces0
@@ -1906,11 +2057,18 @@ def main():
         for _ in range(steps):
             produced += sum(len(t) for t in eng.step().values())
             while eng._free:
-                admit()
+                if not admit():
+                    break
         dt = time.perf_counter() - t0
         s = eng.stats()
+        block_kw = ({"block_size": s["block_size"],
+                     "blocks_total": s["blocks_total"],
+                     "blocks_free": s["blocks_free"],
+                     "midwindow_admissions": s["midwindow_admissions"]}
+                    if paged else {})
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
+             admission_mode=s["admission_mode"],
              kv_cache_bytes=s["kv_cache_bytes"],
              kv_waste_bytes=s["kv_waste_bytes"],
              kv_utilization=round(s["kv_utilization"], 4),
@@ -1918,10 +2076,13 @@ def main():
              cold_compile_ms=round(cold_ms, 2),
              compiles_total=compiles,
              steady_state_retraces=ledger.total_traces() - traces_ss,
+             **block_kw,
              note=f"continuous batching, {slots} slots, decode window="
                   f"{window} (host syncs 1/{window} per token), prompt="
                   f"{prompt}, {new_tokens} new/request, slot re-admit "
                   f"on finish"
+                  + (f", paged pool {s['blocks_total']} blocks x "
+                     f"{s['block_size']} positions" if paged else "")
                   + (f", O(window) ring cache W="
                      f"{getattr(cfg, 'sliding_window', None)}"
                      if rolling else ""))
@@ -1969,6 +2130,7 @@ def main():
         s = eng.stats()
         emit(metric=metric, value=round(produced / dt, 1),
              unit="tokens/sec/chip", vs_baseline=None, window=window,
+             admission_mode=s["admission_mode"],
              kv_cache_bytes=s["kv_cache_bytes"],
              kv_waste_bytes=s["kv_waste_bytes"],
              kv_utilization=round(s["kv_utilization"], 4),
@@ -2208,6 +2370,16 @@ def main():
                                   vocab_size=50257, block_size=512,
                                   dropout=0.0),
                  8, 64, 64, window=8)),
+            # paged twin of the w8 line: same shapes through the
+            # block-pool allocator — the fixed/paged pair on hardware
+            # is the fragmentation win at production sizes
+            ("gpt2_small_engine_decode_paged_w8_throughput",
+             lambda: engine_config(
+                 "gpt2_small_engine_decode_paged_w8_throughput",
+                 models.GPTConfig(n_layer=12, n_head=12, n_embd=768,
+                                  vocab_size=50257, block_size=512,
+                                  dropout=0.0),
+                 8, 64, 64, window=8, paged=True, block_size=64)),
             ("t5_small_seq2seq_engine_decode_throughput",
              lambda: seq2seq_engine_config(
                  "t5_small_seq2seq_engine_decode_throughput",
@@ -2322,6 +2494,15 @@ def main():
                                   n_layer=2, n_head=4, n_embd=32,
                                   dropout=0.0),
                  2, 4, 8, window=8)),
+            # paged twin of the w8 line: block-pool allocator on the
+            # same shapes, smoke-sized (fixed/paged fragmentation pair)
+            ("gpt_tiny_engine_decode_paged_w8_throughput",
+             lambda: engine_config(
+                 "gpt_tiny_engine_decode_paged_w8_throughput",
+                 models.GPTConfig(vocab_size=128, block_size=16,
+                                  n_layer=2, n_head=4, n_embd=32,
+                                  dropout=0.0),
+                 2, 4, 8, window=8, paged=True, block_size=4)),
             ("t5_tiny_seq2seq_engine_decode_throughput",
              lambda: seq2seq_engine_config(
                  "t5_tiny_seq2seq_engine_decode_throughput",

@@ -75,6 +75,23 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    deterministic accounting bug that gates on every backend (the
    steady-state-retrace rule, not the MFU rule).
 
+7. **KV-plane regression** (schema v12 block-pool fields).  Fresh
+   engine lines carry the PR 13 fragmentation ledger
+   (``kv_waste_bytes``), and the paged allocator exists to drive it
+   DOWN — so waste trends as a lower-is-better column per
+   (metric, backend): growth past ``--tol`` errors on accelerator
+   backends and warns on CPU smoke (the sampled waste depends on
+   where in the admit/finish cycle the snapshot lands, which is
+   timing on a noisy host).  A zero baseline is the success state —
+   waste returning from 0 to measurably nonzero gates like comm
+   coming back onto the critical path.  Separately, the v12 FIELD
+   contract is deterministic and gates on every backend: a fresh
+   ``engine_decode`` line whose round declares ``schema_version``
+   >= 12 must carry ``admission_mode``, and a paged line must carry
+   ``block_size``/``blocks_total``/``blocks_free`` — archived rounds
+   that declare an older version are exempt (they were valid when
+   written).
+
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
@@ -235,6 +252,9 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # (metric, backend) -> (round_name, slo_attainment) of the
     # per-tenant attainment trend (schema v11)
     last_attain = {}
+    # (metric, backend) -> (round_name, kv_waste_bytes) of the
+    # KV-plane trend (schema v12)
+    last_waste = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
 
@@ -452,6 +472,84 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             else:
                 errors.append(msg)
 
+    def track_kv_fields(rname, rec):
+        """KV-plane gates for one fresh metric line (schema v12).
+        Two halves: the ``kv_waste_bytes`` trend (lower is better —
+        the paged allocator's whole purpose; growth past ``--tol``
+        follows the accelerator-gates / CPU-warns policy because the
+        sampled waste depends on where in the admit/finish cycle the
+        snapshot lands) and the block-pool FIELD contract, which is
+        deterministic: a fresh engine_decode line in a round that
+        declares schema_version >= 12 without ``admission_mode`` —
+        or a paged line without its block fields — gates on every
+        backend (archived rounds declaring an older version are
+        exempt; they were valid when written)."""
+        subject = rec.get("metric")
+        if not isinstance(subject, str) or not subject:
+            return
+        sv = rec.get("schema_version")
+        declared_v12 = isinstance(sv, int) and not isinstance(sv, bool) \
+            and sv >= 12
+        if declared_v12 and "engine_decode" in subject:
+            mode = rec.get("admission_mode")
+            if mode is None:
+                errors.append(
+                    f"{rname}: {subject} "
+                    f"[{rec.get('backend') or '?'}] declares schema "
+                    f"v{sv} but carries no admission_mode — every "
+                    f"fresh v12 engine line must say which allocator "
+                    f"(fixed_slot | paged) produced it")
+            elif mode == "paged":
+                missing = [f for f in ("block_size", "blocks_total",
+                                       "blocks_free")
+                           if not isinstance(rec.get(f), int)
+                           or isinstance(rec.get(f), bool)]
+                if missing:
+                    errors.append(
+                        f"{rname}: {subject} "
+                        f"[{rec.get('backend') or '?'}] is a paged "
+                        f"engine line missing {missing} — v12 paged "
+                        f"lines must expose the block pool")
+        waste = rec.get("kv_waste_bytes")
+        if (not isinstance(waste, (int, float))
+                or isinstance(waste, bool) or waste < 0):
+            return
+        key = (subject, rec.get("backend"))
+        prev = last_waste.get(key)
+        last_waste[key] = (rname, float(waste))
+        if prev is None:
+            return
+        pname, pval = prev
+        if pval <= 0:
+            # zero waste is the success state (a well-sized block
+            # pool); waste returning from 0 to measurably nonzero is
+            # the regression this column exists to catch
+            if waste > 0:
+                msg = (f"{rname}: {subject} "
+                       f"[{rec.get('backend') or '?'}] kv_waste_bytes "
+                       f"returned from a zero baseline to "
+                       f"{waste:.4g} vs {pname} — the KV pool is "
+                       f"fragmenting again (block_size too large, or "
+                       f"blocks leaking)")
+                if is_cpu(rec) and not strict_cpu:
+                    warnings.append(msg + " [cpu smoke: warning only]")
+                else:
+                    errors.append(msg)
+            return
+        growth = (waste - pval) / pval
+        if growth > tol:
+            msg = (f"{rname}: {subject} "
+                   f"[{rec.get('backend') or '?'}] kv_waste_bytes "
+                   f"grew {growth * 100:.0f}% vs {pname} "
+                   f"({pval:.4g} -> {waste:.4g} bytes, tol "
+                   f"{tol * 100:.0f}%) — KV fragmentation is trending "
+                   f"the wrong way (block_size too large, or blocks "
+                   f"leaking)")
+            if is_cpu(rec) and not strict_cpu:
+                warnings.append(msg + " [cpu smoke: warning only]")
+            else:
+                errors.append(msg)
+
     for rname, recs in rounds:
         wedged = any(r.get("metric") == WEDGE_FLAG for r in recs)
         for rec in recs:
@@ -515,6 +613,7 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             track_overlap_fields(rname, rec)
             track_compile_fields(rname, rec)
             track_tenant_fields(rname, rec)
+            track_kv_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:

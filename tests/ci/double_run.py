@@ -61,11 +61,17 @@ SUITES = ["tests/test_serving.py", "tests/test_fleet.py"]
 # outside the engines are not part of the reload contract
 SERVING_ENTRY_PREFIXES = ("engine.", "seq2seq.")
 
+# the paged engine's jitted executables get their own named assertion:
+# they are the newest donated mutators (block-pool KV, schema v12) and
+# the exact class the PR 2 reload regression bites — run 2 must reload
+# them from the persistent cache, not merely "some serving entry"
+PAGED_ENTRIES = ("engine._paged_step_k", "engine._paged_admit")
+
 
 def _serving_cache_counts(dump_path):
-    """(hits, misses, uncached, entries) summed over the serving
-    entries of one run's ledger dump; None when the dump is missing
-    or unreadable (reported by the caller)."""
+    """(hits, misses, uncached, entries, per_entry) summed over the
+    serving entries of one run's ledger dump; None when the dump is
+    missing or unreadable (reported by the caller)."""
     try:
         with open(dump_path) as f:
             snap = json.load(f)
@@ -75,15 +81,20 @@ def _serving_cache_counts(dump_path):
         return None
     hits = misses = uncached = 0
     names = []
+    per = {}
     for name, st in snap.get("entries", {}).items():
         if not name.startswith(SERVING_ENTRY_PREFIXES):
             continue
         cache = st.get("cache", {})
-        hits += int(cache.get("hit", 0))
-        misses += int(cache.get("miss", 0))
-        uncached += int(cache.get("uncached", 0))
+        h = int(cache.get("hit", 0))
+        m = int(cache.get("miss", 0))
+        u = int(cache.get("uncached", 0))
+        hits += h
+        misses += m
+        uncached += u
         names.append(name)
-    return hits, misses, uncached, sorted(names)
+        per[name] = (h, m, u)
+    return hits, misses, uncached, sorted(names), per
 
 
 def check_cache_hits(run1_dump, run2_dump):
@@ -96,8 +107,8 @@ def check_cache_hits(run1_dump, run2_dump):
     if c1 is None or c2 is None:
         return ["ledger dump missing — conftest's "
                 "APEX_TPU_COMPILATION_LEDGER_DUMP hook did not fire"]
-    h1, m1, u1, names1 = c1
-    h2, m2, u2, names2 = c2
+    h1, m1, u1, names1, _ = c1
+    h2, m2, u2, names2, per2 = c2
     if not names2:
         return ["run 2 ledger recorded no serving entries — the "
                 "engines' jits are no longer instrumented?"]
@@ -128,6 +139,22 @@ def check_cache_hits(run1_dump, run2_dump):
     if m2 == 0 and h2 == 0:
         errs.append("run 2 recorded serving compiles but zero cache "
                     "hits and zero misses — attribution is broken")
+    # the paged executables by name: each must be present in run 2 and
+    # reload as pure hits (>=1 hit, 0 misses) — the aggregate check
+    # above could be satisfied by the fixed-slot engine alone
+    for pname in PAGED_ENTRIES:
+        if pname not in per2:
+            errs.append(f"run 2 ledger has no entry for {pname} — "
+                        f"the paged engine's jit is no longer "
+                        f"instrumented or the suites stopped "
+                        f"exercising it")
+            continue
+        ph, pm, pu = per2[pname]
+        if pm > 0 or (ph == 0 and pu > 0):
+            errs.append(f"run 2: {pname} compiled with hits={ph} "
+                        f"misses={pm} uncached={pu} — the paged "
+                        f"executable did not reload from the "
+                        f"persistent cache")
     if not errs:
         print(f"double_run: run 2 serving suite ledger-measured "
               f"cache-HIT ({h2} hits, 0 misses over "

@@ -130,6 +130,53 @@ def test_donation_rule_flags_blocklisted_cur_len():
     assert _run(fixed, "donation") == []
 
 
+def test_donation_rule_flags_undonated_block_pool():
+    """PR 17 mutation: a paged decode window whose jit forgot to
+    donate the block POOL — the one buffer that dwarfs everything
+    else — must be flagged; the kv_len/n_blk length vectors joined
+    cur_len/n_new on the permanent blocklist (same PR 2 corruption
+    class: per-slot int32 state the compile cache must never alias)."""
+    assert "kv_len" in serving.DONATION_BLOCKLIST
+    assert "n_blk" in serving.DONATION_BLOCKLIST
+
+    def paged_stepish(ids, pool, tables, free_stack):
+        dense = jax.tree_util.tree_map(lambda p: p[tables].sum(), pool)
+        return (ids + 1,
+                jax.tree_util.tree_map(lambda p: p + 1.0, pool),
+                dense, free_stack)
+
+    pool = {"k": jnp.zeros((6, 2, 4, 8)), "v": jnp.zeros((6, 2, 4, 8))}
+    args = (jnp.zeros((2, 16), jnp.int32), pool,
+            jnp.zeros((2, 3), jnp.int32), jnp.arange(6))
+    names = ("ids", "pool", "tables", "free_stack")
+    expect = {"expect_donated": ("ids", "pool"),
+              "forbid_donated": ("tables", "free_stack")}
+    broken = _donation_ep("mutant_undonated_pool", paged_stepish, (0,),
+                          args, names, expect)
+    found = _run(broken, "donation")
+    assert {f.detail.get("argument") for f in found} == {"pool"}
+    assert all(f.severity == "error" for f in found)
+
+    fixed = _donation_ep("fixed_donated_pool", paged_stepish, (0, 1),
+                         args, names, expect)
+    assert _run(fixed, "donation") == []
+
+    # donating a blocklisted paged length vector is flagged even when
+    # the expectation forgot to forbid it
+    def lenish(kv_len, pool):
+        return kv_len + 1, jax.tree_util.tree_map(lambda p: p + 1.0,
+                                                  pool)
+
+    largs = (jnp.zeros((2,), jnp.int32), {"k": jnp.zeros((6, 8))})
+    bad_len = _donation_ep("mutant_kv_len", lenish, (0, 1), largs,
+                           ("kv_len", "pool"),
+                           {"expect_donated": ("pool",)})
+    found = _run(bad_len, "donation")
+    assert len(found) == 1
+    assert found[0].detail["argument"] == "kv_len"
+    assert found[0].detail["blocklisted"] is True
+
+
 def test_donation_rule_flags_double_donation():
     """The gpt init_cache gotcha: a zeros buffer shared across layers
     (dict(layer) shallow copy) donated once per layer — XLA rejects
@@ -875,7 +922,9 @@ def test_telemetry_jsonl_validates_mixed_stream():
          "kv_waste_bytes": 16384, "kv_utilization": 0.75,
          # the compile-plane triple, required fresh at schema v10
          "cold_compile_ms": 120.5, "compiles_total": 2,
-         "steady_state_retraces": 0})
+         "steady_state_retraces": 0,
+         # required fresh at schema v12 (paged serving plane)
+         "admission_mode": "fixed_slot"})
     lint_rec = _enriched(analysis.Finding(
         rule="layout", entry_point="x", message="leak"))
     fleet_rec = exporters.JsonlExporter.enrich(

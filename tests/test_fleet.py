@@ -609,6 +609,51 @@ def test_failover_exactness_replica_killed_mid_decode():
         assert fl.result(r) == expected[tuple(p)]
 
 
+def test_failover_exactness_paged_replicas():
+    """PR 17: the failover pin holds through the paged engine — a
+    block-pool replica killed mid-decode hands its requests to a
+    paged survivor, and every result() is token-for-token the
+    undisturbed single-PagedEngine run (greedy AND explicitly-seeded
+    sampled: the stream is request-intrinsic, never pool-layout-
+    dependent)."""
+    m, params = _gpt(4)
+    rng = np.random.RandomState(4)
+    prompts = [list(rng.randint(0, 64, int(rng.randint(3, 9))))
+               for _ in range(6)]
+
+    def paged_engine():
+        return serving.PagedEngine(m, params, slots=2, buf_len=24,
+                                   block_size=8, window=2,
+                                   temperature=0.8, top_k=8,
+                                   rng=jax.random.PRNGKey(7))
+
+    # half greedy (temperature=0 override), half seeded-sampled
+    kws = [dict(temperature=0.0) if i % 2 == 0 else dict(seed=100 + i)
+           for i in range(len(prompts))]
+    single = paged_engine()
+    srids = [single.submit(p, max_new_tokens=7, **kw)
+             for p, kw in zip(prompts, kws)]
+    while single.live() or single.queue_depth():
+        single.step()
+    expected = [single.result(r) for r in srids]
+    for toks, p, kw in zip(expected, prompts, kws):
+        if kw.get("temperature") == 0.0:
+            assert toks == _solo(m, params, p, 7)
+
+    bad = FaultyReplica(paged_engine(), raise_on_step=(3, None))
+    fl = Fleet([bad, paged_engine()], policy="round_robin",
+               health=HealthConfig(dead_consecutive=2,
+                                   cooldown_steps=50),
+               retry=RetryPolicy(max_attempts=6, jitter=0.0))
+    rids = [fl.submit(p, max_new_tokens=7, **kw)
+            for p, kw in zip(prompts, kws)]
+    _drive(fl, limit=300)
+    s = fl.stats()
+    assert s["failovers"] >= 1            # the fault actually fired
+    assert s["failed"] == 0
+    assert [fl.result(r) for r in rids] == expected
+
+
 def test_failover_exactness_sampled_with_explicit_seeds():
     """Same pin through the sampled tick: explicit seeds make the
     stream request-intrinsic, so a failed-over sampled request

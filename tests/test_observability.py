@@ -802,7 +802,9 @@ def test_bench_record_schema_serving_decode_window_fields():
             "kv_waste_bytes": 4096, "kv_utilization": 0.75,
             # required fresh at schema v10 (compile-plane triple)
             "cold_compile_ms": 350.0, "compiles_total": 2,
-            "steady_state_retraces": 0}
+            "steady_state_retraces": 0,
+            # required fresh at schema v12 (paged serving plane)
+            "admission_mode": "fixed_slot"}
     good = exporters.JsonlExporter.enrich(
         dict(base, window=8, tokens_per_sync=7.5))
     assert exporters.validate_bench_record(good) == []
@@ -2097,6 +2099,7 @@ def test_check_bench_trend_compile_gate(tmp_path):
              "vs_baseline": None, "backend": backend, "ndev": 8,
              "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
              "window": 8, "tokens_per_sync": 7.5,
+             "admission_mode": "fixed_slot",
              "kv_cache_bytes": 16384, "kv_waste_bytes": 4096,
              "kv_utilization": 0.75,
              "cold_compile_ms": cold_ms, "compiles_total": 2,
@@ -2276,6 +2279,156 @@ def test_check_bench_trend_tenant_gate(tmp_path):
                  [dict(tline("tpu", 0.1), stale=True),
                   dict(parity("tpu", 0.5, 50, 100), stale=True)])
     r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 0, r.stderr
+
+
+def test_v12_block_pool_fields_and_version_gating():
+    """Schema v12 (the paged serving plane): fresh engine-decode lines
+    must say which allocator produced them (``admission_mode``), paged
+    lines must expose the block pool, field VALUES are checked
+    wherever they appear, and archived v11 streams re-validate clean
+    at their declared version."""
+    assert exporters.SCHEMA_VERSION >= 12
+    assert exporters.ADMISSION_MODES == ("fixed_slot", "paged")
+    from apex_tpu import serving
+    assert serving.Engine.admission_mode in exporters.ADMISSION_MODES
+    assert serving.PagedEngine.admission_mode in exporters.ADMISSION_MODES
+
+    base = {"metric": "gpt_tiny_engine_decode_paged_throughput",
+            "value": 9.0, "unit": "tokens/sec/chip",
+            "vs_baseline": None, "backend": "cpu", "ndev": 8,
+            "arch": "cpu", "window": 8, "tokens_per_sync": 7.5,
+            "kv_cache_bytes": 16384, "kv_waste_bytes": 4096,
+            "kv_utilization": 0.75, "cold_compile_ms": 350.0,
+            "compiles_total": 2, "steady_state_retraces": 0,
+            "admission_mode": "paged", "block_size": 8,
+            "blocks_total": 16, "blocks_free": 5}
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(dict(base))) == []
+    # fresh v12 engine line without admission_mode
+    rec = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items() if k != "admission_mode"})
+    assert any("admission_mode" in e
+               for e in exporters.validate_bench_record(rec))
+    # a fixed-slot line needs no block fields
+    fixed = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items()
+         if k not in ("block_size", "blocks_total", "blocks_free")}
+        | {"admission_mode": "fixed_slot"})
+    assert exporters.validate_bench_record(fixed) == []
+    # ...but a paged line missing any of them fails
+    for key in ("block_size", "blocks_total", "blocks_free"):
+        rec = exporters.JsonlExporter.enrich(
+            {k: v for k, v in base.items() if k != key})
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), key
+    # archived v11 stream without any of it: valid at its version
+    v11 = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items()
+         if k not in ("admission_mode", "block_size", "blocks_total",
+                      "blocks_free")})
+    v11["schema_version"] = 11
+    assert exporters.validate_bench_record(v11) == []
+    # field VALUES checked wherever they appear
+    for key, bad in (("admission_mode", "slab"), ("admission_mode", 3),
+                     ("block_size", 0), ("block_size", 8.5),
+                     ("blocks_total", -1), ("blocks_free", True)):
+        rec = exporters.JsonlExporter.enrich(dict(base, **{key: bad}))
+        assert any(key in e
+                   for e in exporters.validate_bench_record(rec)), \
+            (key, bad)
+    # blocks_free beyond the pool is an accounting bug
+    rec = exporters.JsonlExporter.enrich(dict(base, blocks_free=99))
+    assert any("blocks_free" in e
+               for e in exporters.validate_bench_record(rec))
+    # stale replay of a pre-paged record: exempt
+    stale = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items()
+         if k not in ("admission_mode", "block_size", "blocks_total",
+                      "blocks_free")}, stale=True)
+    assert exporters.validate_bench_record(stale) == []
+
+
+def test_check_bench_trend_kv_gate(tmp_path):
+    """The KV-plane trend gates: kv_waste_bytes growth past --tol
+    errors on accelerators / warns on CPU smoke (the sampled waste is
+    timing-adjacent), waste returning from a ZERO baseline gates like
+    comm coming back onto the critical path, waste dropping (the paged
+    engine's whole purpose) is clean, and the v12 field contract —
+    fresh engine lines must carry admission_mode — gates on every
+    backend while archived v11 rounds stay exempt."""
+    def kline(backend, waste, **kw):
+        return exporters.JsonlExporter.enrich(
+            {"metric": "gpt_tiny_engine_decode_throughput",
+             "value": 100.0, "unit": "tokens/sec/chip",
+             "vs_baseline": None, "backend": backend, "ndev": 8,
+             "arch": "TPU v5 lite" if backend == "tpu" else "cpu",
+             "window": 8, "tokens_per_sync": 7.5,
+             "admission_mode": "fixed_slot",
+             "kv_cache_bytes": 16384, "kv_waste_bytes": waste,
+             "kv_utilization": 0.75, "cold_compile_ms": 300.0,
+             "compiles_total": 2, "steady_state_retraces": 0, **kw})
+
+    # accelerator waste growth past tol: error
+    d1 = tmp_path / "kv1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [kline("tpu", 4096)])
+    _trend_round(d1, "BENCH_r02.json", [kline("tpu", 9000)])
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 1
+    assert "kv_waste_bytes" in r.stderr
+    # the same growth on CPU smoke: warning only (strict-cpu gates)
+    d2 = tmp_path / "kv2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [kline("cpu", 4096)])
+    _trend_round(d2, "BENCH_r02.json", [kline("cpu", 9000)])
+    r = _run_trend(["--dir", str(d2)])
+    assert r.returncode == 0 and "kv_waste_bytes" in r.stderr
+    r = _run_trend(["--dir", str(d2), "--strict-cpu"])
+    assert r.returncode == 1
+    # waste DROPPING (the paged win) is clean
+    d3 = tmp_path / "kv3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [kline("tpu", 4096)])
+    _trend_round(d3, "BENCH_r02.json", [kline("tpu", 128)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 0, r.stderr
+    # waste returning from a zero baseline: the leak signature
+    d4 = tmp_path / "kv4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json", [kline("tpu", 0)])
+    _trend_round(d4, "BENCH_r02.json", [kline("tpu", 2048)])
+    r = _run_trend(["--dir", str(d4)])
+    assert r.returncode == 1
+    assert "zero baseline" in r.stderr
+    # fresh v12 line without admission_mode: error on every backend
+    d5 = tmp_path / "kv5"
+    d5.mkdir()
+    noam = kline("cpu", 4096)
+    del noam["admission_mode"]
+    _trend_round(d5, "BENCH_r01.json", [noam])
+    r = _run_trend(["--dir", str(d5)])
+    assert r.returncode == 1
+    assert "admission_mode" in r.stderr
+    # a paged line missing its block fields: error
+    d6 = tmp_path / "kv6"
+    d6.mkdir()
+    _trend_round(d6, "BENCH_r01.json",
+                 [kline("cpu", 4096, admission_mode="paged")])
+    r = _run_trend(["--dir", str(d6)])
+    assert r.returncode == 1
+    assert "block" in r.stderr
+    # ...but an archived round DECLARING v11 is exempt, and a stale
+    # replay with cratered waste never trends
+    d7 = tmp_path / "kv7"
+    d7.mkdir()
+    old = kline("tpu", 4096)
+    del old["admission_mode"]
+    old["schema_version"] = 11
+    _trend_round(d7, "BENCH_r01.json", [old])
+    _trend_round(d7, "BENCH_r02.json",
+                 [dict(kline("tpu", 999999), stale=True)])
+    r = _run_trend(["--dir", str(d7)])
     assert r.returncode == 0, r.stderr
 
 

@@ -1105,3 +1105,331 @@ def test_warmup_requires_idle_engine():
     eng.add_request([1, 2], max_new_tokens=2)
     with pytest.raises(RuntimeError, match="idle"):
         eng.warmup()
+
+
+# -- PR 17: paged KV + continuous batching ---------------------------------
+# The block-pool engine must be INVISIBLE to results: every request
+# token-for-token equal to generate_cached and to the fixed-slot engine
+# under the same schedule, with blocks recycling in-graph the moment a
+# request dies and admission landing at ITERATION boundaries (not
+# window boundaries) — all of it zero-retrace after warmup.
+
+
+def _paged(m, params, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("buf_len", 24)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return serving.PagedEngine(m, params, **kw)
+
+
+def test_paged_engine_matches_solo_and_fixed():
+    """Greedy parity across mixed prompt lengths and staggered
+    arrivals: paged == fixed-slot == generate_cached, at window 1 and
+    a real window, under block-pool pressure (num_blocks < the
+    worst-case sum, so admissions genuinely contend for blocks)."""
+    m, params = _gpt(70)
+    rng = np.random.RandomState(70)
+    prompts = [list(rng.randint(0, 64, k)) for k in (6, 4, 9, 2)]
+    buds = [8, 10, 5, 7]
+
+    def run(make):
+        eng = make()
+        ra = eng.submit(prompts[0], max_new_tokens=buds[0])
+        eng.step()                       # A alone for one dispatch
+        rb = eng.submit(prompts[1], max_new_tokens=buds[1])
+        rc = eng.submit(prompts[2], max_new_tokens=buds[2])
+        eng.step()
+        rd = eng.submit(prompts[3], max_new_tokens=buds[3])
+        steps = 0
+        while eng.live() or eng.queue_depth():
+            eng.step()
+            steps += 1
+            assert steps < 60
+        return [eng.result(r) for r in (ra, rb, rc, rd)]
+
+    want = [_solo(m, params, p, b) for p, b in zip(prompts, buds)]
+    fixed = run(lambda: serving.Engine(m, params, slots=3, buf_len=24))
+    assert fixed == want
+    for window in (1, 4):
+        got = run(lambda: _paged(m, params, num_blocks=7,
+                                 window=window))
+        assert got == fixed == want, window
+
+
+def test_paged_engine_nondivisor_block_size():
+    """block_size that does NOT divide buf_len: the table pads to
+    ceil(buf_len / block_size) blocks and the dense gather masks the
+    overhang — parity must be bit-exact anyway."""
+    m, params = _gpt(71)
+    rng = np.random.RandomState(71)
+    pa = list(rng.randint(0, 64, 7))
+    eng = _paged(m, params, slots=2, block_size=5, window=2)
+    ra = eng.add_request(pa, max_new_tokens=9)
+    while eng.live():
+        eng.step()
+    assert eng.result(ra) == _solo(m, params, pa, 9)
+
+
+def test_paged_engine_mid_window_eos_recycles_blocks_in_graph():
+    """EOS at an interior tick: the host sees exactly the tokens up to
+    and including EOS, and the dead request's blocks are back on the
+    free stack at the SAME window's fetch — in-graph recycling, not a
+    host-side cleanup on the next dispatch."""
+    m, params = _gpt(72)
+    rng = np.random.RandomState(72)
+    pa = list(rng.randint(0, 64, 5))
+    solo = _solo(m, params, pa, 8)
+    eos = solo[2]                        # fires mid-window (K=8)
+    want = solo[:solo.index(eos) + 1]
+    eng = _paged(m, params, slots=1, window=8)
+    total = eng.stats()["blocks_total"]
+    ra = eng.add_request(pa, max_new_tokens=8, eos_token_id=eos)
+    assert eng.stats()["blocks_free"] < total
+    out = eng.step()
+    assert out == {ra: want}
+    assert eng.live() == 0
+    assert eng.stats()["blocks_free"] == total   # all blocks recycled
+    # the recycled blocks are clean for the next occupant
+    pb = list(rng.randint(0, 64, 7))
+    rb = eng.add_request(pb, max_new_tokens=6)
+    while eng.live():
+        eng.step()
+    assert eng.result(rb) == _solo(m, params, pb, 6)
+
+
+def test_paged_engine_midwindow_admission_is_exact():
+    """THE continuous-batching claim: on a full engine, a queued
+    request is admitted at the iteration where blocks free up — INSIDE
+    the window — and still decodes exactly as its solo run.  The
+    stats counter proves the in-window path (not the window-boundary
+    drain) actually served it."""
+    m, params = _gpt(73)
+    rng = np.random.RandomState(73)
+    pa = list(rng.randint(0, 64, 5))
+    pb = list(rng.randint(0, 64, 7))
+    eng = _paged(m, params, slots=1, window=16)
+    ra = eng.submit(pa, max_new_tokens=4)    # takes the slot
+    rb = eng.submit(pb, max_new_tokens=6)    # queues
+    assert eng.live() == 1
+    out = eng.step()                         # ONE window serves both
+    assert sorted(out) == sorted([ra, rb])
+    assert eng.live() == 0 and eng.queue_depth() == 0
+    assert eng.stats()["midwindow_admissions"] == 1
+    assert eng.stats()["host_syncs"] == 1
+    assert eng.result(ra) == _solo(m, params, pa, 4)
+    assert eng.result(rb) == _solo(m, params, pb, 6)
+
+
+def test_paged_sampled_matches_fixed_with_explicit_seeds():
+    """Seeded-sampled parity: per-request streams are derived from
+    (rid | seed) exactly like the fixed engine's, and advance once per
+    OWN decode tick — so explicit seeds draw identical tokens on both
+    engines, at any window, under any arrival pattern."""
+    m, params = _gpt(74)
+    rng = np.random.RandomState(74)
+    pa = list(rng.randint(0, 64, 5))
+    pb = list(rng.randint(0, 64, 7))
+
+    def run(make, stagger):
+        eng = make()
+        ra = eng.add_request(pa, max_new_tokens=9, seed=3)
+        if stagger:
+            eng.step()
+        rb = eng.add_request(pb, max_new_tokens=6, seed=4)
+        while eng.live():
+            eng.step()
+        return eng.result(ra), eng.result(rb)
+
+    kw = dict(temperature=1.0, top_k=8, rng=jax.random.PRNGKey(9))
+    base = run(lambda: serving.Engine(m, params, slots=2, buf_len=24,
+                                      **kw), False)
+    for window in (1, 4):
+        for stagger in (False, True):
+            got = run(lambda: _paged(m, params, slots=2,
+                                     window=window, **kw), stagger)
+            assert got == base, (window, stagger)
+    a, b = base
+    assert len(a) == 9 and len(b) == 6
+    assert all(0 <= t < 64 for t in a + b)
+
+
+def test_paged_engine_int8_kv_matches_solo():
+    """int8 KV composes with the block pool (the quantized buffers and
+    their scale sidecars page identically): parity vs the solo int8
+    decode and the fixed-slot int8 engine."""
+    m, params = _gpt(75)
+    rng = np.random.RandomState(75)
+    prompts = [list(rng.randint(0, 64, k)) for k in (4, 8)]
+
+    def solo8(p, n):
+        buf = jnp.zeros((1, 24), jnp.int32).at[0, :len(p)].set(
+            jnp.asarray(p))
+        out, fl = m.generate_cached(params, buf, len(p), n,
+                                    cache_dtype=jnp.int8)
+        return list(np.asarray(out[0, len(p):int(fl[0])]))
+
+    fixed = serving.Engine(m, params, slots=2, buf_len=24,
+                           cache_dtype=jnp.int8)
+    paged = _paged(m, params, slots=2, cache_dtype=jnp.int8, window=2)
+    rids_f = [fixed.add_request(p, max_new_tokens=6) for p in prompts]
+    rids_p = [paged.add_request(p, max_new_tokens=6) for p in prompts]
+    while fixed.live():
+        fixed.step()
+    while paged.live():
+        paged.step()
+    for rf, rp, p in zip(rids_f, rids_p, prompts):
+        want = solo8(p, 6)
+        assert fixed.result(rf) == want, p
+        assert paged.result(rp) == want, p
+
+
+def test_paged_engine_prefix_affinity_cross_check():
+    """Prefix-affinity splice cross-check: prompts sharing a system
+    prefix through the FIXED engine's splice path and through the
+    plain paged engine must produce identical tokens — the splice is
+    an admission-latency lever, never a numerics one, so the paged
+    engine (which re-prefills the shared prefix chunked) agrees
+    token-for-token."""
+    m, params = _gpt(76)
+    rng = np.random.RandomState(76)
+    pref = list(rng.randint(0, 64, 7))
+    prompts = [pref + list(rng.randint(0, 64, k)) for k in (1, 3, 6)]
+    fixed = serving.Engine(m, params, slots=3, buf_len=24,
+                           prefix_pool=1, prefix_chunk=4)
+    fixed.register_prefix(pref)
+    paged = _paged(m, params, window=2)
+    rids_f = [fixed.submit(p, max_new_tokens=6) for p in prompts]
+    rids_p = [paged.submit(p, max_new_tokens=6) for p in prompts]
+    while fixed.live() or fixed.queue_depth():
+        fixed.step()
+    while paged.live() or paged.queue_depth():
+        paged.step()
+    assert fixed.stats()["prefix_hits"] == 3     # the splice ran
+    for rf, rp, p in zip(rids_f, rids_p, prompts):
+        want = _solo(m, params, p, 6)
+        assert fixed.result(rf) == want, p
+        assert paged.result(rp) == want, p
+
+
+def test_paged_admission_control_and_cancel_release_blocks():
+    """add_request on a slot-free but block-starved engine fails loud
+    (submit() is the queueing path); cancel() of a live request
+    releases its blocks eagerly so the next admission fits."""
+    m, params = _gpt(77)
+    # 4 blocks of 8: one 20-position request (3 blocks) starves the
+    # pool for anything needing 2+
+    eng = _paged(m, params, slots=2, num_blocks=4)
+    ra = eng.add_request([1] * 16, max_new_tokens=8)     # 3 blocks
+    assert eng.stats()["blocks_free"] == 1
+    with pytest.raises(RuntimeError, match="no free KV blocks"):
+        eng.add_request([2] * 8, max_new_tokens=8)       # needs 2
+    rb = eng.add_request([3] * 4, max_new_tokens=4)      # 1 block fits
+    assert eng.stats()["blocks_free"] == 0
+    assert eng.cancel(rb)
+    assert eng.stats()["blocks_free"] == 1
+    assert eng.cancel(ra)
+    assert eng.stats()["blocks_free"] == 4
+    # queueing path: submit() holds the request until blocks recycle
+    rc = eng.submit([4] * 16, max_new_tokens=6)
+    rd = eng.submit([5] * 16, max_new_tokens=6)          # can't fit yet
+    assert eng.live() == 1 and eng.queue_depth() == 1
+    while eng.live() or eng.queue_depth():
+        eng.step()
+    assert eng.is_finished(rc) and eng.is_finished(rd)
+    assert eng.stats()["blocks_free"] == 4
+
+
+def test_paged_kv_fragmentation_block_accounting():
+    """Per-BLOCK ledger: an empty pool is all waste, a live request
+    wastes only the unfilled tail of its last block-set (not the whole
+    buf_len row), decode shrinks the waste, and finish returns every
+    block.  Gauges == ledger == stats() at each stage, plus the paged
+    blocks_free gauge."""
+    m, params = _gpt(78)
+    eng = _paged(m, params, slots=2)
+    frag = _assert_kv_pinned(eng)
+    total = frag["kv_cache_bytes"]
+    assert frag["kv_waste_bytes"] == total
+    assert frag["kv_utilization"] == 0.0
+
+    rng = np.random.RandomState(78)
+    pa = list(rng.randint(0, 64, 6))
+    ra = eng.add_request(pa, max_new_tokens=4)   # 10 pos -> 2 blocks
+    frag = _assert_kv_pinned(eng)
+    by_slot = {e["rid"]: e for e in frag["slots"]}
+    assert by_slot[ra]["blocks_held"] == 2
+    assert by_slot[ra]["used_positions"] == 6
+    # block granularity: the live slot's waste is its block-tail, far
+    # less than a fixed-slot engine's whole-row reservation would be
+    assert by_slot[ra]["capacity_positions"] == 16
+    waste_admit = frag["kv_waste_bytes"]
+    assert waste_admit < total
+    g_free = eng.metrics.gauge("engine_kv_blocks_free").value
+    assert g_free == eng.stats()["blocks_free"]
+
+    eng.step()
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] < waste_admit
+    while eng.live():
+        eng.step()
+    frag = _assert_kv_pinned(eng)
+    assert frag["kv_waste_bytes"] == total       # all blocks returned
+    assert eng.stats()["blocks_free"] == eng.stats()["blocks_total"]
+
+
+def test_paged_warmup_compiles_exactly_the_census():
+    """warmup() traces exactly the two paged entries (the decode
+    window's ONE graph covers chunked prefill, decode, in-window
+    admission and recycling as cond branches / masked lanes), and a
+    second warmup adds zero traces."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    led = compilation.get_ledger()
+    eng = _paged(m, params, window=2)
+    census = eng.compile_census()
+    assert census == {"engine._paged_admit": "admission",
+                      "engine._paged_step_k": "decode"}
+    before = led.counts()
+    t0 = led.total_traces()
+    eng.warmup()
+    after = led.counts()
+    assert led.total_traces() - t0 == len(census)
+    for e in census:
+        assert after.get(e, 0) - before.get(e, 0) == 1, e
+    t1 = led.total_traces()
+    eng.warmup()
+    assert led.total_traces() == t1
+
+
+def test_paged_zero_retrace_steady_state_with_midwindow_admission():
+    """THE acceptance pin for the paged plane: after warmup, N mixed
+    windows — staggered arrivals, an eos that fires, queue drains AND
+    a mid-window admission — add exactly 0 traces.  Everything that
+    varies (prompt length, block counts, budgets, arrival timing) is
+    buffer values, never abstract signatures."""
+    from apex_tpu.observability import compilation
+    m, params = _gpt()
+    eng = _paged(m, params, slots=2, window=8)
+    eng.warmup()
+    rng = np.random.RandomState(0)
+    pa = list(rng.randint(0, 64, 5))
+    eos_a = _solo(m, params, pa, 1)[0]
+    led = compilation.get_ledger()
+    t0 = led.total_traces()
+    ra = eng.submit(pa, max_new_tokens=6, eos_token_id=eos_a)
+    rb = eng.submit(list(rng.randint(0, 64, 9)), max_new_tokens=4)
+    rc = eng.submit(list(rng.randint(0, 64, 3)), max_new_tokens=8)
+    rd = eng.submit(list(rng.randint(0, 64, 7)), max_new_tokens=3)
+    windows = 0
+    while eng.live() or eng.queue_depth():
+        eng.step()
+        windows += 1
+        assert windows < 50
+    for r in (ra, rb, rc, rd):
+        assert eng.is_finished(r)
+    assert eng.result(ra) == [eos_a]           # the eos path ran
+    assert eng.stats()["midwindow_admissions"] >= 1
+    assert led.total_traces() - t0 == 0        # zero retraces, pinned
+    # the census is the whole compiled surface: nothing outside it
+    assert set(eng.compile_census()) <= set(led.counts())
