@@ -36,10 +36,19 @@ from .graphs import (HOST_TRANSFER_PRIMS, COLLECTIVE_PRIMS, Graph,
 from .entry_points import (EntryPoint, ENTRY_POINTS,
                            register_entry_point, get, select,
                            entry_point_memory_record)
+from .sharding import (Partition, ArgSharding, CollectiveSite,
+                       ShardMapAnalysis, RESHARD_PRIMS, shard_map_eqns,
+                       analyze_shard_map, analyze_sharding,
+                       check_shard_map_specs, divergent_output_claims,
+                       entry_point_sharding_record)
+from .pallas_lint import (KernelSite, capture_kernel_sites, check_site,
+                          collect_kernel_sites, lint_pallas_kernels)
 from . import rules  # noqa: F401  (registers the core rule set)
 from . import core
 from . import graphs
 from . import entry_points
+from . import sharding
+from . import pallas_lint
 
 __all__ = [
     "Finding", "Rule", "RULES", "register_rule", "get_rule",
@@ -52,4 +61,11 @@ __all__ = [
     "donated_arg_names", "duplicate_donated_leaves",
     "EntryPoint", "ENTRY_POINTS", "register_entry_point", "get",
     "select", "rules", "core", "graphs", "entry_points",
+    "Partition", "ArgSharding", "CollectiveSite", "ShardMapAnalysis",
+    "RESHARD_PRIMS", "shard_map_eqns", "analyze_shard_map",
+    "analyze_sharding", "check_shard_map_specs",
+    "divergent_output_claims", "entry_point_sharding_record",
+    "sharding",
+    "KernelSite", "capture_kernel_sites", "check_site",
+    "collect_kernel_sites", "lint_pallas_kernels", "pallas_lint",
 ]

@@ -51,6 +51,17 @@ def main(argv: List[str] = None) -> int:
                         "(e.g. training,serving)")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule names (default: all)")
+    p.add_argument("--entry", default=None, metavar="SUBSTR",
+                   help="substring filter on entry-point names — the "
+                        "rule-author iteration loop (the warm full "
+                        "registry is ~16-21s; --entry=paged runs just "
+                        "the paged engine EPs).  Composes with "
+                        "--entry-points/--tags and filters --list")
+    p.add_argument("--rule", default=None, metavar="SUBSTR",
+                   help="substring filter on rule names (e.g. "
+                        "--rule=shard matches sharding + "
+                        "resharding-census).  Composes with --rules "
+                        "and filters --list")
     p.add_argument("--list", action="store_true",
                    help="list entry points and rules, run nothing")
     p.add_argument("--memory", action="store_true",
@@ -59,19 +70,35 @@ def main(argv: List[str] = None) -> int:
                         "memory plan) instead of linting.  Compiles "
                         "each selected entry point — combine with "
                         "--entry-points/--tags to bound the cost")
+    p.add_argument("--sharding", action="store_true",
+                   help="emit one `kind: sharding` record (the "
+                        "replication ledger: per-dtype replicated "
+                        "bytes, top replicated arrays, resharding "
+                        "census) per entry point instead of linting. "
+                        "Entry points that trace no shard_map "
+                        "(serving engines) are skipped")
     p.add_argument("--out", default=None,
                    help="append JSONL findings to this path instead of "
                         "stdout")
     args = p.parse_args(argv)
 
     from . import ENTRY_POINTS, RULES, get_rule, run_lint, select
+
+    def _ep_match(name):
+        return args.entry is None or args.entry in name
+
+    def _rule_match(name):
+        return args.rule is None or args.rule in name
+
     from ..observability.exporters import JsonlExporter
 
     if args.list:
         for ep in ENTRY_POINTS.values():
-            print(f"{ep.name:32s} [{', '.join(sorted(ep.tags))}] "
-                  f"{ep.description}")
-        print(f"rules: {', '.join(sorted(RULES))}")
+            if _ep_match(ep.name):
+                print(f"{ep.name:32s} [{', '.join(sorted(ep.tags))}] "
+                      f"{ep.description}")
+        print("rules: " + ", ".join(
+            r for r in sorted(RULES) if _rule_match(r)))
         return 0
 
     try:
@@ -84,6 +111,14 @@ def main(argv: List[str] = None) -> int:
     except KeyError as e:
         print(f"graph lint: {e.args[0]}", file=sys.stderr)
         return 2
+    eps = [ep for ep in eps if _ep_match(ep.name)]
+    if args.rule is not None:
+        rules = [r for r in (rules if rules is not None
+                             else RULES.values())
+                 if _rule_match(r.name)]
+        if not rules:
+            print(f"no rules match --rule={args.rule}", file=sys.stderr)
+            return 2
     if not eps:
         print("no entry points selected", file=sys.stderr)
         return 2
@@ -131,6 +166,43 @@ def main(argv: List[str] = None) -> int:
                 print(f"{ep.name:32s} flops={rec['flops']:.4g} "
                       f"peak_bytes={rec['peak_bytes']:,} "
                       f"[{time.perf_counter() - t0:.1f}s]",
+                      file=sys.stderr)
+        return 1 if failed else 0
+
+    if args.sharding:
+        # per-entry-point replication ledger: statically derived from
+        # the traced jaxpr (free: reuses the cached trace, never
+        # compiles).  Same stdout contract as lint: pure schema-valid
+        # JSONL.  Two skip classes ride the bare-RuntimeError gate:
+        # the device-count gate (hierarchical EPs on a 1-device host)
+        # and "traces no shard_map" (serving engines) — jaxlib's
+        # XlaRuntimeError SUBCLASSES RuntimeError, so a real trace
+        # failure still fails the run.
+        from .sharding import entry_point_sharding_record
+        failed = 0
+        with exp:
+            for ep in eps:
+                t0 = time.perf_counter()
+                try:
+                    rec = entry_point_sharding_record(ep)
+                except RuntimeError as e:
+                    if type(e) is not RuntimeError:
+                        failed += 1
+                        print(f"{ep.name:32s} FAILED: {e}",
+                              file=sys.stderr)
+                        continue
+                    print(f"{ep.name:32s} skipped: {e}",
+                          file=sys.stderr)
+                    continue
+                except Exception as e:
+                    failed += 1
+                    print(f"{ep.name:32s} FAILED: {e}", file=sys.stderr)
+                    continue
+                exp.emit(rec)
+                print(f"{ep.name:32s} "
+                      f"replicated={rec['replicated_bytes']:,} "
+                      f"({rec['replicated_fraction']:.1%} of world "
+                      f"bytes) [{time.perf_counter() - t0:.1f}s]",
                       file=sys.stderr)
         return 1 if failed else 0
     t0 = time.perf_counter()

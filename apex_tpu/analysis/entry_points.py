@@ -71,10 +71,26 @@ class EntryPoint:
             # at trace time scope it explicitly via _scoped().
             from ..amp import policy as amp_policy
             base = amp_policy.current_policy()
+            # same discipline for the thread-local mesh context: a
+            # builder that raises mid-``with mesh:`` (the device-count
+            # skip gate fires INSIDE some builders) or that forgets to
+            # exit would otherwise leak a physical mesh into every
+            # graph traced after it — which silently changes what the
+            # sharding propagator sees as the ambient mesh
+            try:
+                from jax.interpreters import pxla
+                mesh_env = pxla.thread_resources.env
+            except Exception:        # pragma: no cover - jax internals
+                pxla = mesh_env = None
             try:
                 self._graph = self._build(self)
             finally:
                 amp_policy.set_policy(base)
+                if mesh_env is not None:
+                    try:
+                        pxla.thread_resources.env = mesh_env
+                    except Exception:   # pragma: no cover
+                        pass
         return self._graph
 
     def cost(self):
@@ -298,12 +314,23 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
             return (params, nb, ost2, ntele), jax.lax.pmean(loss, "data")
         return (params, nb, ost2), jax.lax.pmean(loss, "data")
 
+    # divergent-output ledger (spec-consistency rule): the seed's
+    # intended non-SyncBN semantics — every rank updates its BN running
+    # stats from LOCAL batch statistics, so each floating BN-state leaf
+    # (2 stats x 20 BN layers = 40) diverges across ranks despite the
+    # replicated out_spec.  The ENABLED numerics monitor adds 3 carry
+    # leaves derived from rank-local bucket stats before their flush.
+    divergent = sum(
+        1 for leaf in jax.tree_util.tree_leaves(bn)
+        if np.issubdtype(np.asarray(leaf).dtype, np.floating))
+    if numerics == "on":
+        divergent += 3
     _fill_ddp_expectations(ep, opt_level, params,
                            comm_topology=comm_topology,
                            compress=compress, ici_size=ici_size,
                            extra_plan=digest_plan if (
                                numerics == "on") else None,
-                           world=ndev)
+                           world=ndev, divergent_outputs=divergent)
     if numerics is not None:
         ep.expect.setdefault("numerics", {
             "baseline": "ddp_resnet18_o2",
@@ -341,7 +368,8 @@ def _ddp_resnet_graph(ep, opt_level, channels_last=False,
 
 def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
                            compress=False, ici_size=None,
-                           extra_plan=None, world=None):
+                           extra_plan=None, world=None,
+                           divergent_outputs=0):
     """Derive the amp + collective expectations for a DDP train step.
 
     Comm accounting: the step's collective population is exactly the
@@ -391,6 +419,22 @@ def _fill_ddp_expectations(ep, opt_level, params, comm_topology="flat",
         ep.expect.setdefault("flops", {"max_fp32_matmul_fraction": 0.02,
                                        "min_matmul_flops": 1e6})
     ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    # sharding plane (PR 18): the mesh the step maps over, plus the
+    # DECLARED divergent-output count — the spec-consistency rule
+    # re-derives the count from the partition propagator and flags any
+    # drift in either direction (see _ddp_resnet_graph for what the
+    # declared leaves are).  The resharding census is plan-derived like
+    # the collective census: the hierarchical buckets' reduce_scatter /
+    # all_gather payloads are the ONLY sanctioned reshards, and the
+    # flat plan sanctions none (psums never reshard).
+    ep.expect.setdefault("sharding", {
+        "mesh_axes": {"data": world if world is not None
+                      else len(jax.devices())},
+        "divergent_outputs": divergent_outputs})
+    ep.expect.setdefault(
+        "resharding",
+        parallel.plan_resharding_expectations(
+            plan + list(extra_plan or [])))
 
 
 for _lvl in ("O0", "O1", "O2", "O3"):
@@ -579,6 +623,15 @@ def _staged_mlp_graph(ep, overlap=True, comm_topology="hierarchical",
         parallel.overlap_collective_expectations(
             schedule, extra_psums=2, extra_psum_bytes=2 * 4))
     ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    # sharding plane: params replicated, batch sharded over data, and
+    # every output provably agrees (the per-stage allreduce chains
+    # resolve to replicated); the census sanctions exactly the
+    # schedule's per-bucket reduce_scatter/all_gather payloads
+    ep.expect.setdefault("sharding", {"mesh_axes": {"data": ndev},
+                                      "divergent_outputs": 0})
+    ep.expect.setdefault(
+        "resharding",
+        parallel.plan_resharding_expectations(schedule["buckets"]))
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"), P("data"))),
@@ -663,6 +716,14 @@ def _transformer_graph(ep, family):
     ep.expect.setdefault("flops", {"max_fp32_matmul_fraction": 0.02,
                                    "min_matmul_flops": 1e6})
     ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    # sharding plane: flat DDP — the plan sanctions NO reshards (every
+    # bucket is a bare psum), so any all_gather that creeps into the
+    # transformer step is an immediate census finding
+    ep.expect.setdefault("sharding", {
+        "mesh_axes": {"data": len(jax.devices())},
+        "divergent_outputs": 0})
+    ep.expect.setdefault("resharding",
+                         parallel.plan_resharding_expectations(plan))
     mesh = Mesh(np.array(jax.devices()), ("data",))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(P(), (P("data"),)),
@@ -919,11 +980,8 @@ def _tp_train_step_graph(ep):
     # bucket over the LOCAL param shards (specs divide the model-axis
     # dims by 4) plus the axis-size scalar gradient_average divides by
     local = [
-        jax.ShapeDtypeStruct(
-            tuple(d // mesh.shape[ax] if ax else d
-                  for d, ax in zip(leaf.shape, tuple(spec)
-                                   + (None,) * leaf.ndim)),
-            leaf.dtype)
+        jax.ShapeDtypeStruct(tp.local_shape(leaf.shape, spec, mesh),
+                             leaf.dtype)
         for leaf, spec in zip(jax.tree_util.tree_leaves(params),
                               jax.tree_util.tree_leaves(
                                   specs, is_leaf=lambda s:
@@ -935,6 +993,18 @@ def _tp_train_step_graph(ep):
         parallel.plan_collective_expectations(
             plan, extra_psums=2, extra_psum_bytes=act_bytes + 4))
     ep.expect.setdefault("memory", {"max_live_to_argument_ratio": 4.0})
+    # sharding plane: the 2x4 mesh, ONE declared divergent output — a
+    # precision limit of the static propagator, not a real divergence:
+    # DDP concatenates all local grad shards into one flat bundle
+    # before the data-axis psum, and the partition model cannot see
+    # through the concat/slice round trip, so the second bias's grad
+    # conservatively reports varies(model) even though the psum made
+    # the whole bundle agree along data and nothing mixed model ranks
+    ep.expect.setdefault("sharding", {
+        "mesh_axes": {"data": 2, "model": 4},
+        "divergent_outputs": 1})
+    ep.expect.setdefault("resharding",
+                         parallel.plan_resharding_expectations(plan))
     mapped = jax.shard_map(step, mesh=mesh,
                            in_specs=(specs, P("data"), P("data")),
                            out_specs=specs, check_vma=False)

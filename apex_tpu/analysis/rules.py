@@ -114,6 +114,45 @@ runs where its key is present):
     and contain zero host-transfer primitives — enabled or disabled,
     because the supervisor consumes host-side flush points only and
     ``RunSupervisor.wrap_step`` is an identity by contract.
+
+``sharding``::
+
+    {"mesh_axes": {"data": 8},
+     "divergent_outputs": 40,            # default 0
+     "max_replicated_bytes": None}       # optional budget
+
+    Spec-vs-mesh consistency (PR 18): the traced ``shard_map``'s mesh
+    axes must be exactly what ``topology.make_mesh`` was asked for,
+    every axis named in in/out specs must exist, every sharded dim
+    must divide across its axes, and the number of outputs whose spec
+    claims MORE agreement than ``analysis.sharding``'s propagated
+    partition guarantees is pinned (``divergent_outputs`` — 40 on the
+    resnet DDP entry points: two unsynced BatchNorm running stats per
+    BN layer, the documented non-SyncBN semantics; any OTHER count,
+    up or down, is a finding, so a new missing collective flags and a
+    fixed sync forces a ratchet).  ``max_replicated_bytes`` caps the
+    replication ledger's world-total duplicate bytes — the budget
+    ZeRO-2/3 stages (ROADMAP item 2) will ratchet down.
+
+``resharding``::
+
+    {"planned": {"reduce_scatter": [38400, 22344088],
+                 "all_gather": [9600, 5586022]},
+     "budget": {"all_gather": 0}}        # extra eqns allowed, default 0
+
+    The resharding census (PR 18): every placement-changing collective
+    (``all_gather``/``all_to_all``/``reduce_scatter``/``pgather``) in
+    the hot graph must be explained — matched one-for-one by payload
+    against the comm plan's per-eqn list
+    (``parallel.plan_resharding_expectations`` derives it from
+    ``allreduce_comm_plan`` / ``overlap_comm_schedule``) or covered by
+    a declared per-primitive ``budget``.  An unplanned gather (the
+    classic "XLA silently replicated my shard") is an error naming the
+    culprit operand's shape, dtype, payload, and statically inferred
+    spec; a planned payload missing from the graph flags too
+    (plan/graph desync).  psum/pmax/pmin stay the collective rule's
+    business — a reduce changes values, not placement, which is why
+    an unplanned all-gather can hide behind an identical psum census.
 """
 
 from __future__ import annotations
@@ -126,7 +165,8 @@ from . import graphs as G
 
 __all__ = ["HostTransferRule", "DonationRule", "AmpDtypeRule",
            "LayoutRule", "CollectiveRule", "FlopAccountingRule",
-           "MemoryBudgetRule", "NumericsRule", "SupervisorRule"]
+           "MemoryBudgetRule", "NumericsRule", "SupervisorRule",
+           "SpecConsistencyRule", "ReshardingCensusRule"]
 
 
 @register_rule
@@ -677,4 +717,132 @@ class CollectiveRule(Rule):
                     f"nothing is left for the reduction to overlap "
                     f"with", matmuls_after=after, floor=floor,
                 first_collective_eqn=first_coll))
+        return out
+
+
+@register_rule
+class SpecConsistencyRule(Rule):
+    """``shard_map`` specs are consistent with the mesh and with what
+    the body actually computes: axes exist, sharded dims divide, the
+    mesh is the one ``topology.make_mesh`` was asked for, and the
+    number of outputs claiming more agreement than the propagated
+    partition guarantees is exactly the declared count.  With
+    ``check_vma=False`` (how every train entry point runs) NOTHING at
+    runtime checks the last property — a replicated out-spec over a
+    still-varying value silently keeps one replica's answer."""
+
+    name = "sharding"
+    expect_key = "sharding"
+
+    def check(self, ep, graph) -> List[Finding]:
+        from . import sharding as S
+        want = ep.expect["sharding"]
+        out: List[Finding] = []
+        eqns = S.shard_map_eqns(graph.jaxpr)
+        if not eqns:
+            return [self.finding(
+                ep, "a sharding expectation is declared but the graph "
+                    "traces no shard_map eqn")]
+        analyses = [S.analyze_shard_map(e) for e in eqns]
+        divergent: List[str] = []
+        for eqn, a in zip(eqns, analyses):
+            for msg in S.check_shard_map_specs(
+                    eqn, want.get("mesh_axes"), analysis=a):
+                out.append(self.finding(ep, msg))
+            divergent.extend(S.divergent_output_claims(eqn, a))
+        declared = int(want.get("divergent_outputs", 0))
+        if len(divergent) != declared:
+            sample = "; ".join(divergent[:3])
+            if len(divergent) > declared:
+                out.append(self.finding(
+                    ep, f"{len(divergent)} output spec(s) claim more "
+                        f"agreement than the propagated partitions "
+                        f"guarantee; {declared} are declared (the "
+                        f"non-synced BatchNorm stats class) — a "
+                        f"collective went missing before a return. "
+                        f"First undeclared: {sample}",
+                    divergent=len(divergent), declared=declared))
+            else:
+                out.append(self.finding(
+                    ep, f"only {len(divergent)} divergent output "
+                        f"claim(s) but {declared} are declared — "
+                        f"ratchet divergent_outputs down",
+                    divergent=len(divergent), declared=declared))
+        budget = want.get("max_replicated_bytes")
+        if budget is not None:
+            repl = sum(a.replicated_bytes for a in analyses)
+            if repl > int(budget):
+                worst = max(
+                    (arg for a in analyses for arg in a.args),
+                    key=lambda g: g.replicated_bytes(analyses[0].world))
+                out.append(self.finding(
+                    ep, f"replication ledger reports {repl:,} "
+                        f"world-total duplicate bytes, budget is "
+                        f"{int(budget):,} — largest contributor: "
+                        f"{worst.dtype}{list(worst.shape)} x"
+                        f"{worst.replication_factor} ({worst.spec})",
+                    replicated_bytes=repl, budget_bytes=int(budget)))
+        return out
+
+
+@register_rule
+class ReshardingCensusRule(Rule):
+    """Every placement-changing collective in the hot graph is
+    explained by the comm plan or a declared budget.  The collective
+    rule pins counts and payload totals — but an unplanned all-gather
+    introduced while a planned one is dropped can leave both intact.
+    This rule matches graph eqns against the plan's per-eqn payload
+    list one by one, and names the operand (shape, dtype, inferred
+    spec) of anything unexplained — the "XLA silently replicated my
+    shard" failure, caught statically."""
+
+    name = "resharding-census"
+    expect_key = "resharding"
+
+    def check(self, ep, graph) -> List[Finding]:
+        from . import sharding as S
+        want = ep.expect["resharding"]
+        out: List[Finding] = []
+        eqns = S.shard_map_eqns(graph.jaxpr)
+        if not eqns:
+            return [self.finding(
+                ep, "a resharding expectation is declared but the "
+                    "graph traces no shard_map eqn")]
+        sites = [s for e in eqns for s in S.analyze_shard_map(e).sites
+                 if s.primitive in S.RESHARD_PRIMS]
+        planned = {prim: list(pays)
+                   for prim, pays in want.get("planned", {}).items()}
+        budget = {k: int(v) for k, v in want.get("budget", {}).items()}
+        unplanned: dict = {}
+        for s in sites:
+            pool = planned.get(s.primitive, [])
+            if s.payload_bytes in pool:
+                pool.remove(s.payload_bytes)
+            else:
+                unplanned.setdefault(s.primitive, []).append(s)
+        for prim in sorted(unplanned):
+            extra = unplanned[prim]
+            allowed = budget.get(prim, 0)
+            if len(extra) <= allowed:
+                continue
+            for s in extra:
+                out.append(self.finding(
+                    ep, f"unplanned {s.describe()} — not in the comm "
+                        f"plan's {prim} payload list and beyond the "
+                        f"declared budget of {allowed}; an unexplained "
+                        f"resharding in the hot path",
+                    primitive=s.primitive,
+                    payload_bytes=s.payload_bytes,
+                    shape=list(map(int, s.shape)), dtype=s.dtype,
+                    spec=s.spec, budget=allowed))
+        for prim in sorted(planned):
+            left = planned[prim]
+            if left:
+                out.append(self.finding(
+                    ep, f"comm plan schedules {len(left)} {prim} "
+                        f"eqn(s) of {sorted(left)} bytes that the "
+                        f"traced graph never issues — plan/graph "
+                        f"desync",
+                    primitive=prim, missing=len(left),
+                    payloads=sorted(int(x) for x in left)))
         return out

@@ -36,7 +36,7 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
            "validate_fleet_record", "validate_trace_record",
            "validate_memory_record", "validate_numerics_record",
            "validate_run_record", "validate_recovery_record",
-           "validate_profile_record",
+           "validate_profile_record", "validate_sharding_record",
            "validate_telemetry_record", "validate_telemetry_jsonl"]
 
 # v2: ``kind: fleet`` records REQUIRE ``trace_id`` (the fleet-record
@@ -143,9 +143,24 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # meaningless without the block size that produced it.  All four are
 # validated whenever present at any version; required on fresh v12
 # engine-decode lines.
+# v13: the sharding plane.  ``kind: sharding`` records exist (the
+# static replication ledger from ``analysis.sharding``, via
+# ``python -m apex_tpu.analysis --sharding`` and ``bench.py
+# --graph-lint``): per entry point, the shard_map world and mesh axes,
+# the body-operand byte census split into ``unique_bytes`` +
+# ``replicated_bytes`` (world-total duplicate bytes the ZeRO-2/3
+# stages of ROADMAP item 2 exist to delete — on the ZeRO-1 DDP train
+# EPs this names the fully-replicated fp32 master/optimizer state),
+# the per-dtype replicated split, the top replicated arrays with their
+# inferred specs, and the resharding-eqn census.  The arithmetic
+# identity ``unique_bytes + replicated_bytes == world *
+# argument_bytes`` is enforced — a ledger that does not reassemble
+# from its own parts is hand-built, not propagated.  Deterministic
+# like the compiled memory plan, so ``check_bench_trend`` gates
+# ``replicated_bytes`` per entry point on every backend.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v11 streams stay valid.
-SCHEMA_VERSION = 12
+# version, so archived v1..v12 streams stay valid.
+SCHEMA_VERSION = 13
 
 # how a serving engine admits requests and holds KV (stdlib-side
 # duplicate of the serving engines' ``admission_mode`` class attrs —
@@ -1318,6 +1333,161 @@ def validate_memory_record(rec: Any) -> List[str]:
     return errs
 
 
+# -- sharding record schema -------------------------------------------------
+
+def validate_sharding_record(rec: Any) -> List[str]:
+    """Schema check for one ``kind: sharding`` JSONL record (the static
+    replication ledger from ``analysis.sharding.
+    entry_point_sharding_record``, schema v13): the common envelope, a
+    non-empty ``entry_point``, a coherent mesh (``world`` equals the
+    product of ``mesh_axes``), non-negative byte totals with the
+    arithmetic identity ``unique_bytes + replicated_bytes == world *
+    argument_bytes`` (the ledger must reassemble from its own parts),
+    a per-dtype split that sums to ``replicated_bytes``, a
+    ``replicated_fraction`` consistent with the totals, well-formed
+    ``top_replicated`` entries, and a resharding census of
+    non-negative eqn counts."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+
+    def need(key, types):
+        return _need(rec, errs, key, types)
+
+    _check_envelope(rec, errs)
+    if rec.get("kind") != "sharding":
+        errs.append(f"kind must be 'sharding', got {rec.get('kind')!r}")
+    epn = need("entry_point", str)
+    if isinstance(epn, str) and not epn:
+        errs.append("entry_point must be non-empty")
+    src = need("source", str)
+    if isinstance(src, str) and not src:
+        errs.append("source must be non-empty")
+    world = need("world", int)
+    if isinstance(world, int) and not isinstance(world, bool) \
+            and world < 1:
+        errs.append(f"world must be >= 1, got {world}")
+    axes = need("mesh_axes", dict)
+    if isinstance(axes, dict):
+        prod = 1
+        ok = bool(axes)
+        for name, sz in axes.items():
+            if not isinstance(name, str) or not name:
+                errs.append(f"mesh axis names must be non-empty "
+                            f"strings, got {name!r}")
+                ok = False
+            if not isinstance(sz, int) or isinstance(sz, bool) or sz < 1:
+                errs.append(f"mesh_axes[{name!r}] must be an int >= 1, "
+                            f"got {sz!r}")
+                ok = False
+            else:
+                prod *= sz
+        if not axes:
+            errs.append("mesh_axes must be non-empty")
+        if (ok and isinstance(world, int) and not isinstance(world, bool)
+                and prod != world):
+            errs.append(f"world ({world}) != product of mesh_axes "
+                        f"({prod})")
+    sm = need("shard_maps", int)
+    if isinstance(sm, int) and not isinstance(sm, bool) and sm < 1:
+        errs.append(f"shard_maps must be >= 1, got {sm}")
+    parts = {}
+    for key in ("argument_bytes", "unique_bytes", "replicated_bytes"):
+        v = need(key, int)
+        if isinstance(v, int) and not isinstance(v, bool):
+            if v < 0:
+                errs.append(f"{key!r} must be >= 0, got {v}")
+            else:
+                parts[key] = v
+    if (len(parts) == 3 and isinstance(world, int)
+            and not isinstance(world, bool) and world >= 1
+            and parts["unique_bytes"] + parts["replicated_bytes"]
+            != world * parts["argument_bytes"]):
+        errs.append(
+            f"unique_bytes + replicated_bytes "
+            f"({parts['unique_bytes']} + {parts['replicated_bytes']}) "
+            f"!= world * argument_bytes "
+            f"({world} * {parts['argument_bytes']}) — the ledger must "
+            f"reassemble from its own parts")
+    by_dtype = need("replicated_bytes_by_dtype", dict)
+    if isinstance(by_dtype, dict):
+        total = 0
+        ok = True
+        for dt, v in by_dtype.items():
+            if not isinstance(dt, str) or not dt:
+                errs.append(f"replicated_bytes_by_dtype keys must be "
+                            f"non-empty strings, got {dt!r}")
+                ok = False
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"replicated_bytes_by_dtype[{dt!r}] must "
+                            f"be an int >= 0, got {v!r}")
+                ok = False
+            else:
+                total += v
+        if ok and "replicated_bytes" in parts \
+                and total != parts["replicated_bytes"]:
+            errs.append(f"replicated_bytes_by_dtype sums to {total}, "
+                        f"!= replicated_bytes "
+                        f"({parts['replicated_bytes']})")
+    frac = need("replicated_fraction", numbers.Number)
+    if (isinstance(frac, numbers.Number) and not isinstance(frac, bool)
+            and not (0.0 <= frac <= 1.0)):
+        errs.append(f"replicated_fraction must be in [0, 1], got "
+                    f"{frac!r}")
+    if (isinstance(frac, numbers.Number) and not isinstance(frac, bool)
+            and len(parts) == 3 and isinstance(world, int)
+            and not isinstance(world, bool) and world >= 1
+            and parts["argument_bytes"] > 0):
+        expect = (parts["replicated_bytes"]
+                  / (world * parts["argument_bytes"]))
+        if abs(frac - expect) > 1e-9:
+            errs.append(f"replicated_fraction ({frac}) inconsistent "
+                        f"with replicated_bytes / (world * "
+                        f"argument_bytes) ({expect:.6g})")
+    top = need("top_replicated", list)
+    if isinstance(top, list):
+        for i, t in enumerate(top):
+            if not isinstance(t, dict):
+                errs.append(f"top_replicated[{i}] is not an object")
+                continue
+            idx = t.get("index")
+            if not isinstance(idx, int) or isinstance(idx, bool) \
+                    or idx < 0:
+                errs.append(f"top_replicated[{i}].index must be an "
+                            f"int >= 0, got {idx!r}")
+            if not isinstance(t.get("shape"), list):
+                errs.append(f"top_replicated[{i}].shape must be a list")
+            if not isinstance(t.get("dtype"), str) or not t.get("dtype"):
+                errs.append(f"top_replicated[{i}].dtype must be a "
+                            f"non-empty string")
+            lb = t.get("local_bytes")
+            if not isinstance(lb, int) or isinstance(lb, bool) or lb < 0:
+                errs.append(f"top_replicated[{i}].local_bytes must be "
+                            f"an int >= 0, got {lb!r}")
+            rf = t.get("replication_factor")
+            if (not isinstance(rf, numbers.Number)
+                    or isinstance(rf, bool) or not (rf >= 1)):
+                errs.append(f"top_replicated[{i}].replication_factor "
+                            f"must be a number >= 1, got {rf!r}")
+            if not isinstance(t.get("spec"), str) or not t.get("spec"):
+                errs.append(f"top_replicated[{i}].spec must be a "
+                            f"non-empty string")
+    census = need("resharding_eqns", dict)
+    if isinstance(census, dict):
+        for prim, n in census.items():
+            if not isinstance(prim, str) or not prim:
+                errs.append(f"resharding_eqns keys must be non-empty "
+                            f"strings, got {prim!r}")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+                errs.append(f"resharding_eqns[{prim!r}] must be an "
+                            f"int >= 0, got {n!r}")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        errs.append(f"record is not JSON-serializable: {e}")
+    return errs
+
+
 # -- numerics record schema -------------------------------------------------
 
 def validate_numerics_record(rec: Any) -> List[str]:
@@ -2011,7 +2181,10 @@ def validate_telemetry_record(rec: Any) -> List[str]:
     recovery-controller snapshots (``kind: recovery``, from
     ``bench.py --chaos`` / ``RecoveryLog.record``, schema v6) and
     device-timeline attributions (``kind: profile``, from
-    ``bench.py --profile`` / ``/profilez``, schema v8)."""
+    ``bench.py --profile`` / ``/profilez``, schema v8) and static
+    replication ledgers (``kind: sharding``, from
+    ``python -m apex_tpu.analysis --sharding`` / ``bench.py
+    --graph-lint``, schema v13)."""
     if isinstance(rec, dict) and rec.get("kind") in (
             "graph_lint", "graph_lint_summary"):
         return validate_lint_record(rec)
@@ -2029,6 +2202,8 @@ def validate_telemetry_record(rec: Any) -> List[str]:
         return validate_recovery_record(rec)
     if isinstance(rec, dict) and rec.get("kind") == "profile":
         return validate_profile_record(rec)
+    if isinstance(rec, dict) and rec.get("kind") == "sharding":
+        return validate_sharding_record(rec)
     return validate_bench_record(rec)
 
 

@@ -7,10 +7,11 @@ from typing import List, Optional, Tuple
 from . import multiproc
 from .topology import (make_mesh, mesh_info, hierarchical_axis_groups,
                        default_ici_size, auto_comm_topology,
-                       overlap_issue_order)
+                       overlap_issue_order, collective_rank_groups)
 from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, allreduce_comm_plan,
                           plan_collective_expectations,
+                          plan_resharding_expectations,
                           predivide_factors, flat_dist_call,
                           staged_grads, overlap_comm_schedule,
                           overlap_schedule_fields,

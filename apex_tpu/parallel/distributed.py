@@ -54,6 +54,7 @@ from . import topology as _topology
 
 __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
            "allreduce_comm_plan", "plan_collective_expectations",
+           "plan_resharding_expectations",
            "predivide_factors", "flat_dist_call", "staged_grads",
            "overlap_comm_schedule", "overlap_schedule_fields",
            "overlap_collective_expectations", "OVERLAP_MODES"]
@@ -583,6 +584,52 @@ def plan_collective_expectations(plan: List[dict],
     return {"counts": dict(counts),
             "payload_bytes": total + extra_psum_bytes,
             "payload_bytes_by_primitive": dict(by_prim)}
+
+
+def plan_resharding_expectations(plan: List[dict],
+                                 budget: Optional[Dict[str, int]] = None
+                                 ) -> dict:
+    """Fold a comm plan (:func:`allreduce_comm_plan` buckets, or
+    ``overlap_comm_schedule()["buckets"]``) into the ``resharding``
+    expectation the census rule consumes: the exact per-eqn payload
+    list of every *placement-changing* collective the plan issues.
+
+    Unlike :func:`plan_collective_expectations` (which pins totals),
+    the census needs per-eqn payloads so it can match graph eqns one by
+    one and name the unexplained gather.  Per bucket:
+
+    - ``reduce_scatter``: one eqn, the full padded bucket.
+    - ``all_gather``: the in-slice gather-back of the 1/ici shard;
+      under bf16 compression the DCN reduce is itself an all_gather of
+      ``dcn_wire_bytes``, so the bucket contributes two payloads —
+      ``[dcn_wire_bytes, total - dcn_wire_bytes]``.
+
+    ``budget`` declares per-primitive counts of *additional* resharding
+    eqns the entry point is allowed beyond the plan (default: none —
+    any unplanned gather is an error finding)."""
+    planned: Dict[str, List[int]] = {}
+    for b in plan:
+        eqns = b.get("eqns", {})
+        payload = b.get("eqn_payload_bytes", {})
+        for prim in ("all_gather", "all_to_all", "reduce_scatter",
+                     "pgather"):
+            k = int(eqns.get(prim, 0))
+            if not k:
+                continue
+            total = int(payload.get(prim, 0))
+            if prim == "all_gather" and k == 2:
+                dcn = int(b.get("dcn_wire_bytes", 0))
+                pays = [dcn, total - dcn]
+            elif k == 1:
+                pays = [total]
+            else:
+                pays = [total // k] * k
+                pays[0] += total - sum(pays)
+            planned.setdefault(prim, []).extend(pays)
+    exp: Dict[str, Any] = {"planned": planned}
+    if budget:
+        exp["budget"] = {k: int(v) for k, v in budget.items()}
+    return exp
 
 
 def _stamp_stage_labels(records: List[dict], stage: int,

@@ -59,7 +59,7 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "ParallelMLP",
     "ParallelSelfAttention", "VocabParallelEmbedding",
     "vocab_parallel_cross_entropy", "partition_specs",
-    "sharded_optimizer_specs",
+    "local_shape", "sharded_optimizer_specs",
 ]
 
 DEFAULT_AXIS = "model"
@@ -501,13 +501,21 @@ def partition_specs(module: Module, params: Optional[Any] = None,
     return build(module, params)
 
 
-def _local_shape(shape, spec, mesh):
+def local_shape(shape, spec, mesh):
     """Per-device shape of a global array sharded by ``spec`` — via
     NamedSharding, which also rejects non-divisible dims with a clear
-    error instead of silently floor-dividing."""
+    error instead of silently floor-dividing.
+
+    Public because it is the ONE global→local shape rule: the TP entry
+    point derives its shard_map operand shapes through it and the
+    static sharding propagator (``analysis.sharding``) owes its
+    local-bytes accounting to the same arithmetic."""
     from jax.sharding import NamedSharding
     return NamedSharding(mesh, spec if spec is not None else P()
                          ).shard_shape(tuple(shape))
+
+
+_local_shape = local_shape
 
 
 def sharded_optimizer_specs(optimizer, params: Any, param_specs: Any,

@@ -20,7 +20,8 @@ topology-aware device orderings underneath.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,7 +30,7 @@ from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "mesh_info", "hierarchical_axis_groups",
            "default_ici_size", "auto_comm_topology",
-           "overlap_issue_order"]
+           "overlap_issue_order", "collective_rank_groups"]
 
 
 def make_mesh(devices: Optional[list] = None, **axes: int) -> Mesh:
@@ -142,6 +143,66 @@ def overlap_issue_order(n_stages: int) -> List[int]:
     if n < 1:
         raise ValueError(f"need at least one stage, got {n_stages}")
     return list(range(n - 1, -1, -1))
+
+
+def collective_rank_groups(axis_sizes: Dict[str, int],
+                           axes,
+                           axis_index_groups: Optional[Sequence[Sequence[int]]]
+                           = None) -> List[Tuple[int, ...]]:
+    """Flattened-rank participant groups for a collective over named mesh
+    axes.
+
+    ``axis_sizes`` is the mesh shape as an ordered ``{name: size}`` dict
+    (outermost first); ranks are row-major indices into the mesh's device
+    array, so this is the ONE place the jaxpr-level ``axis_index_groups``
+    (positions along a single named axis) are translated into concrete
+    device ranks.  Without explicit groups, each group holds every rank
+    that shares its coordinates on the *unnamed* axes, ordered row-major
+    over the named axes — exactly the set a ``psum``/``all_gather`` over
+    ``axes`` mixes.  With explicit groups (only legal over a single named
+    axis, as in JAX), each listed index tuple is instantiated once per
+    combination of unnamed-axis coordinates, preserving the listed order
+    (gather/scatter position is meaningful).
+
+    The static sharding propagator (``analysis.sharding``) consumes this
+    to model which ranks a collective makes agree."""
+    names = list(axis_sizes)
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(axes)
+    for a in axes:
+        if a not in axis_sizes:
+            raise KeyError(f"axis {a!r} not in mesh axes {names}")
+    strides: Dict[str, int] = {}
+    s = 1
+    for n in reversed(names):
+        strides[n] = s
+        s *= int(axis_sizes[n])
+    other = [n for n in names if n not in axes]
+    other_ranges = [range(int(axis_sizes[n])) for n in other]
+
+    def rank(coords: Dict[str, int]) -> int:
+        return sum(coords[n] * strides[n] for n in names)
+
+    groups: List[Tuple[int, ...]] = []
+    if axis_index_groups is not None:
+        if len(axes) != 1:
+            raise ValueError(
+                "axis_index_groups only apply to a single named axis")
+        ax = axes[0]
+        for combo in itertools.product(*other_ranges):
+            coords = dict(zip(other, combo))
+            for g in axis_index_groups:
+                groups.append(tuple(rank({**coords, ax: int(i)})
+                                    for i in g))
+    else:
+        named_ranges = [range(int(axis_sizes[a])) for a in axes]
+        for combo in itertools.product(*other_ranges):
+            coords = dict(zip(other, combo))
+            groups.append(tuple(
+                rank({**coords, **dict(zip(axes, named))})
+                for named in itertools.product(*named_ranges)))
+    return groups
 
 
 def mesh_info(mesh: Mesh) -> str:

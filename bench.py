@@ -675,6 +675,21 @@ def main():
         print(f"bench --graph-lint: {lint_errors} error(s), "
               f"{summary.get('skipped_entry_points', 0)} skipped "
               f"entry point(s)", file=sys.stderr)
+        # the replication ledger rides the same stream (schema v13):
+        # one kind: sharding record per shard_map-tracing entry point,
+        # so check_bench_trend can ratchet replicated_bytes down as
+        # the ZeRO-2/3 stages land.  Statically derived from the
+        # already-cached traces — no extra compiles.  Serving engines
+        # (no shard_map) and device-count-gated EPs skip via the same
+        # bare-RuntimeError class run_lint honors.
+        for _ep in analysis.select():
+            try:
+                rec = analysis.entry_point_sharding_record(_ep)
+            except RuntimeError as e:
+                if type(e) is not RuntimeError:
+                    raise
+                continue
+            print(json.dumps(JsonlExporter.enrich(rec)), flush=True)
 
     if fleet_n:
         run_fleet_bench()

@@ -54,7 +54,14 @@ within the fleet totals — plus ``tenants_dropped``), fresh
 ``*_tenant_*_goodput`` lines from the two-tenant leg must carry
 ``tenant`` + ``slo_attainment``, and the ``*_tenant_parity`` line
 must carry (and arithmetically match) the token counts its ratio
-came from.  All
+came from.  At schema v13 the sharding plane joins the stream:
+replication-ledger records (``kind: sharding``, from ``python -m
+apex_tpu.analysis --sharding`` or ``bench.py --graph-lint``) are
+validated against the sharding schema (``validate_sharding_record``:
+the mesh must multiply out to the world, the per-dtype duplicate
+split must sum, and the ledger identity ``unique + replicated ==
+world x argument_bytes`` must reassemble — a ledger that cannot
+re-derive its own totals proves nothing about ZeRO).  All
 record families may interleave in one stream.  Usage:
 
     python bench.py | python tests/ci/check_bench_schema.py
@@ -65,6 +72,8 @@ record families may interleave in one stream.  Usage:
     python bench.py --profile | python tests/ci/check_bench_schema.py
     python tests/ci/check_bench_schema.py bench_output.jsonl
     python -m apex_tpu.analysis | python tests/ci/check_bench_schema.py
+    python -m apex_tpu.analysis --sharding \
+        | python tests/ci/check_bench_schema.py
 
 Exit status 0 = every record valid; 1 = any schema violation (each is
 printed).  Stderr chatter must not be piped in — bench keeps stdout

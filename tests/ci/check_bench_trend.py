@@ -92,6 +92,18 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    that declare an older version are exempt (they were valid when
    written).
 
+8. **Sharding-plane regression** (schema v13 ``kind: sharding``
+   records from ``bench.py --graph-lint`` /
+   ``python -m apex_tpu.analysis --sharding``).  The replication
+   ledger's ``replicated_bytes`` is derived statically from the traced
+   jaxpr — deterministic on every backend, exactly like
+   ``peak_bytes`` — so growth past ``--mem-tol`` gates per
+   (entry_point, backend) even on CPU smoke: a train step that
+   suddenly duplicates more world bytes un-sharded something (a ZeRO
+   shard silently re-replicated, an optimizer state that stopped
+   partitioning).  Shrinkage is the ROADMAP item 2 direction and never
+   gates.  Stale replays are partitioned out like everything else.
+
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
@@ -255,6 +267,9 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # (metric, backend) -> (round_name, kv_waste_bytes) of the
     # KV-plane trend (schema v12)
     last_waste = {}
+    # (entry_point, backend) -> (round_name, replicated_bytes) of the
+    # replication-ledger trend (schema v13)
+    last_repl = {}
     earlier_lines = set()
     n_fresh = n_stale = 0
 
@@ -472,6 +487,51 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             else:
                 errors.append(msg)
 
+    def track_sharding_fields(rname, rec):
+        """Replication-ledger gate for one fresh ``kind: sharding``
+        record (schema v13).  ``replicated_bytes`` is statically
+        derived from the traced jaxpr — deterministic on every
+        backend, the peak_bytes rule, not the MFU rule — so growth
+        past ``--mem-tol`` gates per (entry_point, backend)
+        everywhere; shrinkage is the ZeRO direction and never
+        gates."""
+        subject = rec.get("entry_point")
+        if not isinstance(subject, str) or not subject:
+            return
+        repl = rec.get("replicated_bytes")
+        if (not isinstance(repl, (int, float)) or isinstance(repl, bool)
+                or repl < 0):
+            return
+        key = (subject, rec.get("backend"))
+        prev = last_repl.get(key)
+        last_repl[key] = (rname, float(repl))
+        if prev is None:
+            return
+        pname, pval = prev
+        if pval <= 0:
+            # nothing replicated is the fully-sharded success state;
+            # duplicate bytes returning from 0 is the regression the
+            # ledger exists to catch
+            if repl > 0:
+                errors.append(
+                    f"{rname}: {subject} "
+                    f"[{rec.get('backend') or '?'}] replicated_bytes "
+                    f"returned from a zero baseline to {repl:,.0f} vs "
+                    f"{pname} — something un-sharded (the ledger is "
+                    f"static, so this is a real graph change)")
+            return
+        growth = (repl - pval) / pval
+        if growth > mem_tol:
+            errors.append(
+                f"{rname}: {subject} "
+                f"[{rec.get('backend') or '?'}] replicated_bytes grew "
+                f"{growth * 100:.0f}% vs {pname} ({pval:,.0f} -> "
+                f"{repl:,.0f} bytes, mem-tol {mem_tol * 100:.0f}%) — "
+                f"more world bytes are duplicate copies (a ZeRO shard "
+                f"re-replicated, or optimizer state stopped "
+                f"partitioning); the ledger is deterministic, so this "
+                f"gates on every backend")
+
     def track_kv_fields(rname, rec):
         """KV-plane gates for one fresh metric line (schema v12).
         Two halves: the ``kv_waste_bytes`` trend (lower is better —
@@ -560,6 +620,14 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
                     n_stale += 1
                 elif "error" not in rec:
                     track_cost_fields(rname, rec)
+                continue
+            # ``kind: sharding`` records carry the replication-ledger
+            # trend (schema v13); stale replays stay out as ever
+            if isinstance(rec, dict) and rec.get("kind") == "sharding":
+                if is_stale(rec):
+                    n_stale += 1
+                elif "error" not in rec:
+                    track_sharding_fields(rname, rec)
                 continue
             # ``kind: numerics`` records (gradient-health dumps from
             # bench --numerics) describe one run's numerics, not a

@@ -7,10 +7,17 @@ to learn: no host syncs in jitted hot graphs, every donated KV buffer
 actually aliased (and the per-slot length vectors NEVER donated — the
 PR 2 compile-cache corruption), conv/matmul operand dtypes matching
 the O-level policy, transpose-free channels-last steps, and the exact
-collective pattern DDP/TP assume.  Usage:
+collective pattern DDP/TP assume — plus, since the sharding plane
+landed, shard_map specs consistent with their mesh (and every
+replicated-out-spec divergence declared) and every placement-changing
+collective explained by the comm plan or a declared budget
+(resharding census).  New rules registered in apex_tpu.analysis.rules
+are picked up here automatically: this gate runs the full RULES
+registry via the module CLI.  Usage:
 
     python tests/ci/graph_lint.py                      # full registry
     python tests/ci/graph_lint.py --tags serving       # subset
+    python tests/ci/graph_lint.py --entry paged        # substring
     python tests/ci/graph_lint.py | \\
         python tests/ci/check_bench_schema.py          # schema-check it
 

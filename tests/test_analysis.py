@@ -874,6 +874,251 @@ def test_comm_plan_matches_traced_buckets():
         allreduce_comm_plan(grads, trigger_paths={"nope/typo"})
 
 
+# -- sharding rule (spec consistency + replication budget) ----------------
+
+def _sharded_trace(fn, in_specs, out_specs, shape=(1024,), world=8):
+    mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    return lambda: jax.make_jaxpr(mapped)(jnp.ones(shape))
+
+
+def test_sharding_rule_flags_divergent_output_claim_both_ways():
+    """check_vma=False (how every train entry point runs) means NOTHING
+    at runtime verifies a replicated out-spec over a still-varying
+    value — one replica's answer silently wins.  The propagator must
+    flag the claim; the declared count must ratchet both directions."""
+    varying = _sharded_trace(lambda x: x * 2.0, (P("data"),), P())
+
+    over = _ep("mutant_divergent_out",
+               expect={"sharding": {"mesh_axes": {"data": 8},
+                                    "divergent_outputs": 0}},
+               trace=varying)
+    found = _run(over, "sharding")
+    assert len(found) == 1
+    assert "more agreement than the propagated" in found[0].message
+    assert (found[0].detail["divergent"],
+            found[0].detail["declared"]) == (1, 0)
+
+    # the honest declaration (the non-synced BatchNorm-stats class)
+    declared = _ep("fixed_divergent_out",
+                   expect={"sharding": {"mesh_axes": {"data": 8},
+                                        "divergent_outputs": 1}},
+                   trace=varying)
+    assert _run(declared, "sharding") == []
+
+    # ...and a stale over-declaration must ratchet DOWN, not linger
+    synced = _sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                            (P("data"),), P())
+    stale = _ep("mutant_stale_declaration",
+                expect={"sharding": {"mesh_axes": {"data": 8},
+                                     "divergent_outputs": 1}},
+                trace=synced)
+    found = _run(stale, "sharding")
+    assert len(found) == 1
+    assert "ratchet divergent_outputs down" in found[0].message
+
+
+def test_sharding_rule_flags_mesh_mismatch_and_vacuity():
+    trace = _sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                           (P("data"),), P())
+    wrong_mesh = _ep("mutant_wrong_mesh",
+                     expect={"sharding": {"mesh_axes": {"data": 4},
+                                          "divergent_outputs": 0}},
+                     trace=trace)
+    found = _run(wrong_mesh, "sharding")
+    assert found and any("mesh" in f.message for f in found)
+
+    # an expectation over a shard_map-free graph cannot pass silently
+    vacuous = _ep("mutant_shardless",
+                  expect={"sharding": {"mesh_axes": {"data": 8}}},
+                  trace=lambda: jax.make_jaxpr(lambda x: x * 2.0)(
+                      jnp.ones((8,))))
+    found = _run(vacuous, "sharding")
+    assert len(found) == 1 and "no shard_map" in found[0].message
+
+
+def test_sharding_rule_flags_over_budget_replication():
+    """The ZeRO ratchet: declare max_replicated_bytes below what the
+    graph actually replicates and the ledger must flag, naming the
+    largest contributor — the number a ZeRO-2 shard of optimizer state
+    is supposed to shrink."""
+    # replicated (P()) operand of 4 KB on the 8-way mesh: 7 duplicate
+    # copies = 28672 world-total duplicate bytes
+    trace = _sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                           (P(),), P())
+    over = _ep("mutant_replication_budget",
+               expect={"sharding": {"mesh_axes": {"data": 8},
+                                    "divergent_outputs": 0,
+                                    "max_replicated_bytes": 1000}},
+               trace=trace)
+    found = _run(over, "sharding")
+    assert len(found) == 1
+    assert found[0].detail["replicated_bytes"] == 7 * 1024 * 4
+    assert "largest contributor" in found[0].message
+
+    within = _ep("fixed_replication_budget",
+                 expect={"sharding": {"mesh_axes": {"data": 8},
+                                      "divergent_outputs": 0,
+                                      "max_replicated_bytes":
+                                      7 * 1024 * 4}},
+                 trace=trace)
+    assert _run(within, "sharding") == []
+
+
+# -- resharding-census rule -----------------------------------------------
+
+def test_resharding_census_flags_unplanned_all_gather():
+    """The tentpole's seeded mutation: a full all-gather smuggled in
+    AFTER the honest hierarchical chain.  The psum census is identical
+    to the planned graph — only matching each placement-changing eqn
+    against the comm plan's per-eqn payload list catches it, and the
+    finding must name the operand."""
+    from apex_tpu import parallel
+    mesh, ici_groups, dcn_groups = _hier_setup()
+    n = 1024
+
+    def honest(x):
+        return parallel.allreduce_grads_tree(
+            {"w": x}, "data", comm_topology="hierarchical", ici_size=4,
+            gradient_average=False)["w"]
+
+    def sneaky(x):
+        y = honest(x)
+        # the smuggled reshard: "XLA silently replicated my shard"
+        g = jax.lax.all_gather(y, "data", tiled=True)
+        return y + g[:n]
+
+    plan = parallel.allreduce_comm_plan(
+        {"w": jnp.zeros((n,), jnp.float32)},
+        comm_topology="hierarchical", ici_size=4, world=8)
+    expect = parallel.plan_resharding_expectations(plan)
+
+    def _trace(fn):
+        mapped = jax.shard_map(fn, mesh=mesh, in_specs=(P(),),
+                               out_specs=P(), check_vma=False)
+        return lambda: jax.make_jaxpr(mapped)(jnp.ones((n,)))
+
+    broken = _ep("mutant_unplanned_gather",
+                 expect={"resharding": dict(expect)},
+                 trace=_trace(sneaky))
+    found = _run(broken, "resharding-census")
+    assert len(found) == 1, found
+    assert found[0].detail["primitive"] == "all_gather"
+    assert "unplanned" in found[0].message
+    assert found[0].detail["payload_bytes"] == n * 4
+
+    fixed = _ep("fixed_planned_chain",
+                expect={"resharding": dict(expect)},
+                trace=_trace(honest))
+    assert _run(fixed, "resharding-census") == []
+
+    # a declared budget absorbs exactly that many unplanned eqns --
+    # the paved path for an intentionally-unplanned reshard
+    budgeted = _ep("fixed_budgeted_gather",
+                   expect={"resharding": dict(
+                       expect, budget={"all_gather": 1})},
+                   trace=_trace(sneaky))
+    assert _run(budgeted, "resharding-census") == []
+
+
+def test_resharding_census_flags_plan_graph_desync():
+    """The other direction: the plan schedules a chain the graph never
+    issues (flat allreduce traced under hierarchical expectations) —
+    a plan/graph desync, not a silent pass."""
+    from apex_tpu import parallel
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    n = 1024
+
+    plan = parallel.allreduce_comm_plan(
+        {"w": jnp.zeros((n,), jnp.float32)},
+        comm_topology="hierarchical", ici_size=4, world=8)
+    expect = parallel.plan_resharding_expectations(plan)
+
+    flat = jax.shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                         in_specs=(P(),), out_specs=P(),
+                         check_vma=False)
+    broken = _ep("mutant_plan_desync",
+                 expect={"resharding": dict(expect)},
+                 trace=lambda: jax.make_jaxpr(flat)(jnp.ones((n,))))
+    found = _run(broken, "resharding-census")
+    assert found and all("never issues" in f.message for f in found)
+    assert {f.detail["primitive"] for f in found} == \
+        {"reduce_scatter", "all_gather"}
+
+    # vacuity: a resharding expectation over a shard_map-free graph
+    vacuous = _ep("mutant_resharding_shardless",
+                  expect={"resharding": dict(expect)},
+                  trace=lambda: jax.make_jaxpr(lambda x: x + 1.0)(
+                      jnp.ones((4,))))
+    found = _run(vacuous, "resharding-census")
+    assert len(found) == 1 and "no shard_map" in found[0].message
+
+
+# -- the replication ledger over real entry points ------------------------
+
+def test_sharding_ledger_reports_replicated_optimizer_state():
+    """The acceptance number: on the ZeRO-1 DDP train step the ledger
+    must statically report the fp32 master/optimizer state as fully
+    replicated (factor 8 on the 8-way mesh, ~7/8 of world bytes
+    duplicated), and its argument accounting must agree byte-for-byte
+    with the memory plane's jaxpr walk — same graph, two lenses."""
+    from apex_tpu.observability import memory as obsmem
+    ep = analysis.get("ddp_resnet18_o2")
+    rec = analysis.entry_point_sharding_record(ep)
+    assert rec["kind"] == "sharding" and rec["world"] == 8
+    assert rec["mesh_axes"] == {"data": 8}
+
+    # cross-check against the memory plane on the same jaxpr
+    live = obsmem.jaxpr_live_bytes(ep.graph().jaxpr)
+    assert rec["argument_bytes"] == live["argument_bytes"]
+    # the ledger identity: every byte is unique or duplicate
+    assert rec["unique_bytes"] + rec["replicated_bytes"] == \
+        rec["world"] * rec["argument_bytes"]
+
+    # ZeRO-1 DDP: params + fp32 master + both Adam moments all ride
+    # every rank -- factor 8, and fp32 dominates the duplicate bytes
+    assert rec["replicated_fraction"] > 0.80
+    f32 = rec["replicated_bytes_by_dtype"]["float32"]
+    assert f32 > 0.8 * rec["replicated_bytes"]
+    assert rec["top_replicated"], "ledger must name the arrays"
+    for t in rec["top_replicated"]:
+        assert t["replication_factor"] == 8
+        assert t["spec"] == "replicated"
+    # fp32 master + m + v: three full fp32 copies of the parameters
+    # (~2.6x the mixed-precision compute params) — for resnet18 that
+    # is ~0.94 GB of world-total duplicate fp32 under ZeRO-1
+    assert 0.8e9 < f32 < 1.1e9
+
+
+def test_sharding_ledger_zero2_sharded_state_is_not_replicated():
+    """The contrast the ledger exists to draw: shard the same bytes
+    with a spec that actually partitions ('data',) and the duplicate
+    count drops to zero — the ZeRO-2/3 direction ROADMAP item 2 will
+    ratchet with max_replicated_bytes."""
+    repl = _ep("ledger_replicated",
+               trace=_sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                                    (P(),), P()))
+    shard = _ep("ledger_sharded",
+                trace=_sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                                     (P("data"),), P()))
+    r = analysis.entry_point_sharding_record(repl)
+    s = analysis.entry_point_sharding_record(shard)
+    assert r["replicated_bytes"] == 7 * 1024 * 4
+    assert r["replicated_fraction"] == pytest.approx(7 / 8)
+    assert s["replicated_bytes"] == 0
+    assert s["unique_bytes"] == 8 * s["argument_bytes"]
+
+    # a shard_map-free entry point raises the bare-RuntimeError skip
+    # class the CLI and bench use to exempt single-device graphs
+    bare = _ep("ledger_no_shardmap",
+               trace=lambda: jax.make_jaxpr(lambda x: x + 1.0)(
+                   jnp.ones((4,))))
+    with pytest.raises(RuntimeError, match="no shard_map") as ei:
+        analysis.entry_point_sharding_record(bare)
+    assert type(ei.value) is RuntimeError
+
+
 # -- findings as JSONL: schema + exporters integration --------------------
 
 def _enriched(finding):
@@ -1006,11 +1251,57 @@ def test_memory_record_schema_and_dispatch():
     assert len(errs) == 1 and "line 2" in errs[0]
 
 
+def test_sharding_record_schema_and_dispatch():
+    """``kind: sharding`` record contract (schema v13): the ledger
+    identity must reassemble, the fraction must be consistent, and the
+    telemetry dispatcher grows bench|lint|fleet|trace|memory|sharding."""
+    import json
+    good = exporters.JsonlExporter.enrich({
+        "kind": "sharding", "entry_point": "ddp_x", "source": "jaxpr",
+        "world": 8, "mesh_axes": {"data": 8}, "shard_maps": 1,
+        "argument_bytes": 1000, "unique_bytes": 1000,
+        "replicated_bytes": 7000,
+        "replicated_bytes_by_dtype": {"float32": 7000},
+        "replicated_fraction": 0.875,
+        "top_replicated": [{"index": 0, "shape": [250],
+                            "dtype": "float32", "local_bytes": 1000,
+                            "replication_factor": 8, "spec": "P()"}],
+        "resharding_eqns": {}})
+    assert exporters.validate_sharding_record(good) == []
+    # kind-dispatched, not bench-shaped
+    assert exporters.validate_telemetry_record(good) == []
+    # the ledger identity: unique + replicated == world x argument
+    assert any("reassemble" in e for e in
+               exporters.validate_sharding_record(
+                   dict(good, unique_bytes=900)))
+    # the fraction must agree with its own numerator/denominator
+    assert any("replicated_fraction" in e for e in
+               exporters.validate_sharding_record(
+                   dict(good, replicated_fraction=0.5)))
+    # mesh must multiply out to the world
+    assert any("mesh_axes" in e for e in
+               exporters.validate_sharding_record(
+                   dict(good, mesh_axes={"data": 4})))
+    # per-dtype split must sum to the total
+    assert any("replicated_bytes_by_dtype" in e for e in
+               exporters.validate_sharding_record(
+                   dict(good,
+                        replicated_bytes_by_dtype={"float32": 1})))
+    # positionally caught in a mixed stream next to a bench record
+    bench = exporters.JsonlExporter.enrich(
+        {"metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+         "ndev": 8, "arch": "cpu"})
+    errs = exporters.validate_telemetry_jsonl(
+        [json.dumps(bench), json.dumps(dict(good, world=0))])
+    assert len(errs) >= 1 and all("line 2" in e for e in errs)
+
+
 def test_findings_to_records_and_registry_surface():
     assert set(analysis.RULES) == {"host-transfer", "donation",
                                    "amp-dtype", "layout", "collective",
                                    "flop-accounting", "memory-budget",
-                                   "numerics", "supervisor"}
+                                   "numerics", "supervisor",
+                                   "sharding", "resharding-census"}
     for name in ("ddp_resnet18_o2", "engine_step_k", "seq2seq_step_k",
                  "tp_mlp_train_step", "ddp_resnet18_o2_numerics",
                  "ddp_resnet18_o2_numerics_off",
@@ -1084,6 +1375,55 @@ def test_cli_memory_flag(capsys):
     assert rec["entry_point"] == "engine_prefill_slot"
     assert rec["flops"] > 0 and rec["peak_bytes"] > 0
     assert rec["alias_bytes"] > 0             # donation plan visible
+
+
+def test_cli_entry_and_rule_filters(capsys):
+    """`--entry`/`--rule` substring filters (satellite): --list honors
+    both, a filtered run emits schema-valid JSONL with the filtered
+    rule set only, and an unmatched filter exits 2 like any other
+    selection error."""
+    import json
+    from apex_tpu.analysis.__main__ import main
+    assert main(["--list", "--entry", "engine", "--rule", "shard"]) == 0
+    out = capsys.readouterr().out
+    assert "engine_step_k" in out and "ddp_resnet18_o2" not in out
+    rules_line = [ln for ln in out.splitlines()
+                  if ln.startswith("rules:")][0]
+    assert rules_line == "rules: resharding-census, sharding"
+
+    # a filtered run is still pure schema-valid JSONL with the usual
+    # summary envelope, now over the narrowed cross product
+    assert main(["--entry", "engine_prefill", "--rule", "donat"]) == 0
+    out = capsys.readouterr().out
+    assert exporters.validate_telemetry_jsonl(out.splitlines()) == []
+    last = json.loads(out.strip().splitlines()[-1])
+    assert last["kind"] == "graph_lint_summary"
+    assert (last["entry_points"], last["rules"]) == (1, 1)
+
+    assert main(["--entry", "zzz_no_such"]) == 2
+    assert main(["--rule", "zzz_no_such"]) == 2
+
+
+def test_cli_sharding_flag(capsys):
+    """`python -m apex_tpu.analysis --sharding`: one `kind: sharding`
+    record per entry point, schema-valid at v13, serving engines
+    skipped via the bare-RuntimeError gate rather than failing."""
+    import json
+    from apex_tpu.analysis.__main__ import main
+    assert main(["--sharding", "--entry", "ddp_mlp_overlap_flat"]) == 0
+    out = capsys.readouterr().out
+    assert exporters.validate_telemetry_jsonl(out.splitlines()) == []
+    (rec,) = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert rec["kind"] == "sharding"
+    assert rec["schema_version"] == exporters.SCHEMA_VERSION
+    assert rec["entry_point"] == "ddp_mlp_overlap_flat"
+    assert rec["world"] == 8 and rec["replicated_bytes"] > 0
+
+    # a shard_map-free serving engine is a skip, not a failure
+    assert main(["--sharding", "--entry", "engine_prefill_slot"]) == 0
+    captured = capsys.readouterr()
+    assert captured.out.strip() == ""
+    assert "skipped" in captured.err
 
 
 def test_cli_exit_nonzero_on_finding(monkeypatch):

@@ -2432,6 +2432,98 @@ def test_check_bench_trend_kv_gate(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+def _ledger_rec(entry_point="ddp_resnet18_o2", repl=7000, **kw):
+    """A schema-complete v13 replication-ledger record (what bench.py
+    --graph-lint and the --sharding CLI emit)."""
+    arg = 1000
+    return exporters.JsonlExporter.enrich({
+        "kind": "sharding", "entry_point": entry_point,
+        "source": "jaxpr", "world": 8, "mesh_axes": {"data": 8},
+        "shard_maps": 1, "argument_bytes": arg,
+        "unique_bytes": 8 * arg - repl, "replicated_bytes": repl,
+        "replicated_bytes_by_dtype": {"float32": repl} if repl else {},
+        "replicated_fraction": repl / (8 * arg),
+        "top_replicated": [], "resharding_eqns": {}, **kw})
+
+
+def test_v13_sharding_records_and_version_gating():
+    """Schema v13 (the sharding plane): ``kind: sharding`` records
+    dispatch to their own validator, the ledger identity must
+    reassemble, and archived streams declaring v1..v12 — which never
+    carry the kind — re-validate clean at their declared versions."""
+    assert exporters.SCHEMA_VERSION == 13
+    good = _ledger_rec()
+    assert exporters.validate_sharding_record(good) == []
+    assert exporters.validate_telemetry_record(good) == []
+    # the identity every record must satisfy:
+    # unique + replicated == world * argument
+    assert any("reassemble" in e for e in
+               exporters.validate_sharding_record(
+                   dict(good, replicated_bytes=6999,
+                        replicated_bytes_by_dtype={"float32": 6999})))
+    # archived pre-v13 records of every enveloped kind stay valid at
+    # their declared version after the bump
+    old_kinds = [
+        exporters.JsonlExporter.enrich(
+            {"metric": "m", "value": 1.0, "unit": "x",
+             "backend": "cpu", "ndev": 8, "arch": "cpu"}),
+        exporters.JsonlExporter.enrich(
+            {"kind": "graph_lint", "rule": "donation",
+             "severity": "error", "entry_point": "e", "message": "m"}),
+    ]
+    for rec in old_kinds:
+        for v in range(1, 13):
+            archived = dict(rec, schema_version=v)
+            assert exporters.validate_telemetry_record(archived) == [], v
+
+
+def test_check_bench_trend_sharding_gate(tmp_path):
+    """The replication-ledger trend gate (schema v13): duplicate-bytes
+    growth past --mem-tol gates on EVERY backend (the ledger is
+    statically derived, the peak_bytes rule), a zero baseline
+    returning to nonzero is the un-sharded signature, shrinkage (the
+    ZeRO direction) is clean, and stale replays partition out."""
+    # growth past mem-tol on CPU smoke still errors — no noise excuse
+    d1 = tmp_path / "sh1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json",
+                 [_ledger_rec(repl=7000, backend="cpu")])
+    _trend_round(d1, "BENCH_r02.json",
+                 [_ledger_rec(repl=7900, backend="cpu")])  # +13%
+    r = _run_trend(["--dir", str(d1)])
+    assert r.returncode == 0, r.stderr          # within default 25%
+    r = _run_trend(["--dir", str(d1), "--mem-tol", "0.1"])
+    assert r.returncode == 1
+    assert "replicated_bytes" in r.stderr
+    # shrinking the duplicate bytes (a ZeRO shard landing) is clean
+    d2 = tmp_path / "sh2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [_ledger_rec(repl=7000)])
+    _trend_round(d2, "BENCH_r02.json", [_ledger_rec(repl=1000)])
+    r = _run_trend(["--dir", str(d2), "--mem-tol", "0.1"])
+    assert r.returncode == 0, r.stderr
+    # a fully-sharded (zero) baseline returning to replication gates
+    d3 = tmp_path / "sh3"
+    d3.mkdir()
+    _trend_round(d3, "BENCH_r01.json", [_ledger_rec(repl=0)])
+    _trend_round(d3, "BENCH_r02.json", [_ledger_rec(repl=2048)])
+    r = _run_trend(["--dir", str(d3)])
+    assert r.returncode == 1
+    assert "zero baseline" in r.stderr
+    # distinct entry points trend independently; a stale replay with
+    # inflated bytes never enters the trend
+    d4 = tmp_path / "sh4"
+    d4.mkdir()
+    _trend_round(d4, "BENCH_r01.json",
+                 [_ledger_rec("ep_a", 7000), _ledger_rec("ep_b", 100)])
+    _trend_round(d4, "BENCH_r02.json",
+                 [_ledger_rec("ep_a", 7000),
+                  dict(_ledger_rec("ep_b", 999999), stale=True)])
+    r = _run_trend(["--dir", str(d4), "--mem-tol", "0.01"])
+    assert r.returncode == 0, r.stderr
+    assert "stale replays partitioned" in r.stderr
+
+
 def test_check_bench_trend_skips_twin_anomaly_overlap_records(tmp_path):
     """A record whose attribution flagged its own compute twin as
     slower than the step (compute_twin_excess_ms > 0) carries CLAMPED
