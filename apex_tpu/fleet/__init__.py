@@ -31,9 +31,16 @@ sibling of ``apex.parallel.DistributedDataParallel``'s replica model:
   episode/action bookkeeping;
 - autoscale (autoscale.py, PR 11): the loop's serving side —
   :class:`SloController` reads the SLO tracker's per-tick deltas and
-  actuates the admission bound, decode windows, drain/undrain and the
-  breaker's cooldowns with hysteresis and bounded actuation
-  (``tests/ci/chaos_smoke.py`` gates the no-oscillation contract).
+  actuates the admission bound (per CLASS under a multi-class QoS
+  policy), decode windows, drain/undrain and the breaker's cooldowns
+  with hysteresis and bounded actuation (``tests/ci/chaos_smoke.py``
+  gates the no-oscillation contract);
+- QoS (qos.py, PR 19): :class:`QosPolicy` (priority classes: weight,
+  default deadline, queue share, preemptibility, tenant->class map)
+  and :class:`WfqQueue` — the deterministic stride-scheduled pending
+  queue replacing FIFO admission, plus the fleet-side decode
+  preemption it enables (evict a low class mid-decode, re-queue from
+  the prompt, exactness intact).
 
 Attach the live introspection server with one call
 (``apex_tpu.observability.server.serve(fleet=fleet)``): ``/statusz``
@@ -53,9 +60,11 @@ from .recovery import (RECOVERY_ACTION_KINDS, RECOVERY_CAUSES,
                        PreemptionGuard, RecoveryError, RecoveryLog,
                        reshard_flat_state)
 from .autoscale import AutoscaleConfig, SloController
-from . import slo
+from .qos import QosClass, QosPolicy, WfqQueue
+from . import qos, slo
 
 __all__ = ["Fleet", "FleetOverloaded", "RetryPolicy", "RoundRobin",
+           "QosClass", "QosPolicy", "WfqQueue", "qos",
            "LeastLoaded", "PrefixAffinity", "make_policy",
            "HealthConfig", "ReplicaHealth", "Ewma", "HEALTHY",
            "DEGRADED", "DEAD", "DRAINING", "DRAINED", "STATE_CODES",

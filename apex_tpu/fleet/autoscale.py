@@ -156,6 +156,18 @@ class SloController:
                                clock=self._clock, ring=ring,
                                registry=registry)
         self.base_max_queue = int(fleet.max_queue)
+        # per-class actuation (PR 19): with a multi-class QosPolicy on
+        # the fleet, admission tightens PER CLASS — the batch class's
+        # queue_share halves while the interactive class's quota is
+        # never touched.  Baseline shares snapshot here so relax can
+        # restore them exactly (including a None = unbounded share).
+        self._qos = getattr(fleet, "qos", None)
+        self._qos_active = bool(getattr(fleet, "_qos_active", False)
+                                and self._qos is not None)
+        self._base_shares = (
+            {name: c.queue_share
+             for name, c in self._qos.classes.items()}
+            if self._qos_active else {})
         # replicas' combined slot capacity — the backlog signal's
         # yardstick (replicas without a slots attribute count as 1)
         self.total_slots = sum(int(getattr(r, "slots", 1))
@@ -234,10 +246,58 @@ class SloController:
         return [(i, self.fleet.replicas[i])
                 for i in sorted(self._base_windows)]
 
+    def _class_cap(self, name: str) -> int:
+        return self._qos.cap(name, self.fleet.max_queue)
+
+    def _act_class_tighten(self, reason: str) \
+            -> Optional[Dict[str, Any]]:
+        """Halve the queue quota of the LOWEST-priority class that
+        still has room to give, never the top class: shedding lands on
+        the batch tier while the interactive tier's admission is
+        untouched — the per-class knob ROADMAP item 4 asked for."""
+        names = list(self._qos.classes)
+        for name in reversed(names[1:]):    # lowest priority first;
+            cap = self._class_cap(name)     # rank 0 is never tightened
+            if cap > 1:
+                new_cap = max(1, cap // 2)
+                cls = self._qos.classes[name]
+                cls.queue_share = new_cap / self.fleet.max_queue
+                return self.log.action("class_admission_tighten",
+                                       qos_class=name,
+                                       queue_cap_from=cap,
+                                       queue_cap_to=new_cap,
+                                       reason=reason)
+        return None
+
+    def _act_class_relax(self) -> Optional[Dict[str, Any]]:
+        """Restore one notch of a tightened class quota toward its
+        baseline share (lowest-priority classes first — they were
+        tightened first)."""
+        names = list(self._qos.classes)
+        for name in reversed(names[1:]):
+            base_share = self._base_shares.get(name)
+            base_cap = (self.fleet.max_queue if base_share is None
+                        else max(1, int(base_share
+                                        * self.fleet.max_queue)))
+            cap = self._class_cap(name)
+            if cap < base_cap:
+                new_cap = min(base_cap, cap * 2)
+                cls = self._qos.classes[name]
+                cls.queue_share = (base_share if new_cap == base_cap
+                                   else new_cap / self.fleet.max_queue)
+                return self.log.action("class_admission_relax",
+                                       qos_class=name,
+                                       queue_cap_from=cap,
+                                       queue_cap_to=new_cap)
+        return None
+
     def _act_overload(self, reason: str) -> Optional[Dict[str, Any]]:
         """One actuation per tick, in fixed priority order: capacity
         back first (undrain, fast-probe a broken breaker), then load
-        shedding (tighten admission), then latency (shrink windows)."""
+        shedding (tighten admission — per CLASS when the fleet runs a
+        multi-class QoS policy, so the batch tier sheds and the
+        interactive tier is untouched), then latency (shrink
+        windows)."""
         fl, cfg = self.fleet, self.config
         for i, h in enumerate(fl.health):
             if h.drained:
@@ -252,7 +312,15 @@ class SloController:
                 return self.log.action(
                     "cooldown_shorten", replica=i,
                     remaining=cfg.probe_cooldown_steps, reason=reason)
-        if fl.max_queue > cfg.min_queue:
+        if self._qos_active:
+            # per-class shed: the global max_queue (and with it the
+            # interactive class's quota) is deliberately NOT touched —
+            # when every lower class is already at cap 1 the next
+            # lever is latency (windows), not interactive admission
+            act = self._act_class_tighten(reason)
+            if act is not None:
+                return act
+        elif fl.max_queue > cfg.min_queue:
             new = max(cfg.min_queue, fl.max_queue // 2)
             old, fl.max_queue = fl.max_queue, new
             return self.log.action("admission_tighten",
@@ -272,6 +340,10 @@ class SloController:
     def _act_relax(self) -> Optional[Dict[str, Any]]:
         """Undo one notch of tightening after sustained health."""
         fl, cfg = self.fleet, self.config
+        if self._qos_active:
+            act = self._act_class_relax()
+            if act is not None:
+                return act
         if fl.max_queue < self.base_max_queue:
             new = min(self.base_max_queue, fl.max_queue * 2)
             old, fl.max_queue = fl.max_queue, new
@@ -370,6 +442,10 @@ class SloController:
                     self.log.max_actions_in_episode,
                 "max_queue": self.fleet.max_queue,
                 "base_max_queue": self.base_max_queue,
+                **({"class_queue_caps":
+                    {name: self._class_cap(name)
+                     for name in self._qos.classes}}
+                   if self._qos_active else {}),
                 "healthy_ticks": self._healthy_ticks,
                 "last_signal": dict(self.last_signal),
                 "fleet_mttr": self.fleet.mttr()}

@@ -69,6 +69,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..observability import MetricsRegistry, flightrec, tracing
 from .health import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                      STATE_CODES, HealthConfig, ReplicaHealth)
+from .qos import QosPolicy, WfqQueue
 from .router import FleetOverloaded, RetryPolicy, make_policy
 from .slo import SloTracker
 
@@ -77,7 +78,8 @@ __all__ = ["Fleet"]
 
 class _FleetRequest:
     def __init__(self, rid, prompt, max_new, eos, seed, temperature,
-                 deadline_at, tenant=None, priority=None):
+                 deadline_at, tenant=None, priority=None,
+                 qos_class=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new = max_new
@@ -91,6 +93,11 @@ class _FleetRequest:
         # past the cardinality cap
         self.tenant = tenant
         self.priority = priority
+        # resolved priority class (QosPolicy.resolve at submit): the
+        # WfqQueue keys its per-class FIFOs on this, and preemption
+        # direction compares class RANKS, never the raw priority tag
+        self.qos_class = qos_class
+        self.preemptions = 0                # times evicted mid-decode
         self.assigned: Optional[Tuple[int, int]] = None  # (replica, rrid)
         self.attempts = 0                   # failed dispatches + failovers
         self.next_attempt_step = 0
@@ -131,7 +138,8 @@ class Fleet:
                  step_workers: Optional[int] = None,
                  ring=None,
                  trace: bool = True,
-                 flight_dump_path: Optional[str] = None):
+                 flight_dump_path: Optional[str] = None,
+                 qos: Optional[QosPolicy] = None):
         if not replicas:
             raise ValueError("Fleet needs at least one replica")
         if max_queue < 1:
@@ -184,7 +192,16 @@ class Fleet:
                              f"{step_workers}")
         self.step_workers = step_workers
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._pending: List[_FleetRequest] = []
+        # QoS plane (PR 19): the pending queue is a WfqQueue — under
+        # the default single-class policy its order IS submission
+        # order (exact FIFO), so a policy-less fleet behaves
+        # byte-for-byte as before; with a multi-class policy it
+        # stride-schedules across per-class FIFOs.  ``_qos_active``
+        # gates the class stamp on spans/events so untagged fleets
+        # keep their pre-QoS event shapes.
+        self.qos = qos if qos is not None else QosPolicy.single()
+        self._qos_active = len(self.qos.classes) > 1
+        self._pending: WfqQueue = WfqQueue(self.qos)
         self._inflight: Dict[Tuple[int, int], _FleetRequest] = {}
         self._results: Dict[int, _FleetRequest] = {}
         # rid -> trace id, retained for the fleet's lifetime like
@@ -204,12 +221,17 @@ class Fleet:
         self._n_failed = 0
         self._n_tokens = 0
         self._n_shed = 0
-        self._shedding = False      # inside an overload episode?
+        # overload episodes are PER CLASS: an admitted interactive
+        # request must not end the batch class's shed episode (with
+        # the default single class this degenerates to the old global
+        # flag — any admit ends the episode)
+        self._shedding_classes: set = set()
         self._tick_retry_logged: set = set()  # replicas ring-logged this tick
         self._n_retries = 0
         self._n_failovers = 0
         self._n_drains = 0
         self._n_deadline = 0
+        self._n_preempted = 0
         # MTTR accounting (PR 11): a failover opens a recovery window;
         # the first subsequent tick with real progress (tokens emitted
         # or a finish harvested) closes it — fault injection to first
@@ -256,6 +278,10 @@ class Fleet:
                  "restarted on a survivor")
         self._m_drains = m.counter("fleet_drains_total")
         self._m_deadline = m.counter("fleet_deadline_exceeded_total")
+        self._m_preempted = m.counter(
+            "fleet_preemptions_total",
+            help="in-flight requests evicted mid-decode to admit a "
+                 "higher-priority class (re-queued from their prompt)")
         self._m_latency = m.histogram(
             "fleet_request_seconds",
             help="submit-to-finish latency per completed request")
@@ -288,33 +314,54 @@ class Fleet:
         touches (shed / deadline / failover events say WHOSE request
         suffered).  Tenant ids are user-supplied strings — past the
         tracker's cardinality cap new ids fold into the shared
-        ``other`` bucket.  ``priority`` rides along as an opaque tag
-        on the same surfaces (this plane measures; the QoS actuation
-        that CONSUMES the priority is ROADMAP item 4's follow-up)."""
-        if len(self._pending) >= self.max_queue:
+        ``other`` bucket.  ``priority`` is CONSUMED by the QoS plane
+        (PR 19): it resolves to a priority class via the fleet's
+        :class:`~apex_tpu.fleet.qos.QosPolicy` (explicit priority
+        naming a known class wins, then the tenant->class map, then
+        the default class), which decides the request's weighted-fair
+        dispatch share, its per-class queue quota, its default
+        deadline, and whether it may be preempted mid-decode."""
+        qcls = self.qos.resolve(tenant, priority)
+        # shed against BOTH bounds: the global queue AND the class's
+        # own quota (queue_share x max_queue) — a batch flood sheds
+        # against its quota long before it can squeeze the
+        # interactive class out of the queue
+        cap = self.qos.cap(qcls, self.max_queue)
+        if (len(self._pending) >= self.max_queue
+                or self._pending.depth(qcls) >= cap):
             self._n_shed += 1
             self._m_shed.inc()
             # a shed happens before a rid exists; feed the tenant
             # straight to the tracker (folded name comes back for the
             # ring stamp)
-            shed_tenant = self.slo.on_shed(tenant)
-            if not self._shedding:
+            shed_tenant = self.slo.on_shed(
+                tenant, qos_class=qcls if self._qos_active else None)
+            if qcls not in self._shedding_classes:
                 # one ring event per overload EPISODE (the transition
                 # into shedding), not per rejected submit: sustained
                 # overload is hundreds of rejections a second, which
                 # would wheel the bounded ring past the breaker/
                 # failover history a post-mortem needs.
                 # fleet_shed_total carries the volume.
-                self._shedding = True
+                self._shedding_classes.add(qcls)
                 self.ring.append("shed",
                                  queue_depth=len(self._pending),
                                  max_queue=self.max_queue,
+                                 **({"qos_class": qcls}
+                                    if self._qos_active else {}),
                                  **({"tenant": shed_tenant}
                                     if shed_tenant is not None else {}))
-            raise FleetOverloaded(len(self._pending), self.max_queue)
+            raise FleetOverloaded(len(self._pending), self.max_queue,
+                                  qos_class=(qcls if self._qos_active
+                                             else None))
         if deadline is not None and deadline <= 0:
             raise ValueError(f"deadline must be > 0 seconds, got "
                              f"{deadline}")
+        if deadline is None:
+            # per-class default deadline (validated > 0 at policy
+            # construction) — interactive classes get their SLO bound
+            # without every caller restating it
+            deadline = self.qos.deadline_for(qcls)
         rid = self._next_rid
         self._next_rid += 1
         now = self._clock()
@@ -322,7 +369,8 @@ class Fleet:
                             seed, temperature,
                             None if deadline is None else now + deadline,
                             tenant=self.slo.tenant_name(tenant),
-                            priority=priority)
+                            priority=priority,
+                            qos_class=qcls)
         req.t_submit = now
         if self.tracing:
             # the root of the request's causal chain; every later
@@ -336,25 +384,31 @@ class Fleet:
                 queue_depth=len(self._pending),
                 **self._tenant_attrs(req))
         self._pending.append(req)
-        self._shedding = False      # an admitted submit ends the episode
+        # an admitted submit ends THIS class's overload episode
+        self._shedding_classes.discard(qcls)
         self._n_submitted += 1
         self._m_submitted.inc()
         # feed the ALREADY-folded name (req.tenant): folding twice
         # would double-count tenants_dropped for over-cap ids
         self.slo.on_submit(rid, now, req.deadline_at,
-                           tenant=req.tenant)
+                           tenant=req.tenant,
+                           qos_class=qcls if self._qos_active else None)
         return rid
 
-    @staticmethod
-    def _tenant_attrs(req: "_FleetRequest") -> Dict[str, Any]:
-        """The tenant/priority stamp for spans and ring events; empty
-        for untagged requests so their events keep the pre-tenant
-        shape."""
+    def _tenant_attrs(self, req: "_FleetRequest") -> Dict[str, Any]:
+        """The tenant/priority/class stamp for spans and ring events;
+        empty for untagged requests under the default policy so their
+        events keep the pre-tenant shape.  With a multi-class policy
+        EVERY request carries its resolved class (untagged traffic
+        lands in the default class — the class split must cover 100%
+        of traffic or the /tenantz class view lies)."""
         attrs: Dict[str, Any] = {}
         if req.tenant is not None:
             attrs["tenant"] = req.tenant
         if req.priority is not None:
             attrs["priority"] = req.priority
+        if self._qos_active and req.qos_class is not None:
+            attrs["qos_class"] = req.qos_class
         return attrs
 
     def _trace_ev(self, req: "_FleetRequest", name: str,
@@ -585,13 +639,38 @@ class Fleet:
             return
         # candidate capacity only changes when a dispatch lands (or
         # fails), so recompute per outcome, not per queued request —
-        # the backlog can be hundreds deep and this loop is per tick
+        # the backlog can be hundreds deep and this loop is per tick.
+        # The snapshot is in WFQ order: the stride schedule decides
+        # who meets the router first, the router only decides WHERE.
         cands = self._candidates()
         for req in list(self._pending):
-            if not cands:
-                break                   # capacity is request-independent
             if req.next_attempt_step > self._step_no:
                 continue
+            if not cands:
+                # no capacity anywhere — the QoS escape hatch: a
+                # dispatchable high-class request may evict a strictly
+                # lower-class in-flight one (decode preemption).  If
+                # there is no eligible victim either, capacity is
+                # request-independent and the sweep ends.
+                if not self._try_preempt(req):
+                    break
+                cands = self._candidates()
+                if not cands:
+                    # eviction freed capacity on a replica the breaker
+                    # currently refuses — nothing more this tick
+                    break
+            elif (self._qos_active
+                    and not any(self.replicas[j].free_slots() > 0
+                                for j in cands)):
+                # every candidate would only QUEUE the request behind
+                # work already decoding — for a class that outranks an
+                # in-flight victim that is a priority inversion, not
+                # admission: evict first so the request lands on a
+                # real slot.  No victim → fall through and queue.
+                if self._try_preempt(req):
+                    cands = self._candidates()
+                    if not cands:
+                        break
             i = self.policy.select(self, cands, req)
             rep = self.replicas[i]
             # routing decision + dispatch attempt on the request's
@@ -671,6 +750,74 @@ class Fleet:
         # (rejection or repeated dispatch failure): if that emptied
         # the MTTR watch set, close the window sample-free
         self._abandon_recovery()
+
+    # -- decode preemption -------------------------------------------------
+    def _try_preempt(self, req: "_FleetRequest") -> bool:
+        """Evict one in-flight request of a STRICTLY lower class to
+        make room for ``req``.  The victim is chosen
+        deterministically: lowest class first (highest rank number),
+        then fewest harvested tokens, then the YOUNGEST request
+        (highest rid) — the least sunk work to redo.  Eviction goes
+        through the replica's ``preempt()`` when it has one (the
+        engine scheduler's eviction API: paged replicas free the
+        victim's KV blocks through the in-graph recycling path —
+        eager host-side ops, so a warmed fleet preempts with zero new
+        traces) and falls back to ``cancel()``.  The evictee
+        re-queues at the FRONT of its own class queue and restarts
+        from its prompt exactly like a failed-over request, so its
+        final ``result()`` stays token-for-token what an undisturbed
+        run produces (greedy / explicitly-seeded decodes are
+        request-intrinsic).  A preemption is not a failure: the
+        victim's retry budget is untouched."""
+        if not self._qos_active:
+            return False
+        rank = self.qos.rank(req.qos_class)
+        victims = [(key, r) for key, r in self._inflight.items()
+                   if r.qos_class is not None
+                   and self.qos.rank(r.qos_class) > rank
+                   and self.qos.preemptible(r.qos_class)
+                   and self.health[key[0]].admissible()]
+        if not victims:
+            return False
+        key, victim = max(
+            victims,
+            key=lambda kv: (self.qos.rank(kv[1].qos_class),
+                            -len(kv[1].generated), kv[1].rid))
+        i, rrid = key
+        rep = self.replicas[i]
+        try:
+            fn = getattr(rep, "preempt", None)
+            if callable(fn):
+                fn(rrid)
+            else:
+                rep.cancel(rrid)
+        except Exception:               # noqa: BLE001 — best-effort,
+            pass                        # like _replica_failed's cancel
+        del self._inflight[key]
+        victim.assigned = None
+        victim.generated = []
+        victim.preemptions += 1
+        victim.next_attempt_step = self._step_no  # eligible at once
+        self._n_preempted += 1
+        self._m_preempted.inc()
+        self.slo.on_preempt(victim.qos_class)
+        # preemption is an aggregate two-party event: the ?tenant=
+        # membership filter must find it from EITHER side, so both
+        # tenants ride in the ``tenants`` list
+        tenants = sorted({t for t in (victim.tenant, req.tenant)
+                          if t is not None})
+        self.ring.append("preemption", replica=i,
+                         evicted_rid=victim.rid,
+                         evicted_class=victim.qos_class,
+                         admitted_rid=req.rid,
+                         admitted_class=req.qos_class,
+                         fleet_step=self._step_no,
+                         **({"tenants": tenants} if tenants else {}))
+        self._trace_ev(victim, "fleet_preempted", replica=i,
+                       by_rid=req.rid, by_class=req.qos_class,
+                       preemptions=victim.preemptions)
+        self._pending[:0] = [victim]
+        return True
 
     # -- failure handling --------------------------------------------------
     def _replica_failed(self, i: int, reason: str):
@@ -1001,18 +1148,49 @@ class Fleet:
         """The per-tenant rollup (``/tenantz``'s fleet source): every
         tenant's SLO/goodput tallies under one goodput window (the
         ``stats()`` discipline: extended to now while work is live),
-        the tracker's overflow-fold count, and the per-metric label
-        drop accounting from the registry cardinality cap."""
+        the tracker's overflow-fold count, the per-metric label drop
+        accounting from the registry cardinality cap, and (PR 19) the
+        per-CLASS split the ``?class=`` filter serves."""
         now = self._clock() if self.live() else None
         drops = {m.name: m.labels_dropped
                  for m in self.metrics.collect() if m.labels_dropped}
         return {"tenants": self.slo.tenant_stats(now=now),
                 "tenants_dropped": self.slo.tenants_dropped,
+                "classes": self._class_block(
+                    self.slo.class_stats(now=now)),
+                "preemptions": self._n_preempted,
                 "label_sets_dropped": drops}
+
+    def _class_block(self, slo_classes: Dict[str, Any]) -> \
+            Dict[str, Any]:
+        """Merge the tracker's per-class SLO tallies with the queue
+        plane (per-class depth, effective quota) and the policy spec
+        so one block answers both 'how is the class doing' and 'what
+        did we promise it'.  Every POLICY class appears even before
+        traffic — a dashboard keying on the interactive class must
+        not 404 during the first quiet minute."""
+        depths = self._pending.class_depths()
+        out: Dict[str, Any] = {}
+        for name, cls in self.qos.classes.items():
+            b = dict(slo_classes.get(name)
+                     or self.slo.zero_class_stats())
+            b["queue_depth"] = depths.get(name, 0)
+            b["weight"] = cls.weight
+            b["queue_cap"] = self.qos.cap(name, self.max_queue)
+            b["preemptible"] = cls.preemptible
+            out[name] = b
+        for name, b in slo_classes.items():   # classes a policy swap
+            if name not in out:               # orphaned: keep tallies
+                out[name] = dict(b)
+        return out
 
     def _update_gauges(self):
         m = self.metrics
         m.gauge("fleet_queue_depth").set(float(len(self._pending)))
+        if self._qos_active:
+            g = m.gauge("fleet_class_queue_depth")
+            for name, d in self._pending.class_depths().items():
+                g.labels(qos_class=name).set(float(d))
         states = self.states()
         for s, g in ((HEALTHY, "fleet_replicas_healthy"),
                      (DEGRADED, "fleet_replicas_degraded"),
@@ -1060,12 +1238,14 @@ class Fleet:
                 "drains": self._n_drains,
                 "deadline_exceeded": self._n_deadline,
                 "deadline_last_sweep": dict(self._last_deadline_sweep),
+                "preemptions": self._n_preempted,
                 "mttr": self.mttr(),
                 "recovery_in_flight": self.recovery_in_flight,
                 "slo": slo,
                 "goodput_tokens_per_s": slo["goodput_tokens_per_s"],
                 "tenants": slo["tenants"],
                 "tenants_dropped": slo["tenants_dropped"],
+                "classes": self._class_block(slo["classes"]),
                 "states": states,
                 "healthy": states.count(HEALTHY),
                 "degraded": states.count(DEGRADED),
@@ -1085,14 +1265,20 @@ class Fleet:
         aggregate (optional in the validator, so archived records
         stay clean); v11 adds the per-tenant block — one compact
         tally per tenant (no histogram summaries; ``/tenantz`` has
-        those) plus the overflow-fold count."""
+        those) plus the overflow-fold count; v14 adds the per-CLASS
+        block (same stripping rule) and the fleet preemption total."""
         s = self.stats()
         tenants = {t: {k: v for k, v in b.items()
                        if k not in ("queue_wait", "service_time")}
                    for t, b in s["tenants"].items()}
+        classes = {c: {k: v for k, v in b.items()
+                       if k not in ("queue_wait", "service_time")}
+                   for c, b in s["classes"].items()}
         return {"kind": "fleet", "trace_id": self.trace_id,
                 "tenants": tenants,
                 "tenants_dropped": s["tenants_dropped"],
+                "classes": classes,
+                "preemptions": s["preemptions"],
                 "replicas": s["replicas"], "policy": s["policy"],
                 "healthy": s["healthy"], "degraded": s["degraded"],
                 "dead": s["dead"],

@@ -81,12 +81,16 @@ RECOVERY_ROLES = ("training", "serving")
 #   (the coordinated emergency snapshot a preemption notice triggers
 #   at the next step boundary, within the grace budget);
 # serving — admission_tighten/relax (the fleet's bounded-queue knob),
+#   class_admission_tighten/relax (PR 19: the same knob scoped to ONE
+#   QoS class's queue quota — tighten the lowest-priority class first,
+#   never rank 0, so interactive admission survives a batch flood),
 #   window_shrink/grow (decode window on replicas that support it),
 #   drain/undrain (capacity out/in), cooldown_shorten/extend (the
 #   breaker's step-counted cooldowns).
 RECOVERY_ACTION_KINDS = (
     "world_shrink", "resume", "rollback", "preempt_snapshot",
     "admission_tighten", "admission_relax",
+    "class_admission_tighten", "class_admission_relax",
     "window_shrink", "window_grow",
     "drain", "undrain",
     "cooldown_shorten", "cooldown_extend")

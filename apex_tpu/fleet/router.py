@@ -43,14 +43,22 @@ __all__ = ["FleetOverloaded", "RetryPolicy", "RoundRobin",
 class FleetOverloaded(RuntimeError):
     """The bounded fleet queue is full: the request was SHED, not
     queued.  Retriable — resubmit after backoff; ``queue_depth`` and
-    ``max_queue`` say how far over capacity the caller found us."""
+    ``max_queue`` say how far over capacity the caller found us.
+    Under a multi-class :class:`~apex_tpu.fleet.qos.QosPolicy`,
+    ``qos_class`` names the priority class whose quota (or the global
+    queue) rejected the submit — a batch client seeing its own class
+    here knows backing off harder won't help the interactive tier,
+    it IS the relief."""
 
-    def __init__(self, queue_depth: int, max_queue: int):
+    def __init__(self, queue_depth: int, max_queue: int,
+                 qos_class=None):
+        cls = f" [class {qos_class}]" if qos_class is not None else ""
         super().__init__(
-            f"fleet queue full ({queue_depth}/{max_queue}); request "
-            f"shed — retry after backoff")
+            f"fleet queue full ({queue_depth}/{max_queue}){cls}; "
+            f"request shed — retry after backoff")
         self.queue_depth = queue_depth
         self.max_queue = max_queue
+        self.qos_class = qos_class
 
 
 class RetryPolicy:
@@ -96,9 +104,11 @@ def _req_tags(req) -> dict:
     """Tenant/priority tags of a request, for ``last_decision``: the
     routing record of a tagged request says WHOSE request was ranked
     (the fleet copies the decision onto the ``fleet_route`` trace
-    event, and the future QoS actuation will rank ON these tags —
-    recording them now keeps the decision schema stable across that
-    change).  Untagged requests keep the pre-tenant decision shape."""
+    event; since PR 19 the QoS plane consumes the priority BEFORE
+    routing — the WfqQueue decides who meets the router first, the
+    policy only decides where — and the fleet stamps the resolved
+    ``qos_class`` on the trace event itself).  Untagged requests keep
+    the pre-tenant decision shape."""
     tags = {}
     tenant = getattr(req, "tenant", None)
     if tenant is not None:

@@ -66,6 +66,15 @@ def _new_tenant_bucket() -> Dict[str, Any]:
             "within_deadline": 0, "t_first": None, "t_last": None}
 
 
+def _new_class_bucket() -> Dict[str, Any]:
+    # per-QoS-class tallies: the tenant shape plus the preemption
+    # count (only classes can be preempted — eviction direction is a
+    # class-rank decision, so the tally lives here, not per tenant)
+    b = _new_tenant_bucket()
+    b["preempted"] = 0
+    return b
+
+
 class SloTracker:
     """Per-request deadline-attainment, queue-wait/service split, and
     goodput, owned and fed by one :class:`~apex_tpu.fleet.Fleet`.
@@ -115,7 +124,7 @@ class SloTracker:
             "fleet_goodput_tokens_per_s",
             help="goodput tokens over the submit-to-last-finish window")
         # rid -> [t_submit, t_first_dispatch|None, deadline_at|None,
-        #         tenant-bucket-name|None]
+        #         tenant-bucket-name|None, qos-class-name|None]
         self._open: Dict[int, list] = {}
         self._with_deadline = 0         # resolved requests that had one
         self._within = 0                # ... and finished in time
@@ -124,6 +133,10 @@ class SloTracker:
         self._t_last: Optional[float] = None
         self._tenants: Dict[str, Dict[str, Any]] = {}
         self._tenants_dropped = 0
+        # per-QoS-class tallies (PR 19): class names come from the
+        # fleet's QosPolicy — a small operator-declared set, so no
+        # cardinality fold is needed (unlike tenant ids)
+        self._classes: Dict[str, Dict[str, Any]] = {}
 
     # -- per-tenant plumbing ------------------------------------------------
     def _tenant_bucket(self, tenant: Optional[str]
@@ -154,30 +167,60 @@ class SloTracker:
         b = self._tenant_bucket(tenant)
         return None if b is None else b["tenant"]
 
+    def _class_bucket(self, qos_class: Optional[str]
+                      ) -> Optional[Dict[str, Any]]:
+        if qos_class is None:
+            return None
+        bucket = self._classes.get(qos_class)
+        if bucket is None:
+            bucket = _new_class_bucket()
+            self._classes[qos_class] = bucket
+        return bucket
+
     # -- fleet feed (same instants as the trace spans) ---------------------
     def on_submit(self, rid: int, now: float,
                   deadline_at: Optional[float],
-                  tenant: Optional[str] = None):
+                  tenant: Optional[str] = None,
+                  qos_class: Optional[str] = None):
         b = self._tenant_bucket(tenant)
+        c = self._class_bucket(qos_class)
         self._open[rid] = [now, None, deadline_at,
-                           None if b is None else b["tenant"]]
+                           None if b is None else b["tenant"],
+                           qos_class]
         if self._t_first is None:
             self._t_first = now
         if b is not None:
             b["submitted"] += 1
             if b["t_first"] is None:
                 b["t_first"] = now
+        if c is not None:
+            c["submitted"] += 1
+            if c["t_first"] is None:
+                c["t_first"] = now
 
-    def on_shed(self, tenant: Optional[str] = None) -> Optional[str]:
+    def on_shed(self, tenant: Optional[str] = None,
+                qos_class: Optional[str] = None) -> Optional[str]:
         """A shed happens before a rid exists, so the fleet feeds the
         tenant directly; untagged sheds live only in the fleet-wide
         counter the fleet already keeps.  Returns the folded bucket
         name (for the ring-event stamp) or None."""
+        c = self._class_bucket(qos_class)
+        if c is not None:
+            c["shed"] += 1
         b = self._tenant_bucket(tenant)
         if b is None:
             return None
         b["shed"] += 1
         return b["tenant"]
+
+    def on_preempt(self, qos_class: Optional[str] = None):
+        """One mid-decode eviction charged to the victim's class (the
+        per-class needle the runbook pairs against queue_wait: rising
+        preemptions with flat queue_wait means the batch class is
+        paying for interactive admission, not starving in line)."""
+        c = self._class_bucket(qos_class)
+        if c is not None:
+            c["preempted"] += 1
 
     def on_dispatch(self, rid: int, now: float):
         """First dispatch only: queue wait = submit → first dispatch;
@@ -190,6 +233,8 @@ class SloTracker:
         self._m_queue_wait.observe(wait)
         if rec[3] is not None:
             self._m_queue_wait.labels(tenant=rec[3]).observe(wait)
+        if rec[4] is not None:
+            self._m_queue_wait.labels(qos_class=rec[4]).observe(wait)
 
     def _resolve(self, rid: int, now: float):
         rec = self._open.pop(rid, None)
@@ -202,36 +247,53 @@ class SloTracker:
         rec = self._resolve(rid, now)
         if rec is None:
             return
-        t_submit, t_dispatch, deadline_at, tenant = rec
+        t_submit, t_dispatch, deadline_at, tenant, qos_class = rec
         b = None if tenant is None else self._tenants.get(tenant)
+        c = None if qos_class is None else self._classes.get(qos_class)
         service = now - (t_dispatch if t_dispatch is not None
                          else t_submit)
         self._m_service.observe(service)
         if tenant is not None:
             self._m_service.labels(tenant=tenant).observe(service)
+        if qos_class is not None:
+            self._m_service.labels(qos_class=qos_class).observe(service)
         within = deadline_at is None or now <= deadline_at
         if deadline_at is not None:
             self._with_deadline += 1
             if b is not None:
                 b["with_deadline"] += 1
+            if c is not None:
+                c["with_deadline"] += 1
             if within:
                 self._within += 1
                 if b is not None:
                     b["within_deadline"] += 1
+                if c is not None:
+                    c["within_deadline"] += 1
             else:
                 self._m_miss.inc()
                 if b is not None:
                     b["slo_misses"] += 1
                     self._m_miss.labels(tenant=tenant).inc()
+                if c is not None:
+                    c["slo_misses"] += 1
+                    self._m_miss.labels(qos_class=qos_class).inc()
         if within:
             self._goodput_tokens += int(tokens)
             self._m_goodput.inc(int(tokens))
             if b is not None:
                 b["goodput_tokens"] += int(tokens)
                 self._m_goodput.labels(tenant=tenant).inc(int(tokens))
+            if c is not None:
+                c["goodput_tokens"] += int(tokens)
+                self._m_goodput.labels(
+                    qos_class=qos_class).inc(int(tokens))
         if b is not None:
             b["finished"] += 1
             b["t_last"] = now
+        if c is not None:
+            c["finished"] += 1
+            c["t_last"] = now
         self._fold_gauges()
 
     def on_fail(self, rid: int, now: float,
@@ -243,8 +305,9 @@ class SloTracker:
         rec = self._resolve(rid, now)
         if rec is None:
             return
-        tenant = rec[3]
+        tenant, qos_class = rec[3], rec[4]
         b = None if tenant is None else self._tenants.get(tenant)
+        c = None if qos_class is None else self._classes.get(qos_class)
         if rec[2] is not None:
             self._with_deadline += 1
             self._m_miss.inc()
@@ -252,11 +315,20 @@ class SloTracker:
                 b["with_deadline"] += 1
                 b["slo_misses"] += 1
                 self._m_miss.labels(tenant=tenant).inc()
+            if c is not None:
+                c["with_deadline"] += 1
+                c["slo_misses"] += 1
+                self._m_miss.labels(qos_class=qos_class).inc()
         if b is not None:
             b["failed"] += 1
             if deadline_exceeded:
                 b["deadline_exceeded"] += 1
             b["t_last"] = now
+        if c is not None:
+            c["failed"] += 1
+            if deadline_exceeded:
+                c["deadline_exceeded"] += 1
+            c["t_last"] = now
         self._fold_gauges()
 
     # -- aggregates ---------------------------------------------------------
@@ -309,6 +381,12 @@ class SloTracker:
                 self._m_attainment.labels(tenant=t).set(ta)
             self._m_goodput_rate.labels(tenant=t).set(
                 self._tenant_rate(b))
+        for cname, c in self._classes.items():
+            ca = self._tenant_attainment(c)
+            if ca is not None:
+                self._m_attainment.labels(qos_class=cname).set(ca)
+            self._m_goodput_rate.labels(qos_class=cname).set(
+                self._tenant_rate(c))
 
     @property
     def tenants_dropped(self) -> int:
@@ -338,6 +416,36 @@ class SloTracker:
             out[t] = entry
         return out
 
+    @staticmethod
+    def zero_class_stats() -> Dict[str, Any]:
+        """The derived-stats shape of a class that saw no traffic —
+        what ``Fleet._class_block`` emits for a policy class before
+        its first request (so dashboards keyed on a class never 404)."""
+        entry = {k: v for k, v in _new_class_bucket().items()
+                 if k not in ("t_first", "t_last")}
+        entry["slo_attainment"] = None
+        entry["goodput_tokens_per_s"] = 0.0
+        return entry
+
+    def class_stats(self, now: Optional[float] = None
+                    ) -> Dict[str, Dict[str, Any]]:
+        """Per-QoS-class rollup, shaped like :meth:`tenant_stats`
+        (same derived attainment/rate, same labeled histogram
+        summaries) plus the per-class ``preempted`` count."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for cname, c in sorted(self._classes.items()):
+            entry = {k: v for k, v in c.items()
+                     if k not in ("t_first", "t_last")}
+            entry["slo_attainment"] = self._tenant_attainment(c)
+            entry["goodput_tokens_per_s"] = round(
+                self._tenant_rate(c, now=now), 4)
+            entry["queue_wait"] = self._m_queue_wait.labels(
+                qos_class=cname).summary()
+            entry["service_time"] = self._m_service.labels(
+                qos_class=cname).summary()
+            out[cname] = entry
+        return out
+
     def stats(self, now: Optional[float] = None) -> Dict[str, Any]:
         """``now`` extends the goodput window for a still-running
         fleet (``Fleet.stats()`` passes its clock while work is live,
@@ -353,6 +461,7 @@ class SloTracker:
             "service_time": self._m_service.summary(),
             "tenants": self.tenant_stats(now=now),
             "tenants_dropped": self._tenants_dropped,
+            "classes": self.class_stats(now=now),
         }
 
 

@@ -28,7 +28,8 @@ from typing import Any, Dict, IO, Iterable, List, Optional
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
-           "COMPILE_FIELDS", "TENANT_COUNTS", "ADMISSION_MODES",
+           "COMPILE_FIELDS", "TENANT_COUNTS", "CLASS_COUNTS",
+           "ADMISSION_MODES",
            "host_info", "JsonlExporter",
            "prometheus_text", "parse_prometheus_text",
            "validate_prometheus_text", "validate_bench_record",
@@ -158,9 +159,31 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # from its own parts is hand-built, not propagated.  Deterministic
 # like the compiled memory plan, so ``check_bench_trend`` gates
 # ``replicated_bytes`` per entry point on every backend.
+# v14: the QoS plane.  ``kind: fleet`` records carry the per-class
+# rollup — a ``classes`` object keyed by priority-class name whose
+# buckets hold the CLASS_COUNTS tallies (TENANT_COUNTS plus
+# ``preempted``: requests evicted mid-decode to admit a higher class)
+# alongside ``slo_attainment`` / ``goodput_tokens_per_s`` (fleet-level
+# contract) and the live queue shape (``queue_depth`` / ``queue_cap``
+# / ``weight`` / ``preemptible``), and a fleet-level ``preemptions``
+# total.  Validated whenever present; REQUIRED on fresh v14 fleet
+# records — a fleet snapshot that cannot split its SLO story by
+# priority class cannot answer "did the batch flood eat the
+# interactive tier".  RECOVERY_ACTION_KINDS grows
+# ``class_admission_tighten`` / ``class_admission_relax`` (the
+# per-class admission knob — the controller squeezes the
+# lowest-priority class's queue quota, never rank 0's).  Bench grows
+# the QoS leg: fresh per-class ``*_class_*_goodput`` lines must carry
+# ``qos_class`` + ``slo_attainment``, and the ``*_preemption_parity``
+# line (token-for-token equality of a preempted-then-readmitted
+# request vs an undisturbed run) must carry the token counts its
+# ratio came from (``matched_tokens`` / ``expected_tokens``), at
+# least one measured ``preemptions``, and reassemble from them —
+# check_bench_trend gates the parity at exactly 1.0 on EVERY backend
+# (determinism, not timing).
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v12 streams stay valid.
-SCHEMA_VERSION = 13
+# version, so archived v1..v13 streams stay valid.
+SCHEMA_VERSION = 14
 
 # how a serving engine admits requests and holds KV (stdlib-side
 # duplicate of the serving engines' ``admission_mode`` class attrs —
@@ -959,6 +982,57 @@ def validate_bench_record(rec: Any) -> List[str]:
                         f"value ({val}) inconsistent with "
                         f"tenants_goodput_tokens/tokens_within_slo "
                         f"({expect:.4g})")
+    # QoS-tagged bench lines (bench.py --fleet QoS leg, schema v14):
+    # whenever a line names a priority class it must name it
+    # coherently; fresh v14 per-class goodput lines must carry the SLO
+    # side of the claim, and the preemption-parity line must carry the
+    # token counts its ratio came from plus the preemption count it
+    # survived — an exactness claim that preempted nothing measured
+    # nothing.
+    if "qos_class" in rec and (not isinstance(rec["qos_class"], str)
+                               or not rec["qos_class"]):
+        errs.append(f"'qos_class' must be a non-empty string when "
+                    f"present, got {rec['qos_class']!r}")
+    v14 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+           and sv_rec >= 14)
+    if (v14 and isinstance(metric, str)
+            and "error" not in rec and not rec.get("stale")):
+        if "_class_" in metric and metric.endswith("_goodput"):
+            if "qos_class" not in rec:
+                errs.append("fresh per-class goodput records must "
+                            "carry 'qos_class' (schema v14)")
+            att = _need(rec, errs, "slo_attainment", numbers.Number,
+                        allow_none=True)
+            if (isinstance(att, numbers.Number)
+                    and not isinstance(att, bool)
+                    and not (0.0 <= att <= 1.0)):
+                errs.append(f"'slo_attainment' must be null or in "
+                            f"[0, 1], got {att!r}")
+        if metric.endswith("_preemption_parity"):
+            counts = {}
+            for key in ("matched_tokens", "expected_tokens"):
+                v = _need(rec, errs, key, int)
+                if isinstance(v, int) and not isinstance(v, bool):
+                    if v < 0:
+                        errs.append(f"{key!r} must be >= 0, got {v}")
+                    else:
+                        counts[key] = v
+            pre = _need(rec, errs, "preemptions", int)
+            if (isinstance(pre, int) and not isinstance(pre, bool)
+                    and pre < 1):
+                errs.append(f"'preemptions' must be >= 1 on a "
+                            f"preemption-parity line, got {pre}")
+            val = rec.get("value")
+            if (len(counts) == 2 and counts["expected_tokens"] > 0
+                    and isinstance(val, numbers.Number)
+                    and not isinstance(val, bool)):
+                expect = (counts["matched_tokens"]
+                          / counts["expected_tokens"])
+                if abs(val - expect) > 0.005:
+                    errs.append(
+                        f"value ({val}) inconsistent with "
+                        f"matched_tokens/expected_tokens "
+                        f"({expect:.4g})")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:
@@ -1039,6 +1113,13 @@ TENANT_COUNTS = ("submitted", "finished", "failed", "shed",
                  "deadline_exceeded", "slo_misses", "goodput_tokens",
                  "with_deadline", "within_deadline")
 
+# the per-class bucket tallies a v14 ``classes`` block carries — the
+# tenant bucket plus ``preempted`` (requests evicted mid-decode to
+# admit a higher-priority class; the evictee is re-queued from its
+# prompt, so ``preempted`` is not a failure count).  Stdlib-side
+# duplicate of fleet.slo's class bucket; tests pin the shapes equal.
+CLASS_COUNTS = TENANT_COUNTS + ("preempted",)
+
 
 def _check_tenants_block(rec, errs):
     """The v11 per-tenant rollup contract, validated whenever present:
@@ -1112,6 +1193,107 @@ def _check_tenants_block(rec, errs):
                 and sums[key] > total):
             errs.append(f"sum of per-tenant {key} ({sums[key]}) "
                         f"exceeds fleet {total_key} ({total})")
+
+
+def _check_classes_block(rec, errs):
+    """The v14 per-class rollup contract, validated whenever present:
+    ``classes`` maps non-empty priority-class names to buckets of
+    CLASS_COUNTS tallies (ints >= 0, internally consistent the tenant
+    way), each riding with the SLO pair (null-or-fraction attainment,
+    non-negative goodput rate) and the live queue shape (depth/cap
+    ints, weight >= 1, preemptible bool) — and the per-class sums stay
+    within the fleet totals (every admitted request resolves to
+    exactly one class, so under a multi-class policy the sums may
+    reach the totals but never exceed them).  ``preemptions`` is the
+    fleet-level eviction total the per-class ``preempted`` tallies
+    roll up into."""
+    if "preemptions" in rec:
+        v = rec["preemptions"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"'preemptions' must be an int >= 0, "
+                        f"got {v!r}")
+    if "classes" not in rec:
+        return
+    classes = rec["classes"]
+    if not isinstance(classes, dict):
+        errs.append("'classes' must be an object when present")
+        return
+    sums = {k: 0 for k in ("shed", "deadline_exceeded",
+                           "goodput_tokens")}
+    preempted_sum = 0
+    for name, b in classes.items():
+        if not isinstance(name, str) or not name:
+            errs.append(f"class names must be non-empty strings, "
+                        f"got {name!r}")
+        if not isinstance(b, dict):
+            errs.append(f"classes[{name!r}] must be an object")
+            continue
+        for key in CLASS_COUNTS:
+            v = b.get(key)
+            if key not in b:
+                errs.append(f"classes[{name!r}] missing {key!r}")
+            elif not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"classes[{name!r}].{key} must be an int "
+                            f">= 0, got {v!r}")
+            elif key in sums:
+                sums[key] += v
+            elif key == "preempted":
+                preempted_sum += v
+        fin, sub = b.get("finished"), b.get("submitted")
+        if (isinstance(fin, int) and isinstance(sub, int)
+                and not isinstance(fin, bool)
+                and not isinstance(sub, bool) and fin > sub):
+            errs.append(f"classes[{name!r}]: finished ({fin}) exceeds "
+                        f"submitted ({sub})")
+        wi, wd = b.get("within_deadline"), b.get("with_deadline")
+        if (isinstance(wi, int) and isinstance(wd, int)
+                and not isinstance(wi, bool)
+                and not isinstance(wd, bool) and wi > wd):
+            errs.append(f"classes[{name!r}]: within_deadline ({wi}) "
+                        f"exceeds with_deadline ({wd})")
+        att = b.get("slo_attainment")
+        if att is not None and (
+                not isinstance(att, numbers.Number)
+                or isinstance(att, bool)
+                or not (0.0 <= att <= 1.0)):
+            errs.append(f"classes[{name!r}].slo_attainment must be "
+                        f"null or in [0, 1], got {att!r}")
+        gp = b.get("goodput_tokens_per_s")
+        if gp is not None and (
+                not isinstance(gp, numbers.Number)
+                or isinstance(gp, bool) or not (gp >= 0)):
+            errs.append(f"classes[{name!r}].goodput_tokens_per_s must "
+                        f"be null or a number >= 0, got {gp!r}")
+        for key in ("queue_depth", "queue_cap"):
+            if key in b:
+                v = b[key]
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errs.append(f"classes[{name!r}].{key} must be an "
+                                f"int >= 0 when present, got {v!r}")
+        if "weight" in b:
+            w = b["weight"]
+            if not isinstance(w, int) or isinstance(w, bool) or w < 1:
+                errs.append(f"classes[{name!r}].weight must be an int "
+                            f">= 1 when present, got {w!r}")
+        if "preemptible" in b and not isinstance(b["preemptible"],
+                                                 bool):
+            errs.append(f"classes[{name!r}].preemptible must be a "
+                        f"bool when present, got "
+                        f"{b['preemptible']!r}")
+    for key, total_key in (("shed", "shed"),
+                           ("deadline_exceeded", "deadline_exceeded"),
+                           ("goodput_tokens", "tokens_within_slo")):
+        total = rec.get(total_key)
+        if (isinstance(total, int) and not isinstance(total, bool)
+                and sums[key] > total):
+            errs.append(f"sum of per-class {key} ({sums[key]}) "
+                        f"exceeds fleet {total_key} ({total})")
+    pre = rec.get("preemptions")
+    if (isinstance(pre, int) and not isinstance(pre, bool)
+            and preempted_sum > pre):
+        errs.append(f"sum of per-class preempted ({preempted_sum}) "
+                    f"exceeds fleet preemptions ({pre})")
 
 
 def validate_fleet_record(rec: Any) -> List[str]:
@@ -1232,6 +1414,18 @@ def validate_fleet_record(rec: Any) -> List[str]:
             errs.append("fresh fleet records must carry "
                         "'tenants_dropped' (schema v11)")
     _check_tenants_block(rec, errs)
+    # the v14 QoS plane: validated whenever present, required on
+    # records declaring v14 — Fleet.record() always emits the block
+    # (zero buckets for every policy class when nothing ran), so a
+    # fresh record missing it was hand-built
+    if isinstance(sv, int) and not isinstance(sv, bool) and sv >= 14:
+        if "classes" not in rec:
+            errs.append("fresh fleet records must carry 'classes' "
+                        "(schema v14: the per-QoS-class SLO rollup)")
+        if "preemptions" not in rec:
+            errs.append("fresh fleet records must carry "
+                        "'preemptions' (schema v14)")
+    _check_classes_block(rec, errs)
     if "deadline_last_sweep" in rec:
         sweep = rec["deadline_last_sweep"]
         if not isinstance(sweep, dict):
@@ -1771,6 +1965,7 @@ RECOVERY_ROLES = ("training", "serving")
 RECOVERY_ACTION_KINDS = (
     "world_shrink", "resume", "rollback", "preempt_snapshot",
     "admission_tighten", "admission_relax",
+    "class_admission_tighten", "class_admission_relax",
     "window_shrink", "window_grow",
     "drain", "undrain",
     "cooldown_shorten", "cooldown_extend")

@@ -38,7 +38,20 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-__all__ = ["EventRing", "get_ring", "set_ring", "resolve", "record"]
+__all__ = ["EventRing", "event_matches_tenant", "get_ring",
+           "set_ring", "resolve", "record"]
+
+
+def event_matches_tenant(event: Dict[str, Any], tenant: str) -> bool:
+    """THE membership rule for "is this event part of ``tenant``'s
+    story": a per-request event stamped ``tenant: <name>`` matches,
+    and so does an aggregate transition (failover reclaim, deadline
+    sweep, preemption) listing the name in its ``tenants`` list.
+    Both :meth:`EventRing.snapshot` and ``/flightz?tenant=`` call
+    this one function, so a post-mortem dump filter and a live scrape
+    can never drift apart."""
+    return (event.get("tenant") == tenant
+            or tenant in (event.get("tenants") or ()))
 
 
 class EventRing:
@@ -85,9 +98,7 @@ class EventRing:
         if kind is not None:
             evs = [e for e in evs if e["kind"] == kind]
         if tenant is not None:
-            evs = [e for e in evs
-                   if e.get("tenant") == tenant
-                   or tenant in (e.get("tenants") or ())]
+            evs = [e for e in evs if event_matches_tenant(e, tenant)]
         return evs
 
     def __len__(self) -> int:

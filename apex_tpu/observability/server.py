@@ -46,8 +46,10 @@ any hot path, no dependencies:
   attainment, queue-wait vs service split, shed / deadline-miss
   counts per tenant, plus the cardinality-cap drop accounting), with
   the same per-source error isolation as ``/statusz``.  ``?tenant=``
-  narrows to one tenant (404 when no source knows it); a process with
-  no tenant source serves the empty shape, not an error — "which
+  narrows to one tenant and ``?class=`` (PR 19) narrows each source's
+  per-QoS-class ``classes`` rollup to one priority class (each 404s
+  only when NO source knows the name; the filters compose); a process
+  with no tenant source serves the empty shape, not an error — "which
   tenant's p99 regressed" must be answerable by scrape even before
   the first tagged request.
 
@@ -266,11 +268,14 @@ class ObservabilityServer:
             events = [e for e in events if e["kind"] == kind]
         if tenant is not None:
             # per-request events carry ``tenant``; aggregate ones
-            # (failover reclaim, deadline sweep) list every affected
-            # tenant in ``tenants`` — a tenant's view includes both
+            # (failover reclaim, deadline sweep, preemption) list
+            # every affected tenant in ``tenants`` — one shared rule
+            # (flightrec.event_matches_tenant) serves both this scrape
+            # and ring.snapshot(tenant=...), so the live view and the
+            # post-mortem dump can never disagree on membership
+            from .flightrec import event_matches_tenant
             events = [e for e in events
-                      if e.get("tenant") == tenant
-                      or tenant in (e.get("tenants") or ())]
+                      if event_matches_tenant(e, tenant)]
         return {"kind": "flight_ring", "capacity": ring.capacity,
                 "total": total, "retained": retained,
                 "dropped": total - retained,
@@ -311,16 +316,21 @@ class ObservabilityServer:
             snap["filter"] = entry
         return snap
 
-    def tenantz(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+    def tenantz(self, tenant: Optional[str] = None,
+                qos_class: Optional[str] = None) -> Dict[str, Any]:
         """Every attached tenant source's per-tenant SLO rollup, with
         the ``/statusz`` error-isolation rule (a raising source reports
         its error under its own key — one sick fleet must not blank the
         page).  ``tenant=`` narrows every source's ``tenants`` map to
-        that tenant; a tenant no source knows raises ``KeyError``
-        (handler → 404).  No sources attached is the valid empty
+        that tenant; ``class=`` narrows every source's ``classes`` map
+        (PR 19: the per-QoS-class rollup a multi-class fleet stamps
+        alongside the tenants) the same way — each raises ``KeyError``
+        (handler → 404) only when NO source knows the name.  The two
+        filters compose.  No sources attached is the valid empty
         shape, not an error."""
         by_source: Dict[str, Any] = {}
         names: set = set()
+        class_names: set = set()
         for name, fn in sorted(self._tenants.items()):
             try:
                 snap = dict(fn())
@@ -332,6 +342,9 @@ class ObservabilityServer:
                 tenants = {}
             snap["tenants"] = tenants
             names.update(tenants)
+            classes = snap.get("classes")
+            if isinstance(classes, dict):
+                class_names.update(classes)
             by_source[name] = snap
         if tenant is not None:
             if tenant not in names:
@@ -341,10 +354,21 @@ class ObservabilityServer:
                 if isinstance(t, dict):
                     snap["tenants"] = {k: v for k, v in t.items()
                                        if k == tenant}
+        if qos_class is not None:
+            if qos_class not in class_names:
+                raise KeyError(qos_class)
+            for snap in by_source.values():
+                c = snap.get("classes")
+                if isinstance(c, dict):
+                    snap["classes"] = {k: v for k, v in c.items()
+                                       if k == qos_class}
         return {"kind": "tenants", "filter": tenant,
+                "class_filter": qos_class,
                 "sources": sorted(self._tenants),
                 "tenant_names": ([tenant] if tenant is not None
                                  else sorted(names)),
+                "class_names": ([qos_class] if qos_class is not None
+                                else sorted(class_names)),
                 "by_source": by_source}
 
     def profilez(self, duration_ms: Optional[float] = None
@@ -460,12 +484,17 @@ class ObservabilityServer:
                                 "error": f"unknown entry {ent!r}"})
                     elif route == "/tenantz":
                         ten = q.get("tenant", [None])[0]
+                        qcls = q.get("class", [None])[0]
                         try:
-                            self._send_json(200,
-                                            srv.tenantz(tenant=ten))
-                        except KeyError:
+                            self._send_json(200, srv.tenantz(
+                                tenant=ten, qos_class=qcls))
+                        except KeyError as e:
+                            missing = e.args[0] if e.args else None
+                            what = ("class" if qcls is not None
+                                    and missing == qcls else "tenant")
                             self._send_json(404, {
-                                "error": f"unknown tenant {ten!r}"})
+                                "error": f"unknown {what} "
+                                         f"{missing!r}"})
                     elif route == "/":
                         self._send_json(200, {
                             "endpoints": list(ENDPOINTS)})

@@ -182,6 +182,7 @@ class _SlotScheduler:
         self._n_tokens = 0
         self._n_steps = 0
         self._n_syncs = 0
+        self._n_preempted = 0
         self._last_util = 0.0
         self.window = int(getattr(self, "window", 1))
         self._m_prefill = self.metrics.histogram(
@@ -480,6 +481,28 @@ class _SlotScheduler:
                 return True
         return False
 
+    def preempt(self, rid: int) -> bool:
+        """Evict a request to make room for a higher-priority one (the
+        fleet QoS plane's eviction API).  Mechanically this is
+        :meth:`cancel` — the slot frees, a paged engine returns the
+        victim's KV blocks through the same eager host-side recycling
+        path (``_freeze_slot``), so a warmed engine preempts with
+        ZERO new traces — but the intent differs and is accounted
+        separately: ``preempted`` in :meth:`stats` and the
+        ``engine_preempted_total`` counter name evictions, not
+        abandonments.  The caller owns re-queueing the victim from its
+        prompt (exactness holds: greedy / explicitly-seeded decodes
+        are request-intrinsic).  Returns False for unknown/finished
+        rids, like ``cancel``."""
+        ok = self.cancel(rid)
+        if ok:
+            self._n_preempted += 1
+            self.metrics.counter(
+                "engine_preempted_total",
+                help="requests evicted mid-decode by the fleet QoS "
+                     "plane (slot freed, KV blocks recycled)").inc()
+        return ok
+
     def _finish(self, slot, req):
         req.done = True
         req.t_finish = self._clock()
@@ -662,6 +685,7 @@ class _SlotScheduler:
                 "occupancy": len(self._by_slot) / self.slots,
                 "queue_depth": len(self._waiting),
                 "admitted": self._n_admitted,
+                "preempted": self._n_preempted,
                 "tokens_generated": self._n_tokens,
                 "decode_steps": self._n_steps,
                 "window": self.window,

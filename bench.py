@@ -654,6 +654,192 @@ def main():
                   f"eos + iteration-boundary admission; vs_baseline "
                   f"is paged/fixed goodput; {mx_note}")
 
+        # QoS leg (schema v14, the ROADMAP item 4 gate): the SAME
+        # flood-plus-trickle mix as the v11 tenant leg, run twice —
+        # once untagged (single-class FIFO fleet, the baseline) and
+        # once under a two-class QosPolicy (interactive weight 8,
+        # unpreemptible; batch weight 1, tenant->class mapped).  The
+        # WFQ plane must hold the interactive class's SLO attainment
+        # through the batch flood while the AGGREGATE goodput stays
+        # within ~5% of the untagged baseline — priority isolation
+        # that taxes total throughput is a regression, not a feature.
+        from apex_tpu.fleet import QosClass, QosPolicy
+
+        def _qos_policy():
+            return QosPolicy(
+                [QosClass("interactive", weight=8, preemptible=False),
+                 QosClass("batch", weight=1)],
+                tenant_class={"interactive": "interactive",
+                              "batch": "batch"})
+
+        def _qos_pass(qos):
+            traces0 = ledger.total_traces()
+            wall0 = ledger.compile_wall_s()
+            fl = Fleet([serving.Engine(model, params, slots=slots,
+                                       buf_len=cfg.block_size)
+                        for _ in range(fleet_n)],
+                       policy="least_loaded", max_queue=2 * requests,
+                       retry=RetryPolicy(max_attempts=10),
+                       step_workers=1, qos=qos)
+            fl.warmup()
+            cold_ms = (ledger.compile_wall_s() - wall0) * 1e3
+            compiles = ledger.total_traces() - traces0
+            rng = np.random.RandomState(3)
+
+            def _p():
+                return list(rng.randint(0, cfg.vocab_size,
+                                        prompt_len))
+
+            # settle pass (host caches), then the timed open loop —
+            # the arrival schedule and every prompt are identical on
+            # both passes (same seeded stream, same call order)
+            for _ in range(2 * slots):
+                fl.submit(_p(), max_new_tokens=new_tokens)
+            while fl.live():
+                fl.step()
+            traces_ss = ledger.total_traces()
+            tok0 = fl.stats()["tokens_generated"]
+            t0 = time.perf_counter()
+            for _ in range(n_batch):
+                fl.submit(_p(), max_new_tokens=new_tokens,
+                          deadline=deadline_s, tenant="batch")
+            sent = 0
+            step_i = 0
+            while fl.live() or sent < n_inter:
+                if sent < n_inter and step_i % 4 == 0:
+                    fl.submit(_p(), max_new_tokens=new_tokens,
+                              deadline=deadline_s,
+                              tenant="interactive")
+                    sent += 1
+                fl.step()
+                step_i += 1
+            dt = time.perf_counter() - t0
+            tput = (fl.stats()["tokens_generated"] - tok0) / dt
+            rec = fl.record()
+            cls = fl.tenant_stats()["classes"]
+            fl.close()
+            return {"tput": tput, "rec": rec, "classes": cls,
+                    "cold_ms": cold_ms, "compiles": compiles,
+                    "retraces": ledger.total_traces() - traces_ss,
+                    "dt": dt}
+
+        base_q = _qos_pass(None)
+        qos_q = _qos_pass(_qos_policy())
+        q_note = (f"two-class open loop: {n_batch} batch requests "
+                  f"flood up front, {n_inter} interactive ones "
+                  f"trickle in every 4 steps (identical seeded "
+                  f"arrivals as the untagged baseline pass); deadline "
+                  f"{deadline_s:.0f}s trends the QoS accounting, not "
+                  f"CPU latency; QoS pass drained in "
+                  f"{qos_q['dt']:.1f}s vs baseline "
+                  f"{base_q['dt']:.1f}s")
+        for cname in ("interactive", "batch"):
+            b = qos_q["classes"][cname]
+            emit(metric=f"gpt_tiny_fleet{fleet_n}_qos_class_{cname}"
+                        f"_goodput",
+                 value=b["goodput_tokens_per_s"], unit="tokens/sec",
+                 vs_baseline=None, qos_class=cname,
+                 slo_attainment=b["slo_attainment"],
+                 goodput_tokens=b["goodput_tokens"],
+                 submitted=b["submitted"], shed=b["shed"],
+                 deadline_exceeded=b["deadline_exceeded"],
+                 preempted=b["preempted"], weight=b["weight"],
+                 queue_wait_p99_s=b["queue_wait"].get("p99"),
+                 cold_compile_ms=round(qos_q["cold_ms"], 2),
+                 compiles_total=qos_q["compiles"],
+                 steady_state_retraces=qos_q["retraces"],
+                 note=f"class {cname!r} (weight {b['weight']}) under "
+                      f"the two-class policy; {q_note}")
+        emit(metric=f"gpt_tiny_fleet{fleet_n}_qos_aggregate_goodput",
+             value=round(qos_q["tput"], 1), unit="tokens/sec",
+             vs_baseline=(None if not base_q["tput"] else
+                          round(qos_q["tput"] / base_q["tput"], 3)),
+             cold_compile_ms=round(qos_q["cold_ms"], 2),
+             compiles_total=qos_q["compiles"],
+             steady_state_retraces=qos_q["retraces"],
+             note=f"aggregate decode throughput of the QoS-tagged "
+                  f"pass; vs_baseline is qos/untagged — the WFQ "
+                  f"plane's overhead, gated at ~5% "
+                  f"(check_bench_trend); {q_note}")
+        emit(**qos_q["rec"])
+
+        # preemption-exactness episode (schema v14, paged replica):
+        # both slots held by batch requests mid-decode, then an
+        # interactive submit forces the QoS plane to evict the
+        # youngest batch victim, recycle its blocks, and re-queue it
+        # from its prompt — the victim's final tokens must equal an
+        # undisturbed solo-engine run token-for-token (greedy), and a
+        # WARMED fleet must run the whole episode with a
+        # compilation-ledger delta of ZERO (eviction is eager
+        # host-side slot surgery, never a retrace)
+        def _paged_small():
+            return serving.PagedEngine(
+                model, params, slots=2, buf_len=cfg.block_size,
+                block_size=cfg.block_size // 4, num_blocks=8,
+                prefill_chunk=4, window=2, temperature=0.0)
+
+        rng_p = np.random.RandomState(5)
+        vic_prompt = list(rng_p.randint(0, cfg.vocab_size,
+                                        prompt_len))
+        oth_prompt = list(rng_p.randint(0, cfg.vocab_size,
+                                        prompt_len))
+        hi_prompt = list(rng_p.randint(0, cfg.vocab_size,
+                                       prompt_len))
+
+        solo_fl = Fleet([_paged_small()], max_queue=8,
+                        step_workers=1)
+        solo_fl.warmup()
+        srid = solo_fl.submit(vic_prompt, max_new_tokens=new_tokens)
+        while solo_fl.live():
+            solo_fl.step()
+        expected = solo_fl.result(srid)
+        solo_fl.close()
+
+        fl_p = Fleet([_paged_small()], max_queue=64,
+                     retry=RetryPolicy(max_attempts=10),
+                     step_workers=1, qos=_qos_policy())
+        fl_p.warmup()
+        settle = fl_p.submit(vic_prompt, max_new_tokens=new_tokens,
+                             tenant="batch")
+        while fl_p.live():
+            fl_p.step()
+        fl_p.result(settle)
+        traces_p = ledger.total_traces()
+        # oth first, vic second: the victim picker takes the
+        # youngest (highest-rid) batch request, so the request we
+        # pin against the solo run is the one evicted
+        oth = fl_p.submit(oth_prompt, max_new_tokens=new_tokens,
+                          tenant="batch")
+        vic = fl_p.submit(vic_prompt, max_new_tokens=new_tokens,
+                          tenant="batch")
+        for _ in range(3):
+            fl_p.step()
+        hi = fl_p.submit(hi_prompt, max_new_tokens=new_tokens,
+                         tenant="interactive")
+        while fl_p.live():
+            fl_p.step()
+        fl_p.result(oth)
+        fl_p.result(hi)
+        got = fl_p.result(vic)
+        pre_n = fl_p.stats()["preemptions"]
+        retr_p = ledger.total_traces() - traces_p
+        fl_p.close()
+        matched = sum(1 for a, b in zip(got, expected) if a == b)
+        emit(metric="gpt_tiny_fleet_qos_preemption_parity",
+             value=round(matched / max(len(expected), 1), 4),
+             unit="ratio", vs_baseline=None,
+             matched_tokens=matched,
+             expected_tokens=len(expected),
+             preemptions=pre_n,
+             steady_state_retraces=retr_p,
+             note=f"greedy tokens of a preempted-then-readmitted "
+                  f"batch request vs an undisturbed solo paged "
+                  f"engine: anything but 1.0 means eviction "
+                  f"perturbed decode; steady_state_retraces counts "
+                  f"ledger traces across the WARMED episode and "
+                  f"must be 0 — check_bench_trend gates both on "
+                  f"every backend (determinism, not timing)")
+
     lint_errors = 0
     if "--graph-lint" in sys.argv:
         # prepend static graph-lint findings to the telemetry stream

@@ -104,6 +104,20 @@ THIS gate validates the trend ACROSS rounds).  Two failure classes:
    partitioning).  Shrinkage is the ROADMAP item 2 direction and never
    gates.  Stale replays are partitioned out like everything else.
 
+9. **QoS-plane regression** (schema v14 fields from the ``bench.py
+   --fleet`` QoS leg).  Per-class goodput lines carry ``qos_class`` +
+   ``slo_attainment``; attainment trends per (metric, backend) like
+   the tenant column (timing-derived: accelerator gates, CPU warns),
+   and the ``*_qos_aggregate_goodput`` line's ``vs_baseline`` — the
+   QoS-tagged pass over the untagged baseline — dropping below 0.95
+   follows the same policy (the WFQ plane is allowed ~5% overhead,
+   not more).  The ``*_preemption_parity`` line is NOT timing: a
+   preempted-then-readmitted request's tokens must equal an
+   undisturbed run token-for-token, so a fresh parity off 1.0 by more
+   than 1% is a deterministic exactness violation that gates on every
+   backend (the steady-state-retrace rule — and the line's own
+   ``steady_state_retraces`` must be 0, enforced by the v10 gate).
+
 Stale replays are partitioned out of the trend entirely: a replay can
 neither regress nor improve a metric (r04/r05's 1830 img/s replays do
 not count as beating r02's fresh 508.6 — the tunnel was wedged, nobody
@@ -267,6 +281,9 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
     # (metric, backend) -> (round_name, kv_waste_bytes) of the
     # KV-plane trend (schema v12)
     last_waste = {}
+    # (metric, backend) -> (round_name, slo_attainment) of the
+    # per-class attainment trend (schema v14)
+    last_class_attain = {}
     # (entry_point, backend) -> (round_name, replicated_bytes) of the
     # replication-ledger trend (schema v13)
     last_repl = {}
@@ -487,6 +504,76 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             else:
                 errors.append(msg)
 
+    def track_qos_fields(rname, rec):
+        """QoS-plane gates for one fresh metric line (schema v14).
+        Three columns: the preemption-parity check (exact token
+        equality of a preempted-then-readmitted request vs an
+        undisturbed run — deterministic, gates on every backend, the
+        steady-state-retrace rule), the per-class ``slo_attainment``
+        trend (timing-derived: accelerator gates, CPU warns, the
+        tenant rule), and the aggregate-goodput overhead bound (the
+        QoS pass's ``vs_baseline`` vs the untagged pass must stay
+        >= 0.95 — timing-derived, same policy)."""
+        subject = rec.get("metric")
+        if not isinstance(subject, str) or not subject:
+            return
+        if subject.endswith("_preemption_parity"):
+            val = rec.get("value")
+            if (isinstance(val, (int, float))
+                    and not isinstance(val, bool)
+                    and abs(val - 1.0) > 0.01):
+                errors.append(
+                    f"{rname}: {subject} "
+                    f"[{rec.get('backend') or '?'}] preemption parity "
+                    f"is {val:.4g}, not 1.0 — a preempted request's "
+                    f"replayed tokens diverged from the undisturbed "
+                    f"run (eviction perturbed decode state: blocks "
+                    f"not recycled cleanly, or the sampling stream "
+                    f"is not request-intrinsic); exactness is "
+                    f"deterministic, so this gates on every backend")
+            return
+        if subject.endswith("_qos_aggregate_goodput"):
+            vb = rec.get("vs_baseline")
+            if (isinstance(vb, (int, float))
+                    and not isinstance(vb, bool) and vb < 0.95):
+                msg = (f"{rname}: {subject} "
+                       f"[{rec.get('backend') or '?'}] QoS aggregate "
+                       f"goodput is {vb:.3g}x the untagged baseline "
+                       f"(bound 0.95) — the WFQ plane is taxing total "
+                       f"throughput beyond its ~5% allowance")
+                if is_cpu(rec) and not strict_cpu:
+                    warnings.append(msg + " [cpu smoke: warning only]")
+                else:
+                    errors.append(msg)
+            return
+        if "qos_class" not in rec:
+            return
+        att = rec.get("slo_attainment")
+        if (not isinstance(att, (int, float)) or isinstance(att, bool)
+                or not (0.0 <= att <= 1.0)):
+            return
+        key = (subject, rec.get("backend"))
+        prev = last_class_attain.get(key)
+        last_class_attain[key] = (rname, float(att))
+        if prev is None:
+            return
+        pname, pval = prev
+        if pval <= 0:
+            return
+        drop = (pval - att) / pval
+        if drop > tol:
+            msg = (f"{rname}: {subject} "
+                   f"[{rec.get('backend') or '?'}] slo_attainment "
+                   f"dropped {drop * 100:.0f}% vs {pname} "
+                   f"({pval:.4g} -> {att:.4g}, tol "
+                   f"{tol * 100:.0f}%) — this priority class's "
+                   f"deadlines stopped holding (did the flood start "
+                   f"starving it?)")
+            if is_cpu(rec) and not strict_cpu:
+                warnings.append(msg + " [cpu smoke: warning only]")
+            else:
+                errors.append(msg)
+
     def track_sharding_fields(rname, rec):
         """Replication-ledger gate for one fresh ``kind: sharding``
         record (schema v13).  ``replicated_bytes`` is statically
@@ -682,6 +769,7 @@ def check(directory, tol=0.25, strict_cpu=False, mem_tol=0.25,
             track_compile_fields(rname, rec)
             track_tenant_fields(rname, rec)
             track_kv_fields(rname, rec)
+            track_qos_fields(rname, rec)
             key = (rec["metric"], rec.get("backend"))
             prev = last_fresh.get(key)
             if prev is not None:

@@ -245,11 +245,16 @@ def main(argv):
         tz0 = json.loads(body)
         if (code != 200 or tz0.get("kind") != "tenants"
                 or tz0.get("tenant_names") != []
+                or tz0.get("class_names") != []
                 or tz0.get("by_source") != {}):
             errs.append(f"/tenantz empty shape wrong: {code} {tz0}")
         code, _, _ = _get(base + "/tenantz?tenant=acme")
         if code != 404:
             errs.append(f"/tenantz?tenant= with no source expected "
+                        f"404, got {code}")
+        code, _, _ = _get(base + "/tenantz?class=interactive")
+        if code != 404:
+            errs.append(f"/tenantz?class= with no source expected "
                         f"404, got {code}")
 
         # /tenantz — seeded tenant source + a raising one: per-tenant
@@ -289,6 +294,53 @@ def main(argv):
             errs.append(f"/tenantz unknown tenant expected 404, got "
                         f"{code}")
 
+        # /tenantz?class= — a QoS-aware source adds a per-class
+        # ``classes`` rollup (schema v14) next to its tenants; the
+        # filter narrows it per source, 404s only when NO source
+        # knows the class, and composes with ?tenant=
+        cbucket = dict(bucket, preempted=1, queue_depth=0,
+                       queue_cap=8, weight=8, preemptible=False)
+        srv.add_tenant_source("qosfleet", lambda: {
+            "tenants": {"acme": dict(bucket)},
+            "classes": {"interactive": dict(cbucket),
+                        "batch": dict(cbucket, weight=1,
+                                      preemptible=True)},
+            "tenants_dropped": 0, "preemptions": 2})
+        code, _, body = _get(base + "/tenantz")
+        tzc = json.loads(body)
+        if (code != 200
+                or tzc.get("class_names") != ["batch", "interactive"]):
+            errs.append(f"/tenantz class_names wrong: {code} "
+                        f"{tzc.get('class_names')}")
+        code, _, body = _get(base + "/tenantz?class=interactive")
+        tzc = json.loads(body)
+        qf = tzc.get("by_source", {}).get("qosfleet", {})
+        if (code != 200 or tzc.get("class_filter") != "interactive"
+                or list(qf.get("classes", {})) != ["interactive"]
+                or qf["classes"]["interactive"] != cbucket):
+            errs.append(f"/tenantz?class=interactive filter broken: "
+                        f"{code} {qf.get('classes')}")
+        # the class filter must leave class-less sources intact (the
+        # plain fleet source has no classes block) and compose with
+        # the tenant filter
+        code, _, body = _get(
+            base + "/tenantz?tenant=acme&class=batch")
+        tzb = json.loads(body)
+        qf = tzb.get("by_source", {}).get("qosfleet", {})
+        if (code != 200 or list(qf.get("classes", {})) != ["batch"]
+                or list(qf.get("tenants", {})) != ["acme"]):
+            errs.append(f"/tenantz tenant+class compose broken: "
+                        f"{code} {qf}")
+        code, _, body = _get(base + "/tenantz?class=nope")
+        if code != 404:
+            errs.append(f"/tenantz unknown class expected 404, got "
+                        f"{code}")
+        else:
+            czerr = json.loads(body)
+            if "class" not in str(czerr.get("error", "")):
+                errs.append(f"/tenantz 404 body must name the class: "
+                            f"{czerr}")
+
         # sick supervisor flips /healthz to 503
         sup.observe_step(step=1, loss=float("nan"))
         code, _, body = _get(base + "/healthz")
@@ -306,7 +358,7 @@ def main(argv):
     print("server_smoke: all 8 endpoints OK (exposition conformant, "
           "schemas valid, profilez no-capture 404, compilez retrace "
           "differ verdict served, tenantz empty shape + per-tenant "
-          "rollup + 404, sick-run 503)")
+          "rollup + per-class ?class= filter + 404, sick-run 503)")
     return 0
 
 

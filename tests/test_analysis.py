@@ -1179,7 +1179,9 @@ def test_telemetry_jsonl_validates_mixed_stream():
          "submitted": 8, "finished": 8, "failed": 0, "shed": 0,
          "retries": 1, "failovers": 3, "drains": 0, "tokens": 64,
          # the per-tenant rollup, required fresh at schema v11
-         "tenants": {}, "tenants_dropped": 0})
+         "tenants": {}, "tenants_dropped": 0,
+         # the per-QoS-class rollup, required fresh at schema v14
+         "classes": {}, "preemptions": 0})
     trace_rec = exporters.JsonlExporter.enrich(
         {"kind": "trace", "trace_id": "fleet-1f-1/r0", "span_count": 2,
          "spans": [{"name": "fleet_submit", "ph": "i", "ts": 1.0,
