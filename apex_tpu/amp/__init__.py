@@ -17,7 +17,9 @@ from .handle import (scale_loss, scaled_grad, scaled_grad_accum,
                      disable_casts)
 from .scaler import LossScaler, ScalerState
 from ._process_optimizer import (AmpOptimizer, AmpOptState,
-                                 zero_optimizer_specs)
+                                 zero_optimizer_specs,
+                                 zero_gather_params,
+                                 zero_gather_checkpoint_policy)
 from ._initialize import AmpModel, cast_param_tree
 from ._amp_state import master_params, maybe_print
 from .policy import (CastPolicy, NoPolicy, current_policy, set_policy,

@@ -38,11 +38,41 @@ def _axis_in_scope(name: str) -> bool:
         return True
 
 __all__ = ["AmpOptState", "AmpOptimizer", "FlatMasters",
-           "zero_optimizer_specs"]
+           "zero_optimizer_specs", "zero_gather_params",
+           "zero_gather_checkpoint_policy"]
+
+
+def _zero_slice_groups(axis_name: str, ici: int):
+    """(ici_groups, dcn_groups) of the hierarchical fabric for the
+    mapped axis — the same consecutive-block/same-offset split the DDP
+    hierarchical allreduce uses (lazy import: the parallel package must
+    not enter amp's import graph at module load)."""
+    from ..parallel import topology as _topology
+    world = jax.lax.axis_size(axis_name)
+    return _topology.hierarchical_axis_groups(int(world), int(ici))
+
+
+def _validate_zero_knobs(zero_stage: int, zero_ici_size, compress: bool):
+    if zero_stage not in (1, 2, 3):
+        raise ValueError(f"zero_stage must be 1, 2 or 3, got "
+                         f"{zero_stage!r}")
+    if zero_stage >= 2 and zero_ici_size is None:
+        raise ValueError(
+            f"ZeRO stage {zero_stage} shards over the ICI slice of the "
+            f"hierarchical fabric; pass zero_ici_size= (devices per "
+            f"slice)")
+    if compress and zero_stage < 2:
+        raise ValueError(
+            "zero_compress_bf16 compresses the DCN hop of the stage-2/3 "
+            "grad reduction; stage 1 shards over the full axis and has "
+            "no DCN hop to shrink")
 
 
 def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
-                         axis_name: str = "data") -> Any:
+                         axis_name: str = "data",
+                         zero_stage: int = 1,
+                         zero_ici_size: Optional[int] = None,
+                         zero_compress_bf16: bool = False) -> Any:
     """PartitionSpec tree for ``optimizer.init(params, zero_axis=...)``
     run inside shard_map — flat master/moment shards are ``P(axis)``
     (device-concat layout), scalars replicated.  Use as the out_specs of
@@ -52,6 +82,18 @@ def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
         opt_state = jax.jit(jax.shard_map(
             lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
             in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+
+    The ZeRO knobs must MATCH the ``init`` call exactly: the layout is
+    the FlatMasters pytree's aux data, so a spec tree built with
+    different knobs is a different treedef and shard_map rejects it.
+    For stages 2/3 the buffer is the ICI-slice concat replicated across
+    slices, so the global view is still ``P(axis)`` over the mapped
+    axis only when every slice holds identical bytes — which the
+    stage-2/3 step maintains (DCN-reduced shards are bitwise equal);
+    the spec stays ``P(axis)`` for the world-concat layout of stage 1
+    and ``P()`` is wrong for all stages (the buffer is never
+    replicated per device).  Stage 2/3 specs remain ``P(axis)``: jax
+    materializes the device-concat global, slices repeat across DCN.
     """
     from jax.sharding import PartitionSpec as P
     if not (optimizer.master_weights
@@ -61,8 +103,13 @@ def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
         raise ValueError(
             "zero_axis requires master weights and an elementwise inner "
             "optimizer (the flat-buffer path)")
+    _validate_zero_knobs(zero_stage, zero_ici_size, zero_compress_bf16)
     layout = _FlatLayout(params)
     layout.zero_axis = axis_name
+    layout.zero_stage = int(zero_stage)
+    layout.zero_ici = (int(zero_ici_size) if zero_ici_size is not None
+                       else None)
+    layout.zero_compress = bool(zero_compress_bf16)
 
     def leaf_spec(l):
         return P() if getattr(l, "ndim", 0) == 0 else P(axis_name)
@@ -78,6 +125,207 @@ def zero_optimizer_specs(optimizer: "AmpOptimizer", params: Any,
     return AmpOptState(inner=inner_specs,
                        masters=FlatMasters(P(axis_name), layout),
                        scalers=scaler_specs)
+
+
+# checkpoint_name tag on the ZeRO-3 gathered flat parameter buffer —
+# the policy below rematerializes exactly this value in the backward
+ZERO3_GATHER_NAME = "zero3_gathered_params"
+
+
+# the gather -> rebuild chain of zero_gather_params, by primitive: the
+# remat policy must mark EVERY eqn on it unsaveable, because partial
+# eval cuts the replay at the first saveable ancestor — a name tag on
+# the leaves alone is useless when the producing slice/reshape/convert
+# outputs are unnamed saveable aliases one eqn upstream
+_ZERO3_REPLAY_PRIMS = frozenset(
+    ("all_gather", "slice", "dynamic_slice", "reshape",
+     "convert_element_type", "custom_vjp_call", "custom_vjp_call_jaxpr"))
+
+
+def zero_gather_checkpoint_policy():
+    """Rematerialization policy for a ZeRO-3 forward: save every
+    residual EXCEPT the just-in-time gathered parameters, which the
+    backward re-gathers from the master shard (one extra in-slice
+    all_gather on the wire — the ZeRO-3 trade: the full fp32 model
+    never stays live across the backward).  Activations stay saved;
+    only the gather/rebuild chain (and any other pure data-movement
+    slice/reshape/cast the model does) is recomputed.  Use as
+    ``jax.checkpoint(loss_fn, policy=zero_gather_checkpoint_policy())``
+    around a loss that calls :func:`zero_gather_params`."""
+    from jax._src.ad_checkpoint import name_p
+
+    def policy(prim, *_, **params):
+        if prim is name_p:
+            return params["name"] != ZERO3_GATHER_NAME
+        return prim.name not in _ZERO3_REPLAY_PRIMS
+    return policy
+
+
+def _zero3_gather_tables(layout: "_FlatLayout", ici: int):
+    """Static index tables for the ZeRO-3 mixed-dtype gather.
+
+    The wire-heavy gather runs at the model's half dtype (the values
+    the forward needs are ``half(master)`` anyway), but leaves that
+    stay fp32 (BN affine under O2) must arrive bit-exact — a bf16
+    round-trip would diverge from the replicated-param stages.  Those
+    "exact" elements are scattered through the flat buffer and the
+    shard cut does not align with leaf boundaries, so each device
+    contributes its local exact elements through a per-device index
+    row (padded to the max count ``M`` so the all_gather stays
+    uniform).  Returns ``(idx [ici, max(M,1)] int32 local-shard
+    indices, rebuild [n32] int32 indices into the gathered
+    [ici*max(M,1)] aux buffer, n32, M)`` — all plain numpy, computed
+    identically by :func:`zero_gather_params` and the comm plan so
+    graph and plan cannot desync on the aux payload."""
+    import numpy as np
+    padded = -(-layout.total // ici) * ici
+    shard = padded // ici
+    half = (str(layout.half_dtype) if layout.half_dtype is not None
+            else None)
+    pos = []
+    for dt, f, off, n in zip(layout.dtypes, layout.is_float,
+                             layout.offsets, layout.sizes):
+        if f and dt != half:
+            pos.extend(range(off, off + n))
+    per = [[p - d * shard for p in pos if d * shard <= p < (d + 1) * shard]
+           for d in range(ici)]
+    m_max = max((len(p) for p in per), default=0)
+    idx = np.zeros((ici, max(m_max, 1)), np.int32)
+    rebuild = np.zeros(len(pos), np.int32)
+    k = 0
+    for d, p in enumerate(per):
+        idx[d, :len(p)] = p
+        # offsets ascend, so concatenating the per-device partitions in
+        # device order walks the exact elements in layout order
+        for slot in range(len(p)):
+            rebuild[k] = d * max(m_max, 1) + slot
+            k += 1
+    return idx, rebuild, len(pos), m_max
+
+
+def zero_gather_params(masters: "FlatMasters", axis_name: Optional[str]
+                       = None) -> Any:
+    """ZeRO-3 just-in-time parameter materialization: all_gather the
+    master shard within its ICI slice, slice off the layout pad, and
+    rebuild the params tree at the model dtypes.
+
+    The gather runs at the model's HALF dtype when the layout has one
+    (O2): the forward only ever consumes ``half(master)``, so casting
+    the shard before the collective halves both the wire bytes and the
+    gathered buffer that XLA must hold live — the fp32 full model never
+    exists.  Leaves that stay fp32 (BN affine) ride a second tiny
+    all_gather of the exact elements (see :func:`_zero3_gather_tables`)
+    so their values match the replicated-param stages bit for bit.
+    All-fp32 layouts (no half dtype) fall back to one fp32 gather.
+
+    The backward is a hand-written VJP, not the autodiff transpose:
+    transposing 60+ per-leaf ``slice``/``reshape``/``cast`` chains
+    pads every leaf cotangent back to the FULL flat length and
+    ``add_any``s the padded buffers — XLA materializes several
+    whole-model fp32 temporaries.  The custom rule packs the leaf
+    cotangents with ONE concatenate (each element belongs to exactly
+    one leaf, so the values are bitwise those of the transpose) and
+    feeds the in-slice ``psum_scatter`` — which is exactly the flat
+    grad shard ``AmpOptimizer.step`` expects: call this at the top of
+    the loss function, differentiate w.r.t. ``masters`` (a pytree
+    whose only leaf is the shard), and pass the cotangent straight in
+    as ``scaled_grads``.
+
+    The gathered values are tagged ``checkpoint_name(...,
+    ZERO3_GATHER_NAME)``: wrap the loss function in
+    ``jax.checkpoint(f, policy=zero_gather_checkpoint_policy())`` and
+    the full parameter set is NOT a residual — the backward RE-GATHERS
+    the slice params just in time (everything else — activations —
+    stays saved) instead of holding ``total`` fp32 elements live
+    across the whole backward."""
+    from jax.ad_checkpoint import checkpoint_name
+    layout = masters.layout
+    if layout.zero_axis is None or layout.zero_stage != 3:
+        raise RuntimeError(
+            "zero_gather_params requires a ZeRO-3 layout (init with "
+            "zero_stage=3); stages 1/2 gather inside the step itself")
+    axis = axis_name if axis_name is not None else layout.zero_axis
+    ici_groups, _ = _zero_slice_groups(axis, layout.zero_ici)
+    padded = -(-layout.total // layout.zero_ici) * layout.zero_ici
+    half = layout.half_dtype
+    if half is not None:
+        idx_np, rebuild_np, n32, _ = _zero3_gather_tables(
+            layout, layout.zero_ici)
+        # concrete device constants (constvars in the jaxpr) — a plain
+        # numpy capture would stage per-dispatch device_put transfers
+        with jax.ensure_compile_time_eval():
+            idx_t = jnp.asarray(idx_np)
+            rebuild_t = jnp.asarray(rebuild_np)
+
+    @jax.custom_vjp
+    def gather(buf):
+        # the tag lands on every value derived from the gather that
+        # the backward would otherwise keep as a residual: the flat
+        # gathered buffer AND the reshaped/cast leaves (conv
+        # dgrad/wgrad read the leaves, not the buffer)
+        if half is None:
+            full = jax.lax.all_gather(
+                buf, axis, axis=0, tiled=True,
+                axis_index_groups=ici_groups)[:layout.total]
+            full = checkpoint_name(full, ZERO3_GATHER_NAME)
+            leaves = []
+            for shape, dt, off, n in zip(layout.shapes, layout.dtypes,
+                                         layout.offsets, layout.sizes):
+                piece = jax.lax.slice_in_dim(full, off, off + n)
+                piece = piece.reshape(shape)
+                if str(piece.dtype) != dt:
+                    piece = piece.astype(jnp.dtype(dt))
+                leaves.append(checkpoint_name(piece, ZERO3_GATHER_NAME))
+            return tuple(leaves)
+        fullh = jax.lax.all_gather(
+            buf.astype(half), axis, axis=0, tiled=True,
+            axis_index_groups=ici_groups)[:layout.total]
+        fullh = checkpoint_name(fullh, ZERO3_GATHER_NAME)
+        exact = None
+        if n32:
+            row = jnp.take(idx_t,
+                           jax.lax.axis_index(axis) % layout.zero_ici,
+                           axis=0)
+            aux = jnp.take(buf, row)
+            g32 = jax.lax.all_gather(aux, axis, axis=0, tiled=True,
+                                     axis_index_groups=ici_groups)
+            exact = jnp.take(g32, rebuild_t)
+        leaves, ex_off = [], 0
+        for shape, dt, f, off, n in zip(layout.shapes, layout.dtypes,
+                                        layout.is_float, layout.offsets,
+                                        layout.sizes):
+            if f and dt == str(half):
+                piece = jax.lax.slice_in_dim(fullh, off, off + n)
+                piece = piece.reshape(shape)
+            else:
+                piece = jax.lax.slice_in_dim(exact, ex_off, ex_off + n)
+                ex_off += n
+                piece = piece.reshape(shape).astype(jnp.dtype(dt))
+            leaves.append(checkpoint_name(piece, ZERO3_GATHER_NAME))
+        return tuple(leaves)
+
+    def gather_fwd(buf):
+        return gather(buf), None
+
+    def gather_bwd(_, cts):
+        # commit each cotangent to its leaf dtype before widening: XLA's
+        # excess-precision pass would otherwise elide the f16 round-trip
+        # (cotangent -> f16 -> f32) and hand the optimizer higher-precision
+        # grads than the replicated-param (ZeRO-1/2) path sees, breaking
+        # bitwise master parity across stages
+        cts = jax.lax.optimization_barrier(cts)
+        flat = jnp.concatenate(
+            [ct.astype(jnp.float32).reshape(-1) for ct in cts])
+        if padded != layout.total:
+            flat = jnp.pad(flat, (0, padded - layout.total))
+        shard = jax.lax.psum_scatter(
+            flat, axis, scatter_dimension=0, tiled=True,
+            axis_index_groups=ici_groups)
+        return (shard,)
+
+    gather.defvjp(gather_fwd, gather_bwd)
+    return jax.tree_util.tree_unflatten(layout.treedef,
+                                        list(gather(masters.buf)))
 
 
 class AmpOptState(NamedTuple):
@@ -128,14 +376,27 @@ class _FlatLayout:
         self.half_dtype = (jnp.dtype(halves.pop()) if len(halves) == 1
                            else None)
 
-    # ZeRO-1: when set, the flat master/moment buffers hold only THIS
-    # device's slice (sharded over the named data axis); the step
-    # reduce-scatters grads and all-gathers the updated params
+    # ZeRO: when zero_axis is set, the flat master/moment buffers hold
+    # only THIS device's slice (sharded over the named data axis); the
+    # step reduce-scatters grads and all-gathers the updated params.
+    #   stage 1 — shard over the FULL axis (world-concat layout)
+    #   stage 2 — shard over the ICI slice of the hierarchical fabric
+    #             (zero_ici devices); state replicated across slices,
+    #             grads DCN-reduced on the 1/ici shard, params
+    #             re-gathered within the slice only
+    #   stage 3 — like 2, but params are NEVER gathered back by the
+    #             step: the fp32 master shard IS the parameter store
+    #             and the forward regathers just-in-time
+    #             (zero_gather_params)
     zero_axis: Optional[str] = None
+    zero_stage: int = 1
+    zero_ici: Optional[int] = None
+    zero_compress: bool = False       # bf16 DCN hop on the grad reduce
 
     # layouts are jit-cache keys via FlatMasters aux_data
     def _key(self):
-        return (self.treedef, self.shapes, self.dtypes, self.zero_axis)
+        return (self.treedef, self.shapes, self.dtypes, self.zero_axis,
+                self.zero_stage, self.zero_ici, self.zero_compress)
 
     def __eq__(self, other):
         return isinstance(other, _FlatLayout) and self._key() == other._key()
@@ -227,29 +488,68 @@ class AmpOptimizer(Optimizer):
         self._bound = None
 
     # -- functional API ----------------------------------------------------
-    def init(self, params: Any, zero_axis: Optional[str] = None
-             ) -> AmpOptState:
-        """``zero_axis``: ZeRO stage-1 — shard the fp32 masters and the
-        inner optimizer's moments across the named DATA-parallel mesh
-        axis (each device owns ``ceil(N/dp)`` elements of the flat
-        buffer).  Must run inside shard_map with the axis mapped (it
-        degrades to the full replicated state outside one); requires an
-        elementwise inner optimizer + master weights (the flat path).
-        The matching step reduce-scatters the UN-reduced local grads —
-        do NOT pre-allreduce them with DDP."""
+    def init(self, params: Any, zero_axis: Optional[str] = None,
+             zero_stage: int = 1, zero_ici_size: Optional[int] = None,
+             zero_compress_bf16: bool = False) -> AmpOptState:
+        """``zero_axis``: ZeRO — shard the fp32 masters and the inner
+        optimizer's moments across the named DATA-parallel mesh axis.
+        ``zero_stage`` picks how far the sharding goes:
+
+        * 1 (default) — shard over the FULL axis: each device owns
+          ``ceil(N/world)`` elements; the step reduce-scatters the
+          un-reduced grads and all-gathers the updated params.
+        * 2 — shard over the ICI slice (``zero_ici_size`` devices) of
+          the hierarchical fabric: state is replicated across slices,
+          grads are psum_scatter'd within the slice then DCN-reduced on
+          the 1/ici shard, and the updated params are gathered back
+          within the slice only (the DCN never carries params).
+        * 3 — like 2 for grads, but the step never gathers params
+          back: the fp32 master shard IS the parameter store, the
+          forward regathers just-in-time via :func:`zero_gather_params`
+          and the step receives the flat 1-D grad shard its transpose
+          produces.  Requires every param leaf to be floating point.
+
+        ``zero_compress_bf16`` (stages 2/3) quantizes only the DCN hop
+        of the grad reduction to bf16 — same contract as DDP's
+        ``allreduce_compress_bf16`` (fp32 accumulate, half wire).
+
+        Must run inside shard_map with the axis mapped (it degrades to
+        the full replicated state outside one); requires an elementwise
+        inner optimizer + master weights (the flat path).  The matching
+        step reduces the grads itself — do NOT pre-allreduce them with
+        DDP."""
         if zero_axis is not None and _axis_in_scope(zero_axis):
             if not (self.master_weights
                     and getattr(self.inner, "elementwise", False)):
                 raise ValueError(
                     "zero_axis requires master weights and an "
                     "elementwise inner optimizer (the flat-buffer path)")
+            _validate_zero_knobs(zero_stage, zero_ici_size,
+                                 zero_compress_bf16)
             layout = _FlatLayout(params)
             layout.zero_axis = zero_axis
+            layout.zero_stage = int(zero_stage)
+            layout.zero_ici = (int(zero_ici_size)
+                               if zero_ici_size is not None else None)
+            layout.zero_compress = bool(zero_compress_bf16)
+            if zero_stage == 3 and not all(layout.is_float):
+                raise ValueError(
+                    "ZeRO-3 rebuilds every param from the flat fp32 "
+                    "master shard; non-float leaves have no master "
+                    "storage to regather from")
             dp = jax.lax.axis_size(zero_axis)
-            shard_n = -(-layout.total // dp)          # ceil
+            if zero_stage >= 2:
+                # validates world % ici == 0 (static) and pins the
+                # slice geometry the step will reuse
+                _zero_slice_groups(zero_axis, layout.zero_ici)
+                shard_count = layout.zero_ici
+                idx = jax.lax.axis_index(zero_axis) % shard_count
+            else:
+                shard_count = dp
+                idx = jax.lax.axis_index(zero_axis)
+            shard_n = -(-layout.total // shard_count)          # ceil
             full = jnp.pad(layout.pack(params),
-                           (0, shard_n * dp - layout.total))
-            idx = jax.lax.axis_index(zero_axis)
+                           (0, shard_n * shard_count - layout.total))
             shard = jax.lax.dynamic_slice_in_dim(full, idx * shard_n,
                                                  shard_n)
             masters = FlatMasters(shard, layout)
@@ -341,22 +641,68 @@ class AmpOptimizer(Optimizer):
             raise RuntimeError(
                 f"optimizer state is ZeRO-sharded over axis {zaxis!r} "
                 f"but step() was called outside a shard_map mapping it")
-        if flat:
+        zstage = (opt_state.masters.layout.zero_stage if zero else 1)
+        zero_groups = (_zero_slice_groups(
+            zaxis, opt_state.masters.layout.zero_ici)
+            if zero and zstage >= 2 else None)
+        if zstage == 3 and zero:
+            # the gather transpose hands back the flat in-slice-summed
+            # grad SHARD (possibly still wrapped in the FlatMasters
+            # pytree scaled_grad differentiated through)
+            if isinstance(scaled_grads, FlatMasters):
+                scaled_grads = scaled_grads.buf
+            if (getattr(scaled_grads, "ndim", None) != 1
+                    or scaled_grads.shape
+                    != opt_state.masters.buf.shape):
+                raise ValueError(
+                    f"ZeRO-3 step expects the flat grad shard the "
+                    f"zero_gather_params transpose produces "
+                    f"(shape {opt_state.masters.buf.shape}), got "
+                    f"{getattr(scaled_grads, 'shape', type(scaled_grads))}")
+        elif flat:
             # fused-buffer hot path: one concat, one fused unscale, one
             # optimizer kernel, static slices back out
             scaled_grads = opt_state.masters.layout.pack(scaled_grads)
         if zero:
-            # ZeRO-1: reduce-scatter the UN-reduced local grads — each
-            # device receives the summed grads for exactly its master
-            # shard (the psum+slice DDP would do, in one collective),
-            # then averages like gradient_average
             layout = opt_state.masters.layout
             dp = jax.lax.axis_size(zaxis)
             shard_n = opt_state.masters.buf.shape[0]
-            scaled_grads = jnp.pad(
-                scaled_grads, (0, shard_n * dp - layout.total))
-            scaled_grads = jax.lax.psum_scatter(
-                scaled_grads, zaxis, scatter_dimension=0, tiled=True)
+            if zstage >= 2:
+                # ZeRO-2/3: two-level reduce mirroring the DDP
+                # hierarchical path — psum_scatter within the ICI slice
+                # lands the 1/ici shard, the DCN hop reduces only that
+                # shard (optionally as a bf16 all_gather + fp32 local
+                # sum), and unlike DDP there is no gather-back: the
+                # shard is exactly what the local optimizer state needs
+                ici_groups, dcn_groups = zero_groups
+                if zstage == 2:
+                    scaled_grads = jnp.pad(
+                        scaled_grads,
+                        (0, shard_n * layout.zero_ici - layout.total))
+                    scaled_grads = jax.lax.psum_scatter(
+                        scaled_grads, zaxis, scatter_dimension=0,
+                        axis_index_groups=ici_groups, tiled=True)
+                # stage 3 grads arrive already in-slice summed (the
+                # all_gather transpose is exactly that psum_scatter)
+                if layout.zero_compress:
+                    q = scaled_grads.astype(jnp.bfloat16)
+                    wire = jax.lax.all_gather(
+                        q, zaxis, axis_index_groups=dcn_groups)
+                    scaled_grads = jnp.sum(
+                        wire.astype(jnp.float32), axis=0)
+                else:
+                    scaled_grads = jax.lax.psum(
+                        scaled_grads, zaxis,
+                        axis_index_groups=dcn_groups)
+            else:
+                # ZeRO-1: reduce-scatter the UN-reduced local grads —
+                # each device receives the summed grads for exactly its
+                # master shard (the psum+slice DDP would do, in one
+                # collective), then averages like gradient_average
+                scaled_grads = jnp.pad(
+                    scaled_grads, (0, shard_n * dp - layout.total))
+                scaled_grads = jax.lax.psum_scatter(
+                    scaled_grads, zaxis, scatter_dimension=0, tiled=True)
             scaled_grads = scaled_grads / dp
         grads32, found_inf = self.scaler.unscale(scaled_grads, sstate)
         if found_inf_extra is not None:
@@ -372,23 +718,38 @@ class AmpOptimizer(Optimizer):
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(opt_state.scalers))
 
-        if zero:
+        if zero and zstage == 3:
+            def do_update(operand):
+                p, masters, inner = operand
+                # the master shard IS the parameter store: update it in
+                # place, no half copy, no gather-back — the next
+                # forward's zero_gather_params reads the new shard
+                new_buf, new_inner = self.inner.update(
+                    grads32, inner, masters.buf)
+                return p, FlatMasters(new_buf, masters.layout), new_inner
+        elif zero:
+            gather_groups = zero_groups[0] if zstage == 2 else None
+
             def do_update(operand):
                 p, masters, inner = operand
                 layout = masters.layout
                 new_buf, new_inner, half = self._flat_inner_step(
                     masters, inner, grads32)
-                # params are replicated: gather every shard's update.
+                # params are replicated: gather every shard's update
+                # (stage 2: within the ICI slice only — cross-slice
+                # shards are bitwise equal after the DCN grad reduce).
                 # rebuild reads full32 only for fp32 float leaves — skip
                 # that gather (the biggest collective here) when every
                 # float leaf has the half dtype
                 any_fp32 = any(f and d == "float32" for f, d in
                                zip(layout.is_float, layout.dtypes))
                 full32 = (jax.lax.all_gather(
-                    new_buf, zaxis, axis=0, tiled=True)[:layout.total]
+                    new_buf, zaxis, axis=0, tiled=True,
+                    axis_index_groups=gather_groups)[:layout.total]
                     if any_fp32 or half is None else None)
                 full_half = (jax.lax.all_gather(
-                    half, zaxis, axis=0, tiled=True)[:layout.total]
+                    half, zaxis, axis=0, tiled=True,
+                    axis_index_groups=gather_groups)[:layout.total]
                     if half is not None else None)
                 new_p = layout.rebuild(full32, full_half,
                                        jax.tree_util.tree_leaves(p))
@@ -431,7 +792,14 @@ class AmpOptimizer(Optimizer):
         # it DCE'd — no cost unless consumed.  Under ZeRO each device
         # holds a disjoint grad window, so the squared sums psum to the
         # global norm (the pad elements are zero).
-        if zero:
+        if zero and zstage >= 2:
+            # windows are disjoint within the slice but REPLICATED
+            # across slices (post-DCN grads are identical): a full-axis
+            # psum would overcount by dcn_size
+            grad_norm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(jnp.square(grads32)), zaxis,
+                axis_index_groups=zero_groups[0]))
+        elif zero:
             grad_norm = jnp.sqrt(jax.lax.psum(
                 jnp.sum(jnp.square(grads32)), zaxis))
         else:

@@ -677,12 +677,15 @@ class CollectiveRule(Rule):
         thresh = int(inter.get("min_payload_bytes", 16))
         ordered = list(G.walk_jaxpr(graph.jaxpr))
         first_coll = None
+        coll_pos: List[int] = []
         matmul_pos: List[int] = []
         for i, e in enumerate(ordered):
             name = e.primitive.name
-            if (first_coll is None and name in G.COLLECTIVE_PRIMS
+            if (name in G.COLLECTIVE_PRIMS
                     and G.eqn_payload_bytes(e) >= thresh):
-                first_coll = i
+                if first_coll is None:
+                    first_coll = i
+                coll_pos.append(i)
             if name in ("dot_general", "conv_general_dilated"):
                 matmul_pos.append(i)
         if first_coll is None:
@@ -717,7 +720,37 @@ class CollectiveRule(Rule):
                     f"nothing is left for the reduction to overlap "
                     f"with", matmuls_after=after, floor=floor,
                 first_collective_eqn=first_coll))
+        # the per-stage pin: one bucket sneaking ahead of the last
+        # matmul satisfies the first-collective check even if every
+        # OTHER stage's reduction collapsed to reduce-after-backward.
+        # The schedule knows exactly how many bucket eqns belong to
+        # stages issued before the last one, so it declares a floor on
+        # qualifying collectives preceding the last matmul
+        # (parallel.overlap_collective_expectations).
+        coll_floor = inter.get("min_collectives_before_last_matmul")
+        if coll_floor is not None:
+            before = sum(1 for i in coll_pos if i < last_mm)
+            if before < int(coll_floor):
+                out.append(self.finding(
+                    ep, f"only {before} gradient-bucket collective(s) "
+                        f"precede the last matmul (eqn #{last_mm}); "
+                        f"the overlap schedule issues "
+                        f">= {int(coll_floor)} before the final "
+                        f"stage's backward — the staged overlap "
+                        f"partially collapsed to "
+                        f"reduce-after-backward",
+                    collectives_before=before,
+                    floor=int(coll_floor),
+                    last_matmul_eqn=last_mm))
         return out
+
+
+# a declared max_replicated_bytes budget whose measured ledger value
+# sits below this fraction of it is "stale": the deterministic
+# propagation means real headroom never exceeds the declaration slack
+# (entry points declare ~1.05x measured), so >25% slack is a budget
+# that outlived a ZeRO-stage (or sharding) change and must ratchet down
+RATCHET_FRACTION = 0.75
 
 
 @register_rule
@@ -781,6 +814,20 @@ class SpecConsistencyRule(Rule):
                         f"{int(budget):,} — largest contributor: "
                         f"{worst.dtype}{list(worst.shape)} x"
                         f"{worst.replication_factor} ({worst.spec})",
+                    replicated_bytes=repl, budget_bytes=int(budget)))
+            elif repl < int(int(budget) * RATCHET_FRACTION):
+                # the ratchet-both-ways contract: a ZeRO stage that
+                # collapses the replicated state must tighten the
+                # declared budget with it, or the budget silently
+                # stops guarding anything (a later regression back to
+                # full replication would still "pass")
+                out.append(self.finding(
+                    ep, f"replication budget is stale: the ledger "
+                        f"reports {repl:,} world-total duplicate "
+                        f"bytes but {int(budget):,} are budgeted "
+                        f"(> {100 - int(RATCHET_FRACTION * 100)}% "
+                        f"headroom) — ratchet max_replicated_bytes "
+                        f"down to the measured value",
                     replicated_bytes=repl, budget_bytes=int(budget)))
         return out
 

@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -754,7 +755,11 @@ def entry_point_sharding_record(ep, top_n: int = 8) -> Dict[str, Any]:
     argument_bytes = sum(a.argument_bytes for a in analyses)
     replicated = sum(a.replicated_bytes for a in analyses)
     unique = sum(a.unique_bytes for a in analyses)
-    return {
+    # schema v15: zero EPs name their stage in the registry name
+    # (ddp_resnet18_o2_zero3, ddp_mlp_overlap_zero2) — stamp it so the
+    # ledger says which stage its replicated_bytes claim measured
+    zero_m = re.search(r"zero([123])", ep.name)
+    rec = {
         "kind": "sharding",
         "entry_point": ep.name,
         "source": "jaxpr",
@@ -771,3 +776,6 @@ def entry_point_sharding_record(ep, top_n: int = 8) -> Dict[str, Any]:
         "top_replicated": top,
         "resharding_eqns": resharding,
     }
+    if zero_m:
+        rec["zero_stage"] = int(zero_m.group(1))
+    return rec

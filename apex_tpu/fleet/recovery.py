@@ -396,18 +396,27 @@ class PreemptionGuard:
 
 def reshard_flat_state(tree: Any, total: int, old_world: int,
                        new_world: int) -> Any:
-    """Redistribute ZeRO-1 flat optimizer shards onto a resized world.
+    """Redistribute ZeRO flat optimizer shards onto a resized world.
 
-    The flat-buffer ZeRO-1 state (``amp.zero_optimizer_specs``) pads
+    The flat-buffer ZeRO state (``amp.zero_optimizer_specs``) pads
     every 1-D shard buffer — fp32 masters and the elementwise inner
-    optimizer's moment buffers — to a multiple of the world size so the
-    device-concat global splits evenly.  ``total`` is the logical
-    (unpadded) element count (``opt_state.masters.layout.total``);
-    every 1-D leaf of exactly the old padded length is sliced back to
-    ``total`` and zero-re-padded for ``new_world``.  Scalars and
-    non-flat leaves pass through unchanged.  Host-side numpy math —
-    the resharded tree is handed to the re-jitted step, whose
-    shard_map in_specs place the new shards on the survivors."""
+    optimizer's moment buffers — to a multiple of the shard population
+    so the device-concat global splits evenly.  ``total`` is the
+    logical (unpadded) element count
+    (``opt_state.masters.layout.total``); every 1-D leaf of exactly
+    the old padded length is sliced back to ``total`` and
+    zero-re-padded for the new population.  Scalars and non-flat
+    leaves pass through unchanged.  Host-side numpy math — the
+    resharded tree is handed to the re-jitted step, whose shard_map
+    in_specs place the new shards on the survivors.
+
+    ``old_world`` / ``new_world`` are the shard POPULATIONS, which is
+    what the buffers were padded for: the full axis size for ZeRO-1,
+    the ICI slice size (``layout.zero_ici``) for ZeRO-2/3 — an 8->4
+    world shrink at ici 4->2 resharding stage-2/3 state passes (4, 2)
+    here while the ZeRO-1 leg of the same shrink passes (8, 4).  The
+    math is identical: stage 2/3 state is replicated across slices, so
+    redistributing one slice's padding redistributes them all."""
     if old_world < 1 or new_world < 1:
         raise ValueError(f"world sizes must be >= 1, got {old_world} "
                          f"and {new_world}")

@@ -181,9 +181,21 @@ __all__ = ["SCHEMA_VERSION", "OVERLAP_MODES", "OVERLAP_SCHEDULE_FIELDS",
 # least one measured ``preemptions``, and reassemble from them —
 # check_bench_trend gates the parity at exactly 1.0 on EVERY backend
 # (determinism, not timing).
+# v15: the ZeRO weight-update sharding plane.  Fresh ZeRO bench lines
+# (``*zero*_train_throughput`` from the ``ddp_resnet18_o2_zero{1,2,3}``
+# / ``ddp_mlp_overlap_zero2`` legs) must carry ``zero_stage`` in
+# {1, 2, 3} — a sharded-update throughput number compared against the
+# wrong stage's baseline is the exact confusion the replication ledger
+# exists to prevent — and ``kind: sharding`` ledger records for zero
+# entry points carry the same tag so ``check_bench_trend`` can gate
+# ``replicated_bytes`` per (entry_point, backend) on every backend
+# with the stage visible in the gated record (the stage-3 ledger
+# collapse — masters ARE the params, nothing replicated but BN state
+# and scalars — is a per-stage claim, not a per-EP one).  Validated
+# whenever present at any version; required on fresh v15 records.
 # Validators gate each version's requirements on the record's DECLARED
-# version, so archived v1..v13 streams stay valid.
-SCHEMA_VERSION = 14
+# version, so archived v1..v14 streams stay valid.
+SCHEMA_VERSION = 15
 
 # how a serving engine admits requests and holds KV (stdlib-side
 # duplicate of the serving engines' ``admission_mode`` class attrs —
@@ -1033,6 +1045,25 @@ def validate_bench_record(rec: Any) -> List[str]:
                         f"value ({val}) inconsistent with "
                         f"matched_tokens/expected_tokens "
                         f"({expect:.4g})")
+    # ZeRO-tagged bench lines (bench.py --comm zero legs, schema v15):
+    # whenever a line names a ZeRO stage it must be a real one; fresh
+    # v15 zero train-throughput lines must say WHICH stage produced the
+    # number — trending a stage-3 rate against a stage-1 baseline
+    # unknowingly is the blind spot the tag closes.
+    if "zero_stage" in rec:
+        zs = rec["zero_stage"]
+        if not isinstance(zs, int) or isinstance(zs, bool) \
+                or zs not in (1, 2, 3):
+            errs.append(f"'zero_stage' must be 1, 2 or 3 when present, "
+                        f"got {zs!r}")
+    v15 = (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+           and sv_rec >= 15)
+    if (v15 and isinstance(metric, str) and "zero" in metric
+            and metric.endswith("_train_throughput")
+            and "error" not in rec and not rec.get("stale")
+            and "zero_stage" not in rec):
+        errs.append("fresh ZeRO train-throughput records must carry "
+                    "'zero_stage' (schema v15)")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:
@@ -1675,6 +1706,23 @@ def validate_sharding_record(rec: Any) -> List[str]:
             if not isinstance(n, int) or isinstance(n, bool) or n < 0:
                 errs.append(f"resharding_eqns[{prim!r}] must be an "
                             f"int >= 0, got {n!r}")
+    # v15: a ledger for a ZeRO entry point must say which stage it
+    # measured — stage 3's collapse (nothing replicated but BN state
+    # and scalars) is only comparable against stage 1/2 ledgers when
+    # each carries its stage; validated whenever present at any
+    # version, required on fresh v15 zero-EP records
+    if "zero_stage" in rec:
+        zs = rec["zero_stage"]
+        if not isinstance(zs, int) or isinstance(zs, bool) \
+                or zs not in (1, 2, 3):
+            errs.append(f"'zero_stage' must be 1, 2 or 3 when present, "
+                        f"got {zs!r}")
+    sv_rec = rec.get("schema_version")
+    if (isinstance(sv_rec, int) and not isinstance(sv_rec, bool)
+            and sv_rec >= 15 and isinstance(epn, str) and "zero" in epn
+            and not rec.get("stale") and "zero_stage" not in rec):
+        errs.append("fresh sharding records for ZeRO entry points must "
+                    "carry 'zero_stage' (schema v15)")
     try:
         json.dumps(rec)
     except (TypeError, ValueError) as e:

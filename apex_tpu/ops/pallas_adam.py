@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_common import (BLOCK_ROWS, LANES, from_2d, interpret, to_2d)
+from .pallas_common import (LANES, from_2d, interpret, pick_block_rows,
+                            to_2d)
 
 
 def _adam_kernel(scal_ref, p_ref, m_ref, v_ref, g_ref,
@@ -52,13 +53,18 @@ def _adam_kernel(scal_ref, p_ref, m_ref, v_ref, g_ref,
                               "weight_decay", "half_dtype"))
 def _adam_flat(p, m, v, g, step_size, combined_scale, *, beta1, beta2, eps,
                eps_inside_sqrt, weight_decay, half_dtype):
-    p2, n = to_2d(p)
-    m2, _ = to_2d(m)
-    v2, _ = to_2d(v)
-    g2, _ = to_2d(g)
+    # shard-aware block sizing: a ZeRO master shard (1/ici or 1/world
+    # of the model) must stay ONE kernel launch without padding up to a
+    # full 512-row block — pick_block_rows shrinks the block (multiple
+    # of the fp32 min-tile sublanes) for sub-block buffers
+    block_rows = pick_block_rows(p.shape[0])
+    p2, n = to_2d(p, block_rows)
+    m2, _ = to_2d(m, block_rows)
+    v2, _ = to_2d(v, block_rows)
+    g2, _ = to_2d(g, block_rows)
     rows = p2.shape[0]
-    grid = rows // BLOCK_ROWS
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+    grid = rows // block_rows
+    blk = lambda: pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
     scal = jnp.stack([jnp.asarray(step_size, jnp.float32),
                       1.0 / jnp.asarray(combined_scale, jnp.float32)]

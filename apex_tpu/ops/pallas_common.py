@@ -32,12 +32,37 @@ def interpret() -> bool:
     return dispatch.interpret_mode()
 
 
-def to_2d(flat: jax.Array) -> Tuple[jax.Array, int]:
+# fp32 minimum tile is (8, 128): any block_rows the optimizer kernels
+# use must stay a multiple of this sublane count
+MIN_SUBLANES = 8
+
+
+def pick_block_rows(n: int) -> int:
+    """Rows per grid block for an ``n``-element buffer: BLOCK_ROWS for
+    full-model buffers, but a ZeRO-sharded update runs on a 1/ici (or
+    1/world) slice that can be far smaller than BLOCK_ELEMS — padding
+    it up to a 512-row block and launching a 1-block grid would move
+    up to 65535 dead elements through VMEM per operand.  For buffers
+    under one block, shrink the block to the smallest multiple of the
+    fp32 min-tile sublane count (8 rows x 128 lanes) that covers the
+    buffer, so the shard update stays ONE kernel launch with at most
+    one sublane tile of padding.  Rows stay divisible by the block by
+    construction — the partial-tile lint (analysis.pallas_lint) holds
+    for every shard size."""
+    rows = max(1, -(-int(n) // LANES))
+    if rows >= BLOCK_ROWS:
+        return BLOCK_ROWS
+    return -(-rows // MIN_SUBLANES) * MIN_SUBLANES
+
+
+def to_2d(flat: jax.Array, block_rows: int = BLOCK_ROWS
+          ) -> Tuple[jax.Array, int]:
     """Pad a 1-D buffer to a (rows, LANES) view, rows a multiple of
-    BLOCK_ROWS so every grid block is full.  Returns (arr2d, orig_len)."""
+    ``block_rows`` so every grid block is full.  Returns
+    (arr2d, orig_len)."""
     n = flat.shape[0]
     rows = max(1, -(-n // LANES))
-    rows = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
+    rows = -(-rows // block_rows) * block_rows
     padded = rows * LANES
     if padded != n:
         flat = jnp.pad(flat, (0, padded - n))

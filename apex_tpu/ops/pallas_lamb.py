@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_common import (BLOCK_ROWS, LANES, from_2d, interpret, to_2d)
+from .pallas_common import (LANES, from_2d, interpret, pick_block_rows,
+                            to_2d)
 
 
 def _stage1_kernel(scal_ref, g_ref, p_ref, m_ref, v_ref,
@@ -51,13 +52,16 @@ def _stage1_kernel(scal_ref, g_ref, p_ref, m_ref, v_ref,
                               "weight_decay", "adam_w_mode"))
 def _stage1_flat(g, p, m, v, inv_clip, inv_bc1, inv_bc2, *, beta1, beta2,
                  beta3, eps, weight_decay, adam_w_mode):
-    g2, n = to_2d(g)
-    p2, _ = to_2d(p)
-    m2, _ = to_2d(m)
-    v2, _ = to_2d(v)
+    # shard-aware block sizing (see pick_block_rows): a ZeRO shard
+    # update stays one launch instead of padding to a full block
+    block_rows = pick_block_rows(g.shape[0])
+    g2, n = to_2d(g, block_rows)
+    p2, _ = to_2d(p, block_rows)
+    m2, _ = to_2d(m, block_rows)
+    v2, _ = to_2d(v, block_rows)
     rows = g2.shape[0]
-    grid = rows // BLOCK_ROWS
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+    grid = rows // block_rows
+    blk = lambda: pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
     scal = jnp.stack([jnp.asarray(inv_clip, jnp.float32),
                       jnp.asarray(inv_bc1, jnp.float32),
@@ -84,12 +88,13 @@ def _stage2_kernel(lr_ref, p_ref, upd_ref, ratio_ref, p_out):
 
 @jax.jit
 def _stage2_flat(p, upd, ratio, lr):
-    p2, n = to_2d(p)
-    upd2, _ = to_2d(upd)
-    ratio2, _ = to_2d(ratio)
+    block_rows = pick_block_rows(p.shape[0])
+    p2, n = to_2d(p, block_rows)
+    upd2, _ = to_2d(upd, block_rows)
+    ratio2, _ = to_2d(ratio, block_rows)
     rows = p2.shape[0]
-    grid = rows // BLOCK_ROWS
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+    grid = rows // block_rows
+    blk = lambda: pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
     lr_s = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     new_p2 = pl.pallas_call(

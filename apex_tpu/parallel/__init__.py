@@ -12,6 +12,7 @@ from .distributed import (DistributedDataParallel, Reducer,
                           allreduce_grads_tree, allreduce_comm_plan,
                           plan_collective_expectations,
                           plan_resharding_expectations,
+                          zero_update_comm_plan,
                           predivide_factors, flat_dist_call,
                           staged_grads, overlap_comm_schedule,
                           overlap_schedule_fields,

@@ -54,7 +54,7 @@ from . import topology as _topology
 
 __all__ = ["DistributedDataParallel", "Reducer", "allreduce_grads_tree",
            "allreduce_comm_plan", "plan_collective_expectations",
-           "plan_resharding_expectations",
+           "plan_resharding_expectations", "zero_update_comm_plan",
            "predivide_factors", "flat_dist_call", "staged_grads",
            "overlap_comm_schedule", "overlap_schedule_fields",
            "overlap_collective_expectations", "OVERLAP_MODES"]
@@ -207,9 +207,27 @@ def _hierarchical_reduce(comm: jax.Array, axis_name: str,
     quantization error of THIS replica's own 1/ici shard on the bf16
     DCN hop — local elementwise math, no extra collectives, and
     ``None`` otherwise so the uninstrumented graph is unchanged."""
-    ici = len(ici_groups[0])
     n = comm.shape[0]
-    pad = (-n) % ici
+    shard, err = _hier_scatter_reduce(comm, axis_name, ici_groups,
+                                      dcn_groups, compress, want_error)
+    return _hier_gather(shard, axis_name, ici_groups, n), err
+
+
+def _hier_scatter_reduce(comm: jax.Array, axis_name: str,
+                         ici_groups, dcn_groups, compress: bool,
+                         want_error: bool = False
+                         ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """The scatter half of :func:`_hierarchical_reduce`: pad to the
+    slice size, ``psum_scatter`` within ICI, DCN-reduce the 1/ici
+    shard — and STOP.  This is exactly the ZeRO-2 gradient reduction
+    (arXiv:2004.13336's reduce-scatter placement with the gather-back
+    deleted): the caller that owns only the matching 1/ici optimizer
+    shard never needs the full gradient, so the in-slice all_gather of
+    grads is replaced by an all_gather of *updated params* after the
+    shard update (:func:`_hier_gather`, same payload, same fabric
+    level)."""
+    ici = len(ici_groups[0])
+    pad = (-comm.shape[0]) % ici
     if pad:
         comm = jnp.pad(comm, (0, pad))
     shard = lax.psum_scatter(comm, axis_name, scatter_dimension=0,
@@ -226,9 +244,16 @@ def _hierarchical_reduce(comm: jax.Array, axis_name: str,
         shard = jnp.sum(wire.astype(shard.dtype), axis=0)
     else:
         shard = lax.psum(shard, axis_name, axis_index_groups=dcn_groups)
+    return shard, err
+
+
+def _hier_gather(shard: jax.Array, axis_name: str, ici_groups,
+                 n: int) -> jax.Array:
+    """The gather half: in-slice ``all_gather`` of a 1/ici shard back
+    to the full (unpadded) buffer."""
     full = lax.all_gather(shard, axis_name,
                           axis_index_groups=ici_groups, tiled=True)
-    return (full[:n] if pad else full), err
+    return full[:n] if full.shape[0] != n else full
 
 
 def _path_str(path) -> str:
@@ -632,6 +657,144 @@ def plan_resharding_expectations(plan: List[dict],
     return exp
 
 
+def zero_update_comm_plan(params: Any, *, zero_stage: int,
+                          world: int, ici_size: Optional[int] = None,
+                          zero_compress_bf16: bool = False
+                          ) -> List[dict]:
+    """Static comm plan of one ZeRO-sharded optimizer step
+    (``amp.AmpOptimizer.step`` with a ``zero_axis`` layout), in the
+    same bucket schema as :func:`allreduce_comm_plan` so
+    :func:`plan_collective_expectations` and
+    :func:`plan_resharding_expectations` fold it unchanged — the
+    analysis rules pin the ZeRO collective structure from the same
+    source the runtime derives it from.  Buckets, by ``role``:
+
+    - ``grad_reduce`` — the gradient reduction.  Stage 1: one
+      full-axis ``reduce_scatter`` of the padded flat buffer (flat
+      accounting: every byte crosses the slowest link).  Stages 2/3:
+      the in-slice ``reduce_scatter`` plus the DCN reduce of the
+      1/ici shard (a ``psum``, or a bf16 ``all_gather`` when
+      compressed) — stage 3's scatter is the *transpose* of the
+      just-in-time parameter gather, but it is the same eqn with the
+      same payload, so the plan does not care who emitted it.
+    - ``param_gather`` (stages 1/2, one bucket per gathered dtype) —
+      the updated-shard all_gather back to full params: the half
+      model copy, plus the fp32 copy only when some float leaf stays
+      fp32 (``amp`` skips that gather otherwise, and so does the
+      plan).
+    - ``jit_gather`` (stage 3) — the ``zero_gather_params`` collectives
+      in the forward and again in the ``jax.checkpoint`` replay: the
+      half-dtype shard all_gather plus, when some float leaf stays
+      fp32, the tiny fp32 aux gather of the exact elements (one fp32
+      all_gather total when the layout has no half dtype).  Stage 3 has
+      NO param_gather buckets: the master shard is the parameter store.
+
+    ``params`` is the model parameter tree (shapes/dtypes only — the
+    plan is static)."""
+    from ..amp._process_optimizer import (_FlatLayout,
+                                          _validate_zero_knobs)
+    _validate_zero_knobs(zero_stage, ici_size, zero_compress_bf16)
+    layout = _FlatLayout(params)
+    n = layout.total
+    isz = 4                                    # grads reduce in fp32
+    if zero_stage >= 2:
+        ici = int(ici_size)
+        _topology.hierarchical_axis_groups(int(world), ici)
+        dcn = int(world) // ici
+        pop = ici
+        topo = "hierarchical"
+    else:
+        pop = ici = int(world)
+        dcn = 1
+        topo = "flat"
+    n_pad = n + ((-n) % pop)
+    m = n_pad // pop
+    half = layout.half_dtype
+    any_fp32 = any(f and d == "float32" for f, d in
+                   zip(layout.is_float, layout.dtypes))
+    n_float = sum(1 for f in layout.is_float if f)
+
+    def bucket(role, dtype, comm_dtype, leaves, elements, padded,
+               eqns, payload, ici_bytes, dcn_bytes, dcn_dt):
+        return {"role": role, "zero_stage": int(zero_stage),
+                "dtype": str(dtype), "comm_dtype": str(comm_dtype),
+                "leaves": leaves, "elements": elements,
+                "chunks": 1, "cause": "zero", "topology": topo,
+                "ici_size": ici, "dcn_size": dcn,
+                "wire_elements": elements + padded,
+                "padded_elements": padded,
+                "wire_bytes": sum(payload.values()),
+                "ici_wire_bytes": ici_bytes,
+                "dcn_wire_bytes": dcn_bytes,
+                "dcn_comm_dtype": str(jnp.dtype(dcn_dt)),
+                "eqns": eqns, "eqn_payload_bytes": payload}
+
+    plan: List[dict] = []
+    if zero_stage >= 2:
+        if zero_compress_bf16:
+            eqns = {"reduce_scatter": 1, "all_gather": 1}
+            payload = {"reduce_scatter": n_pad * isz,
+                       "all_gather": m * 2}
+            dcn_bytes, dcn_dt = m * 2, jnp.bfloat16
+        else:
+            eqns = {"reduce_scatter": 1, "psum": 1}
+            payload = {"reduce_scatter": n_pad * isz, "psum": m * isz}
+            dcn_bytes, dcn_dt = m * isz, jnp.float32
+        plan.append(bucket("grad_reduce", jnp.float32, jnp.float32,
+                           n_float, n, n_pad - n, eqns, payload,
+                           n_pad * isz, dcn_bytes, dcn_dt))
+    else:
+        plan.append(bucket("grad_reduce", jnp.float32, jnp.float32,
+                           n_float, n, n_pad - n,
+                           {"reduce_scatter": 1},
+                           {"reduce_scatter": n_pad * isz},
+                           n_pad * isz, n_pad * isz, jnp.float32))
+    if zero_stage == 3:
+        # the jit gather runs at the model half dtype when the layout
+        # has one (zero_gather_params): the half all_gather plus a tiny
+        # fp32 aux gather for the exact (non-half) elements; all-fp32
+        # layouts gather once in fp32.  Both appear twice: forward +
+        # remat replay (zero_gather_checkpoint_policy re-gathers in the
+        # backward instead of keeping the full model live).
+        if half is not None:
+            from ..amp._process_optimizer import _zero3_gather_tables
+            _, _, n32, m32 = _zero3_gather_tables(layout, ici)
+            hsz = jnp.dtype(half).itemsize
+            gathers = [(half, {"all_gather": m * hsz}, m * hsz)]
+            if n32:
+                gathers.append((jnp.float32,
+                                {"all_gather": max(m32, 1) * isz},
+                                max(m32, 1) * isz))
+        else:
+            gathers = [(jnp.float32, {"all_gather": m * isz}, m * isz)]
+        for _ in range(2):                     # forward + remat replay
+            for dt, payload, ici_bytes in gathers:
+                plan.append(bucket(
+                    "jit_gather", dt, dt, n_float,
+                    payload["all_gather"] // jnp.dtype(dt).itemsize, 0,
+                    {"all_gather": 1}, dict(payload),
+                    ici_bytes, 0, dt))
+    else:
+        gathers = []
+        if any_fp32 or half is None:
+            gathers.append((jnp.float32, 4,
+                            sum(1 for f, d in zip(layout.is_float,
+                                                  layout.dtypes)
+                                if f and d == "float32")))
+        if half is not None:
+            gathers.append((half, jnp.dtype(half).itemsize,
+                            sum(1 for f, d in zip(layout.is_float,
+                                                  layout.dtypes)
+                                if f and d == str(half))))
+        for dt, dsz, leaves in gathers:
+            b = m * dsz
+            plan.append(bucket(
+                "param_gather", dt, dt, leaves, m, 0,
+                {"all_gather": 1}, {"all_gather": b},
+                b, b if zero_stage == 1 else 0, dt))
+    return plan
+
+
 def _stamp_stage_labels(records: List[dict], stage: int,
                         issue_start: int) -> int:
     """Stamp one stage's bucket records (plan buckets OR runtime
@@ -711,7 +874,9 @@ def overlap_comm_schedule(stage_trees: Sequence[Any],
                           ici_size: Optional[int] = None,
                           world: Optional[int] = None,
                           nproc: Optional[int] = None,
-                          overlap: bool = True) -> Dict[str, Any]:
+                          overlap: bool = True,
+                          zero_stage: Optional[int] = None
+                          ) -> Dict[str, Any]:
     """The static overlap schedule: :func:`allreduce_comm_plan`
     extended with WHEN each bucket's reduction is issued, computed from
     shapes alone.  Returns::
@@ -731,7 +896,25 @@ def overlap_comm_schedule(stage_trees: Sequence[Any],
     trace time; ``tests/test_overlap.py`` pins the two sides equal.
     The collective lint rule derives its expectations (census, per-
     primitive payloads, AND the static interleaving property) from this
-    schedule via :func:`overlap_collective_expectations`."""
+    schedule via :func:`overlap_collective_expectations`.
+
+    ``zero_stage=2`` describes the ZeRO-2 fused staged step
+    (:meth:`DistributedDataParallel.staged_zero2_allreduce_grads`):
+    per-stage wire accounting is IDENTICAL to the plain hierarchical
+    schedule — the in-slice all_gather carries the *updated params*
+    instead of the reduced grads, same shard, same payload, same
+    fabric level — so the buckets are unchanged and the schedule is
+    merely tagged (requires ``comm_topology='hierarchical'``)."""
+    if zero_stage is not None:
+        if zero_stage != 2:
+            raise ValueError(
+                f"overlap_comm_schedule composes with ZeRO stage 2 "
+                f"only (stage 3's gather lives in the forward, not "
+                f"the grad schedule); got zero_stage={zero_stage!r}")
+        if comm_topology != "hierarchical":
+            raise ValueError(
+                "the fused ZeRO-2 staged schedule shards over the ICI "
+                "slice; comm_topology must be 'hierarchical'")
     order = _topology.overlap_issue_order(len(stage_trees))
     buckets: List[dict] = []
     issue = 0
@@ -748,6 +931,7 @@ def overlap_comm_schedule(stage_trees: Sequence[Any],
                              else "reduce_after_backward"),
             "n_stages": len(stage_trees),
             "issue_order": order,
+            "zero_stage": zero_stage,
             "buckets": buckets}
 
 
@@ -761,9 +945,12 @@ def overlap_schedule_fields(schedule: Optional[Dict[str, Any]]
     if schedule is None:
         return {"overlap_mode": "reduce_after_backward",
                 "n_stages": 1, "issue_order": [0]}
-    return {"overlap_mode": schedule["overlap_mode"],
-            "n_stages": int(schedule["n_stages"]),
-            "issue_order": [int(s) for s in schedule["issue_order"]]}
+    out = {"overlap_mode": schedule["overlap_mode"],
+           "n_stages": int(schedule["n_stages"]),
+           "issue_order": [int(s) for s in schedule["issue_order"]]}
+    if schedule.get("zero_stage") is not None:
+        out["zero_stage"] = int(schedule["zero_stage"])
+    return out
 
 
 def overlap_collective_expectations(schedule: Dict[str, Any],
@@ -786,9 +973,23 @@ def overlap_collective_expectations(schedule: Dict[str, Any],
         min_hop = min(
             min(b["dcn_wire_bytes"], b["ici_wire_bytes"])
             for b in schedule["buckets"])
+        # every bucket of every stage except the LAST-issued one (stage
+        # 0 — reverse AD drains back-to-front) is emitted before that
+        # stage's VJP, hence before the last grad matmul: each of its
+        # eqns clears min_payload_bytes (every per-eqn payload is at
+        # least its bucket's smaller fabric hop, which is at least the
+        # global min_hop), so the schedule implies an exact FLOOR on
+        # how many reductions precede the last matmul — the static
+        # proof that the overlap did not silently collapse to
+        # reduce-after-backward for all but one stage
+        last_stage = schedule["issue_order"][-1]
+        n_before = sum(sum(b["eqns"].values())
+                       for b in schedule["buckets"]
+                       if b["stage"] != last_stage)
         exp["interleaving"] = {
             "min_payload_bytes": max(int(min_hop), 16),
-            "min_matmuls_after": 1}
+            "min_matmuls_after": 1,
+            "min_collectives_before_last_matmul": int(n_before)}
     return exp
 
 
@@ -844,7 +1045,8 @@ class DistributedDataParallel:
                  comm_topology: str = "flat",
                  allreduce_compress_bf16: bool = False,
                  ici_size: Optional[int] = None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 zero_stage: Optional[int] = None):
         if shared_param is not None:
             raise ValueError("shared_param is deprecated (reference "
                              "distributed.py:176-180)")
@@ -912,6 +1114,28 @@ class DistributedDataParallel:
                     f"overlap=True issues per-stage bucket reductions "
                     f"inside the backward; these options contradict "
                     f"that schedule: {clashes}")
+        # zero_stage=2 arms the fused ZeRO-2 staged path
+        # (staged_zero2_allreduce_grads): per-stage scatter-reduce to
+        # the 1/ici shard, shard update, in-slice gather of the
+        # UPDATED params — state sharding composed with the overlap
+        # schedule.  Stages 1/3 shard inside amp.AmpOptimizer (the
+        # step owns the flat master buffer), not here.
+        if zero_stage is not None:
+            if zero_stage != 2:
+                raise ValueError(
+                    f"DistributedDataParallel composes with ZeRO "
+                    f"stage 2 only (stages 1/3 live in "
+                    f"amp.AmpOptimizer's flat-buffer step); got "
+                    f"zero_stage={zero_stage!r}")
+            if comm_topology != "hierarchical":
+                raise ValueError(
+                    "zero_stage=2 shards the update over the ICI "
+                    "slice; comm_topology must be 'hierarchical'")
+            if adasum:
+                raise ValueError("zero_stage=2 does not compose with "
+                                 "adasum (the butterfly replaces the "
+                                 "reduce-scatter the shard rides on)")
+        self.zero_stage = zero_stage
         self.allreduce_buffers: list = []
         # trace-time comm accounting (observability): one record per
         # bucket of the most recently traced allreduce — see
@@ -949,6 +1173,12 @@ class DistributedDataParallel:
     def allreduce_grads(self, grads: Any,
                         axis_index_groups: Optional[List[List[int]]] = None,
                         numerics_out: Optional[list] = None) -> Any:
+        if self.zero_stage is not None:
+            raise ValueError(
+                "zero_stage=2 shards the update — a full-gradient "
+                "allreduce would gather bytes the shard update never "
+                "reads; use staged_zero2_allreduce_grads (or "
+                "amp.AmpOptimizer's zero_axis step)")
         if not self.comm_enabled:
             self.last_comm_stats = []
             if self.gradient_average and not self.adasum:
@@ -1036,6 +1266,11 @@ class DistributedDataParallel:
             raise ValueError("staged_allreduce_grads does not compose "
                              "with adasum (the butterfly replaces the "
                              "bucket pipeline)")
+        if self.zero_stage is not None:
+            raise ValueError(
+                "zero_stage=2 replaces the per-stage gather-back of "
+                "grads with a gather of updated params; use "
+                "staged_zero2_allreduce_grads")
         if self.delay_allreduce or self.allreduce_trigger_params:
             raise ValueError(
                 "staged_allreduce_grads: stage boundaries define the "
@@ -1104,6 +1339,130 @@ class DistributedDataParallel:
             "world": world_static}
         self._record_comm_stats()
         return loss, grads
+
+    def staged_zero2_allreduce_grads(
+            self, stage_fns: Sequence[Callable], loss_head: Callable,
+            stage_params: Sequence[Any], x: Any,
+            update_shard: Callable) -> Tuple[jax.Array, List[Any]]:
+        """The fused ZeRO-2 overlapped step (requires
+        ``zero_stage=2``): the staged backward of
+        :meth:`staged_allreduce_grads`, but each stage's arrival-order
+        reduction is the *sharded weight update* instead of a plain
+        allreduce —
+
+        1. the stage's flat gradient bucket is scatter-reduced to its
+           1/ici shard (``psum_scatter`` within the ICI slice + the
+           DCN reduce, :func:`_hier_scatter_reduce` — the same eqns,
+           payloads and fabric levels as the hierarchical allreduce's
+           first two hops);
+        2. ``update_shard(stage, param_shard, grad_shard)`` applies
+           the optimizer to the local 1/ici window of the stage's
+           params — shard-sized math, one fused kernel launch when the
+           caller dispatches to the Pallas optimizer kernels;
+        3. the in-slice ``all_gather`` carries the UPDATED param shard
+           back (same payload the plain schedule spends gathering
+           reduced grads — ZeRO-2 costs nothing extra on the wire).
+
+        All three are issued the moment the stage's grads exist
+        (``overlap=True``), so by the time the backward reaches stage
+        0, the later stages' params for the next step are already in
+        flight — update/backward overlap on top of comm/backward
+        overlap.  With ``overlap=False`` the same chain runs after the
+        full backward (the pinned baseline).
+
+        Returns ``(loss, new_stage_params)`` — NOT grads: the update
+        already happened.  The traced schedule lands in
+        ``last_overlap_schedule`` tagged ``zero_stage=2``; bucket wire
+        accounting is byte-identical to
+        ``overlap_comm_schedule(..., zero_stage=2)``."""
+        if self.zero_stage != 2:
+            raise ValueError(
+                "staged_zero2_allreduce_grads requires "
+                "DistributedDataParallel(zero_stage=2, "
+                "comm_topology='hierarchical')")
+        if not self.comm_enabled:
+            raise ValueError(
+                "the ZeRO-2 compute twin is not wired: eliding the "
+                "scatter-reduce would update each shard with local "
+                "grads and the gathered params would diverge")
+        world_static = int(lax.axis_size(self.axis_name))
+        ici = (int(self.ici_size) if self.ici_size is not None
+               else _topology.default_ici_size(world_static))
+        ici_groups, dcn_groups = _topology.hierarchical_axis_groups(
+            world_static, ici)
+        compress = self.allreduce_compress_bf16
+        world_scalar = _axis_size(self.axis_name)
+        comm_stats: list = []
+        issue_state = {"comm": 0}
+
+        def reduce_stage(stage, issue, grads_s):
+            leaves, treedef = jax.tree_util.tree_flatten(grads_s)
+            dts = {jnp.dtype(l.dtype) for l in leaves}
+            if len(dts) != 1:
+                raise ValueError(
+                    f"stage {stage} mixes gradient dtypes {dts}: the "
+                    f"fused shard update runs on ONE flat buffer per "
+                    f"stage — cast the stage params to a single dtype")
+            (dt,) = dts
+            flat = (leaves[0].reshape(-1) if len(leaves) == 1 else
+                    jnp.concatenate([l.reshape(-1) for l in leaves]))
+            comm = (flat.astype(jnp.float32)
+                    if self.allreduce_always_fp32 else flat)
+            pre, post = predivide_factors(
+                world_scalar, self.gradient_predivide_factor)
+            if pre != 1.0:
+                comm = comm / jnp.asarray(pre, comm.dtype)
+            n = comm.shape[0]
+            g_shard, _ = _hier_scatter_reduce(
+                comm, self.axis_name, ici_groups, dcn_groups, compress)
+            if self.gradient_average:
+                g_shard = g_shard / post.astype(g_shard.dtype)
+            g_shard = g_shard.astype(dt)
+            m = g_shard.shape[0]
+            # the local window of the CURRENT params at the shard's
+            # offset — a static-offset slice, no communication
+            p_leaves = jax.tree_util.tree_leaves(stage_params[stage])
+            flat_par = (p_leaves[0].reshape(-1) if len(p_leaves) == 1
+                        else jnp.concatenate(
+                            [l.reshape(-1) for l in p_leaves]))
+            flat_par = jnp.pad(flat_par, (0, m * ici - n))
+            idx = lax.axis_index(self.axis_name) % ici
+            p_shard = lax.dynamic_slice_in_dim(flat_par, idx * m, m)
+            new_shard = update_shard(stage, p_shard, g_shard)
+            full = _hier_gather(new_shard, self.axis_name, ici_groups,
+                                n)
+            out, off = [], 0
+            for l in leaves:
+                sz = int(l.size)
+                out.append(full[off:off + sz].reshape(l.shape))
+                off += sz
+            acct = _bucket_wire_accounting(
+                n, comm.dtype, "hierarchical", ici, compress,
+                self.message_size, False, False)
+            rec = {"dtype": str(dt), "comm_dtype": str(comm.dtype),
+                   "leaves": len(leaves), "elements": int(n),
+                   **{k: v for k, v in acct.items()
+                      if k not in ("eqns", "eqn_payload_bytes")}}
+            issue_state["comm"] = _stamp_stage_labels(
+                [rec], stage, issue_state["comm"])
+            comm_stats.append(rec)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        loss, new_params = staged_grads(stage_fns, loss_head,
+                                        stage_params, x,
+                                        reduce_stage=reduce_stage,
+                                        overlap=self.overlap)
+        self.last_comm_stats = comm_stats
+        self.last_overlap_schedule = {
+            "overlap_mode": ("overlapped" if self.overlap
+                             else "reduce_after_backward"),
+            "n_stages": len(stage_fns),
+            "issue_order": _topology.overlap_issue_order(len(stage_fns)),
+            "zero_stage": 2,
+            "buckets": comm_stats,
+            "world": world_static}
+        self._record_comm_stats()
+        return loss, new_params
 
     def _record_comm_stats(self):
         """Fold the per-bucket accounting into the process observability

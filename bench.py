@@ -131,6 +131,16 @@ def _tpu_responsive(timeout_s: int = 180) -> bool:
         return False
 
 
+def require_shard_devices(ndev: int, n: int = 2):
+    """The ZeRO bench legs' device-count gate: a bare RuntimeError —
+    the same skippable class as the graph-lint entry points — so a
+    1-ambient-device host skips the legs instead of failing the run."""
+    if ndev < n:
+        raise RuntimeError(
+            f"the ZeRO legs shard the weight update over the data "
+            f"axis; {ndev} ambient device(s) admit no shard split")
+
+
 def main():
     import jax
 
@@ -1191,6 +1201,161 @@ def main():
                   "asserted positive on accelerator backends, "
                   "reported on CPU smoke where the virtual mesh "
                   "executes collectives synchronously")
+
+        # -- ZeRO weight-update sharding legs (zero1/2/3) ---------------
+        # one tiny O2 MLP train step per stage, AOT-compiled once so the
+        # memory plan describes the exact executable that was timed;
+        # every wire-byte field comes from zero_update_comm_plan and the
+        # cross-stage relationships are asserted from the plan, never
+        # eyeballed from the output.  Schema v15: each line carries its
+        # zero_stage.
+        def run_zero_legs():
+            require_shard_devices(ndev)
+            from apex_tpu import nn
+            from apex_tpu.observability import (
+                compilation as obscomp, costmodel, memory as obsmem)
+            net = nn.Sequential([nn.Flatten(), nn.Linear(64, 64),
+                                 nn.ReLU(), nn.Linear(64, 32)])
+            model, opt = amp.initialize(
+                net, optimizers.FusedAdam(lr=1e-2), opt_level="O2",
+                verbosity=0, hard_override=True)
+            params, _ = model.init(jax.random.PRNGKey(0))
+            B = 8 * ndev
+            rng = np.random.RandomState(0)
+            batch = (jnp.asarray(rng.randn(B, 64), jnp.float32),
+                     jnp.asarray(rng.randint(0, 32, B), jnp.int32))
+            stages = [1] + ([2, 3] if ici >= 2 else [])
+            if ici < 2:
+                print(f"bench --comm: {ndev} device(s) admit no "
+                      f"2-level split; zero2/zero3 legs skipped",
+                      file=sys.stderr)
+            plans = {}
+            for stage in stages:
+                isz = ici if stage >= 2 else None
+                plans[stage] = parallel.zero_update_comm_plan(
+                    params, zero_stage=stage, world=ndev,
+                    ici_size=isz)
+            if len(plans) == 3:
+                by_role = {s: {b["role"]: b for b in p}
+                           for s, p in plans.items()}
+                # the stage-2 point: the DCN carries exactly 1/ici of
+                # stage 1's flat-accounted grad payload
+                assert (by_role[2]["grad_reduce"]["dcn_wire_bytes"]
+                        * ici
+                        == by_role[1]["grad_reduce"]["dcn_wire_bytes"]
+                        ), (plans[1], plans[2])
+                # params never cross the DCN at stages 2/3
+                assert all(b["dcn_wire_bytes"] == 0
+                           for s in (2, 3) for b in plans[s]
+                           if b["role"] != "grad_reduce"), plans
+                # the stage-3 point: no param_gather back — only the
+                # just-in-time jit_gather, twice (forward + remat
+                # replay), at the model HALF dtype (2 bytes/elem, half
+                # a would-be fp32 gather)
+                assert ({b["role"] for b in plans[3]}
+                        == {"grad_reduce", "jit_gather"}), plans[3]
+                jg = [b for b in plans[3] if b["role"] == "jit_gather"]
+                assert sum(b["eqns"]["all_gather"] for b in jg) == 2
+                assert all(b["wire_bytes"] == b["elements"] * 2
+                           for b in jg), jg
+            ledger = obscomp.get_ledger()
+            for stage in stages:
+                isz = ici if stage >= 2 else None
+                ospecs = amp.zero_optimizer_specs(
+                    opt, params, "data", zero_stage=stage,
+                    zero_ici_size=isz)
+                ost0 = jax.jit(jax.shard_map(
+                    lambda p, _s=stage, _i=isz: opt.init(
+                        p, zero_axis="data", zero_stage=_s,
+                        zero_ici_size=_i),
+                    mesh=mesh, in_specs=(P(),), out_specs=ospecs,
+                    check_vma=False))(params)
+
+                if stage == 3:
+                    def step(ost, bt):
+                        xb, yb = bt
+
+                        def loss_fn(m):
+                            pp = amp.zero_gather_params(m)
+                            out, _ = model.apply(pp, xb, train=True)
+                            return F.cross_entropy(out, yb)
+
+                        loss, g = amp.scaled_grad(loss_fn,
+                                                  ost.masters, ost)
+                        _, ost2, _ = opt.step((), ost, g)
+                        return ost2, lax.pmean(loss, "data")
+                    state = ost0
+                    in_sp = (ospecs, (P("data"), P("data")))
+                    out_sp = (ospecs, P())
+                else:
+                    def step(st, bt):
+                        p, ost = st
+                        xb, yb = bt
+
+                        def loss_fn(pp):
+                            out, _ = model.apply(pp, xb, train=True)
+                            return F.cross_entropy(out, yb)
+
+                        loss, g = amp.scaled_grad(loss_fn, p, ost)
+                        p2, ost2, _ = opt.step(p, ost, g)
+                        return (p2, ost2), lax.pmean(loss, "data")
+                    state = (params, ost0)
+                    in_sp = ((P(), ospecs), (P("data"), P("data")))
+                    out_sp = ((P(), ospecs), P())
+                train = jax.jit(jax.shard_map(
+                    step, mesh=mesh, in_specs=in_sp, out_specs=out_sp,
+                    check_vma=False))
+                t0 = time.perf_counter()
+                try:
+                    traced = train.trace(state, batch)
+                    closed, lowered = traced.jaxpr, traced.lower()
+                except AttributeError:
+                    closed = jax.make_jaxpr(
+                        lambda s, b: train(s, b))(state, batch)
+                    lowered = train.lower(state, batch)
+                compiled = lowered.compile()
+                cold_ms = (time.perf_counter() - t0) * 1e3
+                traces_before = ledger.total_traces()
+                dt = timed(compiled, state, batch, 10, 2)
+                retraces = ledger.total_traces() - traces_before
+                assert retraces == 0, (
+                    f"zero{stage} timed loop re-traced {retraces}x")
+                cost = costmodel.jaxpr_cost(closed)
+                plan_mem = obsmem.memory_plan(compiled)
+                gb = plans[stage][0]          # the grad_reduce bucket
+                wire = {k: sum(b[k] for b in plans[stage])
+                        for k in ("wire_bytes", "ici_wire_bytes",
+                                  "dcn_wire_bytes")}
+                mdtype = cost.dominant_matmul_dtype or "float32"
+                metric = f"ddp_mlp_zero{stage}_train_throughput"
+                emit(kind="memory", metric=metric, source="compiled",
+                     zero_stage=stage, **cost.to_record(), **plan_mem)
+                emit(metric=metric, value=round(B / dt / ndev, 1),
+                     unit="samples/sec/chip", vs_baseline=None,
+                     zero_stage=stage, comm_topology=gb["topology"],
+                     compress=False, ici_size=gb["ici_size"],
+                     dcn_size=gb["dcn_size"], **wire,
+                     flops_per_step=cost.flops,
+                     peak_bytes=plan_mem["peak_bytes"],
+                     cold_compile_ms=round(cold_ms, 2),
+                     compiles_total=1, steady_state_retraces=retraces,
+                     **costmodel.mfu(cost.flops, dt, base["arch"],
+                                     mdtype),
+                     note=f"ZeRO-{stage} sharded weight update on the "
+                          f"{ndev}-device axis"
+                          + (f" (ici {ici})" if stage >= 2 else
+                             " (full-axis shards)")
+                          + "; wire bytes from zero_update_comm_plan, "
+                            "peak_bytes from the compiled plan of the "
+                            "timed executable")
+
+        try:
+            run_zero_legs()
+        except RuntimeError as e:
+            if type(e) is not RuntimeError:
+                raise
+            print(f"bench --comm: skipping zero legs: {e}",
+                  file=sys.stderr)
 
         if profile:
             # --comm --profile: capture the SAME executables the

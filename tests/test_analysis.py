@@ -1119,6 +1119,124 @@ def test_sharding_ledger_zero2_sharded_state_is_not_replicated():
     assert type(ei.value) is RuntimeError
 
 
+def test_sharding_rule_ratchet_flags_stale_budget_both_ways():
+    """The ratchet-down direction (RATCHET_FRACTION): a ZeRO stage
+    collapses the replicated state but the declared budget stays at
+    the pre-ZeRO value — with >25% headroom the ledger must flag the
+    stale declaration (else a regression back to full replication
+    would still 'pass'), while a snug budget at measured/0.75 does
+    not."""
+    trace = _sharded_trace(lambda x: jax.lax.psum(x, "data"),
+                           (P(),), P())
+    measured = 7 * 1024 * 4                       # world-total dupes
+    stale = _ep("mutant_stale_replication_budget",
+                expect={"sharding": {"mesh_axes": {"data": 8},
+                                     "divergent_outputs": 0,
+                                     "max_replicated_bytes":
+                                     measured * 2}},
+                trace=trace)
+    found = _run(stale, "sharding")
+    assert len(found) == 1, found
+    assert "stale" in found[0].message
+    assert found[0].detail["replicated_bytes"] == measured
+    assert found[0].detail["budget_bytes"] == measured * 2
+
+    snug = _ep("fixed_snug_replication_budget",
+               expect={"sharding": {"mesh_axes": {"data": 8},
+                                    "divergent_outputs": 0,
+                                    "max_replicated_bytes":
+                                    int(measured / 0.75)}},
+               trace=trace)
+    assert _run(snug, "sharding") == []
+
+
+def test_sharding_ledger_zero3_collapses_replicated_fraction():
+    """The tentpole acceptance pin: all four ZeRO entry points are
+    registered, and the stage-3 step's replication ledger collapses —
+    the fp32 master/moment state that rides every rank under plain DDP
+    (fraction > 0.8) becomes the parameter store's ICI shard, leaving
+    only BN state, scaler scalars and gather tables replicated
+    (fraction < 0.01, within the declared ratchet budget).  Records
+    carry the ``zero_stage`` stamp the v15 exporters gate on."""
+    for name in ("ddp_resnet18_o2_zero1", "ddp_resnet18_o2_zero2",
+                 "ddp_resnet18_o2_zero3", "ddp_mlp_overlap_zero2"):
+        assert name in analysis.ENTRY_POINTS
+    assert len(analysis.ENTRY_POINTS) >= 29
+
+    base = analysis.entry_point_sharding_record(
+        analysis.get("ddp_resnet18_o2"))
+    z3 = analysis.entry_point_sharding_record(
+        analysis.get("ddp_resnet18_o2_zero3"))
+    assert base["replicated_fraction"] > 0.80
+    assert z3["replicated_fraction"] < 0.01
+    assert z3["replicated_bytes"] <= 1_333_000    # the declared ratchet
+    assert z3["zero_stage"] == 3
+    assert "zero_stage" not in base
+    assert exporters.validate_sharding_record(
+        exporters.JsonlExporter.enrich(z3)) == []
+
+
+def test_zero2_overlap_interleaving_mutation_both_ways():
+    """The tentpole's fused-schedule position pin, mutation-proofed:
+    the SAME fused ZeRO-2 staged step traced with overlap=False
+    (identical census, payloads and fabric levels — the whole
+    scatter/update/gather chain just runs after the full backward)
+    must flag the ``min_collectives_before_last_matmul`` floor derived
+    from ``overlap_comm_schedule(zero_stage=2)``, and the overlapped
+    trace must lint clean under the same expectations."""
+    from apex_tpu import parallel
+    from jax import lax
+    ici, stages, hidden, B = 4, 4, 32, 8
+    ndev = len(jax.devices())
+    rng = np.random.RandomState(20)
+    stage_params = [
+        {"w": jnp.asarray(rng.randn(hidden, hidden) * 0.1, jnp.float32),
+         "b": jnp.zeros((hidden,), jnp.float32)}
+        for _ in range(stages)]
+    x = jnp.asarray(rng.randn(B, hidden), jnp.float32)
+    y = jnp.asarray(rng.randn(B, hidden), jnp.float32)
+    stage_fns = [lambda p, a: jnp.tanh(a @ p["w"] + p["b"])] * stages
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def graph_with(overlap):
+        ddp = parallel.DistributedDataParallel(
+            comm_topology="hierarchical", ici_size=ici,
+            overlap=overlap, zero_stage=2)
+
+        def step(params_list, batch):
+            xb, yb = batch
+            loss, new = ddp.staged_zero2_allreduce_grads(
+                stage_fns, lambda a: jnp.mean((a - yb) ** 2),
+                params_list, xb,
+                lambda stage, p_sh, g_sh: p_sh - 0.1 * g_sh)
+            return new, lax.pmean(loss, "data")
+
+        mapped = jax.shard_map(step, mesh=mesh,
+                               in_specs=(P(), (P("data"), P("data"))),
+                               out_specs=(P(), P()), check_vma=False)
+        return lambda: jax.make_jaxpr(mapped)(stage_params, (x, y))
+
+    schedule = parallel.overlap_comm_schedule(
+        stage_params, comm_topology="hierarchical", ici_size=ici,
+        world=ndev, nproc=1, overlap=True, zero_stage=2)
+    expect = {"collectives": parallel.overlap_collective_expectations(
+        schedule, extra_psums=2, extra_psum_bytes=2 * 4)}
+    assert expect["collectives"]["interleaving"][
+        "min_collectives_before_last_matmul"] > 0
+
+    broken = _ep("mutant_zero2_reduce_after_backward",
+                 expect=dict(expect), trace=graph_with(False))
+    found = _run(broken, "collective")
+    assert len(found) == 1, found
+    assert "reduce-after-backward schedule" in found[0].message
+    assert found[0].detail["first_collective_eqn"] > \
+        found[0].detail["last_matmul_eqn"]
+
+    fixed = _ep("fixed_zero2_overlapped",
+                expect=dict(expect), trace=graph_with(True))
+    assert _run(fixed, "collective") == []
+
+
 # -- findings as JSONL: schema + exporters integration --------------------
 
 def _enriched(finding):

@@ -142,3 +142,17 @@ def test_committed_record_is_valid():
     stale = bench.stale_lines(rec)
     assert stale[-1]["metric"] == bench.HEADLINE_METRIC
     assert stale[-1]["backend"] == "tpu"
+
+
+def test_zero_leg_device_gate_is_bare_runtime_error():
+    """The --comm ZeRO legs skip (not fail) on a 1-ambient-device host:
+    the gate raises a BARE RuntimeError — the same skippable class the
+    graph-lint entry points use — which bench catches with an exact
+    type check so real failures still propagate."""
+    import pytest
+    with pytest.raises(RuntimeError, match="no shard split") as ei:
+        bench.require_shard_devices(1)
+    assert type(ei.value) is RuntimeError      # bare, not a subclass
+    # 2+ devices pass straight through
+    bench.require_shard_devices(2)
+    bench.require_shard_devices(8)

@@ -1215,6 +1215,46 @@ def test_check_bench_trend_memory_and_mfu_gate(tmp_path):
     assert _run_trend(["--dir", str(d5), "--strict-cpu"]).returncode == 1
 
 
+def test_check_bench_trend_zero_peak_memory_ratchet(tmp_path):
+    """The ZeRO memory ratchet on the --comm zero legs: a stage
+    landing DROPS the leg's compiled peak_bytes and the trend accepts
+    the new floor without ceremony; the next round regressing back
+    toward the unsharded peak gates at --mem-tol on EVERY backend —
+    the compiled plan is deterministic, so CPU noise is no excuse
+    (same policy as the replication-ledger gate)."""
+
+    def zleg(peak, stage=3):
+        return exporters.JsonlExporter.enrich(
+            {"metric": f"ddp_mlp_zero{stage}_train_throughput",
+             "value": 5000.0, "unit": "samples/sec/chip",
+             "vs_baseline": None, "backend": "cpu", "ndev": 8,
+             "arch": "cpu", "peak_bytes": peak, "zero_stage": stage,
+             "flops_per_step": 1e6, "achieved_tflops": 0.001,
+             "mfu": None, "cold_compile_ms": 10.0,
+             "compiles_total": 1, "steady_state_retraces": 0})
+
+    # ratchet DOWN: the stage-3 peak collapse vs last round is clean
+    d1 = tmp_path / "zmem1"
+    d1.mkdir()
+    _trend_round(d1, "BENCH_r01.json", [zleg(151_000_000)])
+    _trend_round(d1, "BENCH_r02.json", [zleg(128_000_000)])
+    r = _run_trend(["--dir", str(d1), "--mem-tol", "0.05"])
+    assert r.returncode == 0, r.stderr
+
+    # ...and the ratcheted-down floor HOLDS: regressing back up past
+    # --mem-tol gates, even on the CPU backend
+    d2 = tmp_path / "zmem2"
+    d2.mkdir()
+    _trend_round(d2, "BENCH_r01.json", [zleg(128_000_000)])
+    _trend_round(d2, "BENCH_r02.json", [zleg(145_000_000)])  # +13%
+    r = _run_trend(["--dir", str(d2), "--mem-tol", "0.1"])
+    assert r.returncode == 1
+    assert "peak memory grew" in r.stderr
+    # the same growth inside a loosened tolerance passes
+    r = _run_trend(["--dir", str(d2), "--mem-tol", "0.25"])
+    assert r.returncode == 0, r.stderr
+
+
 def test_check_bench_trend_partitions_numerics_records(tmp_path):
     """kind: numerics gradient-health dumps (PR 9) are per-run
     diagnostics, not a cross-round trend: fresh ones pass through
@@ -2522,6 +2562,60 @@ def test_check_bench_trend_sharding_gate(tmp_path):
     r = _run_trend(["--dir", str(d4), "--mem-tol", "0.01"])
     assert r.returncode == 0, r.stderr
     assert "stale replays partitioned" in r.stderr
+
+
+def test_v15_zero_stage_records_and_version_gating():
+    """Schema v15 (the ZeRO weight-update plane): fresh zero
+    train-throughput lines and zero-EP sharding ledgers must carry
+    ``zero_stage`` in {1, 2, 3}; the field is value-checked wherever
+    it appears; archived v1..v14 streams re-validate clean at their
+    declared versions."""
+    assert exporters.SCHEMA_VERSION == 15
+    base = {"metric": "ddp_resnet18_o2_zero3_train_throughput",
+            "value": 100.0, "unit": "images/sec/chip",
+            "vs_baseline": None, "backend": "cpu", "ndev": 8,
+            "arch": "cpu", "flops_per_step": 1e12,
+            "achieved_tflops": 10.0, "mfu": None,
+            "peak_bytes": 1_000_000, "cold_compile_ms": 10.0,
+            "compiles_total": 1, "steady_state_retraces": 0,
+            "zero_stage": 3}
+    assert exporters.validate_bench_record(
+        exporters.JsonlExporter.enrich(dict(base))) == []
+    # fresh v15 zero line without the stage tag gates
+    rec = exporters.JsonlExporter.enrich(
+        {k: v for k, v in base.items() if k != "zero_stage"})
+    assert any("zero_stage" in e for e in
+               exporters.validate_bench_record(rec))
+    # ...but the same record declaring v14 rolls back clean
+    v14 = dict(rec, schema_version=14)
+    assert exporters.validate_bench_record(v14) == []
+    # non-zero train lines never need the tag
+    plain = exporters.JsonlExporter.enrich(
+        dict({k: v for k, v in base.items() if k != "zero_stage"},
+             metric="ddp_resnet18_o2_train_throughput"))
+    assert exporters.validate_bench_record(plain) == []
+    # the stage is value-checked wherever it appears (any metric)
+    for bad in (0, 4, True, "3", 2.0):
+        rec = exporters.JsonlExporter.enrich(
+            {"metric": "m", "value": 1.0, "unit": "x",
+             "vs_baseline": None, "backend": "cpu", "ndev": 8,
+             "arch": "cpu", "zero_stage": bad})
+        assert any("zero_stage" in e for e in
+                   exporters.validate_bench_record(rec)), bad
+
+    # sharding plane: fresh v15 ledgers for zero EPs carry the stage
+    zled = _ledger_rec("ddp_resnet18_o2_zero2", zero_stage=2)
+    assert exporters.validate_sharding_record(zled) == []
+    missing = {k: v for k, v in zled.items() if k != "zero_stage"}
+    assert any("zero_stage" in e for e in
+               exporters.validate_sharding_record(missing))
+    archived = dict(missing, schema_version=14)
+    assert exporters.validate_sharding_record(archived) == []
+    assert any("zero_stage" in e for e in
+               exporters.validate_sharding_record(
+                   dict(zled, zero_stage=7)))
+    # non-zero EPs stay exempt at v15
+    assert exporters.validate_sharding_record(_ledger_rec()) == []
 
 
 def test_check_bench_trend_skips_twin_anomaly_overlap_records(tmp_path):

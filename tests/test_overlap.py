@@ -420,3 +420,123 @@ def test_attribute_step_clamps_slow_compute_twin():
          **{k: att[k] for k in steptime.ATTRIBUTION_FIELDS},
          **{k: att[k] for k in steptime.OVERLAP_SCHEDULE_FIELDS}})
     assert exporters.validate_bench_record(rec) == []
+
+
+# -- the fused ZeRO-2 staged step ------------------------------------------
+
+def make_zero2_step(overlap, compress=False):
+    """(ddp, mapped_fn) for the fused ZeRO-2 staged step (SGD shard
+    update); the mapped fn returns (new per-stage params, loss)."""
+    ddp = parallel.DistributedDataParallel(
+        comm_topology="hierarchical", allreduce_compress_bf16=compress,
+        ici_size=4, overlap=overlap, zero_stage=2)
+
+    def step(params_list, batch):
+        xb, yb = batch
+        loss, new = ddp.staged_zero2_allreduce_grads(
+            STAGE_FNS, lambda a: jnp.mean((a - yb) ** 2), params_list,
+            xb, lambda stage, p_sh, g_sh: p_sh - 0.1 * g_sh)
+        return list(new), loss
+
+    mapped = jax.shard_map(step, mesh=_mesh(),
+                           in_specs=(P(), (P("data"), P("data"))),
+                           out_specs=(P(), P()), check_vma=False)
+    return ddp, mapped
+
+
+def test_staged_zero2_matches_unfused_update_and_baseline():
+    """Numerics pin for the fused chain: scatter-reduce -> shard
+    update -> in-slice gather lands on the SAME new params as the
+    plain staged reduction followed by the identical SGD update on the
+    full tree (rtol 1e-6) — fusing moves WHERE the update runs (on the
+    1/ici shard, inside the backward), never its math.  Overlap on/off
+    agree the same way (issue positions only)."""
+    _, fz_ov = make_zero2_step(True)
+    _, fz_ba = make_zero2_step(False)
+    nz_ov, _ = jax.jit(fz_ov)(STAGE_PARAMS, (X, Y))
+    nz_ba, _ = jax.jit(fz_ba)(STAGE_PARAMS, (X, Y))
+
+    _, f_g = make_staged_step(True)
+    g, _ = jax.jit(f_g)(STAGE_PARAMS, (X, Y))
+    ref = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg,
+                                 list(STAGE_PARAMS), list(g))
+    for a, b in zip(jax.tree_util.tree_leaves(nz_ov),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(nz_ov),
+                    jax.tree_util.tree_leaves(nz_ba)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_staged_zero2_schedule_tag_and_runtime_stats():
+    """Plan/runtime consistency for the fused path: the static
+    ``overlap_comm_schedule(zero_stage=2)`` and the traced
+    ``comm_stats`` agree bucket-for-bucket (stage, issue order, cause,
+    topology, wire bytes, both fabric levels), the traced schedule is
+    tagged ``zero_stage=2``, and the tag rides into the bench-record
+    schedule fields."""
+    ddp, fz = make_zero2_step(True)
+    jax.make_jaxpr(fz)(STAGE_PARAMS, (X, Y))
+    sched = parallel.overlap_comm_schedule(
+        STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+        world=8, nproc=1, zero_stage=2)
+    assert sched["zero_stage"] == 2
+    assert len(sched["buckets"]) == len(ddp.last_comm_stats) == S
+    for pb, rb in zip(sched["buckets"], ddp.last_comm_stats):
+        assert pb["stage"] == rb["stage"]
+        assert pb["issue_order"] == rb["issue_order"]
+        assert pb["cause"] == rb["cause"]
+        assert pb["topology"] == rb["topology"] == "hierarchical"
+        assert pb["wire_bytes"] == rb["bytes"]
+        assert pb["ici_wire_bytes"] == rb["ici_wire_bytes"]
+        assert pb["dcn_wire_bytes"] == rb["dcn_wire_bytes"]
+    ls = ddp.last_overlap_schedule
+    assert ls["zero_stage"] == 2
+    fields = parallel.overlap_schedule_fields(ls)
+    assert fields["zero_stage"] == 2
+    assert fields["overlap_mode"] == "overlapped"
+    # the non-zero schedule carries NO zero_stage key at all — absent,
+    # not None, so exporters can gate on presence
+    assert "zero_stage" not in parallel.overlap_schedule_fields(
+        ddp.last_overlap_schedule | {"zero_stage": None})
+
+
+def test_staged_zero2_knob_clashes():
+    """The fused path's guard rails: stage 2 only, hierarchical only,
+    no adasum; the method refuses a DDP without zero_stage=2 armed and
+    refuses the comm-disabled twin (eliding the scatter-reduce would
+    update each shard with LOCAL grads and the gathered params would
+    diverge)."""
+    with pytest.raises(ValueError, match="stage 2 only"):
+        parallel.DistributedDataParallel(
+            comm_topology="hierarchical", ici_size=4, zero_stage=3)
+    with pytest.raises(ValueError, match="hierarchical"):
+        parallel.DistributedDataParallel(zero_stage=2)
+    with pytest.raises(ValueError, match="adasum"):
+        parallel.DistributedDataParallel(
+            comm_topology="hierarchical", ici_size=4, zero_stage=2,
+            adasum=True)
+    with pytest.raises(ValueError, match="zero_stage"):
+        parallel.overlap_comm_schedule(
+            STAGE_PARAMS, comm_topology="hierarchical", ici_size=4,
+            world=8, nproc=1, zero_stage=1)
+
+    plain = parallel.DistributedDataParallel(
+        comm_topology="hierarchical", ici_size=4)
+    with pytest.raises(ValueError, match="zero_stage=2"):
+        plain.staged_zero2_allreduce_grads(
+            STAGE_FNS, lambda a: jnp.sum(a), STAGE_PARAMS, X,
+            lambda stage, p, g: p)
+
+    armed = parallel.DistributedDataParallel(
+        comm_topology="hierarchical", ici_size=4, zero_stage=2)
+    armed.comm_enabled = False
+    with pytest.raises(ValueError, match="compute twin"):
+        armed.staged_zero2_allreduce_grads(
+            STAGE_FNS, lambda a: jnp.sum(a), STAGE_PARAMS, X,
+            lambda stage, p, g: p)
+    # a full-gradient allreduce on a zero_stage=2 DDP is refused too
+    with pytest.raises(ValueError, match="shards the update"):
+        armed.allreduce_grads({"w": X})

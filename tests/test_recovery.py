@@ -331,6 +331,247 @@ def test_zero1_shards_redistribute_onto_survivors(tmp_path):
     np.testing.assert_allclose(post, undisturbed, rtol=1e-6)
 
 
+def _retree(ost, specs):
+    # transplant the state's leaves into the spec tree's treedef: the
+    # ZeRO-2/3 layout (zero_ici) is FlatMasters aux data, so a state
+    # resharded for a different world must also carry the new world's
+    # layout before shard_map will accept it against the new specs
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(specs),
+        jax.tree_util.tree_leaves(ost))
+
+
+def _dedup_slices(ost, padded, dcn):
+    # stage-2/3 host view is the device-concat over the FULL axis: the
+    # padded in-slice concat repeated dcn times (slices hold bitwise
+    # identical shards after the DCN reduce) — keep one copy
+    def fix(a):
+        if getattr(a, "ndim", 0) == 1 and a.shape[0] == dcn * padded:
+            return a[:padded]
+        return a
+    return jax.tree_util.tree_map(fix, ost)
+
+
+def _tile_slices(ost, padded, dcn):
+    # inverse of _dedup_slices: rebuild the device-concat global by
+    # repeating the slice concat across the DCN dimension
+    def fix(a):
+        if getattr(a, "ndim", 0) == 1 and a.shape[0] == padded:
+            return np.concatenate([np.asarray(a)] * dcn)
+        return a
+    return jax.tree_util.tree_map(fix, ost)
+
+
+def test_zero2_shards_redistribute_onto_survivors_hierarchical(tmp_path):
+    # 8 -> 4 world shrink where the ICI slice shrinks with it (4 -> 2):
+    # stage-2 shards live on the slice, so the redistribution population
+    # is layout.zero_ici, not the world — reshard_flat_state gets
+    # (old_ici, new_ici) and the state is re-treed onto the new layout
+    net = nn.Sequential([nn.Flatten(), nn.Linear(24, 10)])
+    model, optimizer = amp.initialize(
+        net, optimizers.FusedAdam(lr=1e-2), opt_level="O2",
+        verbosity=0, hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    total = optimizer.init(params).masters.buf.size
+    batches = _batches(10)
+
+    def ici_of(world):
+        return max(world // 2, 1)
+
+    def ospecs_for(world):
+        return amp.zero_optimizer_specs(
+            optimizer, params, "data", zero_stage=2,
+            zero_ici_size=ici_of(world))
+
+    def build_step(world):
+        mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+        ospecs = ospecs_for(world)
+
+        def step(state, batch):
+            p, ost = state
+            xb, yb = batch
+
+            def loss_fn(pp):
+                out, _ = model.apply(pp, xb, train=True)
+                return F.cross_entropy(out, yb)
+
+            loss, g = amp.scaled_grad(loss_fn, p, ost)
+            # stage 2 reduce-scatters in-slice + DCN-reduces inside
+            p, ost, _ = optimizer.step(p, ost, g)
+            return (p, ost), lax.pmean(loss, "data")
+
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=((P(), ospecs), (P("data"), P("data"))),
+            out_specs=((P(), ospecs), P()), check_vma=False))
+
+    def init_state(world):
+        mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+        opt0 = jax.jit(jax.shard_map(
+            lambda pp: optimizer.init(
+                pp, zero_axis="data", zero_stage=2,
+                zero_ici_size=ici_of(world)),
+            mesh=mesh, in_specs=(P(),), out_specs=ospecs_for(world),
+            check_vma=False))(params)
+        return (params, opt0)
+
+    def to_host(state):
+        # canonical form: population-1 buffers + the ici=1 layout, so
+        # snapshots taken at any world share one treedef
+        p, ost = _np_tree(state)
+        buf_len = ost.masters.buf.shape[0]
+        old_ici = next(i for i in (4, 2, 1)
+                       if buf_len == 2 * (total + (-total) % i))
+        padded = buf_len // 2
+        ost = _dedup_slices(ost, padded, 2)
+        ost = reshard_flat_state(ost, total, old_ici, 1)
+        return (p, _retree(ost, ospecs_for(2)))
+
+    def from_host(tree, world):
+        p, ost = tree
+        ici = ici_of(world)
+        ost = reshard_flat_state(ost, total, 1, ici)
+        ost = _tile_slices(ost, total + (-total) % ici, world // ici)
+        return (p, _retree(ost, ospecs_for(world)))
+
+    faults = TrainingFaults(replica_death=(3, 4), seed=0)
+    trainer = ElasticTrainer(
+        build_step, init_state(8), world=8, ckpt_dir=str(tmp_path),
+        to_host=to_host, from_host=from_host, faults=faults,
+        config=ElasticConfig(checkpoint_every=1, min_world=2),
+        registry=obs.MetricsRegistry(), run="zero2_elastic")
+    trainer.run(7, lambda i: batches[i])
+
+    assert trainer.world == 4
+    assert trainer.resumed_step == 3
+    # shards were redistributed for the SHRUNK slice: the global view
+    # is dcn(2) copies of the concat padded for ici 2, not ici 4
+    _, ost = trainer._state
+    assert ost.masters.buf.shape[0] == 2 * (total + (-total) % ici_of(4))
+    assert ost.masters.layout.zero_ici == ici_of(4)
+    assert trainer.history[-1][0] == 6
+
+    # undisturbed shrunk-world run from the same snapshot
+    template = to_host(init_state(8))
+    restored = ckpt.restore_checkpoint(str(tmp_path), template, step=3)
+    st = from_host(restored, 4)
+    step4 = build_step(4)
+    undisturbed = []
+    for i in range(3, 7):
+        st, loss = step4(st, batches[i])
+        undisturbed.append(float(loss))
+    post = [loss for s, loss, w in trainer.history if w == 4]
+    np.testing.assert_allclose(post, undisturbed, rtol=1e-6)
+
+
+def test_zero3_torn_snapshot_falls_back_and_reshards(tmp_path):
+    # ZeRO-3: the master shard IS the parameter store, so the elastic
+    # snapshot carries the whole model inside the flat shard buffers —
+    # a torn snapshot must fall back to the previous durable one and
+    # the fallback state must reshard 8 -> 4 (ici 4 -> 2) cleanly
+    net = nn.Sequential([nn.Flatten(), nn.Linear(24, 10)])
+    model, optimizer = amp.initialize(
+        net, optimizers.FusedAdam(lr=1e-2), opt_level="O2",
+        verbosity=0, hard_override=True)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    total = optimizer.init(params).masters.buf.size
+    batches = _batches(10)
+
+    def ici_of(world):
+        return max(world // 2, 1)
+
+    def ospecs_for(world):
+        return amp.zero_optimizer_specs(
+            optimizer, params, "data", zero_stage=3,
+            zero_ici_size=ici_of(world))
+
+    def build_step(world):
+        mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+        ospecs = ospecs_for(world)
+
+        def step(ost, batch):
+            xb, yb = batch
+
+            def loss_fn(m):
+                # just-in-time gather: no replicated params in the state
+                pp = amp.zero_gather_params(m)
+                out, _ = model.apply(pp, xb, train=True)
+                return F.cross_entropy(out, yb)
+
+            loss, g = amp.scaled_grad(loss_fn, ost.masters, ost)
+            _, ost, _ = optimizer.step((), ost, g)
+            return ost, lax.pmean(loss, "data")
+
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(ospecs, (P("data"), P("data"))),
+            out_specs=(ospecs, P()), check_vma=False))
+
+    def init_state(world):
+        mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+        return jax.jit(jax.shard_map(
+            lambda pp: optimizer.init(
+                pp, zero_axis="data", zero_stage=3,
+                zero_ici_size=ici_of(world)),
+            mesh=mesh, in_specs=(P(),), out_specs=ospecs_for(world),
+            check_vma=False))(params)
+
+    def to_host(ost):
+        ost = _np_tree(ost)
+        buf_len = ost.masters.buf.shape[0]
+        old_ici = next(i for i in (4, 2, 1)
+                       if buf_len == 2 * (total + (-total) % i))
+        padded = buf_len // 2
+        ost = _dedup_slices(ost, padded, 2)
+        ost = reshard_flat_state(ost, total, old_ici, 1)
+        return _retree(ost, ospecs_for(2))
+
+    def from_host(ost, world):
+        ici = ici_of(world)
+        ost = reshard_flat_state(ost, total, 1, ici)
+        ost = _tile_slices(ost, total + (-total) % ici, world // ici)
+        return _retree(ost, ospecs_for(world))
+
+    ring = obs.EventRing(256)
+    prev_ring = obs.get_ring()
+    obs.set_ring(ring)
+    faults = TrainingFaults(replica_death=(5, 6),
+                            torn_checkpoint=(4, 5), seed=0, ring=ring)
+    trainer = ElasticTrainer(
+        build_step, init_state(8), world=8, ckpt_dir=str(tmp_path),
+        to_host=to_host, from_host=from_host, faults=faults,
+        config=ElasticConfig(checkpoint_every=2, min_world=2),
+        ring=ring, registry=obs.MetricsRegistry(), run="zero3_torn")
+    try:
+        trainer.run(8, lambda i: batches[i])
+    finally:
+        obs.set_ring(prev_ring)
+
+    # torn step-4 snapshot skipped -> durable step-2 fallback, and the
+    # restored stage-3 state landed resharded on the survivor slice
+    assert trainer.resumed_step == 2
+    assert trainer.world == 4
+    assert trainer.history[-1][0] == 7
+    skipped = [ev for ev in ring.snapshot()
+               if ev["kind"] == "snapshot_skipped"]
+    assert [ev["step"] for ev in skipped] == [4]
+    ost = trainer._state
+    assert ost.masters.buf.shape[0] == 2 * (total + (-total) % ici_of(4))
+    assert ost.masters.layout.zero_ici == ici_of(4)
+
+    # trajectory parity vs an undisturbed world-4 replay from step 2
+    template = to_host(init_state(8))
+    restored = ckpt.restore_checkpoint(str(tmp_path), template, step=2)
+    st = from_host(restored, 4)
+    step4 = build_step(4)
+    undisturbed = []
+    for i in range(2, 8):
+        st, loss = step4(st, batches[i])
+        undisturbed.append(float(loss))
+    post = [loss for s, loss, w in trainer.history if w == 4]
+    np.testing.assert_allclose(post, undisturbed, rtol=1e-6)
+
+
 def test_reshard_flat_state_pads_and_slices_exactly():
     total = 10
     base = np.arange(total, dtype=np.float32)

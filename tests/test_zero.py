@@ -320,3 +320,205 @@ def test_zero1_rides_make_step():
     # second call continues from the updated sharded state
     state, losses2 = train(state, (kx, ky))
     assert float(losses2[-1]) < float(losses[0])
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-2/3: in-slice sharding on the hierarchical fabric
+# ---------------------------------------------------------------------------
+
+def _zero1_reference_masters(model, optimizer, params, bn_state, mesh,
+                             x, y):
+    """Gathered ZeRO-1 masters after one step — the parity baseline for
+    the stage-2/3 variants (stage 1 is itself pinned to flat DDP
+    above)."""
+
+    def loss_fn_of(xb, yb, bn):
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), new_bn
+        return loss_fn
+
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data")
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data"), mesh=mesh,
+        in_specs=(P(),), out_specs=ospecs, check_vma=False))(params)
+
+    def masters(p, os, bn, xb, yb):
+        _, _, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                  has_aux=True)
+        _, os, _ = optimizer.step(p, os, g)
+        return lax.all_gather(os.masters.buf, "data", axis=0, tiled=True)
+
+    m1 = jax.jit(jax.shard_map(
+        masters, mesh=mesh,
+        in_specs=(P(), ospecs, P(), P("data"), P("data")),
+        out_specs=P(), check_vma=False))(params, opt_z, bn_state, x, y)
+    total = optimizer.init(params).masters.buf.size
+    return np.asarray(m1)[:total], total
+
+
+@pytest.mark.parametrize("compress", [False, True],
+                         ids=["fp32-dcn", "bf16-dcn"])
+def test_zero2_masters_match_zero1(compress):
+    """ZeRO-2 (state sharded over the ICI slice, grads reduce-scattered
+    in-slice then psum'd over DCN) must land on the same masters as
+    ZeRO-1 after one step from identical state: the reduction totals
+    are identical, only the scatter geometry differs.  With
+    allreduce-style bf16 compression on the DCN hop the parity loosens
+    to the bf16 rounding of the cross-slice partial sums."""
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x, y = _data()
+    m1, total = _zero1_reference_masters(model, optimizer, params,
+                                         bn_state, mesh, x, y)
+
+    def loss_fn_of(xb, yb, bn):
+        def loss_fn(p):
+            out, new_bn = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), new_bn
+        return loss_fn
+
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data",
+                                      zero_stage=2, zero_ici_size=4,
+                                      zero_compress_bf16=compress)
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data", zero_stage=2,
+                                 zero_ici_size=4,
+                                 zero_compress_bf16=compress),
+        mesh=mesh, in_specs=(P(),), out_specs=ospecs,
+        check_vma=False))(params)
+
+    # each device holds a 1/ici shard (NOT 1/world): the state is
+    # replicated across the two DCN slices
+    shard_sizes = {np.asarray(s.data).size
+                   for s in opt_z.masters.buf.addressable_shards}
+    padded = total + (-total) % 4
+    assert shard_sizes == {padded // 4}
+    assert opt_z.masters.layout.zero_ici == 4
+
+    def z2_masters(p, os, bn, xb, yb):
+        _, _, g = amp.scaled_grad(loss_fn_of(xb, yb, bn), p, os,
+                                  has_aux=True)
+        _, os, _ = optimizer.step(p, os, g)
+        # full-axis gather: the device concat is [slice0's padded
+        # buffer, slice1's padded buffer] back to back
+        return lax.all_gather(os.masters.buf, "data", axis=0,
+                              tiled=True)
+
+    m2 = jax.jit(jax.shard_map(
+        z2_masters, mesh=mesh,
+        in_specs=(P(), ospecs, P(), P("data"), P("data")),
+        out_specs=P(), check_vma=False))(params, opt_z, bn_state, x, y)
+    m2 = np.asarray(m2)
+    # the two DCN slices must hold bitwise-equal state (the DCN reduce
+    # is deterministic and every slice applies the same update)
+    assert m2.shape[0] == 2 * padded
+    np.testing.assert_array_equal(m2[:padded], m2[padded:])
+    tol = 2e-2 if compress else 1e-6
+    np.testing.assert_allclose(m2[:total], m1, atol=tol)
+
+
+def test_zero3_masters_match_zero1():
+    """ZeRO-3: the masters ARE the param store — the forward regathers
+    working-precision params just in time via zero_gather_params and
+    step((), ...) consumes the already-scattered flat grad the gather
+    transpose produces.  One step from identical state must agree with
+    ZeRO-1 to float round-off."""
+    model, optimizer, params, bn_state = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x, y = _data()
+    m1, total = _zero1_reference_masters(model, optimizer, params,
+                                         bn_state, mesh, x, y)
+
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data",
+                                      zero_stage=3, zero_ici_size=4)
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data", zero_stage=3,
+                                 zero_ici_size=4),
+        mesh=mesh, in_specs=(P(),), out_specs=ospecs,
+        check_vma=False))(params)
+
+    def z3_masters(os, bn, xb, yb):
+        def loss_fn(masters):
+            p = amp.zero_gather_params(masters, "data")
+            out, new_bn = model.apply(p, xb, state=bn, train=True)
+            return F.cross_entropy(out, yb), new_bn
+        loss, new_bn, g = amp.scaled_grad(loss_fn, os.masters, os,
+                                          has_aux=True)
+        _, os, _ = optimizer.step((), os, g)
+        ici_groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        full = lax.all_gather(os.masters.buf, "data", axis=0,
+                              tiled=True, axis_index_groups=ici_groups)
+        return full, lax.pmean(loss, "data")
+
+    m3, loss = jax.jit(jax.shard_map(
+        z3_masters, mesh=mesh,
+        in_specs=(ospecs, P(), P("data"), P("data")),
+        out_specs=(P(), P()), check_vma=False))(opt_z, bn_state, x, y)
+    m3 = np.asarray(m3)
+    np.testing.assert_allclose(m3[:total], m1, atol=1e-6)
+    assert np.isfinite(float(loss))
+
+
+def test_zero_knob_validation():
+    """The stage/ici/compress knob triple is validated identically at
+    spec-building time and (inside the mapped trace) at init time —
+    outside shard_map init deliberately degrades to replicated state,
+    so the mapped path is the one that must reject."""
+    model, optimizer, params, _ = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    bad_knobs = (dict(zero_stage=4, zero_ici_size=2),
+                 dict(zero_stage=0),
+                 dict(zero_stage=2),                    # no ici size
+                 dict(zero_stage=3),
+                 dict(zero_stage=1, zero_compress_bf16=True))
+    for bad in bad_knobs:
+        with pytest.raises(ValueError):
+            amp.zero_optimizer_specs(optimizer, params, "data", **bad)
+
+    # one representative through the mapped init (trace-time raise)
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data",
+                                      zero_stage=2, zero_ici_size=4)
+    with pytest.raises(ValueError, match="zero_ici_size"):
+        jax.jit(jax.shard_map(
+            lambda p: optimizer.init(p, zero_axis="data", zero_stage=2),
+            mesh=mesh, in_specs=(P(),), out_specs=ospecs,
+            check_vma=False))(params)
+
+
+def test_zero3_rejects_nonfloat_leaves():
+    """Stage 3 drops the working-precision params entirely, so every
+    leaf must be rebuildable from the fp32 master buffer — an int leaf
+    has no master storage and must be rejected at mapped init."""
+    model, optimizer, params, _ = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    tainted = dict(params)
+    tainted["step_count"] = jnp.zeros((), jnp.int32)
+    with pytest.raises(ValueError, match="non-float"):
+        jax.jit(jax.shard_map(
+            lambda p: optimizer.init(p, zero_axis="data", zero_stage=3,
+                                     zero_ici_size=4),
+            mesh=mesh, in_specs=(P(),),
+            out_specs=jax.tree_util.tree_map(lambda _: P(), tainted),
+            check_vma=False))(tainted)
+
+
+def test_zero3_step_rejects_tree_grads():
+    """Stage-3 step() consumes the flat grad shard produced by the
+    zero_gather_params transpose; feeding it a per-param grad tree (the
+    stage-1/2 shape) must fail loudly instead of silently mis-flattening."""
+    model, optimizer, params, _ = _setup()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ospecs = amp.zero_optimizer_specs(optimizer, params, "data",
+                                      zero_stage=3, zero_ici_size=4)
+    opt_z = jax.jit(jax.shard_map(
+        lambda p: optimizer.init(p, zero_axis="data", zero_stage=3,
+                                 zero_ici_size=4),
+        mesh=mesh, in_specs=(P(),), out_specs=ospecs,
+        check_vma=False))(params)
+    tree_grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    with pytest.raises(ValueError, match="flat grad shard"):
+        jax.jit(jax.shard_map(
+            lambda os, g: optimizer.step((), os, g)[1], mesh=mesh,
+            in_specs=(ospecs, P()), out_specs=ospecs,
+            check_vma=False))(opt_z, tree_grads)
